@@ -1,0 +1,75 @@
+"""ray_tpu: a TPU-native distributed AI runtime with Ray's capabilities.
+
+Public surface mirrors the reference framework's L3 API (python/ray/__init__.py):
+``init/shutdown``, ``remote``, ``get/put/wait``, actors, placement groups, plus the
+library stack (``ray_tpu.data``, ``ray_tpu.train``, ``ray_tpu.serve``, ``ray_tpu.tune``)
+— re-architected for JAX/XLA/Pallas over TPU meshes.
+"""
+
+from ray_tpu.core.api import (
+    ActorClass,
+    ActorHandle,
+    ActorMethod,
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    RemoteFunction,
+    RuntimeContext,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    placement_group,
+    placement_group_table,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "method",
+    "get",
+    "put",
+    "wait",
+    "cancel",
+    "kill",
+    "get_actor",
+    "placement_group",
+    "remove_placement_group",
+    "placement_group_table",
+    "PlacementGroup",
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "ObjectRef",
+    "ObjectRefGenerator",
+    "ActorClass",
+    "ActorHandle",
+    "ActorMethod",
+    "RemoteFunction",
+    "RuntimeContext",
+    "get_runtime_context",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "exceptions",
+    "__version__",
+]

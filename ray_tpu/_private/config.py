@@ -1,0 +1,99 @@
+"""Central flag/config system.
+
+TPU-native analog of the reference's ``RAY_CONFIG(type, name, default)`` X-macro table
+(reference: src/ray/common/ray_config_def.h, ~400 flags materialized by the RayConfig
+singleton in ray_config.h). We keep the same three-tier override model:
+
+1. compiled-in defaults (this file),
+2. per-process env overrides via ``RAY_TPU_<NAME>``,
+3. cluster-wide ``_system_config`` dict passed to ``ray_tpu.init()`` (propagated
+   through the controller, reference: gcs propagation of _system_config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+
+def _env(name: str, default: Any, typ: type) -> Any:
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+@dataclasses.dataclass
+class Config:
+    # --- object store (reference: ray_config_def.h:245 max_direct_call_object_size) ---
+    max_inline_object_size: int = 100 * 1024  # bytes; larger objects go to the shm store
+    object_store_memory: int = 512 * 1024 * 1024  # shm arena size for the node store
+    object_spill_threshold: float = 0.8  # spill to disk when arena this full
+    object_chunk_size: int = 1024 * 1024  # node-to-node transfer chunk (~1MB, object_manager.cc:536)
+
+    # --- scheduling (reference: raylet/scheduling/) ---
+    scheduler_top_k_fraction: float = 0.2  # hybrid top-k pack-then-spread
+    scheduler_spread_threshold: float = 0.5
+    lease_reuse: bool = True  # reuse worker leases per scheduling key (normal_task_submitter.cc)
+    worker_pool_prestart: int = 0
+
+    # --- health / fault tolerance (reference: ray_config_def.h:985-991) ---
+    health_check_initial_delay_s: float = 5.0
+    health_check_period_s: float = 3.0
+    health_check_failure_threshold: int = 5
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+
+    # --- timeouts ---
+    get_timeout_default_s: float | None = None
+    rpc_connect_timeout_s: float = 10.0
+
+    # --- fault injection (reference: rpc_chaos.cc, RAY_testing_rpc_failure) ---
+    testing_rpc_failure: str = ""  # "method=N" comma list: inject N failures for method
+
+    # --- task events / observability (reference: task_event_buffer.h) ---
+    task_events_enabled: bool = True
+    task_events_max_buffer: int = 10000
+
+    # --- logging ---
+    log_to_driver: bool = True
+    session_dir_prefix: str = "/tmp/ray_tpu"
+
+    def apply_env_overrides(self) -> "Config":
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, _env(f.name, getattr(self, f.name), type(getattr(self, f.name)) if getattr(self, f.name) is not None else str))
+        return self
+
+    def apply_system_config(self, system_config: dict | None) -> "Config":
+        if system_config:
+            for k, v in system_config.items():
+                if not hasattr(self, k):
+                    raise ValueError(f"Unknown _system_config key: {k}")
+                setattr(self, k, v)
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "Config":
+        return Config(**json.loads(s))
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config().apply_env_overrides()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,144 @@
+"""Binary IDs for objects/tasks/actors/nodes/jobs.
+
+TPU-native analog of the reference's ID system (src/ray/common/id.h; bit layout spec in
+src/ray/design_docs/id_specification.md). We keep the load-bearing properties:
+
+- ObjectIDs embed the owning TaskID plus a return/put index, so ownership and lineage
+  can be derived from the ID alone (reference: id_specification.md ObjectID layout).
+- TaskIDs embed the ActorID for actor tasks (so actor affinity is derivable).
+- IDs are fixed-width bytes, hashable, hex-printable, cheap to compare.
+
+Layouts (bytes):
+  JobID:    4  random
+  ActorID:  12 = 8 unique + 4 job
+  TaskID:   24 = 8 unique + 4 job + 12 actor (nil actor for normal tasks)
+  ObjectID: 28 = 24 task + 4 index (big-endian; index 0..2^31 = returns, high bit = puts)
+  NodeID:   16 random
+  PlacementGroupID: 16 = 12 unique + 4 job
+  WorkerID: 16 random
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+
+class BaseID:
+    SIZE = 16
+    __slots__ = ("_bytes",)
+
+    def __init__(self, b: bytes):
+        if len(b) != self.SIZE:
+            raise ValueError(f"{type(self).__name__} needs {self.SIZE} bytes, got {len(b)}")
+        self._bytes = b
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    @classmethod
+    def from_hex(cls, h: str):
+        return cls(bytes.fromhex(h))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()[:12]}…)" if not self.is_nil() else f"{type(self).__name__}(nil)"
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class ActorID(BaseID):
+    SIZE = 12
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(8) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[8:])
+
+
+class TaskID(BaseID):
+    SIZE = 24
+
+    @classmethod
+    def for_normal_task(cls, job_id: JobID) -> "TaskID":
+        return cls(os.urandom(8) + job_id.binary() + ActorID.nil().binary())
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(os.urandom(8) + actor_id.job_id().binary() + actor_id.binary())
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        return cls(b"\xff" * 8 + job_id.binary() + ActorID.nil().binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[8:12])
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[12:])
+
+
+_PUT_BIT = 1 << 31
+
+
+class ObjectID(BaseID):
+    SIZE = 28
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + struct.pack(">I", index))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        return cls(task_id.binary() + struct.pack(">I", _PUT_BIT | put_index))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:24])
+
+    def index(self) -> int:
+        return struct.unpack(">I", self._bytes[24:])[0] & ~_PUT_BIT
+
+    def is_put(self) -> bool:
+        return bool(struct.unpack(">I", self._bytes[24:])[0] & _PUT_BIT)
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "PlacementGroupID":
+        return cls(os.urandom(12) + job_id.binary())

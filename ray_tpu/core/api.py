@@ -1,0 +1,508 @@
+"""Public API: init/shutdown, @remote, get/put/wait, actors, placement groups.
+
+Parity: python/ray/_private/worker.py (init :1438, get :2873, put :3024, wait :3080,
+get_actor :3416, kill :3451, cancel :3495, remote :3775),
+python/ray/remote_function.py (RemoteFunction._remote :347),
+python/ray/actor.py (ActorClass._remote :1875, ActorHandle :2266, ActorMethod :848),
+python/ray/util/placement_group.py (PlacementGroup :26, factory :133),
+python/ray/util/scheduling_strategies.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ray_tpu._private.config import Config, get_config, set_config
+from ray_tpu._private.ids import ActorID, NodeID, TaskID
+from ray_tpu.core import runtime as rt_mod
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu.core.runtime import DYNAMIC, STREAMING, Runtime, TaskSpec, get_runtime
+from ray_tpu.core.scheduler import PlacementGroupState
+from ray_tpu.exceptions import PlacementGroupError
+
+_init_lock = threading.Lock()
+
+
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    num_nodes: int = 1,
+    labels: dict[str, str] | None = None,
+    namespace: str | None = None,
+    ignore_reinit_error: bool = False,
+    _system_config: dict | None = None,
+    log_to_driver: bool = True,
+) -> "RuntimeContext":
+    """Start (or connect to) a runtime session.
+
+    ``num_nodes > 1`` creates multiple logical nodes in the single-controller
+    scheduler — the analog of the reference's in-process multi-raylet test Cluster
+    (python/ray/cluster_utils.py:141), and the natural shape for a TPU pod where one
+    controller drives many hosts.
+    """
+    with _init_lock:
+        if rt_mod.get_runtime_or_none() is not None:
+            if ignore_reinit_error:
+                return RuntimeContext(get_runtime())
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        cfg = Config().apply_env_overrides().apply_system_config(_system_config)
+        set_config(cfg)
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        if num_tpus is None:
+            num_tpus = _detect_tpu_chips()
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        if "CPU" not in res:
+            import os
+
+            res["CPU"] = float(os.environ.get("RAY_TPU_NUM_CPUS", max(os.cpu_count() or 1, 8)))
+        node_labels = [dict(labels or {}) for _ in range(num_nodes)]
+        rt = Runtime(cfg, num_nodes=num_nodes, resources_per_node=res, node_labels=node_labels)
+        rt_mod.set_runtime(rt)
+        return RuntimeContext(rt)
+
+
+def _detect_tpu_chips() -> float:
+    """TPU chip discovery (reference: _private/accelerators/tpu.py TPUAcceleratorManager:345)."""
+    import glob
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return 0.0
+    # /dev/accel* on TPU VMs; /dev/vfio/<N> (numeric group nodes only — the
+    # /dev/vfio/vfio control node exists on any vfio-enabled host and is not a chip).
+    accels = glob.glob("/dev/accel*") or [
+        p for p in glob.glob("/dev/vfio/*") if p.rsplit("/", 1)[1].isdigit()
+    ]
+    return float(len(accels))
+
+
+def is_initialized() -> bool:
+    return rt_mod.get_runtime_or_none() is not None
+
+
+def shutdown() -> None:
+    rt = rt_mod.get_runtime_or_none()
+    if rt is not None:
+        rt.shutdown()
+        rt_mod.set_runtime(None)
+
+
+def put(value: Any) -> ObjectRef:
+    return get_runtime().put(value)
+
+
+def get(refs, timeout: float | None = None):
+    rt = get_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get([refs], timeout)[0]
+    if isinstance(refs, list):
+        return rt.get(refs, timeout)
+    raise TypeError(f"get() expects ObjectRef or list, got {type(refs)}")
+
+
+def wait(refs: list[ObjectRef], *, num_returns: int = 1, timeout: float | None = None, fetch_local: bool = True):
+    if not isinstance(refs, list):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return get_runtime().wait(refs, num_returns, timeout, fetch_local)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    get_runtime().cancel(ref, force)
+
+
+def kill(actor: "ActorHandle", *, no_restart: bool = True) -> None:
+    get_runtime().kill_actor(actor._actor_id, no_restart)
+
+
+def get_actor(name: str, namespace: str = "default") -> "ActorHandle":
+    rt = get_runtime()
+    actor_id = rt.get_actor(name, namespace)
+    state = rt.actor_state(actor_id)
+    return ActorHandle(actor_id, state.cls)
+
+
+# ---------------------------------------------------------------------- options
+_DEFAULT_TASK_OPTIONS = dict(
+    num_cpus=1.0,
+    num_tpus=0.0,
+    resources=None,
+    num_returns=1,
+    max_retries=None,
+    retry_exceptions=False,
+    name=None,
+    scheduling_strategy=None,
+    runtime_env=None,
+)
+
+_DEFAULT_ACTOR_OPTIONS = dict(
+    num_cpus=1.0,
+    num_tpus=0.0,
+    resources=None,
+    max_restarts=0,
+    max_task_retries=0,
+    max_concurrency=1,
+    name=None,
+    namespace=None,
+    lifetime=None,
+    get_if_exists=False,
+    scheduling_strategy=None,
+    runtime_env=None,
+    max_pending_calls=-1,
+)
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    """Reference: util/scheduling_strategies.py:17."""
+
+    placement_group: "PlacementGroup"
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Reference: util/scheduling_strategies.py:44."""
+
+    node_id: str
+    soft: bool = False
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Reference: util/scheduling_strategies.py:172."""
+
+    hard: dict[str, str]
+
+
+def _apply_strategy(spec_kwargs: dict, strategy) -> None:
+    if strategy is None or strategy == "DEFAULT":
+        return
+    if strategy == "SPREAD":
+        spec_kwargs["policy"] = "spread"
+    elif isinstance(strategy, PlacementGroupSchedulingStrategy):
+        spec_kwargs["placement_group"] = strategy.placement_group._state
+        spec_kwargs["bundle_index"] = strategy.placement_group_bundle_index
+    elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+        spec_kwargs["policy"] = "node_affinity"
+        spec_kwargs["node_affinity"] = NodeID.from_hex(strategy.node_id)
+        spec_kwargs["node_affinity_soft"] = strategy.soft
+    elif isinstance(strategy, NodeLabelSchedulingStrategy):
+        spec_kwargs["policy"] = "node_label"
+        spec_kwargs["label_selector"] = strategy.hard
+    else:
+        raise ValueError(f"Unknown scheduling strategy: {strategy}")
+
+
+# ---------------------------------------------------------------------- tasks
+class RemoteFunction:
+    """Reference: python/ray/remote_function.py (RemoteFunction; _remote :347)."""
+
+    def __init__(self, fn: Callable, options: dict):
+        self._fn = fn
+        self._options = {**_DEFAULT_TASK_OPTIONS, **options}
+        functools.update_wrapper(self, fn)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = {**self._options, **opts}
+        return RemoteFunction(self._fn, merged)
+
+    def _remote(self, args, kwargs, opts):
+        rt = get_runtime()
+        cfg = get_config()
+        resources = {"CPU": float(opts["num_cpus"])}
+        if opts["num_tpus"]:
+            resources["TPU"] = float(opts["num_tpus"])
+        if opts["resources"]:
+            resources.update(opts["resources"])
+        max_retries = opts["max_retries"]
+        if max_retries is None:
+            max_retries = cfg.task_max_retries_default
+        spec_kwargs: dict = dict(
+            policy="hybrid",
+            node_affinity=None,
+            node_affinity_soft=False,
+            label_selector=None,
+            placement_group=None,
+            bundle_index=-1,
+        )
+        _apply_strategy(spec_kwargs, opts["scheduling_strategy"])
+        spec = TaskSpec(
+            task_id=TaskID.for_normal_task(rt.job_id),
+            func=self._fn,
+            args=args,
+            kwargs=kwargs,
+            num_returns=opts["num_returns"],
+            resources=resources,
+            max_retries=max_retries,
+            retry_exceptions=opts["retry_exceptions"],
+            name=opts["name"] or self._fn.__name__,
+            runtime_env=opts["runtime_env"],
+            **spec_kwargs,
+        )
+        refs = rt.submit_task(spec)
+        if opts["num_returns"] in (STREAMING, DYNAMIC):
+            return ObjectRefGenerator(refs[0].object_id(), rt)
+        if opts["num_returns"] == 1:
+            return refs[0]
+        if opts["num_returns"] == 0:
+            return None
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._fn.__name__}' cannot be called directly; use .remote()."
+        )
+
+
+# ---------------------------------------------------------------------- actors
+class ActorMethod:
+    """Reference: python/ray/actor.py:848 (ActorMethod)."""
+
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns=1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, {"num_returns": self._num_returns})
+
+    def options(self, **opts) -> "ActorMethod":
+        m = ActorMethod(self._handle, self._method_name, opts.get("num_returns", self._num_returns))
+        return m
+
+    def _remote(self, args, kwargs, opts):
+        rt = get_runtime()
+        refs = rt.submit_actor_task(self._handle._actor_id, self._method_name, args, kwargs, opts)
+        n = opts.get("num_returns", 1)
+        if n in (STREAMING, DYNAMIC):
+            return ObjectRefGenerator(refs[0].object_id(), rt)
+        if n == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("Actor methods cannot be called directly; use .remote().")
+
+
+class ActorHandle:
+    """Reference: python/ray/actor.py:2266 (ActorHandle)."""
+
+    def __init__(self, actor_id: ActorID, cls):
+        self._actor_id = actor_id
+        self._cls = cls
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        if not hasattr(self._cls, item):
+            raise AttributeError(f"Actor {self._cls.__name__} has no method '{item}'")
+        opts = getattr(getattr(self._cls, item), "__ray_tpu_method_opts__", {})
+        return ActorMethod(self, item, num_returns=opts.get("num_returns", 1))
+
+    def __reduce__(self):
+        return (_rehydrate_actor_handle, (self._actor_id.binary(), self._cls))
+
+    def __repr__(self):
+        return f"ActorHandle({self._cls.__name__}, {self._actor_id.hex()[:12]})"
+
+
+def _rehydrate_actor_handle(binary: bytes, cls) -> ActorHandle:
+    return ActorHandle(ActorID(binary), cls)
+
+
+class ActorClass:
+    """Reference: python/ray/actor.py:1545 (ActorClass); ._remote :1875."""
+
+    def __init__(self, cls, options: dict):
+        self._cls = cls
+        self._options = {**_DEFAULT_ACTOR_OPTIONS, **options}
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._options)
+
+    def options(self, **opts) -> "ActorClass":
+        return ActorClass(self._cls, {**self._options, **opts})
+
+    def _remote(self, args, kwargs, opts) -> ActorHandle:
+        rt = get_runtime()
+        create_opts = dict(opts)
+        spec_kwargs: dict = {}
+        _apply_strategy(spec_kwargs, opts.get("scheduling_strategy"))
+        if "placement_group" in spec_kwargs:
+            create_opts["placement_group"] = spec_kwargs["placement_group"]
+            create_opts["bundle_index"] = spec_kwargs.get("bundle_index", -1)
+        if spec_kwargs.get("policy"):
+            create_opts["policy"] = spec_kwargs["policy"]
+        if spec_kwargs.get("label_selector"):
+            create_opts["label_selector"] = spec_kwargs["label_selector"]
+        actor_id = rt.create_actor(self._cls, args, kwargs, create_opts)
+        return ActorHandle(actor_id, self._cls)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated directly; use .remote()."
+        )
+
+
+# ---------------------------------------------------------------------- remote
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(**options)`` — reference: worker.py:3775."""
+
+    def make(target):
+        if inspect_isclass(target):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("remote() takes keyword options only, e.g. @remote(num_cpus=2)")
+    return make
+
+
+def inspect_isclass(obj) -> bool:
+    import inspect
+
+    return inspect.isclass(obj)
+
+
+def method(**opts):
+    """``@ray.method(num_returns=...)`` marker — stored for ActorMethod dispatch."""
+
+    def deco(f):
+        f.__ray_tpu_method_opts__ = opts
+        return f
+
+    return deco
+
+
+# ---------------------------------------------------------------------- placement groups
+class PlacementGroup:
+    """Reference: python/ray/util/placement_group.py:26."""
+
+    def __init__(self, state: PlacementGroupState):
+        self._state = state
+
+    @property
+    def id(self):
+        return self._state.pg_id
+
+    def ready(self) -> ObjectRef:
+        """Returns a ref you can ray.get to block until PG is placed."""
+        rt = get_runtime()
+
+        def _wait_ready():
+            ok = self._state.ready_event.wait(timeout=30.0)
+            if not ok:
+                raise PlacementGroupError("Placement group not placed within 30s")
+            return self
+
+        return RemoteFunction(_wait_ready, {"num_cpus": 0}).remote()
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        return self._state.ready_event.wait(timeout_seconds)
+
+    @property
+    def bundle_specs(self) -> list[dict]:
+        return [dict(b.resources) for b in self._state.bundles]
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._state.bundles)
+
+
+def placement_group(
+    bundles: list[dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: str | None = None,
+) -> PlacementGroup:
+    """Reference: util/placement_group.py:133; strategies protobuf common.proto:1088."""
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"Invalid placement strategy: {strategy}")
+    if not bundles:
+        raise ValueError("placement_group requires at least one bundle")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"Invalid bundle: {b}")
+    rt = get_runtime()
+    state = rt.scheduler.create_placement_group(bundles, strategy, name)
+    return PlacementGroup(state)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    get_runtime().scheduler.remove_placement_group(pg._state)
+
+
+def placement_group_table() -> list[dict]:
+    rt = get_runtime()
+    return [
+        {
+            "placement_group_id": pg.pg_id.hex(),
+            "name": pg.name,
+            "strategy": pg.strategy,
+            "state": pg.state,
+            "bundles": [dict(b.resources) for b in pg.bundles],
+            "nodes": [b.node_id.hex() if b.node_id else None for b in pg.bundles],
+        }
+        for pg in rt.scheduler.placement_groups()
+    ]
+
+
+# ---------------------------------------------------------------------- context
+class RuntimeContext:
+    """Reference: python/ray/runtime_context.py."""
+
+    def __init__(self, rt: Runtime):
+        self._rt = rt
+
+    @property
+    def job_id(self):
+        return self._rt.job_id
+
+    def get_node_ids(self) -> list[str]:
+        return [n.node_id.hex() for n in self._rt.scheduler.nodes()]
+
+    def total_resources(self) -> dict[str, float]:
+        return self._rt.scheduler.total_resources()
+
+    def available_resources(self) -> dict[str, float]:
+        return self._rt.scheduler.available_resources()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(get_runtime())
+
+
+def cluster_resources() -> dict[str, float]:
+    return get_runtime().scheduler.total_resources()
+
+
+def available_resources() -> dict[str, float]:
+    return get_runtime().scheduler.available_resources()
+
+
+def nodes() -> list[dict]:
+    return [
+        {
+            "NodeID": n.node_id.hex(),
+            "Alive": n.alive,
+            "Resources": dict(n.total),
+            "Labels": dict(n.labels),
+        }
+        for n in get_runtime().scheduler.nodes()
+    ]

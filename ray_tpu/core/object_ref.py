@@ -1,0 +1,134 @@
+"""ObjectRef: a future handle to a value in the object plane.
+
+Parity: python/ray/_raylet.pyx ObjectRef + python/ray/includes/object_ref.pxi.
+Key behaviors preserved:
+- ``__del__`` decrements the owner's local reference count (distributed refcounting
+  entry point, reference: core_worker/reference_counter.cc local refs).
+- Refs are awaitable (asyncio) and support ``future()``.
+- ``ObjectRefGenerator`` wraps streaming-generator returns
+  (reference: python/ray/_private/object_ref_generator.py:32).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import TYPE_CHECKING, Any, Iterator
+
+from ray_tpu._private.ids import ObjectID
+
+if TYPE_CHECKING:
+    from ray_tpu.core.runtime import Runtime
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_runtime", "_owner_hint", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, runtime: "Runtime | None" = None, owner_hint: str | None = None):
+        self._id = object_id
+        self._runtime = runtime
+        self._owner_hint = owner_hint
+        if runtime is not None:
+            runtime.reference_counter.add_local_ref(object_id)
+
+    # --- identity ---
+    def object_id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    # --- refcounting ---
+    def __del__(self):
+        rt = self._runtime
+        if rt is not None and not rt.is_shutdown:
+            try:
+                rt.reference_counter.remove_local_ref(self._id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Crossing a process/task boundary: the receiver re-binds to its runtime and
+        # becomes a borrower (reference: reference_counter borrowing protocol).
+        from ray_tpu.core import runtime as rt_mod
+
+        return (_rehydrate_ref, (self._id.binary(),))
+
+    # --- awaiting ---
+    def future(self) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            from ray_tpu.core.runtime import get_runtime
+
+            try:
+                fut.set_result(get_runtime().get([self], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _rehydrate_ref(binary: bytes) -> ObjectRef:
+    from ray_tpu.core.runtime import get_runtime_or_none
+
+    rt = get_runtime_or_none()
+    return ObjectRef(ObjectID(binary), rt)
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's incrementally-produced returns.
+
+    Reference: python/ray/_private/object_ref_generator.py:32 (ObjectRefGenerator) fed by
+    HandleReportGeneratorItemReturns (core_worker.cc:3399); producer paced by
+    TaskGeneratorBackpressureWaiter (core_worker/generator_waiter.h:58).
+    """
+
+    def __init__(self, stream_id: ObjectID, runtime: "Runtime"):
+        self._stream_id = stream_id
+        self._runtime = runtime
+        self._next_index = 0
+
+    def __iter__(self) -> Iterator[ObjectRef]:
+        return self
+
+    def __next__(self) -> ObjectRef:
+        ref = self._runtime.next_stream_item(self._stream_id, self._next_index)
+        if ref is None:
+            raise StopIteration
+        self._next_index += 1
+        return ref
+
+    async def __anext__(self) -> ObjectRef:
+        loop = asyncio.get_running_loop()
+        ref = await loop.run_in_executor(None, self._runtime.next_stream_item, self._stream_id, self._next_index)
+        if ref is None:
+            raise StopAsyncIteration
+        self._next_index += 1
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    def completed(self) -> bool:
+        return self._runtime.stream_completed(self._stream_id, self._next_index)

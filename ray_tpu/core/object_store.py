@@ -1,0 +1,135 @@
+"""Object plane: in-process memory store + pluggable node-local shared store.
+
+Parity map (reference):
+- ``MemoryStore`` ≈ CoreWorkerMemoryStore (core_worker/store_provider/memory_store/
+  memory_store.h:48): holds small objects & inlined task returns, blocking Get/Wait with
+  per-object condition variables.
+- ``SharedMemoryStore`` (ray_tpu/core/shm_store.py, C++ arena) ≈ Plasma
+  (src/ray/object_manager/plasma/): node-local shm for large objects, zero-copy reads.
+- ``StoreRouter`` ≈ the CoreWorker's split between memory store and plasma provider
+  (core_worker.cc:1350 GetObjects consults both), promoting objects above
+  ``max_inline_object_size`` to the shared store.
+
+Values are stored as ``RayObject`` (data + optional error), mirroring
+src/ray/common/ray_object.h.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+
+@dataclass
+class RayObject:
+    """A stored value or error (reference: src/ray/common/ray_object.h)."""
+
+    value: Any = None
+    error: BaseException | None = None
+    # serialized blob for shm-backed objects (lazily deserialized)
+    blob: bytes | memoryview | None = None
+    size: int = 0
+
+    def resolve(self) -> Any:
+        if self.error is not None:
+            raise self.error
+        if self.value is None and self.blob is not None:
+            from ray_tpu._private.serialization import deserialize_from_bytes
+
+            return deserialize_from_bytes(self.blob)
+        return self.value
+
+
+class MemoryStore:
+    """Thread-safe in-process object store with blocking get/wait."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: dict[ObjectID, RayObject] = {}
+        self._cv = threading.Condition(self._lock)
+        self._deleted: set[ObjectID] = set()
+
+    def put(self, object_id: ObjectID, obj: RayObject) -> None:
+        with self._cv:
+            self._objects[object_id] = obj
+            self._deleted.discard(object_id)
+            self._cv.notify_all()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_if_exists(self, object_id: ObjectID) -> RayObject | None:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def was_deleted(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._deleted
+
+    def unmark_deleted(self, object_id: ObjectID) -> None:
+        """Recovery started: subsequent gets should block for the re-put value."""
+        with self._cv:
+            self._deleted.discard(object_id)
+            self._cv.notify_all()
+
+    def get(self, object_ids: list[ObjectID], timeout: float | None = None) -> list[RayObject]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: list[RayObject] = []
+        for oid in object_ids:
+            with self._cv:
+                while oid not in self._objects:
+                    if oid in self._deleted:
+                        raise ObjectLostError(oid.hex())
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise GetTimeoutError(f"Get timed out waiting for {oid.hex()}")
+                    self._cv.wait(remaining if remaining is not None else 1.0)
+                out.append(self._objects[oid])
+        return out
+
+    def wait(
+        self,
+        object_ids: list[ObjectID],
+        num_returns: int,
+        timeout: float | None,
+        fetch_local: bool = True,
+    ) -> tuple[list[ObjectID], list[ObjectID]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [oid for oid in object_ids if oid in self._objects]
+                if len(ready) >= num_returns:
+                    ready = ready[:num_returns]
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._cv.wait(remaining if remaining is not None else 1.0)
+            ready_set = set(ready)
+            not_ready = [oid for oid in object_ids if oid not in ready_set]
+            return ready, not_ready
+
+    def delete(self, object_ids: Iterable[ObjectID]) -> None:
+        with self._cv:
+            for oid in object_ids:
+                self._objects.pop(oid, None)
+                self._deleted.add(oid)
+            self._cv.notify_all()
+
+    def evict(self, object_ids: Iterable[ObjectID]) -> None:
+        """Simulate loss (for lineage-reconstruction tests and memory pressure)."""
+        self.delete(object_ids)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(o.size for o in self._objects.values())

@@ -1,0 +1,148 @@
+"""Distributed reference counting with ownership, borrowing, and lineage pinning.
+
+Parity: src/ray/core_worker/reference_counter.cc (class ReferenceCounter,
+reference_counter.h:44). The reference tracks, per object:
+  - local references (ObjectRef instances in this process),
+  - submitted-task references (the object is an argument of an in-flight task),
+  - borrowers (other workers holding refs),
+  - lineage refcount (objects whose recreating task must stay resubmittable).
+
+In the single-controller runtime the counter is authoritative for the whole session
+(the controller owns the metadata the way each reference worker owns its objects);
+per-process borrow bookkeeping collapses to entries tagged with worker ids. The
+observable behavior preserved: an object becomes eligible for eviction exactly when
+local refs + submitted-task refs + borrower count hit zero, and lineage is released
+when no downstream object needs reconstruction.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ray_tpu._private.ids import ObjectID, TaskID
+
+
+@dataclass
+class Reference:
+    local_refs: int = 0
+    submitted_task_refs: int = 0
+    borrowers: set = field(default_factory=set)
+    # Lineage: number of downstream objects whose reconstruction depends on this one
+    lineage_refs: int = 0
+    pinned: bool = False  # pinned primary copy (e.g. while spilling)
+
+    def total(self) -> int:
+        return self.local_refs + self.submitted_task_refs + len(self.borrowers) + self.lineage_refs
+
+
+class ReferenceCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._refs: dict[ObjectID, Reference] = {}
+        self._on_zero: list[Callable[[ObjectID], None]] = []
+
+    def add_on_zero_callback(self, cb: Callable[[ObjectID], None]) -> None:
+        self._on_zero.append(cb)
+
+    def _ref(self, oid: ObjectID) -> Reference:
+        r = self._refs.get(oid)
+        if r is None:
+            r = self._refs[oid] = Reference()
+        return r
+
+    # --- local refs (ObjectRef lifecycle) ---
+    def add_local_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._ref(oid).local_refs += 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        self._decrement(oid, "local_refs")
+
+    # --- submitted task refs (object used as task arg) ---
+    def add_submitted_task_refs(self, oids: list[ObjectID]) -> None:
+        with self._lock:
+            for oid in oids:
+                self._ref(oid).submitted_task_refs += 1
+
+    def remove_submitted_task_refs(self, oids: list[ObjectID]) -> None:
+        for oid in oids:
+            self._decrement(oid, "submitted_task_refs")
+
+    # --- borrowing (ref serialized into another worker/task) ---
+    def add_borrower(self, oid: ObjectID, borrower_id) -> None:
+        with self._lock:
+            self._ref(oid).borrowers.add(borrower_id)
+
+    def remove_borrower(self, oid: ObjectID, borrower_id) -> None:
+        zero = False
+        with self._lock:
+            r = self._refs.get(oid)
+            if r is None:
+                return
+            r.borrowers.discard(borrower_id)
+            zero = r.total() == 0 and not r.pinned
+        if zero:
+            self._fire_zero(oid)
+
+    # --- lineage pinning ---
+    def add_lineage_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._ref(oid).lineage_refs += 1
+
+    def remove_lineage_ref(self, oid: ObjectID) -> None:
+        self._decrement(oid, "lineage_refs")
+
+    def pin(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._ref(oid).pinned = True
+
+    def unpin(self, oid: ObjectID) -> None:
+        zero = False
+        with self._lock:
+            r = self._refs.get(oid)
+            if r is None:
+                return
+            r.pinned = False
+            zero = r.total() == 0
+        if zero:
+            self._fire_zero(oid)
+
+    def _decrement(self, oid: ObjectID, field_name: str) -> None:
+        zero = False
+        with self._lock:
+            r = self._refs.get(oid)
+            if r is None:
+                return
+            setattr(r, field_name, max(0, getattr(r, field_name) - 1))
+            zero = r.total() == 0 and not r.pinned
+        if zero:
+            self._fire_zero(oid)
+
+    def _fire_zero(self, oid: ObjectID) -> None:
+        with self._lock:
+            # Re-check: a concurrent add (e.g. a deserialized ref) may have revived it
+            # between the caller's zero check and here.
+            r = self._refs.get(oid)
+            if r is None or r.total() > 0 or r.pinned:
+                return
+            self._refs.pop(oid, None)
+        for cb in self._on_zero:
+            try:
+                cb(oid)
+            except Exception:
+                pass
+
+    # --- introspection (state API / tests) ---
+    def ref_count(self, oid: ObjectID) -> int:
+        with self._lock:
+            r = self._refs.get(oid)
+            return 0 if r is None else r.total()
+
+    def has_reference(self, oid: ObjectID) -> bool:
+        return self.ref_count(oid) > 0
+
+    def all_references(self) -> dict[ObjectID, Reference]:
+        with self._lock:
+            return dict(self._refs)

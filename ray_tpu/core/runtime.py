@@ -1,0 +1,902 @@
+"""The core runtime: task submission/execution, actors, object resolution, recovery.
+
+This is the single-controller runtime that fuses the responsibilities of the
+reference's three C++ planes at session scope:
+
+- CoreWorker task submission (src/ray/core_worker/task_submission/normal_task_submitter.cc
+  SubmitTask:34): ``Runtime.submit_task`` resolves dependencies, acquires a lease from the
+  scheduler, and dispatches to a worker.
+- Raylet lease manager (raylet/scheduling/cluster_lease_manager.cc:45): the dispatcher
+  loop queues infeasible work and re-runs placement whenever resources free up.
+- TaskManager lineage (core_worker/task_manager.cc; task_manager.h:238): every return
+  object's creating TaskSpec is retained while reachable, so lost objects are recovered
+  by re-execution (ObjectRecoveryManager semantics, object_recovery_manager.h:41).
+- Actor lifecycle (gcs/gcs_actor_manager.cc state machine
+  DEPENDENCIES_UNREADY→ALIVE→RESTARTING→DEAD, restarts ≤ max_restarts).
+- Streaming generators (core_worker.cc:3399 HandleReportGeneratorItemReturns +
+  generator_waiter.h backpressure).
+
+Execution backends: local mode runs tasks on threads gated by the resource scheduler
+(one logical node per configured node); cluster mode (ray_tpu/core/cluster.py) runs the
+same TaskSpecs on forked worker processes over the shared-memory object plane.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import inspect
+import logging
+import queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config, get_config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu.core.object_store import MemoryStore, RayObject
+from ray_tpu.core.reference_counter import ReferenceCounter
+from ray_tpu.core.scheduler import (
+    ClusterScheduler,
+    PlacementGroupState,
+    ResourceSet,
+    SchedulingRequest,
+)
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+)
+
+logger = logging.getLogger("ray_tpu")
+
+STREAMING = "streaming"
+DYNAMIC = "dynamic"
+
+
+@dataclass
+class TaskSpec:
+    """Immutable description of one task invocation.
+
+    Reference: src/ray/common/task/task_spec.h (TaskSpecification) — function
+    descriptor, args (by-ref or by-value), num returns, resources, retry policy,
+    scheduling strategy.
+    """
+
+    task_id: TaskID
+    func: Callable | None
+    args: tuple
+    kwargs: dict
+    num_returns: int | str
+    resources: dict[str, float]
+    max_retries: int = 0
+    retry_exceptions: bool | tuple = False
+    name: str = ""
+    # scheduling
+    policy: str = "hybrid"
+    node_affinity: NodeID | None = None
+    node_affinity_soft: bool = False
+    label_selector: dict[str, str] | None = None
+    placement_group: PlacementGroupState | None = None
+    bundle_index: int = -1
+    # actor linkage
+    actor_id: ActorID | None = None
+    method_name: str = ""
+    is_actor_creation: bool = False
+    runtime_env: dict | None = None
+
+    def return_ids(self) -> list[ObjectID]:
+        n = 1 if isinstance(self.num_returns, str) else self.num_returns
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(max(n, 1))]
+
+    def desc(self) -> str:
+        return self.name or (self.func.__name__ if self.func else self.method_name)
+
+
+@dataclass
+class _TaskEntry:
+    spec: TaskSpec
+    attempts: int = 0
+    state: str = "PENDING"  # PENDING/RUNNING/FINISHED/FAILED/CANCELLED
+    node_id: NodeID | None = None
+    cancelled: bool = False
+    thread: threading.Thread | None = None
+    submit_time: float = field(default_factory=time.time)
+    start_time: float | None = None
+    end_time: float | None = None
+    error: str | None = None
+
+
+@dataclass
+class _StreamState:
+    items: list[ObjectID] = field(default_factory=list)
+    done: bool = False
+    error: BaseException | None = None
+    cv: threading.Condition = field(default_factory=threading.Condition)
+
+
+class _ActorState:
+    """Server-side actor record + mailbox.
+
+    Mirrors GcsActorManager's lifecycle record plus the executing worker's
+    TaskReceiver ordered queue (task_receiver.cc:144 QueueTaskForExecution).
+    """
+
+    def __init__(self, actor_id: ActorID, cls, args, kwargs, options: dict):
+        self.actor_id = actor_id
+        self.cls = cls
+        self.init_args = args
+        self.init_kwargs = kwargs
+        self.options = options
+        self.name: str | None = options.get("name")
+        self.namespace: str = options.get("namespace") or "default"
+        self.max_restarts = options.get("max_restarts", 0)
+        self.max_task_retries = options.get("max_task_retries", 0)
+        self.max_concurrency = options.get("max_concurrency", 1)
+        self.num_restarts = 0
+        self.state = "DEPENDENCIES_UNREADY"
+        self.instance: Any = None
+        self.mailbox: "queue.Queue[tuple[TaskSpec, ObjectID] | None]" = queue.Queue()
+        self.threads: list[threading.Thread] = []
+        self.node_id: NodeID | None = None
+        self.sched_req: SchedulingRequest | None = None
+        self.death_cause: str | None = None
+        self.is_async = False
+        self.loop = None  # asyncio loop for async actors
+        self.lock = threading.Lock()
+        self.pending_count = 0
+
+
+class Runtime:
+    def __init__(
+        self,
+        config: Config,
+        num_nodes: int = 1,
+        resources_per_node: dict[str, float] | None = None,
+        node_labels: list[dict[str, str]] | None = None,
+    ):
+        self.config = config
+        self.job_id = JobID.from_random()
+        self.driver_task_id = TaskID.for_driver(self.job_id)
+        self.is_shutdown = False
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter()
+        self.scheduler = ClusterScheduler(config)
+        self.reference_counter.add_on_zero_callback(self._on_ref_zero)
+
+        import os
+
+        default_cpus = float(os.environ.get("RAY_TPU_NUM_CPUS", max(os.cpu_count() or 1, 8)))
+        for i in range(num_nodes):
+            res = dict(resources_per_node or {"CPU": default_cpus})
+            labels = (node_labels[i] if node_labels and i < len(node_labels) else {})
+            self.scheduler.add_node(res, labels=labels)
+
+        self._tasks: dict[TaskID, _TaskEntry] = {}
+        self._lineage: dict[ObjectID, TaskSpec] = {}
+        self._streams: dict[ObjectID, _StreamState] = {}
+        self._actors: dict[ActorID, _ActorState] = {}
+        self._named_actors: dict[tuple[str, str], ActorID] = {}
+        self._lock = threading.Lock()
+        self._put_index = 0
+        self._recovering: set[ObjectID] = set()
+        self._pending_queue: "queue.Queue[TaskID]" = queue.Queue()
+        self._dispatcher = threading.Thread(target=self._dispatch_loop, daemon=True, name="ray_tpu-dispatcher")
+        self._dispatcher.start()
+        self._task_events: list[dict] = []
+
+    # ------------------------------------------------------------------ objects
+    def put(self, value: Any) -> ObjectRef:
+        """Reference: CoreWorker::Put (core_worker.cc:1026) + worker.py:3024 ray.put."""
+        with self._lock:
+            self._put_index += 1
+            oid = ObjectID.for_put(self.driver_task_id, self._put_index)
+        self._store_value(oid, value)
+        return ObjectRef(oid, self)
+
+    def _store_value(self, oid: ObjectID, value: Any) -> None:
+        with self._lock:
+            self._recovering.discard(oid)
+        if isinstance(value, BaseException):
+            self.memory_store.put(oid, RayObject(error=value))
+            return
+        size = _rough_size(value)
+        self.memory_store.put(oid, RayObject(value=value, size=size))
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        """Reference: CoreWorker::Get (core_worker.cc:1297) with the
+        fetch-or-reconstruct loop of the plasma provider; here object loss triggers
+        lineage re-execution directly (object_recovery_manager.h:41)."""
+        ids = [r.object_id() for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: list[Any] = []
+        for oid in ids:
+            while True:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                try:
+                    obj = self.memory_store.get([oid], timeout=remaining)[0]
+                except ObjectLostError:
+                    self._recover_object(oid)
+                    continue
+                val = self._resolve_obj(oid, obj)
+                if val is _RETRY:
+                    continue
+                out.append(val)
+                break
+        return out
+
+    _sentinel = object()
+
+    def _resolve_obj(self, oid: ObjectID, obj: RayObject):
+        if obj.error is not None:
+            if isinstance(obj.error, ObjectLostError):
+                self._recover_object(oid)
+                return _RETRY
+            raise obj.error
+        return obj.resolve()
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ids = [r.object_id() for r in refs]
+        ready_ids, not_ready_ids = self.memory_store.wait(ids, num_returns, timeout)
+        by_id = {r.object_id(): r for r in refs}
+        return [by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids]
+
+    def _on_ref_zero(self, oid: ObjectID) -> None:
+        # Out of scope everywhere -> evict value and release lineage
+        self.memory_store.delete([oid])
+        with self._lock:
+            spec = self._lineage.pop(oid, None)
+        if spec is not None:
+            for dep in _ref_args(spec.args, spec.kwargs):
+                self.reference_counter.remove_lineage_ref(dep.object_id())
+
+    def free(self, refs: list[ObjectRef]) -> None:
+        self.memory_store.delete([r.object_id() for r in refs])
+
+    # ------------------------------------------------------------------ recovery
+    def _recover_object(self, oid: ObjectID) -> None:
+        """Lineage reconstruction: re-execute the creating task.
+
+        Reference: TaskManager resubmit path (task_manager.h:595
+        GetOngoingLineageReconstructionTasks) + ObjectRecoveryManager.
+        """
+        with self._lock:
+            spec = self._lineage.get(oid)
+            if spec is not None:
+                if oid in self._recovering:
+                    self.memory_store.unmark_deleted(oid)
+                    return  # reconstruction already in flight; get() will block on it
+                self._recovering.add(oid)
+        if spec is None:
+            raise ObjectLostError(oid.hex())
+        self.memory_store.unmark_deleted(oid)
+        logger.info("Reconstructing %s by re-executing task %s", oid.hex()[:12], spec.desc())
+        # Recursively recover lost deps first.
+        for dep in _ref_args(spec.args, spec.kwargs):
+            doid = dep.object_id()
+            if not self.memory_store.contains(doid):
+                self._recover_object(doid)
+        self._enqueue(spec)
+
+    # ------------------------------------------------------------------ tasks
+    def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        if self.is_shutdown:
+            raise RuntimeError("ray_tpu runtime is shut down")
+        dep_refs = _ref_args(spec.args, spec.kwargs)
+        self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
+        return_ids = spec.return_ids()
+        with self._lock:
+            for rid in return_ids:
+                self._lineage[rid] = spec
+            for dep in dep_refs:
+                self.reference_counter.add_lineage_ref(dep.object_id())
+            self._tasks[spec.task_id] = _TaskEntry(spec)
+        if isinstance(spec.num_returns, str):
+            self._streams[return_ids[0]] = _StreamState()
+        self._record_event(spec, "PENDING")
+        self._enqueue(spec)
+        refs = [ObjectRef(rid, self) for rid in return_ids]
+        if spec.num_returns == STREAMING or spec.num_returns == DYNAMIC:
+            return refs  # caller wraps in ObjectRefGenerator
+        return refs
+
+    def _enqueue(self, spec: TaskSpec) -> None:
+        with self._lock:
+            entry = self._tasks.get(spec.task_id)
+            if entry is None:
+                entry = self._tasks[spec.task_id] = _TaskEntry(spec)
+            entry.state = "PENDING"
+        self._pending_queue.put(spec.task_id)
+
+    def _dispatch_loop(self) -> None:
+        """The lease/dispatch loop (cluster_lease_manager.cc ScheduleAndGrantLeases)."""
+        waiting: list[TaskID] = []
+        while not self.is_shutdown:
+            try:
+                tid = self._pending_queue.get(timeout=0.05)
+                waiting.append(tid)
+            except queue.Empty:
+                pass
+            if not waiting:
+                continue
+            still_waiting: list[TaskID] = []
+            for tid in waiting:
+                with self._lock:
+                    entry = self._tasks.get(tid)
+                if entry is None or entry.cancelled:
+                    if entry is not None:
+                        self._finish_cancelled(entry)
+                    continue
+                dep_state = self._deps_ready(entry.spec)
+                if dep_state == "FAILED":
+                    entry.state = "FAILED"
+                    self._record_event(entry.spec, "FAILED")
+                    continue
+                if dep_state == "WAITING":
+                    still_waiting.append(tid)
+                    continue
+                req = _sched_request(entry.spec)
+                node_id = self.scheduler.try_acquire(req)
+                if node_id is None:
+                    still_waiting.append(tid)
+                    continue
+                entry.node_id = node_id
+                entry.state = "RUNNING"
+                entry.start_time = time.time()
+                t = threading.Thread(
+                    target=self._execute_task, args=(entry, req), daemon=True,
+                    name=f"ray_tpu-worker-{entry.spec.desc()[:24]}",
+                )
+                entry.thread = t
+                t.start()
+            if len(still_waiting) == len(waiting) and still_waiting:
+                # nothing schedulable: wait for resources/objects to change
+                self.scheduler.wait_for_change(0.02)
+            waiting = still_waiting
+
+    def _deps_ready(self, spec: TaskSpec) -> str:
+        """Returns READY / WAITING / FAILED for this task's ObjectRef dependencies."""
+        for dep in _ref_args(spec.args, spec.kwargs):
+            oid = dep.object_id()
+            if not self.memory_store.contains(oid):
+                if self.memory_store.was_deleted(oid):
+                    try:
+                        self._recover_object(oid)
+                    except ObjectLostError:
+                        # Permanently lost (no lineage, e.g. a freed put): fail the task
+                        # instead of queueing forever.
+                        self._store_error(spec, ObjectLostError(oid.hex()))
+                        return "FAILED"
+                return "WAITING"
+        return "READY"
+
+    def _execute_task(self, entry: _TaskEntry, req: SchedulingRequest) -> None:
+        spec = entry.spec
+        self._record_event(spec, "RUNNING")
+        try:
+            args, kwargs = self._resolve_args(spec)
+            if spec.is_actor_creation:
+                self._execute_actor_creation(spec, args, kwargs)
+                return  # actor holds its lease until death
+            if isinstance(spec.num_returns, str):
+                self._execute_generator(entry, args, kwargs)
+            else:
+                result = self._run_user_fn(entry, spec.func, args, kwargs)
+                self._store_returns(spec, result)
+            entry.state = "FINISHED"
+            self._record_event(spec, "FINISHED")
+        except TaskCancelledError as e:
+            self._store_error(spec, e)
+            entry.state = "CANCELLED"
+            self._record_event(spec, "CANCELLED")
+        except BaseException as e:  # noqa: BLE001
+            self._handle_task_failure(entry, e)
+        finally:
+            entry.end_time = time.time()
+            if not spec.is_actor_creation:
+                self.scheduler.release(entry.node_id, req)
+            self.reference_counter.remove_submitted_task_refs(
+                [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+            )
+
+    def _run_user_fn(self, entry: _TaskEntry, fn, args, kwargs):
+        if entry.cancelled:
+            raise TaskCancelledError(entry.spec.desc())
+        return fn(*args, **kwargs)
+
+    def _handle_task_failure(self, entry: _TaskEntry, exc: BaseException) -> None:
+        spec = entry.spec
+        retry_ok = spec.max_retries > entry.attempts and _should_retry(spec, exc)
+        if retry_ok:
+            entry.attempts += 1
+            logger.warning(
+                "Task %s failed (%s); retry %d/%d", spec.desc(), type(exc).__name__,
+                entry.attempts, spec.max_retries,
+            )
+            self._record_event(spec, "RETRYING")
+            self._enqueue(spec)
+            return
+        entry.state = "FAILED"
+        entry.error = repr(exc)
+        self._record_event(spec, "FAILED")
+        self._store_error(spec, TaskError(exc, spec.desc()))
+
+    def _store_returns(self, spec: TaskSpec, result: Any) -> None:
+        rids = spec.return_ids()
+        if spec.num_returns == 1 or isinstance(spec.num_returns, str):
+            self._store_value(rids[0], result)
+            return
+        if spec.num_returns == 0:
+            return
+        if not isinstance(result, (tuple, list)) or len(result) != spec.num_returns:
+            raise ValueError(
+                f"Task {spec.desc()} declared num_returns={spec.num_returns} but returned {type(result)}"
+            )
+        for rid, val in zip(rids, result):
+            self._store_value(rid, val)
+
+    def _store_error(self, spec: TaskSpec, err: BaseException) -> None:
+        for rid in spec.return_ids():
+            self.memory_store.put(rid, RayObject(error=err))
+        stream = self._streams.get(spec.return_ids()[0])
+        if stream is not None:
+            with stream.cv:
+                stream.error = err
+                stream.done = True
+                stream.cv.notify_all()
+
+    def _resolve_args(self, spec: TaskSpec) -> tuple[tuple, dict]:
+        def res(a):
+            if isinstance(a, ObjectRef):
+                return self.get([a])[0]
+            return a
+
+        return tuple(res(a) for a in spec.args), {k: res(v) for k, v in spec.kwargs.items()}
+
+    # ------------------------------------------------------------------ streaming
+    def _execute_generator(self, entry: _TaskEntry, args, kwargs) -> None:
+        spec = entry.spec
+        stream_id = spec.return_ids()[0]
+        stream = self._streams[stream_id]
+        gen = spec.func(*args, **kwargs)
+        index = 0
+        for item in gen:
+            if entry.cancelled:
+                raise TaskCancelledError(spec.desc())
+            item_id = ObjectID.for_task_return(spec.task_id, index + 1)
+            self._store_value(item_id, item)
+            with self._lock:
+                self._lineage[item_id] = spec  # lineage covers stream items too
+            with stream.cv:
+                stream.items.append(item_id)
+                stream.cv.notify_all()
+            index += 1
+        with stream.cv:
+            stream.done = True
+            stream.cv.notify_all()
+        self.memory_store.put(stream_id, RayObject(value=index, size=8))
+
+    def next_stream_item(self, stream_id: ObjectID, index: int) -> ObjectRef | None:
+        stream = self._streams.get(stream_id)
+        if stream is None:
+            return None
+        with stream.cv:
+            while True:
+                if index < len(stream.items):
+                    return ObjectRef(stream.items[index], self)
+                if stream.done:
+                    if stream.error is not None and index == len(stream.items):
+                        raise stream.error
+                    return None
+                stream.cv.wait(1.0)
+
+    def stream_completed(self, stream_id: ObjectID, index: int) -> bool:
+        stream = self._streams.get(stream_id)
+        return stream is not None and stream.done and index >= len(stream.items)
+
+    # ------------------------------------------------------------------ cancel
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        """Reference: ray.cancel (worker.py:3495) → CoreWorker::CancelTask."""
+        tid = ref.object_id().task_id()
+        with self._lock:
+            entry = self._tasks.get(tid)
+        if entry is None:
+            return
+        entry.cancelled = True
+        if entry.state == "RUNNING" and entry.thread is not None and force:
+            _async_raise(entry.thread, TaskCancelledError)
+        if entry.state == "PENDING":
+            self._finish_cancelled(entry)
+
+    def _finish_cancelled(self, entry: _TaskEntry) -> None:
+        entry.state = "CANCELLED"
+        self._store_error(entry.spec, TaskCancelledError(entry.spec.desc()))
+        self._record_event(entry.spec, "CANCELLED")
+
+    # ------------------------------------------------------------------ actors
+    def create_actor(self, cls, args, kwargs, options: dict) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        state = _ActorState(actor_id, cls, args, kwargs, options)
+        name = options.get("name")
+        if name:
+            key = (state.namespace, name)
+            with self._lock:
+                if key in self._named_actors:
+                    if options.get("get_if_exists"):
+                        return self._named_actors[key]
+                    raise ValueError(f"Actor with name '{name}' already exists in namespace '{state.namespace}'")
+                self._named_actors[key] = actor_id
+        with self._lock:
+            self._actors[actor_id] = state
+        state.is_async = any(
+            inspect.iscoroutinefunction(getattr(cls, m, None))
+            for m in dir(cls)
+            if not m.startswith("__")
+        )
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(actor_id),
+            func=None,
+            args=args,
+            kwargs=kwargs,
+            num_returns=1,
+            resources=options.get("resources_full") or {"CPU": options.get("num_cpus", 1.0), **(options.get("resources") or {})},
+            name=f"{cls.__name__}.__init__",
+            policy=options.get("policy", "hybrid"),
+            label_selector=options.get("label_selector"),
+            placement_group=options.get("placement_group"),
+            bundle_index=options.get("bundle_index", -1),
+            actor_id=actor_id,
+            is_actor_creation=True,
+            max_retries=0,
+        )
+        tpu = options.get("num_tpus", 0)
+        if tpu:
+            spec.resources["TPU"] = tpu
+        self.submit_task(spec)
+        return actor_id
+
+    def _execute_actor_creation(self, spec: TaskSpec, args, kwargs) -> None:
+        state = self._actors[spec.actor_id]
+        if state.state == "DEAD":
+            # killed while the creation task was queued: don't resurrect
+            self._store_error(spec, ActorDiedError(state.death_cause or "actor was killed"))
+            self.scheduler.release(self._tasks[spec.task_id].node_id, _sched_request(spec))
+            return
+        state.node_id = self._tasks[spec.task_id].node_id
+        state.sched_req = _sched_request(spec)
+        try:
+            state.instance = state.cls(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            state.state = "DEAD"
+            state.death_cause = f"__init__ failed: {e!r}"
+            self._store_error(spec, TaskError(e, spec.desc()))
+            self._drain_mailbox(state, ActorDiedError(state.death_cause))
+            self.scheduler.release(state.node_id, state.sched_req)
+            return
+        state.state = "ALIVE"
+        self._store_value(spec.return_ids()[0], None)  # creation done marker
+        for i in range(max(1, state.max_concurrency)):
+            t = threading.Thread(
+                target=self._actor_loop, args=(state,), daemon=True,
+                name=f"ray_tpu-actor-{state.cls.__name__}-{i}",
+            )
+            state.threads.append(t)
+            t.start()
+
+    def _actor_loop(self, state: _ActorState) -> None:
+        """Per-actor execution loop: ordered mailbox (task_receiver.cc ordered queues)."""
+        import asyncio
+
+        if state.is_async and state.loop is None:
+            with state.lock:
+                if state.loop is None:
+                    state.loop = asyncio.new_event_loop()
+                    threading.Thread(target=state.loop.run_forever, daemon=True).start()
+        while True:
+            item = state.mailbox.get()
+            if item is None:
+                return
+            spec, _ = item
+            entry = self._tasks.get(spec.task_id)
+            if entry is not None and entry.cancelled:
+                self._finish_cancelled(entry)
+                continue
+            if entry:
+                entry.state = "RUNNING"
+                entry.start_time = time.time()
+            self._record_event(spec, "RUNNING")
+            try:
+                args, kwargs = self._resolve_args(spec)
+                method = getattr(state.instance, spec.method_name)
+                if inspect.iscoroutinefunction(method):
+                    fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), state.loop)
+                    result = fut.result()
+                elif isinstance(spec.num_returns, str):
+                    self._execute_actor_generator(spec, method, args, kwargs)
+                    result = _NO_STORE
+                else:
+                    result = method(*args, **kwargs)
+                if result is not _NO_STORE:
+                    self._store_returns(spec, result)
+                if entry:
+                    entry.state = "FINISHED"
+                    entry.end_time = time.time()
+                self._record_event(spec, "FINISHED")
+            except BaseException as e:  # noqa: BLE001
+                if entry:
+                    entry.state = "FAILED"
+                    entry.end_time = time.time()
+                self._record_event(spec, "FAILED")
+                self._store_error(spec, TaskError(e, spec.desc()))
+            finally:
+                self.reference_counter.remove_submitted_task_refs(
+                    [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+                )
+                with state.lock:
+                    state.pending_count -= 1
+
+    def _execute_actor_generator(self, spec: TaskSpec, method, args, kwargs) -> None:
+        stream_id = spec.return_ids()[0]
+        stream = self._streams.setdefault(stream_id, _StreamState())
+        index = 0
+        for item in method(*args, **kwargs):
+            item_id = ObjectID.for_task_return(spec.task_id, index + 1)
+            self._store_value(item_id, item)
+            with stream.cv:
+                stream.items.append(item_id)
+                stream.cv.notify_all()
+            index += 1
+        with stream.cv:
+            stream.done = True
+            stream.cv.notify_all()
+        self.memory_store.put(stream_id, RayObject(value=index, size=8))
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs, options: dict) -> list[ObjectRef]:
+        """Reference: CoreWorker::SubmitActorTask (core_worker.cc:2386) via
+        ActorTaskSubmitter sequential queues."""
+        state = self._actors.get(actor_id)
+        if state is None:
+            raise ActorDiedError("Actor handle refers to unknown actor.")
+        if state.state == "DEAD":
+            spec = self._make_actor_task_spec(actor_id, method_name, args, kwargs, options)
+            self._store_error(spec, ActorDiedError(state.death_cause or "actor is dead"))
+            return [ObjectRef(r, self) for r in spec.return_ids()]
+        spec = self._make_actor_task_spec(actor_id, method_name, args, kwargs, options)
+        dep_refs = _ref_args(spec.args, spec.kwargs)
+        self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
+        with self._lock:
+            self._tasks[spec.task_id] = _TaskEntry(spec)
+            for rid in spec.return_ids():
+                self._lineage.setdefault(rid, spec)
+        if isinstance(spec.num_returns, str):
+            self._streams[spec.return_ids()[0]] = _StreamState()
+        with state.lock:
+            state.pending_count += 1
+        self._record_event(spec, "PENDING")
+        state.mailbox.put((spec, spec.return_ids()[0]))
+        return [ObjectRef(r, self) for r in spec.return_ids()]
+
+    def _make_actor_task_spec(self, actor_id, method_name, args, kwargs, options) -> TaskSpec:
+        return TaskSpec(
+            task_id=TaskID.for_actor_task(actor_id),
+            func=None,
+            args=args,
+            kwargs=kwargs,
+            num_returns=options.get("num_returns", 1),
+            resources={},
+            name=f"{method_name}",
+            actor_id=actor_id,
+            method_name=method_name,
+            max_retries=options.get("max_task_retries", 0),
+        )
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        """Reference: ray.kill (worker.py:3451) → GcsActorManager DestroyActor.
+
+        ``no_restart=False`` consults the restart budget (max_restarts), matching the
+        reference's restart-on-death path (gcs_actor_manager.cc:341)."""
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        was_alive = state.state == "ALIVE"
+        state.state = "DEAD"
+        state.death_cause = "ray_tpu.kill() called"
+        if state.name:
+            with self._lock:
+                self._named_actors.pop((state.namespace, state.name), None)
+        self._drain_mailbox(state, ActorDiedError(state.death_cause))
+        for _ in state.threads:
+            state.mailbox.put(None)
+        if state.node_id is not None and state.sched_req is not None:
+            self.scheduler.release(state.node_id, state.sched_req)
+            state.node_id = None
+        if not no_restart and was_alive:
+            self.restart_actor(actor_id)
+
+    def _drain_mailbox(self, state: _ActorState, err: BaseException) -> None:
+        try:
+            while True:
+                item = state.mailbox.get_nowait()
+                if item is None:
+                    continue
+                spec, _ = item
+                self._store_error(spec, err)
+        except queue.Empty:
+            pass
+
+    def restart_actor(self, actor_id: ActorID) -> bool:
+        """Actor restart path (gcs_actor_manager.cc:341 RestartActor...)."""
+        state = self._actors.get(actor_id)
+        if state is None or state.num_restarts >= state.max_restarts:
+            return False
+        state.num_restarts += 1
+        state.state = "RESTARTING"
+        state.threads = []
+        if state.name:
+            with self._lock:
+                self._named_actors.setdefault((state.namespace, state.name), actor_id)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(actor_id),
+            func=None,
+            args=state.init_args,
+            kwargs=state.init_kwargs,
+            num_returns=1,
+            resources={"CPU": state.options.get("num_cpus", 1.0)},
+            name=f"{state.cls.__name__}.__restart__",
+            actor_id=actor_id,
+            is_actor_creation=True,
+        )
+        with self._lock:
+            self._tasks[spec.task_id] = _TaskEntry(spec)
+        self._enqueue(spec)
+        return True
+
+    def get_actor(self, name: str, namespace: str = "default") -> ActorID:
+        with self._lock:
+            key = (namespace, name)
+            if key not in self._named_actors:
+                raise ValueError(f"Failed to look up actor '{name}' in namespace '{namespace}'")
+            return self._named_actors[key]
+
+    def actor_state(self, actor_id: ActorID) -> _ActorState | None:
+        return self._actors.get(actor_id)
+
+    # ------------------------------------------------------------------ events / state API
+    def _record_event(self, spec: TaskSpec, state: str) -> None:
+        """Reference: TaskEventBuffer (task_event_buffer.h:305) → gcs_task_manager."""
+        if not self.config.task_events_enabled:
+            return
+        with self._lock:
+            self._task_events.append(
+                {
+                    "task_id": spec.task_id.hex(),
+                    "name": spec.desc(),
+                    "state": state,
+                    "ts": time.time(),
+                    "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+                }
+            )
+            if len(self._task_events) > self.config.task_events_max_buffer:
+                self._task_events = self._task_events[-self.config.task_events_max_buffer :]
+
+    def task_events(self) -> list[dict]:
+        with self._lock:
+            return list(self._task_events)
+
+    def list_tasks(self) -> list[dict]:
+        with self._lock:
+            return [
+                {
+                    "task_id": t.spec.task_id.hex(),
+                    "name": t.spec.desc(),
+                    "state": t.state,
+                    "attempts": t.attempts,
+                    "node_id": t.node_id.hex() if t.node_id else None,
+                }
+                for t in self._tasks.values()
+            ]
+
+    def list_actors(self) -> list[dict]:
+        return [
+            {
+                "actor_id": a.actor_id.hex(),
+                "class_name": a.cls.__name__,
+                "state": a.state,
+                "name": a.name,
+                "num_restarts": a.num_restarts,
+                "pending_tasks": a.pending_count,
+            }
+            for a in self._actors.values()
+        ]
+
+    # ------------------------------------------------------------------ lifecycle
+    def shutdown(self) -> None:
+        self.is_shutdown = True
+        for state in list(self._actors.values()):
+            for _ in state.threads:
+                state.mailbox.put(None)
+        self.scheduler.notify()
+
+
+_RETRY = object()
+_NO_STORE = object()
+
+
+def _rough_size(value: Any) -> int:
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return value.nbytes
+    except Exception:
+        pass
+    try:
+        return len(value)
+    except Exception:
+        return 64
+
+
+def _ref_args(args, kwargs) -> list[ObjectRef]:
+    out = [a for a in args if isinstance(a, ObjectRef)]
+    out.extend(v for v in kwargs.values() if isinstance(v, ObjectRef))
+    return out
+
+
+def _should_retry(spec: TaskSpec, exc: BaseException) -> bool:
+    if isinstance(exc, TaskCancelledError):
+        return False
+    if spec.retry_exceptions is True:
+        return True
+    if isinstance(spec.retry_exceptions, (tuple, list)):
+        return isinstance(exc, tuple(spec.retry_exceptions))
+    # Default: retry only system-level failures (worker death), not app exceptions —
+    # matches the reference default (max_retries applies to system failures;
+    # retry_exceptions opts into app-level retry).
+    return isinstance(exc, (ActorError, ObjectLostError))
+
+
+def _sched_request(spec: TaskSpec) -> SchedulingRequest:
+    return SchedulingRequest(
+        resources=ResourceSet(spec.resources),
+        policy=spec.policy,
+        node_affinity=spec.node_affinity,
+        node_affinity_soft=spec.node_affinity_soft,
+        label_selector=spec.label_selector,
+        placement_group=spec.placement_group,
+        bundle_index=spec.bundle_index,
+    )
+
+
+def _async_raise(thread: threading.Thread, exc_type) -> None:
+    """Inject an exception into a running thread (force-cancel best effort)."""
+    if thread.ident is None:
+        return
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_long(thread.ident), ctypes.py_object(exc_type)
+    )
+
+
+# ---------------------------------------------------------------------- globals
+_runtime: Runtime | None = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime() -> Runtime:
+    if _runtime is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _runtime
+
+
+def get_runtime_or_none() -> Runtime | None:
+    return _runtime
+
+
+def set_runtime(rt: Runtime | None) -> None:
+    global _runtime
+    with _runtime_lock:
+        _runtime = rt

@@ -1,0 +1,379 @@
+"""Two-level resource scheduler with pluggable policies and placement-group bundles.
+
+Parity map (reference src/ray/raylet/scheduling/):
+- ``ClusterScheduler`` ≈ ClusterResourceScheduler (cluster_resource_scheduler.h:47) +
+  ClusterLeaseManager (cluster_lease_manager.cc:45 QueueAndScheduleLease): picks a node
+  for each lease from the synced cluster resource view.
+- Policies ≈ raylet/scheduling/policy/: hybrid top-k pack-then-spread
+  (hybrid_scheduling_policy.cc), spread, node-affinity, node-label
+  (composite dispatch in composite_scheduling_policy.h).
+- Bundles ≈ placement_group_resource_manager.cc: PG bundles materialize as derived
+  resources (``CPU_group_<pgid>``, ``CPU_group_<idx>_<pgid>``) on prepare/commit 2PC.
+
+TPU twist (per SURVEY §7.3): nodes carry topology labels (slice name, ICI coords from
+accelerators/tpu.py:736 in the reference) and bundle placement scores ICI contiguity so
+gangs land on physically adjacent chips.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu._private.ids import NodeID, PlacementGroupID
+from ray_tpu.exceptions import PlacementGroupError
+
+EPS = 1e-9
+
+
+class ResourceSet(dict):
+    """Float resource map with +/- and >= comparisons.
+
+    Reference: src/ray/common/scheduling/resource_set.h (FixedPoint arithmetic —
+    here plain floats with an epsilon, sufficient at session scope).
+    """
+
+    def fits_in(self, avail: "ResourceSet") -> bool:
+        return all(avail.get(k, 0.0) + EPS >= v for k, v in self.items() if v > 0)
+
+    def subtract(self, other: "ResourceSet") -> None:
+        for k, v in other.items():
+            self[k] = self.get(k, 0.0) - v
+
+    def add(self, other: "ResourceSet") -> None:
+        for k, v in other.items():
+            self[k] = self.get(k, 0.0) + v
+
+    def copy(self) -> "ResourceSet":
+        return ResourceSet(self)
+
+
+@dataclass
+class NodeState:
+    node_id: NodeID
+    total: ResourceSet
+    available: ResourceSet
+    labels: dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    # TPU topology (SURVEY §7.3): slice name + torus coordinates for ICI-aware packing
+    slice_name: str | None = None
+    ici_coords: tuple[int, int, int] | None = None
+
+    def utilization(self) -> float:
+        tot = sum(v for v in self.total.values() if v > 0)
+        if tot <= 0:
+            return 0.0
+        used = sum(max(0.0, self.total.get(k, 0.0) - self.available.get(k, 0.0)) for k in self.total)
+        return used / tot
+
+
+@dataclass
+class SchedulingRequest:
+    resources: ResourceSet
+    policy: str = "hybrid"  # hybrid|spread|node_affinity|node_label
+    node_affinity: NodeID | None = None
+    node_affinity_soft: bool = False
+    label_selector: dict[str, str] | None = None
+    placement_group: Optional["PlacementGroupState"] = None
+    bundle_index: int = -1
+
+
+@dataclass
+class Bundle:
+    index: int
+    resources: ResourceSet
+    node_id: NodeID | None = None
+    committed: bool = False
+
+
+@dataclass
+class PlacementGroupState:
+    pg_id: PlacementGroupID
+    bundles: list[Bundle]
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    name: str = ""
+    state: str = "PENDING"  # PENDING -> CREATED -> REMOVED
+    ready_event: threading.Event = field(default_factory=threading.Event)
+
+    def group_resource_name(self, base: str, index: int | None = None) -> str:
+        pg = self.pg_id.hex()[:16]
+        if index is None:
+            return f"{base}_group_{pg}"
+        return f"{base}_group_{index}_{pg}"
+
+
+class ClusterScheduler:
+    """Authoritative resource view + node selection + PG bundle 2PC."""
+
+    def __init__(self, config):
+        self._lock = threading.Condition()
+        self._nodes: dict[NodeID, NodeState] = {}
+        self._pgs: dict[PlacementGroupID, PlacementGroupState] = {}
+        self._config = config
+
+    # --- node membership ---
+    def add_node(
+        self,
+        resources: dict[str, float],
+        labels: dict[str, str] | None = None,
+        slice_name: str | None = None,
+        ici_coords: tuple[int, int, int] | None = None,
+    ) -> NodeID:
+        nid = NodeID.from_random()
+        rs = ResourceSet(resources)
+        with self._lock:
+            self._nodes[nid] = NodeState(nid, rs.copy(), rs.copy(), dict(labels or {}), True, slice_name, ici_coords)
+            self._lock.notify_all()
+        return nid
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n:
+                n.alive = False
+            self._lock.notify_all()
+
+    def nodes(self) -> list[NodeState]:
+        with self._lock:
+            return [n for n in self._nodes.values()]
+
+    def get_node(self, node_id: NodeID) -> NodeState | None:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    # --- scheduling ---
+    def try_acquire(self, req: SchedulingRequest) -> NodeID | None:
+        """Pick a feasible node and atomically deduct resources; None if infeasible now."""
+        with self._lock:
+            resources = req.resources
+            if req.placement_group is not None:
+                resources = self._pg_wildcard_resources(req)
+            node = self._select(req, resources)
+            if node is None:
+                return None
+            node.available.subtract(resources)
+            return node.node_id
+
+    def release(self, node_id: NodeID, req: SchedulingRequest) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            resources = req.resources
+            if req.placement_group is not None:
+                resources = self._pg_wildcard_resources(req)
+            node.available.add(resources)
+            self._lock.notify_all()
+
+    def wait_for_change(self, timeout: float = 1.0) -> None:
+        with self._lock:
+            self._lock.wait(timeout)
+
+    def notify(self) -> None:
+        with self._lock:
+            self._lock.notify_all()
+
+    def _pg_wildcard_resources(self, req: SchedulingRequest) -> ResourceSet:
+        """Rewrite request resources into PG-bundle derived resource names.
+
+        Reference: raylet/placement_group_resource_manager.cc — tasks inside a PG
+        consume ``<res>_group_<idx>_<pgid>`` (specific bundle) or ``<res>_group_<pgid>``
+        (wildcard) so they can only run where bundles were committed.
+        """
+        pg = req.placement_group
+        out = ResourceSet()
+        for k, v in req.resources.items():
+            idx = req.bundle_index if req.bundle_index >= 0 else None
+            out[pg.group_resource_name(k, idx)] = v
+        return out
+
+    def _feasible(self, node: NodeState, resources: ResourceSet, req: SchedulingRequest) -> bool:
+        if not node.alive:
+            return False
+        if req.label_selector:
+            for k, v in req.label_selector.items():
+                if node.labels.get(k) != v:
+                    return False
+        return resources.fits_in(node.available)
+
+    def _select(self, req: SchedulingRequest, resources: ResourceSet) -> NodeState | None:
+        nodes = [n for n in self._nodes.values() if n.alive]
+        if req.policy == "node_affinity" and req.node_affinity is not None:
+            n = self._nodes.get(req.node_affinity)
+            if n is not None and self._feasible(n, resources, req):
+                return n
+            if not req.node_affinity_soft:
+                return None
+            # soft: fall through to hybrid
+        feas = [n for n in nodes if self._feasible(n, resources, req)]
+        if not feas:
+            return None
+        if req.policy == "spread":
+            # pick least-utilized (spread_scheduling_policy.cc round-robins over feasible)
+            return min(feas, key=lambda n: (n.utilization(), n.node_id.binary()))
+        # hybrid top-k pack-then-spread (hybrid_scheduling_policy.cc): prefer packing
+        # onto already-utilized nodes until utilization crosses the threshold.
+        thresh = self._config.scheduler_spread_threshold
+        below = [n for n in feas if n.utilization() < thresh]
+        pool = below if below else feas
+        # pack: most utilized below threshold first (stable by id)
+        return max(pool, key=lambda n: (n.utilization(), n.node_id.binary()))
+
+    # --- placement groups (2PC: prepare all bundles, then commit) ---
+    def create_placement_group(
+        self, bundles: list[dict[str, float]], strategy: str, name: str = ""
+    ) -> PlacementGroupState:
+        pg_id = PlacementGroupID.from_random()
+        pg = PlacementGroupState(
+            pg_id, [Bundle(i, ResourceSet(b)) for i, b in enumerate(bundles)], strategy, name
+        )
+        with self._lock:
+            self._pgs[pg_id] = pg
+        self._try_place_pg(pg)
+        return pg
+
+    def _try_place_pg(self, pg: PlacementGroupState) -> bool:
+        """Reserve all bundles per strategy; roll back on failure (prepare phase)."""
+        with self._lock:
+            placement = self._plan_bundles(pg)
+            if placement is None:
+                return False
+            # prepare: deduct base resources and create group resources (commit)
+            for bundle, node in zip(pg.bundles, placement):
+                node.available.subtract(bundle.resources)
+                bundle.node_id = node.node_id
+                bundle.committed = True
+                for k, v in bundle.resources.items():
+                    for rname in (
+                        pg.group_resource_name(k, bundle.index),
+                        pg.group_resource_name(k),
+                    ):
+                        node.total[rname] = node.total.get(rname, 0.0) + v
+                        node.available[rname] = node.available.get(rname, 0.0) + v
+            pg.state = "CREATED"
+            pg.ready_event.set()
+            self._lock.notify_all()
+            return True
+
+    def _plan_bundles(self, pg: PlacementGroupState) -> list[NodeState] | None:
+        nodes = [n for n in self._nodes.values() if n.alive]
+        if not nodes:
+            return None
+        avail = {n.node_id: n.available.copy() for n in nodes}
+
+        def fits(n: NodeState, rs: ResourceSet) -> bool:
+            return rs.fits_in(avail[n.node_id])
+
+        plan: list[NodeState] = []
+        strategy = pg.strategy
+        if strategy == "STRICT_PACK":
+            for n in self._ici_sorted(nodes):
+                trial = avail[n.node_id].copy()
+                ok = True
+                for b in pg.bundles:
+                    if b.resources.fits_in(trial):
+                        trial.subtract(b.resources)
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [n] * len(pg.bundles)
+            return None
+        if strategy == "STRICT_SPREAD":
+            chosen: list[NodeState] = []
+            used: set[bytes] = set()
+            for b in pg.bundles:
+                cand = [
+                    n
+                    for n in self._ici_sorted(nodes)
+                    if n.node_id.binary() not in used and fits(n, b.resources)
+                ]
+                if not cand:
+                    return None
+                n = cand[0]
+                chosen.append(n)
+                used.add(n.node_id.binary())
+                avail[n.node_id].subtract(b.resources)
+            return chosen
+        # PACK / SPREAD are best-effort variants (bundle_scheduling_policy.cc)
+        order = self._ici_sorted(nodes)
+        for b in pg.bundles:
+            cand = [n for n in order if fits(n, b.resources)]
+            if not cand:
+                return None
+            if strategy == "SPREAD":
+                # fewest bundles first; ties broken by ICI adjacency (cand is ICI-sorted)
+                counts = {id(n): sum(1 for p in plan if p is n) for n in cand}
+                minc = min(counts.values())
+                n = next(c for c in cand if counts[id(c)] == minc)
+            else:  # PACK: prefer nodes already used by this PG, then ICI order
+                usedset = {id(p) for p in plan}
+                n = next((c for c in cand if id(c) in usedset), cand[0])
+            plan.append(n)
+            avail[n.node_id].subtract(b.resources)
+        return plan
+
+    def _ici_sorted(self, nodes: list[NodeState]) -> list[NodeState]:
+        """Order nodes for ICI contiguity: group by slice, then torus coordinates.
+
+        This is the TPU-native bundle scorer SURVEY §7.3 calls for — gang bundles
+        placed in this order land on physically adjacent chips so XLA collectives
+        ride ICI neighbor links.
+        """
+        return sorted(
+            nodes,
+            key=lambda n: (
+                n.slice_name or "",
+                n.ici_coords or (1 << 30, 0, 0),
+                n.node_id.binary(),
+            ),
+        )
+
+    def remove_placement_group(self, pg: PlacementGroupState) -> None:
+        with self._lock:
+            for b in pg.bundles:
+                if not b.committed or b.node_id is None:
+                    continue
+                node = self._nodes.get(b.node_id)
+                if node is None:
+                    continue
+                node.available.add(b.resources)
+                for k, v in b.resources.items():
+                    for rname in (
+                        pg.group_resource_name(k, b.index),
+                        pg.group_resource_name(k),
+                    ):
+                        node.total[rname] = node.total.get(rname, 0.0) - v
+                        node.available[rname] = node.available.get(rname, 0.0) - v
+            pg.state = "REMOVED"
+            self._pgs.pop(pg.pg_id, None)
+            self._lock.notify_all()
+
+    def retry_pending_pgs(self) -> None:
+        with self._lock:
+            pending = [pg for pg in self._pgs.values() if pg.state == "PENDING"]
+        for pg in pending:
+            self._try_place_pg(pg)
+
+    def placement_groups(self) -> list[PlacementGroupState]:
+        with self._lock:
+            return list(self._pgs.values())
+
+    def total_resources(self) -> dict[str, float]:
+        with self._lock:
+            out: dict[str, float] = {}
+            for n in self._nodes.values():
+                if n.alive:
+                    for k, v in n.total.items():
+                        out[k] = out.get(k, 0.0) + v
+            return out
+
+    def available_resources(self) -> dict[str, float]:
+        with self._lock:
+            out: dict[str, float] = {}
+            for n in self._nodes.values():
+                if n.alive:
+                    for k, v in n.available.items():
+                        out[k] = out.get(k, 0.0) + v
+            return out

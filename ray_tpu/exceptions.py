@@ -1,0 +1,87 @@
+"""User-visible exceptions.
+
+Parity with the reference's python/ray/exceptions.py: RayError, RayTaskError (wraps the
+remote traceback and re-raises at ray.get), RayActorError, ObjectLostError (triggers
+lineage reconstruction upstream), GetTimeoutError, TaskCancelledError,
+ObjectStoreFullError, RuntimeEnvSetupError.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception remotely; re-raised at ``get``.
+
+    Reference: python/ray/exceptions.py RayTaskError — carries the remote traceback
+    string so the driver sees where the failure happened.
+    """
+
+    def __init__(self, cause: BaseException, task_desc: str = "", remote_tb: str | None = None):
+        self.cause = cause
+        self.task_desc = task_desc
+        self.remote_tb = remote_tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(f"Task {task_desc} failed:\n{self.remote_tb}")
+
+    def as_cause(self) -> BaseException:
+        return self.cause
+
+
+class ActorError(RayTpuError):
+    """The actor died before or during this method call (reference: RayActorError)."""
+
+    def __init__(self, msg: str = "The actor died unexpectedly before finishing this task."):
+        super().__init__(msg)
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ActorUnavailableError(ActorError):
+    """Actor is temporarily unreachable (restarting); call may be retried."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object was lost from the store (all copies evicted/node died).
+
+    Recovery path mirrors the reference's ObjectRecoveryManager
+    (src/ray/core_worker/object_recovery_manager.h:41): probe remaining locations,
+    then re-execute the creating task from lineage.
+    """
+
+    def __init__(self, object_id_hex: str):
+        self.object_id_hex = object_id_hex
+        super().__init__(f"Object {object_id_hex} was lost.")
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_desc: str = ""):
+        super().__init__(f"Task {task_desc} was cancelled.")
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass

@@ -1,0 +1,44 @@
+"""Test fixtures.
+
+Per the project environment contract, sharding tests run on a virtual 8-device CPU
+mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8) — the analog of the
+reference's in-process multi-raylet Cluster harness (python/ray/cluster_utils.py:141)
+for simulating multi-node without hardware.
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Analog of the reference's ray_start_regular fixture (tests/conftest.py:616)."""
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-(logical-)node session (reference: ray_start_cluster conftest.py:699)."""
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=4, num_nodes=4, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest must force 8 host devices"
+    yield devices[:8]

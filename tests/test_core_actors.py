@@ -1,0 +1,193 @@
+"""Actor API tests (model: reference python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, ActorError, TaskError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+    def fail(self):
+        raise RuntimeError("actor method failed")
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote(5)) == 15
+    assert ray_tpu.get(c.read.remote()) == 15
+
+
+def test_actor_ordered_execution(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    # sequential mailbox => strictly increasing results
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_actor_method_exception(ray_start_regular):
+    c = Counter.remote()
+    with pytest.raises(TaskError, match="actor method failed"):
+        ray_tpu.get(c.fail.remote())
+    # actor survives app-level method errors
+    assert ray_tpu.get(c.inc.remote()) == 1
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote(5)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.read.remote()) == 5
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="shared", get_if_exists=True).remote(1)
+    b = Counter.options(name="shared", get_if_exists=True).remote(99)
+    ray_tpu.get(a.inc.remote())
+    assert ray_tpu.get(b.read.remote()) == 2  # same actor
+
+
+def test_duplicate_name_rejected(ray_start_regular):
+    Counter.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(ActorError):
+        ray_tpu.get(c.inc.remote(), timeout=5)
+
+
+def test_actor_init_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("init boom")
+
+        def m(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises((TaskError, ActorDiedError)):
+        ray_tpu.get(b.m.remote(), timeout=5)
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            return x * 2
+
+    w = AsyncWorker.remote()
+    assert ray_tpu.get([w.work.remote(i) for i in range(4)]) == [0, 2, 4, 6]
+
+
+def test_max_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def hit(self):
+            time.sleep(0.3)
+            return 1
+
+    s = Slow.remote()
+    start = time.monotonic()
+    assert sum(ray_tpu.get([s.hit.remote() for _ in range(4)])) == 4
+    assert time.monotonic() - start < 1.1  # overlapped, not 1.2s serial
+
+
+def test_actor_handle_passing(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.inc.remote(10))
+
+    assert ray_tpu.get(use.remote(c)) == 10
+    assert ray_tpu.get(c.read.remote()) == 10
+
+
+def test_actor_streaming_method(ray_start_regular):
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    g = Gen.remote()
+    gen = g.stream.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in gen] == [0, 1, 2, 3]
+
+
+def test_list_actors_state_api(ray_start_regular):
+    from ray_tpu.core.runtime import get_runtime
+
+    Counter.options(name="visible").remote()
+    time.sleep(0.2)
+    actors = get_runtime().list_actors()
+    assert any(a["name"] == "visible" and a["state"] == "ALIVE" for a in actors)
+
+
+def test_method_decorator_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class Two:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    t = Two.remote()
+    a, b = t.pair.remote()
+    assert ray_tpu.get([a, b]) == [1, 2]
+
+
+def test_kill_with_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def ping(self):
+            self.calls += 1
+            return self.calls
+
+    p = Phoenix.options(name="phx").remote()
+    assert ray_tpu.get(p.ping.remote()) == 1
+    ray_tpu.kill(p, no_restart=False)
+    time.sleep(0.3)
+    # restarted instance: fresh state
+    assert ray_tpu.get(p.ping.remote(), timeout=5) == 1
+
+
+def test_kill_before_creation_does_not_resurrect(ray_start_regular):
+    @ray_tpu.remote(num_cpus=8)
+    def hog():
+        time.sleep(0.6)
+
+    @ray_tpu.remote(num_cpus=8)
+    class Late:
+        def ping(self):
+            return 1
+
+    h = hog.remote()  # occupy the node so actor creation queues
+    a = Late.remote()
+    ray_tpu.kill(a)
+    ray_tpu.get(h)
+    time.sleep(0.3)
+    with pytest.raises((ActorError, TaskError)):
+        ray_tpu.get(a.ping.remote(), timeout=5)

@@ -1,0 +1,114 @@
+"""Object plane tests: ids, serialization, refcounting (model: reference
+python/ray/tests/test_object_store.py, test_reference_counting.py)."""
+
+import gc
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.reference_counter import ReferenceCounter
+
+
+def test_id_layouts():
+    job = JobID.from_random()
+    t = TaskID.for_normal_task(job)
+    assert t.actor_id().is_nil()
+    a = ActorID.of(job)
+    assert a.job_id() == job
+    at = TaskID.for_actor_task(a)
+    assert at.actor_id() == a
+    o = ObjectID.for_task_return(t, 3)
+    assert o.task_id() == t and o.index() == 3 and not o.is_put()
+    p = ObjectID.for_put(t, 7)
+    assert p.is_put() and p.index() == 7
+
+
+def test_id_hex_roundtrip():
+    t = TaskID.for_normal_task(JobID.from_random())
+    assert TaskID.from_hex(t.hex()) == t
+
+
+def test_serialization_zero_copy_numpy():
+    arr = np.arange(4096, dtype=np.float64)
+    meta, bufs = serialization.serialize(arr)
+    assert len(bufs) >= 1  # out-of-band buffer captured
+    out = serialization.deserialize(meta, bufs)
+    assert np.array_equal(arr, out)
+
+
+def test_serialization_blob_roundtrip():
+    payload = {"a": np.ones((16, 16)), "b": [1, "two", 3.0]}
+    blob = serialization.serialize_to_bytes(payload)
+    out = serialization.deserialize_from_bytes(blob)
+    assert np.array_equal(out["a"], payload["a"])
+    assert out["b"] == payload["b"]
+
+
+def test_jax_array_put_get(ray_start_regular):
+    import jax.numpy as jnp
+
+    x = jnp.arange(16)
+    ref = ray_tpu.put(x)
+    out = ray_tpu.get(ref)
+    assert np.array_equal(np.asarray(x), np.asarray(out))
+
+
+def test_reference_counter_zero_callback():
+    rc = ReferenceCounter()
+    freed = []
+    rc.add_on_zero_callback(freed.append)
+    oid = ObjectID.for_put(TaskID.for_normal_task(JobID.from_random()), 1)
+    rc.add_local_ref(oid)
+    rc.add_local_ref(oid)
+    rc.remove_local_ref(oid)
+    assert not freed
+    rc.remove_local_ref(oid)
+    assert freed == [oid]
+
+
+def test_submitted_task_refs_block_free():
+    rc = ReferenceCounter()
+    freed = []
+    rc.add_on_zero_callback(freed.append)
+    oid = ObjectID.for_put(TaskID.for_normal_task(JobID.from_random()), 1)
+    rc.add_local_ref(oid)
+    rc.add_submitted_task_refs([oid])
+    rc.remove_local_ref(oid)
+    assert not freed  # in-flight task still references it
+    rc.remove_submitted_task_refs([oid])
+    assert freed == [oid]
+
+
+def test_borrower_protocol():
+    rc = ReferenceCounter()
+    freed = []
+    rc.add_on_zero_callback(freed.append)
+    oid = ObjectID.for_put(TaskID.for_normal_task(JobID.from_random()), 1)
+    rc.add_local_ref(oid)
+    rc.add_borrower(oid, "worker-2")
+    rc.remove_local_ref(oid)
+    assert not freed
+    rc.remove_borrower(oid, "worker-2")
+    assert freed == [oid]
+
+
+def test_object_freed_when_refs_dropped(ray_start_regular):
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    ref = ray_tpu.put(np.zeros(1000))
+    oid = ref.object_id()
+    assert rt.memory_store.contains(oid)
+    del ref
+    gc.collect()
+    assert not rt.memory_store.contains(oid)
+
+
+def test_large_object_roundtrip(ray_start_regular):
+    big = np.random.default_rng(0).standard_normal((512, 512))
+    ref = ray_tpu.put(big)
+    out = ray_tpu.get(ref)
+    assert np.array_equal(big, out)

@@ -1,0 +1,145 @@
+"""Scheduler + placement group tests (model: reference tests for
+raylet/scheduling/policy and python/ray/tests/test_placement_group.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import Config
+from ray_tpu.core.scheduler import ClusterScheduler, ResourceSet, SchedulingRequest
+
+
+def make_sched(n_nodes=4, cpus=4):
+    s = ClusterScheduler(Config())
+    ids = [s.add_node({"CPU": cpus}) for _ in range(n_nodes)]
+    return s, ids
+
+
+def test_hybrid_packs_below_threshold():
+    s, ids = make_sched(n_nodes=3, cpus=10)
+    req = SchedulingRequest(resources=ResourceSet({"CPU": 1}))
+    first = s.try_acquire(req)
+    # next small task should pack on the same node (hybrid pack-then-spread)
+    second = s.try_acquire(SchedulingRequest(resources=ResourceSet({"CPU": 1})))
+    assert first == second
+
+
+def test_hybrid_spreads_when_saturated():
+    s, ids = make_sched(n_nodes=2, cpus=2)
+    picks = set()
+    for _ in range(4):
+        nid = s.try_acquire(SchedulingRequest(resources=ResourceSet({"CPU": 1})))
+        picks.add(nid.binary())
+    assert len(picks) == 2  # forced to use both nodes
+
+
+def test_spread_policy():
+    s, ids = make_sched(n_nodes=4, cpus=8)
+    picks = [
+        s.try_acquire(SchedulingRequest(resources=ResourceSet({"CPU": 1}), policy="spread"))
+        for _ in range(4)
+    ]
+    assert len({p.binary() for p in picks}) == 4
+
+
+def test_node_affinity_hard():
+    s, ids = make_sched(n_nodes=2, cpus=1)
+    target = ids[1]
+    nid = s.try_acquire(
+        SchedulingRequest(resources=ResourceSet({"CPU": 1}), policy="node_affinity", node_affinity=target)
+    )
+    assert nid == target
+    # node now full; hard affinity fails
+    assert (
+        s.try_acquire(
+            SchedulingRequest(resources=ResourceSet({"CPU": 1}), policy="node_affinity", node_affinity=target)
+        )
+        is None
+    )
+
+
+def test_label_selector():
+    s = ClusterScheduler(Config())
+    s.add_node({"CPU": 1}, labels={"zone": "a"})
+    good = s.add_node({"CPU": 1}, labels={"zone": "b"})
+    nid = s.try_acquire(
+        SchedulingRequest(resources=ResourceSet({"CPU": 1}), label_selector={"zone": "b"})
+    )
+    assert nid == good
+
+
+def test_pg_strict_spread_needs_enough_nodes():
+    s, _ = make_sched(n_nodes=2, cpus=4)
+    pg = s.create_placement_group([{"CPU": 1}] * 3, "STRICT_SPREAD")
+    assert pg.state == "PENDING"  # 3 bundles, 2 nodes -> cannot place
+    pg2 = s.create_placement_group([{"CPU": 1}] * 2, "STRICT_SPREAD")
+    assert pg2.state == "CREATED"
+    assert len({b.node_id.binary() for b in pg2.bundles}) == 2
+
+
+def test_pg_strict_pack_single_node():
+    s, _ = make_sched(n_nodes=3, cpus=4)
+    pg = s.create_placement_group([{"CPU": 2}, {"CPU": 2}], "STRICT_PACK")
+    assert pg.state == "CREATED"
+    assert len({b.node_id.binary() for b in pg.bundles}) == 1
+
+
+def test_pg_resources_returned_on_remove():
+    s, _ = make_sched(n_nodes=1, cpus=4)
+    before = s.available_resources()["CPU"]
+    pg = s.create_placement_group([{"CPU": 2}], "PACK")
+    assert s.available_resources()["CPU"] == before - 2
+    s.remove_placement_group(pg)
+    assert s.available_resources()["CPU"] == before
+
+
+def test_ici_contiguity_ordering():
+    """TPU twist: bundles placed in slice/torus order (SURVEY §7.3)."""
+    s = ClusterScheduler(Config())
+    far = s.add_node({"TPU": 4}, slice_name="slice-a", ici_coords=(3, 0, 0))
+    near = s.add_node({"TPU": 4}, slice_name="slice-a", ici_coords=(0, 0, 0))
+    mid = s.add_node({"TPU": 4}, slice_name="slice-a", ici_coords=(1, 0, 0))
+    pg = s.create_placement_group([{"TPU": 4}, {"TPU": 4}], "SPREAD")
+    assert pg.state == "CREATED"
+    chosen = [b.node_id for b in pg.bundles]
+    # picks the two lowest-coordinate (adjacent) nodes
+    assert set(c.binary() for c in chosen) == {near.binary(), mid.binary()}
+
+
+def test_task_into_placement_group(ray_start_cluster):
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="SPREAD")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return "ran"
+
+    ref = where.options(
+        scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        )
+    ).remote()
+    assert ray_tpu.get(ref, timeout=10) == "ran"
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_actor_into_placement_group(ray_start_cluster):
+    pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(
+        scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(placement_group=pg)
+    ).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+
+
+def test_cluster_resources_api(ray_start_cluster):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 16.0  # 4 nodes x 4 cpus
+    assert len(ray_tpu.nodes()) == 4

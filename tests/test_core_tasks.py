@@ -1,0 +1,206 @@
+"""Core task API tests (model: reference python/ray/tests/test_basic.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskCancelledError, TaskError
+
+
+def test_task_roundtrip(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_parallelism(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.3)
+        return 1
+
+    start = time.monotonic()
+    assert sum(ray_tpu.get([slow.remote() for _ in range(8)])) == 8
+    # 8 concurrent 0.3s tasks on an 8-CPU node should overlap
+    assert time.monotonic() - start < 2.0
+
+
+def test_object_ref_args_chain(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    r = double.remote(1)
+    for _ in range(5):
+        r = double.remote(r)
+    assert ray_tpu.get(r) == 64
+
+
+def test_put_get_numpy_roundtrip(ray_start_regular):
+    import numpy as np
+
+    arr = np.arange(1000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    assert np.array_equal(arr, out)
+
+
+def test_task_exception_reraised(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(30)
+
+    ref = forever.remote()
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.2)
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_wait_semantics(ray_start_regular):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.05)
+    slow = sleepy.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=2)
+    assert ready == [fast] and not_ready == [slow]
+    ray_tpu.cancel(slow, force=True)
+
+
+def test_retries_app_exception_opt_in(ray_start_regular):
+    calls = {"n": 0}
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert calls["n"] == 3
+
+
+def test_no_retry_by_default_on_app_error(ray_start_regular):
+    calls = {"n": 0}
+
+    @ray_tpu.remote
+    def fails():
+        calls["n"] += 1
+        raise RuntimeError("app error")
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(fails.remote())
+    assert calls["n"] == 1
+
+
+def test_cancel_pending(ray_start_regular):
+    @ray_tpu.remote(num_cpus=8)
+    def hog():
+        time.sleep(1.0)
+        return 1
+
+    @ray_tpu.remote(num_cpus=8)
+    def queued():
+        return 2
+
+    h = hog.remote()
+    q = queued.remote()
+    ray_tpu.cancel(q)
+    with pytest.raises((TaskCancelledError, TaskError)):
+        ray_tpu.get(q, timeout=5)
+    assert ray_tpu.get(h) == 1
+
+
+def test_streaming_generator(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 1, 4, 9, 16]
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) * 10
+
+    assert ray_tpu.get(outer.remote(1)) == 20
+
+
+def test_fractional_and_custom_resources(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0.5, resources={"does_not_exist": 1})
+    def never():
+        return 1
+
+    ref = never.remote()
+    ready, not_ready = ray_tpu.wait([ref], timeout=0.3)
+    assert not ready  # infeasible resources keep it queued
+    ray_tpu.cancel(ref)
+
+
+def test_lineage_reconstruction(ray_start_regular):
+    """Lost object recovered by re-executing its creating task
+    (reference: object_recovery_manager.h:41 + task_manager lineage)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    calls = {"n": 0}
+
+    @ray_tpu.remote
+    def produce():
+        calls["n"] += 1
+        return "value"
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref) == "value"
+    assert calls["n"] == 1
+    # simulate loss (eviction / node death)
+    get_runtime().memory_store.evict([ref.object_id()])
+    assert ray_tpu.get(ref) == "value"
+    assert calls["n"] == 2
+
+
+def test_permanently_lost_dep_fails_not_hangs(ray_start_regular):
+    """A dep with no lineage (freed put) must fail the task, not queue forever."""
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.exceptions import ObjectLostError
+
+    x = ray_tpu.put("v")
+    get_runtime().free([x])
+
+    @ray_tpu.remote
+    def use(v):
+        return v
+
+    ref = use.remote(x)
+    with pytest.raises((ObjectLostError, TaskError)):
+        ray_tpu.get(ref, timeout=5)
