@@ -24,6 +24,7 @@ same TaskSpecs on forked worker processes over the shared-memory object plane.
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 import inspect
 import logging
 import queue
@@ -145,6 +146,7 @@ class _ActorState:
         self.threads: list[threading.Thread] = []
         self.node_id: NodeID | None = None
         self.sched_req: SchedulingRequest | None = None
+        self.creation_spec: "TaskSpec | None" = None
         self.death_cause: str | None = None
         self.is_async = False
         self.loop = None  # asyncio loop for async actors
@@ -246,6 +248,16 @@ class Runtime:
         by_id = {r.object_id(): r for r in refs}
         return [by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids]
 
+    def _add_lineage(self, rid: ObjectID, spec: TaskSpec) -> None:
+        """Record `rid`'s creating task and pin its deps (one lineage ref per lineage
+        entry, so deps release only when ALL returns/stream items are out of scope)."""
+        with self._lock:
+            if rid in self._lineage:
+                return
+            self._lineage[rid] = spec
+        for dep in _ref_args(spec.args, spec.kwargs):
+            self.reference_counter.add_lineage_ref(dep.object_id())
+
     def _on_ref_zero(self, oid: ObjectID) -> None:
         # Out of scope everywhere -> evict value and release lineage
         self.memory_store.delete([oid])
@@ -274,6 +286,9 @@ class Runtime:
                 self._recovering.add(oid)
         if spec is None:
             raise ObjectLostError(oid.hex())
+        # Drop any stale value/error so get() blocks for the re-executed result
+        # instead of spinning on the old object.
+        self.memory_store.delete([oid])
         self.memory_store.unmark_deleted(oid)
         logger.info("Reconstructing %s by re-executing task %s", oid.hex()[:12], spec.desc())
         # Recursively recover lost deps first.
@@ -290,11 +305,9 @@ class Runtime:
         dep_refs = _ref_args(spec.args, spec.kwargs)
         self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
         return_ids = spec.return_ids()
+        for rid in return_ids:
+            self._add_lineage(rid, spec)
         with self._lock:
-            for rid in return_ids:
-                self._lineage[rid] = spec
-            for dep in dep_refs:
-                self.reference_counter.add_lineage_ref(dep.object_id())
             self._tasks[spec.task_id] = _TaskEntry(spec)
         if isinstance(spec.num_returns, str):
             self._streams[return_ids[0]] = _StreamState()
@@ -336,6 +349,9 @@ class Runtime:
                 if dep_state == "FAILED":
                     entry.state = "FAILED"
                     self._record_event(entry.spec, "FAILED")
+                    self.reference_counter.remove_submitted_task_refs(
+                        [r.object_id() for r in _ref_args(entry.spec.args, entry.spec.kwargs)]
+                    )
                     continue
                 if dep_state == "WAITING":
                     still_waiting.append(tid)
@@ -369,7 +385,11 @@ class Runtime:
                         self._recover_object(oid)
                     except ObjectLostError:
                         # Permanently lost (no lineage, e.g. a freed put): fail the task
-                        # instead of queueing forever.
+                        # terminally — drop the returns' lineage so get() raises instead
+                        # of re-entering recovery forever.
+                        with self._lock:
+                            for rid in spec.return_ids():
+                                self._lineage.pop(rid, None)
                         self._store_error(spec, ObjectLostError(oid.hex()))
                         return "FAILED"
                 return "WAITING"
@@ -400,9 +420,12 @@ class Runtime:
             entry.end_time = time.time()
             if not spec.is_actor_creation:
                 self.scheduler.release(entry.node_id, req)
-            self.reference_counter.remove_submitted_task_refs(
-                [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
-            )
+                self.scheduler.retry_pending_pgs()
+            # Keep deps pinned across retries; release only at a terminal state.
+            if entry.state in ("FINISHED", "FAILED", "CANCELLED"):
+                self.reference_counter.remove_submitted_task_refs(
+                    [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+                )
 
     def _run_user_fn(self, entry: _TaskEntry, fn, args, kwargs):
         if entry.cancelled:
@@ -441,6 +464,9 @@ class Runtime:
             self._store_value(rid, val)
 
     def _store_error(self, spec: TaskSpec, err: BaseException) -> None:
+        with self._lock:
+            for rid in spec.return_ids():
+                self._recovering.discard(rid)
         for rid in spec.return_ids():
             self.memory_store.put(rid, RayObject(error=err))
         stream = self._streams.get(spec.return_ids()[0])
@@ -463,6 +489,13 @@ class Runtime:
         spec = entry.spec
         stream_id = spec.return_ids()[0]
         stream = self._streams[stream_id]
+        with stream.cv:
+            # A retry replays the stream from the start (reference: streaming
+            # generator retry semantics) — clear any partial previous attempt.
+            stream.items.clear()
+            stream.done = False
+            stream.error = None
+            stream.cv.notify_all()
         gen = spec.func(*args, **kwargs)
         index = 0
         for item in gen:
@@ -470,8 +503,7 @@ class Runtime:
                 raise TaskCancelledError(spec.desc())
             item_id = ObjectID.for_task_return(spec.task_id, index + 1)
             self._store_value(item_id, item)
-            with self._lock:
-                self._lineage[item_id] = spec  # lineage covers stream items too
+            self._add_lineage(item_id, spec)  # lineage covers stream items too
             with stream.cv:
                 stream.items.append(item_id)
                 stream.cv.notify_all()
@@ -557,6 +589,7 @@ class Runtime:
         tpu = options.get("num_tpus", 0)
         if tpu:
             spec.resources["TPU"] = tpu
+        state.creation_spec = spec  # reused verbatim (new task id) on restart
         self.submit_task(spec)
         return actor_id
 
@@ -671,14 +704,17 @@ class Runtime:
         self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
         with self._lock:
             self._tasks[spec.task_id] = _TaskEntry(spec)
-            for rid in spec.return_ids():
-                self._lineage.setdefault(rid, spec)
+        for rid in spec.return_ids():
+            self._add_lineage(rid, spec)
         if isinstance(spec.num_returns, str):
             self._streams[spec.return_ids()[0]] = _StreamState()
         with state.lock:
             state.pending_count += 1
         self._record_event(spec, "PENDING")
         state.mailbox.put((spec, spec.return_ids()[0]))
+        if state.state == "DEAD":
+            # Raced with kill_actor's drain: no thread will serve the mailbox now.
+            self._drain_mailbox(state, ActorDiedError(state.death_cause or "actor is dead"))
         return [ObjectRef(r, self) for r in spec.return_ids()]
 
     def _make_actor_task_spec(self, actor_id, method_name, args, kwargs, options) -> TaskSpec:
@@ -715,6 +751,7 @@ class Runtime:
         if state.node_id is not None and state.sched_req is not None:
             self.scheduler.release(state.node_id, state.sched_req)
             state.node_id = None
+            self.scheduler.retry_pending_pgs()
         if not no_restart and was_alive:
             self.restart_actor(actor_id)
 
@@ -726,6 +763,11 @@ class Runtime:
                     continue
                 spec, _ = item
                 self._store_error(spec, err)
+                self.reference_counter.remove_submitted_task_refs(
+                    [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+                )
+                with state.lock:
+                    state.pending_count -= 1
         except queue.Empty:
             pass
 
@@ -740,16 +782,12 @@ class Runtime:
         if state.name:
             with self._lock:
                 self._named_actors.setdefault((state.namespace, state.name), actor_id)
-        spec = TaskSpec(
+        # Clone the original creation spec (same resources/PG/labels, fresh task id)
+        orig = state.creation_spec
+        spec = dataclasses.replace(
+            orig,
             task_id=TaskID.for_actor_task(actor_id),
-            func=None,
-            args=state.init_args,
-            kwargs=state.init_kwargs,
-            num_returns=1,
-            resources={"CPU": state.options.get("num_cpus", 1.0)},
             name=f"{state.cls.__name__}.__restart__",
-            actor_id=actor_id,
-            is_actor_creation=True,
         )
         with self._lock:
             self._tasks[spec.task_id] = _TaskEntry(spec)

@@ -349,6 +349,7 @@ class ClusterScheduler:
             pg.state = "REMOVED"
             self._pgs.pop(pg.pg_id, None)
             self._lock.notify_all()
+        self.retry_pending_pgs()
 
     def retry_pending_pgs(self) -> None:
         with self._lock:

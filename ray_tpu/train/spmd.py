@@ -1,0 +1,122 @@
+"""SPMD training step: mesh-sharded forward/backward/update, XLA-compiled once.
+
+This is the compute core the reference delegates to torch DDP/FSDP
+(train/torch/train_loop_utils.py:177) — here it is native: one pjit'd step over a
+Mesh whose axes express dp/fsdp/tp/sp, with donation for in-place HBM reuse and
+jax.checkpoint (in the model) for rematerialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.parallel import sharding as shd
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1, warmup: int = 100):
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=learning_rate, warmup_steps=warmup,
+        decay_steps=10000, end_value=learning_rate * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def init_state(cfg: llama.LlamaConfig, key, optimizer=None) -> TrainState:
+    optimizer = optimizer or make_optimizer()
+    params = llama.init(cfg, key)
+    opt_state = optimizer.init(params)
+    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+
+def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh, state: TrainState) -> TrainState:
+    """Sharding tree for TrainState: params by logical axes; opt_state mirrors params."""
+    ax = llama.logical_axes(cfg)
+    param_sh = shd.tree_shardings(mesh, ax)
+
+    def opt_sharding(leaf_path_value):
+        return leaf_path_value
+
+    # optax states mirror param pytrees; map matching leaves to the param sharding,
+    # scalars to replicated.
+    def mirror(tree):
+        flat_params, treedef = jax.tree.flatten(state.params)
+        flat_sh = jax.tree.leaves(param_sh)
+        shape_to_sh = {}
+        for p, s in zip(flat_params, flat_sh):
+            shape_to_sh.setdefault(p.shape, s)
+        rep = shd.replicated(mesh)
+
+        def pick(leaf):
+            if hasattr(leaf, "shape") and leaf.shape in shape_to_sh and len(leaf.shape) > 0:
+                return shape_to_sh[leaf.shape]
+            return rep
+
+        return jax.tree.map(pick, tree)
+
+    return TrainState(
+        params=param_sh,
+        opt_state=mirror(state.opt_state),
+        step=shd.replicated(mesh),
+    )
+
+
+def make_train_step(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer=None,
+    attn_fn: Callable | None = None,
+) -> Callable:
+    """Build the jitted SPMD train step: (state, tokens, targets) -> (state, metrics).
+
+    Gradients are averaged over (data, fsdp) implicitly by XLA from the sharded loss;
+    param/optimizer shards (fsdp axis) are all-gathered/reduce-scattered by XLA as
+    needed — the ZeRO-3 pattern without manual collectives.
+    """
+    optimizer = optimizer or make_optimizer()
+    batch_sh = NamedSharding(mesh, P(("data", "fsdp"), None))
+
+    def step_fn(state: TrainState, tokens, targets):
+        def loss(params):
+            return llama.loss_fn(params, tokens, targets, cfg, attn_fn)
+
+        lossval, grads = jax.value_and_grad(loss)(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(new_params, new_opt, state.step + 1)
+        return new_state, {"loss": lossval, "grad_norm": gnorm, "step": new_state.step}
+
+    def compile_step(state: TrainState):
+        sh = state_shardings(cfg, mesh, state)
+        state_sh = TrainState(sh.params, sh.opt_state, sh.step)
+        return jax.jit(
+            step_fn,
+            in_shardings=(state_sh, batch_sh, batch_sh),
+            out_shardings=(state_sh, NamedSharding(mesh, P())),
+            donate_argnums=(0,),
+        )
+
+    return compile_step
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["params", "opt_state", "step"], meta_fields=[]
+)

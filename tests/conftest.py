@@ -8,9 +8,18 @@ for simulating multi-node without hardware.
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax backend initialization.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The axon TPU-tunnel sitecustomize force-registers its platform via jax config
+# (overriding the env var); pin the config back to cpu so tests never touch the
+# single real chip (one process may hold it at a time).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

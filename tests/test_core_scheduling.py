@@ -143,3 +143,21 @@ def test_cluster_resources_api(ray_start_cluster):
     total = ray_tpu.cluster_resources()
     assert total["CPU"] == 16.0  # 4 nodes x 4 cpus
     assert len(ray_tpu.nodes()) == 4
+
+
+def test_pending_pg_places_when_resources_free(ray_start_regular):
+    """PG infeasible at creation must place once resources free up
+    (reference: gcs_placement_group_manager retry loop)."""
+    import time as _t
+
+    @ray_tpu.remote(num_cpus=8)
+    def hog():
+        _t.sleep(0.6)
+        return 1
+
+    h = hog.remote()
+    _t.sleep(0.1)
+    pg = ray_tpu.placement_group([{"CPU": 8}], strategy="PACK")
+    assert not pg.wait(0.1)  # blocked by the hog
+    assert ray_tpu.get(h) == 1
+    assert pg.wait(5)  # placed after release

@@ -204,3 +204,46 @@ def test_permanently_lost_dep_fails_not_hangs(ray_start_regular):
     ref = use.remote(x)
     with pytest.raises((ObjectLostError, TaskError)):
         ray_tpu.get(ref, timeout=5)
+
+
+def test_retry_keeps_deps_alive(ray_start_regular):
+    """Deps must stay pinned across retry attempts."""
+    import gc
+
+    calls = {"n": 0}
+    dep = ray_tpu.put("payload")
+
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def flaky(v):
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise RuntimeError("boom")
+        return v
+
+    ref = flaky.remote(dep)
+    del dep
+    gc.collect()
+    assert ray_tpu.get(ref, timeout=10) == "payload"
+
+
+def test_multi_return_lineage_survives_partial_ref_drop(ray_start_regular):
+    """Dropping one of two return refs must not break recovery of the other."""
+    import gc
+
+    from ray_tpu.core.runtime import get_runtime
+
+    calls = {"n": 0}
+    src = ray_tpu.put(21)
+
+    @ray_tpu.remote(num_returns=2)
+    def pair(x):
+        calls["n"] += 1
+        return x, x * 2
+
+    a, b = pair.remote(src)
+    assert ray_tpu.get(b) == 42
+    del a
+    gc.collect()
+    get_runtime().memory_store.evict([b.object_id()])
+    assert ray_tpu.get(b, timeout=10) == 42
+    assert calls["n"] == 2
