@@ -1,0 +1,30 @@
+"""ray_tpu.data: streaming datasets over the task runtime.
+
+Parity surface: ray.data (Dataset, read_*/from_*, map_batches, iter_batches,
+streaming_split). Blocks are columnar numpy, streamed through backpressured
+task pipelines; `iter_batches(batch_format="jax")` lands batches in HBM.
+"""
+
+from ray_tpu.data.block import Block
+from ray_tpu.data.dataset import DataIterator, Dataset
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Block", "Dataset", "DataIterator",
+    "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
+    "from_huggingface", "read_parquet", "read_csv", "read_json", "read_text",
+    "read_binary_files", "read_numpy",
+]

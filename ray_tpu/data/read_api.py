@@ -1,0 +1,171 @@
+"""Read/from constructors for Datasets.
+
+Parity: python/ray/data/read_api.py (range :*, read_parquet :1342, read_json
+:1849, read_csv :2023, read_text, read_binary_files, read_numpy;
+from_pandas/from_numpy/from_items/from_arrow/from_huggingface). Reads are
+file-partitioned: one block per file (or per range chunk) so downstream
+operators stream.
+"""
+
+from __future__ import annotations
+
+import functools
+import glob as _glob
+import math
+import os
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+from ray_tpu.data.block import Block
+from ray_tpu.data.dataset import Dataset
+
+
+def _expand_paths(paths: str | list[str], suffix: str | None = None) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, "**", "*"), recursive=True)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    files = [p for p in out if os.path.isfile(p)]
+    if suffix:
+        matching = [p for p in files if p.endswith(suffix)]
+        files = matching or files
+    if not files:
+        raise FileNotFoundError(f"No files matched {paths}")
+    return files
+
+
+_range = range
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    """Reference: read_api.range — integer dataset with `id` column."""
+    chunk = max(1, math.ceil(n / max(1, parallelism)))
+
+    def source() -> Iterator[Block]:
+        for start in _range(0, n, chunk):
+            yield Block({"id": np.arange(start, min(start + chunk, n))})
+
+    return Dataset(source, (), f"range({n})")
+
+
+def from_items(items: list[Any], *, parallelism: int = 8) -> Dataset:
+    chunk = max(1, math.ceil(len(items) / max(1, parallelism)))
+
+    def source() -> Iterator[Block]:
+        for i in _range(0, len(items), chunk):
+            yield Block.from_items(items[i : i + chunk])
+
+    return Dataset(source, (), "from_items")
+
+
+def from_numpy(arr: np.ndarray | dict, *, blocks: int = 8) -> Dataset:
+    block = Block.from_numpy(arr)
+    n = block.num_rows()
+    per = max(1, math.ceil(n / blocks))
+
+    def source() -> Iterator[Block]:
+        for i in _range(0, n, per):
+            yield block.slice(i, min(i + per, n))
+
+    return Dataset(source, (), "from_numpy")
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset(lambda: iter([Block.from_pandas(df)]), (), "from_pandas")
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset(lambda: iter([Block.from_arrow(table)]), (), "from_arrow")
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """Bridge from a datasets.Dataset (reference: read_api.from_huggingface)."""
+
+    def source() -> Iterator[Block]:
+        batch = hf_dataset.with_format("numpy")
+        size = len(hf_dataset)
+        per = max(1, size // 8)
+        for i in _range(0, size, per):
+            rows = batch[i : min(i + per, size)]
+            yield Block({k: np.asarray(v) for k, v in rows.items()})
+
+    return Dataset(source, (), "from_huggingface")
+
+
+def read_parquet(paths: str | list[str]) -> Dataset:
+    """Reference: read_api.read_parquet :1342 — one block per file."""
+    files = _expand_paths(paths, ".parquet")
+
+    def source() -> Iterator[Block]:
+        import pyarrow.parquet as pq
+
+        for f in files:
+            yield Block.from_arrow(pq.read_table(f))
+
+    return Dataset(source, (), "read_parquet")
+
+
+def read_csv(paths: str | list[str]) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    def source() -> Iterator[Block]:
+        import pandas as pd
+
+        for f in files:
+            yield Block.from_pandas(pd.read_csv(f))
+
+    return Dataset(source, (), "read_csv")
+
+
+def read_json(paths: str | list[str]) -> Dataset:
+    files = _expand_paths(paths, ".json")
+
+    def source() -> Iterator[Block]:
+        import pandas as pd
+
+        for f in files:
+            yield Block.from_pandas(pd.read_json(f, orient="records", lines=True))
+
+    return Dataset(source, (), "read_json")
+
+
+def read_text(paths: str | list[str]) -> Dataset:
+    files = _expand_paths(paths)
+
+    def source() -> Iterator[Block]:
+        for f in files:
+            with open(f) as fh:
+                lines = [ln.rstrip("\n") for ln in fh]
+            yield Block({"text": np.asarray(lines, dtype=object)})
+
+    return Dataset(source, (), "read_text")
+
+
+def read_binary_files(paths: str | list[str]) -> Dataset:
+    files = _expand_paths(paths)
+
+    def source() -> Iterator[Block]:
+        for f in files:
+            with open(f, "rb") as fh:
+                data = fh.read()
+            yield Block({"path": np.asarray([f], dtype=object),
+                         "bytes": np.asarray([data], dtype=object)})
+
+    return Dataset(source, (), "read_binary_files")
+
+
+def read_numpy(paths: str | list[str]) -> Dataset:
+    files = _expand_paths(paths, ".npy")
+
+    def source() -> Iterator[Block]:
+        for f in files:
+            yield Block.from_numpy(np.load(f))
+
+    return Dataset(source, (), "read_numpy")

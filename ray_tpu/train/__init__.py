@@ -1,0 +1,37 @@
+"""ray_tpu.train: gang-scheduled + SPMD training on TPU meshes.
+
+Parity surface: ray.train (report/get_context/Checkpoint/ScalingConfig/RunConfig/
+FailureConfig/Result) + JaxTrainer.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    JaxConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import TrainContext, get_context, report
+from ray_tpu.train.controller import TrainController
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointManager",
+    "CheckpointConfig",
+    "FailureConfig",
+    "JaxConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "TrainController",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "WorkerGroup",
+    "get_context",
+    "report",
+]

@@ -1,0 +1,132 @@
+"""Checkpoints: directory-based, orbax for jax pytrees, top-k retention.
+
+Parity: python/ray/train — Checkpoint (train/_checkpoint.py), CheckpointManager
+(train/v2/_internal/execution/checkpoint/checkpoint_manager.py), storage via
+pyarrow.fs (storage.py:14). TPU-native: pytree state is saved with orbax
+(async-capable, shard-aware) instead of torch.save.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class Checkpoint:
+    """A directory of checkpoint data (reference: ray.train.Checkpoint)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @staticmethod
+    def from_directory(path: str) -> "Checkpoint":
+        return Checkpoint(os.path.abspath(path))
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # --- jax pytree helpers (orbax) ---
+    @staticmethod
+    def from_state(state: Any, base_dir: str | None = None) -> "Checkpoint":
+        """Save a jax pytree (e.g. TrainState) with orbax."""
+        import orbax.checkpoint as ocp
+
+        base = base_dir or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        path = os.path.join(base, f"state_{int(time.time() * 1e6)}")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, state)
+        ckptr.wait_until_finished()
+        return Checkpoint(path)
+
+    def to_state(self, target: Any = None) -> Any:
+        """Restore a pytree; `target` provides structure/shardings."""
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        if target is not None:
+            return ckptr.restore(self.path, target)
+        return ckptr.restore(self.path)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+@dataclass
+class _Tracked:
+    checkpoint: Checkpoint
+    metrics: dict
+    index: int
+
+
+class CheckpointManager:
+    """Top-k checkpoint retention (reference: checkpoint_manager.py)."""
+
+    def __init__(self, storage_path: str, num_to_keep: int | None = None,
+                 score_attribute: str | None = None, score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._tracked: list[_Tracked] = []
+        self._index = 0
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
+        """Persist the checkpoint into storage_path and enforce retention."""
+        dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(checkpoint.path, dest)
+        with open(os.path.join(dest, "_metrics.json"), "w") as f:
+            json.dump(_jsonable(metrics), f)
+        tracked = _Tracked(Checkpoint(dest), metrics, self._index)
+        self._tracked.append(tracked)
+        self._index += 1
+        self._enforce_retention()
+        return tracked.checkpoint
+
+    def _enforce_retention(self) -> None:
+        if self.num_to_keep is None or len(self._tracked) <= self.num_to_keep:
+            return
+        if self.score_attribute:
+            rev = self.score_order == "max"
+            ordered = sorted(
+                self._tracked, key=lambda t: t.metrics.get(self.score_attribute, 0), reverse=rev
+            )
+        else:
+            ordered = sorted(self._tracked, key=lambda t: t.index, reverse=True)
+        keep = set(id(t) for t in ordered[: self.num_to_keep])
+        for t in list(self._tracked):
+            if id(t) not in keep:
+                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+                self._tracked.remove(t)
+
+    def best_checkpoint(self) -> Checkpoint | None:
+        if not self._tracked:
+            return None
+        if self.score_attribute:
+            rev = self.score_order == "max"
+            return sorted(
+                self._tracked, key=lambda t: t.metrics.get(self.score_attribute, 0), reverse=rev
+            )[0].checkpoint
+        return self._tracked[-1].checkpoint
+
+    def latest_checkpoint(self) -> Checkpoint | None:
+        return self._tracked[-1].checkpoint if self._tracked else None
+
+
+def _jsonable(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = float(v) if hasattr(v, "__float__") else str(v)
+    return out

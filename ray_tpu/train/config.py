@@ -1,0 +1,85 @@
+"""Train/AIR configuration dataclasses.
+
+Parity: python/ray/air/config.py (ScalingConfig, RunConfig, FailureConfig,
+CheckpointConfig) and train/v2 JaxConfig (train/v2/jax/config.py:40).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """Reference: air/config.py ScalingConfig."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: dict[str, float] | None = None
+    placement_strategy: str = "PACK"
+    # TPU topology request (reference: SlicePlacementGroup util/tpu.py:420)
+    topology: str | None = None  # e.g. "v5p-16"
+
+    def worker_resources(self) -> dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Reference: air/config.py FailureConfig; train/v2 failure_handling."""
+
+    max_failures: int = 0  # retries of the whole worker group
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Reference: air/config.py CheckpointConfig."""
+
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Reference: air/config.py RunConfig."""
+
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results", self.name or "experiment"
+        )
+
+
+@dataclasses.dataclass
+class JaxConfig:
+    """Reference: train/v2/jax/config.py:40 (JaxConfig) — TPU backend setup.
+
+    In multi-host mode each worker calls jax.distributed.initialize with the
+    rank-0 coordinator; MEGASCALE vars are injected for multislice
+    (config.py:29-35). Single-host (this controller) needs neither.
+    """
+
+    distributed: bool = False
+    coordinator_port: int = 8476
+    num_slices: int = 1
+
+
+@dataclasses.dataclass
+class Result:
+    """Reference: air/result.py."""
+
+    metrics: dict[str, Any]
+    checkpoint: Optional["Checkpoint"]  # noqa: F821
+    error: BaseException | None = None
+    metrics_history: list[dict] = dataclasses.field(default_factory=list)

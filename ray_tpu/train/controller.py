@@ -1,0 +1,89 @@
+"""TrainController: the state machine driving a worker-group run.
+
+Parity: train/v2/_internal/execution/controller/controller.py:105 (TrainController;
+control loop :706, run :763) — polls workers, aggregates reports, applies the
+FailurePolicy (restart the group ≤ max_failures), registers checkpoints.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import FailureConfig, Result, RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainController:
+    POLL_INTERVAL_S = 0.05
+
+    def __init__(
+        self,
+        train_fn: Callable,
+        train_loop_config: dict,
+        scaling: ScalingConfig,
+        run_config: RunConfig,
+    ):
+        self.train_fn = train_fn
+        self.train_loop_config = train_loop_config
+        self.scaling = scaling
+        self.run_config = run_config
+        self.checkpoint_manager = CheckpointManager(
+            run_config.resolved_storage_path(),
+            num_to_keep=run_config.checkpoint_config.num_to_keep,
+            score_attribute=run_config.checkpoint_config.checkpoint_score_attribute,
+            score_order=run_config.checkpoint_config.checkpoint_score_order,
+        )
+
+    def run(self) -> Result:
+        failures = 0
+        while True:
+            result = self._run_attempt()
+            if result.error is None:
+                return result
+            failures += 1
+            if failures > self.run_config.failure_config.max_failures:
+                return result
+
+    def _run_attempt(self) -> Result:
+        group = WorkerGroup(self.scaling)
+        metrics_history: list[dict] = []
+        last_metrics: dict = {}
+        error: BaseException | None = None
+        try:
+            group.start()
+            group.run(self.train_fn, self.train_loop_config)
+            while True:
+                statuses = group.poll()
+                # aggregate rank reports; rank 0's metrics win (reference:
+                # controller aggregates polls, rank-0 checkpoint registered)
+                step_reports: list[dict] = []
+                for rank, st in enumerate(statuses):
+                    for rep in st["reports"]:
+                        if rank == 0:
+                            step_reports.append(rep)
+                for rep in step_reports:
+                    last_metrics = rep["metrics"]
+                    metrics_history.append(last_metrics)
+                    if rep["checkpoint"]:
+                        self.checkpoint_manager.register(
+                            Checkpoint(rep["checkpoint"]), last_metrics
+                        )
+                errs = [st["error"] for st in statuses if st["error"]]
+                if errs:
+                    error = RuntimeError(f"{len(errs)} train worker(s) failed:\n" + errs[0])
+                    break
+                if all(st["finished"] for st in statuses):
+                    break
+                time.sleep(self.POLL_INTERVAL_S)
+        except BaseException as e:  # noqa: BLE001
+            error = e
+        finally:
+            group.shutdown()
+        return Result(
+            metrics=last_metrics,
+            checkpoint=self.checkpoint_manager.latest_checkpoint(),
+            error=error,
+            metrics_history=metrics_history,
+        )

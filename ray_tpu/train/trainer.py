@@ -1,0 +1,79 @@
+"""Trainers: JaxTrainer (SPMD single-controller) + DataParallelTrainer (gang).
+
+Parity: train/v2/jax/jax_trainer.py:20 (JaxTrainer) and
+train/v2/api/data_parallel_trainer.py:159 (DataParallelTrainer.fit).
+
+TPU-first design note: on a TPU pod the idiomatic execution model is
+single-controller SPMD — ONE process drives a pjit'd step over the whole mesh
+(all parallelism is mesh axes; XLA owns the collectives). The gang-of-workers
+model (DataParallelTrainer) exists for multi-host / CPU-preprocessing workers
+and for API parity with the reference's per-rank process groups.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ray_tpu.train import spmd
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import JaxConfig, Result, RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+
+
+class DataParallelTrainer:
+    """Gang-scheduled trainer: N worker actors each running train_loop_per_worker.
+
+    Reference: train/v2/api/data_parallel_trainer.py — controller actor → PG →
+    worker gang → backend setup → loop; here the controller runs in-process and
+    workers are ray_tpu actors.
+    """
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        datasets: dict | None = None,
+    ):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig(name=type(self).__name__.lower())
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        cfg = dict(self.train_loop_config)
+        if self.datasets:
+            cfg["_datasets"] = self.datasets
+        controller = TrainController(
+            self.train_loop_per_worker, cfg, self.scaling_config, self.run_config
+        )
+        return controller.run()
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Reference: train/v2/jax/jax_trainer.py:20 — but TPU-native: the worker
+    loop gets a ready-made mesh; multislice/multi-host env is injected by
+    JaxConfig (MEGASCALE pattern, train/v2/jax/config.py:29)."""
+
+    def __init__(self, *args, jax_config: JaxConfig | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.jax_config = jax_config or JaxConfig()
+
+    def fit(self) -> Result:
+        if self.jax_config.distributed:
+            # Multi-host gangs need per-process workers (jax.distributed +
+            # MEGASCALE env, reference train/v2/jax/config.py:29-65). The
+            # single-controller runtime runs every worker in one process where
+            # jax.distributed.initialize cannot be called per-rank — fail loudly
+            # rather than silently training on a fraction of the mesh.
+            raise NotImplementedError(
+                "JaxConfig(distributed=True) requires the multi-process cluster "
+                "backend (multi-host). In single-controller mode express "
+                "parallelism as mesh axes instead (ray_tpu.parallel.make_mesh); "
+                "multislice env helpers: ray_tpu.parallel.mesh.multislice_env()."
+            )
+        self.train_loop_config["_jax_config"] = self.jax_config
+        return super().fit()

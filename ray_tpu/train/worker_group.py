@@ -1,0 +1,141 @@
+"""WorkerGroup: the gang of train-worker actors in a placement group.
+
+Parity: train/v2/_internal/execution/worker_group/worker_group.py:88 (WorkerGroup;
+PG creation :275 — one bundle per worker, PACK/SPREAD per ScalingConfig). Each
+worker actor runs the user train loop in its own thread (thread_runner.py) and
+streams reports back through a rendezvous queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.context import TrainContext, set_context
+
+
+@dataclass
+class WorkerStatus:
+    rank: int
+    finished: bool = False
+    error: str | None = None
+    result: Any = None
+
+
+class RayTrainWorker:
+    """Actor hosting one rank's train loop (reference: RayTrainWorker)."""
+
+    def __init__(self, rank: int, world_size: int, group_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+        self._reports: "queue.Queue[tuple[dict, Checkpoint | None]]" = queue.Queue()
+        self._done = threading.Event()
+        self._error: str | None = None
+        self._result: Any = None
+
+    def run(self, train_fn: Callable, config: dict) -> None:
+        ctx = TrainContext(
+            rank=self.rank,
+            world_size=self.world_size,
+            report_fn=lambda m, c: self._reports.put((m, c)),
+        )
+
+        def target():
+            set_context(ctx)
+            try:
+                self._result = train_fn(config) if _wants_arg(train_fn) else train_fn()
+            except BaseException:  # noqa: BLE001
+                self._error = traceback.format_exc()
+            finally:
+                self._done.set()
+
+        threading.Thread(target=target, daemon=True, name=f"train-rank-{self.rank}").start()
+
+    def poll(self) -> dict:
+        """Drain pending reports; controller calls this periodically
+        (reference: worker_group/poll.py).
+
+        Order matters: read `finished` BEFORE draining. If the loop finished
+        first, all its reports are already queued and this drain gets them; the
+        reverse order could report finished=True while the final report (and
+        checkpoint) sits undelivered."""
+        finished = self._done.is_set()
+        reports = []
+        try:
+            while True:
+                m, c = self._reports.get_nowait()
+                reports.append({"metrics": m, "checkpoint": c.path if c else None})
+        except queue.Empty:
+            pass
+        return {
+            "reports": reports,
+            "finished": finished,
+            "error": self._error if finished else None,
+            "result": self._result if finished else None,
+        }
+
+    def shutdown(self) -> bool:
+        return True
+
+
+def _wants_arg(fn: Callable) -> bool:
+    import inspect
+
+    try:
+        return len(inspect.signature(fn).parameters) > 0
+    except (TypeError, ValueError):
+        return False
+
+
+class WorkerGroup:
+    """Creates the PG + actor gang; relays run/poll/shutdown."""
+
+    def __init__(self, scaling, group_name: str = "train"):
+        self.scaling = scaling
+        self.group_name = group_name
+        self.pg = None
+        self.workers: list = []
+
+    def start(self) -> None:
+        n = self.scaling.num_workers
+        res = self.scaling.worker_resources()
+        bundles = [dict(res) for _ in range(n)]
+        self.pg = ray_tpu.placement_group(bundles, strategy=self.scaling.placement_strategy)
+        if not self.pg.wait(30):
+            raise RuntimeError(
+                f"Train placement group ({n} x {res}) could not be placed"
+            )
+        actor_cls = ray_tpu.remote(**{"num_cpus": res.get("CPU", 1.0), "num_tpus": res.get("TPU", 0.0), "max_concurrency": 4})(RayTrainWorker)
+        self.workers = [
+            actor_cls.options(
+                scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=i
+                )
+            ).remote(i, n, self.group_name)
+            for i in range(n)
+        ]
+
+    def run(self, train_fn: Callable, config: dict) -> None:
+        ray_tpu.get([w.run.remote(train_fn, config) for w in self.workers])
+
+    def poll(self) -> list[dict]:
+        return ray_tpu.get([w.poll.remote() for w in self.workers])
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        if self.pg is not None:
+            try:
+                ray_tpu.remove_placement_group(self.pg)
+            except Exception:
+                pass
+        self.workers = []

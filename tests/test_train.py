@@ -1,0 +1,148 @@
+"""Train library tests (model: reference python/ray/train/v2/tests/)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+
+
+@pytest.fixture(autouse=True)
+def _session(ray_start_regular):
+    yield
+
+
+def _run(loop, workers=2, **run_kw):
+    return rt_train.DataParallelTrainer(
+        loop,
+        scaling_config=rt_train.ScalingConfig(num_workers=workers),
+        run_config=rt_train.RunConfig(name="t", storage_path=tempfile.mkdtemp(), **run_kw),
+    ).fit()
+
+
+def test_basic_report_aggregation():
+    def loop(config):
+        ctx = rt_train.get_context()
+        for step in range(3):
+            rt_train.report({"step": step, "rank": ctx.get_world_rank()})
+
+    res = _run(loop)
+    assert res.error is None
+    assert res.metrics["step"] == 2
+    assert len(res.metrics_history) == 3  # rank-0 reports only
+
+
+def test_world_size_and_rank():
+    def loop(config):
+        ctx = rt_train.get_context()
+        rt_train.report({"rank": ctx.get_world_rank(), "ws": ctx.get_world_size()})
+
+    res = _run(loop, workers=3)
+    assert res.metrics["ws"] == 3
+
+
+def test_checkpoint_registration_and_retention():
+    def loop(config):
+        for step in range(4):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "s.txt"), "w") as f:
+                f.write(str(step))
+            rt_train.report({"score": step}, rt_train.Checkpoint.from_directory(d))
+
+    storage = tempfile.mkdtemp()
+    res = rt_train.DataParallelTrainer(
+        loop,
+        scaling_config=rt_train.ScalingConfig(num_workers=1),
+        run_config=rt_train.RunConfig(
+            name="ck", storage_path=storage,
+            checkpoint_config=rt_train.CheckpointConfig(num_to_keep=2),
+        ),
+    ).fit()
+    assert res.error is None
+    kept = [p for p in os.listdir(storage) if p.startswith("checkpoint_")]
+    assert len(kept) == 2
+    with open(os.path.join(res.checkpoint.path, "s.txt")) as f:
+        assert f.read() == "3"
+
+
+def test_worker_failure_surfaces():
+    def loop(config):
+        ctx = rt_train.get_context()
+        if ctx.get_world_rank() == 1:
+            raise RuntimeError("rank1 exploded")
+        rt_train.report({"ok": 1})
+
+    res = _run(loop)
+    assert res.error is not None
+    assert "rank1 exploded" in str(res.error)
+
+
+def test_failure_config_retries():
+    marker = {"attempts": 0}
+
+    def loop(config):
+        ctx = rt_train.get_context()
+        if ctx.get_world_rank() == 0:
+            marker["attempts"] += 1
+            if marker["attempts"] == 1:
+                raise RuntimeError("first attempt fails")
+        rt_train.report({"done": 1})
+
+    res = _run(loop, failure_config=rt_train.FailureConfig(max_failures=1))
+    assert res.error is None
+    assert marker["attempts"] == 2
+
+
+def test_jax_spmd_training_through_trainer():
+    """The aha slice (SURVEY §7.5): trainer gang -> pjit model train step ->
+    orbax checkpoint via report."""
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import make_mesh
+    from ray_tpu.train import spmd
+
+    def loop(config):
+        ctx = rt_train.get_context()
+        cfg = llama.LlamaConfig.tiny()
+        mesh = make_mesh(4, devices=jax.devices("cpu")[:4], data=2, fsdp=2)
+        state = spmd.init_state(cfg, jax.random.PRNGKey(0),
+                                optimizer=spmd.make_optimizer(learning_rate=1e-2, warmup=1))
+        step = spmd.make_train_step(cfg, mesh)(state)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+        targets = np.roll(np.asarray(tokens), -1, axis=1)
+        import jax.numpy as jnp
+
+        targets = jnp.asarray(targets)
+        losses = []
+        for i in range(3):
+            state, metrics = step(state, tokens, targets)
+            losses.append(float(metrics["loss"]))
+            if ctx.get_world_rank() == 0:
+                ckpt = rt_train.Checkpoint.from_state({"params": state.params}) if i == 2 else None
+                rt_train.report({"loss": losses[-1]}, ckpt)
+
+    res = _run(loop, workers=1)
+    assert res.error is None, res.error
+    assert res.checkpoint is not None
+    # restore roundtrip
+    restored = res.checkpoint.to_state()
+    assert "params" in restored
+
+
+def test_host_barrier_in_train_loop():
+    from ray_tpu.parallel.collectives import init_collective_group
+
+    def loop(config):
+        ctx = rt_train.get_context()
+        grp = init_collective_group(ctx.get_world_size(), ctx.get_world_rank(), "train_bar")
+        val = grp.broadcast_from_rank_zero("cfg", {"lr": 0.1} if ctx.get_world_rank() == 0 else None)
+        grp.barrier(timeout=30)
+        rt_train.report({"lr": val["lr"]})
+
+    res = _run(loop)
+    assert res.error is None
+    assert res.metrics["lr"] == 0.1
