@@ -27,13 +27,19 @@ from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
 
 @dataclass
 class RayObject:
-    """A stored value or error (reference: src/ray/common/ray_object.h)."""
+    """A stored value or error (reference: src/ray/common/ray_object.h).
+
+    ``in_shm`` marks the value as living in the node's shared-memory store
+    (plasma equivalent) — the runtime fetches/deserializes it zero-copy at
+    resolve time; a miss there means the object was evicted (→ recovery).
+    """
 
     value: Any = None
     error: BaseException | None = None
     # serialized blob for shm-backed objects (lazily deserialized)
     blob: bytes | memoryview | None = None
     size: int = 0
+    in_shm: bool = False
 
     def resolve(self) -> Any:
         if self.error is not None:

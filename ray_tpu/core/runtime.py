@@ -170,6 +170,21 @@ class Runtime:
         self.reference_counter = ReferenceCounter()
         self.scheduler = ClusterScheduler(config)
         self.reference_counter.add_on_zero_callback(self._on_ref_zero)
+        # Node-local shared-memory store for large objects (plasma equivalent;
+        # reference: objects > max_direct_call_object_size go to plasma,
+        # core_worker.cc:1026). Falls back to in-memory if the native build fails.
+        self.shm_store = None
+        import os as _os
+
+        if _os.environ.get("RAY_TPU_DISABLE_SHM") != "1":
+            try:
+                from ray_tpu.core.shm_store import SharedMemoryStore
+
+                self.shm_store = SharedMemoryStore(
+                    f"/raytpu_{self.job_id.hex()}", size=config.object_store_memory, owner=True
+                )
+            except Exception as e:  # pragma: no cover - toolchain missing
+                logger.warning("native shm store unavailable (%s); using memory store only", e)
 
         import os
 
@@ -208,6 +223,21 @@ class Runtime:
             self.memory_store.put(oid, RayObject(error=value))
             return
         size = _rough_size(value)
+        # Promote large objects to the shared-memory store (plasma path); the
+        # memory store keeps only a marker. Reference: max_direct_call_object_size
+        # boundary (ray_config_def.h:245).
+        if self.shm_store is not None and size > self.config.max_inline_object_size:
+            try:
+                blob = serialization.serialize_to_bytes(value)
+                self.shm_store.put_bytes(oid, blob)
+                # Pin while referenced: LRU eviction must not take objects with
+                # live ObjectRefs (plasma pins primary copies of referenced
+                # objects). Released in _on_ref_zero.
+                self.shm_store.pin(oid)
+                self.memory_store.put(oid, RayObject(size=len(blob), in_shm=True))
+                return
+            except Exception as e:  # store full and unevictable -> inline fallback
+                logger.debug("shm put failed for %s (%s); storing inline", oid.hex()[:8], e)
         self.memory_store.put(oid, RayObject(value=value, size=size))
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
@@ -240,6 +270,17 @@ class Runtime:
                 self._recover_object(oid)
                 return _RETRY
             raise obj.error
+        if obj.in_shm:
+            view = self.shm_store.get_bytes(oid) if self.shm_store else None
+            if view is None:
+                # Evicted under memory pressure -> recover via lineage
+                # (reference: plasma miss -> FetchOrReconstruct, §3.2.7).
+                self.memory_store.delete([oid])
+                self._recover_object(oid)
+                return _RETRY
+            # Zero-copy: arrays alias the shm segment; the pin taken by
+            # get_bytes is released by the buffer's GC finalizer.
+            return serialization.deserialize_from_bytes(view)
         return obj.resolve()
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
@@ -261,6 +302,9 @@ class Runtime:
     def _on_ref_zero(self, oid: ObjectID) -> None:
         # Out of scope everywhere -> evict value and release lineage
         self.memory_store.delete([oid])
+        if self.shm_store is not None:
+            self.shm_store.release(oid)  # drop the runtime's referenced-pin
+            self.shm_store.delete(oid)
         with self._lock:
             spec = self._lineage.pop(oid, None)
         if spec is not None:
@@ -269,6 +313,10 @@ class Runtime:
 
     def free(self, refs: list[ObjectRef]) -> None:
         self.memory_store.delete([r.object_id() for r in refs])
+        if self.shm_store is not None:
+            for r in refs:
+                self.shm_store.release(r.object_id())
+                self.shm_store.delete(r.object_id())
 
     # ------------------------------------------------------------------ recovery
     def _recover_object(self, oid: ObjectID) -> None:
@@ -397,6 +445,8 @@ class Runtime:
 
     def _execute_task(self, entry: _TaskEntry, req: SchedulingRequest) -> None:
         spec = entry.spec
+        if self.is_shutdown:
+            return  # session torn down while this task was in flight
         self._record_event(spec, "RUNNING")
         try:
             args, kwargs = self._resolve_args(spec)
@@ -859,6 +909,11 @@ class Runtime:
             for _ in state.threads:
                 state.mailbox.put(None)
         self.scheduler.notify()
+        if self.shm_store is not None:
+            try:
+                self.shm_store.close()
+            except Exception:
+                pass
 
 
 _RETRY = object()

@@ -1,0 +1,163 @@
+"""Python client for the native shared-memory object store.
+
+Parity: the plasma client (object_manager/plasma/client.cc) — create/seal/get/
+release/delete against the node-local store, zero-copy reads via mmap. Unlike
+plasma there is no store process or socket: every process maps the same segment
+(see shm_store.cpp header comment).
+"""
+
+from __future__ import annotations
+
+import atexit
+import ctypes
+import os
+from typing import Optional
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+class _Lib:
+    _instance = None
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            from ray_tpu.native.build import build_library
+
+            path = build_library("shm_store")
+            lib = ctypes.CDLL(path)
+            lib.shm_store_create.restype = ctypes.c_void_p
+            lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+            lib.shm_store_create_object.restype = ctypes.c_uint64
+            lib.shm_store_create_object.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int)
+            ]
+            lib.shm_store_seal.restype = ctypes.c_int
+            lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_get.restype = ctypes.c_uint64
+            lib.shm_store_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64)
+            ]
+            lib.shm_store_contains.restype = ctypes.c_int
+            lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_pin.restype = ctypes.c_int
+            lib.shm_store_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_release.restype = ctypes.c_int
+            lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_delete.restype = ctypes.c_int
+            lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_base.restype = ctypes.c_void_p
+            lib.shm_store_base.argtypes = [ctypes.c_void_p]
+            lib.shm_store_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
+            lib.shm_store_close.argtypes = [ctypes.c_void_p]
+            lib.shm_store_unlink.argtypes = [ctypes.c_char_p]
+            cls._instance = lib
+        return cls._instance
+
+
+def _release_pin(lib, handle, id_bytes: bytes) -> None:
+    try:
+        if handle:
+            lib.shm_store_release(handle, id_bytes)
+    except Exception:
+        pass
+
+
+class SharedMemoryStore:
+    """Node-local shm store handle (plasma-client equivalent)."""
+
+    def __init__(self, name: str, size: int = 512 * 1024 * 1024, table_cap: int = 65536,
+                 owner: bool = False):
+        self._lib = _Lib.get()
+        self.name = name
+        self.owner = owner
+        self._handle = self._lib.shm_store_create(name.encode(), size, table_cap)
+        if not self._handle:
+            raise RuntimeError(f"failed to create/open shm store {name}")
+        self._base = self._lib.shm_store_base(self._handle)
+        atexit.register(self.close)
+
+    # --- object lifecycle ---
+    def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> None:
+        import numpy as np
+
+        data = memoryview(data)
+        err = ctypes.c_int(0)
+        off = self._lib.shm_store_create_object(
+            self._handle, oid.binary(), len(data), ctypes.byref(err)
+        )
+        if err.value == 1:
+            # Entry exists — idempotent ONLY if it is sealed and readable; a
+            # crashed writer (CREATING) or pending delete (DELETING) is not.
+            if self.contains(oid):
+                return
+            raise ObjectStoreFullError(
+                f"object {oid.hex()[:12]} exists in an unreadable state"
+            )
+        if err.value != 0 or not off:
+            raise ObjectStoreFullError(
+                f"shm store cannot fit object of {len(data)} bytes (err={err.value})"
+            )
+        # single memcpy straight from the source buffer (no intermediate bytes())
+        dst = np.frombuffer(
+            (ctypes.c_char * len(data)).from_address(self._base + off), dtype=np.uint8
+        )
+        dst[:] = np.frombuffer(data, dtype=np.uint8)
+        self._lib.shm_store_seal(self._handle, oid.binary())
+
+    def get_bytes(self, oid: ObjectID, timeout_ms: int = 0) -> Optional[memoryview]:
+        """Zero-copy view of the sealed object.
+
+        The get pins the object; the pin is released when the returned buffer
+        (and everything sharing its memory, e.g. numpy arrays deserialized from
+        it) is garbage-collected — plasma's client-buffer lifetime contract, so
+        eviction/delete can never pull memory out from under a live array.
+        """
+        import weakref
+
+        size = ctypes.c_uint64(0)
+        off = self._lib.shm_store_get(self._handle, oid.binary(), timeout_ms, ctypes.byref(size))
+        if not off:
+            return None
+        buf = (ctypes.c_char * size.value).from_address(self._base + off)
+        weakref.finalize(buf, _release_pin, self._lib, self._handle, oid.binary())
+        return memoryview(buf)
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.shm_store_contains(self._handle, oid.binary()))
+
+    def pin(self, oid: ObjectID) -> bool:
+        """Hold the object against LRU eviction (one pin per live ObjectRef)."""
+        return bool(self._lib.shm_store_pin(self._handle, oid.binary()))
+
+    def release(self, oid: ObjectID) -> None:
+        self._lib.shm_store_release(self._handle, oid.binary())
+
+    def delete(self, oid: ObjectID) -> None:
+        self._lib.shm_store_delete(self._handle, oid.binary())
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.shm_store_stats(self._handle, ctypes.byref(out))
+        return {
+            "num_objects": out[0],
+            "bytes_in_use": out[1],
+            "arena_size": out[2],
+            "evictions": out[3],
+        }
+
+    def close(self) -> None:
+        """Retire the store's name. The mapping itself is NOT unmapped: live
+        zero-copy buffers (and their GC finalizers) may still reference it, so
+        the segment is left to die with the process — unlinking the name frees
+        the kernel namespace and lets the memory go when the last mapper exits."""
+        if self._handle and self.owner:
+            self.owner = False
+            self._lib.shm_store_unlink(self.name.encode())
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -107,7 +107,9 @@ def read_parquet(paths: str | list[str]) -> Dataset:
         import pyarrow.parquet as pq
 
         for f in files:
-            yield Block.from_arrow(pq.read_table(f))
+            # use_threads=False: pyarrow's internal pool segfaults sporadically
+            # inside this multi-threaded runtime (and 1-core hosts gain nothing)
+            yield Block.from_arrow(pq.read_table(f, use_threads=False))
 
     return Dataset(source, (), "read_parquet")
 

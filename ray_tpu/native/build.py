@@ -1,0 +1,40 @@
+"""Build native components (g++ → .so), cached by source hash.
+
+The reference builds its native runtime with bazel (src/ray/BUILD.bazel); here a
+single translation unit per component keeps the toolchain to `g++ -shared`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_library(name: str, extra_flags: list[str] | None = None) -> str:
+    """Compile ray_tpu/native/<name>.cpp to a cached .so; returns its path."""
+    src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_NATIVE_DIR, f"lib{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "-o", out, src, "-lpthread", "-lrt",
+    ] + (extra_flags or [])
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"native build failed for {name}:\n{e.stderr}") from e
+    # clean stale builds
+    for f in os.listdir(_NATIVE_DIR):
+        if f.startswith(f"lib{name}-") and f != os.path.basename(out):
+            try:
+                os.unlink(os.path.join(_NATIVE_DIR, f))
+            except OSError:
+                pass
+    return out

@@ -1,0 +1,462 @@
+// Shared-memory object store: the node-local object plane.
+//
+// TPU-native equivalent of the reference's Plasma store
+// (src/ray/object_manager/plasma/: PlasmaStore store.h:55, PlasmaAllocator +
+// dlmalloc.cc, ObjectLifecycleManager, LRU EvictionPolicy eviction_policy.h,
+// CreateRequestQueue backpressure). Semantics preserved, mechanism re-designed:
+//
+// - Instead of a store *process* serving clients over a unix socket with fd
+//   passing (plasma's fling.cc SCM_RIGHTS), the arena AND its metadata live in
+//   one POSIX shm segment that every worker process maps directly. All
+//   bookkeeping (object table, free list, LRU) is inside the segment, guarded
+//   by a process-shared mutex — create/seal/get are a few hundred ns with zero
+//   syscalls or copies on the hot path.
+// - Objects are immutable after seal (plasma's create→seal→get lifecycle).
+// - Refcounted pins (plasma client Release); eviction is LRU over sealed,
+//   unpinned objects (eviction_policy.h) triggered on allocation pressure.
+// - Blocking gets use a process-shared condvar (plasma's GetRequestQueue).
+//
+// C ABI for ctypes; no C++ symbols exported.
+//
+// Layout of the segment:
+//   [Header | ObjectEntry table (cap slots) | data arena (free-list allocated)]
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5241595f54505553ull;  // "RAY_TPUS"
+constexpr uint32_t kIdSize = 28;                    // ObjectID bytes (ids.py)
+constexpr uint32_t kAlign = 64;                     // cacheline-align payloads
+
+enum ObjState : uint32_t {
+  OBJ_FREE = 0,
+  OBJ_CREATING = 1,
+  OBJ_SEALED = 2,
+  OBJ_DELETING = 3,   // delete requested while pinned; freed on last release
+  OBJ_TOMBSTONE = 4,  // deleted slot: keeps linear-probe chains intact
+};
+
+struct ObjectEntry {
+  uint8_t id[kIdSize];
+  uint64_t offset;     // payload offset from segment base
+  uint64_t size;       // payload size
+  uint32_t state;
+  int32_t pins;        // client pin count (get without release)
+  uint64_t lru_tick;   // last access tick for eviction
+  uint64_t create_us;  // creation timestamp
+};
+
+struct FreeNode {   // lives inside the data arena
+  uint64_t size;    // bytes including this node header
+  uint64_t next;    // offset of next free node (0 = none)
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t table_off;
+  uint32_t table_cap;
+  uint64_t arena_off;
+  uint64_t arena_size;
+  uint64_t free_head;  // offset of first FreeNode (0 = none)
+  uint64_t lru_clock;
+  uint64_t bytes_in_use;
+  uint64_t num_objects;
+  uint64_t evictions;
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+};
+
+struct Store {  // per-process view
+  void* base;
+  Header* hdr;
+  ObjectEntry* table;
+  int fd;
+};
+
+uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the id bytes
+  uint64_t h = 1469598103934665603ull;
+  for (uint32_t i = 0; i < kIdSize; i++) h = (h ^ id[i]) * 1099511628211ull;
+  return h;
+}
+
+ObjectEntry* find_slot(Store* s, const uint8_t* id, bool for_insert) {
+  Header* h = s->hdr;
+  uint64_t cap = h->table_cap;
+  uint64_t idx = hash_id(id) % cap;
+  ObjectEntry* first_reusable = nullptr;  // first TOMBSTONE seen (insert target)
+  for (uint64_t probe = 0; probe < cap; probe++) {
+    ObjectEntry* e = &s->table[(idx + probe) % cap];
+    if (e->state == OBJ_FREE) {
+      // chain end: never-used slot
+      if (for_insert) return first_reusable ? first_reusable : e;
+      return nullptr;
+    }
+    if (e->state == OBJ_TOMBSTONE) {
+      if (!first_reusable) first_reusable = e;
+      continue;  // deleted slot: probe past it (chain continues)
+    }
+    if (memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return for_insert ? first_reusable : nullptr;
+}
+
+// --- free-list allocator (first fit with coalescing on free) ---
+uint64_t arena_alloc(Header* h, void* base, uint64_t want) {
+  want = align_up(want + sizeof(uint64_t), kAlign);  // prefix stores chunk size
+  uint64_t prev_off = 0;
+  uint64_t cur = h->free_head;
+  while (cur) {
+    FreeNode* node = (FreeNode*)((char*)base + cur);
+    if (node->size >= want) {
+      uint64_t remaining = node->size - want;
+      if (remaining >= sizeof(FreeNode) + kAlign) {
+        // split: tail stays free
+        uint64_t tail_off = cur + want;
+        FreeNode* tail = (FreeNode*)((char*)base + tail_off);
+        tail->size = remaining;
+        tail->next = node->next;
+        if (prev_off) ((FreeNode*)((char*)base + prev_off))->next = tail_off;
+        else h->free_head = tail_off;
+      } else {
+        want = node->size;  // take the whole chunk
+        if (prev_off) ((FreeNode*)((char*)base + prev_off))->next = node->next;
+        else h->free_head = node->next;
+      }
+      *(uint64_t*)((char*)base + cur) = want;  // chunk size prefix
+      h->bytes_in_use += want;
+      return cur + sizeof(uint64_t);  // payload offset
+    }
+    prev_off = cur;
+    cur = node->next;
+  }
+  return 0;  // out of memory
+}
+
+void arena_free(Header* h, void* base, uint64_t payload_off) {
+  uint64_t chunk_off = payload_off - sizeof(uint64_t);
+  uint64_t chunk_size = *(uint64_t*)((char*)base + chunk_off);
+  h->bytes_in_use -= chunk_size;
+  // insert sorted by offset, coalesce neighbors
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur && cur < chunk_off) {
+    prev = cur;
+    cur = ((FreeNode*)((char*)base + cur))->next;
+  }
+  FreeNode* node = (FreeNode*)((char*)base + chunk_off);
+  node->size = chunk_size;
+  node->next = cur;
+  if (prev) ((FreeNode*)((char*)base + prev))->next = chunk_off;
+  else h->free_head = chunk_off;
+  // coalesce with next
+  if (cur && chunk_off + node->size == cur) {
+    FreeNode* nx = (FreeNode*)((char*)base + cur);
+    node->size += nx->size;
+    node->next = nx->next;
+  }
+  // coalesce with prev
+  if (prev) {
+    FreeNode* pv = (FreeNode*)((char*)base + prev);
+    if (prev + pv->size == chunk_off) {
+      pv->size += node->size;
+      pv->next = node->next;
+    }
+  }
+}
+
+void free_entry_locked(Store* s, ObjectEntry* e) {
+  Header* h = s->hdr;
+  arena_free(h, s->base, e->offset);
+  e->state = OBJ_TOMBSTONE;  // preserve probe chains (see find_slot)
+  memset(e->id, 0, kIdSize);
+  e->pins = 0;
+  h->num_objects--;
+}
+
+// Evict least-recently-used sealed unpinned objects until an allocation of
+// `need` bytes would succeed. Returns number evicted. Mutex held by caller.
+int evict_lru(Store* s, uint64_t need) {
+  Header* h = s->hdr;
+  int evicted = 0;
+  while (true) {
+    uint64_t off = arena_alloc(h, s->base, need);
+    if (off) {
+      arena_free(h, s->base, off);  // probe only; caller re-allocates
+      return evicted;
+    }
+    ObjectEntry* victim = nullptr;
+    for (uint32_t i = 0; i < h->table_cap; i++) {
+      ObjectEntry* e = &s->table[i];
+      if (e->state == OBJ_SEALED && e->pins <= 0) {
+        if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+      }
+    }
+    if (!victim) return evicted;
+    free_entry_locked(s, victim);
+    h->evictions++;
+    evicted++;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (or open, if exists) a store segment. Returns opaque handle or null.
+void* shm_store_create(const char* name, uint64_t segment_size, uint32_t table_cap) {
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  bool creator = fd >= 0;
+  if (!creator) {
+    if (errno != EEXIST) return nullptr;
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    // wait for creator to size it
+    struct stat st;
+    for (int i = 0; i < 1000; i++) {
+      if (fstat(fd, &st) == 0 && (uint64_t)st.st_size >= sizeof(Header)) break;
+      usleep(1000);
+    }
+    if (fstat(fd, &st) != 0 || st.st_size == 0) { close(fd); return nullptr; }
+    segment_size = st.st_size;
+  } else {
+    if (ftruncate(fd, segment_size) != 0) { close(fd); shm_unlink(name); return nullptr; }
+  }
+  void* base = mmap(nullptr, segment_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) { close(fd); return nullptr; }
+
+  Store* s = new Store();
+  s->base = base;
+  s->hdr = (Header*)base;
+  s->fd = fd;
+
+  if (creator) {
+    Header* h = s->hdr;
+    memset(h, 0, sizeof(Header));
+    h->segment_size = segment_size;
+    h->table_off = align_up(sizeof(Header), kAlign);
+    h->table_cap = table_cap ? table_cap : 65536;
+    h->arena_off = align_up(h->table_off + (uint64_t)h->table_cap * sizeof(ObjectEntry), 4096);
+    h->arena_size = segment_size - h->arena_off;
+    memset((char*)base + h->table_off, 0, (uint64_t)h->table_cap * sizeof(ObjectEntry));
+    FreeNode* first = (FreeNode*)((char*)base + h->arena_off);
+    first->size = h->arena_size;
+    first->next = 0;
+    h->free_head = h->arena_off;
+
+    pthread_mutexattr_t ma;
+    pthread_mutexattr_init(&ma);
+    pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&h->mu, &ma);
+    pthread_condattr_t ca;
+    pthread_condattr_init(&ca);
+    pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+    pthread_cond_init(&h->cv, &ca);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    h->magic = kMagic;  // publish
+  } else {
+    for (int i = 0; i < 1000 && s->hdr->magic != kMagic; i++) usleep(1000);
+    if (s->hdr->magic != kMagic) { munmap(base, segment_size); close(fd); delete s; return nullptr; }
+    s->table = nullptr;
+  }
+  s->table = (ObjectEntry*)((char*)base + s->hdr->table_off);
+  return s;
+}
+
+static int lock_mu(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {  // holder died: state is consistent enough (coarse ops)
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+// Allocate space for object `id` of `size` bytes. Returns payload offset or 0.
+// err: 0 ok, 1 exists, 2 out-of-memory (after eviction), 3 internal.
+uint64_t shm_store_create_object(void* handle, const uint8_t* id, uint64_t size, int* err) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  if (lock_mu(h) != 0) { *err = 3; return 0; }
+  ObjectEntry* existing = find_slot(s, id, false);
+  if (existing && existing->state != OBJ_FREE) {
+    *err = 1;
+    pthread_mutex_unlock(&h->mu);
+    return 0;
+  }
+  uint64_t off = arena_alloc(h, s->base, size);
+  if (!off) {
+    evict_lru(s, size);
+    off = arena_alloc(h, s->base, size);
+  }
+  if (!off) {
+    *err = 2;
+    pthread_mutex_unlock(&h->mu);
+    return 0;
+  }
+  ObjectEntry* e = find_slot(s, id, true);
+  if (!e) {  // table full
+    arena_free(h, s->base, off);
+    *err = 2;
+    pthread_mutex_unlock(&h->mu);
+    return 0;
+  }
+  memcpy(e->id, id, kIdSize);
+  e->offset = off;
+  e->size = size;
+  e->state = OBJ_CREATING;
+  e->pins = 1;  // creator holds a pin until seal+release
+  e->lru_tick = ++h->lru_clock;
+  e->create_us = (uint64_t)time(nullptr) * 1000000ull;
+  h->num_objects++;
+  *err = 0;
+  pthread_mutex_unlock(&h->mu);
+  return off;
+}
+
+int shm_store_seal(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  if (lock_mu(h) != 0) return 3;
+  ObjectEntry* e = find_slot(s, id, false);
+  if (!e || e->state != OBJ_CREATING) { pthread_mutex_unlock(&h->mu); return 1; }
+  e->state = OBJ_SEALED;
+  e->pins -= 1;
+  pthread_cond_broadcast(&h->cv);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Get (pin) a sealed object. Blocks up to timeout_ms (-1 = forever, 0 = poll).
+// Returns payload offset (size in *size_out) or 0 if absent/timeout.
+uint64_t shm_store_get(void* handle, const uint8_t* id, int64_t timeout_ms, uint64_t* size_out) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  if (lock_mu(h) != 0) return 0;
+  struct timespec deadline;
+  if (timeout_ms > 0) {
+    clock_gettime(CLOCK_REALTIME, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) { deadline.tv_sec++; deadline.tv_nsec -= 1000000000L; }
+  }
+  while (true) {
+    ObjectEntry* e = find_slot(s, id, false);
+    if (e && e->state == OBJ_SEALED) {
+      e->pins += 1;
+      e->lru_tick = ++h->lru_clock;
+      *size_out = e->size;
+      uint64_t off = e->offset;
+      pthread_mutex_unlock(&h->mu);
+      return off;
+    }
+    if (timeout_ms == 0) break;
+    if (timeout_ms < 0) {
+      pthread_cond_wait(&h->cv, &h->mu);
+    } else if (pthread_cond_timedwait(&h->cv, &h->mu, &deadline) == ETIMEDOUT) {
+      break;
+    }
+  }
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Pin a sealed object without mapping it (runtime holds one pin per live
+// ObjectRef so LRU eviction never takes referenced objects — plasma's
+// pin-primary-copy rule, local_object_manager.h:45). Returns 1 if pinned.
+int shm_store_pin(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  if (lock_mu(h) != 0) return 0;
+  ObjectEntry* e = find_slot(s, id, false);
+  int ok = 0;
+  if (e && e->state == OBJ_SEALED) {
+    e->pins += 1;
+    ok = 1;
+  }
+  pthread_mutex_unlock(&h->mu);
+  return ok;
+}
+
+int shm_store_contains(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  if (lock_mu(s->hdr) != 0) return 0;
+  ObjectEntry* e = find_slot(s, id, false);
+  int ok = (e && e->state == OBJ_SEALED) ? 1 : 0;
+  pthread_mutex_unlock(&s->hdr->mu);
+  return ok;
+}
+
+static void free_entry(Store* s, ObjectEntry* e) { free_entry_locked(s, e); }
+
+// Drop one pin. If the object was delete-requested and this was the last pin,
+// free it now (plasma-client Release semantics: buffers keep objects alive).
+int shm_store_release(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  if (lock_mu(h) != 0) return 3;
+  ObjectEntry* e = find_slot(s, id, false);
+  if (e && e->pins > 0) {
+    e->pins -= 1;
+    if (e->pins == 0 && e->state == OBJ_DELETING) {
+      free_entry(s, e);
+      pthread_cond_broadcast(&h->cv);
+    }
+  }
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int shm_store_delete(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  if (lock_mu(h) != 0) return 3;
+  ObjectEntry* e = find_slot(s, id, false);
+  if (!e || e->state == OBJ_FREE) { pthread_mutex_unlock(&h->mu); return 1; }
+  if (e->pins > 0) {
+    e->state = OBJ_DELETING;  // invisible to get/contains; freed on last release
+  } else {
+    free_entry(s, e);
+  }
+  pthread_cond_broadcast(&h->cv);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+void* shm_store_base(void* handle) { return ((Store*)handle)->base; }
+
+void shm_store_stats(void* handle, uint64_t* out4) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  lock_mu(h);
+  out4[0] = h->num_objects;
+  out4[1] = h->bytes_in_use;
+  out4[2] = h->arena_size;
+  out4[3] = h->evictions;
+  pthread_mutex_unlock(&h->mu);
+}
+
+void shm_store_close(void* handle) {
+  Store* s = (Store*)handle;
+  munmap(s->base, s->hdr ? s->hdr->segment_size : 0);
+  close(s->fd);
+  delete s;
+}
+
+int shm_store_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

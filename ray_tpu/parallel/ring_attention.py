@@ -24,7 +24,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 NEG_INF = -1e30
 
@@ -92,7 +92,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq", positions=None
         mesh=mesh,
         in_specs=(pspec, pspec, pspec, pos_spec, pos_spec),
         out_specs=pspec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v, positions, positions)
 

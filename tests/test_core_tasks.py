@@ -69,7 +69,7 @@ def test_num_returns(ray_start_regular):
 def test_get_timeout(ray_start_regular):
     @ray_tpu.remote
     def forever():
-        time.sleep(30)
+        time.sleep(5)  # keep short: the thread outlives the test session
 
     ref = forever.remote()
     with pytest.raises(GetTimeoutError):

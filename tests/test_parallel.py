@@ -55,7 +55,7 @@ def test_shard_params_places_on_mesh():
 
 
 def test_device_collectives_in_shard_map():
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = cpu_mesh(data=8)

@@ -1,0 +1,205 @@
+"""Native shared-memory object store tests (model: reference plasma tests,
+src/ray/object_manager/plasma/ + python/ray/tests/test_object_store.py)."""
+
+import gc
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu.core.shm_store import SharedMemoryStore
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+@pytest.fixture
+def store():
+    name = f"/raytpu_t{os.getpid()}_{np.random.randint(1e9)}"
+    s = SharedMemoryStore(name, size=16 * 1024 * 1024, owner=True)
+    yield s
+    s.close()
+
+
+def oid(i=1):
+    return ObjectID.for_put(TaskID.for_normal_task(JobID.from_random()), i)
+
+
+def test_put_get_roundtrip(store):
+    o = oid()
+    data = np.random.bytes(100_000)
+    store.put_bytes(o, data)
+    assert bytes(store.get_bytes(o)) == data
+
+
+def test_missing_object_returns_none(store):
+    assert store.get_bytes(oid()) is None
+    assert not store.contains(oid())
+
+
+def test_idempotent_put(store):
+    o = oid()
+    store.put_bytes(o, b"x" * 1000)
+    store.put_bytes(o, b"y" * 1000)  # no error; first write wins
+    assert bytes(store.get_bytes(o))[:1] == b"x"
+
+
+def test_lru_eviction_under_pressure(store):
+    os_ = []
+    for i in range(1, 30):
+        o = oid(i)
+        store.put_bytes(o, np.random.bytes(1024 * 1024))
+        os_.append(o)
+    stats = store.stats()
+    assert stats["evictions"] > 0
+    assert store.contains(os_[-1])
+    assert not store.contains(os_[0])
+
+
+def test_pinned_objects_survive_eviction(store):
+    o = oid(1)
+    store.put_bytes(o, np.random.bytes(1024 * 1024))
+    view = store.get_bytes(o)  # pin
+    for i in range(2, 30):
+        store.put_bytes(oid(i), np.random.bytes(1024 * 1024))
+    assert store.contains(o)  # pinned -> not evicted
+    assert bytes(view[:4]) is not None
+    del view
+    gc.collect()
+
+
+def test_delete_deferred_while_pinned(store):
+    o = oid(1)
+    store.put_bytes(o, b"z" * 4096)
+    view = store.get_bytes(o)
+    store.delete(o)
+    assert not store.contains(o)  # invisible immediately
+    assert bytes(view[:1]) == b"z"  # memory still valid under the pin
+    del view
+    gc.collect()
+    stats = store.stats()
+    assert stats["num_objects"] == 0  # freed after last release
+
+
+def test_oversize_object_raises(store):
+    with pytest.raises(ObjectStoreFullError):
+        store.put_bytes(oid(), np.random.bytes(64 * 1024 * 1024))
+
+
+def test_cross_process_visibility(store):
+    o = oid(7)
+    payload = np.random.bytes(500_000)
+    store.put_bytes(o, payload)
+    code = (
+        "from ray_tpu.core.shm_store import SharedMemoryStore\n"
+        "from ray_tpu._private.ids import ObjectID\n"
+        f"s = SharedMemoryStore({store.name!r}, size=16*1024*1024)\n"
+        f"v = s.get_bytes(ObjectID(bytes.fromhex({o.hex()!r})))\n"
+        "assert v is not None and len(v) == 500000\n"
+        "print('CHILD-OK')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True,
+                       cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert "CHILD-OK" in r.stdout, r.stderr
+
+
+def test_runtime_promotes_large_objects(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    if rt.shm_store is None:
+        pytest.skip("native store unavailable")
+    big = np.random.default_rng(0).standard_normal(200_000)  # 1.6MB > 100KB
+    ref = ray_tpu.put(big)
+    entry = rt.memory_store.get_if_exists(ref.object_id())
+    assert entry.in_shm
+    out = ray_tpu.get(ref)
+    assert np.array_equal(big, out)
+
+
+def test_runtime_shm_eviction_triggers_reconstruction(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    if rt.shm_store is None:
+        pytest.skip("native store unavailable")
+
+    calls = {"n": 0}
+
+    @ray_tpu.remote
+    def produce():
+        calls["n"] += 1
+        return np.ones(200_000)
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref).shape == (200_000,)
+    # simulate eviction from the shm store only
+    rt.shm_store.delete(ref.object_id())
+    gc.collect()
+    out = ray_tpu.get(ref, timeout=10)
+    assert out.shape == (200_000,)
+    assert calls["n"] == 2
+
+
+def test_tombstone_preserves_probe_chains(store):
+    """Delete must not break linear-probe lookup of colliding ids."""
+    import itertools
+
+    # brute-force three ids landing in nearby slots of a tiny table
+    small = SharedMemoryStore(f"/raytpu_tomb{os.getpid()}", size=4 * 1024 * 1024,
+                              table_cap=8, owner=True)
+    ids = [oid(i) for i in range(1, 7)]
+    for o in ids:
+        small.put_bytes(o, b"v" * 128)
+    small.delete(ids[0])
+    small.delete(ids[2])
+    for o in ids[3:]:
+        assert small.contains(o), "probe chain broken by delete"
+    # slots are reusable after tombstoning
+    o99 = oid(99)
+    small.put_bytes(o99, b"w" * 128)
+    assert small.contains(o99)
+    small.close()
+
+
+def test_eviction_does_not_leak_arena(store):
+    """Repeated eviction cycles must keep bytes_in_use ≈ live data."""
+    for i in range(1, 600):  # ~30MB through a 16MB arena
+        store.put_bytes(oid(i), np.random.bytes(50_000))
+    stats = store.stats()
+    live = stats["num_objects"] * 50_048  # payload + chunk header
+    assert stats["evictions"] > 0
+    assert stats["bytes_in_use"] < live * 1.5, stats
+
+
+def test_pin_blocks_eviction_until_release(store):
+    o = oid(1)
+    store.put_bytes(o, np.random.bytes(1024 * 1024))
+    assert store.pin(o)
+    for i in range(2, 40):
+        store.put_bytes(oid(i), np.random.bytes(1024 * 1024))
+    assert store.contains(o)
+    store.release(o)
+    for i in range(40, 60):
+        store.put_bytes(oid(i), np.random.bytes(1024 * 1024))
+    assert not store.contains(o)  # unpinned -> evictable
+
+
+def test_live_ref_survives_memory_pressure(ray_start_regular):
+    """A ray.put object with a live ref must survive heavy churn (runtime pin)."""
+    import ray_tpu
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    if rt.shm_store is None:
+        pytest.skip("native store unavailable")
+    keep = ray_tpu.put(np.arange(100_000, dtype=np.float64))
+    for _ in range(700):  # ~5.6GB churn through a 512MB arena
+        tmp = ray_tpu.put(np.random.standard_normal(1_000_000))
+        del tmp
+    gc.collect()
+    out = ray_tpu.get(keep, timeout=10)
+    assert float(out[54321]) == 54321.0
