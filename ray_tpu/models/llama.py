@@ -255,3 +255,79 @@ def param_count_analytic(cfg: LlamaConfig) -> int:
     if not cfg.tie_embeddings:
         total += h * v
     return total
+
+
+# ---------------------------------------------------------------- KV-cached inference
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
+    """Preallocated KV cache for continuous batching: [L, B, S, Hkv, D].
+
+    Static shapes keep XLA happy (one compile per engine); slot reuse gives
+    continuous batching without re-compiles. (The reference delegates this to
+    vLLM's paged KV; a pallas ragged-paged-attention variant is the planned
+    upgrade per PAPERS.md.)
+    """
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.hd)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def _cached_attention(q, k_cache, v_cache, lengths, q_positions):
+    """q: [B,S,Hq,D]; caches [B,Smax,Hkv,D]; lengths [B] = valid KV prefix."""
+    B, S, Hq, D = q.shape
+    Smax = k_cache.shape[1]
+    Hkv = k_cache.shape[2]
+    g = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, g, D)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache).astype(jnp.float32) / math.sqrt(D)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (B, Smax), 1)
+    valid = kpos[:, None, None, None, :] <= q_positions[:, None, None, :, None]
+    valid &= kpos[:, None, None, None, :] < lengths[:, None, None, None, None] + q.shape[1]
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_cache)
+    return out.reshape(B, S, Hq, D)
+
+
+def _write_cache(cache_l, new, lengths):
+    """Insert new [B,S,H,D] at per-row offsets lengths[b] into cache [B,Smax,H,D].
+
+    vmapped dynamic_update_slice: O(S) per write (no one-hot over Smax)."""
+    return jax.vmap(
+        lambda c, n, l: jax.lax.dynamic_update_slice_in_dim(c, n.astype(c.dtype), l, axis=0)
+    )(cache_l, new, lengths)
+
+
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: dict, lengths):
+    """Append `tokens` [B,S] at positions [lengths, lengths+S) and return
+    (logits[B,S,V], updated cache). Works for prefill (S=prompt, lengths=0)
+    and decode (S=1). lax.scan over layers keeps compile time O(1) in depth
+    (same design as forward())."""
+    B, S = tokens.shape
+    positions = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    hd, nh, nkv = cfg.hd, cfg.num_heads, cfg.num_kv_heads
+
+    def body(x, layer_and_cache):
+        layer, k_old, v_old = layer_and_cache
+        y = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (y @ layer["wq"]).reshape(B, S, nh, hd)
+        k = (y @ layer["wk"]).reshape(B, S, nkv, hd)
+        v = (y @ layer["wv"]).reshape(B, S, nkv, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        k_cache = _write_cache(k_old, k, lengths)
+        v_cache = _write_cache(v_old, v, lengths)
+        o = _cached_attention(q, k_cache, v_cache, lengths, positions)
+        x = x + (o.reshape(B, S, nh * hd) @ layer["wo"])
+        y = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu(y @ layer["w_gate"])
+        x = x + ((gate * (y @ layer["w_up"])) @ layer["w_down"])
+        return x, (k_cache, v_cache)
+
+    x, (out_k, out_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": out_k, "v": out_v}

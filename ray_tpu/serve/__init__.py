@@ -1,0 +1,16 @@
+"""ray_tpu.serve: model serving over the actor runtime.
+
+Parity surface: ray.serve (@deployment, run, status, delete, shutdown, @batch,
+DeploymentHandle, HTTP ingress) — reference python/ray/serve/.
+"""
+
+from ray_tpu.serve.api import delete, run, shutdown, start_http_proxy, status
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.controller import DeploymentHandle, ServeController
+from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
+
+__all__ = [
+    "deployment", "Deployment", "Application", "AutoscalingConfig",
+    "run", "delete", "status", "shutdown", "start_http_proxy",
+    "batch", "DeploymentHandle", "ServeController",
+]

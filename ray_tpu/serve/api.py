@@ -1,0 +1,168 @@
+"""serve public API: run/delete/shutdown/status + HTTP ingress.
+
+Parity: python/ray/serve/api.py (serve.run :930, serve.delete, serve.status,
+serve.shutdown) and the per-node HTTP proxy (_private/proxy.py:1010 HTTPProxy) —
+here a single aiohttp ingress bound to the controller's route table.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, DeploymentHandle, ServeController
+from ray_tpu.serve.deployment import Application
+
+_state: dict = {"controller": None, "proxy": None, "routes": {}}
+_lock = threading.Lock()
+
+
+def _get_or_create_controller():
+    with _lock:
+        if _state["controller"] is None:
+            try:
+                _state["controller"] = ray_tpu.get_actor(CONTROLLER_NAME)
+            except ValueError:
+                cls = ray_tpu.remote(num_cpus=0, max_concurrency=16)(ServeController)
+                _state["controller"] = cls.options(
+                    name=CONTROLLER_NAME, get_if_exists=True
+                ).remote()
+        return _state["controller"]
+
+
+def run(app: Application, *, name: str = "default", route_prefix: str | None = "/",
+        blocking: bool = False) -> DeploymentHandle:
+    """Deploy an application and return its handle (reference: serve.run api.py:930)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    controller = _get_or_create_controller()
+    dep = app.deployment
+    ray_tpu.get(controller.deploy.remote(dep))
+    handle = DeploymentHandle(controller, dep.config.name)
+    prefix = dep.config.route_prefix or route_prefix
+    if prefix:
+        existing = _state["routes"].get(prefix)
+        if existing is not None and existing.deployment_name != dep.config.name:
+            raise ValueError(
+                f"Route prefix {prefix!r} is already bound to deployment "
+                f"'{existing.deployment_name}'; pass a distinct route_prefix."
+            )
+        _state["routes"][prefix] = handle
+    # wait for at least one replica
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if ray_tpu.get(controller.get_replicas.remote(dep.config.name)):
+            break
+        time.sleep(0.05)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def delete(name: str) -> None:
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name))
+    _state["routes"] = {p: h for p, h in _state["routes"].items() if h.deployment_name != name}
+
+
+def status() -> dict:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.status.remote())
+
+
+def shutdown() -> None:
+    with _lock:
+        c = _state["controller"]
+        if c is not None:
+            try:
+                ray_tpu.get(c.shutdown.remote(), timeout=10)
+                ray_tpu.kill(c)
+            except Exception:
+                pass
+            _state["controller"] = None
+        if _state["proxy"] is not None:
+            _state["proxy"].stop()
+            _state["proxy"] = None
+        _state["routes"] = {}
+
+
+# ------------------------------------------------------------------ HTTP proxy
+class HttpProxy:
+    """aiohttp ingress: POST <route_prefix> with JSON body -> handle.remote(body).
+
+    Reference: _private/proxy.py HTTPProxy:1010 (ASGI); routes resolved by
+    longest matching prefix (proxy_router.py).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._loop = None
+        self._runner = None
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("HTTP proxy failed to start")
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        async def handler(request: "web.Request") -> "web.Response":
+            route, handle = self._match(request.path)
+            if handle is None:
+                return web.json_response({"error": f"no route for {request.path}"}, status=404)
+            try:
+                body = await request.json() if request.can_read_body else {}
+            except json.JSONDecodeError:
+                return web.json_response({"error": "invalid JSON body"}, status=400)
+            ref = handle.remote(body)
+            loop = asyncio.get_running_loop()
+            try:
+                result = await loop.run_in_executor(None, lambda: ray_tpu.get(ref, timeout=60))
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)[:500]}, status=500)
+            if isinstance(result, (dict, list, str, int, float)) or result is None:
+                return web.json_response({"result": result})
+            return web.json_response({"result": repr(result)})
+
+        async def start():
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            self._runner = web.AppRunner(app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+            self._started.set()
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(start())
+        self._loop.run_forever()
+
+    def _match(self, path: str):
+        best = None
+        for prefix, handle in _state["routes"].items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, handle)
+        return best if best else (None, None)
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> HttpProxy:
+    with _lock:
+        if _state["proxy"] is None:
+            _state["proxy"] = HttpProxy(host, port)
+        return _state["proxy"]

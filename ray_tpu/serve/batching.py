@@ -1,0 +1,119 @@
+"""Dynamic request batching.
+
+Parity: python/ray/serve/batching.py (@serve.batch) — calls buffer until
+max_batch_size or batch_wait_timeout_s, then the wrapped fn runs once on the
+list of requests; each caller gets its element of the returned list. On TPU
+this is the front door to MXU efficiency: batched forward passes instead of
+per-request ones.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class _Pending:
+    item: Any
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: BaseException | None = None
+
+
+class _Batcher:
+    def __init__(self, fn: Callable, max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self._queue: list[_Pending] = []
+        self._lock = threading.Lock()
+        self._flusher: threading.Timer | None = None
+
+    def submit(self, item: Any) -> Any:
+        p = _Pending(item)
+        flush_now = False
+        with self._lock:
+            self._queue.append(p)
+            if len(self._queue) >= self.max_batch_size:
+                flush_now = True
+            elif self._flusher is None:
+                self._flusher = threading.Timer(self.timeout, self._flush)
+                self._flusher.daemon = True
+                self._flusher.start()
+        if flush_now:
+            self._flush()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _flush(self) -> None:
+        with self._lock:
+            batch, self._queue = self._queue, []
+            if self._flusher is not None:
+                self._flusher.cancel()
+                self._flusher = None
+        if not batch:
+            return
+        try:
+            results = self.fn([p.item for p in batch])
+            if inspect.iscoroutine(results):
+                import asyncio
+
+                results = asyncio.run(results)
+            if len(results) != len(batch):
+                raise ValueError(
+                    f"@serve.batch fn returned {len(results)} results for {len(batch)} requests"
+                )
+            for p, r in zip(batch, results):
+                p.result = r
+                p.event.set()
+        except BaseException as e:  # noqa: BLE001
+            for p in batch:
+                p.error = e
+                p.event.set()
+
+
+def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch`` (reference: serve/batching.py)."""
+
+    def deco(fn):
+        params = list(inspect.signature(fn).parameters)
+        is_method = bool(params) and params[0] == "self"
+        lock = threading.Lock()
+
+        if is_method:
+            attr = f"__serve_batcher_{fn.__name__}"
+
+            @functools.wraps(fn)
+            def method_wrapper(self, item):
+                # batcher lives on the instance, so it dies with the replica
+                b = getattr(self, attr, None)
+                if b is None:
+                    with lock:
+                        b = getattr(self, attr, None)
+                        if b is None:
+                            b = _Batcher(
+                                lambda items: fn(self, items), max_batch_size, batch_wait_timeout_s
+                            )
+                            setattr(self, attr, b)
+                return b.submit(item)
+
+            return method_wrapper
+
+        batcher = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
+
+        @functools.wraps(fn)
+        def wrapper(item):
+            return batcher.submit(item)
+
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

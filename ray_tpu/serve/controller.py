@@ -1,0 +1,347 @@
+"""ServeController, replicas, router, handles.
+
+Parity map (reference python/ray/serve/_private/):
+- ``ServeController`` ≈ controller.py:129 — reconciles target deployment state
+  (replica counts, user config), runs health checks and autoscaling decisions.
+- ``ReplicaActor`` ≈ replica.py — hosts the user callable, reports queue length.
+- ``Router``/``DeploymentHandle`` ≈ router.py:556 + handle API — picks a replica
+  per request with power-of-two-choices on queue length (pow_2_router.py:27).
+- Controller state is re-queryable by name (named actor), matching the detached
+  controller + checkpoint recovery pattern (controller.py:133).
+"""
+
+from __future__ import annotations
+
+import inspect
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig
+
+CONTROLLER_NAME = "_serve_controller"
+
+
+class ReplicaActor:
+    """Hosts one replica of the user callable (reference: serve replica.py)."""
+
+    def __init__(self, func_or_class, init_args, init_kwargs, user_config):
+        self._is_function = inspect.isfunction(func_or_class)
+        if self._is_function:
+            self._callable = func_or_class
+        else:
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            if user_config is not None and hasattr(self._callable, "reconfigure"):
+                self._callable.reconfigure(user_config)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def handle_request(self, method_name: str, args, kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name or "__call__")
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                import asyncio
+
+                out = asyncio.run(out)
+            return out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def queue_len(self) -> int:
+        with self._lock:
+            return self._ongoing
+
+    def reconfigure(self, user_config) -> None:
+        if not self._is_function and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def health_check(self) -> bool:
+        if not self._is_function and hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total}
+
+
+@dataclass
+class _DeploymentState:
+    """Reference: deployment_state.py DeploymentState — target vs running replicas."""
+
+    config: DeploymentConfig
+    deployment: Deployment
+    replicas: list = field(default_factory=list)
+    target_replicas: int = 1
+    version: int = 0
+    last_scale_up: float = 0.0
+    last_scale_down: float = 0.0
+
+
+class ServeController:
+    """The control-plane actor (reference: _private/controller.py:129)."""
+
+    def __init__(self):
+        self._deployments: dict[str, _DeploymentState] = {}
+        self._lock = threading.Lock()
+        self._reconcile_lock = threading.Lock()  # serializes reconcile passes
+        self._running = True
+        self._loop = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._loop.start()
+
+    # ---- API ----
+    def deploy(self, deployment: Deployment) -> None:
+        """Reference: deploy_applications (controller.py:1066). A redeploy
+        (version bump) replaces all running replicas so new code/config serve
+        (reference: DeploymentState rolling update — here stop-then-start)."""
+        name = deployment.config.name
+        old_replicas: list = []
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                st = _DeploymentState(deployment.config, deployment)
+                self._deployments[name] = st
+            else:
+                st.config = deployment.config
+                st.deployment = deployment
+                st.version += 1
+                old_replicas, st.replicas = st.replicas, []
+            auto = deployment.config.autoscaling_config
+            st.target_replicas = auto.min_replicas if auto else deployment.config.num_replicas
+        for r in old_replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._reconcile_once()
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            st = self._deployments.pop(name, None)
+        if st:
+            for r in st.replicas:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+
+    def get_replicas(self, name: str) -> list:
+        st = self._deployments.get(name)
+        return list(st.replicas) if st else []
+
+    def get_deployment_names(self) -> list[str]:
+        return list(self._deployments)
+
+    def status(self) -> dict:
+        out = {}
+        with self._lock:
+            for name, st in self._deployments.items():
+                out[name] = {
+                    "target_replicas": st.target_replicas,
+                    "running_replicas": len(st.replicas),
+                    "version": st.version,
+                }
+        return out
+
+    def record_autoscaling_metrics(self, name: str, ongoing_per_replica: float) -> None:
+        """Router-reported load (reference: autoscaling_state.py metric flow)."""
+        st = self._deployments.get(name)
+        if st is None or st.config.autoscaling_config is None:
+            return
+        auto = st.config.autoscaling_config
+        now = time.monotonic()
+        with self._lock:
+            if ongoing_per_replica > auto.target_ongoing_requests:
+                if now - st.last_scale_up > auto.upscale_delay_s:
+                    st.target_replicas = min(auto.max_replicas, st.target_replicas + 1)
+                    st.last_scale_up = now
+            elif ongoing_per_replica < auto.target_ongoing_requests * 0.5:
+                if now - st.last_scale_down > auto.downscale_delay_s:
+                    st.target_replicas = max(auto.min_replicas, st.target_replicas - 1)
+                    st.last_scale_down = now
+
+    def shutdown(self) -> None:
+        self._running = False
+        for name in list(self._deployments):
+            self.delete_deployment(name)
+
+    # ---- reconciliation (reference: controller loop -> DeploymentStateManager) ----
+    def _reconcile_loop(self) -> None:
+        while self._running:
+            try:
+                self._reconcile_once()
+                self._autoscale_tick()
+            except Exception:
+                pass
+            time.sleep(0.25)
+
+    def _autoscale_tick(self) -> None:
+        """Controller-side load polling so idle deployments scale DOWN even with
+        no router traffic (reference: autoscaling_state.py replica metrics)."""
+        with self._lock:
+            states = [(n, st) for n, st in self._deployments.items()
+                      if st.config.autoscaling_config is not None and st.replicas]
+        for name, st in states:
+            try:
+                qlens = ray_tpu.get([r.queue_len.remote() for r in st.replicas], timeout=5)
+            except Exception:
+                continue
+            self.record_autoscaling_metrics(name, sum(qlens) / max(1, len(qlens)))
+
+    def _reconcile_once(self) -> None:
+        with self._reconcile_lock:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self) -> None:
+        with self._lock:
+            states = list(self._deployments.values())
+        for st in states:
+            while len(st.replicas) < st.target_replicas:
+                opts = dict(st.config.ray_actor_options)
+                actor_cls = ray_tpu.remote(
+                    num_cpus=opts.get("num_cpus", 1.0),
+                    num_tpus=opts.get("num_tpus", 0.0),
+                    max_concurrency=max(4, st.config.max_ongoing_requests),
+                )(ReplicaActor)
+                d = st.deployment
+                st.replicas.append(
+                    actor_cls.remote(d.func_or_class, d.init_args, d.init_kwargs, st.config.user_config)
+                )
+            while len(st.replicas) > st.target_replicas:
+                victim = st.replicas.pop()
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
+
+
+class Router:
+    """Power-of-two-choices replica selection (reference: pow_2_router.py:27),
+    using locally tracked in-flight counts (replica queue-length cache,
+    request_router/common.py:66)."""
+
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._replicas: list = []
+        self._inflight: dict = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+        self._reqs_since_report = 0
+        # single completion watcher (not thread-per-request)
+        import queue as _q
+
+        self._completions: "_q.Queue" = _q.Queue()
+        self._watcher = threading.Thread(target=self._watch_loop, daemon=True)
+        self._watcher.start()
+
+    def _watch_loop(self) -> None:
+        import queue as _q
+
+        outstanding: list = []  # (replica, ref)
+        while True:
+            try:
+                item = self._completions.get(timeout=0.1 if outstanding else 1.0)
+                outstanding.append(item)
+                while True:
+                    outstanding.append(self._completions.get_nowait())
+            except _q.Empty:
+                pass
+            if not outstanding:
+                continue
+            refs = [ref for _, ref in outstanding]
+            try:
+                ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.2)
+            except Exception:
+                continue
+            done_set = set(ready)
+            still = []
+            for replica, ref in outstanding:
+                if ref in done_set:
+                    with self._lock:
+                        self._inflight[id(replica)] = max(0, self._inflight.get(id(replica), 1) - 1)
+                else:
+                    still.append((replica, ref))
+            outstanding = still
+
+    def _refresh(self) -> None:
+        now = time.monotonic()
+        if now - self._last_refresh > 0.5 or not self._replicas:
+            reps = ray_tpu.get(self._controller.get_replicas.remote(self._name))
+            with self._lock:
+                self._replicas = reps
+                self._inflight = {id(r): self._inflight.get(id(r), 0) for r in reps}
+                self._last_refresh = now
+
+    def pick(self):
+        self._refresh()
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(f"No replicas for deployment '{self._name}'")
+            if len(self._replicas) == 1:
+                return self._replicas[0]
+            a, b = random.sample(self._replicas, 2)
+            return a if self._inflight.get(id(a), 0) <= self._inflight.get(id(b), 0) else b
+
+    def submit(self, method_name: str, args, kwargs):
+        replica = self.pick()
+        with self._lock:
+            self._inflight[id(replica)] = self._inflight.get(id(replica), 0) + 1
+        ref = replica.handle_request.remote(method_name, args, kwargs)
+        self._completions.put((replica, ref))
+        self._maybe_report()
+        return ref
+
+    def _maybe_report(self) -> None:
+        self._reqs_since_report += 1
+        if self._reqs_since_report >= 10:
+            self._reqs_since_report = 0
+            with self._lock:
+                n = max(1, len(self._replicas))
+                load = sum(self._inflight.values()) / n
+            try:
+                self._controller.record_autoscaling_metrics.remote(self._name, load)
+            except Exception:
+                pass
+
+
+class _HandleMethod:
+    def __init__(self, handle: "DeploymentHandle", method_name: str):
+        self._handle = handle
+        self._method_name = method_name
+
+    def remote(self, *args, **kwargs):
+        return self._handle._router.submit(self._method_name, args, kwargs)
+
+
+class DeploymentHandle:
+    """Reference: serve DeploymentHandle — .remote() through the router."""
+
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._router = Router(controller, deployment_name)
+
+    def remote(self, *args, **kwargs):
+        return self._router.submit("__call__", args, kwargs)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _HandleMethod(self, item)
+
+    @property
+    def deployment_name(self) -> str:
+        return self._name

@@ -1,0 +1,93 @@
+"""Deployments: the unit of serving.
+
+Parity: python/ray/serve/api.py (@serve.deployment, serve.run :930) and the
+deployment option surface (num_replicas, autoscaling_config, max_ongoing_requests,
+ray_actor_options, user_config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Reference: serve autoscaling_policy.py defaults."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    name: str
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+    autoscaling_config: AutoscalingConfig | None = None
+    user_config: Any = None
+    health_check_period_s: float = 2.0
+    route_prefix: str | None = None
+
+
+class Deployment:
+    """A configured (but not yet running) deployment (reference: serve Deployment)."""
+
+    def __init__(self, func_or_class, config: DeploymentConfig, init_args=(), init_kwargs=None):
+        self.func_or_class = func_or_class
+        self.config = config
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs or {}
+
+    def options(self, **opts) -> "Deployment":
+        cfg = dataclasses.replace(self.config)
+        for k, v in opts.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"Unknown deployment option: {k}")
+            setattr(cfg, k, v)
+        return Deployment(self.func_or_class, cfg, self.init_args, self.init_kwargs)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        """Reference: deployment.bind() builds the app graph node."""
+        return Application(Deployment(self.func_or_class, self.config, args, kwargs))
+
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+
+class Application:
+    """A bound deployment graph root (reference: serve Application)."""
+
+    def __init__(self, deployment: Deployment):
+        self.deployment = deployment
+
+
+def deployment(_func_or_class=None, *, name: str | None = None, num_replicas: int = 1,
+               max_ongoing_requests: int = 100, ray_actor_options: dict | None = None,
+               autoscaling_config: AutoscalingConfig | dict | None = None,
+               user_config: Any = None, route_prefix: str | None = None):
+    """``@serve.deployment`` decorator (reference: serve/api.py)."""
+
+    def wrap(target):
+        nonlocal autoscaling_config
+        if isinstance(autoscaling_config, dict):
+            autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        cfg = DeploymentConfig(
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options or {},
+            autoscaling_config=autoscaling_config,
+            user_config=user_config,
+            route_prefix=route_prefix,
+        )
+        return Deployment(target, cfg)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap

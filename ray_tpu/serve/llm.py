@@ -1,0 +1,267 @@
+"""LLM serving: continuous-batching engine on JAX + serve deployment + OpenAI-ish API.
+
+Parity: python/ray/llm/ — ``LLMConfig``/``LLMServer``/``build_openai_app``
+(serve/llm/__init__.py) and the engine layer the reference delegates to vLLM
+(_internal/serve/engines/vllm/vllm_engine.py). TPU-native design:
+
+- The engine owns a slot-based KV cache with static shapes (one XLA compile for
+  decode, a few for bucketed prefill). Continuous batching = slots join/leave
+  the batched decode step without recompiles — the scheduling idea of
+  continuous-batching servers expressed in XLA-friendly form. (Paged/ragged KV
+  via a pallas kernel is the planned upgrade; see PAPERS.md ragged paged attn.)
+- Prefill and decode are separate jitted programs (the prefill/decode split the
+  reference implements as separate *deployments* — pd_server.py — exists here
+  inside one engine; cross-chip PD disaggregation follows the same interfaces).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.models import llama
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    """Reference: ray.serve.llm LLMConfig (model + engine kwargs)."""
+
+    model_config: llama.LlamaConfig = dataclasses.field(default_factory=llama.LlamaConfig.tiny)
+    max_batch_size: int = 8
+    max_seq_len: int = 256
+    max_new_tokens_default: int = 32
+    temperature: float = 0.0  # 0 = greedy
+    eos_token_id: int = -1  # -1: never stop early (random-weight demo mode)
+    prefill_buckets: tuple = (32, 128)
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    token_ids: list
+    num_prompt_tokens: int
+    num_generated: int
+    ttft_s: float
+    total_s: float
+    finish_reason: str = "length"
+
+
+class _Slot:
+    __slots__ = ("future", "max_new", "generated", "start", "first_token_time", "prompt_len")
+
+    def __init__(self, future, max_new, prompt_len, enqueue_time):
+        self.future = future
+        self.max_new = max_new
+        self.generated = []
+        self.start = enqueue_time  # TTFT measured from request arrival, incl. queueing
+        self.first_token_time = None
+        self.prompt_len = prompt_len
+
+
+class LLMEngine:
+    """Continuous-batching generation engine (vLLM-engine equivalent, jax-native)."""
+
+    def __init__(self, config: LLMConfig, params=None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.config = config
+        cfg = config.model_config
+        self._jax = jax
+        self._jnp = jnp
+        key = jax.random.PRNGKey(seed)
+        self.params = params if params is not None else llama.init(cfg, key)
+        B, S = config.max_batch_size, config.max_seq_len
+        self.cache = llama.init_kv_cache(cfg, B, S)
+        self.lengths = np.zeros(B, dtype=np.int32)
+        self.last_tokens = np.zeros((B, 1), dtype=np.int32)
+        self.active = np.zeros(B, dtype=bool)
+        self.slots: list[Optional[_Slot]] = [None] * B
+        self._pending: "queue.Queue[tuple[list[int], int, Future, float]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._running = True
+        self._sample_key = key
+
+        # --- jitted programs ---
+        def prefill(params, cache, tokens, slot, length):
+            # slice this slot's cache, run, write back (single compile per bucket)
+            sl = lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
+            sub = {"k": sl(cache["k"]), "v": sl(cache["v"])}
+            logits, sub = llama.forward_with_cache(
+                params, tokens, cfg, sub, jnp.zeros((1,), jnp.int32)
+            )
+            wr = lambda c, s: jax.lax.dynamic_update_slice_in_dim(c, s, slot, axis=1)
+            cache = {"k": wr(cache["k"], sub["k"]), "v": wr(cache["v"], sub["v"])}
+            # logits at the last real prompt position (tokens are right-padded)
+            last = logits[0, length - 1]
+            return last, cache
+
+        def decode(params, cache, last_tokens, lengths):
+            logits, cache = llama.forward_with_cache(params, last_tokens, cfg, cache, lengths)
+            return logits[:, 0], cache
+
+        self._prefill = jax.jit(prefill)
+        self._decode = jax.jit(decode, donate_argnums=(1,))
+        self._loop_thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
+        self._loop_thread.start()
+
+    # ---- public API ----
+    def generate(self, prompt_ids: list[int], max_new_tokens: int | None = None) -> Future:
+        fut: Future = Future()
+        max_new = max_new_tokens or self.config.max_new_tokens_default
+        if not prompt_ids:
+            fut.set_exception(ValueError("prompt_ids must be non-empty"))
+            return fut
+        if len(prompt_ids) + max_new > self.config.max_seq_len:
+            fut.set_exception(
+                ValueError(
+                    f"prompt ({len(prompt_ids)}) + max_new_tokens ({max_new}) exceeds "
+                    f"max_seq_len {self.config.max_seq_len}"
+                )
+            )
+            return fut
+        self._pending.put((list(prompt_ids), max_new, fut, time.monotonic()))
+        return fut
+
+    def generate_sync(self, prompt_ids: list[int], max_new_tokens: int | None = None,
+                      timeout: float = 120.0) -> GenerationResult:
+        return self.generate(prompt_ids, max_new_tokens).result(timeout)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "active_slots": int(self.active.sum()),
+                "max_slots": self.config.max_batch_size,
+                "pending": self._pending.qsize(),
+            }
+
+    def shutdown(self) -> None:
+        self._running = False
+
+    # ---- engine loop ----
+    def _bucket(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        return self.config.max_seq_len
+
+    def _sample(self, logits_np: np.ndarray) -> int:
+        if self.config.temperature <= 0:
+            return int(np.argmax(logits_np))
+        z = logits_np / self.config.temperature
+        z = z - z.max()
+        p = np.exp(z) / np.exp(z).sum()
+        return int(np.random.choice(len(p), p=p))
+
+    def _loop(self) -> None:
+        jnp = self._jnp
+        while self._running:
+            did_work = False
+            # 1) admit pending requests into free slots (prefill)
+            free = [i for i in range(self.config.max_batch_size) if not self.active[i]]
+            while free and not self._pending.empty():
+                try:
+                    prompt, max_new, fut, t_enq = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                slot = free.pop(0)
+                bucket = self._bucket(len(prompt))
+                padded = np.zeros((1, bucket), dtype=np.int32)
+                padded[0, : len(prompt)] = prompt
+                last_logits, self.cache = self._prefill(
+                    self.params, self.cache, jnp.asarray(padded), slot, len(prompt)
+                )
+                tok = self._sample(np.asarray(last_logits))
+                with self._lock:
+                    st = _Slot(fut, max_new, len(prompt), t_enq)
+                    st.generated.append(tok)
+                    st.first_token_time = time.monotonic()
+                    self.slots[slot] = st
+                    self.active[slot] = True
+                    self.lengths[slot] = len(prompt)
+                    self.last_tokens[slot, 0] = tok
+                did_work = True
+                self._maybe_finish(slot, tok)
+            # 2) batched decode step for all active slots
+            if self.active.any():
+                logits, self.cache = self._decode(
+                    self.params, self.cache,
+                    jnp.asarray(self.last_tokens), jnp.asarray(self.lengths),
+                )
+                logits_np = np.asarray(logits)
+                with self._lock:
+                    for i in range(self.config.max_batch_size):
+                        if not self.active[i]:
+                            continue
+                        tok = self._sample(logits_np[i])
+                        st = self.slots[i]
+                        st.generated.append(tok)
+                        self.lengths[i] += 1
+                        self.last_tokens[i, 0] = tok
+                for i in range(self.config.max_batch_size):
+                    if self.active[i]:
+                        self._maybe_finish(i, self.slots[i].generated[-1])
+                did_work = True
+            if not did_work:
+                time.sleep(0.002)
+
+    def _maybe_finish(self, slot: int, last_tok: int) -> None:
+        st = self.slots[slot]
+        if st is None:
+            return
+        eos = self.config.eos_token_id >= 0 and last_tok == self.config.eos_token_id
+        if eos or len(st.generated) >= st.max_new:
+            now = time.monotonic()
+            result = GenerationResult(
+                token_ids=list(st.generated),
+                num_prompt_tokens=st.prompt_len,
+                num_generated=len(st.generated),
+                ttft_s=(st.first_token_time or now) - st.start,
+                total_s=now - st.start,
+                finish_reason="stop" if eos else "length",
+            )
+            with self._lock:
+                self.active[slot] = False
+                self.slots[slot] = None
+            st.future.set_result(result)
+
+
+# ------------------------------------------------------------------ serve glue
+def build_llm_deployment(config: LLMConfig | None = None, num_replicas: int = 1):
+    """An LLMServer deployment (reference: ray.serve.llm LLMServer + build_openai_app).
+
+    POST body: {"prompt_ids": [...], "max_tokens": N} -> token ids + timings.
+    """
+    from ray_tpu.serve.deployment import deployment
+
+    cfg = config or LLMConfig()
+
+    @deployment(name="LLMServer", num_replicas=num_replicas,
+                ray_actor_options={"num_tpus": 0.0})
+    class LLMServer:
+        def __init__(self, llm_config: LLMConfig):
+            self.engine = LLMEngine(llm_config)
+
+        def __call__(self, body: dict) -> dict:
+            prompt_ids = body.get("prompt_ids", [])
+            max_tokens = body.get("max_tokens")
+            res = self.engine.generate_sync(prompt_ids, max_tokens)
+            return {
+                "token_ids": res.token_ids,
+                "usage": {
+                    "prompt_tokens": res.num_prompt_tokens,
+                    "completion_tokens": res.num_generated,
+                },
+                "timings": {"ttft_s": res.ttft_s, "total_s": res.total_s},
+                "finish_reason": res.finish_reason,
+            }
+
+        def stats(self) -> dict:
+            return self.engine.stats()
+
+    return LLMServer.bind(cfg)
