@@ -1,0 +1,152 @@
+"""Job submission: run driver scripts as supervised subprocesses.
+
+Parity: python/ray/dashboard/modules/job/ — JobSubmissionClient (sdk.py:37,
+submit_job :133), JobManager (job_manager.py:57), JobSupervisor
+(job_supervisor.py:57): each job's entrypoint runs as a subprocess of a
+supervisor, with status tracking, log capture, and stop support.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+
+class JobStatus(str, Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+@dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: JobStatus = JobStatus.PENDING
+    start_time: float = 0.0
+    end_time: float = 0.0
+    log_path: str = ""
+    metadata: dict = field(default_factory=dict)
+    returncode: int | None = None
+
+
+class _Supervisor:
+    """Reference: JobSupervisor — owns the driver subprocess."""
+
+    def __init__(self, info: JobInfo, runtime_env: dict | None, log_dir: str):
+        self.info = info
+        self.runtime_env = runtime_env or {}
+        self.log_dir = log_dir
+        self.proc: subprocess.Popen | None = None
+
+    def start(self) -> None:
+        env = dict(os.environ)
+        env.update(self.runtime_env.get("env_vars", {}))
+        if "working_dir" in self.runtime_env:
+            cwd = self.runtime_env["working_dir"]
+        else:
+            cwd = os.getcwd()
+        self.info.log_path = os.path.join(self.log_dir, f"job-{self.info.job_id}.log")
+        logf = open(self.info.log_path, "w")
+        self.info.status = JobStatus.RUNNING
+        self.info.start_time = time.time()
+        self.proc = subprocess.Popen(
+            self.info.entrypoint, shell=True, cwd=cwd, env=env,
+            stdout=logf, stderr=subprocess.STDOUT,
+        )
+        threading.Thread(target=self._wait, daemon=True).start()
+
+    def _wait(self) -> None:
+        rc = self.proc.wait()
+        self.info.returncode = rc
+        self.info.end_time = time.time()
+        if self.info.status != JobStatus.STOPPED:
+            self.info.status = JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+
+    def stop(self) -> None:
+        if self.proc and self.proc.poll() is None:
+            self.info.status = JobStatus.STOPPED
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class JobSubmissionClient:
+    """Reference: JobSubmissionClient (dashboard/modules/job/sdk.py:37)."""
+
+    def __init__(self, address: str | None = None, log_dir: str | None = None):
+        self._jobs: dict[str, _Supervisor] = {}
+        self._log_dir = log_dir or "/tmp/ray_tpu/job_logs"
+        os.makedirs(self._log_dir, exist_ok=True)
+
+    def submit_job(self, *, entrypoint: str, runtime_env: dict | None = None,
+                   metadata: dict | None = None, submission_id: str | None = None) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        if job_id in self._jobs:
+            raise ValueError(f"Job {job_id} already exists")
+        info = JobInfo(job_id=job_id, entrypoint=entrypoint, metadata=metadata or {})
+        sup = _Supervisor(info, runtime_env, self._log_dir)
+        self._jobs[job_id] = sup
+        sup.start()
+        return job_id
+
+    def get_job_status(self, job_id: str) -> JobStatus:
+        return self._job(job_id).info.status
+
+    def get_job_info(self, job_id: str) -> JobInfo:
+        return self._job(job_id).info
+
+    def get_job_logs(self, job_id: str) -> str:
+        info = self._job(job_id).info
+        if not info.log_path or not os.path.exists(info.log_path):
+            return ""
+        with open(info.log_path) as f:
+            return f.read()
+
+    def tail_job_logs(self, job_id: str, timeout: float = 60.0):
+        """Generator yielding new log lines until the job finishes."""
+        info = self._job(job_id).info
+        deadline = time.monotonic() + timeout
+        pos = 0
+        while time.monotonic() < deadline:
+            if info.log_path and os.path.exists(info.log_path):
+                with open(info.log_path) as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+                if chunk:
+                    yield chunk
+            if info.status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+                return
+            time.sleep(0.2)
+
+    def stop_job(self, job_id: str) -> bool:
+        self._job(job_id).stop()
+        return True
+
+    def list_jobs(self) -> list[JobInfo]:
+        return [s.info for s in self._jobs.values()]
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> JobStatus:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.get_job_status(job_id)
+            if st in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+                return st
+            time.sleep(0.1)
+        raise TimeoutError(f"Job {job_id} did not finish within {timeout}s")
+
+    def _job(self, job_id: str) -> _Supervisor:
+        if job_id not in self._jobs:
+            raise ValueError(f"Unknown job: {job_id}")
+        return self._jobs[job_id]

@@ -1,0 +1,133 @@
+"""ray-tpu CLI.
+
+Parity: python/ray/scripts/scripts.py (`ray status/summary/timeline/list/
+job submit`) — argparse instead of click (not in the base image's guarantees).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _init_session(args):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
+
+
+def cmd_status(args) -> int:
+    import ray_tpu
+
+    _init_session(args)
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print("== ray_tpu status ==")
+    print(f"nodes: {len(ray_tpu.nodes())}")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):.1f}/{total[k]:.1f} available")
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state
+
+    _init_session(args)
+    fn = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }[args.resource]
+    print(json.dumps(fn(), indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util import state
+
+    _init_session(args)
+    fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors}[args.resource]
+    print(json.dumps(fn(), indent=2))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.util import state
+
+    _init_session(args)
+    out = args.output or "timeline.json"
+    state.timeline(out)
+    print(f"Wrote Chrome trace to {out} (open chrome://tracing)")
+    return 0
+
+
+def cmd_job_submit(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    parts = args.entrypoint
+    if parts and parts[0] == "--":
+        parts = parts[1:]
+    if not parts:
+        print("error: no entrypoint given", file=sys.stderr)
+        return 2
+    job_id = client.submit_job(entrypoint=" ".join(parts))
+    print(f"Submitted {job_id}")
+    if args.wait:
+        status = client.wait_until_finished(job_id, timeout=args.timeout)
+        print(client.get_job_logs(job_id), end="")
+        print(f"Job {job_id}: {status.value}")
+        return 0 if status.value == "SUCCEEDED" else 1
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import runpy
+
+    sys.argv = ["bench.py"]
+    runpy.run_path("bench.py", run_name="__main__")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu", description="TPU-native distributed runtime CLI")
+    p.add_argument("--num-cpus", type=float, default=None)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="cluster resource status")
+
+    lp = sub.add_parser("list", help="list live state")
+    lp.add_argument("resource", choices=["tasks", "actors", "nodes", "objects", "placement-groups"])
+
+    sp = sub.add_parser("summary", help="summarize state")
+    sp.add_argument("resource", choices=["tasks", "actors"])
+
+    tp = sub.add_parser("timeline", help="export Chrome trace of task events")
+    tp.add_argument("-o", "--output", default=None)
+
+    jp = sub.add_parser("job", help="job submission")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    jsp = jsub.add_parser("submit")
+    jsp.add_argument("--wait", action="store_true")
+    jsp.add_argument("--timeout", type=float, default=300.0)
+    jsp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+
+    args = p.parse_args(argv)
+    if args.cmd == "status":
+        return cmd_status(args)
+    if args.cmd == "list":
+        return cmd_list(args)
+    if args.cmd == "summary":
+        return cmd_summary(args)
+    if args.cmd == "timeline":
+        return cmd_timeline(args)
+    if args.cmd == "job":
+        return cmd_job_submit(args)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

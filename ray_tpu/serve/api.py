@@ -41,17 +41,20 @@ def run(app: Application, *, name: str = "default", route_prefix: str | None = "
         ray_tpu.init(ignore_reinit_error=True)
     controller = _get_or_create_controller()
     dep = app.deployment
-    ray_tpu.get(controller.deploy.remote(dep))
-    handle = DeploymentHandle(controller, dep.config.name)
     prefix = dep.config.route_prefix or route_prefix
     if prefix:
         existing = _state["routes"].get(prefix)
         if existing is not None and existing.deployment_name != dep.config.name:
+            # validate BEFORE deploying so a conflict doesn't leave orphan replicas
             raise ValueError(
                 f"Route prefix {prefix!r} is already bound to deployment "
                 f"'{existing.deployment_name}'; pass a distinct route_prefix."
             )
-        _state["routes"][prefix] = handle
+    ray_tpu.get(controller.deploy.remote(dep))
+    handle = DeploymentHandle(controller, dep.config.name)
+    if prefix:
+        with _lock:
+            _state["routes"] = {**_state["routes"], prefix: handle}
     # wait for at least one replica
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
@@ -150,14 +153,26 @@ class HttpProxy:
 
     def _match(self, path: str):
         best = None
-        for prefix, handle in _state["routes"].items():
+        # snapshot: run()/delete() rebind the dict rather than mutating it
+        for prefix, handle in list(_state["routes"].items()):
             if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
                 if best is None or len(prefix) > len(best[0]):
                     best = (prefix, handle)
         return best if best else (None, None)
 
     def stop(self) -> None:
-        if self._loop is not None:
+        if self._loop is None:
+            return
+
+        async def _teardown():
+            if self._runner is not None:
+                await self._runner.cleanup()  # closes the listening socket
+            self._loop.stop()
+
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_teardown(), self._loop)
+            fut.result(timeout=5)
+        except Exception:
             self._loop.call_soon_threadsafe(self._loop.stop)
 
 

@@ -54,10 +54,16 @@ class _Batcher:
 
     def _flush(self) -> None:
         with self._lock:
-            batch, self._queue = self._queue, []
+            # take at most max_batch_size; late arrivals stay queued for the next batch
+            batch = self._queue[: self.max_batch_size]
+            self._queue = self._queue[self.max_batch_size :]
             if self._flusher is not None:
                 self._flusher.cancel()
                 self._flusher = None
+            if self._queue:  # schedule the leftover promptly
+                self._flusher = threading.Timer(0.0, self._flush)
+                self._flusher.daemon = True
+                self._flusher.start()
         if not batch:
             return
         try:

@@ -208,23 +208,44 @@ class ServeController:
         with self._lock:
             states = list(self._deployments.values())
         for st in states:
-            while len(st.replicas) < st.target_replicas:
-                opts = dict(st.config.ray_actor_options)
+            while True:
+                # snapshot target/version under the lock; act outside it
+                with self._lock:
+                    if st is not self._deployments.get(st.config.name):
+                        break  # deleted concurrently
+                    version = st.version
+                    deficit = st.target_replicas - len(st.replicas)
+                    d = st.deployment
+                    cfg = st.config
+                    victim = st.replicas.pop() if deficit < 0 else None
+                if victim is not None:
+                    try:
+                        ray_tpu.kill(victim)
+                    except Exception:
+                        pass
+                    continue
+                if deficit <= 0:
+                    break
+                opts = dict(cfg.ray_actor_options)
                 actor_cls = ray_tpu.remote(
                     num_cpus=opts.get("num_cpus", 1.0),
                     num_tpus=opts.get("num_tpus", 0.0),
-                    max_concurrency=max(4, st.config.max_ongoing_requests),
+                    max_concurrency=max(4, cfg.max_ongoing_requests),
                 )(ReplicaActor)
-                d = st.deployment
-                st.replicas.append(
-                    actor_cls.remote(d.func_or_class, d.init_args, d.init_kwargs, st.config.user_config)
+                replica = actor_cls.remote(
+                    d.func_or_class, d.init_args, d.init_kwargs, cfg.user_config
                 )
-            while len(st.replicas) > st.target_replicas:
-                victim = st.replicas.pop()
-                try:
-                    ray_tpu.kill(victim)
-                except Exception:
-                    pass
+                with self._lock:
+                    # attach only if the deployment wasn't redeployed/deleted meanwhile
+                    cur = self._deployments.get(cfg.name)
+                    if cur is st and st.version == version and len(st.replicas) < st.target_replicas:
+                        st.replicas.append(replica)
+                        replica = None
+                if replica is not None:  # stale: discard the just-made replica
+                    try:
+                        ray_tpu.kill(replica)
+                    except Exception:
+                        pass
 
 
 class Router:
@@ -268,13 +289,19 @@ class Router:
                 continue
             done_set = set(ready)
             still = []
-            for replica, ref in outstanding:
+            for key, ref in outstanding:
                 if ref in done_set:
                     with self._lock:
-                        self._inflight[id(replica)] = max(0, self._inflight.get(id(replica), 1) - 1)
+                        self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
                 else:
-                    still.append((replica, ref))
+                    still.append((key, ref))
             outstanding = still
+
+    @staticmethod
+    def _rkey(replica) -> str:
+        # stable across handle rehydration (id() is not — handles are re-created
+        # on every deserialization)
+        return replica._actor_id.hex()
 
     def _refresh(self) -> None:
         now = time.monotonic()
@@ -282,7 +309,7 @@ class Router:
             reps = ray_tpu.get(self._controller.get_replicas.remote(self._name))
             with self._lock:
                 self._replicas = reps
-                self._inflight = {id(r): self._inflight.get(id(r), 0) for r in reps}
+                self._inflight = {self._rkey(r): self._inflight.get(self._rkey(r), 0) for r in reps}
                 self._last_refresh = now
 
     def pick(self):
@@ -293,14 +320,19 @@ class Router:
             if len(self._replicas) == 1:
                 return self._replicas[0]
             a, b = random.sample(self._replicas, 2)
-            return a if self._inflight.get(id(a), 0) <= self._inflight.get(id(b), 0) else b
+            return (
+                a
+                if self._inflight.get(self._rkey(a), 0) <= self._inflight.get(self._rkey(b), 0)
+                else b
+            )
 
     def submit(self, method_name: str, args, kwargs):
         replica = self.pick()
+        key = self._rkey(replica)
         with self._lock:
-            self._inflight[id(replica)] = self._inflight.get(id(replica), 0) + 1
+            self._inflight[key] = self._inflight.get(key, 0) + 1
         ref = replica.handle_request.remote(method_name, args, kwargs)
-        self._completions.put((replica, ref))
+        self._completions.put((key, ref))
         self._maybe_report()
         return ref
 

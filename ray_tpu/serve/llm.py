@@ -113,9 +113,16 @@ class LLMEngine:
     # ---- public API ----
     def generate(self, prompt_ids: list[int], max_new_tokens: int | None = None) -> Future:
         fut: Future = Future()
-        max_new = max_new_tokens or self.config.max_new_tokens_default
+        max_new = self.config.max_new_tokens_default if max_new_tokens is None else max_new_tokens
         if not prompt_ids:
             fut.set_exception(ValueError("prompt_ids must be non-empty"))
+            return fut
+        if max_new <= 0:
+            fut.set_result(GenerationResult([], len(prompt_ids), 0, 0.0, 0.0))
+            return fut
+        if not all(isinstance(t, int) and 0 <= t < self.config.model_config.vocab_size
+                   for t in prompt_ids):
+            fut.set_exception(ValueError("prompt_ids must be ints within the vocabulary"))
             return fut
         if len(prompt_ids) + max_new > self.config.max_seq_len:
             fut.set_exception(
@@ -159,17 +166,37 @@ class LLMEngine:
         return int(np.random.choice(len(p), p=p))
 
     def _loop(self) -> None:
-        jnp = self._jnp
         while self._running:
-            did_work = False
-            # 1) admit pending requests into free slots (prefill)
-            free = [i for i in range(self.config.max_batch_size) if not self.active[i]]
-            while free and not self._pending.empty():
-                try:
-                    prompt, max_new, fut, t_enq = self._pending.get_nowait()
-                except queue.Empty:
-                    break
-                slot = free.pop(0)
+            try:
+                did_work = self._loop_step()
+            except Exception as e:  # noqa: BLE001 - engine must survive any request
+                self._fail_all_active(e)
+                did_work = True
+            if not did_work:
+                time.sleep(0.002)
+
+    def _fail_all_active(self, exc: Exception) -> None:
+        with self._lock:
+            for i in range(self.config.max_batch_size):
+                st = self.slots[i]
+                if st is not None:
+                    self.active[i] = False
+                    self.slots[i] = None
+                    if not st.future.done():
+                        st.future.set_exception(exc)
+
+    def _loop_step(self) -> bool:
+        jnp = self._jnp
+        did_work = False
+        # 1) admit pending requests into free slots (prefill)
+        free = [i for i in range(self.config.max_batch_size) if not self.active[i]]
+        while free and not self._pending.empty():
+            try:
+                prompt, max_new, fut, t_enq = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            slot = free.pop(0)
+            try:
                 bucket = self._bucket(len(prompt))
                 padded = np.zeros((1, bucket), dtype=np.int32)
                 padded[0, : len(prompt)] = prompt
@@ -177,38 +204,42 @@ class LLMEngine:
                     self.params, self.cache, jnp.asarray(padded), slot, len(prompt)
                 )
                 tok = self._sample(np.asarray(last_logits))
-                with self._lock:
-                    st = _Slot(fut, max_new, len(prompt), t_enq)
-                    st.generated.append(tok)
-                    st.first_token_time = time.monotonic()
-                    self.slots[slot] = st
-                    self.active[slot] = True
-                    self.lengths[slot] = len(prompt)
-                    self.last_tokens[slot, 0] = tok
-                did_work = True
-                self._maybe_finish(slot, tok)
-            # 2) batched decode step for all active slots
-            if self.active.any():
-                logits, self.cache = self._decode(
-                    self.params, self.cache,
-                    jnp.asarray(self.last_tokens), jnp.asarray(self.lengths),
-                )
-                logits_np = np.asarray(logits)
-                with self._lock:
-                    for i in range(self.config.max_batch_size):
-                        if not self.active[i]:
-                            continue
-                        tok = self._sample(logits_np[i])
-                        st = self.slots[i]
-                        st.generated.append(tok)
-                        self.lengths[i] += 1
-                        self.last_tokens[i, 0] = tok
+            except Exception as e:  # noqa: BLE001 - bad request: fail it, keep serving
+                if not fut.done():
+                    fut.set_exception(e)
+                free.insert(0, slot)
+                continue
+            with self._lock:
+                st = _Slot(fut, max_new, len(prompt), t_enq)
+                st.generated.append(tok)
+                st.first_token_time = time.monotonic()
+                self.slots[slot] = st
+                self.active[slot] = True
+                self.lengths[slot] = len(prompt)
+                self.last_tokens[slot, 0] = tok
+            did_work = True
+            self._maybe_finish(slot, tok)
+        # 2) batched decode step for all active slots
+        if self.active.any():
+            logits, self.cache = self._decode(
+                self.params, self.cache,
+                jnp.asarray(self.last_tokens), jnp.asarray(self.lengths),
+            )
+            logits_np = np.asarray(logits)
+            with self._lock:
                 for i in range(self.config.max_batch_size):
-                    if self.active[i]:
-                        self._maybe_finish(i, self.slots[i].generated[-1])
-                did_work = True
-            if not did_work:
-                time.sleep(0.002)
+                    if not self.active[i]:
+                        continue
+                    tok = self._sample(logits_np[i])
+                    st = self.slots[i]
+                    st.generated.append(tok)
+                    self.lengths[i] += 1
+                    self.last_tokens[i, 0] = tok
+            for i in range(self.config.max_batch_size):
+                if self.active[i]:
+                    self._maybe_finish(i, self.slots[i].generated[-1])
+            did_work = True
+        return did_work
 
     def _maybe_finish(self, slot: int, last_tok: int) -> None:
         st = self.slots[slot]

@@ -1,0 +1,29 @@
+"""ray_tpu.tune: hyperparameter tuning over trial actors.
+
+Parity surface: ray.tune (Tuner, tune.run, search spaces, ASHA, PBT) —
+reference python/ray/tune/.
+"""
+
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner, run
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "run",
+    "uniform", "loguniform", "randint", "choice", "grid_search",
+    "BasicVariantGenerator", "Searcher",
+    "ASHAScheduler", "PopulationBasedTraining", "FIFOScheduler", "TrialScheduler",
+]

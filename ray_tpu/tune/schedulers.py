@@ -1,0 +1,137 @@
+"""Trial schedulers: ASHA (async successive halving) + PBT.
+
+Parity: python/ray/tune/schedulers/ — async_hyperband.py (ASHAScheduler) and
+pbt.py (PopulationBasedTraining). The scheduler sees per-trial reports and
+returns CONTINUE/STOP; PBT additionally mutates lagging trials from leaders.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return CONTINUE
+
+    def exploit_config(self, trial_id: str) -> Optional[dict]:
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async successive halving (reference: tune/schedulers/async_hyperband.py).
+
+    At each rung (iteration = grace_period * reduction_factor^k) a trial stops
+    unless its metric is in the top 1/reduction_factor of completed rung entries.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min", grace_period: int = 1,
+                 reduction_factor: int = 3, max_t: int = 100, time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.time_attr = time_attr
+        self._rungs: dict[int, list[float]] = defaultdict(list)
+
+    def _rung_for(self, t: int) -> int | None:
+        r = self.grace
+        while r <= self.max_t:
+            if t == r:
+                return r
+            r *= self.rf
+        return None
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP
+        rung = self._rung_for(t)
+        if rung is None:
+            return CONTINUE
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        rung_vals = self._rungs[rung]
+        rung_vals.append(float(val))
+        if len(rung_vals) < self.rf:
+            return CONTINUE  # not enough peers yet: optimistic continue (async)
+        ordered = sorted(rung_vals, reverse=(self.mode == "max"))
+        cutoff = ordered[max(0, len(ordered) // self.rf - 1)]
+        good = val >= cutoff if self.mode == "max" else val <= cutoff
+        return CONTINUE if good else STOP
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): at each perturbation interval,
+    bottom-quantile trials copy a top-quantile trial's config (exploit) and
+    perturb it (explore)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 2, quantile_fraction: float = 0.25,
+                 hyperparam_mutations: dict | None = None, seed: int | None = None,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.quantile = quantile_fraction
+        self.mutations = hyperparam_mutations or {}
+        self.time_attr = time_attr
+        self.rng = random.Random(seed)
+        self._latest: dict[str, tuple[float, dict]] = {}  # trial -> (metric, config)
+        self._exploit: dict[str, dict] = {}
+
+    def record_config(self, trial_id: str, config: dict) -> None:
+        self._latest.setdefault(trial_id, (float("-inf") if self.mode == "max" else float("inf"), dict(config)))
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        _, cfg = self._latest.get(trial_id, (None, {}))
+        self._latest[trial_id] = (float(val), cfg)
+        t = int(result.get(self.time_attr, 0))
+        if t > 0 and t % self.interval == 0 and len(self._latest) >= 3:
+            ranked = sorted(self._latest.items(), key=lambda kv: kv[1][0],
+                            reverse=(self.mode == "max"))
+            n = len(ranked)
+            k = max(1, int(n * self.quantile))
+            top = ranked[:k]
+            bottom_ids = {tid for tid, _ in ranked[-k:]}
+            if trial_id in bottom_ids:
+                leader_id, (lval, lcfg) = self.rng.choice(top)
+                if leader_id != trial_id:
+                    self._exploit[trial_id] = self._perturb(lcfg)
+        return CONTINUE
+
+    def _perturb(self, config: dict) -> dict:
+        out = dict(config)
+        for k, spec in self.mutations.items():
+            if callable(spec):
+                out[k] = spec()
+            elif isinstance(spec, list):
+                out[k] = self.rng.choice(spec)
+            elif isinstance(spec, tuple) and len(spec) == 2:
+                factor = self.rng.choice([0.8, 1.2])
+                out[k] = min(max(out.get(k, spec[0]) * factor, spec[0]), spec[1])
+        return out
+
+    def exploit_config(self, trial_id: str) -> Optional[dict]:
+        """Trial-side poll: new config to adopt, if any (cleared on read)."""
+        cfg = self._exploit.pop(trial_id, None)
+        if cfg is not None:
+            cur = self._latest.get(trial_id)
+            if cur:
+                self._latest[trial_id] = (cur[0], dict(cfg))
+        return cfg

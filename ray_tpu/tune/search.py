@@ -1,0 +1,119 @@
+"""Search spaces + search algorithms.
+
+Parity: python/ray/tune/search/ — sample.py distributions (uniform, loguniform,
+choice, randint, grid_search) and basic_variant.py (BasicVariantGenerator:
+grid expansion × random sampling). Optuna/hyperopt-style suggesters plug in via
+the same ``Searcher`` interface (suggest/on_trial_complete).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class Randint(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    options: list
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+@dataclass
+class GridSearch:
+    values: list
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def choice(options) -> Choice:
+    return Choice(list(options))
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(list(values))
+
+
+class Searcher:
+    """Reference: tune/search/searcher.py Searcher interface."""
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid × random expansion (reference: tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1, seed: int | None = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
+        grids = [param_space[k].values for k in grid_keys]
+        self._grid_points = [dict(zip(grid_keys, combo)) for combo in itertools.product(*grids)] or [{}]
+        self._emitted = 0
+        self.total = len(self._grid_points) * num_samples
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._emitted >= self.total:
+            return None
+        grid = self._grid_points[self._emitted % len(self._grid_points)]
+        cfg = {}
+        for k, v in self.param_space.items():
+            if k in grid:
+                cfg[k] = grid[k]
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            else:
+                cfg[k] = v
+        self._emitted += 1
+        return cfg

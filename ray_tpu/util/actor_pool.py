@@ -1,0 +1,65 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Parity: python/ray/util/actor_pool.py (map/map_unordered/submit/get_next).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: list):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._pending: list = []  # ordered refs
+        self._index = 0
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef."""
+        if not self._idle:
+            # wait for any in-flight call to finish
+            ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1, timeout=None)
+            for r in ready:
+                self._idle.append(self._future_to_actor.pop(r))
+        actor = self._idle.pop(0)
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._pending.append(ref)
+
+    def has_next(self) -> bool:
+        return bool(self._pending)
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        ref = self._pending.pop(0)
+        out = ray_tpu.get(ref, timeout=timeout)
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+        return out
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        ready, _ = ray_tpu.wait(self._pending, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("No result ready")
+        ref = ready[0]
+        self._pending.remove(ref)
+        out = ray_tpu.get(ref)
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+        return out
+
+    def map(self, fn: Callable, values: Iterable) -> Iterable:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable) -> Iterable:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

@@ -1,0 +1,110 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Parity: python/ray/util/metrics.py — tagged metrics recorded by application
+code; a registry snapshot serves the dashboard/Prometheus scrape (reference:
+per-node metrics agent + opencensus pipeline, SURVEY §5.5).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Iterable, Optional
+
+_registry_lock = threading.Lock()
+_registry: dict[str, "Metric"] = {}
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Optional[Iterable[str]] = None):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: dict | None) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(sorted(merged.items()))
+
+
+class Counter(Metric):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, value: float = 1.0, tags: dict | None = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] += value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._values)
+
+
+class Gauge(Metric):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, tags: dict | None = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._values)
+
+
+class Histogram(Metric):
+    def __init__(self, name: str, description: str = "", boundaries: Iterable[float] = (),
+                 tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [0.01, 0.1, 1, 10, 100]
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+
+    def observe(self, value: float, tags: dict | None = None) -> None:
+        key = self._key(tags)
+        with self._lock:
+            buckets = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            self._sums[key] += value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {k: {"buckets": list(v), "sum": self._sums[k]} for k, v in self._counts.items()}
+
+
+def registry_snapshot() -> dict:
+    """All metrics, for exposition (dashboard / prometheus text format)."""
+    with _registry_lock:
+        metrics = dict(_registry)
+    return {name: m.snapshot() for name, m in metrics.items() if hasattr(m, "snapshot")}
+
+
+def prometheus_text() -> str:
+    """Render the registry in Prometheus exposition format."""
+    lines = []
+    for name, values in registry_snapshot().items():
+        safe = name.replace(".", "_").replace("-", "_")
+        for key, val in values.items():
+            tags = ",".join(f'{k}="{v}"' for k, v in key)
+            label = f"{{{tags}}}" if tags else ""
+            if isinstance(val, dict):  # histogram
+                lines.append(f"{safe}_sum{label} {val['sum']}")
+                lines.append(f"{safe}_count{label} {sum(val['buckets'])}")
+            else:
+                lines.append(f"{safe}{label} {val}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,79 @@
+"""Distributed Queue backed by an actor.
+
+Parity: python/ray/util/queue.py (Queue with put/get/qsize/empty/full,
+blocking + timeout semantics via the hosting actor).
+"""
+
+from __future__ import annotations
+
+import queue as _stdlib_queue
+from typing import Any, Optional
+
+import ray_tpu
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q: _stdlib_queue.Queue = _stdlib_queue.Queue(maxsize=maxsize)
+
+    def put(self, item: Any, timeout: Optional[float] = None) -> bool:
+        try:
+            self._q.put(item, timeout=timeout)
+            return True
+        except _stdlib_queue.Full:
+            return False
+
+    def get(self, timeout: Optional[float] = None):
+        try:
+            return (True, self._q.get(timeout=timeout))
+        except _stdlib_queue.Empty:
+            return (False, None)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def full(self) -> bool:
+        return self._q.full()
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: dict | None = None):
+        opts = {"num_cpus": 0, "max_concurrency": 8, **(actor_options or {})}
+        self._actor = ray_tpu.remote(**opts)(_QueueActor).remote(maxsize)
+
+    def put(self, item: Any, block: bool = True, timeout: float | None = None) -> None:
+        ok = ray_tpu.get(self._actor.put.remote(item, timeout if block else 0.0))
+        if not ok:
+            raise Full("Queue is full")
+
+    def get(self, block: bool = True, timeout: float | None = None) -> Any:
+        ok, item = ray_tpu.get(self._actor.get.remote(timeout if block else 0.0))
+        if not ok:
+            raise Empty("Queue is empty")
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self._actor.full.remote())

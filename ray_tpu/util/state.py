@@ -1,0 +1,115 @@
+"""State API: live introspection of tasks/actors/objects/nodes.
+
+Parity: python/ray/util/state/ (list_actors api.py:793, list_tasks :1020,
+summarize :1375+) and `ray timeline` (_private/state.py:1017 — Chrome trace
+export of task events).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter as _Counter
+from typing import Optional
+
+from ray_tpu.core.runtime import get_runtime
+
+
+def list_tasks(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
+    tasks = get_runtime().list_tasks()
+    return _apply_filters(tasks, filters)[:limit]
+
+
+def list_actors(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
+    return _apply_filters(get_runtime().list_actors(), filters)[:limit]
+
+
+def list_nodes(limit: int = 1000) -> list[dict]:
+    rt = get_runtime()
+    return [
+        {
+            "node_id": n.node_id.hex(),
+            "alive": n.alive,
+            "resources_total": dict(n.total),
+            "resources_available": dict(n.available),
+            "labels": dict(n.labels),
+        }
+        for n in rt.scheduler.nodes()
+    ][:limit]
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    rt = get_runtime()
+    out = []
+    for oid, ref in rt.reference_counter.all_references().items():
+        out.append(
+            {
+                "object_id": oid.hex(),
+                "local_refs": ref.local_refs,
+                "submitted_task_refs": ref.submitted_task_refs,
+                "lineage_refs": ref.lineage_refs,
+                "pinned": ref.pinned,
+                "in_store": rt.memory_store.contains(oid),
+            }
+        )
+        if len(out) >= limit:
+            break
+    return out
+
+
+def list_placement_groups(limit: int = 1000) -> list[dict]:
+    from ray_tpu.core.api import placement_group_table
+
+    return placement_group_table()[:limit]
+
+
+def summarize_tasks() -> dict:
+    by_state = _Counter(t["state"] for t in get_runtime().list_tasks())
+    by_name = _Counter(t["name"] for t in get_runtime().list_tasks())
+    return {"by_state": dict(by_state), "by_name": dict(by_name.most_common(20))}
+
+
+def summarize_actors() -> dict:
+    by_state = _Counter(a["state"] for a in get_runtime().list_actors())
+    by_class = _Counter(a["class_name"] for a in get_runtime().list_actors())
+    return {"by_state": dict(by_state), "by_class": dict(by_class.most_common(20))}
+
+
+def timeline(path: str | None = None) -> list[dict]:
+    """Chrome-trace events from the task event buffer (reference: ray timeline)."""
+    events = get_runtime().task_events()
+    # pair RUNNING->terminal per task into complete events
+    starts: dict[str, float] = {}
+    trace: list[dict] = []
+    for ev in events:
+        tid = ev["task_id"]
+        if ev["state"] == "RUNNING":
+            starts[tid] = ev["ts"]
+        elif ev["state"] in ("FINISHED", "FAILED", "CANCELLED") and tid in starts:
+            t0 = starts.pop(tid)
+            trace.append(
+                {
+                    "name": ev["name"],
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": int(t0 * 1e6),
+                    "dur": int((ev["ts"] - t0) * 1e6),
+                    "pid": 1,
+                    "tid": abs(hash(ev.get("actor_id") or tid)) % 1000,
+                    "args": {"state": ev["state"]},
+                }
+            )
+    if path:
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def _apply_filters(rows: list[dict], filters) -> list[dict]:
+    if not filters:
+        return rows
+    for key, op, value in filters:
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+    return rows

@@ -270,3 +270,65 @@ def test_llm_empty_prompt_rejected():
     with pytest.raises(ValueError, match="non-empty"):
         eng.generate([], 4).result(10)
     eng.shutdown()
+
+
+def test_batch_never_exceeds_max_size():
+    sizes = []
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+    def process2(items):
+        sizes.append(len(items))
+        time.sleep(0.02)
+        return items
+
+    results = [None] * 11
+    threads = [threading.Thread(target=lambda i=i: results.__setitem__(i, process2(i)))
+               for i in range(11)]
+    [t.start() for t in threads]
+    [t.join(timeout=15) for t in threads]
+    assert results == list(range(11))
+    assert max(sizes) <= 4 and sum(sizes) == 11
+
+
+def test_llm_engine_survives_bad_request():
+    from ray_tpu.serve.llm import LLMConfig, LLMEngine
+
+    eng = LLMEngine(LLMConfig(max_batch_size=2, max_seq_len=32))
+    with pytest.raises(ValueError):
+        eng.generate(["a", "b"], 4).result(10)  # non-int tokens rejected up front
+    # engine still serves afterwards
+    res = eng.generate([1, 2, 3], 4).result(60)
+    assert res.num_generated == 4
+    eng.shutdown()
+
+
+def test_llm_max_tokens_zero():
+    from ray_tpu.serve.llm import LLMConfig, LLMEngine
+
+    eng = LLMEngine(LLMConfig(max_batch_size=2, max_seq_len=32))
+    res = eng.generate([1, 2], 0).result(10)
+    assert res.num_generated == 0 and res.token_ids == []
+    eng.shutdown()
+
+
+def test_proxy_port_released_after_shutdown():
+    @serve.deployment
+    class P1:
+        def __call__(self, body):
+            return 1
+
+    serve.run(P1.bind(), route_prefix="/p1")
+    serve.start_http_proxy(port=8461)
+    serve.shutdown()
+    # rebinding the same port must work after cleanup
+    @serve.deployment
+    class P2:
+        def __call__(self, body):
+            return 2
+
+    serve.run(P2.bind(), route_prefix="/p2")
+    proxy = serve.start_http_proxy(port=8461)
+    req = urllib.request.Request("http://127.0.0.1:8461/p2", data=b"{}",
+                                 headers={"Content-Type": "application/json"})
+    out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+    assert out == {"result": 2}

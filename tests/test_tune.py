@@ -1,0 +1,125 @@
+"""Tune library tests (model: reference python/ray/tune/tests/)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import report
+
+
+@pytest.fixture(autouse=True)
+def _session(ray_start_regular):
+    yield
+
+
+def test_grid_and_random_sampling():
+    gen = tune.BasicVariantGenerator(
+        {"lr": tune.grid_search([0.1, 0.01]), "wd": tune.uniform(0, 1), "fixed": 7},
+        num_samples=3, seed=0,
+    )
+    cfgs = []
+    while (c := gen.suggest("t")) is not None:
+        cfgs.append(c)
+    assert len(cfgs) == 6  # 2 grid x 3 samples
+    assert {c["lr"] for c in cfgs} == {0.1, 0.01}
+    assert all(c["fixed"] == 7 and 0 <= c["wd"] <= 1 for c in cfgs)
+
+
+def test_tuner_finds_best():
+    def objective(config):
+        report({"loss": (config["x"] - 3.0) ** 2})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.config["x"] == 3.0
+    assert best.metrics["loss"] == 0.0
+    assert len(grid) == 4
+
+
+def test_trial_error_recorded():
+    def bad(config):
+        if config["x"] == 1:
+            raise RuntimeError("trial blew up")
+        report({"loss": 0})
+
+    grid = tune.Tuner(
+        bad, param_space={"x": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(),
+    ).fit()
+    states = sorted(r.state for r in grid)
+    assert "ERRORED" in states and "COMPLETED" in states
+    errored = [r for r in grid if r.state == "ERRORED"][0]
+    assert "trial blew up" in errored.error
+
+
+def test_asha_stops_bad_trials():
+    iterations = {}
+
+    def objective(config):
+        for i in range(1, 10):
+            iterations[config["x"]] = i
+            report({"loss": config["x"] * 1.0, "training_iteration": i})
+            time.sleep(0.01)
+
+    sched = tune.ASHAScheduler(metric="loss", mode="min", grace_period=1,
+                               reduction_factor=2, max_t=9)
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min", scheduler=sched,
+                                    max_concurrent_trials=4),
+    ).fit()
+    # the worst trial must have been stopped before 9 iterations
+    assert iterations[4] < 9
+    best = grid.get_best_result()
+    assert best.config["x"] == 1
+
+
+def test_pbt_exploits_leader():
+    sched = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"lr": (0.001, 1.0)}, seed=0,
+    )
+
+    def objective(config):
+        lr = config["lr"]
+        for i in range(1, 9):
+            # score improves faster with higher lr (toy)
+            report({"score": lr * i, "training_iteration": i})
+            lr = config["lr"]  # may be updated by exploit
+            time.sleep(0.01)
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.001, 0.01, 0.5, 0.9])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", scheduler=sched,
+                                    max_concurrent_trials=4),
+    ).fit()
+    assert len(grid) == 4
+    # at least one lagging trial adopted a leader-derived lr
+    final_lrs = [r.config["lr"] for r in grid]
+    assert final_lrs != [0.001, 0.01, 0.5, 0.9]
+
+
+def test_run_functional_api():
+    grid = tune.run(
+        lambda cfg: report({"loss": cfg["a"]}),
+        config={"a": tune.grid_search([2, 1])},
+        metric="loss", mode="min",
+    )
+    assert grid.get_best_result().config["a"] == 1
+
+
+def test_result_dataframe():
+    grid = tune.run(
+        lambda cfg: report({"loss": cfg["a"]}),
+        config={"a": tune.grid_search([1, 2])},
+    )
+    df = grid.get_dataframe()
+    assert len(df) == 2 and "config/a" in df.columns

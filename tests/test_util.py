@@ -1,0 +1,151 @@
+"""util + state API + job submission + CLI tests."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Queue
+from ray_tpu.util import metrics as rt_metrics
+from ray_tpu.util import state as rt_state
+
+
+@pytest.fixture(autouse=True)
+def _session(ray_start_regular):
+    yield
+
+
+def test_actor_pool_map():
+    @ray_tpu.remote
+    class Worker:
+        def work(self, x):
+            return x * 2
+
+    pool = ActorPool([Worker.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(10)))
+    assert out == [x * 2 for x in range(10)]
+
+
+def test_actor_pool_unordered():
+    @ray_tpu.remote
+    class W:
+        def work(self, x):
+            time.sleep(0.05 if x == 0 else 0.0)
+            return x
+
+    pool = ActorPool([W.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.work.remote(v), range(4)))
+    assert sorted(out) == [0, 1, 2, 3]
+
+
+def test_queue_basic():
+    q = Queue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+
+def test_queue_cross_task():
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q):
+        for i in range(5):
+            q.put(i)
+
+    producer.remote(q)
+    got = [q.get(timeout=5) for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_metrics_counter_gauge_histogram():
+    c = rt_metrics.Counter("test_requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = rt_metrics.Gauge("test_inflight")
+    g.set(7)
+    h = rt_metrics.Histogram("test_latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(5.0)
+    snap = rt_metrics.registry_snapshot()
+    assert snap["test_requests"][(("route", "/a"),)] == 3
+    assert snap["test_inflight"][()] == 7
+    text = rt_metrics.prometheus_text()
+    assert "test_requests" in text and "test_latency_count" in text
+
+
+def test_state_api_lists():
+    @ray_tpu.remote
+    def t():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get([t.remote(), a.m.remote()])
+    tasks = rt_state.list_tasks()
+    assert any(x["name"] == "t" for x in tasks)
+    actors = rt_state.list_actors()
+    assert any(x["class_name"] == "A" for x in actors)
+    nodes = rt_state.list_nodes()
+    assert nodes and nodes[0]["alive"]
+    assert rt_state.summarize_tasks()["by_state"].get("FINISHED", 0) >= 1
+
+
+def test_timeline_chrome_trace(tmp_path):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.02)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)])
+    out = tmp_path / "trace.json"
+    events = rt_state.timeline(str(out))
+    assert out.exists()
+    named = [e for e in events if e["name"] == "traced"]
+    assert len(named) >= 3
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in named)
+
+
+def test_job_submission_lifecycle(tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient(log_dir=str(tmp_path))
+    jid = client.submit_job(entrypoint=f"{sys.executable} -c 'print(\"job ran ok\")'")
+    status = client.wait_until_finished(jid, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "job ran ok" in client.get_job_logs(jid)
+
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(bad, timeout=60) == JobStatus.FAILED
+    assert client.get_job_info(bad).returncode == 3
+    assert len(client.list_jobs()) == 2
+
+
+def test_job_stop(tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient(log_dir=str(tmp_path))
+    jid = client.submit_job(entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    time.sleep(0.5)
+    client.stop_job(jid)
+    assert client.wait_until_finished(jid, timeout=30) == JobStatus.STOPPED
+
+
+def test_cli_status_and_list(capsys):
+    from ray_tpu.scripts.cli import main
+
+    assert main(["status"]) == 0
+    out = capsys.readouterr().out
+    assert "nodes:" in out and "CPU" in out
+    assert main(["list", "nodes"]) == 0
+    assert main(["summary", "tasks"]) == 0
