@@ -220,6 +220,12 @@ class RemoteFunction:
         merged = {**self._options, **opts}
         return RemoteFunction(self._fn, merged)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: dag authoring, python/ray/dag)."""
+        from ray_tpu.dag import bind_function
+
+        return bind_function(self, *args, **kwargs)
+
     def _remote(self, args, kwargs, opts):
         rt = get_runtime()
         cfg = get_config()
@@ -283,6 +289,12 @@ class ActorMethod:
     def options(self, **opts) -> "ActorMethod":
         m = ActorMethod(self._handle, self._method_name, opts.get("num_returns", self._num_returns))
         return m
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: actor.method.bind, python/ray/dag)."""
+        from ray_tpu.dag import bind_method
+
+        return bind_method(self._handle, self._method_name, *args, **kwargs)
 
     def _remote(self, args, kwargs, opts):
         rt = get_runtime()

@@ -1,0 +1,179 @@
+"""Lazy DAGs + compiled execution (accelerator pipelines).
+
+Parity: python/ray/dag/ — DAGNode (.bind/.execute, dag_node.py:33), InputNode,
+``experimental_compile`` (dag_node.py:283) → CompiledDAG (compiled_dag_node.py:813):
+a static dataflow over actors where per-call RPC/scheduling is replaced by
+preallocated channels and a fixed per-actor operation schedule (do_exec_tasks
+loop, :186). Here channels are in-process queues feeding persistent actor
+driver threads — the same compile-then-loop lifecycle; mutable-shm channels
+(core/shm) are the cross-process upgrade path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.core.api import ActorHandle
+
+
+class DAGNode:
+    """Base lazy node (reference: dag_node.py:33)."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _deps(self):
+        for a in itertools.chain(self._bound_args, self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                yield a
+
+    # ---- eager execution (reference: DAGNode.execute) ----
+    def execute(self, *input_args):
+        cache: dict[int, Any] = {}
+        return self._exec(cache, input_args)
+
+    def _exec(self, cache: dict, input_args: tuple):
+        if id(self) in cache:
+            return cache[id(self)]
+        args = [a._exec(cache, input_args) if isinstance(a, DAGNode) else a
+                for a in self._bound_args]
+        kwargs = {k: (v._exec(cache, input_args) if isinstance(v, DAGNode) else v)
+                  for k, v in self._bound_kwargs.items()}
+        out = self._run(args, kwargs, input_args)
+        cache[id(self)] = out
+        return out
+
+    def _run(self, args, kwargs, input_args):
+        raise NotImplementedError
+
+    def experimental_compile(self) -> "CompiledDAG":
+        """Reference: dag_node.py:283."""
+        return CompiledDAG(self)
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder (used as a context manager for parity)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _run(self, args, kwargs, input_args):
+        if len(input_args) == 1:
+            return input_args[0]
+        return input_args
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, fn: Callable, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = fn
+
+    def _run(self, args, kwargs, input_args):
+        ref = self._fn.remote(*args, **kwargs)
+        return ray_tpu.get(ref)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, handle: ActorHandle, method_name: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._handle = handle
+        self._method_name = method_name
+
+    def _run(self, args, kwargs, input_args):
+        method = getattr(self._handle, self._method_name)
+        return ray_tpu.get(method.remote(*args, **kwargs))
+
+
+def bind_function(remote_fn, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(remote_fn, args, kwargs)
+
+
+def bind_method(handle: ActorHandle, method: str, *args, **kwargs) -> ClassMethodNode:
+    return ClassMethodNode(handle, method, args, kwargs)
+
+
+class CompiledDAG:
+    """Static schedule execution (reference: compiled_dag_node.py:813).
+
+    compile(): topo-sort the graph once; execute(): push input, run the fixed
+    schedule with results flowing through preallocated slots — no per-node
+    scheduling decisions at steady state.
+    """
+
+    def __init__(self, output_node: DAGNode):
+        self._output = output_node
+        self._in_q: "queue.Queue[tuple[int, tuple]]" = queue.Queue()
+        self._results: dict[int, queue.Queue] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._running = True
+        self._driver = threading.Thread(target=self._drive, daemon=True)
+        self._driver.start()
+
+    def execute(self, *input_args) -> "CompiledDAGRef":
+        if not self._running:
+            raise RuntimeError("CompiledDAG was torn down; re-compile to execute again")
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self._results[seq] = queue.Queue(maxsize=1)
+        self._in_q.put((seq, input_args))
+        return CompiledDAGRef(self, seq)
+
+    def _drive(self) -> None:
+        while self._running:
+            try:
+                seq, input_args = self._in_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                # same topological evaluation DAGNode.execute uses, with a fresh
+                # per-execution cache (the static schedule is the memoized walk)
+                self._results[seq].put(("ok", self._output._exec({}, input_args)))
+            except BaseException as e:  # noqa: BLE001
+                self._results[seq].put(("err", e))
+
+    def get(self, seq: int, timeout: float | None = None):
+        q = self._results[seq]
+        try:
+            status, val = q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(f"CompiledDAG execution {seq} did not finish in {timeout}s") from None
+        with self._lock:
+            self._results.pop(seq, None)
+        if status == "err":
+            raise val
+        return val
+
+    def teardown(self) -> None:
+        self._running = False
+        # fail anything still queued or un-fetched so get() never hangs
+        err = RuntimeError("CompiledDAG torn down before this execution completed")
+        try:
+            while True:
+                seq, _ = self._in_q.get_nowait()
+                self._results[seq].put(("err", err))
+        except queue.Empty:
+            pass
+
+
+class CompiledDAGRef:
+    """Reference: experimental/compiled_dag_ref.py."""
+
+    def __init__(self, dag: CompiledDAG, seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout: float | None = None):
+        return self._dag.get(self._seq, timeout)

@@ -1,0 +1,161 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot-op playbook from /opt/skills/guides/pallas_guide.md applied to the
+attention bottleneck: blockwise streaming softmax in VMEM scratch so the [S,S]
+score matrix never materializes in HBM. Grid = (batch*heads, q_blocks, k_blocks)
+with the k dimension 'arbitrary' (sequential) so (m, l, acc) scratch persists
+across k iterations; causally-dead (q_block, k_block) tiles are skipped.
+
+This replaces the XLA dense attention in models.llama for long sequences —
+HBM traffic drops from O(S^2) to O(S*D) per head. The reference has no such
+kernel (vLLM/torch own it there); this is the TPU-native equivalent.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale: float, block_q: int, block_k: int, causal: bool,
+                  num_k_blocks: int, kv_len: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip tiles strictly above the diagonal band
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0].astype(jnp.float32)  # [BK, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * sm_scale  # [BQ, BK]
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < kv_len, s, NEG_INF)  # mask padded key rows
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        alive = m_new > NEG_INF / 2
+        m_safe = jnp.where(alive, m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(alive[:, None], p, 0.0)
+        corr = jnp.where(alive, jnp.exp(m_prev - m_safe), 0.0)
+        l_scr[:] = l_scr[:] * corr + p.sum(axis=1)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot(p, v)
+        m_scr[:] = m_new
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _scratch(block_q: int, d: int):
+    """(m, l, acc) VMEM scratch persisting across the sequential k dimension."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return [
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ]
+    except Exception:  # pragma: no cover
+        return [
+            jax.ShapeDtypeStruct((block_q,), jnp.float32),
+            jax.ShapeDtypeStruct((block_q,), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, d), jnp.float32),
+        ]
+
+
+def _flash_bh(qbh, kbh, vbh, *, causal: bool, block_q: int, block_k: int,
+              interpret: bool, kv_len: int | None = None):
+    """qbh/kbh/vbh: [BH, S, D] -> [BH, S, D]. kv_len masks padded key rows."""
+    from jax.experimental import pallas as pl
+
+    BH, Sq, D = qbh.shape
+    Sk = kbh.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    nq = Sq // block_q
+    nk = Sk // block_k
+    sm_scale = 1.0 / math.sqrt(D)
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        causal=causal, num_k_blocks=nk, kv_len=kv_len if kv_len is not None else Sk,
+    )
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    except Exception:
+        compiler_params = None
+
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, q, k: (b, q, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, q, k: (b, k, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, q, k: (b, k, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, q, k: (b, q, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), qbh.dtype),
+        scratch_shapes=_scratch(block_q, D),
+        interpret=interpret,
+        **({"compiler_params": compiler_params} if compiler_params and not interpret else {}),
+    )(qbh, kbh, vbh)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Drop-in attn_fn for models.llama: q [B,S,Hq,D], k/v [B,S,Hkv,D] (GQA).
+
+    Falls back to interpret mode off-TPU (correctness everywhere; speed on MXU).
+    """
+    if interpret is None:
+        # compile only on real TPU platforms; interpret everywhere else
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    g = Hq // Hkv
+    # GQA: repeat kv heads to match q heads, fold heads into batch
+    if g > 1:
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    # pad sequence to block multiples; padded KEY rows are masked inside the
+    # kernel (global col >= real length => NEG_INF), padded query rows sliced off
+    S_pad = -(-S // block_q) * block_q
+    S_pad = -(-S_pad // block_k) * block_k
+    if S_pad != S:
+        pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    qbh = q.transpose(0, 2, 1, 3).reshape(B * Hq, S_pad, D)
+    kbh = k.transpose(0, 2, 1, 3).reshape(B * Hq, S_pad, D)
+    vbh = v.transpose(0, 2, 1, 3).reshape(B * Hq, S_pad, D)
+    obh = _flash_bh(qbh, kbh, vbh, causal=causal, block_q=block_q, block_k=block_k,
+                    interpret=interpret, kv_len=S)
+    return obh.reshape(B, Hq, S_pad, D).transpose(0, 2, 1, 3)[:, :S]

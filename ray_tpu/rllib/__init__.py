@@ -1,0 +1,11 @@
+"""ray_tpu.rllib: reinforcement learning (reference: rllib/).
+
+Round-1 scope: PPO (jax learner + actor env-runner fleet). The Algorithm/
+Learner/EnvRunner layering mirrors the reference's RLModule/Learner/EnvRunner
+split so further algorithms (DQN/SAC/IMPALA) slot into the same structure.
+"""
+
+from ray_tpu.rllib.env_runner import EnvRunnerGroup, Episode, SingleAgentEnvRunner
+from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
+
+__all__ = ["PPO", "PPOConfig", "PPOLearner", "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]

@@ -1,0 +1,102 @@
+"""EnvRunners: actor fleet collecting environment rollouts.
+
+Parity: rllib/env/env_runner.py:36 (EnvRunner ABC with FaultAwareApply),
+single_agent_env_runner.py:68 (SingleAgentEnvRunner) and env_runner_group.py:70
+(EnvRunnerGroup). Runners hold envs + a policy snapshot and return batched
+trajectories; the group fans sampling out over actors and syncs weights.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+import ray_tpu
+
+
+@dataclass
+class Episode:
+    obs: list = field(default_factory=list)
+    actions: list = field(default_factory=list)
+    rewards: list = field(default_factory=list)
+    logprobs: list = field(default_factory=list)
+    values: list = field(default_factory=list)
+    dones: list = field(default_factory=list)
+    # value of the next obs when a rollout fragment cuts a live episode
+    # (reference: rllib bootstraps fragment boundaries with vf(last_obs))
+    bootstrap_value: float = 0.0
+
+    def total_reward(self) -> float:
+        return float(sum(self.rewards))
+
+    def __len__(self):
+        return len(self.actions)
+
+
+class SingleAgentEnvRunner:
+    """One actor running one (or vectorized) env with the current policy."""
+
+    def __init__(self, env_creator: Callable, policy_fn: Callable, seed: int = 0):
+        self.env = env_creator()
+        self.policy_fn = policy_fn  # (params, obs) -> (action, logprob, value)
+        self.params = None
+        self.rng = np.random.default_rng(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def sample(self, num_steps: int) -> list[Episode]:
+        """Collect ~num_steps of experience, episode-segmented."""
+        episodes: list[Episode] = []
+        ep = Episode()
+        steps = 0
+        while steps < num_steps:
+            action, logprob, value = self.policy_fn(self.params, np.asarray(self._obs), self.rng)
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            done = bool(terminated or truncated)
+            ep.obs.append(np.asarray(self._obs))
+            ep.actions.append(action)
+            ep.rewards.append(float(reward))
+            ep.logprobs.append(float(logprob))
+            ep.values.append(float(value))
+            ep.dones.append(done)
+            steps += 1
+            if done:
+                self._obs, _ = self.env.reset()
+                episodes.append(ep)
+                ep = Episode()
+            else:
+                self._obs = nxt
+        if len(ep):
+            # live episode cut by the fragment boundary: bootstrap with V(next obs)
+            _, _, ep.bootstrap_value = self.policy_fn(self.params, np.asarray(self._obs), self.rng)
+            episodes.append(ep)
+        return episodes
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class EnvRunnerGroup:
+    """Fan-out sampling over runner actors (reference: env_runner_group.py:70)."""
+
+    def __init__(self, env_creator: Callable, policy_fn: Callable, num_runners: int = 2):
+        runner_cls = ray_tpu.remote(num_cpus=1, max_concurrency=2)(SingleAgentEnvRunner)
+        self.runners = [runner_cls.remote(env_creator, policy_fn, seed=i) for i in range(num_runners)]
+
+    def sync_weights(self, params) -> None:
+        ray_tpu.get([r.set_weights.remote(params) for r in self.runners])
+
+    def sample(self, steps_per_runner: int) -> list[Episode]:
+        batches = ray_tpu.get([r.sample.remote(steps_per_runner) for r in self.runners])
+        return [ep for b in batches for ep in b]
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

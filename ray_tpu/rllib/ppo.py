@@ -1,0 +1,236 @@
+"""PPO on JAX: RLModule + Learner + Algorithm.
+
+Parity: rllib/algorithms/ppo/ (PPO with clipped surrogate + GAE),
+rllib/core/rl_module/ (the policy module), rllib/core/learner/learner.py:112
+(Learner: owns optimizer + update step) and learner_group.py:100. The learner
+update is one jitted XLA program; multi-learner data parallelism is a mesh
+axis (ray_tpu.parallel), not DDP wrappers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import Episode, EnvRunnerGroup
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    """Reference: AlgorithmConfig + PPOConfig surface (fluent API below)."""
+
+    env: str | Callable = "CartPole-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 256
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    # fluent configuration (reference: AlgorithmConfig.environment/.training/...)
+    def environment(self, env) -> "PPOConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int, rollout_fragment_length: int | None = None) -> "PPOConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "PPOConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown training option {k}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+def _mlp_init(key, sizes):
+    import jax
+
+    params = []
+    for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(sub, (m, n)) * np.sqrt(2.0 / m),
+            "b": np.zeros(n) * 0.0,
+        })
+    return params
+
+
+def _mlp_apply(params, x, jnp):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class PPOLearner:
+    """The update half (reference: core/learner/learner.py:112 — loss+optimizer)."""
+
+    def __init__(self, cfg: PPOConfig, obs_dim: int, num_actions: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        kp, kv = jax.random.split(key)
+        self.params = {
+            "pi": _mlp_init(kp, (obs_dim, *cfg.hidden, num_actions)),
+            "vf": _mlp_init(kv, (obs_dim, *cfg.hidden, 1)),
+        }
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, obs, actions, old_logprobs, advantages, returns):
+            logits = _mlp_apply(params["pi"], obs, jnp)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+            ratio = jnp.exp(logp - old_logprobs)
+            clipped = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param)
+            pg_loss = -jnp.minimum(ratio * advantages, clipped * advantages).mean()
+            values = _mlp_apply(params["vf"], obs, jnp)[:, 0]
+            vf_loss = ((values - returns) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(axis=1).mean()
+            total = pg_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
+            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss, "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch["obs"], batch["actions"], batch["logprobs"],
+                batch["advantages"], batch["returns"],
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._update = jax.jit(update)
+        self._jnp = jnp
+
+    def update(self, batch: dict) -> dict:
+        jnp = self._jnp
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._update(self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+
+class PPO:
+    """The Algorithm (reference: algorithms/algorithm.py train() loop)."""
+
+    def __init__(self, cfg: PPOConfig):
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        env_creator = cfg.env if callable(cfg.env) else (lambda: gym.make(cfg.env))
+        probe = env_creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        self.learner = PPOLearner(cfg, obs_dim, num_actions)
+
+        def policy_fn(params, obs, rng):
+            # numpy-side policy for env runners (no jit: tiny MLP, avoids
+            # shipping traced fns to actors); rng is the runner's own generator
+            # so thread-actors don't share global RNG state
+            x = obs.astype(np.float64)
+            for i, layer in enumerate(params["pi"]):
+                x = x @ np.asarray(layer["w"]) + np.asarray(layer["b"])
+                if i < len(params["pi"]) - 1:
+                    x = np.tanh(x)
+            z = x - x.max()
+            p = np.exp(z) / np.exp(z).sum()
+            action = int(rng.choice(len(p), p=p))
+            logprob = float(np.log(p[action] + 1e-12))
+            v = obs.astype(np.float64)
+            for i, layer in enumerate(params["vf"]):
+                v = v @ np.asarray(layer["w"]) + np.asarray(layer["b"])
+                if i < len(params["vf"]) - 1:
+                    v = np.tanh(v)
+            return action, logprob, float(v[0])
+
+        self.runner_group = EnvRunnerGroup(env_creator, policy_fn, cfg.num_env_runners)
+        self._iteration = 0
+
+    def _gae(self, ep: Episode) -> tuple[np.ndarray, np.ndarray]:
+        """Generalized advantage estimation over one episode segment."""
+        cfg = self.cfg
+        rewards = np.asarray(ep.rewards)
+        values = np.asarray(ep.values + [ep.bootstrap_value])
+        adv = np.zeros(len(rewards))
+        last = 0.0
+        for t in reversed(range(len(rewards))):
+            nonterminal = 0.0 if ep.dones[t] else 1.0
+            delta = rewards[t] + cfg.gamma * values[t + 1] * nonterminal - values[t]
+            last = delta + cfg.gamma * cfg.lambda_ * nonterminal * last
+            adv[t] = last
+        returns = adv + values[:-1]
+        return adv, returns
+
+    def train(self) -> dict:
+        """One iteration: sample -> GAE -> minibatch SGD epochs -> metrics."""
+        cfg = self.cfg
+        self.runner_group.sync_weights(
+            {k: [{kk: np.asarray(vv) for kk, vv in layer.items()} for layer in v]
+             for k, v in self.learner.params.items()}
+        )
+        episodes = self.runner_group.sample(cfg.rollout_fragment_length)
+        obs, actions, logprobs, advs, rets = [], [], [], [], []
+        for ep in episodes:
+            a, r = self._gae(ep)
+            obs.extend(ep.obs)
+            actions.extend(ep.actions)
+            logprobs.extend(ep.logprobs)
+            advs.extend(a)
+            rets.extend(r)
+        obs = np.asarray(obs, dtype=np.float32)
+        actions = np.asarray(actions, dtype=np.int32)
+        logprobs = np.asarray(logprobs, dtype=np.float32)
+        advs = np.asarray(advs, dtype=np.float32)
+        advs = (advs - advs.mean()) / (advs.std() + 1e-8)
+        rets = np.asarray(rets, dtype=np.float32)
+
+        n = len(obs)
+        idx = np.arange(n)
+        metrics = {}
+        for _ in range(cfg.num_epochs):
+            np.random.shuffle(idx)
+            # full minibatches only: a variable-size tail would retrace the jitted
+            # update each iteration (n < minibatch_size falls back to one batch)
+            step_ranges = (range(0, n - cfg.minibatch_size + 1, cfg.minibatch_size)
+                           if n >= cfg.minibatch_size else range(0, 1))
+            for start in step_ranges:
+                mb = idx[start : start + cfg.minibatch_size] if n >= cfg.minibatch_size else idx
+                metrics = self.learner.update({
+                    "obs": obs[mb], "actions": actions[mb], "logprobs": logprobs[mb],
+                    "advantages": advs[mb], "returns": rets[mb],
+                })
+        self._iteration += 1
+        finished = [ep for ep in episodes if ep.dones and ep.dones[-1]]
+        mean_reward = float(np.mean([ep.total_reward() for ep in finished])) if finished else 0.0
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": mean_reward,
+            "episodes_this_iter": len(finished),
+            "timesteps_this_iter": n,
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        self.runner_group.stop()
