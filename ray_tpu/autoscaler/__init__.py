@@ -1,0 +1,34 @@
+"""Autoscaler v2: demand-driven node scaling with pluggable providers.
+
+Parity: python/ray/autoscaler/v2/ — InstanceManager
+(instance_manager/instance_manager.py:29), Reconciler (reconciler.py:59),
+ResourceDemandScheduler (scheduler.py:895 bin-packing) and the NodeProvider
+plugin contract (autoscaler/_private/ node_provider). The TPU-native provider
+surface is slice-granular: a node type is a TPU slice topology (v5p-8 etc.),
+and the demand scheduler bin-packs gang (placement-group) demand onto whole
+slices — reference: SlicePlacementGroup util/tpu.py:420.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    NodeTypeConfig,
+)
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    Instance,
+    InstanceStatus,
+    NodeProvider,
+    TPUVMNodeProvider,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalingConfig",
+    "NodeTypeConfig",
+    "NodeProvider",
+    "FakeNodeProvider",
+    "TPUVMNodeProvider",
+    "Instance",
+    "InstanceStatus",
+]

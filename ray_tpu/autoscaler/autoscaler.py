@@ -1,0 +1,163 @@
+"""The autoscaler reconciler: demand → launch/terminate decisions.
+
+Parity: autoscaler/v2/ Reconciler (reconciler.py:59) + ResourceDemandScheduler
+(scheduler.py:895): each tick it reads (a) pending task/actor demand, (b)
+pending placement groups, (c) current node utilization; bin-packs unmet demand
+onto the cheapest feasible node types; launches up to max limits; terminates
+nodes idle beyond the timeout. Works against any NodeProvider.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.autoscaler.node_provider import InstanceStatus, NodeProvider
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class AutoscalingConfig:
+    node_types: list[NodeTypeConfig]
+    idle_timeout_s: float = 60.0
+    upscaling_speed: int = 2  # max launches per tick per type
+    tick_interval_s: float = 1.0
+
+
+class Autoscaler:
+    def __init__(self, config: AutoscalingConfig, provider: NodeProvider, runtime=None):
+        self.config = config
+        self.provider = provider
+        self._runtime = runtime
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._idle_since: dict[str, float] = {}
+        self.launch_count = 0
+        self.terminate_count = 0
+
+    def _rt(self):
+        if self._runtime is not None:
+            return self._runtime
+        from ray_tpu.core.runtime import get_runtime
+
+        return get_runtime()
+
+    # ---- demand collection (reference: GcsAutoscalerStateManager feed) ----
+    def get_pending_demand(self) -> list[dict[str, float]]:
+        rt = self._rt()
+        demand: list[dict[str, float]] = []
+        # resource shapes of queued tasks
+        with rt._lock:
+            for entry in rt._tasks.values():
+                if entry.state == "PENDING" and entry.spec.resources:
+                    demand.append(dict(entry.spec.resources))
+        for pg in rt.scheduler.placement_groups():
+            if pg.state == "PENDING":
+                for b in pg.bundles:
+                    demand.append(dict(b.resources))
+        return demand
+
+    def _feasible_now(self, shape: dict[str, float]) -> bool:
+        for n in self._rt().scheduler.nodes():
+            if n.alive and all(n.total.get(k, 0.0) >= v for k, v in shape.items()):
+                return True
+        return False
+
+    # ---- one reconcile tick ----
+    def reconcile(self) -> dict:
+        decisions = {"launched": {}, "terminated": []}
+        instances = self.provider.non_terminated_instances()
+        per_type = {}
+        for inst in instances:
+            per_type.setdefault(inst.node_type, []).append(inst)
+
+        # 1) min_workers floors
+        for nt in self.config.node_types:
+            have = len(per_type.get(nt.name, []))
+            if have < nt.min_workers:
+                n = min(nt.min_workers - have, self.config.upscaling_speed)
+                self.provider.launch(nt.name, n)
+                self.launch_count += n
+                decisions["launched"][nt.name] = decisions["launched"].get(nt.name, 0) + n
+                per_type.setdefault(nt.name, []).extend([None] * n)
+
+        # 2) unmet demand -> bin-pack onto node types (first feasible, smallest).
+        # Nodes still booting (REQUESTED/ALLOCATED) count as satisfying demand so
+        # one pending task can't launch a new node every tick until max_workers.
+        booting = {}
+        for inst in instances:
+            if inst.status in (InstanceStatus.QUEUED, InstanceStatus.REQUESTED,
+                               InstanceStatus.ALLOCATED):
+                booting[inst.node_type] = booting.get(inst.node_type, 0) + 1
+        unmet = [d for d in self.get_pending_demand() if not self._feasible_now(d)]
+        if unmet:
+            for shape in unmet:
+                for nt in sorted(self.config.node_types,
+                                 key=lambda t: sum(t.resources.values())):
+                    fits = all(nt.resources.get(k, 0.0) >= v for k, v in shape.items())
+                    if not fits:
+                        continue
+                    if booting.get(nt.name, 0) > 0:
+                        booting[nt.name] -= 1  # a booting node will absorb this shape
+                        break
+                    have = len(per_type.get(nt.name, []))
+                    launched = decisions["launched"].get(nt.name, 0)
+                    if have + launched < nt.max_workers and launched < self.config.upscaling_speed:
+                        self.provider.launch(nt.name, 1)
+                        self.launch_count += 1
+                        decisions["launched"][nt.name] = launched + 1
+                        break
+
+        # 3) idle nodes -> terminate after timeout (never below min_workers)
+        rt = self._rt()
+        now = time.monotonic()
+        by_node_id = {i.node_id_hex: i for i in instances if i.node_id_hex}
+        for node in rt.scheduler.nodes():
+            nid = node.node_id.hex()
+            inst = by_node_id.get(nid)
+            if inst is None or not node.alive:
+                continue
+            busy = any(node.total.get(k, 0) != node.available.get(k, 0) for k in node.total)
+            if busy:
+                self._idle_since.pop(nid, None)
+                continue
+            first_idle = self._idle_since.setdefault(nid, now)
+            nt = next((t for t in self.config.node_types if t.name == inst.node_type), None)
+            same_type = [i for i in instances if i.node_type == inst.node_type
+                         and i.status == InstanceStatus.RUNNING]
+            if (now - first_idle >= self.config.idle_timeout_s and nt is not None
+                    and len(same_type) > nt.min_workers):
+                self.provider.terminate([inst.instance_id])
+                self.terminate_count += 1
+                decisions["terminated"].append(inst.instance_id)
+                self._idle_since.pop(nid, None)
+        return decisions
+
+    # ---- loop ----
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="autoscaler")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger("ray_tpu.autoscaler")
+        while self._running:
+            try:
+                self.reconcile()
+            except Exception:
+                log.warning("autoscaler reconcile failed", exc_info=True)
+            time.sleep(self.config.tick_interval_s)
+
+    def stop(self) -> None:
+        self._running = False

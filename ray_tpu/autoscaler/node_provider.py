@@ -1,0 +1,147 @@
+"""Node providers: the cloud-side half of the autoscaler.
+
+Parity: python/ray/autoscaler/ NodeProvider plugins (aws/gcp/... in
+_private/<cloud>/) and the v2 instance FSM (instance lifecycle states in
+instance_manager/). ``FakeNodeProvider`` mirrors the reference's
+fake_multi_node provider used to test autoscaling without a cloud.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+
+class InstanceStatus(str, Enum):
+    # v2 instance FSM (reference: autoscaler/v2 instance_manager states)
+    QUEUED = "QUEUED"
+    REQUESTED = "REQUESTED"
+    ALLOCATED = "ALLOCATED"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+    TERMINATED = "TERMINATED"
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: InstanceStatus = InstanceStatus.QUEUED
+    launch_time: float = field(default_factory=time.time)
+    node_id_hex: str | None = None  # filled once the node joins the cluster
+
+
+class NodeProvider:
+    """Plugin ABC (reference: autoscaler node_provider interface)."""
+
+    def launch(self, node_type: str, count: int) -> list[Instance]:
+        raise NotImplementedError
+
+    def terminate(self, instance_ids: list[str]) -> None:
+        raise NotImplementedError
+
+    def non_terminated_instances(self) -> list[Instance]:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """In-process provider: 'launching' a node adds a logical node to the
+    scheduler after a configurable delay (reference: fake_multi_node)."""
+
+    def __init__(self, node_type_resources: dict[str, dict[str, float]],
+                 launch_delay_s: float = 0.0, runtime=None):
+        self.node_type_resources = node_type_resources
+        self.launch_delay_s = launch_delay_s
+        self._instances: dict[str, Instance] = {}
+        self._lock = threading.Lock()
+        self._runtime = runtime
+
+    def _rt(self):
+        if self._runtime is not None:
+            return self._runtime
+        from ray_tpu.core.runtime import get_runtime
+
+        return get_runtime()
+
+    def launch(self, node_type: str, count: int) -> list[Instance]:
+        out = []
+        for _ in range(count):
+            inst = Instance(f"fake-{uuid.uuid4().hex[:8]}", node_type,
+                            InstanceStatus.REQUESTED)
+            with self._lock:
+                self._instances[inst.instance_id] = inst
+            threading.Thread(target=self._boot, args=(inst,), daemon=True).start()
+            out.append(inst)
+        return out
+
+    def _boot(self, inst: Instance) -> None:
+        if self.launch_delay_s:
+            time.sleep(self.launch_delay_s)
+        resources = dict(self.node_type_resources[inst.node_type].get("resources", {}))
+        labels = dict(self.node_type_resources[inst.node_type].get("labels", {}))
+        node_id = self._rt().scheduler.add_node(resources, labels=labels)
+        self._rt().scheduler.retry_pending_pgs()
+        with self._lock:
+            inst.node_id_hex = node_id.hex()
+            inst.status = InstanceStatus.RUNNING
+
+    def terminate(self, instance_ids: list[str]) -> None:
+        from ray_tpu._private.ids import NodeID
+
+        with self._lock:
+            insts = [self._instances[i] for i in instance_ids if i in self._instances]
+        for inst in insts:
+            inst.status = InstanceStatus.TERMINATED
+            if inst.node_id_hex:
+                self._rt().scheduler.remove_node(NodeID.from_hex(inst.node_id_hex))
+
+    def non_terminated_instances(self) -> list[Instance]:
+        with self._lock:
+            return [i for i in self._instances.values()
+                    if i.status != InstanceStatus.TERMINATED]
+
+
+class TPUVMNodeProvider(NodeProvider):
+    """GCE TPU-VM provider surface (slice-granular node types, e.g. 'v5p-8').
+
+    Reference pattern: autoscaler/_private/gcp/ node provider + the TPU pod
+    head-resource convention (TPU-{pod_type}-head, accelerators/tpu.py:269).
+    API calls are delegated to a `gcloud`-style command runner injected by the
+    operator; in environments without cloud access this raises cleanly.
+    """
+
+    def __init__(self, project: str, zone: str, runner=None):
+        self.project = project
+        self.zone = zone
+        self.runner = runner
+
+    def launch(self, node_type: str, count: int) -> list[Instance]:
+        if self.runner is None:
+            raise RuntimeError(
+                "TPUVMNodeProvider requires a cloud command runner "
+                "(no cloud access in this environment)"
+            )
+        out = []
+        for _ in range(count):
+            name = f"raytpu-{node_type}-{uuid.uuid4().hex[:6]}"
+            self.runner(
+                ["gcloud", "compute", "tpus", "tpu-vm", "create", name,
+                 f"--zone={self.zone}", f"--accelerator-type={node_type}",
+                 f"--project={self.project}"]
+            )
+            out.append(Instance(name, node_type, InstanceStatus.REQUESTED))
+        return out
+
+    def terminate(self, instance_ids: list[str]) -> None:
+        if self.runner is None:
+            raise RuntimeError("TPUVMNodeProvider requires a cloud command runner")
+        for name in instance_ids:
+            self.runner(["gcloud", "compute", "tpus", "tpu-vm", "delete", name,
+                         f"--zone={self.zone}", "--quiet"])
+
+    def non_terminated_instances(self) -> list[Instance]:
+        return []

@@ -480,6 +480,12 @@ class Runtime:
     def _run_user_fn(self, entry: _TaskEntry, fn, args, kwargs):
         if entry.cancelled:
             raise TaskCancelledError(entry.spec.desc())
+        if entry.spec.runtime_env:
+            from ray_tpu import runtime_env as renv
+
+            ctx = renv.build_context(entry.spec.runtime_env)
+            with renv.apply_context(ctx):
+                return fn(*args, **kwargs)
         return fn(*args, **kwargs)
 
     def _handle_task_failure(self, entry: _TaskEntry, exc: BaseException) -> None:
@@ -546,7 +552,18 @@ class Runtime:
             stream.done = False
             stream.error = None
             stream.cv.notify_all()
-        gen = spec.func(*args, **kwargs)
+        if spec.runtime_env:
+            from ray_tpu import runtime_env as renv
+
+            ctx = renv.build_context(spec.runtime_env)
+
+            def _wrapped():
+                with renv.apply_context(ctx):
+                    yield from spec.func(*args, **kwargs)
+
+            gen = _wrapped()
+        else:
+            gen = spec.func(*args, **kwargs)
         index = 0
         for item in gen:
             if entry.cancelled:
@@ -635,6 +652,7 @@ class Runtime:
             actor_id=actor_id,
             is_actor_creation=True,
             max_retries=0,
+            runtime_env=options.get("runtime_env"),
         )
         tpu = options.get("num_tpus", 0)
         if tpu:
@@ -671,6 +689,19 @@ class Runtime:
             state.threads.append(t)
             t.start()
 
+    def _runtime_env_ctx(self, state: _ActorState):
+        """Build (once) the actor's runtime_env context from its creation spec."""
+        spec = state.creation_spec
+        if spec is None or not spec.runtime_env:
+            return None
+        cached = getattr(state, "_renv_ctx", None)
+        if cached is None:
+            from ray_tpu import runtime_env as renv
+
+            cached = renv.build_context(spec.runtime_env)
+            state._renv_ctx = cached
+        return cached
+
     def _actor_loop(self, state: _ActorState) -> None:
         """Per-actor execution loop: ordered mailbox (task_receiver.cc ordered queues)."""
         import asyncio
@@ -696,7 +727,17 @@ class Runtime:
             try:
                 args, kwargs = self._resolve_args(spec)
                 method = getattr(state.instance, spec.method_name)
-                if inspect.iscoroutinefunction(method):
+                renv_ctx = self._runtime_env_ctx(state)
+                if renv_ctx is not None:
+                    orig_method = method
+
+                    def method(*a, _m=orig_method, _c=renv_ctx, **kw):
+                        from ray_tpu import runtime_env as renv
+
+                        with renv.apply_context(_c):
+                            return _m(*a, **kw)
+
+                if inspect.iscoroutinefunction(getattr(state.instance, spec.method_name)):
                     fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), state.loop)
                     result = fut.result()
                 elif isinstance(spec.num_returns, str):

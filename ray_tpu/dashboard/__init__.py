@@ -1,0 +1,12 @@
+"""Dashboard: HTTP API serving cluster state, metrics, and jobs.
+
+Parity: python/ray/dashboard/ — the head's aiohttp API (head.py + routes.py)
+with the core module endpoints: nodes/actors/tasks/objects state
+(modules/state/), prometheus metrics (modules/metrics/), job list
+(modules/job/), cluster summary. The React client is out of scope; the JSON
+API is the contract the reference's frontend consumes.
+"""
+
+from ray_tpu.dashboard.head import Dashboard, start_dashboard
+
+__all__ = ["Dashboard", "start_dashboard"]

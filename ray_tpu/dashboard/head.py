@@ -1,0 +1,144 @@
+"""Dashboard head server (aiohttp).
+
+Endpoints (reference: dashboard/routes.py + module handlers):
+  GET /api/cluster_status  — nodes + resources (reference: ray status)
+  GET /api/v0/nodes|actors|tasks|objects|placement_groups — state API
+  GET /api/v0/tasks/summarize , /api/v0/actors/summarize
+  GET /api/jobs            — job submission records
+  GET /metrics             — Prometheus exposition (util.metrics registry)
+  GET /api/serve/status    — serve applications (if serve controller exists)
+  GET /healthz
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265, job_client=None):
+        self.host = host
+        self.port = port
+        self.job_client = job_client
+        self._loop = None
+        self._runner = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True, name="dashboard")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("dashboard failed to start")
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        def jsonable(x):
+            return json.loads(json.dumps(x, default=str))
+
+        async def cluster_status(request):
+            import ray_tpu
+
+            return web.json_response({
+                "nodes": jsonable(ray_tpu.nodes()),
+                "total_resources": ray_tpu.cluster_resources(),
+                "available_resources": ray_tpu.available_resources(),
+            })
+
+        async def state_list(request):
+            from ray_tpu.util import state as st
+
+            resource = request.match_info["resource"]
+            fn = {
+                "nodes": st.list_nodes,
+                "actors": st.list_actors,
+                "tasks": st.list_tasks,
+                "objects": st.list_objects,
+                "placement_groups": st.list_placement_groups,
+            }.get(resource)
+            if fn is None:
+                return web.json_response({"error": f"unknown resource {resource}"}, status=404)
+            return web.json_response(jsonable(fn()))
+
+        async def state_summarize(request):
+            from ray_tpu.util import state as st
+
+            resource = request.match_info["resource"]
+            fn = {"tasks": st.summarize_tasks, "actors": st.summarize_actors}.get(resource)
+            if fn is None:
+                return web.json_response({"error": f"no summary for {resource}"}, status=404)
+            return web.json_response(jsonable(fn()))
+
+        async def jobs(request):
+            if self.job_client is None:
+                return web.json_response([])
+            return web.json_response([
+                {
+                    "job_id": j.job_id, "status": j.status.value,
+                    "entrypoint": j.entrypoint, "start_time": j.start_time,
+                    "end_time": j.end_time,
+                }
+                for j in self.job_client.list_jobs()
+            ])
+
+        async def metrics(request):
+            from ray_tpu.util.metrics import prometheus_text
+
+            return web.Response(text=prometheus_text(), content_type="text/plain")
+
+        async def serve_status(request):
+            try:
+                from ray_tpu import serve
+
+                return web.json_response(serve.status())
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)[:200]}, status=503)
+
+        async def healthz(request):
+            return web.json_response({"status": "ok"})
+
+        async def start():
+            app = web.Application()
+            app.router.add_get("/api/cluster_status", cluster_status)
+            app.router.add_get("/api/v0/{resource}/summarize", state_summarize)
+            app.router.add_get("/api/v0/{resource}", state_list)
+            app.router.add_get("/api/jobs", jobs)
+            app.router.add_get("/metrics", metrics)
+            app.router.add_get("/api/serve/status", serve_status)
+            app.router.add_get("/healthz", healthz)
+            self._runner = web.AppRunner(app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+            self._started.set()
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(start())
+        self._loop.run_forever()
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+
+        async def _teardown():
+            if self._runner is not None:
+                await self._runner.cleanup()
+            self._loop.stop()
+
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_teardown(), self._loop)
+            fut.result(timeout=5)
+        except Exception:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265, job_client=None) -> Dashboard:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port, job_client)
+    return _dashboard

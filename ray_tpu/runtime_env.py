@@ -1,0 +1,216 @@
+"""runtime_env: per-task/actor environment configuration via plugins.
+
+Parity: python/ray/_private/runtime_env/ — the plugin architecture (plugin.py)
+with env_vars, working_dir (packaging.py URI-keyed caching), py_modules, and
+pip/uv plugins. In the single-controller runtime, env setup happens in-process
+around task execution (env vars are save/restored per task); the pip/uv/conda
+plugins validate and cache but do NOT install (no network/package installs in
+this environment) — they materialize into PYTHONPATH/prefix wiring when an
+installer hook is provided.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import uuid
+from typing import Any, Callable, Optional
+
+# Tasks with a runtime_env mutate process-global state (cwd, env vars) in the
+# single-controller thread runtime; serialize them so two envs never interleave.
+# (The multi-process cluster backend gives true per-worker isolation, as the
+# reference does with one worker process per runtime_env.)
+_APPLY_LOCK = threading.RLock()
+
+
+class RuntimeEnvPlugin:
+    """Reference: runtime_env/plugin.py RuntimeEnvPlugin ABC."""
+
+    name: str = "base"
+    priority: int = 50
+
+    def validate(self, value: Any) -> Any:
+        return value
+
+    def create(self, value: Any, context: "RuntimeEnvContext") -> None:
+        raise NotImplementedError
+
+    def delete_uri(self, uri: str) -> None:
+        pass
+
+
+class RuntimeEnvContext:
+    """Accumulated environment changes applied around task execution."""
+
+    def __init__(self):
+        self.env_vars: dict[str, str] = {}
+        self.py_paths: list[str] = []
+        self.working_dir: str | None = None
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+
+    def validate(self, value):
+        if not isinstance(value, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in value.items()
+        ):
+            raise ValueError("env_vars must be a dict[str, str]")
+        return value
+
+    def create(self, value, context):
+        context.env_vars.update(value)
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    """Packages a directory into the URI cache (reference: working_dir.py +
+    packaging.py: zip → content-hash URI → per-node cache)."""
+
+    name = "working_dir"
+    CACHE = os.path.join(tempfile.gettempdir(), "ray_tpu_runtime_env", "working_dir")
+
+    def validate(self, value):
+        if not isinstance(value, str) or not os.path.isdir(value):
+            raise ValueError(f"working_dir must be an existing directory, got {value!r}")
+        return value
+
+    def uri_for(self, path: str) -> str:
+        h = hashlib.sha256()
+        for root, _, files in sorted(os.walk(path)):
+            for f in sorted(files):
+                p = os.path.join(root, f)
+                h.update(p.encode())
+                with open(p, "rb") as fh:
+                    h.update(fh.read())
+        return f"workingdir://{h.hexdigest()[:16]}"
+
+    def create(self, value, context):
+        uri = self.uri_for(value)
+        dest = os.path.join(self.CACHE, uri.split("//")[1])
+        if not os.path.exists(dest):
+            os.makedirs(self.CACHE, exist_ok=True)
+            # atomic populate: copy aside, rename into place (concurrent creators
+            # race benignly; an interrupted copy never becomes visible)
+            tmp = f"{dest}.tmp-{uuid.uuid4().hex[:8]}"
+            shutil.copytree(value, tmp)
+            try:
+                os.rename(tmp, dest)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+        context.working_dir = dest
+        context.py_paths.append(dest)
+
+    def delete_uri(self, uri: str) -> None:
+        dest = os.path.join(self.CACHE, uri.split("//")[1])
+        shutil.rmtree(dest, ignore_errors=True)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+
+    def validate(self, value):
+        if not isinstance(value, list):
+            raise ValueError("py_modules must be a list of paths")
+        for p in value:
+            if not os.path.exists(p):
+                raise ValueError(f"py_module path does not exist: {p}")
+        return value
+
+    def create(self, value, context):
+        context.py_paths.extend(os.path.abspath(p) for p in value)
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """Validates pip specs; installation requires an installer hook
+    (reference: pip.py creates virtualenvs — no installs in this image)."""
+
+    name = "pip"
+    installer: Optional[Callable] = None
+
+    def validate(self, value):
+        if isinstance(value, dict):
+            value = value.get("packages", [])
+        if not isinstance(value, list) or not all(isinstance(p, str) for p in value):
+            raise ValueError("pip must be a list of requirement strings")
+        return value
+
+    def create(self, value, context):
+        if not value:
+            return
+        installer = type(self).installer
+        if installer is None:
+            raise RuntimeError(
+                f"runtime_env {self.name!r} requires an installer hook in this "
+                f"environment (package installation is disabled); set "
+                f"{type(self).__name__}.installer."
+            )
+        prefix = installer(value)
+        if prefix:
+            context.py_paths.append(prefix)
+
+
+class UvPlugin(PipPlugin):
+    name = "uv"
+    installer: Optional[Callable] = None  # independent of PipPlugin.installer
+
+
+_PLUGINS: dict[str, RuntimeEnvPlugin] = {
+    p.name: p for p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
+                        PipPlugin(), UvPlugin())
+}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    _PLUGINS[plugin.name] = plugin
+
+
+def validate_runtime_env(runtime_env: dict) -> dict:
+    out = {}
+    for key, value in (runtime_env or {}).items():
+        plugin = _PLUGINS.get(key)
+        if plugin is None:
+            raise ValueError(f"Unknown runtime_env field: {key!r} "
+                             f"(known: {sorted(_PLUGINS)})")
+        out[key] = plugin.validate(value)
+    return out
+
+
+def build_context(runtime_env: dict) -> RuntimeEnvContext:
+    ctx = RuntimeEnvContext()
+    env = validate_runtime_env(runtime_env)
+    for key in sorted(env, key=lambda k: _PLUGINS[k].priority):
+        _PLUGINS[key].create(env[key], ctx)
+    return ctx
+
+
+@contextlib.contextmanager
+def apply_context(ctx: RuntimeEnvContext):
+    """Apply env changes around a task (save/restore under a global lock —
+    runtime_env tasks are serialized in the thread runtime; see _APPLY_LOCK)."""
+    _APPLY_LOCK.acquire()
+    saved_env = {k: os.environ.get(k) for k in ctx.env_vars}
+    saved_path = list(sys.path)
+    saved_cwd = os.getcwd() if ctx.working_dir else None
+    try:
+        os.environ.update(ctx.env_vars)
+        for p in ctx.py_paths:
+            if p not in sys.path:
+                sys.path.insert(0, p)
+        if ctx.working_dir:
+            os.chdir(ctx.working_dir)
+        yield
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        sys.path[:] = saved_path
+        if saved_cwd:
+            os.chdir(saved_cwd)
+        _APPLY_LOCK.release()

@@ -1,0 +1,207 @@
+"""Autoscaler + dashboard + runtime_env tests (model: reference
+autoscaler/v2/tests with the fake provider, dashboard API tests)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    FakeNodeProvider,
+    NodeTypeConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _session(ray_start_regular):
+    yield
+
+
+NODE_TYPES = {
+    "cpu-small": {"resources": {"CPU": 4.0}},
+    "tpu-v5e": {"resources": {"CPU": 8.0, "TPU": 4.0}, "labels": {"accel": "v5e"}},
+}
+
+
+def make_autoscaler(idle_timeout=0.3, min_workers=0):
+    provider = FakeNodeProvider(NODE_TYPES)
+    cfg = AutoscalingConfig(
+        node_types=[
+            NodeTypeConfig("cpu-small", {"CPU": 4.0}, min_workers=min_workers, max_workers=5),
+            NodeTypeConfig("tpu-v5e", {"CPU": 8.0, "TPU": 4.0}, max_workers=2),
+        ],
+        idle_timeout_s=idle_timeout,
+    )
+    return Autoscaler(cfg, provider), provider
+
+
+def test_min_workers_floor():
+    scaler, provider = make_autoscaler(min_workers=2)
+    scaler.reconcile()
+    time.sleep(0.3)
+    running = [i for i in provider.non_terminated_instances()]
+    assert len([i for i in running if i.node_type == "cpu-small"]) == 2
+
+
+def test_scales_up_for_infeasible_demand():
+    scaler, provider = make_autoscaler()
+
+    @ray_tpu.remote(num_tpus=4)
+    def tpu_task():
+        return "on tpu node"
+
+    ref = tpu_task.remote()  # infeasible on the 8-CPU node
+    time.sleep(0.1)
+    scaler.reconcile()
+    time.sleep(0.4)  # fake boot
+    assert ray_tpu.get(ref, timeout=15) == "on tpu node"
+    types = [i.node_type for i in provider.non_terminated_instances()]
+    assert "tpu-v5e" in types
+
+
+def test_scales_up_for_pending_placement_group():
+    scaler, provider = make_autoscaler()
+    pg = ray_tpu.placement_group([{"TPU": 4}], strategy="PACK")
+    assert not pg.wait(0.2)
+    scaler.reconcile()
+    assert pg.wait(10)
+
+
+def test_idle_nodes_terminated():
+    scaler, provider = make_autoscaler(idle_timeout=0.2)
+    provider.launch("cpu-small", 1)
+    time.sleep(0.3)
+    assert len(provider.non_terminated_instances()) == 1
+    scaler.reconcile()  # records idle_since
+    time.sleep(0.3)
+    scaler.reconcile()  # terminates
+    assert len(provider.non_terminated_instances()) == 0
+
+
+def test_max_workers_cap():
+    scaler, provider = make_autoscaler()
+    refs = [ray_tpu.remote(num_tpus=4)(lambda: 1).remote() for _ in range(10)]
+    time.sleep(0.1)
+    for _ in range(6):
+        scaler.reconcile()
+    tpus = [i for i in provider.non_terminated_instances() if i.node_type == "tpu-v5e"]
+    assert len(tpus) <= 2
+    for r in refs:
+        ray_tpu.cancel(r)
+
+
+def test_dashboard_endpoints():
+    from ray_tpu.dashboard.head import Dashboard
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    @ray_tpu.remote
+    def visible_task():
+        return 1
+
+    ray_tpu.get(visible_task.remote())
+    dash = Dashboard(port=8267, job_client=JobSubmissionClient())
+    try:
+        def get(path):
+            with urllib.request.urlopen(f"http://127.0.0.1:8267{path}", timeout=10) as r:
+                return json.loads(r.read())
+
+        status = get("/api/cluster_status")
+        assert status["total_resources"]["CPU"] == 8.0
+        nodes = get("/api/v0/nodes")
+        assert nodes and nodes[0]["alive"]
+        tasks = get("/api/v0/tasks")
+        assert any(t["name"] == "visible_task" for t in tasks)
+        assert get("/api/v0/tasks/summarize")["by_state"]
+        assert get("/healthz") == {"status": "ok"}
+        assert get("/api/jobs") == []
+        # metrics endpoint is text
+        with urllib.request.urlopen("http://127.0.0.1:8267/metrics", timeout=10) as r:
+            assert r.status == 200
+        # 404 on unknown resource
+        try:
+            get("/api/v0/bogus")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        dash.stop()
+
+
+def test_runtime_env_env_vars_and_working_dir(tmp_path):
+    import sys
+
+    from ray_tpu import runtime_env as renv
+
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "marker.txt").write_text("present")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "42"}, "working_dir": str(wd)})
+    def uses_env():
+        return os.environ.get("MY_FLAG"), os.path.exists("marker.txt")
+
+    flag, marker = ray_tpu.get(uses_env.remote(), timeout=10)
+    assert flag == "42" and marker
+    # env restored after the task
+    assert "MY_FLAG" not in os.environ
+
+
+def test_runtime_env_validation():
+    from ray_tpu import runtime_env as renv
+
+    with pytest.raises(ValueError, match="Unknown runtime_env"):
+        renv.validate_runtime_env({"bogus_plugin": 1})
+    with pytest.raises(ValueError, match="env_vars"):
+        renv.validate_runtime_env({"env_vars": {"A": 1}})
+    with pytest.raises(RuntimeError, match="installer hook"):
+        renv.build_context({"pip": ["requests"]})
+
+
+def test_booting_nodes_absorb_demand():
+    """One pending task must not launch a node per tick while the first boots."""
+    provider = FakeNodeProvider(NODE_TYPES, launch_delay_s=0.5)
+    cfg = AutoscalingConfig(
+        node_types=[NodeTypeConfig("tpu-v5e", {"CPU": 8.0, "TPU": 4.0}, max_workers=5)],
+        idle_timeout_s=60,
+    )
+    scaler = Autoscaler(cfg, provider)
+
+    @ray_tpu.remote(num_tpus=4)
+    def t():
+        return 1
+
+    ref = t.remote()
+    time.sleep(0.1)
+    for _ in range(4):  # several ticks while the node boots
+        scaler.reconcile()
+        time.sleep(0.05)
+    assert scaler.launch_count == 1
+    ray_tpu.get(ref, timeout=15)
+
+
+def test_runtime_env_on_actor_and_generator(tmp_path):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "on"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_FLAG")
+
+        def stream(self, n):
+            for _ in range(n):
+                yield os.environ.get("ACTOR_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=10) == "on"
+    assert "ACTOR_FLAG" not in os.environ
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"GEN_FLAG": "yes"}}, num_returns="streaming")
+    def gen(n):
+        for _ in range(n):
+            yield os.environ.get("GEN_FLAG")
+
+    vals = [ray_tpu.get(r) for r in gen.remote(2)]
+    assert vals == ["yes", "yes"]
