@@ -81,23 +81,36 @@ class FakeNodeProvider(NodeProvider):
     def _boot(self, inst: Instance) -> None:
         if self.launch_delay_s:
             time.sleep(self.launch_delay_s)
+        with self._lock:
+            if inst.status == InstanceStatus.TERMINATED:
+                return  # terminated while booting: never join the cluster
         resources = dict(self.node_type_resources[inst.node_type].get("resources", {}))
         labels = dict(self.node_type_resources[inst.node_type].get("labels", {}))
         node_id = self._rt().scheduler.add_node(resources, labels=labels)
-        self._rt().scheduler.retry_pending_pgs()
+        ghost = False
         with self._lock:
-            inst.node_id_hex = node_id.hex()
-            inst.status = InstanceStatus.RUNNING
+            if inst.status == InstanceStatus.TERMINATED:
+                ghost = True  # raced with terminate during add_node
+            else:
+                inst.node_id_hex = node_id.hex()
+                inst.status = InstanceStatus.RUNNING
+        if ghost:
+            self._rt().scheduler.remove_node(node_id)
+        else:
+            self._rt().scheduler.retry_pending_pgs()
 
     def terminate(self, instance_ids: list[str]) -> None:
         from ray_tpu._private.ids import NodeID
 
         with self._lock:
             insts = [self._instances[i] for i in instance_ids if i in self._instances]
-        for inst in insts:
-            inst.status = InstanceStatus.TERMINATED
-            if inst.node_id_hex:
-                self._rt().scheduler.remove_node(NodeID.from_hex(inst.node_id_hex))
+            node_hexes = []
+            for inst in insts:
+                inst.status = InstanceStatus.TERMINATED
+                if inst.node_id_hex:
+                    node_hexes.append(inst.node_id_hex)
+        for h in node_hexes:
+            self._rt().scheduler.remove_node(NodeID.from_hex(h))
 
     def non_terminated_instances(self) -> list[Instance]:
         with self._lock:
@@ -118,6 +131,8 @@ class TPUVMNodeProvider(NodeProvider):
         self.project = project
         self.zone = zone
         self.runner = runner
+        self._instances: dict[str, Instance] = {}
+        self._lock = threading.Lock()
 
     def launch(self, node_type: str, count: int) -> list[Instance]:
         if self.runner is None:
@@ -133,7 +148,10 @@ class TPUVMNodeProvider(NodeProvider):
                  f"--zone={self.zone}", f"--accelerator-type={node_type}",
                  f"--project={self.project}"]
             )
-            out.append(Instance(name, node_type, InstanceStatus.REQUESTED))
+            inst = Instance(name, node_type, InstanceStatus.REQUESTED)
+            with self._lock:
+                self._instances[name] = inst
+            out.append(inst)
         return out
 
     def terminate(self, instance_ids: list[str]) -> None:
@@ -142,6 +160,14 @@ class TPUVMNodeProvider(NodeProvider):
         for name in instance_ids:
             self.runner(["gcloud", "compute", "tpus", "tpu-vm", "delete", name,
                          f"--zone={self.zone}", "--quiet"])
+            with self._lock:
+                if name in self._instances:
+                    self._instances[name].status = InstanceStatus.TERMINATED
 
     def non_terminated_instances(self) -> list[Instance]:
-        return []
+        # in-process view of what we launched (authoritative listing would page
+        # `gcloud ... tpus list` through the runner); without it the autoscaler
+        # must still see its own launches or min_workers would relaunch forever
+        with self._lock:
+            return [i for i in self._instances.values()
+                    if i.status != InstanceStatus.TERMINATED]

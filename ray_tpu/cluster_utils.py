@@ -1,0 +1,55 @@
+"""Cluster test utility: build multi-node sessions programmatically.
+
+Parity: python/ray/cluster_utils.py (Cluster :141, add_node :208) — the
+reference's single most load-bearing test asset (SURVEY §4): simulate
+multi-node scheduling/FT behavior without real machines. Here nodes are
+logical scheduler nodes (the single-controller analog of extra raylets).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID
+from ray_tpu.core.runtime import get_runtime
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None):
+        self._node_ids: list[NodeID] = []
+        if initialize_head:
+            args = dict(head_node_args or {})
+            if not ray_tpu.is_initialized():
+                ray_tpu.init(num_cpus=args.get("num_cpus", 4),
+                             resources=args.get("resources"),
+                             ignore_reinit_error=True)
+            self._node_ids = [n.node_id for n in get_runtime().scheduler.nodes()]
+
+    def add_node(self, *, num_cpus: float = 4, num_tpus: float = 0,
+                 resources: dict | None = None, labels: dict | None = None,
+                 slice_name: str | None = None,
+                 ici_coords: tuple | None = None) -> NodeID:
+        """Reference: cluster_utils.py:208 add_node."""
+        res = {"CPU": float(num_cpus), **(resources or {})}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        nid = get_runtime().scheduler.add_node(
+            res, labels=labels, slice_name=slice_name, ici_coords=ici_coords
+        )
+        get_runtime().scheduler.retry_pending_pgs()
+        self._node_ids.append(nid)
+        return nid
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Node death: resources vanish; queued work reschedules elsewhere."""
+        get_runtime().scheduler.remove_node(node_id)
+        if node_id in self._node_ids:
+            self._node_ids.remove(node_id)
+
+    @property
+    def node_ids(self) -> list[NodeID]:
+        return list(self._node_ids)
+
+    def shutdown(self) -> None:
+        ray_tpu.shutdown()

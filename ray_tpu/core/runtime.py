@@ -477,13 +477,38 @@ class Runtime:
                     [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
                 )
 
+    def _maybe_inject_chaos(self, spec: TaskSpec) -> None:
+        """Config-driven fault injection (reference: src/ray/rpc/rpc_chaos.cc,
+        RAY_testing_rpc_failure 'method=N' comma list): inject up to N synthetic
+        system failures for tasks whose name matches — exercises retry/FT paths
+        without special builds."""
+        conf = self.config.testing_rpc_failure
+        if not conf:
+            return
+        with self._lock:
+            budget = getattr(self, "_chaos_budget", None)
+            if budget is None:
+                budget = self._chaos_budget = {}
+                for part in conf.split(","):
+                    name, _, n = part.partition("=")
+                    budget[name.strip()] = int(n or 1)
+            remaining = budget.get(spec.desc(), 0)
+            if remaining > 0:
+                budget[spec.desc()] = remaining - 1
+                raise ActorError(f"injected chaos failure for {spec.desc()!r}")
+
     def _run_user_fn(self, entry: _TaskEntry, fn, args, kwargs):
         if entry.cancelled:
             raise TaskCancelledError(entry.spec.desc())
+        self._maybe_inject_chaos(entry.spec)
         if entry.spec.runtime_env:
             from ray_tpu import runtime_env as renv
 
-            ctx = renv.build_context(entry.spec.runtime_env)
+            # cache the built context on the spec: retries (and the working_dir
+            # content hash inside build_context) don't re-pay per attempt
+            ctx = getattr(entry.spec, "_renv_ctx", None)
+            if ctx is None:
+                ctx = entry.spec._renv_ctx = renv.build_context(entry.spec.runtime_env)
             with renv.apply_context(ctx):
                 return fn(*args, **kwargs)
         return fn(*args, **kwargs)
@@ -728,19 +753,36 @@ class Runtime:
                 args, kwargs = self._resolve_args(spec)
                 method = getattr(state.instance, spec.method_name)
                 renv_ctx = self._runtime_env_ctx(state)
+                is_coro = inspect.iscoroutinefunction(method)
+                is_gen = isinstance(spec.num_returns, str)
                 if renv_ctx is not None:
+                    # the context must be LIVE while the body runs — enter it
+                    # inside the coroutine/generator, not around their creation
+                    from ray_tpu import runtime_env as renv
+
                     orig_method = method
+                    if is_coro:
 
-                    def method(*a, _m=orig_method, _c=renv_ctx, **kw):
-                        from ray_tpu import runtime_env as renv
+                        async def method(*a, _m=orig_method, _c=renv_ctx, **kw):
+                            with renv.apply_context(_c):
+                                return await _m(*a, **kw)
 
-                        with renv.apply_context(_c):
-                            return _m(*a, **kw)
+                    elif is_gen:
 
-                if inspect.iscoroutinefunction(getattr(state.instance, spec.method_name)):
+                        def method(*a, _m=orig_method, _c=renv_ctx, **kw):
+                            with renv.apply_context(_c):
+                                yield from _m(*a, **kw)
+
+                    else:
+
+                        def method(*a, _m=orig_method, _c=renv_ctx, **kw):
+                            with renv.apply_context(_c):
+                                return _m(*a, **kw)
+
+                if is_coro:
                     fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), state.loop)
                     result = fut.result()
-                elif isinstance(spec.num_returns, str):
+                elif is_gen:
                     self._execute_actor_generator(spec, method, args, kwargs)
                     result = _NO_STORE
                 else:

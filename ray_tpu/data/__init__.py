@@ -22,7 +22,10 @@ from ray_tpu.data.read_api import (
     read_text,
 )
 
+from ray_tpu.data import llm  # noqa: F401  (ray.data.llm parity surface)
+
 __all__ = [
+    "llm",
     "Block", "Dataset", "DataIterator",
     "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "from_huggingface", "read_parquet", "read_csv", "read_json", "read_text",

@@ -1,0 +1,83 @@
+"""Batch LLM inference over Datasets.
+
+Parity: python/ray/data/llm.py (ProcessorConfig :26, build_llm_processor :104)
+and the staged batch pipeline in ray.llm _internal/batch/stages/
+(chat_template → tokenize → engine → detokenize). The engine stage runs the
+same continuous-batching LLMEngine the serve path uses — one engine per
+processor, shared across blocks, so the MXU sees full decode batches even when
+dataset blocks are small.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.serve.llm import LLMConfig, LLMEngine
+
+
+@dataclasses.dataclass
+class ProcessorConfig:
+    """Reference: data/llm.py ProcessorConfig / vLLMEngineProcessorConfig."""
+
+    llm_config: LLMConfig = dataclasses.field(default_factory=LLMConfig)
+    prompt_column: str = "prompt_ids"
+    output_column: str = "generated_ids"
+    max_new_tokens: int | None = None
+    tokenizer: Callable[[str], list[int]] | None = None
+    detokenizer: Callable[[list[int]], str] | None = None
+    batch_size: int = 16
+
+
+class Processor:
+    """Dataset -> Dataset map with a shared generation engine."""
+
+    def __init__(self, config: ProcessorConfig, engine: LLMEngine | None = None):
+        self.config = config
+        self._engine = engine
+
+    def _get_engine(self) -> LLMEngine:
+        if self._engine is None:
+            self._engine = LLMEngine(self.config.llm_config)
+        return self._engine
+
+    def __call__(self, dataset: Dataset) -> Dataset:
+        cfg = self.config
+
+        def generate_batch(batch: dict) -> dict:
+            engine = self._get_engine()
+            prompts = batch[cfg.prompt_column]
+            token_lists = []
+            for p in prompts:
+                if cfg.tokenizer is not None and isinstance(p, str):
+                    token_lists.append(list(cfg.tokenizer(p)))
+                else:
+                    token_lists.append([int(t) for t in np.asarray(p).tolist()])
+            # overlap: submit everything, let continuous batching fill slots
+            futs = [engine.generate(toks, cfg.max_new_tokens) for toks in token_lists]
+            results = [f.result(600) for f in futs]
+            out = dict(batch)
+            generated = [r.token_ids for r in results]
+            if cfg.detokenizer is not None:
+                out[cfg.output_column.replace("_ids", "_text")] = np.asarray(
+                    [cfg.detokenizer(g) for g in generated], dtype=object
+                )
+            out[cfg.output_column] = np.asarray(generated, dtype=object)
+            out["num_generated"] = np.asarray([r.num_generated for r in results])
+            return out
+
+        # num_cpus=0: the stage blocks on the engine, not a CPU slot — keeps the
+        # streaming executor from serializing engine-bound blocks behind CPU caps
+        return dataset.map_batches(generate_batch, batch_size=cfg.batch_size, num_cpus=0)
+
+    def shutdown(self) -> None:
+        if self._engine is not None:
+            self._engine.shutdown()
+
+
+def build_llm_processor(config: ProcessorConfig) -> Processor:
+    """Reference: data/llm.py:104 build_llm_processor."""
+    return Processor(config)

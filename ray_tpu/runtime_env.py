@@ -180,37 +180,64 @@ def validate_runtime_env(runtime_env: dict) -> dict:
     return out
 
 
+_CTX_CACHE: dict[str, RuntimeEnvContext] = {}
+_CTX_CACHE_LOCK = threading.Lock()
+
+
 def build_context(runtime_env: dict) -> RuntimeEnvContext:
+    """Build (memoized) — identical runtime_env dicts share one context, so the
+    working_dir content hash/copy is paid once per env, not once per task
+    (reference: URI-keyed caching in runtime_env/packaging.py)."""
+    import json
+
+    try:
+        key = json.dumps(runtime_env, sort_keys=True, default=repr)
+    except TypeError:
+        key = None
+    if key is not None:
+        with _CTX_CACHE_LOCK:
+            cached = _CTX_CACHE.get(key)
+        if cached is not None:
+            return cached
     ctx = RuntimeEnvContext()
     env = validate_runtime_env(runtime_env)
-    for key in sorted(env, key=lambda k: _PLUGINS[k].priority):
-        _PLUGINS[key].create(env[key], ctx)
+    for k in sorted(env, key=lambda k: _PLUGINS[k].priority):
+        _PLUGINS[k].create(env[k], ctx)
+    if key is not None:
+        with _CTX_CACHE_LOCK:
+            _CTX_CACHE[key] = ctx
     return ctx
 
 
 @contextlib.contextmanager
 def apply_context(ctx: RuntimeEnvContext):
-    """Apply env changes around a task (save/restore under a global lock —
-    runtime_env tasks are serialized in the thread runtime; see _APPLY_LOCK)."""
-    _APPLY_LOCK.acquire()
-    saved_env = {k: os.environ.get(k) for k in ctx.env_vars}
-    saved_path = list(sys.path)
-    saved_cwd = os.getcwd() if ctx.working_dir else None
-    try:
+    """Apply env changes around a task.
+
+    The lock guards only the mutate/restore windows, NOT user code — holding it
+    across execution deadlocks any runtime_env task that waits on another
+    runtime_env task (both run as threads of this process). Consequence of the
+    thread runtime: two concurrently running runtime_env tasks can observe each
+    other's env between windows; true isolation is one worker process per env
+    (the reference's model, and this framework's multi-process backend)."""
+    with _APPLY_LOCK:
+        saved_env = {k: os.environ.get(k) for k in ctx.env_vars}
+        saved_path = list(sys.path)
+        saved_cwd = os.getcwd() if ctx.working_dir else None
         os.environ.update(ctx.env_vars)
         for p in ctx.py_paths:
             if p not in sys.path:
                 sys.path.insert(0, p)
         if ctx.working_dir:
             os.chdir(ctx.working_dir)
+    try:
         yield
     finally:
-        for k, v in saved_env.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
-        sys.path[:] = saved_path
-        if saved_cwd:
-            os.chdir(saved_cwd)
-        _APPLY_LOCK.release()
+        with _APPLY_LOCK:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            sys.path[:] = saved_path
+            if saved_cwd:
+                os.chdir(saved_cwd)

@@ -205,3 +205,53 @@ def test_runtime_env_on_actor_and_generator(tmp_path):
 
     vals = [ray_tpu.get(r) for r in gen.remote(2)]
     assert vals == ["yes", "yes"]
+
+
+def test_nested_runtime_env_tasks_no_deadlock():
+    @ray_tpu.remote(runtime_env={"env_vars": {"INNER": "1"}})
+    def inner():
+        return os.environ.get("INNER")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"OUTER": "1"}})
+    def outer():
+        return ray_tpu.get(inner.remote(), timeout=10)
+
+    assert ray_tpu.get(outer.remote(), timeout=15) == "1"
+
+
+def test_runtime_env_async_actor_method():
+    @ray_tpu.remote(runtime_env={"env_vars": {"ASYNC_FLAG": "live"}})
+    class A:
+        async def read(self):
+            return os.environ.get("ASYNC_FLAG")
+
+        def stream(self, n):
+            for _ in range(n):
+                yield os.environ.get("ASYNC_FLAG")
+
+    a = A.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=10) == "live"
+    gen = a.stream.options(num_returns="streaming").remote(2)
+    assert [ray_tpu.get(r) for r in gen] == ["live", "live"]
+
+
+def test_fake_provider_terminate_during_boot():
+    provider = FakeNodeProvider(NODE_TYPES, launch_delay_s=0.4)
+    before = len(ray_tpu.nodes())
+    insts = provider.launch("cpu-small", 1)
+    provider.terminate([insts[0].instance_id])
+    time.sleep(0.7)
+    assert provider.non_terminated_instances() == []
+    assert len(ray_tpu.nodes()) == before or not ray_tpu.nodes()[-1]["Alive"]
+
+
+def test_tpu_vm_provider_tracks_instances():
+    from ray_tpu.autoscaler import TPUVMNodeProvider
+
+    calls = []
+    p = TPUVMNodeProvider("proj", "us-central2-b", runner=calls.append)
+    insts = p.launch("v5p-8", 2)
+    assert len(p.non_terminated_instances()) == 2
+    p.terminate([insts[0].instance_id])
+    assert len(p.non_terminated_instances()) == 1
+    assert len(calls) == 3  # 2 creates + 1 delete

@@ -186,3 +186,25 @@ def test_shuffle_changes_block_order():
     ids = [int(r["id"]) for r in rdata.range(1000, parallelism=10).random_shuffle(seed=1).take(100)]
     assert ids != list(range(100))  # head isn't the first source block
     assert sorted(set(ids)) != list(range(100))  # rows mixed across blocks
+
+
+def test_batch_llm_processor():
+    """ray.data.llm parity: batched generation over a dataset (data/llm.py)."""
+    import numpy as np
+
+    from ray_tpu.data.llm import ProcessorConfig, build_llm_processor
+    from ray_tpu.serve.llm import LLMConfig
+
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+    ds = rdata.from_items([{"prompt_ids": np.asarray(p)} for p in prompts])
+    proc = build_llm_processor(ProcessorConfig(
+        llm_config=LLMConfig(max_batch_size=4, max_seq_len=64),
+        max_new_tokens=5,
+    ))
+    try:
+        rows = proc(ds).take_all()
+        assert len(rows) == 3
+        assert all(len(r["generated_ids"]) == 5 for r in rows)
+        assert all(int(r["num_generated"]) == 5 for r in rows)
+    finally:
+        proc.shutdown()
