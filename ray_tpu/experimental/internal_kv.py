@@ -1,0 +1,73 @@
+"""Internal KV: namespaced key-value store on the control plane.
+
+Parity: python/ray/experimental/internal_kv.py (+ gcs_kv_manager.cc backing):
+_internal_kv_get/put/del/exists/keys with namespaces. Backs function/config
+storage the way the reference's GCS KV backs runtime-env packages and cluster
+config.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_store: dict[tuple[bytes, bytes], bytes] = {}
+_lock = threading.Lock()
+
+
+_NO_NAMESPACE = b"\x00__none__"  # distinct from any user namespace (incl. "default")
+
+
+def _key(key: bytes | str, namespace: bytes | str | None) -> tuple[bytes, bytes]:
+    if not isinstance(key, (str, bytes)):
+        raise TypeError(f"key must be str or bytes, got {type(key)}")
+    k = key.encode() if isinstance(key, str) else key
+    ns = _NO_NAMESPACE if namespace is None else namespace
+    ns = ns.encode() if isinstance(ns, str) else ns
+    return (ns, k)
+
+
+def _internal_kv_put(key, value, overwrite: bool = True, namespace=None) -> bool:
+    """Returns True if the key already existed (reference semantics)."""
+    if not isinstance(value, (str, bytes)):
+        raise TypeError(f"value must be str or bytes, got {type(value)}")
+    v = value.encode() if isinstance(value, str) else value
+    with _lock:
+        fk = _key(key, namespace)
+        existed = fk in _store
+        if existed and not overwrite:
+            return True
+        _store[fk] = v
+        return existed
+
+
+def _internal_kv_get(key, namespace=None) -> Optional[bytes]:
+    with _lock:
+        return _store.get(_key(key, namespace))
+
+
+def _internal_kv_exists(key, namespace=None) -> bool:
+    with _lock:
+        return _key(key, namespace) in _store
+
+
+def _internal_kv_del(key, del_by_prefix: bool = False, namespace=None) -> int:
+    with _lock:
+        if del_by_prefix:
+            ns, p = _key(key, namespace)
+            victims = [fk for fk in _store if fk[0] == ns and fk[1].startswith(p)]
+            for fk in victims:
+                del _store[fk]
+            return len(victims)
+        return 1 if _store.pop(_key(key, namespace), None) is not None else 0
+
+
+def _internal_kv_list(prefix, namespace=None) -> list[bytes]:
+    ns, p = _key(prefix, namespace)
+    with _lock:
+        return [k for (n, k) in _store if n == ns and k.startswith(p)]
+
+
+def _internal_kv_reset() -> None:
+    with _lock:
+        _store.clear()

@@ -149,3 +149,38 @@ def test_cli_status_and_list(capsys):
     assert "nodes:" in out and "CPU" in out
     assert main(["list", "nodes"]) == 0
     assert main(["summary", "tasks"]) == 0
+
+
+def test_internal_kv():
+    from ray_tpu.experimental import internal_kv as kv
+
+    kv._internal_kv_reset()
+    assert kv._internal_kv_put("k1", b"v1") is False
+    assert kv._internal_kv_put("k1", b"v2", overwrite=False) is True
+    assert kv._internal_kv_get("k1") == b"v1"
+    assert kv._internal_kv_put("k1", b"v3") is True
+    assert kv._internal_kv_get("k1") == b"v3"
+    kv._internal_kv_put("k2", b"x", namespace="other")
+    assert kv._internal_kv_get("k2") is None  # namespaced
+    assert kv._internal_kv_get("k2", namespace="other") == b"x"
+    assert sorted(kv._internal_kv_list("k")) == [b"k1"]
+    assert kv._internal_kv_del("k1") == 1
+    assert not kv._internal_kv_exists("k1")
+
+
+def test_internal_kv_prefix_delete_and_contracts():
+    from ray_tpu.experimental import internal_kv as kv
+
+    kv._internal_kv_reset()
+    kv._internal_kv_put("job:1", b"a")
+    kv._internal_kv_put("job:2", b"b")
+    kv._internal_kv_put("other", b"c")
+    assert kv._internal_kv_del("job:", del_by_prefix=True) == 2
+    assert kv._internal_kv_exists("other")
+    # "default" namespace is distinct from no-namespace
+    kv._internal_kv_put("k", b"none-ns")
+    kv._internal_kv_put("k", b"default-ns", namespace="default")
+    assert kv._internal_kv_get("k") == b"none-ns"
+    assert kv._internal_kv_get("k", namespace="default") == b"default-ns"
+    with pytest.raises(TypeError):
+        kv._internal_kv_put("k", 5)
