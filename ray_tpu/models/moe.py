@@ -1,0 +1,168 @@
+"""Mixture-of-Experts transformer with native expert parallelism.
+
+BASELINE.json config #3 (Mixtral 8x7B) — where the reference places vLLM
+actors in PGs and delegates EP to the engine (SURVEY §2.5 marks EP as
+pass-through), this implements expert parallelism natively: experts are
+sharded over the `expert` mesh axis; tokens are routed with a capacity-
+bounded top-k dispatch expressed as dense einsums (MXU-friendly, no dynamic
+shapes) so XLA lowers the shuffle to all_to_all/psum over ICI.
+
+Design: Llama backbone (models.llama ops) with the MLP replaced by a
+switch-style top-k MoE layer in every block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    base: llama.LlamaConfig = dataclasses.field(default_factory=llama.LlamaConfig.tiny)
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coeff: float = 0.01
+
+    @staticmethod
+    def tiny() -> "MoEConfig":
+        return MoEConfig(base=llama.LlamaConfig.tiny(), num_experts=4, top_k=2)
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoEConfig":
+        return MoEConfig(
+            base=llama.LlamaConfig(
+                vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                rope_theta=1e6,
+            ),
+            num_experts=8, top_k=2,
+        )
+
+
+def logical_axes(cfg: MoEConfig) -> dict:
+    """Param sharding tree: experts lead with the `expert` axis."""
+    ax = llama.logical_axes(cfg.base)
+    ax["layers"] = dict(ax["layers"])
+    ax["layers"].update({
+        "router": (None, None, None),
+        "e_gate": (None, "expert", "embed_fsdp", "mlp"),
+        "e_up": (None, "expert", "embed_fsdp", "mlp"),
+        "e_down": (None, "expert", "mlp", "embed_fsdp"),
+    })
+    # every block is MoE: the dense MLP weights are replaced by experts
+    for dense_key in ("w_gate", "w_up", "w_down"):
+        ax["layers"].pop(dense_key, None)
+    return ax
+
+
+def init(cfg: MoEConfig, key: jax.Array) -> dict:
+    base = cfg.base
+    params = llama.init(base, key)
+    h, m, L, E = base.hidden_size, base.intermediate_size, base.num_layers, cfg.num_experts
+    ks = jax.random.split(jax.random.fold_in(key, 7), 4)
+
+    def dense(k, fan_in, *shape):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) / math.sqrt(fan_in)).astype(base.dtype)
+
+    params["layers"]["router"] = dense(ks[0], h, L, h, E)
+    params["layers"]["e_gate"] = dense(ks[1], h, L, E, h, m)
+    params["layers"]["e_up"] = dense(ks[2], h, L, E, h, m)
+    params["layers"]["e_down"] = dense(ks[3], m, L, E, m, h)
+    # experts replace the dense MLP — drop the unused llama weights (for
+    # mixtral-8x7b they would be ~5.6B dead params of HBM)
+    for dense_key in ("w_gate", "w_up", "w_down"):
+        params["layers"].pop(dense_key, None)
+    return params
+
+
+def moe_mlp(x, router_w, e_gate, e_up, e_down, cfg: MoEConfig):
+    """Capacity-bounded top-k MoE layer; x: [B, S, H] -> ([B, S, H], aux_loss).
+
+    Dense dispatch/combine einsums over a capacity buffer [E, C]: static shapes,
+    MXU-shaped contractions; with experts sharded over the `expert` axis XLA
+    inserts the token all_to_all automatically.
+    """
+    B, S, H = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    T = B * S
+    C = max(1, int(cfg.capacity_factor * k * T / E))
+    xt = x.reshape(T, H)
+    logits = (xt @ router_w).astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    # top-k expert choice per token
+    topk_p, topk_e = jax.lax.top_k(probs, k)  # [T, k]
+    # position of each (token, choice) in its expert's capacity buffer
+    onehot = jax.nn.one_hot(topk_e, E, dtype=jnp.int32)  # [T, k, E]
+    flat = onehot.reshape(T * k, E)
+    pos_in_expert = jnp.cumsum(flat, axis=0) * flat - 1  # [T*k, E]
+    pos = pos_in_expert.reshape(T, k, E)
+    within_cap = (pos >= 0) & (pos < C)
+    # dispatch tensor [T, E, C]
+    pos_clamped = jnp.clip(pos, 0, C - 1)
+    disp = (jax.nn.one_hot(pos_clamped, C, dtype=xt.dtype)
+            * within_cap[..., None].astype(xt.dtype)
+            * onehot[..., None].astype(xt.dtype))  # [T, k, E, C]
+    dispatch = disp.sum(axis=1)  # [T, E, C]
+    combine = (disp * topk_p[:, :, None, None].astype(xt.dtype)).sum(axis=1)  # [T, E, C]
+    # route tokens to expert buffers: [E, C, H]
+    expert_in = jnp.einsum("tec,th->ech", dispatch, xt)
+    # expert MLPs (batched over E — shardable on the expert axis)
+    gate = jax.nn.silu(jnp.einsum("ech,ehm->ecm", expert_in, e_gate))
+    up = jnp.einsum("ech,ehm->ecm", expert_in, e_up)
+    expert_out = jnp.einsum("ecm,emh->ech", gate * up, e_down)
+    out = jnp.einsum("tec,ech->th", combine, expert_out)
+    # load-balancing aux loss (switch-transformer style)
+    density = flat.reshape(T, k, E).sum(axis=1).astype(jnp.float32).mean(axis=0)  # [E]
+    router_mean = probs.mean(axis=0)
+    aux = (density * router_mean).sum() * (E ** 2) / k
+    return out.reshape(B, S, H), aux
+
+
+def forward(params, tokens, cfg: MoEConfig, positions=None):
+    """Token ids [B,S] -> (logits [B,S,V], total aux loss)."""
+    base = cfg.base
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = params["embed"][tokens].astype(base.dtype)
+    hd, nh, nkv = base.hd, base.num_heads, base.num_kv_heads
+
+    def body(carry, layer):
+        x, aux_total = carry
+        y = llama.rms_norm(x, layer["attn_norm"], base.rms_eps)
+        q = (y @ layer["wq"]).reshape(B, S, nh, hd)
+        kk = (y @ layer["wk"]).reshape(B, S, nkv, hd)
+        v = (y @ layer["wv"]).reshape(B, S, nkv, hd)
+        q = llama.rope(q, positions, base.rope_theta)
+        kk = llama.rope(kk, positions, base.rope_theta)
+        o = llama.attention(q, kk, v, causal=True)
+        x = x + (o.reshape(B, S, nh * hd) @ layer["wo"])
+        y = llama.rms_norm(x, layer["mlp_norm"], base.rms_eps)
+        mlp_out, aux = moe_mlp(y, layer["router"], layer["e_gate"], layer["e_up"],
+                               layer["e_down"], cfg)
+        return (x + mlp_out, aux_total + aux), None
+
+    if base.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    (x, aux_total), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+    x = llama.rms_norm(x, params["final_norm"], base.rms_eps)
+    head = params["embed"].T if base.tie_embeddings else params["lm_head"]
+    return (x @ head.astype(base.dtype)).astype(jnp.float32), aux_total
+
+
+def loss_fn(params, tokens, targets, cfg: MoEConfig):
+    logits, aux = forward(params, tokens, cfg)
+    valid = targets != -100
+    tsafe = jnp.where(valid, targets, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tsafe[..., None], axis=-1)[..., 0]
+    nll = ((logz - gold) * valid).sum() / jnp.maximum(valid.sum(), 1)
+    return nll + cfg.router_aux_coeff * aux

@@ -1,0 +1,153 @@
+"""Vision Transformer (ViT) family.
+
+BASELINE.json config #4 (ViT-L / CLIP with image streaming → TPU HBM).
+Patchify is a single conv-as-reshape matmul (MXU); blocks are pre-LN
+non-causal attention + GELU MLP; lax.scan over layers; bf16 with fp32 norms.
+Same functional parameter-pytree pattern as models.llama so the sharding rule
+table applies unchanged (heads/mlp over tensor, batch over data/fsdp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    ln_eps: float = 1e-6
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def hd(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def tiny() -> "ViTConfig":
+        return ViTConfig(image_size=32, patch_size=8, hidden_size=64,
+                         intermediate_size=128, num_layers=2, num_heads=4,
+                         num_classes=10, dtype=jnp.float32, remat=False)
+
+    @staticmethod
+    def vit_b16() -> "ViTConfig":
+        return ViTConfig(hidden_size=768, intermediate_size=3072, num_layers=12,
+                         num_heads=12)
+
+    @staticmethod
+    def vit_l16() -> "ViTConfig":
+        return ViTConfig()  # defaults are ViT-L/16
+
+
+def logical_axes(cfg: ViTConfig) -> dict:
+    block = {
+        "ln1_scale": (None, None), "ln1_bias": (None, None),
+        "wq": (None, "embed_fsdp", "heads"), "wk": (None, "embed_fsdp", "heads"),
+        "wv": (None, "embed_fsdp", "heads"), "wo": (None, "heads", "embed_fsdp"),
+        "ln2_scale": (None, None), "ln2_bias": (None, None),
+        "w1": (None, "embed_fsdp", "mlp"), "b1": (None, "mlp"),
+        "w2": (None, "mlp", "embed_fsdp"), "b2": (None, None),
+    }
+    return {
+        "patch_embed": (None, "embed_fsdp"),
+        "pos_embed": (None, None),
+        "cls_token": (None,),
+        "layers": block,
+        "final_ln_scale": (None,), "final_ln_bias": (None,),
+        "head": ("embed_fsdp", None),
+    }
+
+
+def init(cfg: ViTConfig, key: jax.Array) -> dict:
+    h, m, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    patch_dim = 3 * cfg.patch_size ** 2
+    ks = jax.random.split(key, 10)
+
+    def dense(k, fan_in, *shape):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+
+    layers = {
+        "ln1_scale": jnp.ones((L, h), jnp.float32), "ln1_bias": jnp.zeros((L, h), jnp.float32),
+        "wq": dense(ks[0], h, L, h, h), "wk": dense(ks[1], h, L, h, h),
+        "wv": dense(ks[2], h, L, h, h), "wo": dense(ks[3], h, L, h, h),
+        "ln2_scale": jnp.ones((L, h), jnp.float32), "ln2_bias": jnp.zeros((L, h), jnp.float32),
+        "w1": dense(ks[4], h, L, h, m), "b1": jnp.zeros((L, m), cfg.dtype),
+        "w2": dense(ks[5], m, L, m, h), "b2": jnp.zeros((L, h), cfg.dtype),
+    }
+    return {
+        "patch_embed": dense(ks[6], patch_dim, patch_dim, h),
+        "pos_embed": (jax.random.normal(ks[7], (cfg.num_patches + 1, h)) * 0.02).astype(cfg.dtype),
+        "cls_token": jnp.zeros((h,), cfg.dtype),
+        "layers": layers,
+        "final_ln_scale": jnp.ones((h,), jnp.float32),
+        "final_ln_bias": jnp.zeros((h,), jnp.float32),
+        "head": dense(ks[8], h, h, cfg.num_classes),
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def patchify(images, patch_size: int):
+    """[B, H, W, 3] -> [B, N, patch_dim] (pure reshape/transpose — no conv op)."""
+    B, H, W, C = images.shape
+    ph = pw = patch_size
+    x = images.reshape(B, H // ph, ph, W // pw, pw, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, (H // ph) * (W // pw), ph * pw * C)
+
+
+def forward(params, images, cfg: ViTConfig):
+    """images [B, H, W, 3] float -> logits [B, num_classes] (fp32)."""
+    B = images.shape[0]
+    patches = patchify(images.astype(cfg.dtype), cfg.patch_size)
+    x = patches @ params["patch_embed"]
+    cls = jnp.broadcast_to(params["cls_token"], (B, 1, cfg.hidden_size))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+    nh, hd = cfg.num_heads, cfg.hd
+
+    def body(x, layer):
+        S = x.shape[1]
+        y = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], cfg.ln_eps)
+        q = (y @ layer["wq"]).reshape(B, S, nh, hd)
+        k = (y @ layer["wk"]).reshape(B, S, nh, hd)
+        v = (y @ layer["wv"]).reshape(B, S, nh, hd)
+        o = attention(q, k, v, causal=False)
+        x = x + (o.reshape(B, S, nh * hd) @ layer["wo"])
+        y = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], cfg.ln_eps)
+        x = x + (jax.nn.gelu(y @ layer["w1"] + layer["b1"]) @ layer["w2"] + layer["b2"])
+        return x, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"], cfg.ln_eps)
+    return (x[:, 0] @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, images, labels, cfg: ViTConfig):
+    logits = forward(params, images, cfg)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return (logz - gold).mean()
