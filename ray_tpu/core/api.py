@@ -142,6 +142,7 @@ _DEFAULT_TASK_OPTIONS = dict(
     name=None,
     scheduling_strategy=None,
     runtime_env=None,
+    isolate_process=False,  # run in an OS worker process (crash FT, no GIL)
 )
 
 _DEFAULT_ACTOR_OPTIONS = dict(
@@ -257,6 +258,7 @@ class RemoteFunction:
             retry_exceptions=opts["retry_exceptions"],
             name=opts["name"] or self._fn.__name__,
             runtime_env=opts["runtime_env"],
+            isolate_process=bool(opts.get("isolate_process")),
             **spec_kwargs,
         )
         refs = rt.submit_task(spec)

@@ -90,6 +90,7 @@ class TaskSpec:
     method_name: str = ""
     is_actor_creation: bool = False
     runtime_env: dict | None = None
+    isolate_process: bool = False
 
     def return_ids(self) -> list[ObjectID]:
         n = 1 if isinstance(self.num_returns, str) else self.num_returns
@@ -455,6 +456,8 @@ class Runtime:
                 return  # actor holds its lease until death
             if isinstance(spec.num_returns, str):
                 self._execute_generator(entry, args, kwargs)
+            elif spec.isolate_process:
+                self._execute_in_process(entry, args, kwargs)
             else:
                 result = self._run_user_fn(entry, spec.func, args, kwargs)
                 self._store_returns(spec, result)
@@ -496,6 +499,56 @@ class Runtime:
             if remaining > 0:
                 budget[spec.desc()] = remaining - 1
                 raise ActorError(f"injected chaos failure for {spec.desc()!r}")
+
+    def _process_pool(self):
+        """Lazy per-node process worker pool (reference: WorkerPool)."""
+        with self._lock:
+            pool = getattr(self, "_proc_pool", None)
+            if pool is None:
+                import os as _os
+
+                from ray_tpu.core.process_pool import ProcessWorkerPool
+
+                n = int(_os.environ.get("RAY_TPU_PROCESS_WORKERS", "2"))
+                pool = self._proc_pool = ProcessWorkerPool(
+                    num_workers=n,
+                    shm_name=self.shm_store.name if self.shm_store else None,
+                    shm_size=self.config.object_store_memory,
+                )
+        return pool
+
+    def _execute_in_process(self, entry: _TaskEntry, args, kwargs) -> None:
+        """Run the task in an OS worker process (crash -> system failure -> retry)."""
+        from ray_tpu.core.process_pool import _RemoteTaskError
+
+        spec = entry.spec
+        if entry.cancelled:
+            raise TaskCancelledError(spec.desc())
+        self._maybe_inject_chaos(spec)
+        rids = spec.return_ids()
+        oid_bin = rids[0].binary() if spec.num_returns == 1 else None
+        fn = spec.func
+        if spec.runtime_env:
+            # env applies INSIDE the worker process — true isolation (the
+            # reference's per-worker runtime_env model)
+            from ray_tpu.core.process_pool import wrap_with_runtime_env
+
+            fn = wrap_with_runtime_env(fn, spec.runtime_env)
+        try:
+            status, payload, size = self._process_pool().execute(
+                fn, args, kwargs, result_oid_bin=oid_bin
+            )
+        except _RemoteTaskError as e:
+            raise TaskError(RuntimeError(e.remote_tb), spec.desc(), remote_tb=e.remote_tb) from None
+        if status == "shm":
+            # worker already sealed the result into the node store (zero-copy handoff)
+            self.shm_store.pin(rids[0])
+            self.memory_store.put(rids[0], RayObject(size=size or 0, in_shm=True))
+            with self._lock:
+                self._recovering.discard(rids[0])
+            return
+        result = serialization.deserialize_from_bytes(payload)
+        self._store_returns(spec, result)
 
     def _run_user_fn(self, entry: _TaskEntry, fn, args, kwargs):
         if entry.cancelled:
@@ -992,6 +1045,12 @@ class Runtime:
             for _ in state.threads:
                 state.mailbox.put(None)
         self.scheduler.notify()
+        pool = getattr(self, "_proc_pool", None)
+        if pool is not None:
+            try:
+                pool.shutdown()
+            except Exception:
+                pass
         if self.shm_store is not None:
             try:
                 self.shm_store.close()

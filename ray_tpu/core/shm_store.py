@@ -89,12 +89,18 @@ class SharedMemoryStore:
         )
         if err.value == 1:
             # Entry exists — idempotent ONLY if it is sealed and readable; a
-            # crashed writer (CREATING) or pending delete (DELETING) is not.
+            # crashed writer leaves an orphaned CREATING entry: reclaim it and
+            # retry once (delete frees CREATING entries regardless of pins).
             if self.contains(oid):
                 return
-            raise ObjectStoreFullError(
-                f"object {oid.hex()[:12]} exists in an unreadable state"
+            self._lib.shm_store_delete(self._handle, oid.binary())
+            off = self._lib.shm_store_create_object(
+                self._handle, oid.binary(), len(data), ctypes.byref(err)
             )
+            if err.value != 0 or not off:
+                raise ObjectStoreFullError(
+                    f"object {oid.hex()[:12]} exists in an unreadable state"
+                )
         if err.value != 0 or not off:
             raise ObjectStoreFullError(
                 f"shm store cannot fit object of {len(data)} bytes (err={err.value})"
@@ -121,6 +127,12 @@ class SharedMemoryStore:
         if not off:
             return None
         buf = (ctypes.c_char * size.value).from_address(self._base + off)
+        if os.environ.get("RAY_TPU_SHM_COPY_READS") == "1":
+            # bisect/debug mode: copy out and release immediately (no zero-copy,
+            # no GC-tied pin release)
+            data = bytes(buf)
+            self._lib.shm_store_release(self._handle, oid.binary())
+            return memoryview(data)
         weakref.finalize(buf, _release_pin, self._lib, self._handle, oid.binary())
         return memoryview(buf)
 

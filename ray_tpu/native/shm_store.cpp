@@ -427,7 +427,9 @@ int shm_store_delete(void* handle, const uint8_t* id) {
   if (lock_mu(h) != 0) return 3;
   ObjectEntry* e = find_slot(s, id, false);
   if (!e || e->state == OBJ_FREE) { pthread_mutex_unlock(&h->mu); return 1; }
-  if (e->pins > 0) {
+  // CREATING entries can have no readers (get only returns SEALED) — their only
+  // pin is the creator's. Deleting one reclaims an orphan from a crashed writer.
+  if (e->pins > 0 && e->state != OBJ_CREATING) {
     e->state = OBJ_DELETING;  // invisible to get/contains; freed on last release
   } else {
     free_entry(s, e);

@@ -1,0 +1,92 @@
+"""Sort/groupby/aggregate + preprocessor tests (model: reference data tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data.preprocessors import Concatenator, LabelEncoder, MinMaxScaler, StandardScaler
+
+
+@pytest.fixture(autouse=True)
+def _session(ray_start_regular):
+    yield
+
+
+def _toy():
+    return rdata.from_items([
+        {"g": "a", "x": 1.0, "y": 10},
+        {"g": "b", "x": 2.0, "y": 20},
+        {"g": "a", "x": 3.0, "y": 30},
+        {"g": "b", "x": 4.0, "y": 40},
+        {"g": "a", "x": 5.0, "y": 50},
+    ], parallelism=2)
+
+
+def test_sort():
+    ds = rdata.from_items([{"v": x} for x in [3, 1, 2]], parallelism=2)
+    assert [int(r["v"]) for r in ds.sort("v").take_all()] == [1, 2, 3]
+    assert [int(r["v"]) for r in ds.sort("v", descending=True).take_all()] == [3, 2, 1]
+
+
+def test_groupby_aggregations():
+    counts = {r["g"]: int(r["count"]) for r in _toy().groupby("g").count().take_all()}
+    assert counts == {"a": 3, "b": 2}
+    sums = {r["g"]: float(r["x_sum"]) for r in _toy().groupby("g").sum("x").take_all()}
+    assert sums == {"a": 9.0, "b": 6.0}
+    means = {r["g"]: float(r["y_mean"]) for r in _toy().groupby("g").mean("y").take_all()}
+    assert means == {"a": 30.0, "b": 30.0}
+    maxes = {r["g"]: float(r["x_max"]) for r in _toy().groupby("g").max("x").take_all()}
+    assert maxes == {"a": 5.0, "b": 4.0}
+
+
+def test_dataset_level_aggregates():
+    ds = rdata.range(10)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == 4.5
+    assert ds.unique("id") == list(range(10))
+
+
+def test_standard_scaler():
+    ds = rdata.from_numpy({"x": np.asarray([0.0, 5.0, 10.0])})
+    scaled = StandardScaler(["x"]).fit_transform(ds).take_all()
+    vals = np.asarray([r["x"] for r in scaled])
+    assert abs(vals.mean()) < 1e-9
+    assert abs(vals.std() - 1.0) < 1e-9
+
+
+def test_minmax_scaler_and_concat():
+    ds = rdata.from_numpy({"a": np.asarray([0.0, 5.0, 10.0]), "b": np.asarray([1.0, 2.0, 3.0])})
+    out = MinMaxScaler(["a"]).fit_transform(ds)
+    out = Concatenator(["a", "b"]).transform(out).take_all()
+    assert out[0]["features"].shape == (2,)
+    assert float(out[-1]["features"][0]) == 1.0
+
+
+def test_label_encoder():
+    ds = rdata.from_items([{"label": "cat"}, {"label": "dog"}, {"label": "cat"}])
+    enc = LabelEncoder("label").fit(ds)
+    assert enc.classes_ == ["cat", "dog"]
+    out = [int(r["label"]) for r in enc.transform(ds).take_all()]
+    assert out == [0, 1, 0]
+
+
+def test_empty_dataset_aggregates_return_none():
+    empty = rdata.range(10).filter(lambda r: False)
+    assert empty.sum("id") is None
+    assert empty.min("id") is None
+    assert empty.max("id") is None
+    assert empty.mean("id") is None
+
+
+def test_groupby_default_skips_string_columns():
+    ds = rdata.from_items([
+        {"g": 0, "name": "a", "x": 1.0},
+        {"g": 0, "name": "b", "x": 2.0},
+        {"g": 1, "name": "c", "x": 3.0},
+    ])
+    rows = ds.groupby("g").sum().take_all()
+    assert all("name_sum" not in r for r in rows)
+    assert {int(r["g"]): float(r["x_sum"]) for r in rows} == {0: 3.0, 1: 3.0}
