@@ -64,15 +64,25 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             args, kwargs = serialization.deserialize_from_bytes(args_blob)
             result = fn(*args, **kwargs)
             blob = serialization.serialize_to_bytes(result)
+            sent = False
             if store is not None and len(blob) > 100 * 1024 and oid_bin is not None:
                 from ray_tpu._private.ids import ObjectID
 
-                store.put_bytes(ObjectID(oid_bin), blob)
-                conn.send_bytes(cloudpickle.dumps(("shm", oid_bin, len(blob))))
-            else:
+                try:
+                    store.put_bytes(ObjectID(oid_bin), blob)
+                    conn.send_bytes(cloudpickle.dumps(("shm", oid_bin, len(blob))))
+                    sent = True
+                except Exception:
+                    pass  # store full/unreadable: fall back to the pipe
+            if not sent:
                 conn.send_bytes(cloudpickle.dumps(("val", blob, len(blob))))
-        except BaseException:  # noqa: BLE001
-            conn.send_bytes(cloudpickle.dumps(("err", traceback.format_exc(), None)))
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            try:
+                exc_blob = cloudpickle.dumps(e)
+            except Exception:
+                exc_blob = None
+            conn.send_bytes(cloudpickle.dumps(("err", tb, exc_blob)))
 
 
 @dataclass
@@ -121,6 +131,14 @@ class ProcessWorkerPool:
                     self._spawn()
                 self._cv.wait(0.1)
 
+    def _drop_worker(self, w: "_Worker") -> None:
+        with self._cv:
+            if w in self._workers:
+                self._workers.remove(w)
+            while len(self._workers) < self._num:
+                self._spawn()
+            self._cv.notify_all()
+
     def _checkin(self, w: _Worker) -> None:
         with self._cv:
             w.busy = False
@@ -151,29 +169,19 @@ class ProcessWorkerPool:
                     # rather than check it back in (a reused worker would hand the
                     # NEXT task this task's late response)
                     w.proc.terminate()
-                    with self._cv:
-                        if w in self._workers:
-                            self._workers.remove(w)
-                        while len(self._workers) < self._num:
-                            self._spawn()
-                        self._cv.notify_all()
+                    self._drop_worker(w)
                     raise TimeoutError(f"process task exceeded {timeout}s")
                 resp = cloudpickle.loads(w.conn.recv_bytes())
             except (EOFError, OSError, BrokenPipeError) as e:
-                # worker died mid-task: drop it; _checkout respawns capacity
-                with self._cv:
-                    if w in self._workers:
-                        self._workers.remove(w)
-                    while len(self._workers) < self._num:
-                        self._spawn()
-                    self._cv.notify_all()
+                # worker died mid-task: drop it; capacity respawns immediately
+                self._drop_worker(w)
                 raise WorkerCrashedError(
                     f"worker process died while executing task ({type(e).__name__})"
                 ) from e
-            status, payload, size = resp
+            status, payload, extra = resp
             if status == "err":
-                raise _RemoteTaskError(payload)
-            return status, payload, size
+                raise _RemoteTaskError(payload, exc_blob=extra)
+            return status, payload, extra
         finally:
             if w.proc.is_alive():
                 self._checkin(w)
@@ -222,8 +230,18 @@ def wrap_with_runtime_env(fn, runtime_env: dict):
 
 
 class _RemoteTaskError(Exception):
-    """App-level failure inside the worker, carrying the remote traceback."""
+    """App-level failure inside the worker, carrying the remote traceback and
+    (when picklable) the original exception object for retry matching."""
 
-    def __init__(self, remote_tb: str):
+    def __init__(self, remote_tb: str, exc_blob: bytes | None = None):
         self.remote_tb = remote_tb
+        self.exc_blob = exc_blob
         super().__init__(remote_tb)
+
+    def original_exception(self):
+        if self.exc_blob is not None:
+            try:
+                return cloudpickle.loads(self.exc_blob)
+            except Exception:
+                pass
+        return None

@@ -539,7 +539,13 @@ class Runtime:
                 fn, args, kwargs, result_oid_bin=oid_bin
             )
         except _RemoteTaskError as e:
-            raise TaskError(RuntimeError(e.remote_tb), spec.desc(), remote_tb=e.remote_tb) from None
+            # Re-raise the ORIGINAL exception type so retry_exceptions matching
+            # and _store_error's single TaskError wrap behave like inline tasks.
+            orig = e.original_exception()
+            if orig is not None:
+                orig.__ray_tpu_remote_tb__ = e.remote_tb
+                raise orig from None
+            raise RuntimeError(e.remote_tb) from None
         if status == "shm":
             # worker already sealed the result into the node store (zero-copy handoff)
             self.shm_store.pin(rids[0])
@@ -554,6 +560,15 @@ class Runtime:
         if entry.cancelled:
             raise TaskCancelledError(entry.spec.desc())
         self._maybe_inject_chaos(entry.spec)
+        from ray_tpu.util import tracing
+
+        if tracing.is_enabled():
+            with tracing.span(f"task::{entry.spec.desc()}",
+                              {"task_id": entry.spec.task_id.hex()[:16]}):
+                return self._run_user_fn_inner(entry, fn, args, kwargs)
+        return self._run_user_fn_inner(entry, fn, args, kwargs)
+
+    def _run_user_fn_inner(self, entry: _TaskEntry, fn, args, kwargs):
         if entry.spec.runtime_env:
             from ray_tpu import runtime_env as renv
 
@@ -831,6 +846,27 @@ class Runtime:
                         def method(*a, _m=orig_method, _c=renv_ctx, **kw):
                             with renv.apply_context(_c):
                                 return _m(*a, **kw)
+
+                from ray_tpu.util import tracing
+
+                if tracing.is_enabled() and not is_gen:
+                    orig_call = method
+
+                    def method(*a, _m=orig_call, **kw):
+                        with tracing.span(
+                            f"actor::{state.cls.__name__}.{spec.method_name}",
+                            {"actor_id": state.actor_id.hex()[:16]},
+                        ):
+                            return _m(*a, **kw)
+
+                    if is_coro:
+                        # wrap the coroutine result, not the call
+                        async def method(*a, _m=orig_call, **kw):  # noqa: F811
+                            with tracing.span(
+                                f"actor::{state.cls.__name__}.{spec.method_name}",
+                                {"actor_id": state.actor_id.hex()[:16]},
+                            ):
+                                return await _m(*a, **kw)
 
                 if is_coro:
                     fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), state.loop)

@@ -52,7 +52,10 @@ class GroupedData:
         for b in self._ds.iter_blocks():
             keys = b.columns[self._key]
             for gk in np.unique(keys):
-                mask = keys == gk
+                if isinstance(gk, float) and np.isnan(gk):
+                    mask = np.isnan(keys)  # NaN != NaN: group NaN keys explicitly
+                else:
+                    mask = keys == gk
                 slot = groups.setdefault(_scalar(gk), {})
                 for col, vals in b.columns.items():
                     slot.setdefault(col, []).append(vals[mask])

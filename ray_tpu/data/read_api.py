@@ -107,6 +107,10 @@ def _read_parquet_columns(path: str) -> dict:
             for name, col in zip(table.column_names, table.columns)}
 
 
+class _ChildDied(IOError):
+    pass
+
+
 def _read_parquet_subprocess(path: str) -> dict:
     """Read in a child process: pyarrow's parquet reader sporadically segfaults
     inside this long-lived multi-threaded process (native-state interaction we
@@ -118,7 +122,9 @@ def _read_parquet_subprocess(path: str) -> dict:
     spawned interpreter (clean state, slower)."""
     try:
         return _read_in_child(path, "fork")
-    except IOError:
+    except _ChildDied:
+        # only a crashed child warrants the clean-interpreter retry; app-level
+        # read errors (corrupt file, schema mismatch) surface immediately
         return _read_in_child(path, "spawn")
 
 
@@ -136,7 +142,7 @@ def _read_in_child(path: str, method: str) -> dict:
             raise TimeoutError(f"parquet read of {path} timed out")
         status, payload = pickle.loads(parent.recv_bytes())
     except EOFError:
-        raise IOError(f"parquet reader subprocess ({method}) died reading {path}") from None
+        raise _ChildDied(f"parquet reader subprocess ({method}) died reading {path}") from None
     finally:
         proc.join(timeout=5)
         if proc.is_alive():

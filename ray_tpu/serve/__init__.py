@@ -6,6 +6,7 @@ DeploymentHandle, HTTP ingress) — reference python/ray/serve/.
 
 from ray_tpu.serve.api import delete, run, shutdown, start_http_proxy, status
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.controller import DeploymentHandle, ServeController
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
 
@@ -13,4 +14,5 @@ __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "run", "delete", "status", "shutdown", "start_http_proxy",
     "batch", "DeploymentHandle", "ServeController",
+    "multiplexed", "get_multiplexed_model_id",
 ]

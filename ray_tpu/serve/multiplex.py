@@ -1,0 +1,81 @@
+"""Model multiplexing: many models served by one replica pool.
+
+Parity: python/ray/serve/multiplex.py (@serve.multiplexed + get_multiplexed_model_id):
+a replica lazily loads models on demand and keeps an LRU of at most
+``max_num_models_per_replica``; the router steers requests for the same model id
+to replicas that already hold it (here: the model id travels in the request and
+the replica-local LRU does the steering's cache half).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+from typing import Any, Callable
+
+_request_ctx = threading.local()
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica call: the model id of the current request."""
+    return getattr(_request_ctx, "model_id", "")
+
+
+def _set_model_id(model_id: str) -> None:
+    _request_ctx.model_id = model_id
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorator for the model-loader method of a deployment class.
+
+    The wrapped ``async/sync def load_model(self, model_id)`` becomes an
+    LRU-cached loader; calling it inside a request both loads (if needed) and
+    marks the model most-recently-used, evicting beyond the cap.
+    """
+
+    def deco(load_fn: Callable):
+        attr = f"__serve_mux_{load_fn.__name__}"
+        lock = threading.Lock()
+
+        @functools.wraps(load_fn)
+        def wrapper(self, model_id: str):
+            with lock:
+                cache: "collections.OrderedDict[str, Any]" = getattr(self, attr, None)
+                if cache is None:
+                    cache = collections.OrderedDict()
+                    setattr(self, attr, cache)
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    _set_model_id(model_id)
+                    return cache[model_id]
+            model = load_fn(self, model_id)
+            import inspect
+
+            if inspect.iscoroutine(model):
+                import asyncio
+
+                model = asyncio.run(model)
+            with lock:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                evicted = []
+                while len(cache) > max_num_models_per_replica:
+                    _, old = cache.popitem(last=False)
+                    evicted.append(old)
+            for old in evicted:
+                unload = getattr(old, "unload", None)
+                if callable(unload):
+                    try:
+                        unload()
+                    except Exception:
+                        pass
+            _set_model_id(model_id)
+            return model
+
+        wrapper.__is_multiplexed__ = True
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

@@ -1,0 +1,131 @@
+"""Tracing: span recording around task/actor submit + execute.
+
+Parity: python/ray/util/tracing/tracing_helper.py (opt-in OpenTelemetry spans
+around remote calls) + the task timeline pipeline (SURVEY §5.1). Spans are
+recorded into an in-process buffer; `spans()` returns OTel-shaped dicts and
+`to_chrome_trace()` renders the same Chrome-trace format as `ray timeline`.
+OpenTelemetry SDK export can be layered on by registering a processor.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+_state = threading.local()
+
+
+@dataclass
+class Span:
+    name: str
+    span_id: str
+    trace_id: str
+    parent_id: str | None
+    start_ns: int
+    end_ns: int = 0
+    attributes: dict = field(default_factory=dict)
+    status: str = "OK"
+
+
+class _Tracer:
+    def __init__(self):
+        self._spans: list[Span] = []
+        self._lock = threading.Lock()
+        self._processors: list[Callable[[Span], None]] = []
+        self.enabled = False
+
+    def add_span_processor(self, fn: Callable[[Span], None]) -> None:
+        self._processors.append(fn)
+
+    def record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+        for p in self._processors:
+            try:
+                p(span)
+            except Exception:
+                pass
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+_tracer = _Tracer()
+
+
+def enable_tracing() -> None:
+    """Reference: `ray start --tracing-startup-hook` opt-in."""
+    _tracer.enabled = True
+
+
+def disable_tracing() -> None:
+    _tracer.enabled = False
+
+
+def is_enabled() -> bool:
+    return _tracer.enabled
+
+
+def add_span_processor(fn: Callable[[Span], None]) -> None:
+    _tracer.add_span_processor(fn)
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: dict | None = None):
+    """Record a span (no-op unless tracing is enabled). Nested spans link via
+    thread-local parent context (tracing_helper's context propagation)."""
+    if not _tracer.enabled:
+        yield None
+        return
+    parent: Span | None = getattr(_state, "current", None)
+    s = Span(
+        name=name,
+        span_id=uuid.uuid4().hex[:16],
+        trace_id=parent.trace_id if parent else uuid.uuid4().hex[:32],
+        parent_id=parent.span_id if parent else None,
+        start_ns=time.time_ns(),
+        attributes=dict(attributes or {}),
+    )
+    _state.current = s
+    try:
+        yield s
+    except BaseException:
+        s.status = "ERROR"
+        raise
+    finally:
+        s.end_ns = time.time_ns()
+        _state.current = parent
+        _tracer.record(s)
+
+
+def spans() -> list[Span]:
+    return _tracer.spans()
+
+
+def clear() -> None:
+    _tracer.clear()
+
+
+def to_chrome_trace() -> list[dict]:
+    return [
+        {
+            "name": s.name,
+            "cat": "span",
+            "ph": "X",
+            "ts": s.start_ns // 1000,
+            "dur": max(0, (s.end_ns - s.start_ns) // 1000),
+            "pid": 1,
+            "tid": abs(hash(s.trace_id)) % 1000,
+            "args": {**s.attributes, "status": s.status},
+        }
+        for s in _tracer.spans()
+    ]

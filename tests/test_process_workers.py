@@ -142,3 +142,37 @@ def test_crash_mid_shm_write_recovers_on_retry():
 
     out = ray_tpu.get(big_then_die.remote(marker), timeout=60)
     assert out.shape == (300_000,)
+
+
+def test_process_retry_exceptions_matches_original_type():
+    calls = {"n": 0}
+    import tempfile
+
+    counter_file = tempfile.mktemp()
+
+    @ray_tpu.remote(isolate_process=True, max_retries=2, retry_exceptions=[ValueError])
+    def flaky(path):
+        import os as _os
+
+        n = 1
+        if _os.path.exists(path):
+            n = int(open(path).read()) + 1
+        open(path, "w").write(str(n))
+        if n < 2:
+            raise ValueError("transient in worker")
+        return n
+
+    assert ray_tpu.get(flaky.remote(counter_file), timeout=60) == 2
+
+
+def test_process_error_not_double_wrapped():
+    @ray_tpu.remote(isolate_process=True)
+    def boom2():
+        raise KeyError("once")
+
+    try:
+        ray_tpu.get(boom2.remote(), timeout=30)
+        assert False
+    except TaskError as e:
+        assert str(e).count("Task boom2 failed") == 1
+        assert isinstance(e.cause, KeyError)

@@ -1,0 +1,105 @@
+"""Tracing spans + serve multiplexing tests."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(autouse=True)
+def _session(ray_start_regular):
+    tracing.clear()
+    yield
+    tracing.disable_tracing()
+    serve.shutdown()
+
+
+def test_tracing_disabled_by_default():
+    @ray_tpu.remote
+    def t():
+        return 1
+
+    ray_tpu.get(t.remote())
+    assert tracing.spans() == []
+
+
+def test_task_spans_recorded_when_enabled():
+    tracing.enable_tracing()
+
+    @ray_tpu.remote
+    def traced_fn():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([traced_fn.remote() for _ in range(3)])
+    names = [s.name for s in tracing.spans()]
+    assert names.count("task::traced_fn") == 3
+    trace = tracing.to_chrome_trace()
+    assert all(e["dur"] > 0 for e in trace if e["name"] == "task::traced_fn")
+
+
+def test_nested_spans_link_parent():
+    tracing.enable_tracing()
+    with tracing.span("outer") as outer:
+        with tracing.span("inner") as inner:
+            pass
+    by_name = {s.name: s for s in tracing.spans()}
+    assert by_name["inner"].parent_id == by_name["outer"].span_id
+    assert by_name["inner"].trace_id == by_name["outer"].trace_id
+
+
+def test_error_span_status():
+    tracing.enable_tracing()
+    with pytest.raises(ValueError):
+        with tracing.span("bad"):
+            raise ValueError("x")
+    assert tracing.spans()[-1].status == "ERROR"
+
+
+def test_multiplexed_lru():
+    loads, unloads = [], []
+
+    class FakeModel:
+        def __init__(self, mid):
+            self.mid = mid
+
+        def unload(self):
+            unloads.append(self.mid)
+
+    @serve.deployment
+    class MuxHost:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            loads.append(model_id)
+            return FakeModel(model_id)
+
+        def __call__(self, body):
+            model = self.get_model(body["model"])
+            return {"model": model.mid, "active": serve.get_multiplexed_model_id()}
+
+    h = serve.run(MuxHost.bind())
+    assert ray_tpu.get(h.remote({"model": "a"}), timeout=10)["model"] == "a"
+    assert ray_tpu.get(h.remote({"model": "b"}), timeout=10)["active"] == "b"
+    assert ray_tpu.get(h.remote({"model": "a"}), timeout=10)["model"] == "a"
+    assert loads == ["a", "b"]  # 'a' cached, not reloaded
+    ray_tpu.get(h.remote({"model": "c"}), timeout=10)  # evicts LRU ('b')
+    assert unloads == ["b"]
+    ray_tpu.get(h.remote({"model": "b"}), timeout=10)
+    assert loads == ["a", "b", "c", "b"]
+
+
+def test_actor_method_spans():
+    tracing.enable_tracing()
+
+    @ray_tpu.remote
+    class Traced:
+        def work(self):
+            return 1
+
+    t = Traced.remote()
+    ray_tpu.get([t.work.remote() for _ in range(2)])
+    names = [s.name for s in tracing.spans()]
+    assert names.count("actor::Traced.work") == 2
