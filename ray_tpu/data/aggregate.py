@@ -47,16 +47,20 @@ class GroupedData:
         self._ds = ds
         self._key = key
 
+    _NAN_KEY = "\x00__nan_group__"  # merges NaN keys across blocks (nan != nan)
+
     def _gather(self) -> dict[Any, dict[str, list[np.ndarray]]]:
         groups: dict[Any, dict[str, list]] = {}
         for b in self._ds.iter_blocks():
             keys = b.columns[self._key]
             for gk in np.unique(keys):
                 if isinstance(gk, float) and np.isnan(gk):
-                    mask = np.isnan(keys)  # NaN != NaN: group NaN keys explicitly
+                    mask = np.isnan(keys)
+                    group_key = self._NAN_KEY
                 else:
                     mask = keys == gk
-                slot = groups.setdefault(_scalar(gk), {})
+                    group_key = _scalar(gk)
+                slot = groups.setdefault(group_key, {})
                 for col, vals in b.columns.items():
                     slot.setdefault(col, []).append(vals[mask])
         return groups

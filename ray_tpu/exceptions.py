@@ -25,6 +25,9 @@ class TaskError(RayTpuError):
     def __init__(self, cause: BaseException, task_desc: str = "", remote_tb: str | None = None):
         self.cause = cause
         self.task_desc = task_desc
+        # exceptions that crossed a process boundary carry their worker-side
+        # traceback as an attribute (core/process_pool.py)
+        remote_tb = remote_tb or getattr(cause, "__ray_tpu_remote_tb__", None)
         self.remote_tb = remote_tb or "".join(
             traceback.format_exception(type(cause), cause, cause.__traceback__)
         )

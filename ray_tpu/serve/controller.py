@@ -41,6 +41,9 @@ class ReplicaActor:
         self._lock = threading.Lock()
 
     def handle_request(self, method_name: str, args, kwargs):
+        from ray_tpu.serve.multiplex import _set_model_id
+
+        _set_model_id("")  # fresh per request: no stale id across thread reuse
         with self._lock:
             self._ongoing += 1
             self._total += 1

@@ -26,6 +26,32 @@ def _set_model_id(model_id: str) -> None:
     _request_ctx.model_id = model_id
 
 
+def _run_coro_sync(coro):
+    """Run a coroutine to completion whether or not this thread has a running
+    event loop (the replica executes async handlers via asyncio.run, so a sync
+    loader wrapper called from inside one must hop to a fresh thread)."""
+    import asyncio
+
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    out: dict = {}
+
+    def runner():
+        try:
+            out["v"] = asyncio.run(coro)
+        except BaseException as e:  # noqa: BLE001
+            out["e"] = e
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    t.join()
+    if "e" in out:
+        raise out["e"]
+    return out["v"]
+
+
 def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
     """Decorator for the model-loader method of a deployment class.
 
@@ -38,24 +64,40 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
         attr = f"__serve_mux_{load_fn.__name__}"
         lock = threading.Lock()
 
+        inflight_attr = attr + "_inflight"
+
         @functools.wraps(load_fn)
         def wrapper(self, model_id: str):
-            with lock:
-                cache: "collections.OrderedDict[str, Any]" = getattr(self, attr, None)
-                if cache is None:
-                    cache = collections.OrderedDict()
-                    setattr(self, attr, cache)
-                if model_id in cache:
-                    cache.move_to_end(model_id)
-                    _set_model_id(model_id)
-                    return cache[model_id]
-            model = load_fn(self, model_id)
-            import inspect
+            while True:
+                with lock:
+                    cache: "collections.OrderedDict[str, Any]" = getattr(self, attr, None)
+                    if cache is None:
+                        cache = collections.OrderedDict()
+                        setattr(self, attr, cache)
+                    inflight: dict = getattr(self, inflight_attr, None)
+                    if inflight is None:
+                        inflight = {}
+                        setattr(self, inflight_attr, inflight)
+                    if model_id in cache:
+                        cache.move_to_end(model_id)
+                        _set_model_id(model_id)
+                        return cache[model_id]
+                    ev = inflight.get(model_id)
+                    if ev is None:
+                        inflight[model_id] = threading.Event()
+                        break  # we are the loader
+                # another request is loading this model: wait, then re-check
+                ev.wait(timeout=300)
+            try:
+                model = load_fn(self, model_id)
+                import inspect
 
-            if inspect.iscoroutine(model):
-                import asyncio
-
-                model = asyncio.run(model)
+                if inspect.iscoroutine(model):
+                    model = _run_coro_sync(model)
+            except BaseException:
+                with lock:
+                    inflight.pop(model_id).set()
+                raise
             with lock:
                 cache[model_id] = model
                 cache.move_to_end(model_id)
@@ -63,6 +105,7 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
                 while len(cache) > max_num_models_per_replica:
                     _, old = cache.popitem(last=False)
                     evicted.append(old)
+                inflight.pop(model_id).set()
             for old in evicted:
                 unload = getattr(old, "unload", None)
                 if callable(unload):

@@ -1,0 +1,115 @@
+"""Elastic scaling + preemption handling for training.
+
+Parity: train/v2/_internal/execution/scaling_policy/elastic.py (resize the
+worker group between attempts within [min, max] as resources come and go) and
+train/v2 preemption.py (graceful drain on provider preemption notice:
+checkpoint at the next report, then restart the group).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import ray_tpu
+
+
+@dataclass
+class ElasticConfig:
+    min_workers: int = 1
+    max_workers: int = 8
+    resources_per_worker: dict | None = None
+
+
+class ElasticScalingPolicy:
+    """Decide the worker count for the next run attempt from live capacity."""
+
+    def __init__(self, config: ElasticConfig):
+        self.config = config
+
+    def workers_for_next_attempt(self) -> int:
+        res = self.config.resources_per_worker or {"CPU": 1.0}
+        avail = ray_tpu.available_resources()
+        fits = min(
+            (avail.get(k, 0.0) // v) for k, v in res.items() if v > 0
+        )
+        n = int(max(self.config.min_workers, min(self.config.max_workers, fits)))
+        return n
+
+    def validate(self) -> None:
+        if self.workers_for_next_attempt() < self.config.min_workers:
+            raise RuntimeError(
+                f"Cluster cannot satisfy min_workers={self.config.min_workers}"
+            )
+
+
+class PreemptionHandler:
+    """Drain hook: when a preemption notice arrives, workers see
+    ``should_checkpoint_and_exit()`` truthy and exit cleanly at the next step
+    boundary (reference: preemption.py drain + MEGASCALE stale-env trap —
+    the restart must rebuild coordination env from scratch, which the
+    controller's fresh WorkerGroup per attempt guarantees)."""
+
+    def __init__(self):
+        self._preempted = threading.Event()
+        self._notice_time: float | None = None
+
+    def notify_preemption(self) -> None:
+        """Wired to the cloud provider's preemption signal (e.g. GCE metadata
+        server 'preempted' event on TPU-VMs)."""
+        self._notice_time = time.monotonic()
+        self._preempted.set()
+
+    def should_checkpoint_and_exit(self) -> bool:
+        return self._preempted.is_set()
+
+    def clear(self) -> None:
+        self._preempted.clear()
+        self._notice_time = None
+
+    def seconds_since_notice(self) -> Optional[float]:
+        if self._notice_time is None:
+            return None
+        return time.monotonic() - self._notice_time
+
+
+_global_handler = PreemptionHandler()
+
+
+def get_preemption_handler() -> PreemptionHandler:
+    return _global_handler
+
+
+def run_elastic(
+    train_fn,
+    *,
+    config: dict | None = None,
+    elastic: ElasticConfig | None = None,
+    run_config=None,
+    max_attempts: int = 3,
+):
+    """Train with per-attempt elastic sizing: each attempt sizes the gang to
+    current capacity; worker failure or preemption triggers a resized retry."""
+    from ray_tpu.train.config import RunConfig, ScalingConfig
+    from ray_tpu.train.controller import TrainController
+
+    elastic = elastic or ElasticConfig()
+    policy = ElasticScalingPolicy(elastic)
+    policy.validate()
+    last = None
+    for attempt in range(max_attempts):
+        n = policy.workers_for_next_attempt()
+        scaling = ScalingConfig(
+            num_workers=n, resources_per_worker=elastic.resources_per_worker
+        )
+        controller = TrainController(
+            train_fn, dict(config or {}, _elastic_attempt=attempt, _num_workers=n),
+            scaling, run_config or RunConfig(name="elastic"),
+        )
+        last = controller._run_attempt()
+        if last.error is None:
+            return last
+        get_preemption_handler().clear()
+    return last

@@ -1,0 +1,58 @@
+"""Serializability inspection.
+
+Parity: python/ray/util/check_serialize.py (inspect_serializability) — walks a
+callable/object and reports which nested members fail cloudpickle, the standard
+debugging tool for 'cannot pickle' task errors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import cloudpickle
+
+
+def _try_pickle(obj: Any) -> Optional[str]:
+    try:
+        cloudpickle.dumps(obj)
+        return None
+    except Exception as e:  # noqa: BLE001
+        return f"{type(e).__name__}: {e}"
+
+
+def inspect_serializability(obj: Any, name: str | None = None, depth: int = 3,
+                            _prefix: str = "") -> tuple[bool, list[dict]]:
+    """Returns (serializable, failures). Each failure: {path, error}."""
+    label = _prefix + (name or getattr(obj, "__name__", type(obj).__name__))
+    err = _try_pickle(obj)
+    if err is None:
+        return True, []
+    failures = [{"path": label, "error": err}]
+    if depth <= 0:
+        return False, failures
+    children: dict[str, Any] = {}
+    closure = getattr(obj, "__closure__", None)
+    if closure:
+        names = obj.__code__.co_freevars
+        for nm, cell in zip(names, closure):
+            try:
+                children[f"closure:{nm}"] = cell.cell_contents
+            except ValueError:
+                pass
+    for attr in ("__self__", "__wrapped__", "__func__"):
+        if hasattr(obj, attr):
+            children[attr] = getattr(obj, attr)
+    gd = getattr(obj, "__globals__", None)
+    if gd and hasattr(obj, "__code__"):
+        for nm in obj.__code__.co_names:
+            if nm in gd:
+                children[f"global:{nm}"] = gd[nm]
+    if hasattr(obj, "__dict__") and not callable(obj):
+        children.update({f"attr:{k}": v for k, v in vars(obj).items()})
+    for child_name, child in children.items():
+        if _try_pickle(child) is not None:
+            ok, sub = inspect_serializability(
+                child, child_name, depth - 1, _prefix=label + "."
+            )
+            failures.extend(sub)
+    return False, failures

@@ -1,0 +1,10 @@
+"""multiprocessing.Pool API over the task runtime.
+
+Parity: python/ray/util/multiprocessing/ — drop-in Pool whose workers are
+runtime tasks (map/starmap/imap/apply_async), letting stdlib-Pool code scale
+onto the cluster unchanged.
+"""
+
+from ray_tpu.util.multiprocessing.pool import Pool
+
+__all__ = ["Pool"]

@@ -1,0 +1,108 @@
+"""Pool implementation (reference: ray.util.multiprocessing.Pool)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: list, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: float | None = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: float | None = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    def __init__(self, processes: int | None = None, initializer: Callable | None = None,
+                 initargs: tuple = (), **_):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self._processes = processes or 8
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    def _task(self, fn: Callable):
+        init, initargs = self._initializer, self._initargs
+
+        def run(*args, **kwargs):
+            if init is not None:
+                init(*initargs)
+            return fn(*args, **kwargs)
+
+        return ray_tpu.remote(num_cpus=1, name=getattr(fn, "__name__", "pool_task"))(run)
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict | None = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (), kwds: dict | None = None) -> AsyncResult:
+        self._check_open()
+        return AsyncResult([self._task(fn).remote(*args, **(kwds or {}))], single=True)
+
+    def map(self, fn: Callable, iterable: Iterable, chunksize: int | None = None) -> list:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable, chunksize=None) -> AsyncResult:
+        self._check_open()
+        task = self._task(fn)
+        return AsyncResult([task.remote(x) for x in iterable], single=False)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple]) -> list:
+        self._check_open()
+        task = self._task(fn)
+        return ray_tpu.get([task.remote(*args) for args in iterable])
+
+    def imap(self, fn: Callable, iterable: Iterable, chunksize: int | None = None):
+        self._check_open()
+        task = self._task(fn)
+        refs = [task.remote(x) for x in iterable]
+        for r in refs:
+            yield ray_tpu.get(r)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize=None):
+        self._check_open()
+        task = self._task(fn)
+        refs = [task.remote(x) for x in iterable]
+        while refs:
+            ready, refs = ray_tpu.wait(refs, num_returns=1, timeout=None)
+            yield ray_tpu.get(ready[0])
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

@@ -10,13 +10,17 @@ OpenTelemetry SDK export can be layered on by registering a processor.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-_state = threading.local()
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "ray_tpu_current_span", default=None
+)
+MAX_BUFFERED_SPANS = 100_000
 
 
 @dataclass
@@ -44,6 +48,11 @@ class _Tracer:
     def record(self, span: Span) -> None:
         with self._lock:
             self._spans.append(span)
+            if len(self._spans) > MAX_BUFFERED_SPANS:
+                # ring-buffer semantics: drop the oldest half (bounded memory
+                # for long-running traced jobs)
+                self.dropped = getattr(self, "dropped", 0) + len(self._spans) // 2
+                self._spans = self._spans[len(self._spans) // 2 :]
         for p in self._processors:
             try:
                 p(span)
@@ -86,7 +95,7 @@ def span(name: str, attributes: dict | None = None):
     if not _tracer.enabled:
         yield None
         return
-    parent: Span | None = getattr(_state, "current", None)
+    parent: Optional[Span] = _current_span.get()
     s = Span(
         name=name,
         span_id=uuid.uuid4().hex[:16],
@@ -95,7 +104,7 @@ def span(name: str, attributes: dict | None = None):
         start_ns=time.time_ns(),
         attributes=dict(attributes or {}),
     )
-    _state.current = s
+    token = _current_span.set(s)
     try:
         yield s
     except BaseException:
@@ -103,7 +112,7 @@ def span(name: str, attributes: dict | None = None):
         raise
     finally:
         s.end_ns = time.time_ns()
-        _state.current = parent
+        _current_span.reset(token)
         _tracer.record(s)
 
 

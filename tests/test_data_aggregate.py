@@ -90,3 +90,15 @@ def test_groupby_default_skips_string_columns():
     rows = ds.groupby("g").sum().take_all()
     assert all("name_sum" not in r for r in rows)
     assert {int(r["g"]): float(r["x_sum"]) for r in rows} == {0: 3.0, 1: 3.0}
+
+
+def test_groupby_nan_keys_merged_across_blocks():
+    import math
+
+    ds = rdata.from_items(
+        [{"g": float("nan"), "x": 1.0}, {"g": 1.0, "x": 2.0}] * 3, parallelism=3
+    )
+    rows = ds.groupby("g").count().take_all()
+    assert len(rows) == 2  # one NaN group + one 1.0 group
+    counts = sorted(int(r["count"]) for r in rows)
+    assert counts == [3, 3]

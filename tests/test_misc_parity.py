@@ -1,0 +1,115 @@
+"""check_serialize, multiprocessing Pool, elastic training tests."""
+
+import tempfile
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import report
+from ray_tpu.util.check_serialize import inspect_serializability
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture(autouse=True)
+def _session(ray_start_regular):
+    yield
+
+
+def test_inspect_serializability_ok():
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and failures == []
+
+
+def test_inspect_serializability_finds_culprit():
+    lock = threading.Lock()
+
+    def bad(x):
+        with lock:
+            return x
+
+    ok, failures = inspect_serializability(bad)
+    assert not ok
+    assert any("closure:lock" in f["path"] for f in failures)
+
+
+def test_pool_map_and_starmap():
+    with Pool() as p:
+        assert p.map(lambda x: x * 2, range(5)) == [0, 2, 4, 6, 8]
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_pool_apply_async_and_imap():
+    with Pool() as p:
+        r = p.apply_async(lambda a: a + 1, (41,))
+        assert r.get(timeout=10) == 42
+        assert sorted(p.imap_unordered(lambda x: x, range(4))) == [0, 1, 2, 3]
+
+
+def test_pool_initializer():
+    state = {}
+
+    def init(v):
+        state["v"] = v
+
+    with Pool(initializer=init, initargs=(7,)) as p:
+        out = p.map(lambda x: state.get("v", -1) + x, range(2))
+    assert out == [7, 8]
+
+
+def test_pool_closed_rejects():
+    p = Pool()
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(lambda x: x, [1])
+
+
+def test_elastic_sizes_to_capacity():
+    from ray_tpu.train.elastic import ElasticConfig, run_elastic
+
+    seen = {}
+
+    def loop(config):
+        seen["n"] = config["_num_workers"]
+        report({"done": 1})
+
+    res = run_elastic(
+        loop,
+        elastic=ElasticConfig(min_workers=1, max_workers=4,
+                              resources_per_worker={"CPU": 2.0}),
+        max_attempts=2,
+    )
+    assert res.error is None
+    assert seen["n"] == 4  # 8 CPUs / 2 per worker, capped by max_workers
+
+
+def test_elastic_retries_after_failure():
+    from ray_tpu.train.elastic import ElasticConfig, run_elastic
+
+    marker = tempfile.mktemp()
+
+    def loop(config):
+        import os
+
+        if not os.path.exists(marker):
+            open(marker, "w").write("x")
+            raise RuntimeError("first attempt dies")
+        report({"recovered": True})
+
+    res = run_elastic(loop, elastic=ElasticConfig(min_workers=1, max_workers=2),
+                      max_attempts=3)
+    assert res.error is None
+    assert res.metrics.get("recovered") is True
+
+
+def test_preemption_handler_flow():
+    from ray_tpu.train.elastic import get_preemption_handler
+
+    h = get_preemption_handler()
+    assert not h.should_checkpoint_and_exit()
+    h.notify_preemption()
+    assert h.should_checkpoint_and_exit()
+    assert h.seconds_since_notice() >= 0
+    h.clear()
+    assert not h.should_checkpoint_and_exit()

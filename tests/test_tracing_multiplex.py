@@ -103,3 +103,60 @@ def test_actor_method_spans():
     ray_tpu.get([t.work.remote() for _ in range(2)])
     names = [s.name for s in tracing.spans()]
     assert names.count("actor::Traced.work") == 2
+
+
+def test_multiplexed_async_loader():
+    @serve.deployment
+    class AsyncMux:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return {"id": model_id}
+
+        async def __call__(self, body):
+            m = self.get_model(body["m"])
+            return m["id"]
+
+    h = serve.run(AsyncMux.bind())
+    assert ray_tpu.get(h.remote({"m": "z"}), timeout=15) == "z"
+
+
+def test_multiplexed_concurrent_single_load():
+    import threading as th
+
+    loads = []
+
+    @serve.deployment(max_ongoing_requests=8)
+    class Mux2:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            loads.append(model_id)
+            time.sleep(0.2)
+            return model_id
+
+        def __call__(self, body):
+            return self.get_model(body["m"])
+
+    h = serve.run(Mux2.bind())
+    refs = [h.remote({"m": "same"}) for _ in range(4)]
+    assert ray_tpu.get(refs, timeout=20) == ["same"] * 4
+    assert loads == ["same"]  # loaded once despite concurrency
+
+
+def test_model_id_reset_between_requests():
+    @serve.deployment
+    class IdHost:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def load(self, mid):
+            return mid
+
+        def __call__(self, body):
+            if body.get("load"):
+                self.load(body["m"])
+            return serve.get_multiplexed_model_id()
+
+    h = serve.run(IdHost.bind())
+    assert ray_tpu.get(h.remote({"m": "a", "load": True}), timeout=10) == "a"
+    assert ray_tpu.get(h.remote({}), timeout=10) == ""  # no stale leak
