@@ -1,0 +1,61 @@
+"""Usage stats: opt-out telemetry recording (local only).
+
+Parity: python/ray/dashboard/modules/usage_stats/ + usage.proto — feature-tag
+recording behind an opt-out env var. This implementation only aggregates tags
+locally (written next to the session log dir); there is no network reporting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_tags: dict[str, str] = {}
+_counters: dict[str, int] = {}
+
+
+def usage_stats_enabled() -> bool:
+    """Opt-out (reference: RAY_USAGE_STATS_ENABLED)."""
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    """Reference: usage_lib TagKey recording API."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _tags[key] = value
+
+
+def record_library_usage(library: str) -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _counters[f"library:{library}"] = _counters.get(f"library:{library}", 0) + 1
+
+
+def usage_report() -> dict:
+    with _lock:
+        return {"tags": dict(_tags), "counters": dict(_counters), "ts": time.time()}
+
+
+def write_report(path: str | None = None) -> str:
+    if path is None:
+        from ray_tpu._private.config import get_config
+
+        path = os.path.join(get_config().session_dir_prefix, "usage_stats.json")
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(usage_report(), f)
+    return path
+
+
+def reset() -> None:
+    with _lock:
+        _tags.clear()
+        _counters.clear()

@@ -31,3 +31,8 @@ __all__ = [
     "from_huggingface", "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rec
+
+_rec("data")
+del _rec

@@ -9,3 +9,8 @@ from ray_tpu.rllib.env_runner import EnvRunnerGroup, Episode, SingleAgentEnvRunn
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
 
 __all__ = ["PPO", "PPOConfig", "PPOLearner", "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rec
+
+_rec("rllib")
+del _rec

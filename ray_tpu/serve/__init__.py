@@ -16,3 +16,8 @@ __all__ = [
     "batch", "DeploymentHandle", "ServeController",
     "multiplexed", "get_multiplexed_model_id",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rec
+
+_rec("serve")
+del _rec

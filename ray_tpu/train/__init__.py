@@ -35,3 +35,8 @@ __all__ = [
     "get_context",
     "report",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rec
+
+_rec("train")
+del _rec

@@ -27,3 +27,8 @@ __all__ = [
     "BasicVariantGenerator", "Searcher",
     "ASHAScheduler", "PopulationBasedTraining", "FIFOScheduler", "TrialScheduler",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rec
+
+_rec("tune")
+del _rec

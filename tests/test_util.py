@@ -184,3 +184,30 @@ def test_internal_kv_prefix_delete_and_contracts():
     assert kv._internal_kv_get("k", namespace="default") == b"default-ns"
     with pytest.raises(TypeError):
         kv._internal_kv_put("k", 5)
+
+
+def test_usage_stats_opt_out(monkeypatch, tmp_path):
+    from ray_tpu._private import usage_stats as us
+
+    us.reset()
+    try:
+        us.record_library_usage("data")
+        us.record_extra_usage_tag("mesh", "2x2")
+        rep = us.usage_report()
+        assert rep["counters"]["library:data"] == 1
+        assert rep["tags"]["mesh"] == "2x2"
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+        us.record_library_usage("train")
+        assert "library:train" not in us.usage_report()["counters"]
+        path = us.write_report(str(tmp_path / "usage.json"))
+        import json as _json
+
+        assert _json.load(open(path))["counters"]["library:data"] == 1
+        # import-time recording is wired into the library namespaces
+        import ray_tpu.data  # noqa: F401
+
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+        us.record_library_usage("data")
+        assert us.usage_report()["counters"]["library:data"] >= 1
+    finally:
+        us.reset()
