@@ -123,4 +123,23 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 - the driver needs ONE parseable line
+        if isinstance(e, SystemExit) and not e.code:
+            raise  # clean exit (e.g. --help) is not a failure
+        import traceback
+
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": f"train_tokens_per_sec_per_chip_error_{type(e).__name__}",
+                    "value": 0.0,
+                    "unit": "tokens/s/chip",
+                    "vs_baseline": 0.0,
+                }
+            ),
+            flush=True,
+        )
+        raise SystemExit(2)

@@ -17,6 +17,7 @@ from ray_tpu.data.read_api import (
     read_binary_files,
     read_csv,
     read_json,
+    read_images,
     read_numpy,
     read_parquet,
     read_text,
@@ -29,7 +30,7 @@ __all__ = [
     "Block", "Dataset", "DataIterator",
     "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "from_huggingface", "read_parquet", "read_csv", "read_json", "read_text",
-    "read_binary_files", "read_numpy",
+    "read_binary_files", "read_numpy", "read_images",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rec

@@ -339,9 +339,14 @@ def _format_batch(block: Block, batch_format: str, device_put) -> Any:
     if batch_format == "jax":
         import jax
 
-        if device_put is not None:
-            return {k: jax.device_put(v, device_put) for k, v in batch.items()}
-        return {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        def to_dev(v):
+            if v.dtype.kind not in "biufc":
+                return v  # strings/objects stay host-side numpy
+            if device_put is not None:
+                return jax.device_put(v, device_put)
+            return jax.numpy.asarray(v)
+
+        return {k: to_dev(v) for k, v in batch.items()}
     return batch
 
 

@@ -238,3 +238,43 @@ def read_numpy(paths: str | list[str]) -> Dataset:
             yield Block.from_numpy(np.load(f))
 
     return Dataset(source, (), "read_numpy")
+
+
+def read_images(paths: str | list[str], *, size: tuple[int, int] | None = None,
+                mode: str = "RGB", batch_size: int = 32) -> Dataset:
+    """Reference: read_api.read_images :1690 — image files -> {image, path} blocks.
+
+    The BASELINE ViT/CLIP ingest path: decoded (optionally resized) uint8 arrays
+    batch-ready for `iter_batches(batch_format="jax")` → HBM.
+    """
+    files = _expand_paths(paths)
+    exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+    files = [f for f in files if f.lower().endswith(exts)]
+    if not files:
+        raise FileNotFoundError(
+            f"No image files ({', '.join(exts)}) matched {paths}"
+        )
+
+    def source() -> Iterator[Block]:
+        from PIL import Image
+
+        for i in _range(0, len(files), batch_size):
+            chunk = files[i : i + batch_size]
+            images, okpaths = [], []
+            for f in chunk:
+                try:
+                    img = Image.open(f).convert(mode)
+                except Exception:
+                    continue  # skip unreadable files (reference: ignore_missing)
+                if size is not None:
+                    img = img.resize(size)
+                images.append(np.asarray(img))
+                okpaths.append(f)
+            if not images:
+                continue
+            same_shape = len({im.shape for im in images}) == 1
+            arr = (np.stack(images) if same_shape
+                   else np.asarray(images, dtype=object))
+            yield Block({"image": arr, "path": np.asarray(okpaths, dtype=object)})
+
+    return Dataset(source, (), "read_images")

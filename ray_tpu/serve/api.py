@@ -18,6 +18,7 @@ from ray_tpu.serve.controller import CONTROLLER_NAME, DeploymentHandle, ServeCon
 from ray_tpu.serve.deployment import Application
 
 _state: dict = {"controller": None, "proxy": None, "routes": {}}
+_STREAM_END = object()
 _lock = threading.Lock()
 
 
@@ -106,10 +107,15 @@ class HttpProxy:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.host = host
         self.port = port
         self._loop = None
         self._runner = None
+        # dedicated pool for long-lived SSE polls so streams can't starve the
+        # default executor used by non-streaming requests
+        self._stream_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="sse")
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._started = threading.Event()
         self._thread.start()
@@ -127,6 +133,8 @@ class HttpProxy:
                 body = await request.json() if request.can_read_body else {}
             except json.JSONDecodeError:
                 return web.json_response({"error": "invalid JSON body"}, status=400)
+            if isinstance(body, dict) and body.get("stream"):
+                return await self._stream_response(request, handle, body)
             ref = handle.remote(body)
             loop = asyncio.get_running_loop()
             try:
@@ -150,6 +158,45 @@ class HttpProxy:
         asyncio.set_event_loop(self._loop)
         self._loop.run_until_complete(start())
         self._loop.run_forever()
+
+    async def _stream_response(self, request, handle, body):
+        """Server-sent events: one `data:` frame per yielded item
+        (reference: serve streaming responses through the proxy)."""
+        from aiohttp import web
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        method = body.get("stream_method", "stream_tokens")
+        it = handle.stream(body, method_name=method)
+
+        def next_item():
+            try:
+                return next(it)
+            except StopIteration:
+                return _STREAM_END
+
+        try:
+            while True:
+                try:
+                    item = await loop.run_in_executor(self._stream_pool, next_item)
+                except Exception as e:  # noqa: BLE001 - stream errors become frames
+                    msg = str(e).splitlines()[-1][:200] if str(e) else type(e).__name__
+                    await resp.write(f"data: {json.dumps({'error': msg})}\n\n".encode())
+                    break
+                if item is _STREAM_END:
+                    break
+                await resp.write(f"data: {json.dumps(item)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (ConnectionError, ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away: fall through to close the stream below
+        finally:
+            it.close()  # releases the router's in-flight slot (GeneratorExit)
+        return resp
 
     def _match(self, path: str):
         best = None

@@ -62,6 +62,24 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_streaming(self, method_name: str, args, kwargs):
+        """Generator entry: streams the user's generator method incrementally
+        (reference: serve streaming responses over proxy)."""
+        from ray_tpu.serve.multiplex import _set_model_id
+
+        _set_model_id("")
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            fn = self._callable if self._is_function else getattr(
+                self._callable, method_name or "__call__"
+            )
+            yield from fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def queue_len(self) -> int:
         with self._lock:
             return self._ongoing
@@ -329,6 +347,28 @@ class Router:
                 else b
             )
 
+    def submit_stream(self, method_name: str, args, kwargs):
+        """Streaming variant: (ObjectRefGenerator, done_cb). The stream counts as
+        in flight until the caller's iterator finishes/closes (done_cb) — long
+        token streams stay visible to load balancing and autoscaling."""
+        replica = self.pick()
+        key = self._rkey(replica)
+        with self._lock:
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+        gen = replica.handle_streaming.options(num_returns="streaming").remote(
+            method_name, args, kwargs
+        )
+        self._maybe_report()
+        done = {"d": False}
+
+        def done_cb():
+            if not done["d"]:
+                done["d"] = True
+                with self._lock:
+                    self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+
+        return gen, done_cb
+
     def submit(self, method_name: str, args, kwargs):
         replica = self.pick()
         key = self._rkey(replica)
@@ -371,6 +411,17 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         return self._router.submit("__call__", args, kwargs)
+
+    def stream(self, *args, method_name: str = "__call__", **kwargs):
+        """Iterate a streaming deployment method's yielded values as they arrive."""
+        import ray_tpu as _rt
+
+        gen, done_cb = self._router.submit_stream(method_name, args, kwargs)
+        try:
+            for ref in gen:
+                yield _rt.get(ref)
+        finally:
+            done_cb()
 
     def __getattr__(self, item):
         if item.startswith("_"):

@@ -52,15 +52,17 @@ class GenerationResult:
 
 
 class _Slot:
-    __slots__ = ("future", "max_new", "generated", "start", "first_token_time", "prompt_len")
+    __slots__ = ("future", "max_new", "generated", "start", "first_token_time",
+                 "prompt_len", "token_queue")
 
-    def __init__(self, future, max_new, prompt_len, enqueue_time):
+    def __init__(self, future, max_new, prompt_len, enqueue_time, token_queue=None):
         self.future = future
         self.max_new = max_new
         self.generated = []
         self.start = enqueue_time  # TTFT measured from request arrival, incl. queueing
         self.first_token_time = None
         self.prompt_len = prompt_len
+        self.token_queue = token_queue  # streaming consumers get tokens as decoded
 
 
 class LLMEngine:
@@ -132,8 +134,35 @@ class LLMEngine:
                 )
             )
             return fut
-        self._pending.put((list(prompt_ids), max_new, fut, time.monotonic()))
+        self._pending.put((list(prompt_ids), max_new, fut, time.monotonic(), None))
         return fut
+
+    def generate_stream(self, prompt_ids: list[int], max_new_tokens: int | None = None):
+        """Yield token ids as they are decoded (streaming TTFT path).
+
+        Validation matches generate(); every engine path (completion, request
+        failure, engine failure, shutdown) terminates the stream via the None
+        sentinel so consumers never hang."""
+        fut: Future = Future()
+        max_new = self.config.max_new_tokens_default if max_new_tokens is None else max_new_tokens
+        if not prompt_ids:
+            raise ValueError("prompt_ids must be non-empty")
+        if not all(isinstance(t, int) and 0 <= t < self.config.model_config.vocab_size
+                   for t in prompt_ids):
+            raise ValueError("prompt_ids must be ints within the vocabulary")
+        if max_new <= 0:
+            return
+        if len(prompt_ids) + max_new > self.config.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        tq: "queue.Queue" = queue.Queue()
+        self._pending.put((list(prompt_ids), max_new, fut, time.monotonic(), tq))
+        while True:
+            item = tq.get(timeout=300)
+            if item is None:
+                if fut.done() and fut.exception() is not None:
+                    raise fut.exception()
+                return
+            yield item
 
     def generate_sync(self, prompt_ids: list[int], max_new_tokens: int | None = None,
                       timeout: float = 120.0) -> GenerationResult:
@@ -149,6 +178,7 @@ class LLMEngine:
 
     def shutdown(self) -> None:
         self._running = False
+        self._fail_all_active(RuntimeError("LLM engine shut down"))
 
     # ---- engine loop ----
     def _bucket(self, n: int) -> int:
@@ -184,6 +214,8 @@ class LLMEngine:
                     self.slots[i] = None
                     if not st.future.done():
                         st.future.set_exception(exc)
+                    if st.token_queue is not None:
+                        st.token_queue.put(None)
 
     def _loop_step(self) -> bool:
         jnp = self._jnp
@@ -192,7 +224,7 @@ class LLMEngine:
         free = [i for i in range(self.config.max_batch_size) if not self.active[i]]
         while free and not self._pending.empty():
             try:
-                prompt, max_new, fut, t_enq = self._pending.get_nowait()
+                prompt, max_new, fut, t_enq, tq = self._pending.get_nowait()
             except queue.Empty:
                 break
             slot = free.pop(0)
@@ -207,11 +239,15 @@ class LLMEngine:
             except Exception as e:  # noqa: BLE001 - bad request: fail it, keep serving
                 if not fut.done():
                     fut.set_exception(e)
+                if tq is not None:
+                    tq.put(None)  # terminate any streaming consumer
                 free.insert(0, slot)
                 continue
             with self._lock:
-                st = _Slot(fut, max_new, len(prompt), t_enq)
+                st = _Slot(fut, max_new, len(prompt), t_enq, tq)
                 st.generated.append(tok)
+                if tq is not None:
+                    tq.put(tok)
                 st.first_token_time = time.monotonic()
                 self.slots[slot] = st
                 self.active[slot] = True
@@ -233,6 +269,8 @@ class LLMEngine:
                     tok = self._sample(logits_np[i])
                     st = self.slots[i]
                     st.generated.append(tok)
+                    if st.token_queue is not None:
+                        st.token_queue.put(tok)
                     self.lengths[i] += 1
                     self.last_tokens[i, 0] = tok
             for i in range(self.config.max_batch_size):
@@ -259,7 +297,10 @@ class LLMEngine:
             with self._lock:
                 self.active[slot] = False
                 self.slots[slot] = None
-            st.future.set_result(result)
+            if st.token_queue is not None:
+                st.token_queue.put(None)  # end-of-stream
+            if not st.future.done():
+                st.future.set_result(result)
 
 
 # ------------------------------------------------------------------ serve glue
@@ -294,5 +335,11 @@ def build_llm_deployment(config: LLMConfig | None = None, num_replicas: int = 1)
 
         def stats(self) -> dict:
             return self.engine.stats()
+
+        def stream_tokens(self, body: dict):
+            """Generator: one token id per yield (serve streaming path)."""
+            yield from self.engine.generate_stream(
+                body.get("prompt_ids", []), body.get("max_tokens")
+            )
 
     return LLMServer.bind(cfg)

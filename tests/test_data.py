@@ -208,3 +208,17 @@ def test_batch_llm_processor():
         assert all(int(r["num_generated"]) == 5 for r in rows)
     finally:
         proc.shutdown()
+
+
+def test_read_images(tmp_path):
+    from PIL import Image
+
+    for i in range(4):
+        Image.new("RGB", (16 + i, 16 + i), color=(i * 20, 0, 0)).save(tmp_path / f"im{i}.png")
+    (tmp_path / "junk.txt").write_text("not an image")
+    ds = rdata.read_images(str(tmp_path), size=(8, 8))
+    rows = ds.take_all()
+    assert len(rows) == 4
+    assert rows[0]["image"].shape == (8, 8, 3)
+    batch = next(iter(ds.iter_batches(batch_size=4, batch_format="jax")))
+    assert batch["image"].shape == (4, 8, 8, 3)
