@@ -187,9 +187,13 @@ class Dataset:
         their position in the chain."""
         stream: Iterator[Block] = self._source_fn()
         segment: list[PhysicalOp] = []
+        # per-execution sink, atomically rebound: concurrent iterations of the
+        # same Dataset each own their list; stats() shows the latest execution
+        sink: list = []
+        self._last_stats = sink
 
         def flush(s: Iterator[Block], seg: list[PhysicalOp]) -> Iterator[Block]:
-            return execute_streaming(s, seg) if seg else s
+            return execute_streaming(s, seg, stats_sink=sink) if seg else s
 
         for op in self._ops:
             if op.kind == "limit":
@@ -225,6 +229,20 @@ class Dataset:
         for b in self.iter_blocks():
             return b.schema()
         return {}
+
+    def stats(self) -> str:
+        """Per-operator counters for the LAST execution of this dataset
+        (reference: Dataset.stats / _internal stats.py)."""
+        rows = getattr(self, "_last_stats", [])
+        if not rows:
+            return "No execution stats recorded yet (run an action first)."
+        lines = []
+        for st in rows:
+            lines.append(
+                f"{st.name}: blocks_in={st.blocks_in} blocks_out={st.blocks_out} "
+                f"rows_out={st.rows_out}"
+            )
+        return "\n".join(lines)
 
     def materialize(self) -> "Dataset":
         blocks = list(self.iter_blocks())

@@ -45,17 +45,23 @@ def execute_streaming(
     source: Iterator[Block],
     ops: list[PhysicalOp],
     preserve_order: bool = True,
+    stats_sink: list | None = None,
 ) -> Iterator[Block]:
     """Run blocks from `source` through `ops`, yielding result blocks.
 
     Each op keeps ≤ max_in_flight tasks outstanding; completed blocks flow to
     the next op without waiting for stage completion (streaming, not bulk).
+    Per-op counters land in `stats_sink` (reference: data stats.py).
     """
+    # NOTE: not a generator — stats register eagerly (in pipeline order) even
+    # though block flow is lazy; the inner generator does the streaming.
     stats = [OpStats(op.name) for op in ops]
+    if stats_sink is not None:
+        stats_sink.extend(stats)
     stream: Iterator[Block] = source
     for op, st in zip(ops, stats):
         stream = _apply_op(stream, op, st, preserve_order)
-    yield from stream
+    return stream
 
 
 def _apply_op(

@@ -222,3 +222,18 @@ def test_read_images(tmp_path):
     assert rows[0]["image"].shape == (8, 8, 3)
     batch = next(iter(ds.iter_batches(batch_size=4, batch_format="jax")))
     assert batch["image"].shape == (4, 8, 8, 3)
+
+
+def test_dataset_stats():
+    ds = rdata.range(100).map_batches(lambda b: {"id": b["id"]}).filter(lambda r: r["id"] < 50)
+    assert "No execution stats" in ds.stats()
+    ds.count()
+    s = ds.stats()
+    assert "MapBatches" in s and "Filter" in s and "rows_out=50" in s
+
+
+def test_stats_pipeline_order_with_limit():
+    ds = rdata.range(100).map(lambda r: {"id": r["id"]}).limit(50).filter(lambda r: True)
+    ds.count()
+    s = ds.stats()
+    assert s.index("Map") < s.index("Filter")  # pipeline order preserved
