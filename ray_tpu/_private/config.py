@@ -41,6 +41,17 @@ class Config:
     lease_reuse: bool = True  # reuse worker leases per scheduling key (normal_task_submitter.cc)
     worker_pool_prestart: int = 0
 
+    # --- execution backend (reference: every task executes in a worker process,
+    #     task_receiver.cc:228; "thread" is an in-process debugging mode) ---
+    task_execution: str = "process"  # "process" | "thread"
+    process_workers: int = 0  # workers per node pool; 0 = min(cpu_count, 8)
+
+    # --- control plane (reference: gcs_server + raylet gRPC mesh) ---
+    control_plane_host: str = "127.0.0.1"
+    control_plane_port: int = 0  # 0 = ephemeral
+    agent_heartbeat_period_s: float = 0.5
+    agent_heartbeat_timeout_s: float = 5.0
+
     # --- health / fault tolerance (reference: ray_config_def.h:985-991) ---
     health_check_initial_delay_s: float = 5.0
     health_check_period_s: float = 3.0

@@ -19,23 +19,24 @@ zero-copy numpy reads from plasma). Design:
 from __future__ import annotations
 
 import pickle
+import sys
 from typing import Any, Iterable
 
 import cloudpickle
 
-_JAX_TYPES = None
-
 
 def _jax_array_types():
-    global _JAX_TYPES
-    if _JAX_TYPES is None:
-        try:
-            import jax
-
-            _JAX_TYPES = (jax.Array,)
-        except Exception:  # pragma: no cover - jax always present in this env
-            _JAX_TYPES = ()
-    return _JAX_TYPES
+    # Never IMPORT jax here: a value can only be a jax.Array if jax is already
+    # loaded in this process, and importing jax in a fresh worker is multi-
+    # second (plus sitecustomize hooks may register a TPU platform the worker
+    # must not touch — one process per chip).
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return ()
+    try:
+        return (jax.Array,)
+    except AttributeError:  # partially-imported jax
+        return ()
 
 
 def _to_host(obj: Any) -> Any:

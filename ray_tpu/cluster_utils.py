@@ -2,12 +2,22 @@
 
 Parity: python/ray/cluster_utils.py (Cluster :141, add_node :208) — the
 reference's single most load-bearing test asset (SURVEY §4): simulate
-multi-node scheduling/FT behavior without real machines. Here nodes are
-logical scheduler nodes (the single-controller analog of extra raylets).
+multi-node scheduling/FT behavior without real machines. Nodes come in two
+flavors:
+
+- logical nodes: extra entries in the head scheduler's resource view (fast,
+  for scheduling-policy tests), and
+- real nodes (``real_process=True``): a node-agent OS process that registers
+  over the TCP control plane, runs its own worker pool, and can be killed
+  with SIGKILL to exercise node-death fault tolerance — the analog of the
+  reference spawning extra raylets on one machine.
 """
 
 from __future__ import annotations
 
+import os
+import signal
+import time
 from typing import Optional
 
 import ray_tpu
@@ -18,6 +28,7 @@ from ray_tpu.core.runtime import get_runtime
 class Cluster:
     def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None):
         self._node_ids: list[NodeID] = []
+        self._agent_procs: dict[NodeID, "object"] = {}
         if initialize_head:
             args = dict(head_node_args or {})
             if not ray_tpu.is_initialized():
@@ -29,20 +40,74 @@ class Cluster:
     def add_node(self, *, num_cpus: float = 4, num_tpus: float = 0,
                  resources: dict | None = None, labels: dict | None = None,
                  slice_name: str | None = None,
-                 ici_coords: tuple | None = None) -> NodeID:
+                 ici_coords: tuple | None = None,
+                 real_process: bool = False,
+                 timeout: float = 60.0) -> NodeID:
         """Reference: cluster_utils.py:208 add_node."""
         res = {"CPU": float(num_cpus), **(resources or {})}
         if num_tpus:
             res["TPU"] = float(num_tpus)
-        nid = get_runtime().scheduler.add_node(
+        rt = get_runtime()
+        if real_process:
+            from ray_tpu.core.cluster import start_node_agent
+
+            if rt.control_plane is None:
+                raise RuntimeError("control plane unavailable; cannot start node agents")
+            before = {n.node_id for n in rt.scheduler.nodes()}
+            proc = start_node_agent(
+                rt.control_plane.address, rt.control_plane.token,
+                num_cpus=num_cpus, resources=resources, labels=labels,
+                slice_name=slice_name, ici_coords=ici_coords,
+            )
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                new = [n.node_id for n in rt.scheduler.nodes()
+                       if n.node_id not in before and n.node_id in rt._agents]
+                if new:
+                    nid = new[0]
+                    self._agent_procs[nid] = proc
+                    self._node_ids.append(nid)
+                    return nid
+                if proc.poll() is not None:
+                    raise RuntimeError(f"node agent exited rc={proc.returncode} before registering")
+                time.sleep(0.05)
+            proc.kill()
+            raise TimeoutError("node agent did not register in time")
+        nid = rt.scheduler.add_node(
             res, labels=labels, slice_name=slice_name, ici_coords=ici_coords
         )
-        get_runtime().scheduler.retry_pending_pgs()
+        rt.scheduler.retry_pending_pgs()
         self._node_ids.append(nid)
         return nid
 
+    def agent_pid(self, node_id: NodeID) -> int | None:
+        proc = self._agent_procs.get(node_id)
+        return proc.pid if proc is not None else None
+
+    @staticmethod
+    def _reap(proc) -> None:
+        try:
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+
+    def kill_node(self, node_id: NodeID) -> None:
+        """SIGKILL a real node agent (node-death chaos; the head notices via
+        socket EOF / missed heartbeats)."""
+        proc = self._agent_procs.pop(node_id, None)
+        if proc is None:
+            raise ValueError("kill_node requires a real_process node")
+        os.kill(proc.pid, signal.SIGKILL)
+        self._reap(proc)
+        if node_id in self._node_ids:
+            self._node_ids.remove(node_id)
+
     def remove_node(self, node_id: NodeID) -> None:
         """Node death: resources vanish; queued work reschedules elsewhere."""
+        proc = self._agent_procs.pop(node_id, None)
+        if proc is not None:
+            proc.terminate()
+            self._reap(proc)
         get_runtime().scheduler.remove_node(node_id)
         if node_id in self._node_ids:
             self._node_ids.remove(node_id)
@@ -52,4 +117,13 @@ class Cluster:
         return list(self._node_ids)
 
     def shutdown(self) -> None:
+        procs = list(self._agent_procs.values())
+        self._agent_procs.clear()
+        for proc in procs:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in procs:
+            self._reap(proc)
         ray_tpu.shutdown()

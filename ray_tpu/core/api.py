@@ -142,7 +142,9 @@ _DEFAULT_TASK_OPTIONS = dict(
     name=None,
     scheduling_strategy=None,
     runtime_env=None,
-    isolate_process=False,  # run in an OS worker process (crash FT, no GIL)
+    # None follows config.task_execution (default: OS worker processes);
+    # True/False force process/thread execution for this task.
+    isolate_process=None,
 )
 
 _DEFAULT_ACTOR_OPTIONS = dict(
@@ -258,7 +260,7 @@ class RemoteFunction:
             retry_exceptions=opts["retry_exceptions"],
             name=opts["name"] or self._fn.__name__,
             runtime_env=opts["runtime_env"],
-            isolate_process=bool(opts.get("isolate_process")),
+            isolate_process=opts.get("isolate_process"),
             **spec_kwargs,
         )
         refs = rt.submit_task(spec)
@@ -280,17 +282,25 @@ class RemoteFunction:
 class ActorMethod:
     """Reference: python/ray/actor.py:848 (ActorMethod)."""
 
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns=1):
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns=1,
+                 extra_opts: dict | None = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._extra_opts = extra_opts or {}
 
     def remote(self, *args, **kwargs):
-        return self._remote(args, kwargs, {"num_returns": self._num_returns})
+        return self._remote(
+            args, kwargs, {"num_returns": self._num_returns, **self._extra_opts}
+        )
 
     def options(self, **opts) -> "ActorMethod":
-        m = ActorMethod(self._handle, self._method_name, opts.get("num_returns", self._num_returns))
-        return m
+        """Per-call overrides (num_returns, max_task_retries, retry_exceptions)."""
+        extra = {**self._extra_opts, **{k: v for k, v in opts.items() if k != "num_returns"}}
+        return ActorMethod(
+            self._handle, self._method_name,
+            opts.get("num_returns", self._num_returns), extra,
+        )
 
     def bind(self, *args, **kwargs):
         """Lazy DAG node (reference: actor.method.bind, python/ray/dag)."""
@@ -325,7 +335,9 @@ class ActorHandle:
         if not hasattr(self._cls, item):
             raise AttributeError(f"Actor {self._cls.__name__} has no method '{item}'")
         opts = getattr(getattr(self._cls, item), "__ray_tpu_method_opts__", {})
-        return ActorMethod(self, item, num_returns=opts.get("num_returns", 1))
+        extra = {k: v for k, v in opts.items() if k != "num_returns"}
+        return ActorMethod(self, item, num_returns=opts.get("num_returns", 1),
+                           extra_opts=extra)
 
     def __reduce__(self):
         return (_rehydrate_actor_handle, (self._actor_id.binary(), self._cls))

@@ -1,0 +1,285 @@
+"""Client runtime: the worker-process side of the control plane.
+
+Parity: the reference's CoreWorker-embedded-in-every-worker model
+(src/ray/core_worker/core_worker.h:168) — a worker process is a first-class
+runtime participant that can submit tasks, create actors, and get/put objects.
+Here the worker holds a thin RPC client to the head's control plane
+(ray_tpu/core/cluster.py) plus a direct mapping of the node's shared-memory
+store for zero-copy reads; the head remains the authoritative scheduler and
+object directory (single-controller design).
+
+Installed by worker_main at startup (install_client_runtime), it registers as
+the process-global runtime so the public API (ray_tpu.get/put/remote/actors)
+works unchanged inside tasks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+
+
+class _ActorStateShim:
+    def __init__(self, cls):
+        self.cls = cls
+
+
+class _ClientRefCounter:
+    """Process-local refcounts that mirror 0→1 / 1→0 transitions to the head,
+    which holds one borrowed ref per (peer, object) while the client holds any
+    (reference: the borrowing protocol of reference_counter.cc — WORKER_REF_
+    REMOVED pubsub collapsed to explicit add/drop notifications)."""
+
+    def __init__(self, client: "ClientRuntime"):
+        self._client = client
+        self._counts: dict[ObjectID, int] = {}
+        self._lock = threading.Lock()
+
+    def add_local_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            n = self._counts.get(oid, 0)
+            self._counts[oid] = n + 1
+        if n == 0:
+            self._client._notify_ref("ref_add", oid)
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            n = self._counts.get(oid, 0) - 1
+            if n <= 0:
+                self._counts.pop(oid, None)
+            else:
+                self._counts[oid] = n
+        if n == 0:
+            self._client._notify_ref("ref_drop", oid)
+
+    # lineage/submitted-task refs are head-side concerns; no-ops here
+    def add_submitted_task_refs(self, oids) -> None:
+        pass
+
+    def remove_submitted_task_refs(self, oids) -> None:
+        pass
+
+    def add_lineage_ref(self, oid) -> None:
+        pass
+
+    def remove_lineage_ref(self, oid) -> None:
+        pass
+
+
+class ClientRuntime:
+    """Satisfies the Runtime surface the public API layer uses, over RPC."""
+
+    def __init__(self, host: str, port: int, token: str | None,
+                 shm_name: str | None, shm_size: int):
+        self._host, self._port, self._token = host, port, token
+        self._shm_name, self._shm_size = shm_name, shm_size
+        self._peer = None
+        self._store = None
+        self._lock = threading.Lock()
+        self.is_shutdown = False
+        self.reference_counter = _ClientRefCounter(self)
+        self._actor_cls_cache: dict[bytes, Any] = {}
+        from ray_tpu._private.ids import JobID
+
+        self.job_id = JobID.from_random()  # worker-local; head re-keys task ids
+
+    def _notify_ref(self, op: str, oid: ObjectID) -> None:
+        if self.is_shutdown:
+            return
+        try:
+            self._rpc().notify(op, oid=oid.binary())
+        except Exception:
+            pass  # best effort; the head also drops borrows on disconnect
+
+    # ------------------------------------------------------------ transport
+    def _rpc(self):
+        with self._lock:
+            if self._peer is None or self._peer.closed:
+                from ray_tpu.core import wire
+
+                self._peer = wire.connect(
+                    self._host, self._port, name=f"worker-{os.getpid()}"
+                )
+                self._peer.call("hello", token=self._token, kind="worker",
+                                pid=os.getpid(), timeout=10)
+            return self._peer
+
+    def _shm(self):
+        if self._store is None and self._shm_name:
+            try:
+                from ray_tpu.core.shm_store import SharedMemoryStore
+
+                self._store = SharedMemoryStore(self._shm_name, size=self._shm_size)
+            except Exception:
+                self._shm_name = None
+        return self._store
+
+    # ------------------------------------------------------------ objects
+    def put(self, value: Any) -> ObjectRef:
+        blob = serialization.serialize_to_bytes(value)
+        store = self._shm()
+        if store is not None and len(blob) > 100 * 1024:
+            oid_bin = self._rpc().call("client_put_alloc", timeout=30)
+            store.put_bytes(ObjectID(oid_bin), blob)
+            self._rpc().call("client_put_seal", oid=oid_bin, size=len(blob), timeout=30)
+        else:
+            oid_bin = self._rpc().call("client_put", blob=blob, timeout=60)
+        return ObjectRef(ObjectID(oid_bin), self)
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        entries = self._rpc().call(
+            "client_get",
+            oids=[r.object_id().binary() for r in refs],
+            get_timeout=timeout,
+            task=getattr(self, "_current_task", None),
+            timeout=None if timeout is None else timeout + 30,
+        )
+        out = []
+        for (kind, payload), ref in zip(entries, refs):
+            if kind == "err":
+                raise cloudpickle.loads(payload)
+            if kind == "shm":
+                store = self._shm()
+                view = store.get_bytes(ref.object_id()) if store is not None else None
+                if view is None:
+                    # segment not attachable (or evicted between reply and read):
+                    # re-fetch materialized through the head
+                    (kind2, payload2), = self._rpc().call(
+                        "client_get",
+                        oids=[ref.object_id().binary()],
+                        get_timeout=timeout, materialize=True,
+                        timeout=None if timeout is None else timeout + 30,
+                    )
+                    if kind2 == "err":
+                        raise cloudpickle.loads(payload2)
+                    out.append(serialization.deserialize_from_bytes(payload2))
+                    continue
+                out.append(serialization.deserialize_from_bytes(view))
+            else:
+                out.append(serialization.deserialize_from_bytes(payload))
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ready_bins, not_ready_bins = self._rpc().call(
+            "client_wait",
+            oids=[r.object_id().binary() for r in refs],
+            num_returns=num_returns, wait_timeout=timeout, fetch_local=fetch_local,
+            task=getattr(self, "_current_task", None),
+            timeout=None if timeout is None else timeout + 30,
+        )
+        by_bin = {r.object_id().binary(): r for r in refs}
+        return [by_bin[b] for b in ready_bins], [by_bin[b] for b in not_ready_bins]
+
+    def free(self, refs) -> None:
+        self._rpc().call("client_free", oids=[r.object_id().binary() for r in refs])
+
+    # ------------------------------------------------------------ tasks
+    def submit_task(self, spec) -> list[ObjectRef]:
+        """Nested submission: ship the spec's function/args to the head, which
+        re-submits through its own scheduler (ownership stays at the head —
+        single-controller analog of task spec forwarding)."""
+        if spec.placement_group is not None:
+            raise NotImplementedError(
+                "placement groups are not supported for tasks submitted from "
+                "inside workers yet; submit PG tasks from the driver"
+            )
+        opts = {
+            "num_returns": spec.num_returns,
+            "max_retries": spec.max_retries,
+            "retry_exceptions": spec.retry_exceptions,
+            "name": spec.name,
+            "resources": dict(spec.resources),
+            "runtime_env": spec.runtime_env,
+            "isolate_process": spec.isolate_process,
+        }
+        ref_bins, is_stream = self._rpc().call(
+            "client_submit",
+            func=cloudpickle.dumps(spec.func),
+            args=cloudpickle.dumps((spec.args, spec.kwargs)),
+            opts=opts, timeout=120,
+        )
+        return [ObjectRef(ObjectID(b), self) for b in ref_bins]
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        self._rpc().call("client_cancel", oid=ref.object_id().binary(), force=force)
+
+    # ------------------------------------------------------------ actors
+    def create_actor(self, cls, args, kwargs, options: dict) -> ActorID:
+        opts = {k: v for k, v in options.items() if k != "placement_group"}
+        if options.get("placement_group") is not None:
+            raise NotImplementedError(
+                "PG-placed actors cannot be created from inside workers yet"
+            )
+        actor_bin = self._rpc().call(
+            "client_create_actor",
+            cls=cloudpickle.dumps(cls),
+            args=cloudpickle.dumps((args, kwargs)),
+            opts=opts, timeout=120,
+        )
+        return ActorID(actor_bin)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
+                          options: dict) -> list[ObjectRef]:
+        ref_bins = self._rpc().call(
+            "client_actor_call",
+            actor=actor_id.binary(), method=method_name,
+            args=cloudpickle.dumps((args, kwargs)), opts=options, timeout=None,
+        )
+        return [ObjectRef(ObjectID(b), self) for b in ref_bins]
+
+    def get_actor(self, name: str, namespace: str = "default") -> ActorID:
+        return ActorID(self._rpc().call("client_get_actor", name=name,
+                                        namespace=namespace, timeout=30))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._rpc().call("client_kill_actor", actor=actor_id.binary(),
+                         no_restart=no_restart, timeout=30)
+
+    def actor_state(self, actor_id: ActorID):
+        key = actor_id.binary()
+        cls = self._actor_cls_cache.get(key)
+        if cls is None:
+            blob = self._rpc().call("client_actor_cls", actor=key, timeout=30)
+            cls = self._actor_cls_cache[key] = cloudpickle.loads(blob)
+        return _ActorStateShim(cls)
+
+    # ------------------------------------------------------------ streams
+    def next_stream_item(self, stream_id: ObjectID, index: int):
+        got = self._rpc().call("client_next_stream", stream=stream_id.binary(),
+                               index=index, timeout=None)
+        if got is None:
+            return None
+        if isinstance(got, tuple) and got[0] == "err":
+            raise cloudpickle.loads(got[1])
+        return ObjectRef(ObjectID(got), self)
+
+    def stream_completed(self, stream_id: ObjectID, index: int) -> bool:
+        return bool(self._rpc().call("client_stream_done",
+                                     stream=stream_id.binary(), index=index, timeout=30))
+
+    def shutdown(self) -> None:
+        self.is_shutdown = True
+        if self._peer is not None:
+            self._peer.close()
+
+
+def install_client_runtime(host: str, port: int, token: str | None,
+                           shm_name: str | None, shm_size: int) -> ClientRuntime:
+    """Make this process a runtime participant (worker_main startup hook)."""
+    from ray_tpu.core import runtime as rt_mod
+    from ray_tpu._private.config import Config, get_config, set_config
+
+    try:
+        get_config()
+    except Exception:
+        set_config(Config().apply_env_overrides())
+    client = ClientRuntime(host, port, token, shm_name, shm_size)
+    rt_mod.set_runtime(client)
+    return client

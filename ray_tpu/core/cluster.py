@@ -1,0 +1,358 @@
+"""Head-side control plane: the GCS + raylet-mesh equivalent.
+
+Parity map (reference):
+- ``ControlPlane`` ≈ the GCS server's RPC surface (src/ray/gcs/gcs_server.h:99,
+  grpc_services.cc) + the raylet registration/heartbeat handshake
+  (gcs/gcs_node_manager.cc, gcs_health_check_manager.h:46): node agents
+  register over TCP, heartbeat, and receive task dispatches; worker processes
+  connect as clients for nested submission/get/put (the CoreWorker↔GCS and
+  CoreWorker↔raylet planes collapsed onto one head server — single-controller
+  design).
+- ``start_node_agent`` ≈ `ray start --address=<head>` spawning a raylet
+  (python/ray/_private/services.py:1610 start_raylet).
+
+Nodes here are OS processes on one host sharing the shm object plane (the
+reference's test topology: multiple raylets on one machine,
+python/ray/cluster_utils.py:141). Cross-host agents use the same protocol; the
+object plane then needs the chunked transfer layer (ROADMAP).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import subprocess
+import sys
+import threading
+import time
+from typing import TYPE_CHECKING, Any, Optional
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu.core.wire import PeerDisconnected, RpcPeer, RpcServer
+
+if TYPE_CHECKING:
+    from ray_tpu.core.runtime import Runtime
+
+import logging
+
+logger = logging.getLogger("ray_tpu")
+
+
+class ControlPlane:
+    def __init__(self, runtime: "Runtime"):
+        self.runtime = runtime
+        self.token = secrets.token_hex(16)
+        cfg = runtime.config
+        self._hb: dict[NodeID, float] = {}
+        self._hb_lock = threading.Lock()
+        self.server = RpcServer(
+            handlers=self._handlers(),
+            host=cfg.control_plane_host,
+            port=cfg.control_plane_port,
+            on_disconnect=self._peer_gone,
+        )
+        self._closed = False
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="ray_tpu-hb-monitor"
+        )
+        self._monitor.start()
+
+    @property
+    def address(self) -> str:
+        host, port = self.server.address
+        return f"{host}:{port}"
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        self._closed = True
+        self.server.close()
+
+    def _monitor_loop(self) -> None:
+        """Active failure detection (reference: GcsHealthCheckManager
+        gcs_health_check_manager.h:46 — period/threshold probing)."""
+        timeout = self.runtime.config.agent_heartbeat_timeout_s
+        while not self._closed:
+            time.sleep(self.runtime.config.agent_heartbeat_period_s)
+            now = time.monotonic()
+            with self._hb_lock:
+                stale = [nid for nid, ts in self._hb.items() if now - ts > timeout]
+            for nid in stale:
+                logger.warning("node agent %s missed heartbeats; declaring dead", nid.hex()[:12])
+                with self._hb_lock:
+                    self._hb.pop(nid, None)
+                peer = self.runtime._agents.get(nid)
+                if peer is not None:
+                    peer.close()  # triggers _peer_gone -> node removal
+
+    def _peer_gone(self, peer: RpcPeer) -> None:
+        peer.meta.pop("held_refs", None)  # release the client's borrowed refs
+        nid = peer.meta.get("node_id")
+        if nid is not None:
+            with self._hb_lock:
+                self._hb.pop(nid, None)
+            self.runtime.on_node_death(nid)
+
+    # ---- distributed borrowing (reference: reference_counter.cc borrows +
+    # WORKER_REF_REMOVED channel): the head holds one ref per (client, object)
+    # while the client process holds any local refs.
+    def _hold_for(self, peer: RpcPeer, refs) -> None:
+        held = peer.meta.setdefault("held_refs", {})
+        for r in refs:
+            held.setdefault(r.object_id().binary(), r)
+
+    def _h_ref_add(self, peer: RpcPeer, msg: dict):
+        held = peer.meta.setdefault("held_refs", {})
+        if msg["oid"] not in held:
+            held[msg["oid"]] = ObjectRef(ObjectID(msg["oid"]), self.runtime)
+
+    def _h_ref_drop(self, peer: RpcPeer, msg: dict):
+        peer.meta.setdefault("held_refs", {}).pop(msg["oid"], None)
+
+    # ------------------------------------------------------------ handlers
+    def _handlers(self):
+        h = {
+            "hello": self._h_hello,
+            "register_node": self._h_register_node,
+            "heartbeat": self._h_heartbeat,
+            "client_submit": self._h_client_submit,
+            "client_get": self._h_client_get,
+            "client_put": self._h_client_put,
+            "client_put_alloc": self._h_client_put_alloc,
+            "client_put_seal": self._h_client_put_seal,
+            "client_wait": self._h_client_wait,
+            "client_free": self._h_client_free,
+            "client_cancel": self._h_client_cancel,
+            "client_create_actor": self._h_client_create_actor,
+            "client_actor_call": self._h_client_actor_call,
+            "client_get_actor": self._h_client_get_actor,
+            "client_kill_actor": self._h_client_kill_actor,
+            "client_actor_cls": self._h_client_actor_cls,
+            "client_next_stream": self._h_client_next_stream,
+            "client_stream_done": self._h_client_stream_done,
+            "ref_add": self._h_ref_add,
+            "ref_drop": self._h_ref_drop,
+        }
+        return {op: self._authed(op, fn) for op, fn in h.items()}
+
+    def _authed(self, op, fn):
+        def wrapper(peer: RpcPeer, msg: dict):
+            if op != "hello" and not peer.meta.get("auth"):
+                raise PermissionError("unauthenticated control-plane request")
+            return fn(peer, msg)
+
+        return wrapper
+
+    def _h_hello(self, peer: RpcPeer, msg: dict):
+        if msg.get("token") != self.token:
+            raise PermissionError("bad control-plane token")
+        peer.meta["auth"] = True
+        peer.meta["kind"] = msg.get("kind", "client")
+        return {"ok": True}
+
+    def _h_register_node(self, peer: RpcPeer, msg: dict):
+        rt = self.runtime
+        nid = rt.scheduler.add_node(
+            msg["resources"],
+            labels=msg.get("labels"),
+            slice_name=msg.get("slice_name"),
+            ici_coords=msg.get("ici_coords"),
+        )
+        peer.meta["node_id"] = nid
+        peer.meta["pid"] = msg.get("pid")
+        rt._agents[nid] = peer
+        with self._hb_lock:
+            self._hb[nid] = time.monotonic()
+        rt.scheduler.retry_pending_pgs()
+        logger.info("node agent registered: %s pid=%s resources=%s",
+                    nid.hex()[:12], msg.get("pid"), msg["resources"])
+        return {
+            "node_id": nid.binary(),
+            "shm_name": rt.shm_store.name if rt.shm_store else None,
+            "shm_size": rt.config.object_store_memory,
+        }
+
+    def _h_heartbeat(self, peer: RpcPeer, msg: dict):
+        nid = peer.meta.get("node_id")
+        if nid is not None:
+            with self._hb_lock:
+                self._hb[nid] = time.monotonic()
+        return True
+
+    # ---- worker/client object plane
+    def _h_client_get(self, peer: RpcPeer, msg: dict):
+        rt = self.runtime
+        if msg.get("task"):
+            rt.release_blocked_task_resources(msg["task"])
+        out = []
+        for ob in msg["oids"]:
+            oid = ObjectID(ob)
+            ref = ObjectRef(oid, rt)
+            try:
+                if not msg.get("materialize"):
+                    obj = rt.memory_store.get([oid], timeout=msg.get("get_timeout"))[0]
+                    if (
+                        obj.error is None and obj.in_shm
+                        and rt.shm_store is not None and rt.shm_store.contains(oid)
+                    ):
+                        out.append(("shm", None))
+                        continue
+                val = rt.get([ref], timeout=msg.get("get_timeout"))[0]
+                out.append(("val", serialization.serialize_to_bytes(val)))
+            except BaseException as e:  # noqa: BLE001
+                out.append(("err", cloudpickle.dumps(e)))
+        return out
+
+    def _h_client_put(self, peer: RpcPeer, msg: dict):
+        value = serialization.deserialize_from_bytes(msg["blob"])
+        ref = self.runtime.put(value)
+        self._hold_for(peer, [ref])
+        return ref.object_id().binary()
+
+    def _h_client_put_alloc(self, peer: RpcPeer, msg: dict):
+        rt = self.runtime
+        with rt._lock:
+            rt._put_index += 1
+            oid = ObjectID.for_put(rt.driver_task_id, rt._put_index)
+        return oid.binary()
+
+    def _h_client_put_seal(self, peer: RpcPeer, msg: dict):
+        """The worker wrote the blob into the shared store itself (zero-copy
+        path); register the object with the head's directory and pin it."""
+        rt = self.runtime
+        oid = ObjectID(msg["oid"])
+        from ray_tpu.core.object_store import RayObject
+
+        rt.shm_store.pin(oid)
+        rt.memory_store.put(oid, RayObject(size=msg["size"], in_shm=True))
+        self._hold_for(peer, [ObjectRef(oid, rt)])
+        return True
+
+    def _h_client_wait(self, peer: RpcPeer, msg: dict):
+        rt = self.runtime
+        if msg.get("task"):
+            rt.release_blocked_task_resources(msg["task"])
+        refs = [ObjectRef(ObjectID(b), rt) for b in msg["oids"]]
+        ready, not_ready = rt.wait(
+            refs, num_returns=msg["num_returns"], timeout=msg.get("wait_timeout"),
+            fetch_local=msg.get("fetch_local", True),
+        )
+        return (
+            [r.object_id().binary() for r in ready],
+            [r.object_id().binary() for r in not_ready],
+        )
+
+    def _h_client_free(self, peer: RpcPeer, msg: dict):
+        rt = self.runtime
+        rt.free([ObjectRef(ObjectID(b), rt) for b in msg["oids"]])
+        return True
+
+    def _h_client_cancel(self, peer: RpcPeer, msg: dict):
+        rt = self.runtime
+        rt.cancel(ObjectRef(ObjectID(msg["oid"]), rt), force=msg.get("force", False))
+        return True
+
+    # ---- worker/client task + actor plane
+    def _h_client_submit(self, peer: RpcPeer, msg: dict):
+        from ray_tpu.core import api
+
+        func = cloudpickle.loads(msg["func"])
+        args, kwargs = cloudpickle.loads(msg["args"])  # refs rebind to head runtime
+        opts = {k: v for k, v in (msg.get("opts") or {}).items() if v is not None}
+        resources = opts.pop("resources", None) or {}
+        if "CPU" in resources:
+            opts["num_cpus"] = resources.pop("CPU")
+        if "TPU" in resources:
+            opts["num_tpus"] = resources.pop("TPU")
+        if resources:
+            opts["resources"] = resources
+        rf = api.remote(**opts)(func) if opts else api.remote(func)
+        result = rf.remote(*args, **kwargs)
+        if isinstance(result, ObjectRefGenerator):
+            return [result._stream_id.binary()], True
+        refs = result if isinstance(result, list) else [result]
+        self._hold_for(peer, refs)
+        return [r.object_id().binary() for r in refs], False
+
+    def _h_client_create_actor(self, peer: RpcPeer, msg: dict):
+        cls = cloudpickle.loads(msg["cls"])
+        args, kwargs = cloudpickle.loads(msg["args"])
+        actor_id = self.runtime.create_actor(cls, args, kwargs, msg.get("opts") or {})
+        return actor_id.binary()
+
+    def _h_client_actor_call(self, peer: RpcPeer, msg: dict):
+        args, kwargs = cloudpickle.loads(msg["args"])
+        refs = self.runtime.submit_actor_task(
+            ActorID(msg["actor"]), msg["method"], args, kwargs, msg.get("opts") or {}
+        )
+        self._hold_for(peer, refs)
+        return [r.object_id().binary() for r in refs]
+
+    def _h_client_get_actor(self, peer: RpcPeer, msg: dict):
+        return self.runtime.get_actor(
+            msg["name"], msg.get("namespace") or "default"
+        ).binary()
+
+    def _h_client_kill_actor(self, peer: RpcPeer, msg: dict):
+        self.runtime.kill_actor(ActorID(msg["actor"]), no_restart=msg.get("no_restart", True))
+        return True
+
+    def _h_client_actor_cls(self, peer: RpcPeer, msg: dict):
+        state = self.runtime.actor_state(ActorID(msg["actor"]))
+        if state is None:
+            raise ValueError("unknown actor")
+        return cloudpickle.dumps(state.cls)
+
+    def _h_client_next_stream(self, peer: RpcPeer, msg: dict):
+        try:
+            ref = self.runtime.next_stream_item(ObjectID(msg["stream"]), msg["index"])
+        except BaseException as e:  # noqa: BLE001
+            return ("err", cloudpickle.dumps(e))
+        if ref is None:
+            return None
+        self._hold_for(peer, [ref])
+        return ref.object_id().binary()
+
+    def _h_client_stream_done(self, peer: RpcPeer, msg: dict):
+        return self.runtime.stream_completed(ObjectID(msg["stream"]), msg["index"])
+
+    def _h_kv(self, peer: RpcPeer, msg: dict):
+        from ray_tpu.experimental import internal_kv
+
+        return internal_kv._internal_kv_get(msg["key"], namespace=msg.get("namespace"))
+
+
+# ------------------------------------------------------------------ agents
+def start_node_agent(
+    head_addr: str,
+    token: str,
+    num_cpus: float = 4,
+    resources: dict[str, float] | None = None,
+    labels: dict[str, str] | None = None,
+    slice_name: str | None = None,
+    ici_coords: tuple | None = None,
+    name: str = "",
+) -> subprocess.Popen:
+    """Spawn a node-agent OS process that joins the session (reference:
+    services.py:1610 start_raylet)."""
+    from ray_tpu.core.process_pool import worker_env
+
+    res = {"CPU": float(num_cpus), **(resources or {})}
+    cmd = [
+        sys.executable, "-m", "ray_tpu.core.node_agent",
+        "--head", head_addr,
+        "--token", token,
+        "--resources", json.dumps(res),
+        "--labels", json.dumps(labels or {}),
+    ]
+    if slice_name:
+        cmd += ["--slice-name", slice_name]
+    if ici_coords:
+        cmd += ["--ici-coords", json.dumps(list(ici_coords))]
+    if name:
+        cmd += ["--name", name]
+    return subprocess.Popen(cmd, env=worker_env())

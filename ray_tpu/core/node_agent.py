@@ -1,0 +1,132 @@
+"""Node agent: per-node daemon joining a session over the control plane.
+
+Parity: the raylet (src/ray/raylet/node_manager.h:144 + main.cc) — registers
+with the head (GCS equivalent), heartbeats, runs the node's worker pool, and
+executes task dispatches pushed by the head's scheduler. Runs as
+`python -m ray_tpu.core.node_agent --head host:port --token ...`.
+
+Same-host agents share the session's shm object plane (zero-copy results/args);
+the protocol itself is host-agnostic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True)
+    parser.add_argument("--token", required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--slice-name", default=None)
+    parser.add_argument("--ici-coords", default=None)
+    parser.add_argument("--name", default="")
+    args = parser.parse_args()
+
+    from ray_tpu.core.worker_main import _pin_worker_jax
+
+    _pin_worker_jax()
+
+    from ray_tpu.core import wire
+    from ray_tpu.core.process_pool import (
+        ProcessWorkerPool,
+        _RemoteTaskError,
+        wrap_with_runtime_env,
+    )
+
+    host, _, port = args.head.rpartition(":")
+    resources = json.loads(args.resources)
+
+    pool_box: dict = {}
+
+    def h_execute_task(peer, msg):
+        """Head-pushed task dispatch (reference: raylet grants a lease and the
+        spec lands on a pooled worker, task_receiver.cc:228)."""
+        pool = pool_box["pool"]
+        fn_blob = msg["fn"]
+        if msg.get("renv"):
+            import cloudpickle
+
+            fn = wrap_with_runtime_env(cloudpickle.loads(fn_blob), msg["renv"])
+            fn_blob = cloudpickle.dumps(fn)
+        try:
+            return pool.execute_blob(fn_blob, msg["args"], msg.get("oid"),
+                                     task_bin=msg.get("task"))
+        except _RemoteTaskError as e:
+            # Unwrap so the ORIGINAL app exception type crosses the wire
+            # (picklable) and head-side retry matching behaves like local tasks.
+            orig = e.original_exception()
+            if orig is not None:
+                raise orig from None
+            raise RuntimeError(e.remote_tb) from None
+
+    def h_kill_worker(peer, msg):
+        return pool_box["pool"].kill_random_worker()
+
+    def h_num_alive(peer, msg):
+        return pool_box["pool"].num_alive
+
+    def h_ping(peer, msg):
+        return "pong"
+
+    def h_shutdown(peer, msg):
+        os._exit(0)
+
+    peer = wire.connect(
+        host, int(port),
+        handlers={
+            "execute_task": h_execute_task,
+            "kill_worker": h_kill_worker,
+            "num_alive": h_num_alive,
+            "ping": h_ping,
+            "shutdown": h_shutdown,
+        },
+        name=f"agent-{os.getpid()}",
+    )
+    peer.call("hello", token=args.token, kind="agent", pid=os.getpid(), timeout=10)
+    reg = peer.call(
+        "register_node",
+        resources=resources,
+        labels=json.loads(args.labels),
+        slice_name=args.slice_name,
+        ici_coords=tuple(json.loads(args.ici_coords)) if args.ici_coords else None,
+        pid=os.getpid(),
+        name=args.name,
+        timeout=10,
+    )
+
+    num_workers = max(1, int(resources.get("CPU", 1)))
+    pool_box["pool"] = ProcessWorkerPool(
+        num_workers=num_workers,
+        shm_name=reg.get("shm_name"),
+        shm_size=reg.get("shm_size") or 0,
+        head_addr=args.head,
+        token=args.token,
+    )
+
+    # Heartbeat until the head goes away, then exit (reference: raylet dies
+    # when the GCS connection is lost).
+    period = float(os.environ.get("RAY_TPU_AGENT_HEARTBEAT_PERIOD_S", "0.5"))
+    try:
+        while not peer.closed:
+            try:
+                peer.notify("heartbeat")
+            except wire.PeerDisconnected:
+                break
+            time.sleep(period)
+    finally:
+        try:
+            pool_box["pool"].shutdown()
+        except Exception:
+            pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

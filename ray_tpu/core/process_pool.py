@@ -17,11 +17,14 @@ worker_pool.h:284). Tasks opted into process isolation run in forked workers:
 
 from __future__ import annotations
 
-import multiprocessing as mp
 import os
+import socket
+import subprocess
+import sys
 import threading
 import traceback
 from dataclasses import dataclass
+from multiprocessing.connection import Connection
 from typing import Any, Callable, Optional
 
 import cloudpickle
@@ -32,6 +35,84 @@ from ray_tpu.exceptions import ActorError
 class WorkerCrashedError(ActorError):
     """The worker process died while executing the task (system failure —
     retryable by default, matching the reference's max_retries semantics)."""
+
+
+@dataclass
+class ShmArg:
+    """Marker for a task argument living in the node's shared-memory store:
+    the worker resolves it zero-copy from the segment instead of the value
+    traveling over the pipe (the reference passes plasma object ids in task
+    specs the same way — args by reference, doc task-lifecycle.rst)."""
+
+    oid_bin: bytes
+
+
+def resolve_shm_args(args, kwargs, store, fetch=None):
+    """Replace top-level ShmArg markers with their deserialized values."""
+    from ray_tpu._private import serialization
+    from ray_tpu._private.ids import ObjectID
+
+    def conv(a):
+        if isinstance(a, ShmArg):
+            view = store.get_bytes(ObjectID(a.oid_bin)) if store is not None else None
+            if view is None:
+                if fetch is not None:
+                    return fetch(a.oid_bin)
+                raise WorkerCrashedError(
+                    f"shm arg {a.oid_bin.hex()[:12]} missing in worker store"
+                )
+            return serialization.deserialize_from_bytes(view)
+        return a
+
+    return tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
+
+
+def worker_env() -> dict:
+    """Child env hygiene for session-spawned processes (workers, node agents).
+
+    CPU-pinned workers (the default — the TPU chip admits one process, held by
+    the driver) must not run TPU-site bootstrap hooks; stripping them also cuts
+    worker cold-start from seconds to ~0.3s. RAY_TPU_WORKER_TPU=1 opts a pool
+    into inheriting the TPU environment untouched."""
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if env.get("RAY_TPU_WORKER_TPU") != "1":
+        exclude = env.get("RAY_TPU_WORKER_PYTHONPATH_EXCLUDE", ".axon_site")
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        parts = [p for p in parts if not any(x and x in p for x in exclude.split(","))]
+        env["PYTHONPATH"] = os.pathsep.join(parts + [pkg_root])
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env["PYTHONPATH"] = os.pathsep.join(
+            filter(None, [env.get("PYTHONPATH"), pkg_root])
+        )
+    return env
+
+
+def _set_current_task(task_bin: bytes | None) -> None:
+    """Tag the worker's client runtime with the executing task id so nested
+    get/wait can tell the head which task is blocking (resource release)."""
+    from ray_tpu.core import runtime as rt_mod
+
+    rt = rt_mod.get_runtime_or_none()
+    if rt is not None:
+        try:
+            rt._current_task = task_bin
+        except Exception:
+            pass
+
+
+def _client_fetch(oid_bin: bytes):
+    """Fetch a missing arg through the head (only when a client runtime is
+    installed in this worker; otherwise raises)."""
+    from ray_tpu.core import runtime as rt_mod
+    from ray_tpu.core.object_ref import ObjectRef
+    from ray_tpu._private.ids import ObjectID
+
+    rt = rt_mod.get_runtime_or_none()
+    if rt is None:
+        raise WorkerCrashedError(f"shm arg {oid_bin.hex()[:12]} missing and no head link")
+    return rt.get([ObjectRef(ObjectID(oid_bin), rt)])[0]
 
 
 def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
@@ -46,6 +127,14 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             store = None
     from ray_tpu._private import serialization
 
+    def _reply(payload) -> None:
+        try:
+            conn.send_bytes(cloudpickle.dumps(payload))
+        except (BrokenPipeError, OSError):
+            # parent (driver or node agent) died: exit quietly; the head's
+            # failure machinery re-runs the task elsewhere
+            os._exit(0)
+
     while True:
         try:
             msg = conn.recv_bytes()
@@ -54,14 +143,17 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         try:
             req = cloudpickle.loads(msg)
         except Exception:
-            conn.send_bytes(cloudpickle.dumps(("err", "request deserialization failed", None)))
+            _reply(("err", "request deserialization failed", None))
             continue
         if req[0] == "exit":
             return
-        _, oid_bin, fn_blob, args_blob = req
+        _, oid_bin, fn_blob, args_blob = req[:4]
+        task_bin = req[4] if len(req) > 4 else None
+        _set_current_task(task_bin)
         try:
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = serialization.deserialize_from_bytes(args_blob)
+            args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
             result = fn(*args, **kwargs)
             blob = serialization.serialize_to_bytes(result)
             sent = False
@@ -70,37 +162,51 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
                 try:
                     store.put_bytes(ObjectID(oid_bin), blob)
-                    conn.send_bytes(cloudpickle.dumps(("shm", oid_bin, len(blob))))
+                    _reply(("shm", oid_bin, len(blob)))
                     sent = True
                 except Exception:
                     pass  # store full/unreadable: fall back to the pipe
             if not sent:
-                conn.send_bytes(cloudpickle.dumps(("val", blob, len(blob))))
+                _reply(("val", blob, len(blob)))
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
             try:
                 exc_blob = cloudpickle.dumps(e)
             except Exception:
                 exc_blob = None
-            conn.send_bytes(cloudpickle.dumps(("err", tb, exc_blob)))
+            _reply(("err", tb, exc_blob))
+        finally:
+            _set_current_task(None)
 
 
 @dataclass
 class _Worker:
-    proc: mp.Process
+    proc: subprocess.Popen
     conn: Any
     busy: bool = False
+
+    def is_alive(self) -> bool:
+        return self.proc.poll() is None
 
 
 class ProcessWorkerPool:
     """Parent-side pool (reference: raylet/worker_pool.cc semantics)."""
 
     def __init__(self, num_workers: int = 2, shm_name: str | None = None,
-                 shm_size: int = 0):
-        self._ctx = mp.get_context("fork")  # same-process imports; cheap on linux
+                 shm_size: int = 0, head_addr: str | None = None,
+                 token: str | None = None):
+        # Workers are exec'd fresh (python -m ray_tpu.core.worker_main), never
+        # forked: the driver runs many threads (dispatcher, actor loops,
+        # JAX/XLA) and fork-with-threads can copy locks mid-acquire; fork-based
+        # mp start methods also re-prepare the parent's __main__ in the child,
+        # which re-executes driver scripts (and breaks stdin drivers). The
+        # reference execs default_worker.py for the same reasons
+        # (python/ray/_private/workers/default_worker.py:203).
         self._num = num_workers
         self._shm_name = shm_name
         self._shm_size = shm_size
+        self._head_addr = head_addr
+        self._token = token
         self._workers: list[_Worker] = []
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -108,27 +214,51 @@ class ProcessWorkerPool:
             self._spawn()
 
     def _spawn(self) -> "_Worker":
-        parent, child = self._ctx.Pipe()
-        proc = self._ctx.Process(
-            target=_worker_main, args=(child, self._shm_name, self._shm_size), daemon=True
+        parent_s, child_s = socket.socketpair()
+        cmd = [
+            sys.executable, "-m", "ray_tpu.core.worker_main",
+            "--fd", str(child_s.fileno()),
+        ]
+        if self._shm_name:
+            cmd += ["--shm-name", self._shm_name, "--shm-size", str(self._shm_size)]
+        if self._head_addr:
+            cmd += ["--head", self._head_addr]
+            if self._token:
+                cmd += ["--token", self._token]
+        proc = subprocess.Popen(
+            cmd, pass_fds=(child_s.fileno(),), close_fds=True, env=worker_env()
         )
-        proc.start()
-        child.close()
-        w = _Worker(proc, parent)
+        child_s.close()
+        conn = Connection(parent_s.detach())
+        w = _Worker(proc, conn)
         self._workers.append(w)
         return w
+
+    # Growth cap: demand overflow (tasks blocked in nested gets, num_cpus=0
+    # tasks) spawns extra workers instead of deadlocking — the reference
+    # similarly starts new workers while existing ones are blocked
+    # (worker_pool.cc PopWorker + blocked-task accounting).
+    MAX_WORKERS = int(os.environ.get("RAY_TPU_MAX_PROCESS_WORKERS", "64"))
 
     def _checkout(self) -> _Worker:
         with self._cv:
             while True:
                 for w in self._workers:
-                    if not w.busy and w.proc.is_alive():
+                    if not w.busy and w.is_alive():
                         w.busy = True
                         return w
-                # replace any dead idle workers, then wait
-                self._workers = [w for w in self._workers if w.proc.is_alive() or w.busy]
-                while len(self._workers) < self._num:
-                    self._spawn()
+                # replace any dead idle workers, then rescan (the fresh
+                # replacements are idle and claimable)
+                alive = [w for w in self._workers if w.is_alive() or w.busy]
+                if len(alive) != len(self._workers) or len(alive) < self._num:
+                    self._workers = alive
+                    while len(self._workers) < self._num:
+                        self._spawn()
+                    continue
+                if len(self._workers) < self.MAX_WORKERS:
+                    w = self._spawn()
+                    w.busy = True
+                    return w
                 self._cv.wait(0.1)
 
     def _drop_worker(self, w: "_Worker") -> None:
@@ -145,7 +275,8 @@ class ProcessWorkerPool:
             self._cv.notify_all()
 
     def execute(self, fn: Callable, args: tuple, kwargs: dict,
-                result_oid_bin: bytes | None = None, timeout: float | None = None):
+                result_oid_bin: bytes | None = None, timeout: float | None = None,
+                task_bin: bytes | None = None):
         """Run fn in a worker process; returns ('val', blob) | ('shm', oid_bin).
 
         Raises WorkerCrashedError if the worker dies mid-task; the caller's
@@ -153,15 +284,21 @@ class ProcessWorkerPool:
         """
         from ray_tpu._private import serialization
 
+        try:
+            fn_blob = cloudpickle.dumps(fn)
+            args_blob = serialization.serialize_to_bytes((args, kwargs))
+        except Exception as e:
+            raise ValueError(f"task not serializable for process isolation: {e}") from e
+        return self.execute_blob(fn_blob, args_blob, result_oid_bin, timeout, task_bin)
+
+    def execute_blob(self, fn_blob: bytes, args_blob: bytes,
+                     result_oid_bin: bytes | None = None,
+                     timeout: float | None = None,
+                     task_bin: bytes | None = None):
+        """Pre-marshalled form (used by the head dispatcher and node agents)."""
         w = self._checkout()
         try:
-            try:
-                req = cloudpickle.dumps(
-                    ("run", result_oid_bin, cloudpickle.dumps(fn),
-                     serialization.serialize_to_bytes((args, kwargs)))
-                )
-            except Exception as e:
-                raise ValueError(f"task not serializable for process isolation: {e}") from e
+            req = cloudpickle.dumps(("run", result_oid_bin, fn_blob, args_blob, task_bin))
             try:
                 w.conn.send_bytes(req)
                 if timeout is not None and not w.conn.poll(timeout):
@@ -183,14 +320,14 @@ class ProcessWorkerPool:
                 raise _RemoteTaskError(payload, exc_blob=extra)
             return status, payload, extra
         finally:
-            if w.proc.is_alive():
+            if w.is_alive():
                 self._checkin(w)
 
     def kill_random_worker(self) -> int:
         """Chaos hook: SIGKILL one busy-or-idle worker (tests worker-death FT)."""
         with self._lock:
             for w in self._workers:
-                if w.proc.is_alive():
+                if w.is_alive():
                     pid = w.proc.pid
                     os.kill(pid, 9)
                     return pid
@@ -204,14 +341,19 @@ class ProcessWorkerPool:
                 w.conn.send_bytes(cloudpickle.dumps(("exit",)))
             except Exception:
                 pass
-            w.proc.join(timeout=1)
-            if w.proc.is_alive():
+            try:
+                w.proc.wait(timeout=1)
+            except subprocess.TimeoutExpired:
                 w.proc.terminate()
+            try:
+                w.conn.close()
+            except Exception:
+                pass
 
     @property
     def num_alive(self) -> int:
         with self._lock:
-            return sum(1 for w in self._workers if w.proc.is_alive())
+            return sum(1 for w in self._workers if w.is_alive())
 
 
 def _run_with_env(fn, runtime_env, *args, **kwargs):

@@ -16,9 +16,10 @@ reference's three C++ planes at session scope:
 - Streaming generators (core_worker.cc:3399 HandleReportGeneratorItemReturns +
   generator_waiter.h backpressure).
 
-Execution backends: local mode runs tasks on threads gated by the resource scheduler
-(one logical node per configured node); cluster mode (ray_tpu/core/cluster.py) runs the
-same TaskSpecs on forked worker processes over the shared-memory object plane.
+Execution backends: tasks run on OS worker processes by default (ProcessWorkerPool
+over the shared-memory object plane, core/process_pool.py) with thread execution for
+tasks that opt out; the scheduler gates both behind one logical resource view per
+configured node.
 """
 
 from __future__ import annotations
@@ -90,7 +91,8 @@ class TaskSpec:
     method_name: str = ""
     is_actor_creation: bool = False
     runtime_env: dict | None = None
-    isolate_process: bool = False
+    # None = follow config.task_execution; True/False force process/thread
+    isolate_process: bool | None = None
 
     def return_ids(self) -> list[ObjectID]:
         n = 1 if isinstance(self.num_returns, str) else self.num_returns
@@ -112,6 +114,10 @@ class _TaskEntry:
     start_time: float | None = None
     end_time: float | None = None
     error: str | None = None
+    sched_req: "SchedulingRequest | None" = None
+    # Set when the task blocked in a nested get and its cpus were handed back
+    # (reference: NotifyDirectCallTaskBlocked, raylet_ipc_client.h)
+    resources_released: bool = False
 
 
 @dataclass
@@ -204,6 +210,17 @@ class Runtime:
         self._put_index = 0
         self._recovering: set[ObjectID] = set()
         self._pending_queue: "queue.Queue[TaskID]" = queue.Queue()
+        # Control plane: node agents register + heartbeat here; worker
+        # processes connect as clients for nested API calls (reference: the
+        # GCS/raylet gRPC mesh — gcs_server.h:99, node_manager.h:144).
+        self._agents: dict[NodeID, Any] = {}
+        self.control_plane = None
+        try:
+            from ray_tpu.core.cluster import ControlPlane
+
+            self.control_plane = ControlPlane(self)
+        except Exception as e:  # pragma: no cover
+            logger.warning("control plane unavailable (%s); nested worker API disabled", e)
         self._dispatcher = threading.Thread(target=self._dispatch_loop, daemon=True, name="ray_tpu-dispatcher")
         self._dispatcher.start()
         self._task_events: list[dict] = []
@@ -285,7 +302,32 @@ class Runtime:
         return obj.resolve()
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        """Reference: ray.wait (worker.py:3080) + core_worker wait semantics:
+        a lost-but-reconstructable object triggers recovery instead of hanging;
+        an unrecoverable one surfaces as a ready-with-error object. With
+        ``fetch_local=False`` availability is reported without forcing local
+        recovery (in a one-node store, present == local otherwise)."""
         ids = [r.object_id() for r in refs]
+        if fetch_local:
+            for oid in ids:
+                obj = self.memory_store.get_if_exists(oid)
+                lost = (obj is None and self.memory_store.was_deleted(oid)) or (
+                    obj is not None and isinstance(obj.error, ObjectLostError)
+                )
+                if not lost and obj is not None and obj.in_shm:
+                    # shm value evicted under memory pressure: treat as lost
+                    if self.shm_store is None or not self.shm_store.contains(oid):
+                        self.memory_store.delete([oid])
+                        lost = True
+                if lost:
+                    try:
+                        self._recover_object(oid)
+                    except ObjectLostError:
+                        # No lineage: mark permanently lost so wait() reports it
+                        # ready (get() then raises) instead of blocking forever.
+                        self.memory_store.put(
+                            oid, RayObject(error=ObjectLostError(oid.hex()))
+                        )
         ready_ids, not_ready_ids = self.memory_store.wait(ids, num_returns, timeout)
         by_id = {r.object_id(): r for r in refs}
         return [by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids]
@@ -413,6 +455,8 @@ class Runtime:
                 entry.node_id = node_id
                 entry.state = "RUNNING"
                 entry.start_time = time.time()
+                entry.sched_req = req
+                entry.resources_released = False
                 t = threading.Thread(
                     target=self._execute_task, args=(entry, req), daemon=True,
                     name=f"ray_tpu-worker-{entry.spec.desc()[:24]}",
@@ -450,15 +494,33 @@ class Runtime:
             return  # session torn down while this task was in flight
         self._record_event(spec, "RUNNING")
         try:
-            args, kwargs = self._resolve_args(spec)
             if spec.is_actor_creation:
+                args, kwargs = self._resolve_args(spec)
                 self._execute_actor_creation(spec, args, kwargs)
                 return  # actor holds its lease until death
             if isinstance(spec.num_returns, str):
+                args, kwargs = self._resolve_args(spec)
                 self._execute_generator(entry, args, kwargs)
-            elif spec.isolate_process:
-                self._execute_in_process(entry, args, kwargs)
+            elif self._use_process_execution(spec):
+                agent = self._agents.get(entry.node_id)
+                from ray_tpu.util import tracing
+
+                # Span recorded owner-side (the worker is another process);
+                # covers dispatch + remote execution, like the reference's
+                # submit-side task spans (util/tracing/tracing_helper.py).
+                if tracing.is_enabled():
+                    with tracing.span(f"task::{spec.desc()}",
+                                      {"task_id": spec.task_id.hex()[:16]}):
+                        if agent is not None:
+                            self._execute_on_agent(entry, agent)
+                        else:
+                            self._execute_in_process(entry)
+                elif agent is not None:
+                    self._execute_on_agent(entry, agent)
+                else:
+                    self._execute_in_process(entry)
             else:
+                args, kwargs = self._resolve_args(spec)
                 result = self._run_user_fn(entry, spec.func, args, kwargs)
                 self._store_returns(spec, result)
             entry.state = "FINISHED"
@@ -471,7 +533,7 @@ class Runtime:
             self._handle_task_failure(entry, e)
         finally:
             entry.end_time = time.time()
-            if not spec.is_actor_creation:
+            if not spec.is_actor_creation and self._claim_release(entry):
                 self.scheduler.release(entry.node_id, req)
                 self.scheduler.retry_pending_pgs()
             # Keep deps pinned across retries; release only at a terminal state.
@@ -509,15 +571,105 @@ class Runtime:
 
                 from ray_tpu.core.process_pool import ProcessWorkerPool
 
-                n = int(_os.environ.get("RAY_TPU_PROCESS_WORKERS", "2"))
+                n = self.config.process_workers or int(
+                    _os.environ.get("RAY_TPU_PROCESS_WORKERS", "0")
+                ) or min(_os.cpu_count() or 2, 8)
                 pool = self._proc_pool = ProcessWorkerPool(
                     num_workers=n,
                     shm_name=self.shm_store.name if self.shm_store else None,
                     shm_size=self.config.object_store_memory,
+                    head_addr=self.control_plane.address if self.control_plane else None,
+                    token=self.control_plane.token if self.control_plane else None,
                 )
         return pool
 
-    def _execute_in_process(self, entry: _TaskEntry, args, kwargs) -> None:
+    def _claim_release(self, entry: _TaskEntry) -> bool:
+        """Atomically claim the right to release this attempt's resources —
+        exactly one of {finish path, blocked-in-get notification} wins."""
+        with self._lock:
+            if entry.resources_released:
+                return False
+            entry.resources_released = True
+            return True
+
+    def release_blocked_task_resources(self, task_bin: bytes) -> None:
+        """A worker announced it is blocked in a nested get/wait: hand its cpus
+        back to the scheduler so the tasks it waits on can run (reference:
+        NotifyDirectCallTaskBlocked — raylet releases the blocked worker's
+        resources; the task finishes oversubscribed after unblocking)."""
+        try:
+            tid = TaskID(task_bin)
+        except Exception:
+            return
+        with self._lock:
+            entry = self._tasks.get(tid)
+        if (
+            entry is not None and entry.state == "RUNNING"
+            and entry.sched_req is not None
+            and not entry.spec.is_actor_creation
+        ):
+            if self._claim_release(entry):
+                self.scheduler.release(entry.node_id, entry.sched_req)
+                self.scheduler.retry_pending_pgs()
+
+    def on_node_death(self, node_id: NodeID) -> None:
+        """Agent vanished (socket EOF or missed heartbeats): remove the node;
+        its in-flight dispatches fail with PeerDisconnected and retry onto
+        surviving nodes (reference: node death -> task FT + lineage rebuild)."""
+        self._agents.pop(node_id, None)
+        try:
+            self.scheduler.remove_node(node_id)
+        except Exception:
+            pass
+        self.scheduler.retry_pending_pgs()
+        self.scheduler.notify()
+
+    def _use_process_execution(self, spec: TaskSpec) -> bool:
+        """Process workers are the default execution backend (reference: every
+        task executes in a worker process, task_receiver.cc:228). Per-task
+        isolate_process=True/False forces; None follows the config."""
+        if spec.func is None:
+            return False
+        if spec.isolate_process is not None:
+            return bool(spec.isolate_process)
+        return self.config.task_execution == "process"
+
+    def _marshal_args(self, spec: TaskSpec) -> bytes:
+        """Serialize (args, kwargs) for a worker: top-level refs to shm-backed
+        objects become ShmArg markers (resolved zero-copy in the worker);
+        other refs are materialized inline. Nested refs travel as refs and
+        rehydrate against the worker's client runtime."""
+        from ray_tpu.core.process_pool import ShmArg
+
+        def conv(a):
+            if isinstance(a, ObjectRef):
+                oid = a.object_id()
+                obj = self.memory_store.get_if_exists(oid)
+                if (
+                    obj is not None and obj.error is None and obj.in_shm
+                    and self.shm_store is not None and self.shm_store.contains(oid)
+                ):
+                    return ShmArg(oid.binary())
+                return self.get([a])[0]
+            return a
+
+        args = tuple(conv(a) for a in spec.args)
+        kwargs = {k: conv(v) for k, v in spec.kwargs.items()}
+        return serialization.serialize_to_bytes((args, kwargs))
+
+    def _task_blobs(self, spec: TaskSpec):
+        import cloudpickle
+
+        fn = spec.func
+        if spec.runtime_env:
+            # env applies INSIDE the worker process — true isolation (the
+            # reference's per-worker runtime_env model)
+            from ray_tpu.core.process_pool import wrap_with_runtime_env
+
+            fn = wrap_with_runtime_env(fn, spec.runtime_env)
+        return cloudpickle.dumps(fn), self._marshal_args(spec)
+
+    def _execute_in_process(self, entry: _TaskEntry) -> None:
         """Run the task in an OS worker process (crash -> system failure -> retry)."""
         from ray_tpu.core.process_pool import _RemoteTaskError
 
@@ -527,16 +679,19 @@ class Runtime:
         self._maybe_inject_chaos(spec)
         rids = spec.return_ids()
         oid_bin = rids[0].binary() if spec.num_returns == 1 else None
-        fn = spec.func
-        if spec.runtime_env:
-            # env applies INSIDE the worker process — true isolation (the
-            # reference's per-worker runtime_env model)
-            from ray_tpu.core.process_pool import wrap_with_runtime_env
-
-            fn = wrap_with_runtime_env(fn, spec.runtime_env)
         try:
-            status, payload, size = self._process_pool().execute(
-                fn, args, kwargs, result_oid_bin=oid_bin
+            fn_blob, args_blob = self._task_blobs(spec)
+        except Exception:
+            # Not serializable (closures over locks/queues/live handles):
+            # fall back to in-process execution rather than failing the task.
+            args, kwargs = self._resolve_args(spec)
+            result = self._run_user_fn(entry, spec.func, args, kwargs)
+            self._store_returns(spec, result)
+            return
+        try:
+            status, payload, size = self._process_pool().execute_blob(
+                fn_blob, args_blob, result_oid_bin=oid_bin,
+                task_bin=spec.task_id.binary(),
             )
         except _RemoteTaskError as e:
             # Re-raise the ORIGINAL exception type so retry_exceptions matching
@@ -546,6 +701,9 @@ class Runtime:
                 orig.__ray_tpu_remote_tb__ = e.remote_tb
                 raise orig from None
             raise RuntimeError(e.remote_tb) from None
+        self._store_worker_result(spec, rids, status, payload, size)
+
+    def _store_worker_result(self, spec, rids, status, payload, size) -> None:
         if status == "shm":
             # worker already sealed the result into the node store (zero-copy handoff)
             self.shm_store.pin(rids[0])
@@ -555,6 +713,31 @@ class Runtime:
             return
         result = serialization.deserialize_from_bytes(payload)
         self._store_returns(spec, result)
+
+    def _execute_on_agent(self, entry: _TaskEntry, agent) -> None:
+        """Dispatch to a node agent over the control plane (reference: lease
+        granted on a remote raylet -> PushNormalTask to its worker,
+        normal_task_submitter.cc:515)."""
+        from ray_tpu.core.wire import PeerDisconnected
+
+        spec = entry.spec
+        if entry.cancelled:
+            raise TaskCancelledError(spec.desc())
+        self._maybe_inject_chaos(spec)
+        rids = spec.return_ids()
+        oid_bin = rids[0].binary() if spec.num_returns == 1 else None
+        try:
+            fn_blob, args_blob = self._task_blobs(spec)
+        except Exception as e:
+            raise ValueError(f"task not serializable for remote dispatch: {e}") from e
+        try:
+            status, payload, size = agent.call(
+                "execute_task", fn=fn_blob, args=args_blob, oid=oid_bin,
+                task=spec.task_id.binary(), renv=None, timeout=None,
+            )
+        except PeerDisconnected as e:
+            raise ActorError(f"node agent died during task: {e}") from e
+        self._store_worker_result(spec, rids, status, payload, size)
 
     def _run_user_fn(self, entry: _TaskEntry, fn, args, kwargs):
         if entry.cancelled:
@@ -583,7 +766,7 @@ class Runtime:
 
     def _handle_task_failure(self, entry: _TaskEntry, exc: BaseException) -> None:
         spec = entry.spec
-        retry_ok = spec.max_retries > entry.attempts and _should_retry(spec, exc)
+        retry_ok = _retries_left(spec, entry.attempts) and _should_retry(spec, exc)
         if retry_ok:
             entry.attempts += 1
             logger.warning(
@@ -817,7 +1000,9 @@ class Runtime:
                 entry.state = "RUNNING"
                 entry.start_time = time.time()
             self._record_event(spec, "RUNNING")
+            retrying = False
             try:
+                self._maybe_inject_chaos(spec)
                 args, kwargs = self._resolve_args(spec)
                 method = getattr(state.instance, spec.method_name)
                 renv_ctx = self._runtime_env_ctx(state)
@@ -883,21 +1068,49 @@ class Runtime:
                     entry.end_time = time.time()
                 self._record_event(spec, "FINISHED")
             except BaseException as e:  # noqa: BLE001
+                # max_task_retries: re-run the method on system failures (and on
+                # app exceptions iff retry_exceptions opted in) — reference:
+                # ActorMethod max_task_retries (python/ray/actor.py:848). The
+                # retried attempt keeps its dep pins and pending-count slot.
+                attempts = entry.attempts if entry else 0
+                if (
+                    _retries_left(spec, attempts)
+                    and _should_retry(spec, e)
+                    and state.state == "ALIVE"
+                ):
+                    if entry:
+                        entry.attempts += 1
+                    retrying = True
+                    logger.warning(
+                        "Actor task %s failed (%s); retry %d/%d",
+                        spec.desc(), type(e).__name__, attempts + 1, spec.max_retries,
+                    )
+                    self._record_event(spec, "RETRYING")
+                    state.mailbox.put((spec, spec.return_ids()[0]))
+                    continue
                 if entry:
                     entry.state = "FAILED"
                     entry.end_time = time.time()
                 self._record_event(spec, "FAILED")
                 self._store_error(spec, TaskError(e, spec.desc()))
             finally:
-                self.reference_counter.remove_submitted_task_refs(
-                    [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
-                )
-                with state.lock:
-                    state.pending_count -= 1
+                if not retrying:
+                    self.reference_counter.remove_submitted_task_refs(
+                        [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+                    )
+                    with state.lock:
+                        state.pending_count -= 1
 
     def _execute_actor_generator(self, spec: TaskSpec, method, args, kwargs) -> None:
         stream_id = spec.return_ids()[0]
         stream = self._streams.setdefault(stream_id, _StreamState())
+        with stream.cv:
+            # A retry replays the stream from the start — clear any partial
+            # previous attempt so consumers don't see duplicated items.
+            stream.items.clear()
+            stream.done = False
+            stream.error = None
+            stream.cv.notify_all()
         index = 0
         for item in method(*args, **kwargs):
             item_id = ObjectID.for_task_return(spec.task_id, index + 1)
@@ -940,6 +1153,10 @@ class Runtime:
         return [ObjectRef(r, self) for r in spec.return_ids()]
 
     def _make_actor_task_spec(self, actor_id, method_name, args, kwargs, options) -> TaskSpec:
+        # Per-call max_task_retries overrides the actor-level default
+        # (reference: @ray.method(max_task_retries=...) over actor options).
+        state = self._actors.get(actor_id)
+        default_retries = state.max_task_retries if state else 0
         return TaskSpec(
             task_id=TaskID.for_actor_task(actor_id),
             func=None,
@@ -950,7 +1167,8 @@ class Runtime:
             name=f"{method_name}",
             actor_id=actor_id,
             method_name=method_name,
-            max_retries=options.get("max_task_retries", 0),
+            max_retries=options.get("max_task_retries", default_retries),
+            retry_exceptions=options.get("retry_exceptions", False),
         )
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
@@ -1081,6 +1299,16 @@ class Runtime:
             for _ in state.threads:
                 state.mailbox.put(None)
         self.scheduler.notify()
+        for agent in list(self._agents.values()):
+            try:
+                agent.notify("shutdown")
+            except Exception:
+                pass
+        if self.control_plane is not None:
+            try:
+                self.control_plane.close()
+            except Exception:
+                pass
         pool = getattr(self, "_proc_pool", None)
         if pool is not None:
             try:
@@ -1116,6 +1344,11 @@ def _ref_args(args, kwargs) -> list[ObjectRef]:
     out = [a for a in args if isinstance(a, ObjectRef)]
     out.extend(v for v in kwargs.values() if isinstance(v, ObjectRef))
     return out
+
+
+def _retries_left(spec: TaskSpec, attempts: int) -> bool:
+    """max_retries=-1 means retry indefinitely (reference: ray docs semantics)."""
+    return spec.max_retries < 0 or spec.max_retries > attempts
 
 
 def _should_retry(spec: TaskSpec, exc: BaseException) -> bool:

@@ -47,6 +47,8 @@ class _Lib:
             lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.shm_store_delete.restype = ctypes.c_int
             lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_abort.restype = ctypes.c_int
+            lib.shm_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.shm_store_base.restype = ctypes.c_void_p
             lib.shm_store_base.argtypes = [ctypes.c_void_p]
             lib.shm_store_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
@@ -83,34 +85,70 @@ class SharedMemoryStore:
         import numpy as np
 
         data = memoryview(data)
-        err = ctypes.c_int(0)
-        off = self._lib.shm_store_create_object(
-            self._handle, oid.binary(), len(data), ctypes.byref(err)
-        )
-        if err.value == 1:
-            # Entry exists — idempotent ONLY if it is sealed and readable; a
-            # crashed writer leaves an orphaned CREATING entry: reclaim it and
-            # retry once (delete frees CREATING entries regardless of pins).
-            if self.contains(oid):
-                return
-            self._lib.shm_store_delete(self._handle, oid.binary())
-            off = self._lib.shm_store_create_object(
-                self._handle, oid.binary(), len(data), ctypes.byref(err)
+        off = self._create_slot(oid, len(data))
+        if off is None:
+            return  # another writer already sealed this object (idempotent put)
+        try:
+            # single memcpy straight from the source buffer (no intermediate bytes())
+            dst = np.frombuffer(
+                (ctypes.c_char * len(data)).from_address(self._base + off), dtype=np.uint8
             )
-            if err.value != 0 or not off:
-                raise ObjectStoreFullError(
-                    f"object {oid.hex()[:12]} exists in an unreadable state"
-                )
-        if err.value != 0 or not off:
-            raise ObjectStoreFullError(
-                f"shm store cannot fit object of {len(data)} bytes (err={err.value})"
-            )
-        # single memcpy straight from the source buffer (no intermediate bytes())
-        dst = np.frombuffer(
-            (ctypes.c_char * len(data)).from_address(self._base + off), dtype=np.uint8
-        )
-        dst[:] = np.frombuffer(data, dtype=np.uint8)
+            dst[:] = np.frombuffer(data, dtype=np.uint8)
+        except BaseException:
+            # abort OUR in-progress create so the entry doesn't stay CREATING
+            # forever (the live-writer guard would otherwise block every later
+            # put of this oid for the life of the process)
+            self._lib.shm_store_abort(self._handle, oid.binary())
+            raise
         self._lib.shm_store_seal(self._handle, oid.binary())
+
+    def _create_slot(self, oid: ObjectID, size: int) -> Optional[int]:
+        """Allocate a CREATING entry; returns payload offset, or None if the
+        object is already sealed.
+
+        Conflict handling: a sealed duplicate is an idempotent no-op; an
+        unsealed entry whose writer pid is dead is a crash orphan the native
+        store reclaims; an unsealed entry with a LIVE writer is mid-memcpy —
+        we wait for its seal rather than freeing memory under it (delete
+        returns busy=2 for live writers)."""
+        import time
+
+        err = ctypes.c_int(0)
+        deadline = None
+        reclaim_attempts = 0
+        while True:
+            off = self._lib.shm_store_create_object(
+                self._handle, oid.binary(), size, ctypes.byref(err)
+            )
+            if err.value == 0 and off:
+                return off
+            if err.value == 1:
+                if self.contains(oid):
+                    return None
+                rc = self._lib.shm_store_delete(self._handle, oid.binary())
+                if rc != 2:
+                    # Orphan reclaimed or entry vanished: retry the create. A
+                    # DELETING entry with outstanding reader pins survives the
+                    # delete — bounded attempts, then let the caller fall back
+                    # (the runtime stores inline on ObjectStoreFullError).
+                    reclaim_attempts += 1
+                    if reclaim_attempts > 3:
+                        raise ObjectStoreFullError(
+                            f"object {oid.hex()[:12]} exists in an unreadable state"
+                        )
+                    continue
+                if deadline is None:
+                    deadline = time.monotonic() + 10.0
+                elif time.monotonic() > deadline:
+                    raise ObjectStoreFullError(
+                        f"object {oid.hex()[:12]} has been mid-write by a live "
+                        "process for >10s; giving up"
+                    )
+                time.sleep(0.001)
+                continue
+            raise ObjectStoreFullError(
+                f"shm store cannot fit object of {size} bytes (err={err.value})"
+            )
 
     def get_bytes(self, oid: ObjectID, timeout_ms: int = 0) -> Optional[memoryview]:
         """Zero-copy view of the sealed object.
@@ -134,7 +172,10 @@ class SharedMemoryStore:
             self._lib.shm_store_release(self._handle, oid.binary())
             return memoryview(data)
         weakref.finalize(buf, _release_pin, self._lib, self._handle, oid.binary())
-        return memoryview(buf)
+        # Read-only: arrays deserialized zero-copy alias the store segment; an
+        # in-place op on a writable view would silently mutate the object every
+        # reader sees (plasma marks client buffers immutable for the same reason).
+        return memoryview(buf).toreadonly()
 
     def contains(self, oid: ObjectID) -> bool:
         return bool(self._lib.shm_store_contains(self._handle, oid.binary()))

@@ -1,0 +1,65 @@
+"""Worker process entrypoint: `python -m ray_tpu.core.worker_main`.
+
+Parity: python/ray/_private/workers/default_worker.py:203 — workers are exec'd
+fresh (never forked from the multi-threaded driver), wired to the parent over
+an inherited socketpair fd, and attach the node's shared-memory object store
+by name.
+
+TPU discipline: the build/runtime environment admits ONE process per TPU chip
+(the driver holds it). Workers therefore pin JAX to CPU unless explicitly
+opted into TPU with RAY_TPU_WORKER_TPU=1 — this also counters sitecustomize
+hooks that force-register a TPU platform in every fresh interpreter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _pin_worker_jax() -> None:
+    if os.environ.get("RAY_TPU_WORKER_TPU") == "1":
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" in sys.modules:  # a sitecustomize already imported jax: re-pin it
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--fd", type=int, required=True)
+    parser.add_argument("--shm-name", default=None)
+    parser.add_argument("--shm-size", type=int, default=0)
+    parser.add_argument("--head", default=None, help="host:port of the head control plane")
+    parser.add_argument("--token", default=None)
+    args = parser.parse_args()
+
+    _pin_worker_jax()
+
+    from multiprocessing.connection import Connection
+
+    conn = Connection(args.fd)
+    if args.head:
+        # Install a client runtime so user code inside tasks can call
+        # ray_tpu.get/put/remote (nested submission through the head).
+        try:
+            from ray_tpu.core.client_runtime import install_client_runtime
+
+            host, _, port = args.head.rpartition(":")
+            install_client_runtime(host, int(port), args.token, args.shm_name, args.shm_size)
+        except Exception:
+            pass
+
+    from ray_tpu.core.process_pool import _worker_main
+
+    _worker_main(conn, args.shm_name, args.shm_size)
+
+
+if __name__ == "__main__":
+    main()

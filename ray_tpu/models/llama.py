@@ -190,6 +190,25 @@ def attention(q, k, v, causal: bool = True, mask=None):
     return out.reshape(B, S, Hq, D)
 
 
+def auto_attention(q, k, v, causal: bool = True):
+    """Pick the pallas flash kernel for long sequences on real TPU platforms,
+    dense MXU attention otherwise.
+
+    The crossover: at S>=1024 the [S,S] score matrix dominates HBM traffic and
+    the blockwise-softmax kernel wins; short sequences fit XLA's fused dense
+    path. Off-TPU the pallas kernel only runs in interpret mode (slow), so
+    dense is used there unconditionally."""
+    S = q.shape[1]
+    import jax as _jax
+
+    on_tpu = _jax.devices()[0].platform in ("tpu", "axon")
+    if causal and on_tpu and S >= 1024:
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True, interpret=False)
+    return attention(q, k, v, causal=causal)
+
+
 def _block(cfg: LlamaConfig, x, layer, positions, attn_fn):
     hd, nh, nkv = cfg.hd, cfg.num_heads, cfg.num_kv_heads
     B, S, h = x.shape
@@ -212,7 +231,7 @@ def _block(cfg: LlamaConfig, x, layer, positions, attn_fn):
 def forward(params, tokens, cfg: LlamaConfig, attn_fn=None, positions=None):
     """Token ids [B, S] → logits [B, S, vocab] (fp32)."""
     if attn_fn is None:
-        attn_fn = partial(attention, causal=True)
+        attn_fn = partial(auto_attention, causal=True)
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))

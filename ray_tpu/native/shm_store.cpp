@@ -29,8 +29,10 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/types.h>
 #include <unistd.h>
 
 namespace {
@@ -55,6 +57,7 @@ struct ObjectEntry {
   int32_t pins;        // client pin count (get without release)
   uint64_t lru_tick;   // last access tick for eviction
   uint64_t create_us;  // creation timestamp
+  int32_t writer_pid;  // pid of the CREATING writer (orphan detection)
 };
 
 struct FreeNode {   // lives inside the data arena
@@ -184,7 +187,31 @@ void free_entry_locked(Store* s, ObjectEntry* e) {
   e->state = OBJ_TOMBSTONE;  // preserve probe chains (see find_slot)
   memset(e->id, 0, kIdSize);
   e->pins = 0;
+  e->writer_pid = 0;
   h->num_objects--;
+  // If the slot after this one is FREE, no probe chain continues through it:
+  // convert the tombstone run ending here back to FREE so absent-key lookups
+  // don't degrade to full-table scans in long sessions.
+  uint64_t cap = h->table_cap;
+  uint64_t idx = (uint64_t)(e - s->table);
+  if (s->table[(idx + 1) % cap].state == OBJ_FREE) {
+    uint64_t i = idx;
+    while (s->table[i].state == OBJ_TOMBSTONE) {
+      s->table[i].state = OBJ_FREE;
+      i = (i + cap - 1) % cap;
+      if (i == idx) break;  // wrapped: entire table was tombstones
+    }
+  }
+}
+
+// Is the recorded writer of a CREATING entry still alive? EPERM counts as
+// alive (process exists under another uid); only ESRCH proves death. Our own
+// pid is alive too: another THREAD of this process may be mid-memcpy on the
+// entry — reclaiming it would free the chunk under that live writer.
+bool writer_alive(int32_t pid) {
+  if (pid <= 0) return false;
+  if ((pid_t)pid == getpid()) return true;
+  return kill((pid_t)pid, 0) == 0 || errno == EPERM;
 }
 
 // Evict least-recently-used sealed unpinned objects until an allocation of
@@ -322,6 +349,7 @@ uint64_t shm_store_create_object(void* handle, const uint8_t* id, uint64_t size,
   e->pins = 1;  // creator holds a pin until seal+release
   e->lru_tick = ++h->lru_clock;
   e->create_us = (uint64_t)time(nullptr) * 1000000ull;
+  e->writer_pid = (int32_t)getpid();
   h->num_objects++;
   *err = 0;
   pthread_mutex_unlock(&h->mu);
@@ -421,15 +449,24 @@ int shm_store_release(void* handle, const uint8_t* id) {
   return 0;
 }
 
+// Returns 0 freed/deferred, 1 absent, 2 busy (live writer mid-create), 3 lock err.
 int shm_store_delete(void* handle, const uint8_t* id) {
   Store* s = (Store*)handle;
   Header* h = s->hdr;
   if (lock_mu(h) != 0) return 3;
   ObjectEntry* e = find_slot(s, id, false);
   if (!e || e->state == OBJ_FREE) { pthread_mutex_unlock(&h->mu); return 1; }
-  // CREATING entries can have no readers (get only returns SEALED) — their only
-  // pin is the creator's. Deleting one reclaims an orphan from a crashed writer.
-  if (e->pins > 0 && e->state != OBJ_CREATING) {
+  if (e->state == OBJ_CREATING) {
+    // CREATING entries can have no readers (get only returns SEALED). Reclaim
+    // only when the recorded writer is verifiably dead (or is us): freeing the
+    // arena chunk under a live writer mid-memcpy would corrupt whatever object
+    // the allocator hands that memory to next.
+    if (writer_alive(e->writer_pid)) {
+      pthread_mutex_unlock(&h->mu);
+      return 2;
+    }
+    free_entry(s, e);
+  } else if (e->pins > 0) {
     e->state = OBJ_DELETING;  // invisible to get/contains; freed on last release
   } else {
     free_entry(s, e);
@@ -437,6 +474,24 @@ int shm_store_delete(void* handle, const uint8_t* id) {
   pthread_cond_broadcast(&h->cv);
   pthread_mutex_unlock(&h->mu);
   return 0;
+}
+
+// Abort this process's own in-progress create (failed copy, interrupted put):
+// frees the CREATING entry iff we are its recorded writer. Returns 0 freed,
+// 1 absent/not-creating/not-ours, 3 lock err.
+int shm_store_abort(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  if (lock_mu(h) != 0) return 3;
+  ObjectEntry* e = find_slot(s, id, false);
+  int rc = 1;
+  if (e && e->state == OBJ_CREATING && (pid_t)e->writer_pid == getpid()) {
+    free_entry(s, e);
+    pthread_cond_broadcast(&h->cv);
+    rc = 0;
+  }
+  pthread_mutex_unlock(&h->mu);
+  return rc;
 }
 
 void* shm_store_base(void* handle) { return ((Store*)handle)->base; }

@@ -78,6 +78,13 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
         """Persist the checkpoint into storage_path and enforce retention."""
+        if self.score_attribute and self.score_attribute not in metrics:
+            # Silently ranking a missing score as 0 can delete the genuinely
+            # best checkpoint; the reference raises on a missing score attribute.
+            raise ValueError(
+                f"score_attribute {self.score_attribute!r} missing from reported "
+                f"metrics {sorted(metrics)}; report it or drop score-based retention"
+            )
         dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
         if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
             if os.path.exists(dest):
@@ -97,7 +104,7 @@ class CheckpointManager:
         if self.score_attribute:
             rev = self.score_order == "max"
             ordered = sorted(
-                self._tracked, key=lambda t: t.metrics.get(self.score_attribute, 0), reverse=rev
+                self._tracked, key=lambda t: t.metrics[self.score_attribute], reverse=rev
             )
         else:
             ordered = sorted(self._tracked, key=lambda t: t.index, reverse=True)
@@ -113,7 +120,7 @@ class CheckpointManager:
         if self.score_attribute:
             rev = self.score_order == "max"
             return sorted(
-                self._tracked, key=lambda t: t.metrics.get(self.score_attribute, 0), reverse=rev
+                self._tracked, key=lambda t: t.metrics[self.score_attribute], reverse=rev
             )[0].checkpoint
         return self._tracked[-1].checkpoint
 

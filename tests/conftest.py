@@ -45,6 +45,30 @@ def ray_start_cluster():
 
 
 @pytest.fixture
+def counter_file(tmp_path):
+    """Cross-process invocation counter (tasks run in worker processes by
+    default, so closure-dict counters don't propagate back to the driver).
+    Call it inside a task to bump; `.count()` reads from the driver."""
+    path = str(tmp_path / "invocations")
+
+    def bump():
+        with open(path, "a") as f:
+            f.write("x")
+        with open(path) as f:
+            return len(f.read())
+
+    def count():
+        try:
+            with open(path) as f:
+                return len(f.read())
+        except FileNotFoundError:
+            return 0
+
+    bump.count = count
+    return bump
+
+
+@pytest.fixture
 def cpu_mesh8():
     import jax
 

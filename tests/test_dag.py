@@ -44,12 +44,10 @@ def test_actor_method_dag():
     assert dag.execute(7) == 12  # same actor, stateful across executions
 
 
-def test_diamond_dag_single_evaluation():
-    calls = {"n": 0}
-
+def test_diamond_dag_single_evaluation(counter_file):
     @ray_tpu.remote
     def src(x):
-        calls["n"] += 1
+        counter_file()
         return x + 1
 
     @ray_tpu.remote
@@ -68,7 +66,7 @@ def test_diamond_dag_single_evaluation():
         s = src.bind(inp)
         dag = join.bind(left.bind(s), right.bind(s))
     assert dag.execute(1) == 4 + 6
-    assert calls["n"] == 1  # shared dep evaluated once
+    assert counter_file.count() == 1  # shared dep evaluated once
 
 
 def test_compiled_dag_pipeline():

@@ -87,6 +87,7 @@ def test_process_workers_run_concurrently():
         time.sleep(0.6)
         return os.getpid()
 
+    ray_tpu.get([sleepy.remote() for _ in range(2)], timeout=60)  # warm the pool
     t0 = time.monotonic()
     pids = ray_tpu.get([sleepy.remote() for _ in range(2)], timeout=60)
     dt = time.monotonic() - t0

@@ -1,0 +1,193 @@
+"""Round-2 correctness fixes: actor task retries, wait() recovery, shm
+immutability/orphan handling, checkpoint score validation.
+
+Reference semantics: actor max_task_retries (python/ray/actor.py:848),
+ray.wait recovery (core_worker wait + FetchOrReconstruct), plasma read-only
+client buffers, CheckpointManager score validation.
+"""
+
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import get_runtime
+from ray_tpu.exceptions import ObjectLostError, TaskError
+
+
+# ------------------------------------------------------------- actor retries
+def test_actor_max_task_retries_chaos():
+    """Injected system failures on an actor method are consumed by
+    max_task_retries (reference: actor task FT on system failure)."""
+    ray_tpu.init(
+        num_cpus=4,
+        _system_config={"testing_rpc_failure": "flaky_method=2"},
+        ignore_reinit_error=False,
+    )
+    try:
+
+        @ray_tpu.remote(max_task_retries=3)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def flaky_method(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        # chaos consumes 2 budgeted failures; retries land the call
+        assert ray_tpu.get(c.flaky_method.remote(), timeout=15) == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_retry_exceptions_app_level(ray_start_regular):
+    """retry_exceptions=True opts an actor method into app-exception retries."""
+
+    @ray_tpu.remote
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def get_calls(self):
+            return self.calls
+
+        def fails_twice(self):
+            self.calls += 1
+            if self.calls < 3:
+                raise ValueError("transient")
+            return "ok"
+
+    a = Flaky.remote()
+    ref = a.fails_twice.options(max_task_retries=5, retry_exceptions=True).remote()
+    assert ray_tpu.get(ref, timeout=15) == "ok"
+    assert ray_tpu.get(a.get_calls.remote(), timeout=15) == 3
+
+
+def test_actor_task_no_retry_by_default(ray_start_regular):
+    """App exceptions are NOT retried without retry_exceptions (reference default)."""
+
+    @ray_tpu.remote(max_task_retries=3)
+    class Boom:
+        def __init__(self):
+            self.calls = 0
+
+        def get_calls(self):
+            return self.calls
+
+        def explode(self):
+            self.calls += 1
+            raise ValueError("app error")
+
+    a = Boom.remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(a.explode.remote(), timeout=15)
+    assert ray_tpu.get(a.get_calls.remote(), timeout=15) == 1
+
+
+# ------------------------------------------------------------- wait recovery
+def test_wait_recovers_lost_object(ray_start_regular, counter_file):
+    @ray_tpu.remote
+    def produce():
+        counter_file()
+        return 41
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref, timeout=60) == 41
+    get_runtime().memory_store.evict([ref.object_id()])
+    ready, not_ready = ray_tpu.wait([ref], timeout=60)
+    assert ready == [ref] and not_ready == []
+    assert ray_tpu.get(ref, timeout=60) == 41
+    assert counter_file.count() == 2
+
+
+def test_wait_permanently_lost_surfaces_error(ray_start_regular):
+    """An unrecoverable object (no lineage) comes back ready; get() raises —
+    instead of wait() hanging forever."""
+    ref = ray_tpu.put([1, 2, 3])
+    get_runtime().memory_store.evict([ref.object_id()])
+    ready, not_ready = ray_tpu.wait([ref], timeout=5)
+    assert ready == [ref]
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(ref, timeout=5)
+
+
+def test_wait_fetch_local_false_does_not_recover(ray_start_regular):
+    @ray_tpu.remote
+    def produce():
+        return 1
+
+    ref = produce.remote()
+    ray_tpu.get(ref, timeout=10)
+    get_runtime().memory_store.evict([ref.object_id()])
+    ready, not_ready = ray_tpu.wait([ref], timeout=0.2, fetch_local=False)
+    assert ready == [] and not_ready == [ref]
+
+
+# ------------------------------------------------------------- shm semantics
+def _orphan_writer(shm_name, size, oid_bin):
+    """Child: allocate a CREATING entry and die without sealing it."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.core.shm_store import SharedMemoryStore
+
+    store = SharedMemoryStore(shm_name, size=size)
+    err_off = store._create_slot(ObjectID(oid_bin), 1000)
+    assert err_off is not None
+    os._exit(0)  # no seal: leaves an orphaned CREATING entry
+
+
+def test_shm_orphaned_creating_entry_reclaimed():
+    from ray_tpu._private.ids import JobID, ObjectID, TaskID
+    from ray_tpu.core.shm_store import SharedMemoryStore
+
+    name = f"/raytpu_orph{os.getpid()}_{np.random.randint(1e9)}"
+    store = SharedMemoryStore(name, size=8 * 1024 * 1024, owner=True)
+    try:
+        o = ObjectID.for_put(TaskID.for_normal_task(JobID.from_random()), 1)
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_orphan_writer, args=(name, 8 * 1024 * 1024, o.binary()))
+        p.start()
+        p.join(timeout=30)
+        assert p.exitcode == 0
+        assert not store.contains(o)  # unsealed: invisible to readers
+        # the dead writer's orphan must be reclaimed, not block the put
+        store.put_bytes(o, b"x" * 500)
+        assert bytes(store.get_bytes(o)) == b"x" * 500
+    finally:
+        store.close()
+
+
+def test_shm_zero_copy_reads_are_readonly(ray_start_regular):
+    """Zero-copy arrays alias the store segment; in-place writes must fail
+    loudly instead of silently mutating the object for every reader."""
+    rt = get_runtime()
+    if rt.shm_store is None:
+        pytest.skip("native store unavailable")
+    arr = np.arange(200_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    assert not out.flags.writeable
+    with pytest.raises((ValueError, RuntimeError)):
+        out += 1
+    # the stored object is unchanged for later readers
+    again = ray_tpu.get(ref)
+    np.testing.assert_array_equal(again[:5], np.arange(5, dtype=np.float32))
+
+
+# ------------------------------------------------------------- checkpoints
+def test_checkpoint_missing_score_raises(tmp_path):
+    from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+    mgr = CheckpointManager(
+        str(tmp_path / "store"), num_to_keep=2, score_attribute="acc"
+    )
+    src = tmp_path / "ck"
+    src.mkdir()
+    (src / "data.txt").write_text("x")
+    mgr.register(Checkpoint.from_directory(str(src)), {"acc": 0.9})
+    with pytest.raises(ValueError, match="score_attribute"):
+        mgr.register(Checkpoint.from_directory(str(src)), {"loss": 0.1})

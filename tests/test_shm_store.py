@@ -119,7 +119,7 @@ def test_runtime_promotes_large_objects(ray_start_regular):
     assert np.array_equal(big, out)
 
 
-def test_runtime_shm_eviction_triggers_reconstruction(ray_start_regular):
+def test_runtime_shm_eviction_triggers_reconstruction(ray_start_regular, counter_file):
     import ray_tpu
     from ray_tpu.core.runtime import get_runtime
 
@@ -127,11 +127,9 @@ def test_runtime_shm_eviction_triggers_reconstruction(ray_start_regular):
     if rt.shm_store is None:
         pytest.skip("native store unavailable")
 
-    calls = {"n": 0}
-
     @ray_tpu.remote
     def produce():
-        calls["n"] += 1
+        counter_file()
         return np.ones(200_000)
 
     ref = produce.remote()
@@ -139,9 +137,9 @@ def test_runtime_shm_eviction_triggers_reconstruction(ray_start_regular):
     # simulate eviction from the shm store only
     rt.shm_store.delete(ref.object_id())
     gc.collect()
-    out = ray_tpu.get(ref, timeout=10)
+    out = ray_tpu.get(ref, timeout=60)
     assert out.shape == (200_000,)
-    assert calls["n"] == 2
+    assert counter_file.count() == 2
 
 
 def test_tombstone_preserves_probe_chains(store):
@@ -203,3 +201,31 @@ def test_live_ref_survives_memory_pressure(ray_start_regular):
     gc.collect()
     out = ray_tpu.get(keep, timeout=10)
     assert float(out[54321]) == 54321.0
+
+
+def test_abort_reclaims_own_creating_entry(store):
+    """A failed put (exception between create and seal) must not poison the
+    oid for the life of the process (live-writer guard + abort path)."""
+    o = oid(77)
+    off = store._create_slot(o, 4096)
+    assert off is not None  # entry now CREATING, owned by this pid
+    assert store._lib.shm_store_abort(store._handle, o.binary()) == 0
+    # the slot is reclaimed: a fresh put succeeds immediately
+    store.put_bytes(o, b"y" * 4096)
+    assert bytes(store.get_bytes(o)) == b"y" * 4096
+
+
+def test_put_bytes_failure_aborts_create(store):
+    o = oid(78)
+
+    class Evil:
+        """memoryview()-able object whose buffer copy fails."""
+
+        def __len__(self):
+            return 1024
+
+    with pytest.raises(Exception):
+        store.put_bytes(o, Evil())  # memoryview(Evil) raises TypeError
+    # regardless of where it failed, a follow-up put of the same oid works
+    store.put_bytes(o, b"z" * 512)
+    assert bytes(store.get_bytes(o)) == b"z" * 512
