@@ -42,12 +42,15 @@ class _ClientRefCounter:
         self._counts: dict[ObjectID, int] = {}
         self._lock = threading.Lock()
 
+    # Notifications are sent UNDER the lock: a drop-to-zero racing a re-add
+    # must reach the head in transition order, or the head's borrow is popped
+    # while the client still holds a live ref.
     def add_local_ref(self, oid: ObjectID) -> None:
         with self._lock:
             n = self._counts.get(oid, 0)
             self._counts[oid] = n + 1
-        if n == 0:
-            self._client._notify_ref("ref_add", oid)
+            if n == 0:
+                self._client._notify_ref("ref_add", oid)
 
     def remove_local_ref(self, oid: ObjectID) -> None:
         with self._lock:
@@ -56,8 +59,8 @@ class _ClientRefCounter:
                 self._counts.pop(oid, None)
             else:
                 self._counts[oid] = n
-        if n == 0:
-            self._client._notify_ref("ref_drop", oid)
+            if n == 0:
+                self._client._notify_ref("ref_drop", oid)
 
     # lineage/submitted-task refs are head-side concerns; no-ops here
     def add_submitted_task_refs(self, oids) -> None:
@@ -123,14 +126,23 @@ class ClientRuntime:
 
     # ------------------------------------------------------------ objects
     def put(self, value: Any) -> ObjectRef:
+        from ray_tpu._private.config import get_config
+
         blob = serialization.serialize_to_bytes(value)
         store = self._shm()
-        if store is not None and len(blob) > 100 * 1024:
-            oid_bin = self._rpc().call("client_put_alloc", timeout=30)
-            store.put_bytes(ObjectID(oid_bin), blob)
-            self._rpc().call("client_put_seal", oid=oid_bin, size=len(blob), timeout=30)
-        else:
-            oid_bin = self._rpc().call("client_put", blob=blob, timeout=60)
+        if store is not None and len(blob) > get_config().max_inline_object_size:
+            try:
+                oid_bin = self._rpc().call("client_put_alloc", timeout=30)
+                store.put_bytes(ObjectID(oid_bin), blob)
+                self._rpc().call("client_put_seal", oid=oid_bin, size=len(blob),
+                                 timeout=30)
+                return ObjectRef(ObjectID(oid_bin), self)
+            except Exception:
+                # Store full of pinned objects (or the alloc'd entry is
+                # unusable): route through the head, which spills/falls back
+                # inline — a worker put must degrade, not fail.
+                pass
+        oid_bin = self._rpc().call("client_put", blob=blob, timeout=120)
         return ObjectRef(ObjectID(oid_bin), self)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:

@@ -186,7 +186,12 @@ class ControlPlane:
     # ---- worker/client object plane
     def _h_client_get(self, peer: RpcPeer, msg: dict):
         rt = self.runtime
-        if msg.get("task"):
+        if msg.get("task") and any(
+            not rt.memory_store.contains(ObjectID(b)) for b in msg["oids"]
+        ):
+            # Only a get that will actually BLOCK releases the caller's
+            # resources (reference: NotifyDirectCallTaskBlocked fires on
+            # unready objects, not on every fetch).
             rt.release_blocked_task_resources(msg["task"])
         out = []
         for ob in msg["oids"]:
@@ -228,6 +233,8 @@ class ControlPlane:
         from ray_tpu.core.object_store import RayObject
 
         rt.shm_store.pin(oid)
+        if rt.spill is not None:
+            rt.spill.on_put(oid, msg["size"])
         rt.memory_store.put(oid, RayObject(size=msg["size"], in_shm=True))
         self._hold_for(peer, [ObjectRef(oid, rt)])
         return True
@@ -235,7 +242,9 @@ class ControlPlane:
     def _h_client_wait(self, peer: RpcPeer, msg: dict):
         rt = self.runtime
         if msg.get("task"):
-            rt.release_blocked_task_resources(msg["task"])
+            n_ready = sum(1 for b in msg["oids"] if rt.memory_store.contains(ObjectID(b)))
+            if n_ready < msg["num_returns"]:
+                rt.release_blocked_task_resources(msg["task"])
         refs = [ObjectRef(ObjectID(b), rt) for b in msg["oids"]]
         ready, not_ready = rt.wait(
             refs, num_returns=msg["num_returns"], timeout=msg.get("wait_timeout"),

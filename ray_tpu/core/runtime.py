@@ -183,12 +183,21 @@ class Runtime:
         self.shm_store = None
         import os as _os
 
+        self.spill = None
         if _os.environ.get("RAY_TPU_DISABLE_SHM") != "1":
             try:
                 from ray_tpu.core.shm_store import SharedMemoryStore
 
                 self.shm_store = SharedMemoryStore(
                     f"/raytpu_{self.job_id.hex()}", size=config.object_store_memory, owner=True
+                )
+                from ray_tpu.core.spill import SpillManager
+
+                self.spill = SpillManager(
+                    self.shm_store,
+                    _os.path.join(config.session_dir_prefix,
+                                  f"session_{self.job_id.hex()[:12]}", "spill"),
+                    threshold=config.object_spill_threshold,
                 )
             except Exception as e:  # pragma: no cover - toolchain missing
                 logger.warning("native shm store unavailable (%s); using memory store only", e)
@@ -247,11 +256,21 @@ class Runtime:
         if self.shm_store is not None and size > self.config.max_inline_object_size:
             try:
                 blob = serialization.serialize_to_bytes(value)
-                self.shm_store.put_bytes(oid, blob)
+                try:
+                    self.shm_store.put_bytes(oid, blob)
+                except Exception:
+                    # Store full of PINNED (referenced) objects: spill oldest
+                    # primaries to disk and retry (local_object_manager.cc:45
+                    # semantics), then fall back inline.
+                    if self.spill is None or not self.spill.spill_for(len(blob)):
+                        raise
+                    self.shm_store.put_bytes(oid, blob)
                 # Pin while referenced: LRU eviction must not take objects with
                 # live ObjectRefs (plasma pins primary copies of referenced
                 # objects). Released in _on_ref_zero.
                 self.shm_store.pin(oid)
+                if self.spill is not None:
+                    self.spill.on_put(oid, len(blob))
                 self.memory_store.put(oid, RayObject(size=len(blob), in_shm=True))
                 return
             except Exception as e:  # store full and unevictable -> inline fallback
@@ -291,11 +310,24 @@ class Runtime:
         if obj.in_shm:
             view = self.shm_store.get_bytes(oid) if self.shm_store else None
             if view is None:
+                # Spilled copy first (restore, reference: LocalObjectManager
+                # restore path), then lineage reconstruction.
+                if self.spill is not None:
+                    blob = self.spill.restore(oid)
+                    if blob is not None:
+                        return serialization.deserialize_from_bytes(blob)
+                    # restore race: a concurrent getter may have just re-seated
+                    # the object in shm — re-check before declaring it lost
+                    view = self.shm_store.get_bytes(oid) if self.shm_store else None
+                    if view is not None:
+                        return serialization.deserialize_from_bytes(view)
                 # Evicted under memory pressure -> recover via lineage
                 # (reference: plasma miss -> FetchOrReconstruct, §3.2.7).
                 self.memory_store.delete([oid])
                 self._recover_object(oid)
                 return _RETRY
+            if self.spill is not None:
+                self.spill.on_access(oid)
             # Zero-copy: arrays alias the shm segment; the pin taken by
             # get_bytes is released by the buffer's GC finalizer.
             return serialization.deserialize_from_bytes(view)
@@ -316,7 +348,11 @@ class Runtime:
                 )
                 if not lost and obj is not None and obj.in_shm:
                     # shm value evicted under memory pressure: treat as lost
-                    if self.shm_store is None or not self.shm_store.contains(oid):
+                    # (a spilled copy is still available, not lost)
+                    if (
+                        (self.shm_store is None or not self.shm_store.contains(oid))
+                        and not (self.spill is not None and self.spill.is_spilled(oid))
+                    ):
                         self.memory_store.delete([oid])
                         lost = True
                 if lost:
@@ -348,6 +384,8 @@ class Runtime:
         if self.shm_store is not None:
             self.shm_store.release(oid)  # drop the runtime's referenced-pin
             self.shm_store.delete(oid)
+        if self.spill is not None:
+            self.spill.on_delete(oid)  # GC the spill file too
         with self._lock:
             spec = self._lineage.pop(oid, None)
         if spec is not None:
@@ -360,6 +398,9 @@ class Runtime:
             for r in refs:
                 self.shm_store.release(r.object_id())
                 self.shm_store.delete(r.object_id())
+        if self.spill is not None:
+            for r in refs:
+                self.spill.on_delete(r.object_id())
 
     # ------------------------------------------------------------------ recovery
     def _recover_object(self, oid: ObjectID) -> None:
@@ -707,6 +748,8 @@ class Runtime:
         if status == "shm":
             # worker already sealed the result into the node store (zero-copy handoff)
             self.shm_store.pin(rids[0])
+            if self.spill is not None:
+                self.spill.on_put(rids[0], size or 0)
             self.memory_store.put(rids[0], RayObject(size=size or 0, in_shm=True))
             with self._lock:
                 self._recovering.discard(rids[0])
@@ -728,8 +771,15 @@ class Runtime:
         oid_bin = rids[0].binary() if spec.num_returns == 1 else None
         try:
             fn_blob, args_blob = self._task_blobs(spec)
-        except Exception as e:
-            raise ValueError(f"task not serializable for remote dispatch: {e}") from e
+        except Exception:
+            # Marshal failure is EITHER unserializable user objects OR a dep
+            # that resolved to a real error — run inline so the true exception
+            # surfaces (and unserializable tasks still execute), mirroring
+            # _execute_in_process's fallback.
+            args, kwargs = self._resolve_args(spec)
+            result = self._run_user_fn(entry, spec.func, args, kwargs)
+            self._store_returns(spec, result)
+            return
         try:
             status, payload, size = agent.call(
                 "execute_task", fn=fn_blob, args=args_blob, oid=oid_bin,
@@ -1313,6 +1363,11 @@ class Runtime:
         if pool is not None:
             try:
                 pool.shutdown()
+            except Exception:
+                pass
+        if self.spill is not None:
+            try:
+                self.spill.close()
             except Exception:
                 pass
         if self.shm_store is not None:

@@ -1,0 +1,162 @@
+"""Object spilling: primary copies overflow to disk under memory pressure.
+
+Parity: the raylet's LocalObjectManager (local_object_manager.h:45 — pins
+primary copies, spills them to external storage when the store fills,
+restores on demand, deletes spilled URLs when refs drop) together with
+python/ray/_private/external_storage.py (filesystem backend). Design doc:
+doc/source/ray-core/internals/object-spilling.rst.
+
+Differences from the reference, by design: eviction of UNREFERENCED objects
+stays pure-LRU in the native store (an unreferenced object is unreachable in
+the single-owner model, so spilling it would be waste); spilling targets
+REFERENCED (pinned) objects when a put cannot fit, which is exactly the case
+where the reference spills primaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import OrderedDict
+from typing import TYPE_CHECKING, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+if TYPE_CHECKING:
+    from ray_tpu.core.shm_store import SharedMemoryStore
+
+logger = logging.getLogger("ray_tpu")
+
+
+class SpillManager:
+    """Tracks shm-resident pinned objects (LRU) and their spilled files."""
+
+    def __init__(self, store: "SharedMemoryStore", spill_dir: str,
+                 threshold: float = 0.8):
+        self._store = store
+        self._dir = spill_dir
+        self._threshold = threshold
+        self._lock = threading.RLock()
+        # insertion-ordered: oldest puts first = spill victims
+        self._resident: "OrderedDict[ObjectID, int]" = OrderedDict()
+        self._spilled: dict[ObjectID, tuple[str, int]] = {}
+        self.spilled_bytes_total = 0
+        self.restored_bytes_total = 0
+
+    # ------------------------------------------------------------ bookkeeping
+    def on_put(self, oid: ObjectID, size: int) -> None:
+        with self._lock:
+            self._resident[oid] = size
+            self._resident.move_to_end(oid)
+
+    def on_access(self, oid: ObjectID) -> None:
+        with self._lock:
+            if oid in self._resident:
+                self._resident.move_to_end(oid)
+
+    def on_delete(self, oid: ObjectID) -> None:
+        """Ref dropped to zero / freed: forget the object and GC its file."""
+        with self._lock:
+            self._resident.pop(oid, None)
+            entry = self._spilled.pop(oid, None)
+        if entry is not None:
+            try:
+                os.unlink(entry[0])
+            except OSError:
+                pass
+
+    def is_spilled(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._spilled
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "spilled_objects": len(self._spilled),
+                "spilled_bytes_total": self.spilled_bytes_total,
+                "restored_bytes_total": self.restored_bytes_total,
+            }
+
+    # ------------------------------------------------------------ spill
+    def spill_for(self, need_bytes: int) -> int:
+        """Make room for an allocation by spilling oldest pinned residents.
+
+        Returns bytes spilled. Spills until the need fits AND usage is back
+        under the threshold (mirrors spilling high/low watermarks)."""
+        freed = 0
+        with self._lock:
+            victims: list[ObjectID] = []
+            stats = self._store.stats()
+            arena = max(1, stats["arena_size"])
+            target_free = need_bytes + max(
+                0, int(stats["bytes_in_use"] - self._threshold * arena)
+            )
+            for oid, size in self._resident.items():
+                if freed >= target_free:
+                    break
+                victims.append(oid)
+                freed += size
+            for oid in victims:
+                self._spill_one(oid)
+        return freed
+
+    def _spill_one(self, oid: ObjectID) -> None:
+        view = self._store.get_bytes(oid)
+        if view is None:
+            self._resident.pop(oid, None)
+            return
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(self._dir, oid.hex())
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(view)
+        os.replace(tmp, path)
+        size = self._resident.pop(oid, 0) or len(view)
+        self._spilled[oid] = (path, size)
+        self.spilled_bytes_total += size
+        del view  # drop the read pin before releasing the primary pin
+        # release the runtime's referenced-pin and evict the shm copy
+        self._store.release(oid)
+        self._store.delete(oid)
+        logger.debug("spilled %s (%d bytes) to %s", oid.hex()[:12], size, path)
+
+    # ------------------------------------------------------------ restore
+    def restore(self, oid: ObjectID) -> Optional[bytes]:
+        """Bring a spilled object back; returns its serialized bytes, or None
+        if this object was never spilled. Re-seats it in shm (re-pinned) when
+        it fits so subsequent reads are zero-copy again."""
+        with self._lock:
+            entry = self._spilled.get(oid)
+            if entry is None:
+                return None
+            path, size = entry
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                self._spilled.pop(oid, None)
+                return None
+            self.restored_bytes_total += len(blob)
+            try:
+                self._store.put_bytes(oid, blob)
+                self._store.pin(oid)
+                self._resident[oid] = len(blob)
+                self._spilled.pop(oid, None)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            except Exception:
+                pass  # store still under pressure: serve from the file copy
+            return blob
+
+    def close(self) -> None:
+        with self._lock:
+            entries = list(self._spilled.values())
+            self._spilled.clear()
+        for path, _ in entries:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
