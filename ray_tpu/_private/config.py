@@ -74,6 +74,10 @@ class Config:
     log_to_driver: bool = True
     session_dir_prefix: str = "/tmp/ray_tpu"
 
+    # --- control-plane persistence (reference: GCS FT via external Redis,
+    #     gcs/store_client/redis_store_client.h; empty = volatile session) ---
+    gcs_storage_path: str = ""
+
     def apply_env_overrides(self) -> "Config":
         for f in dataclasses.fields(self):
             setattr(self, f.name, _env(f.name, getattr(self, f.name), type(getattr(self, f.name)) if getattr(self, f.name) is not None else str))

@@ -1,0 +1,140 @@
+"""Control-plane persistence: session metadata that survives driver restarts.
+
+Parity: the reference's GCS fault tolerance — metadata tables checkpointed to
+an external Redis (gcs/store_client/redis_store_client.h, gcs_table_storage.cc)
+so a restarted head recovers jobs/actors/KV; the serve controller additionally
+checkpoints its app state into the internal KV and reloads it on restart
+(serve/_private/controller.py:124-133, storage/kv_store.py:24).
+
+Here the backing store is a pickle file under a user-chosen directory
+(`_system_config={"gcs_storage_path": ...}`): every internal-KV mutation and
+detached-actor registration writes through; `ray_tpu.init()` with the same
+storage path restores the KV and re-creates named detached actors from their
+recorded creation specs (the serve controller then self-heals its apps from
+its KV checkpoint).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Optional
+
+logger = logging.getLogger("ray_tpu")
+
+
+class GcsStore:
+    """Durable map of {kv: {(ns, key): val}, detached_actors: {key: spec}}."""
+
+    def __init__(self, path: str):
+        self.dir = path
+        self.file = os.path.join(path, "gcs_store.pkl")
+        self._lock = threading.Lock()
+        self._data: dict[str, dict] = {"kv": {}, "detached_actors": {}}
+        os.makedirs(path, exist_ok=True)
+        if os.path.exists(self.file):
+            try:
+                with open(self.file, "rb") as f:
+                    self._data = pickle.load(f)
+            except Exception as e:
+                logger.warning("gcs store at %s unreadable (%s); starting fresh",
+                               self.file, e)
+
+    def _flush(self) -> None:
+        tmp = self.file + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._data, f)
+        os.replace(tmp, self.file)  # atomic: a crash never corrupts the store
+
+    # ---- internal KV write-through ----
+    def kv_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._data["kv"])
+
+    def kv_put(self, fk: tuple, value: bytes) -> None:
+        with self._lock:
+            self._data["kv"][fk] = value
+            self._flush()
+
+    def kv_del(self, fks: list) -> None:
+        with self._lock:
+            for fk in fks:
+                self._data["kv"].pop(fk, None)
+            self._flush()
+
+    # ---- detached actors ----
+    def record_detached_actor(self, namespace: str, name: str, cls, args, kwargs,
+                              options: dict) -> None:
+        import cloudpickle
+
+        try:
+            blob = cloudpickle.dumps(
+                {
+                    "cls": cls,
+                    "args": args,
+                    "kwargs": kwargs,
+                    "options": {
+                        k: v for k, v in options.items()
+                        if k not in ("placement_group",)  # not durable
+                    },
+                }
+            )
+        except Exception as e:
+            logger.warning("detached actor %s/%s not persistable: %s", namespace, name, e)
+            return
+        with self._lock:
+            self._data["detached_actors"][(namespace, name)] = blob
+            self._flush()
+
+    def remove_detached_actor(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if self._data["detached_actors"].pop((namespace, name), None) is not None:
+                self._flush()
+
+    def detached_actors(self) -> dict:
+        with self._lock:
+            return dict(self._data["detached_actors"])
+
+
+_store: Optional[GcsStore] = None
+
+
+def get_store() -> Optional[GcsStore]:
+    return _store
+
+
+def set_store(store: Optional[GcsStore]) -> None:
+    global _store
+    _store = store
+
+
+def restore_session(runtime) -> int:
+    """Recreate named detached actors from the durable store (reference: GCS
+    restart reconstructing actor metadata; here the actors re-run __init__,
+    and checkpoint-aware actors like the serve controller self-heal from the
+    restored internal KV). Returns the number restored."""
+    import cloudpickle
+
+    store = get_store()
+    if store is None:
+        return 0
+    # KV first: actors' __init__ may read their checkpoints from it.
+    from ray_tpu.experimental import internal_kv
+
+    internal_kv._load_snapshot(store.kv_snapshot())
+    restored = 0
+    for (namespace, name), blob in store.detached_actors().items():
+        try:
+            spec = cloudpickle.loads(blob)
+            opts = dict(spec["options"])
+            opts["name"] = name
+            opts["namespace"] = namespace
+            opts["get_if_exists"] = True
+            runtime.create_actor(spec["cls"], spec["args"], spec["kwargs"], opts)
+            restored += 1
+        except Exception as e:
+            logger.warning("failed to restore detached actor %s/%s: %s",
+                           namespace, name, e)
+    return restored

@@ -68,6 +68,20 @@ def init(
         node_labels = [dict(labels or {}) for _ in range(num_nodes)]
         rt = Runtime(cfg, num_nodes=num_nodes, resources_per_node=res, node_labels=node_labels)
         rt_mod.set_runtime(rt)
+        if cfg.gcs_storage_path:
+            # Durable control plane: restore internal KV + named detached
+            # actors recorded by a previous session at this storage path
+            # (reference: GCS restart with Redis persistence).
+            from ray_tpu._private import persistence
+
+            persistence.set_store(persistence.GcsStore(cfg.gcs_storage_path))
+            restored = persistence.restore_session(rt)
+            if restored:
+                import logging
+
+                logging.getLogger("ray_tpu").info(
+                    "restored %d detached actor(s) from %s", restored, cfg.gcs_storage_path
+                )
         return RuntimeContext(rt)
 
 
@@ -95,6 +109,17 @@ def shutdown() -> None:
     if rt is not None:
         rt.shutdown()
         rt_mod.set_runtime(None)
+    from ray_tpu._private import persistence
+
+    if persistence.get_store() is not None:
+        # KV contents live on in the durable store, not in module globals —
+        # the next init() with the same storage path restores them (matching
+        # the reference: the in-memory GCS KV dies with the cluster; Redis
+        # persistence brings it back).
+        from ray_tpu.experimental import internal_kv
+
+        internal_kv._internal_kv_reset()
+        persistence.set_store(None)
 
 
 def put(value: Any) -> ObjectRef:

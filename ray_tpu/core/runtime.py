@@ -773,9 +773,17 @@ class Runtime:
             fn_blob, args_blob = self._task_blobs(spec)
         except Exception:
             # Marshal failure is EITHER unserializable user objects OR a dep
-            # that resolved to a real error — run inline so the true exception
-            # surfaces (and unserializable tasks still execute), mirroring
-            # _execute_in_process's fallback.
+            # that resolved to a real error. Inline fallback is only legal for
+            # placement-agnostic CPU tasks — a task pinned to this node (by
+            # affinity, labels, or non-CPU resources) must NOT silently run on
+            # the head instead.
+            portable = (
+                spec.node_affinity is None
+                and not spec.label_selector
+                and all(k == "CPU" or v <= 0 for k, v in spec.resources.items())
+            )
+            if not portable:
+                raise
             args, kwargs = self._resolve_args(spec)
             result = self._run_user_fn(entry, spec.func, args, kwargs)
             self._store_returns(spec, result)
@@ -958,6 +966,16 @@ class Runtime:
                 self._named_actors[key] = actor_id
         with self._lock:
             self._actors[actor_id] = state
+        if options.get("lifetime") == "detached" and name:
+            # Durable actor metadata (reference: GCS actor table persisted to
+            # Redis; detached actors recoverable after head restart).
+            from ray_tpu._private import persistence
+
+            store = persistence.get_store()
+            if store is not None:
+                store.record_detached_actor(
+                    state.namespace, name, cls, args, kwargs, options
+                )
         state.is_async = any(
             inspect.iscoroutinefunction(getattr(cls, m, None))
             for m in dir(cls)
@@ -1235,6 +1253,12 @@ class Runtime:
         if state.name:
             with self._lock:
                 self._named_actors.pop((state.namespace, state.name), None)
+            if no_restart and state.options.get("lifetime") == "detached":
+                from ray_tpu._private import persistence
+
+                store = persistence.get_store()
+                if store is not None:
+                    store.remove_detached_actor(state.namespace, state.name)
         self._drain_mailbox(state, ActorDiedError(state.death_cause))
         for _ in state.threads:
             state.mailbox.put(None)

@@ -41,6 +41,7 @@ class SpillManager:
         # insertion-ordered: oldest puts first = spill victims
         self._resident: "OrderedDict[ObjectID, int]" = OrderedDict()
         self._spilled: dict[ObjectID, tuple[str, int]] = {}
+        self._restoring: set[ObjectID] = set()
         self.spilled_bytes_total = 0
         self.restored_bytes_total = 0
 
@@ -125,31 +126,51 @@ class SpillManager:
     def restore(self, oid: ObjectID) -> Optional[bytes]:
         """Bring a spilled object back; returns its serialized bytes, or None
         if this object was never spilled. Re-seats it in shm (re-pinned) when
-        it fits so subsequent reads are zero-copy again."""
+        it fits so subsequent reads are zero-copy again.
+
+        Disk I/O and the shm memcpy run OUTSIDE the manager lock — a large
+        restore must not stall every concurrent put/get's bookkeeping."""
         with self._lock:
             entry = self._spilled.get(oid)
             if entry is None:
                 return None
-            path, size = entry
+            # one restorer re-seats; concurrent readers serve the file copy
+            # (a second pin would leak and keep the object unevictable)
+            i_reseat = oid not in self._restoring
+            if i_reseat:
+                self._restoring.add(oid)
+        path, size = entry
+        try:
             try:
                 with open(path, "rb") as f:
                     blob = f.read()
             except OSError:
-                self._spilled.pop(oid, None)
+                with self._lock:
+                    self._spilled.pop(oid, None)
                 return None
-            self.restored_bytes_total += len(blob)
-            try:
-                self._store.put_bytes(oid, blob)
-                self._store.pin(oid)
-                self._resident[oid] = len(blob)
-                self._spilled.pop(oid, None)
+            reseated = False
+            if i_reseat:
+                try:
+                    self._store.put_bytes(oid, blob)
+                    self._store.pin(oid)
+                    reseated = True
+                except Exception:
+                    pass  # store still under pressure: serve from the file copy
+            with self._lock:
+                self.restored_bytes_total += len(blob)
+                if reseated:
+                    self._resident[oid] = len(blob)
+                    self._spilled.pop(oid, None)
+            if reseated:
                 try:
                     os.unlink(path)
                 except OSError:
                     pass
-            except Exception:
-                pass  # store still under pressure: serve from the file copy
             return blob
+        finally:
+            if i_reseat:
+                with self._lock:
+                    self._restoring.discard(oid)
 
     def close(self) -> None:
         with self._lock:

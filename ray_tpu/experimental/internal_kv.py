@@ -27,6 +27,19 @@ def _key(key: bytes | str, namespace: bytes | str | None) -> tuple[bytes, bytes]
     return (ns, k)
 
 
+def _persist():
+    from ray_tpu._private import persistence
+
+    return persistence.get_store()
+
+
+def _load_snapshot(snapshot: dict) -> None:
+    """Restore-from-durable-store path (reference: GCS tables reloaded from
+    Redis on restart, redis_store_client.h)."""
+    with _lock:
+        _store.update(snapshot)
+
+
 def _internal_kv_put(key, value, overwrite: bool = True, namespace=None) -> bool:
     """Returns True if the key already existed (reference semantics)."""
     if not isinstance(value, (str, bytes)):
@@ -38,7 +51,12 @@ def _internal_kv_put(key, value, overwrite: bool = True, namespace=None) -> bool
         if existed and not overwrite:
             return True
         _store[fk] = v
-        return existed
+        # persist UNDER the lock: durable order must match in-memory order or
+        # a restart can restore a stale value over a newer one
+        p = _persist()
+        if p is not None:
+            p.kv_put(fk, v)
+    return existed
 
 
 def _internal_kv_get(key, namespace=None) -> Optional[bytes]:
@@ -58,8 +76,13 @@ def _internal_kv_del(key, del_by_prefix: bool = False, namespace=None) -> int:
             victims = [fk for fk in _store if fk[0] == ns and fk[1].startswith(p)]
             for fk in victims:
                 del _store[fk]
-            return len(victims)
-        return 1 if _store.pop(_key(key, namespace), None) is not None else 0
+        else:
+            fk = _key(key, namespace)
+            victims = [fk] if _store.pop(fk, None) is not None else []
+        p2 = _persist()
+        if p2 is not None and victims:
+            p2.kv_del(victims)
+    return len(victims)
 
 
 def _internal_kv_list(prefix, namespace=None) -> list[bytes]:

@@ -4,7 +4,14 @@ Parity surface: ray.serve (@deployment, run, status, delete, shutdown, @batch,
 DeploymentHandle, HTTP ingress) — reference python/ray/serve/.
 """
 
-from ray_tpu.serve.api import delete, run, shutdown, start_http_proxy, status
+from ray_tpu.serve.api import (
+    delete,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+    status,
+)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.controller import DeploymentHandle, ServeController
@@ -13,6 +20,7 @@ from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment,
 __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "run", "delete", "status", "shutdown", "start_http_proxy",
+    "get_deployment_handle",
     "batch", "DeploymentHandle", "ServeController",
     "multiplexed", "get_multiplexed_model_id",
 ]

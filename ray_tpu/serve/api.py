@@ -23,14 +23,24 @@ _lock = threading.Lock()
 
 
 def _get_or_create_controller():
+    from ray_tpu.core.runtime import get_runtime
+
     with _lock:
+        rt = get_runtime()
+        if _state.get("_rt") is not rt:
+            # a new session started (possibly resumed from persistence):
+            # cached handles point at the dead runtime
+            _state.update(controller=None, proxy=None, routes={}, _rt=rt)
         if _state["controller"] is None:
             try:
                 _state["controller"] = ray_tpu.get_actor(CONTROLLER_NAME)
             except ValueError:
+                # detached + named: with gcs_storage_path set, the controller
+                # is re-created on resume and self-heals apps from its KV
+                # checkpoint (reference: controller.py:133 crash recovery)
                 cls = ray_tpu.remote(num_cpus=0, max_concurrency=16)(ServeController)
                 _state["controller"] = cls.options(
-                    name=CONTROLLER_NAME, get_if_exists=True
+                    name=CONTROLLER_NAME, get_if_exists=True, lifetime="detached"
                 ).remote()
         return _state["controller"]
 
@@ -44,14 +54,16 @@ def run(app: Application, *, name: str = "default", route_prefix: str | None = "
     dep = app.deployment
     prefix = dep.config.route_prefix or route_prefix
     if prefix:
-        existing = _state["routes"].get(prefix)
-        if existing is not None and existing.deployment_name != dep.config.name:
-            # validate BEFORE deploying so a conflict doesn't leave orphan replicas
+        # validate against the CONTROLLER's route table (authoritative — it
+        # includes routes restored from a checkpoint), before deploying so a
+        # conflict doesn't leave orphan replicas
+        bound = ray_tpu.get(controller.get_routes.remote()).get(prefix)
+        if bound is not None and bound != dep.config.name:
             raise ValueError(
                 f"Route prefix {prefix!r} is already bound to deployment "
-                f"'{existing.deployment_name}'; pass a distinct route_prefix."
+                f"'{bound}'; pass a distinct route_prefix."
             )
-    ray_tpu.get(controller.deploy.remote(dep))
+    ray_tpu.get(controller.deploy.remote(dep, prefix))
     handle = DeploymentHandle(controller, dep.config.name)
     if prefix:
         with _lock:
@@ -69,6 +81,16 @@ def run(app: Application, *, name: str = "default", route_prefix: str | None = "
         except KeyboardInterrupt:
             pass
     return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    """Handle to an already-deployed deployment — e.g. after a resumed session
+    restored the controller from its checkpoint (reference:
+    serve.get_deployment_handle / get_app_handle)."""
+    controller = _get_or_create_controller()
+    if name not in ray_tpu.get(controller.get_deployment_names.remote()):
+        raise ValueError(f"Deployment {name!r} not found")
+    return DeploymentHandle(controller, name)
 
 
 def delete(name: str) -> None:

@@ -114,16 +114,63 @@ class _DeploymentState:
 class ServeController:
     """The control-plane actor (reference: _private/controller.py:129)."""
 
+    CHECKPOINT_KEY = "controller_checkpoint"
+    CHECKPOINT_NS = "serve"
+
     def __init__(self):
         self._deployments: dict[str, _DeploymentState] = {}
+        self._routes: dict[str, str] = {}  # route_prefix -> deployment name
         self._lock = threading.Lock()
         self._reconcile_lock = threading.Lock()  # serializes reconcile passes
         self._running = True
+        self._restore_from_checkpoint()
         self._loop = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._loop.start()
 
+    # ---- checkpointing (reference: controller.py:124-133 — app state saved
+    # to the GCS internal KV; a restarted controller reloads and reconciles) ----
+    def _checkpoint(self) -> None:
+        import cloudpickle
+
+        from ray_tpu.experimental import internal_kv
+
+        with self._lock:
+            payload = {
+                name: (st.deployment, st.target_replicas, st.version)
+                for name, st in self._deployments.items()
+            }
+            routes = dict(self._routes)
+        try:
+            internal_kv._internal_kv_put(
+                self.CHECKPOINT_KEY,
+                cloudpickle.dumps({"deployments": payload, "routes": routes}),
+                namespace=self.CHECKPOINT_NS,
+            )
+        except Exception:
+            pass  # an unpicklable app stays volatile rather than failing deploy
+
+    def _restore_from_checkpoint(self) -> None:
+        import cloudpickle
+
+        from ray_tpu.experimental import internal_kv
+
+        blob = internal_kv._internal_kv_get(self.CHECKPOINT_KEY, namespace=self.CHECKPOINT_NS)
+        if not blob:
+            return
+        try:
+            data = cloudpickle.loads(blob)
+        except Exception:
+            return
+        with self._lock:
+            for name, (deployment, target, version) in data.get("deployments", {}).items():
+                st = _DeploymentState(deployment.config, deployment)
+                st.target_replicas = target
+                st.version = version
+                self._deployments[name] = st  # reconcile loop spawns replicas
+            self._routes = dict(data.get("routes", {}))
+
     # ---- API ----
-    def deploy(self, deployment: Deployment) -> None:
+    def deploy(self, deployment: Deployment, route_prefix: str | None = None) -> None:
         """Reference: deploy_applications (controller.py:1066). A redeploy
         (version bump) replaces all running replicas so new code/config serve
         (reference: DeploymentState rolling update — here stop-then-start)."""
@@ -141,22 +188,31 @@ class ServeController:
                 old_replicas, st.replicas = st.replicas, []
             auto = deployment.config.autoscaling_config
             st.target_replicas = auto.min_replicas if auto else deployment.config.num_replicas
+            if route_prefix is not None:
+                self._routes[route_prefix] = name
         for r in old_replicas:
             try:
                 ray_tpu.kill(r)
             except Exception:
                 pass
+        self._checkpoint()
         self._reconcile_once()
+
+    def get_routes(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             st = self._deployments.pop(name, None)
+            self._routes = {p: n for p, n in self._routes.items() if n != name}
         if st:
             for r in st.replicas:
                 try:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
+        self._checkpoint()
 
     def get_replicas(self, name: str) -> list:
         st = self._deployments.get(name)
@@ -333,8 +389,21 @@ class Router:
                 self._inflight = {self._rkey(r): self._inflight.get(self._rkey(r), 0) for r in reps}
                 self._last_refresh = now
 
-    def pick(self):
+    def pick(self, wait_timeout: float = 30.0):
         self._refresh()
+        if not self._replicas:
+            # Replicas may still be starting (deploy in progress, controller
+            # restored from checkpoint and reconciling) — the reference router
+            # queues requests until replicas exist rather than failing fast.
+            deadline = time.monotonic() + wait_timeout
+            while time.monotonic() < deadline and not self._replicas:
+                if self._name not in ray_tpu.get(
+                    self._controller.get_deployment_names.remote()
+                ):
+                    break  # genuinely absent: fail below
+                time.sleep(0.1)
+                self._last_refresh = 0.0
+                self._refresh()
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(f"No replicas for deployment '{self._name}'")

@@ -1,0 +1,119 @@
+"""Control-plane persistence tests (reference: GCS FT via Redis —
+redis_store_client.h, gcs_table_storage.cc; serve controller checkpoint
+recovery — serve/_private/controller.py:124-133)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import internal_kv
+
+
+def _init(path):
+    ray_tpu.init(
+        num_cpus=4,
+        _system_config={"gcs_storage_path": str(path)},
+        ignore_reinit_error=False,
+    )
+
+
+def test_internal_kv_survives_restart(tmp_path):
+    _init(tmp_path / "gcs")
+    internal_kv._internal_kv_put("alpha", b"1")
+    internal_kv._internal_kv_put("beta", b"2", namespace="ns")
+    internal_kv._internal_kv_del("missing")
+    ray_tpu.shutdown()
+    assert internal_kv._internal_kv_get("alpha") is None  # volatile copy gone
+    _init(tmp_path / "gcs")
+    try:
+        assert internal_kv._internal_kv_get("alpha") == b"1"
+        assert internal_kv._internal_kv_get("beta", namespace="ns") == b"2"
+        internal_kv._internal_kv_del("alpha")
+    finally:
+        ray_tpu.shutdown()
+    _init(tmp_path / "gcs")
+    try:
+        assert internal_kv._internal_kv_get("alpha") is None  # deletion durable
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_detached_actor_recreated_on_resume(tmp_path):
+    _init(tmp_path / "gcs")
+
+    @ray_tpu.remote
+    class Registry:
+        def __init__(self):
+            self.entries = ["seeded"]
+
+        def add(self, x):
+            self.entries.append(x)
+            return len(self.entries)
+
+        def all(self):
+            return self.entries
+
+    Registry.options(name="registry", lifetime="detached").remote()
+    h = ray_tpu.get_actor("registry")
+    assert ray_tpu.get(h.add.remote("x"), timeout=30) == 2
+    ray_tpu.shutdown()
+
+    _init(tmp_path / "gcs")
+    try:
+        h2 = ray_tpu.get_actor("registry")  # re-created from the durable spec
+        # state is re-initialized (__init__ re-ran) — metadata durability, not
+        # actor-state checkpointing (matches reference GCS-FT semantics)
+        assert ray_tpu.get(h2.all.remote(), timeout=30) == ["seeded"]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_killed_detached_actor_not_resurrected(tmp_path):
+    _init(tmp_path / "gcs")
+
+    @ray_tpu.remote
+    class Ephemeral:
+        def ping(self):
+            return "pong"
+
+    Ephemeral.options(name="eph", lifetime="detached").remote()
+    h = ray_tpu.get_actor("eph")
+    assert ray_tpu.get(h.ping.remote(), timeout=30) == "pong"
+    ray_tpu.kill(h)
+    ray_tpu.shutdown()
+
+    _init(tmp_path / "gcs")
+    try:
+        with pytest.raises(ValueError):
+            ray_tpu.get_actor("eph")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_serve_app_survives_restart_without_redeploy(tmp_path):
+    """VERDICT r1 criterion: kill runtime, re-init, serve app serves WITHOUT
+    redeploy (controller checkpoint + detached recreation)."""
+    from ray_tpu import serve
+
+    _init(tmp_path / "gcs")
+
+    @serve.deployment(num_replicas=1)
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Doubler.bind(), route_prefix="/double")
+    assert ray_tpu.get(handle.remote(21), timeout=60) == 42
+    ray_tpu.shutdown()  # driver "crash": all actors die with the session
+
+    _init(tmp_path / "gcs")
+    try:
+        h2 = serve.get_deployment_handle("Doubler")
+        assert ray_tpu.get(h2.remote(5), timeout=60) == 10
+        # route table restored too
+        controller = ray_tpu.get_actor("_serve_controller")
+        routes = ray_tpu.get(controller.get_routes.remote(), timeout=30)
+        assert routes.get("/double") == "Doubler"
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
