@@ -109,17 +109,15 @@ def shutdown() -> None:
     if rt is not None:
         rt.shutdown()
         rt_mod.set_runtime(None)
+    # The in-memory KV dies with the session (reference: GCS KV lifetime);
+    # only a durable store (gcs_storage_path) carries it to the next init().
+    # Without the reset, a later session in this process would resurrect
+    # stale state (e.g. the serve controller checkpoint).
     from ray_tpu._private import persistence
+    from ray_tpu.experimental import internal_kv
 
-    if persistence.get_store() is not None:
-        # KV contents live on in the durable store, not in module globals —
-        # the next init() with the same storage path restores them (matching
-        # the reference: the in-memory GCS KV dies with the cluster; Redis
-        # persistence brings it back).
-        from ray_tpu.experimental import internal_kv
-
-        internal_kv._internal_kv_reset()
-        persistence.set_store(None)
+    internal_kv._internal_kv_reset()
+    persistence.set_store(None)
 
 
 def put(value: Any) -> ObjectRef:

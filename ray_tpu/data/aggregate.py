@@ -18,17 +18,13 @@ from ray_tpu.data.dataset import Dataset
 
 
 def sort(ds: Dataset, key: str, descending: bool = False) -> Dataset:
-    """Reference: Dataset.sort — global order requires materializing."""
+    """Reference: Dataset.sort → sample-sort exchange (planner/exchange/):
+    boundary sampling, range partition over tasks, per-partition sort."""
 
     def source():
-        blocks = list(ds.iter_blocks())
-        if not blocks:
-            return
-        merged = Block.concat(blocks)
-        order = np.argsort(merged.columns[key], kind="stable")
-        if descending:
-            order = order[::-1]
-        yield Block({k: v[order] for k, v in merged.columns.items()})
+        from ray_tpu.data.exchange import sort_exchange
+
+        yield from sort_exchange(ds.iter_blocks(), key, descending)
 
     return Dataset(source, (), "sort")
 
@@ -40,51 +36,122 @@ def unique(ds: Dataset, column: str) -> list:
     return sorted(vals)
 
 
+def _block_groups(block: Block, key: str):
+    """Yield (group_key, mask) per group in one block; all NaN keys form ONE
+    group (nan != nan, and np.unique may emit several)."""
+    keys = block.columns[key]
+    seen_nan = False
+    for gk in np.unique(keys):
+        if isinstance(gk, float) and np.isnan(gk):
+            if seen_nan:
+                continue
+            seen_nan = True
+            yield float("nan"), np.isnan(keys)
+        else:
+            yield _scalar(gk), keys == gk
+
+
+def _agg_partition(block: Block, key: str, fn: Callable, cols: tuple,
+                   suffix: str) -> Block:
+    """Aggregate one hash partition — post-exchange, every group here is
+    complete (all of its rows landed in this partition)."""
+    rows = []
+    for gk, mask in _block_groups(block, key):
+        row = {key: gk}
+        for col, vals in block.columns.items():
+            if col == key or (cols and col not in cols):
+                continue
+            arr = vals[mask]
+            if not cols and arr.dtype.kind not in "biufc":
+                continue  # default aggregation covers numeric columns only
+            row[f"{col}_{suffix}" if suffix else col] = fn(arr)
+        rows.append(row)
+    return Block.from_rows(rows) if rows else Block({})
+
+
+def _count_partition(block: Block, key: str) -> Block:
+    rows = [{key: gk, "count": int(mask.sum())} for gk, mask in _block_groups(block, key)]
+    return Block.from_rows(rows) if rows else Block({})
+
+
 class GroupedData:
-    """Reference: data/grouped_data.py GroupedData."""
+    """Reference: data/grouped_data.py GroupedData. Aggregations run as a
+    hash-partition exchange (every group lands wholly in one partition —
+    _internal/execution/operators/hash_shuffle.py) followed by per-partition
+    aggregation tasks."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    _NAN_KEY = "\x00__nan_group__"  # merges NaN keys across blocks (nan != nan)
+    def _run_exchange(self, partition_agg: Callable[[Block], Block], name: str) -> Dataset:
+        ds, key = self._ds, self._key
 
-    def _gather(self) -> dict[Any, dict[str, list[np.ndarray]]]:
-        groups: dict[Any, dict[str, list]] = {}
-        for b in self._ds.iter_blocks():
-            keys = b.columns[self._key]
-            for gk in np.unique(keys):
-                if isinstance(gk, float) and np.isnan(gk):
-                    mask = np.isnan(keys)
-                    group_key = self._NAN_KEY
-                else:
-                    mask = keys == gk
-                    group_key = _scalar(gk)
-                slot = groups.setdefault(group_key, {})
-                for col, vals in b.columns.items():
-                    slot.setdefault(col, []).append(vals[mask])
-        return groups
+        def source():
+            from ray_tpu.data.exchange import grouped_aggregate
+
+            blocks = list(grouped_aggregate(ds.iter_blocks(), key, partition_agg))
+            if not blocks:
+                return
+            # deterministic output order across runs/partitionings
+            merged = Block.concat(blocks)
+            order = np.argsort([str(v) for v in merged.columns[key]], kind="stable")
+            yield Block({c: v[order] for c, v in merged.columns.items()})
+
+        return Dataset(source, (), name)
 
     def _agg(self, fn: Callable, cols: tuple, suffix: str) -> Dataset:
-        groups = self._gather()
-        rows = []
-        for gk, colmap in sorted(groups.items(), key=lambda kv: str(kv[0])):
-            row = {self._key: gk}
-            for col, chunks in colmap.items():
-                if col == self._key or (cols and col not in cols):
-                    continue
-                arr = np.concatenate(chunks)
-                if not cols and arr.dtype.kind not in "biufc":
-                    continue  # default aggregation covers numeric columns only
-                row[f"{col}_{suffix}" if suffix else col] = fn(arr)
-            rows.append(row)
-        return Dataset(lambda r=rows: iter([Block.from_rows(r)] if r else []), (), f"groupby.{suffix}")
+        import functools
+
+        return self._run_exchange(
+            functools.partial(_agg_partition, key=self._key, fn=fn, cols=cols,
+                              suffix=suffix),
+            f"groupby.{suffix}",
+        )
 
     def count(self) -> Dataset:
-        groups = self._gather()
-        rows = [{self._key: gk, "count": len(np.concatenate(cm[self._key]))}
-                for gk, cm in sorted(groups.items(), key=lambda kv: str(kv[0]))]
-        return Dataset(lambda: iter([Block.from_rows(rows)] if rows else []), (), "groupby.count")
+        import functools
+
+        return self._run_exchange(
+            functools.partial(_count_partition, key=self._key), "groupby.count"
+        )
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply fn to each whole group (reference: GroupedData.map_groups).
+        fn receives {col: np.ndarray} for one group and returns a row dict,
+        a list of row dicts, or a {col: array} mapping."""
+        key = self._key
+
+        def partition_fn(block: Block) -> Block:
+            out_rows: list = []
+            for gk, mask in _block_groups(block, key):
+                group = {c: v[mask] for c, v in block.columns.items()}
+                res = fn(group)
+                if isinstance(res, dict):
+                    vals = list(res.values())
+                    if vals and isinstance(vals[0], (np.ndarray, list)):
+                        n = len(vals[0])
+                        out_rows.extend(
+                            {c: (v[i] if hasattr(v, "__len__") else v)
+                             for c, v in res.items()}
+                            for i in range(n)
+                        )
+                    else:
+                        out_rows.append(res)
+                elif isinstance(res, list):
+                    out_rows.extend(res)
+                else:
+                    raise TypeError(f"map_groups fn returned {type(res)}")
+            return Block.from_rows(out_rows) if out_rows else Block({})
+
+        ds, k = self._ds, self._key
+
+        def source():
+            from ray_tpu.data.exchange import grouped_aggregate
+
+            yield from grouped_aggregate(ds.iter_blocks(), k, partition_fn)
+
+        return Dataset(source, (), "groupby.map_groups")
 
     def sum(self, *cols) -> Dataset:
         return self._agg(np.sum, cols, "sum")

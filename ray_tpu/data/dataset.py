@@ -67,8 +67,9 @@ class Dataset:
         return self._append(LogicalOp("limit", None, dict(n=n)))
 
     def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
-        """Block-local shuffle + block-order shuffle (approximate global shuffle;
-        the reference's full hash shuffle is a later milestone)."""
+        """True global shuffle via an all-to-all exchange: rows scatter
+        uniformly over partitions, each partition permutes (reference:
+        random_shuffle as a full exchange, hash_shuffle.py)."""
         return self._append(LogicalOp("shuffle", None, dict(seed=seed)))
 
     def repartition(self, num_blocks: int) -> "Dataset":
@@ -108,6 +109,23 @@ class Dataset:
         from ray_tpu.data.aggregate import ds_mean
 
         return ds_mean(self, column)
+
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             num_partitions: int | None = None) -> "Dataset":
+        """Hash join over an all-to-all exchange (reference:
+        _internal/execution/operators/join.py): both sides partition on the
+        key; each partition joins independently in a task."""
+        left, right = self, other
+
+        def source():
+            from ray_tpu.data.exchange import DEFAULT_PARTITIONS, join_exchange
+
+            yield from join_exchange(
+                left.iter_blocks(), right.iter_blocks(), on, how,
+                num_partitions or DEFAULT_PARTITIONS,
+            )
+
+        return Dataset(source, (), f"join({self._name},{other._name})")
 
     def union(self, other: "Dataset") -> "Dataset":
         left, right = self, other
@@ -291,7 +309,12 @@ class Dataset:
             yield _format_batch(emit(carried), batch_format, device_put)
 
     def streaming_split(self, n: int, *, equal: bool = False) -> list["DataIterator"]:
-        """Reference: dataset.py:2117 — one iterator shard per train worker."""
+        """Reference: dataset.py:2117 — one iterator shard per train worker.
+
+        The shards MUST be consumed concurrently (one consumer per shard, the
+        train-worker pattern): output flows through bounded per-shard queues
+        for backpressure, so draining one shard alone blocks once the others'
+        queues fill — the same contract as the reference's streaming_split."""
         splitter = OutputSplitter(self.iter_blocks(), n, equal)
         return [DataIterator(functools.partial(splitter.iterator, i)) for i in range(n)]
 
@@ -432,13 +455,7 @@ def _repartition_stream(stream: Iterator[Block], num_blocks: int) -> Iterator[Bl
 
 
 def _shuffle_stream(stream: Iterator[Block], seed: int | None) -> Iterator[Block]:
-    """Global-approximate shuffle: shuffle block order, then permute rows within
-    each block with a per-block substream (reference: random_shuffle is a full
-    exchange; this is the streaming approximation documented on the method)."""
-    blocks = list(stream)
-    rng = np.random.default_rng(seed)
-    order = rng.permutation(len(blocks))
-    for j, bi in enumerate(order):
-        b = blocks[bi]
-        perm = rng.permutation(b.num_rows())
-        yield Block({k: v[perm] for k, v in b.columns.items()})
+    """Full random shuffle as an all-to-all exchange over tasks."""
+    from ray_tpu.data.exchange import shuffle_exchange
+
+    yield from shuffle_exchange(stream, seed)

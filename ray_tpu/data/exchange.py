@@ -1,0 +1,247 @@
+"""All-to-all exchange operators: hash shuffle, sample-sort, join.
+
+Parity: python/ray/data/_internal/execution/operators/hash_shuffle.py (+ _v2),
+join.py, and planner/exchange/ (sort's boundary-sampling exchange). Shape kept
+from the reference: a MAP stage partitions every input block (one task per
+block, one return per partition) and a REDUCE stage combines each partition's
+slices (one task per partition); the object plane carries the slices, so the
+exchange parallelizes across worker processes and spills under pressure.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block
+
+DEFAULT_PARTITIONS = 8
+
+
+# ------------------------------------------------------------------ map/reduce
+def _split_by_index(block: Block, idx: np.ndarray, P: int):
+    outs = []
+    for i in range(P):
+        mask = idx == i
+        outs.append(Block({k: v[mask] for k, v in block.columns.items()}))
+    return tuple(outs) if P > 1 else outs[0]
+
+
+def _map_partition(block: Block, part_fn, P: int, block_idx: int):
+    """One map task per input block -> P partition slices."""
+    idx = part_fn(block, block_idx)
+    return _split_by_index(block, np.asarray(idx, dtype=np.int64), P)
+
+
+def _scatter(blocks: Iterator[Block], part_fn, P: int, map_task):
+    """MAP stage shared by exchange() and join_exchange(): one task per block,
+    one return per partition. Returns (per-partition ref lists, n_blocks,
+    schema of the first non-empty block)."""
+    partitions: list[list] = [[] for _ in range(P)]
+    n_blocks = 0
+    schema: dict | None = None
+    for b in blocks:
+        if schema is None and b.num_rows() > 0:
+            schema = {k: v.dtype for k, v in b.columns.items()}
+        if P == 1:
+            refs = [map_task.remote(b, part_fn, P, n_blocks)]
+        else:
+            refs = map_task.options(num_returns=P).remote(b, part_fn, P, n_blocks)
+        for i, r in enumerate(refs):
+            partitions[i].append(r)
+        n_blocks += 1
+    return partitions, n_blocks, schema
+
+
+def _reduce_partition(reduce_fn, *slices: Block) -> Block:
+    blocks = [s for s in slices if s.num_rows() > 0]
+    return reduce_fn(blocks) if blocks else Block({})
+
+
+def exchange(
+    blocks: Iterator[Block],
+    part_fn: Callable[[Block], np.ndarray],
+    num_partitions: int,
+    reduce_fn: Callable[[list[Block]], Block],
+    ordered: bool = True,
+) -> Iterator[Block]:
+    """Partition every block with `part_fn`, then reduce each partition.
+
+    An exchange is a barrier by nature (every reducer needs a slice of every
+    mapper); memory pressure is absorbed by the object store (spilling)."""
+    P = num_partitions
+    map_task = ray_tpu.remote(name="data::exchange_map")(_map_partition)
+    reduce_task = ray_tpu.remote(name="data::exchange_reduce")(_reduce_partition)
+    partitions, n_blocks, _ = _scatter(blocks, part_fn, P, map_task)
+    if n_blocks == 0:
+        return
+    out_refs = [reduce_task.remote(reduce_fn, *parts) for parts in partitions]
+    if ordered:
+        for r in out_refs:
+            blk = ray_tpu.get(r)
+            if blk.num_rows() > 0:
+                yield blk
+    else:
+        pending = list(out_refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            blk = ray_tpu.get(ready[0])
+            if blk.num_rows() > 0:
+                yield blk
+
+
+def _concat_reduce(blocks: list[Block]) -> Block:
+    return Block.concat(blocks)
+
+
+# ------------------------------------------------------------------ shuffle
+def shuffle_exchange(blocks: Iterator[Block], seed: Optional[int],
+                     num_partitions: int = DEFAULT_PARTITIONS) -> Iterator[Block]:
+    """True global random shuffle: rows scatter uniformly over partitions,
+    each partition permutes (reference: random_shuffle as full exchange)."""
+    root = np.random.SeedSequence(seed)
+    mix, reduce_seed = [int(s.generate_state(1)[0]) for s in root.spawn(2)]
+
+    def part(block: Block, block_idx: int) -> np.ndarray:
+        # per-block substream keyed by block POSITION: deterministic for a
+        # given seed across runs/processes, distinct per block
+        rng = np.random.default_rng([mix, block_idx])
+        return rng.integers(0, num_partitions, size=block.num_rows())
+
+    def reduce(bs: list[Block]) -> Block:
+        merged = Block.concat(bs)
+        rng = np.random.default_rng([reduce_seed, merged.num_rows()])
+        perm = rng.permutation(merged.num_rows())
+        return Block({k: v[perm] for k, v in merged.columns.items()})
+
+    yield from exchange(blocks, part, num_partitions, reduce, ordered=False)
+
+
+# ------------------------------------------------------------------ sort
+def sort_exchange(blocks: Iterator[Block], key: str, descending: bool = False,
+                  num_partitions: int = DEFAULT_PARTITIONS) -> Iterator[Block]:
+    """Distributed sample-sort (reference: planner/exchange sort): sample key
+    values -> P-1 range boundaries -> range-partition -> per-partition sort ->
+    emit partitions in boundary order."""
+    block_list = list(blocks)
+    if not block_list:
+        return
+    samples = []
+    for b in block_list:
+        col = b.columns.get(key)
+        if col is not None and len(col):
+            k = min(len(col), 20)
+            samples.append(np.random.default_rng(0).choice(col, size=k, replace=False))
+    if not samples:
+        return
+    sample = np.sort(np.concatenate(samples))
+    P = min(num_partitions, max(1, len(sample)))
+    bounds = sample[np.linspace(0, len(sample) - 1, P + 1).astype(int)][1:-1]
+
+    def part(block: Block, block_idx: int) -> np.ndarray:
+        return np.searchsorted(bounds, block.columns[key], side="right")
+
+    def reduce(bs: list[Block]) -> Block:
+        merged = Block.concat(bs)
+        order = np.argsort(merged.columns[key], kind="stable")
+        return Block({k2: v[order] for k2, v in merged.columns.items()})
+
+    out = list(exchange(iter(block_list), part, P, reduce, ordered=True))
+    if descending:
+        for blk in reversed(out):
+            rev = slice(None, None, -1)
+            yield Block({k2: v[rev] for k2, v in blk.columns.items()})
+    else:
+        yield from out
+
+
+# ------------------------------------------------------------------ groupby
+def _hash_key_col(col: np.ndarray, P: int) -> np.ndarray:
+    # stable content hash per element (abs of Python hash is per-process stable
+    # for numbers; strings need a content hash because PYTHONHASHSEED varies
+    # across worker processes)
+    if col.dtype.kind in "biufc":
+        if col.dtype.kind in "biu":
+            return col.astype(np.int64, copy=False) % P
+        # hash(nan) is id-based since py3.10 — all NaNs must co-partition
+        return np.asarray(
+            [0 if x != x else hash(float(x)) for x in col.tolist()]
+        ) % P
+    import zlib
+
+    return np.asarray([zlib.crc32(str(x).encode()) for x in col]) % P
+
+
+def hash_partitioner(key: str, P: int):
+    def part(block: Block, block_idx: int) -> np.ndarray:
+        return np.abs(_hash_key_col(block.columns[key], P)) % P
+
+    return part
+
+
+def grouped_aggregate(blocks: Iterator[Block], key: str, agg_block_fn,
+                      num_partitions: int = DEFAULT_PARTITIONS) -> Iterator[Block]:
+    """Hash-exchange on the group key, then aggregate each partition locally
+    (every group lands wholly in one partition — hash_shuffle.py semantics)."""
+    yield from exchange(
+        blocks, hash_partitioner(key, num_partitions), num_partitions,
+        lambda bs: agg_block_fn(Block.concat(bs)), ordered=False,
+    )
+
+
+# ------------------------------------------------------------------ join
+def join_exchange(left: Iterator[Block], right: Iterator[Block], on: str,
+                  how: str = "inner",
+                  num_partitions: int = DEFAULT_PARTITIONS) -> Iterator[Block]:
+    """Hash join (reference: execution/operators/join.py): both sides hash-
+    partition on the key; each partition joins independently."""
+    if how not in ("inner", "left", "outer", "right"):
+        raise ValueError(f"unsupported join how={how!r}")
+    P = num_partitions
+    map_task = ray_tpu.remote(name="data::join_map")(_map_partition)
+    join_task = ray_tpu.remote(name="data::join_reduce")(_join_partition)
+    part = hash_partitioner(on, P)
+
+    lparts, _, lschema = _scatter(left, part, P, map_task)
+    rparts, _, rschema = _scatter(right, part, P, map_task)
+    out_refs = []
+    for i in range(P):
+        if not lparts[i] and not rparts[i]:
+            continue
+        out_refs.append(
+            join_task.remote(on, how, len(lparts[i]),
+                             {k: str(v) for k, v in (lschema or {}).items()},
+                             {k: str(v) for k, v in (rschema or {}).items()},
+                             *(lparts[i] + rparts[i]))
+        )
+    pending = list(out_refs)
+    while pending:
+        ready, pending = ray_tpu.wait(pending, num_returns=1)
+        blk = ray_tpu.get(ready[0])
+        if blk.num_rows() > 0:
+            yield blk
+
+
+def _join_partition(on: str, how: str, n_left: int, lschema: dict, rschema: dict,
+                    *slices: Block) -> Block:
+    import pandas as pd
+
+    def side_df(bs: list[Block], schema: dict):
+        if bs:
+            return Block.concat(bs).to_pandas()
+        # An empty side still joins with the full OUTPUT SCHEMA (its columns
+        # come out NaN-filled) so every partition's block has identical
+        # columns — downstream Block.concat requires it. Dtypes must match
+        # the real side's or pandas refuses to merge the key column.
+        schema = schema or {on: "object"}
+        return pd.DataFrame({c: pd.Series(dtype=dt) for c, dt in schema.items()})
+
+    ldf = side_df([s for s in slices[:n_left] if s.num_rows() > 0], lschema)
+    rdf = side_df([s for s in slices[n_left:] if s.num_rows() > 0], rschema)
+    if ldf.empty and rdf.empty:
+        return Block({})
+    merged = ldf.merge(rdf, on=on, how=how, suffixes=("", "_r"))
+    return Block.from_pandas(merged)

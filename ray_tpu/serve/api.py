@@ -29,7 +29,14 @@ def _get_or_create_controller():
         rt = get_runtime()
         if _state.get("_rt") is not rt:
             # a new session started (possibly resumed from persistence):
-            # cached handles point at the dead runtime
+            # cached handles point at the dead runtime; stop the old proxy so
+            # its port is released instead of serving dead handles
+            old_proxy = _state.get("proxy")
+            if old_proxy is not None:
+                try:
+                    old_proxy.stop()
+                except Exception:
+                    pass
             _state.update(controller=None, proxy=None, routes={}, _rt=rt)
         if _state["controller"] is None:
             try:
