@@ -14,13 +14,14 @@ from ray_tpu.serve.api import (
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.openai_api import build_openai_app
 from ray_tpu.serve.controller import DeploymentHandle, ServeController
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
 
 __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "run", "delete", "status", "shutdown", "start_http_proxy",
-    "get_deployment_handle",
+    "get_deployment_handle", "build_openai_app",
     "batch", "DeploymentHandle", "ServeController",
     "multiplexed", "get_multiplexed_model_id",
 ]

@@ -21,6 +21,13 @@ _state: dict = {"controller": None, "proxy": None, "routes": {}}
 _STREAM_END = object()
 _lock = threading.Lock()
 
+# OpenAI surface: subpath under a route -> (method, streaming method)
+_OPENAI_METHODS = {
+    "completions": ("completions", "completions_stream"),
+    "chat/completions": ("chat_completions", "chat_completions_stream"),
+    "models": ("models", None),
+}
+
 
 def _get_or_create_controller():
     from ray_tpu.core.runtime import get_runtime
@@ -162,6 +169,30 @@ class HttpProxy:
                 body = await request.json() if request.can_read_body else {}
             except json.JSONDecodeError:
                 return web.json_response({"error": "invalid JSON body"}, status=400)
+            # OpenAI-compatible endpoints (reference: ray.serve.llm ingress,
+            # llm/_internal/serve/core/ingress/): only for deployments that
+            # opted into the surface (build_openai_app) — the subpath selects
+            # the deployment method, responses are raw OpenAI objects.
+            from ray_tpu.serve.openai_api import OPENAI_DEPLOYMENT_NAMES
+
+            sub = request.path[len(route.rstrip("/")):].strip("/") if route else ""
+            if sub in _OPENAI_METHODS and handle.deployment_name in OPENAI_DEPLOYMENT_NAMES:
+                method, stream_method = _OPENAI_METHODS[sub]
+                if isinstance(body, dict) and body.get("stream") and stream_method:
+                    body = {**body, "stream_method": stream_method}
+                    return await self._stream_response(request, handle, body)
+                ref = getattr(handle, method).remote(body)
+                loop = asyncio.get_running_loop()
+                try:
+                    result = await loop.run_in_executor(
+                        None, lambda: ray_tpu.get(ref, timeout=120)
+                    )
+                except Exception as e:  # noqa: BLE001
+                    return web.json_response(
+                        {"error": {"message": str(e)[:500], "type": type(e).__name__}},
+                        status=500,
+                    )
+                return web.json_response(result)
             if isinstance(body, dict) and body.get("stream"):
                 return await self._stream_response(request, handle, body)
             ref = handle.remote(body)

@@ -120,6 +120,7 @@ class ServeController:
     def __init__(self):
         self._deployments: dict[str, _DeploymentState] = {}
         self._routes: dict[str, str] = {}  # route_prefix -> deployment name
+        self._health_failures: dict[str, int] = {}  # replica -> consecutive fails
         self._lock = threading.Lock()
         self._reconcile_lock = threading.Lock()  # serializes reconcile passes
         self._running = True
@@ -256,6 +257,10 @@ class ServeController:
 
     # ---- reconciliation (reference: controller loop -> DeploymentStateManager) ----
     def _reconcile_loop(self) -> None:
+        # health probing runs on its OWN thread so a hung replica can't stall
+        # reconcile/autoscale passes (reference: health checks are async in
+        # deployment_state.py)
+        threading.Thread(target=self._health_loop, daemon=True).start()
         while self._running:
             try:
                 self._reconcile_once()
@@ -263,6 +268,56 @@ class ServeController:
             except Exception:
                 pass
             time.sleep(0.25)
+
+    HEALTH_CHECK_FAILURE_THRESHOLD = 3
+    HEALTH_CHECK_PERIOD_S = 1.0
+    # generous: a saturated-but-healthy replica answers between requests
+    # (reference default health_check_timeout_s=30)
+    HEALTH_CHECK_TIMEOUT_S = 30.0
+
+    def _health_loop(self) -> None:
+        while self._running:
+            try:
+                self._health_check_tick()
+            except Exception:
+                pass
+            time.sleep(self.HEALTH_CHECK_PERIOD_S)
+
+    def _health_check_tick(self) -> None:
+        """Probe every replica's health_check CONCURRENTLY; consecutive
+        failures tear the replica down and reconcile replaces it (reference:
+        deployment_state.py health-check -> replica restart loop)."""
+        with self._lock:
+            probes = [
+                (st, r) for st in self._deployments.values() for r in list(st.replicas)
+            ]
+        if not probes:
+            return
+        refs = [r.health_check.remote() for _, r in probes]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=self.HEALTH_CHECK_TIMEOUT_S)
+        for (st, r), ref in zip(probes, refs):
+            key = r._actor_id.hex()
+            try:
+                ray_tpu.get(ref, timeout=0.1)  # already-resolved or timed out
+                self._health_failures.pop(key, None)
+                continue
+            except ray_tpu.exceptions.ActorDiedError:
+                pass  # definitively dead: replace immediately
+            except Exception:
+                n = self._health_failures.get(key, 0) + 1
+                self._health_failures[key] = n
+                if n < self.HEALTH_CHECK_FAILURE_THRESHOLD:
+                    continue
+            self._health_failures.pop(key, None)
+            with self._lock:
+                cur = self._deployments.get(st.config.name)
+                if cur is None or r not in cur.replicas:
+                    continue
+                cur.replicas.remove(r)  # reconcile loop will replace it
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
 
     def _autoscale_tick(self) -> None:
         """Controller-side load polling so idle deployments scale DOWN even with
@@ -335,6 +390,7 @@ class Router:
         self._name = deployment_name
         self._replicas: list = []
         self._inflight: dict = {}
+        self._dead: set = set()  # replicas observed dead; excluded on refresh
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         self._reqs_since_report = 0
@@ -385,6 +441,7 @@ class Router:
         if now - self._last_refresh > 0.5 or not self._replicas:
             reps = ray_tpu.get(self._controller.get_replicas.remote(self._name))
             with self._lock:
+                reps = [r for r in reps if self._rkey(r) not in self._dead]
                 self._replicas = reps
                 self._inflight = {self._rkey(r): self._inflight.get(self._rkey(r), 0) for r in reps}
                 self._last_refresh = now
@@ -439,14 +496,33 @@ class Router:
         return gen, done_cb
 
     def submit(self, method_name: str, args, kwargs):
-        replica = self.pick()
-        key = self._rkey(replica)
-        with self._lock:
-            self._inflight[key] = self._inflight.get(key, 0) + 1
-        ref = replica.handle_request.remote(method_name, args, kwargs)
-        self._completions.put((key, ref))
-        self._maybe_report()
-        return ref
+        # A replica killed between router refreshes yields an instantly-errored
+        # ref; retry on a different replica so in-flight traffic survives
+        # replica death (reference: serve router replica retry on dead actors).
+        last_ref = None
+        for _ in range(4):
+            replica = self.pick()
+            key = self._rkey(replica)
+            with self._lock:
+                self._inflight[key] = self._inflight.get(key, 0) + 1
+            ref = replica.handle_request.remote(method_name, args, kwargs)
+            self._completions.put((key, ref))
+            self._maybe_report()
+            last_ref = ref
+            ready, _ = ray_tpu.wait([ref], timeout=0)
+            if ready:
+                try:
+                    ray_tpu.get(ref)
+                except ray_tpu.exceptions.ActorDiedError:
+                    with self._lock:
+                        self._dead.add(key)
+                        self._replicas = [x for x in self._replicas if x is not replica]
+                        self._last_refresh = 0.0  # force re-pull from controller
+                    continue
+                except Exception:
+                    pass  # app error: surfaces at the caller's get
+            return ref
+        return last_ref
 
     def _maybe_report(self) -> None:
         self._reqs_since_report += 1

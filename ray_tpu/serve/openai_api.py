@@ -1,0 +1,183 @@
+"""OpenAI-compatible ingress for the LLM engine.
+
+Parity: ray.serve.llm `build_openai_app` + the OpenAI-compatible HTTP surface
+(python/ray/llm/_internal/serve/core/ingress/ — /v1/completions,
+/v1/chat/completions, /v1/models; streaming via SSE chunks terminated by
+`data: [DONE]`). The engine is the native continuous-batching TPU engine
+(serve/llm.py), not a vLLM delegation.
+
+Tokenization is pluggable: pass any object with encode(str)->list[int] and
+decode(list[int])->str (e.g. a HuggingFace tokenizer); the default is a
+hermetic byte-level tokenizer so the API surface works without model assets.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import TYPE_CHECKING, Any, Optional
+
+import ray_tpu
+from ray_tpu.serve.deployment import deployment as _deployment
+
+if TYPE_CHECKING:
+    from ray_tpu.serve.llm import LLMConfig
+
+# Deployments that opted into the OpenAI proxy surface (the proxy only
+# dispatches /v1-style method routing for names registered here; arbitrary
+# apps keep their plain __call__ routing).
+OPENAI_DEPLOYMENT_NAMES: set[str] = {"OpenAIServer"}
+
+
+class ByteTokenizer:
+    """Hermetic fallback tokenizer: UTF-8 bytes shifted past special ids.
+    Ids beyond the byte range fold back into it (random-weight demo mode
+    samples from the full model vocab)."""
+
+    OFFSET = 3  # 0=pad, 1=bos, 2=eos
+
+    def encode(self, text: str) -> list[int]:
+        return [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes((i - self.OFFSET) % 256 for i in ids if i >= self.OFFSET)
+        return data.decode("utf-8", errors="replace")
+
+
+def _render_chat(messages: list[dict]) -> str:
+    """Minimal chat template (reference: chat templates live with the model;
+    this is the fallback rendering)."""
+    parts = [f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages]
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+def build_openai_app(config: "LLMConfig | None" = None, *,
+                     model_id: str = "ray-tpu-llm",
+                     tokenizer=None, num_replicas: int = 1):
+    """An OpenAI-API-shaped deployment over the native engine
+    (reference: ray.serve.llm build_openai_app). jax-heavy imports stay inside
+    this builder so `import ray_tpu.serve` never pays them."""
+    from ray_tpu.serve.llm import LLMConfig, LLMEngine
+
+    cfg = config or LLMConfig()
+    tok = tokenizer or ByteTokenizer()
+
+    @_deployment(name="OpenAIServer", num_replicas=num_replicas,
+                 ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=64)
+    class OpenAIServer:
+        def __init__(self, llm_config, tokenizer, model_id: str):
+            from ray_tpu.serve.llm import LLMEngine as _Engine
+
+            self.engine = _Engine(llm_config)
+            self.tok = tokenizer
+            self.model_id = model_id
+
+        # ---- OpenAI surface ----
+        def models(self, body: dict | None = None) -> dict:
+            return {
+                "object": "list",
+                "data": [{"id": self.model_id, "object": "model",
+                          "owned_by": "ray_tpu"}],
+            }
+
+        def completions(self, body: dict) -> dict:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = "".join(prompt)
+            ids = self.tok.encode(prompt)
+            res = self.engine.generate_sync(ids, body.get("max_tokens"))
+            text = self.tok.decode(res.token_ids)
+            return {
+                "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+                "object": "text_completion",
+                "created": int(time.time()),
+                "model": body.get("model", self.model_id),
+                "choices": [{
+                    "index": 0,
+                    "text": text,
+                    "finish_reason": res.finish_reason,
+                    "logprobs": None,
+                }],
+                "usage": {
+                    "prompt_tokens": res.num_prompt_tokens,
+                    "completion_tokens": res.num_generated,
+                    "total_tokens": res.num_prompt_tokens + res.num_generated,
+                },
+            }
+
+        def chat_completions(self, body: dict) -> dict:
+            prompt = _render_chat(body.get("messages", []))
+            ids = self.tok.encode(prompt)
+            res = self.engine.generate_sync(ids, body.get("max_tokens"))
+            text = self.tok.decode(res.token_ids)
+            return {
+                "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": body.get("model", self.model_id),
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": res.finish_reason,
+                }],
+                "usage": {
+                    "prompt_tokens": res.num_prompt_tokens,
+                    "completion_tokens": res.num_generated,
+                    "total_tokens": res.num_prompt_tokens + res.num_generated,
+                },
+            }
+
+        def chat_completions_stream(self, body: dict):
+            """Generator of OpenAI chat chunks (SSE frames at the proxy)."""
+            rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+            prompt = _render_chat(body.get("messages", []))
+            ids = self.tok.encode(prompt)
+            for tok_id in self.engine.generate_stream(ids, body.get("max_tokens")):
+                yield {
+                    "id": rid,
+                    "object": "chat.completion.chunk",
+                    "created": int(time.time()),
+                    "model": body.get("model", self.model_id),
+                    "choices": [{
+                        "index": 0,
+                        "delta": {"content": self.tok.decode([int(tok_id)])},
+                        "finish_reason": None,
+                    }],
+                }
+            yield {
+                "id": rid,
+                "object": "chat.completion.chunk",
+                "created": int(time.time()),
+                "model": body.get("model", self.model_id),
+                "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+            }
+
+        def completions_stream(self, body: dict):
+            rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = "".join(prompt)
+            ids = self.tok.encode(prompt)
+            for tok_id in self.engine.generate_stream(ids, body.get("max_tokens")):
+                yield {
+                    "id": rid,
+                    "object": "text_completion",
+                    "created": int(time.time()),
+                    "model": body.get("model", self.model_id),
+                    "choices": [{"index": 0, "text": self.tok.decode([int(tok_id)]),
+                                 "finish_reason": None}],
+                }
+            yield {
+                "id": rid,
+                "object": "text_completion",
+                "created": int(time.time()),
+                "model": body.get("model", self.model_id),
+                "choices": [{"index": 0, "text": "", "finish_reason": "stop"}],
+            }
+
+        def stats(self) -> dict:
+            return self.engine.stats()
+
+    return OpenAIServer.bind(cfg, tok, model_id)

@@ -1,0 +1,155 @@
+"""Serve hardening tests: OpenAI-compatible ingress + replica health-check
+restart (reference: llm/_internal/serve/core/ingress/, deployment_state.py
+health checks)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _post(url: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _sse_frames(url: str, body: dict) -> list:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), headers={"Content-Type": "application/json"}
+    )
+    frames = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            frames.append(json.loads(payload))
+    return frames
+
+
+def test_openai_completions_and_chat(session):
+    app = serve.build_openai_app()
+    serve.run(app, route_prefix="/v1")
+    proxy = serve.start_http_proxy(port=18431)
+    base = "http://127.0.0.1:18431/v1"
+
+    out = _post(f"{base}/completions", {"prompt": "hello", "max_tokens": 4})
+    assert out["object"] == "text_completion"
+    assert out["choices"][0]["finish_reason"] in ("length", "stop")
+    assert out["usage"]["completion_tokens"] == 4
+    assert isinstance(out["choices"][0]["text"], str)
+
+    chat = _post(
+        f"{base}/chat/completions",
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 3},
+    )
+    assert chat["object"] == "chat.completion"
+    assert chat["choices"][0]["message"]["role"] == "assistant"
+    assert chat["usage"]["completion_tokens"] == 3
+
+    models = _post(f"{base}/models", {})
+    assert models["data"][0]["id"] == "ray-tpu-llm"
+
+
+def test_openai_streaming_chat(session):
+    app = serve.build_openai_app()
+    serve.run(app, route_prefix="/v1")
+    serve.start_http_proxy(port=18432)
+    frames = _sse_frames(
+        "http://127.0.0.1:18432/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "go"}], "max_tokens": 5,
+         "stream": True},
+    )
+    chunks = [f for f in frames if f.get("object") == "chat.completion.chunk"]
+    assert len(chunks) == 6  # 5 delta chunks + final stop chunk
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    assert all("delta" in c["choices"][0] for c in chunks)
+
+
+def test_replica_death_recovers_and_traffic_continues(session):
+    """Kill a replica; the controller's health loop replaces it and the
+    handle keeps serving (reference: deployment_state replica restart)."""
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind(), route_prefix="/echo2")
+    assert ray_tpu.get(handle.remote(1), timeout=60) == 1
+    controller = ray_tpu.get_actor("_serve_controller")
+    replicas = ray_tpu.get(controller.get_replicas.remote("Echo"), timeout=30)
+    assert len(replicas) == 2
+    ray_tpu.kill(replicas[0])
+    # traffic continues throughout (the router skips the dead replica via retry
+    # on the live one; health loop replaces the dead one)
+    for i in range(10):
+        assert ray_tpu.get(handle.remote(i), timeout=60) == i
+        time.sleep(0.1)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        reps = ray_tpu.get(controller.get_replicas.remote("Echo"), timeout=30)
+        live = [r for r in reps if r is not None]
+        if len(live) == 2 and replicas[0] not in live:
+            break
+        time.sleep(0.25)
+    else:
+        pytest.fail("dead replica was not replaced")
+    status = serve.status()
+    assert status["Echo"]["running_replicas"] == 2
+
+
+def test_unhealthy_replica_replaced(session):
+    """A replica whose check_health starts failing is torn down after the
+    failure threshold and replaced."""
+    import os
+
+    marker = f"/tmp/_unhealthy_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @serve.deployment(num_replicas=1)
+    class Moody:
+        def __call__(self, x):
+            return x
+
+        def check_health(self):
+            if os.path.exists(marker):
+                raise RuntimeError("simulated unhealthiness")
+
+    handle = serve.run(Moody.bind(), route_prefix="/moody")
+    assert ray_tpu.get(handle.remote("ok"), timeout=60) == "ok"
+    controller = ray_tpu.get_actor("_serve_controller")
+    first = ray_tpu.get(controller.get_replicas.remote("Moody"), timeout=30)[0]
+    open(marker, "w").close()  # start failing health checks
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            reps = ray_tpu.get(controller.get_replicas.remote("Moody"), timeout=30)
+            if reps and reps[0] is not first:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("unhealthy replica was not replaced")
+    finally:
+        os.unlink(marker)
+    # the replacement is healthy and serving
+    assert ray_tpu.get(handle.remote("back"), timeout=60) == "back"
