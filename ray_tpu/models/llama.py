@@ -292,6 +292,72 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
     }
 
 
+def init_kv_pool(cfg: LlamaConfig, num_blocks: int, block_size: int) -> dict:
+    """Paged KV pool: [L, N_blocks, block_size, Hkv, D] per k/v.
+
+    Unlike the dense per-slot cache (init_kv_cache), HBM is allocated in
+    block_size-token pages handed out on demand by a host-side allocator
+    (serve/paged_kv.py), so memory scales with ACTUAL tokens, full prefix
+    blocks are shareable across sequences, and capacity admits many short
+    sequences or few long ones interchangeably (vLLM paged-KV semantics,
+    which the reference delegates to vLLM — here native)."""
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.hd)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def forward_paged(params, tokens, cfg: LlamaConfig, pool: dict, tables, lengths,
+                  block_size: int):
+    """Cached forward over a PAGED pool. tokens [B,S] append at positions
+    [lengths, lengths+S); tables [B, max_blocks] map sequence-block index ->
+    pool block id. Returns (logits [B,S,V], updated pool).
+
+    New K/V scatter into their pages ([B,S]-indexed .at[] scatter); attention
+    reads a gathered per-sequence view (pool[tables] — the transient gather
+    is the same traffic dense attention reads anyway; a pallas kernel that
+    indexes pages in-place is the planned upgrade per PAPERS.md)."""
+    B, S = tokens.shape
+    max_blocks = tables.shape[1]
+    positions = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    seq_blk = positions // block_size
+    # Pad positions past the table (bucketed prefill of a near-full sequence)
+    # must scatter into the reserved garbage block 0 — jax's gather clamp
+    # would otherwise alias them onto the REAL last block and clobber it.
+    oob = seq_blk >= max_blocks
+    blk_idx = tables[jnp.arange(B)[:, None], jnp.where(oob, 0, seq_blk)]  # [B,S]
+    blk_idx = jnp.where(oob, 0, blk_idx)
+    blk_off = positions % block_size
+    x = params["embed"][tokens].astype(cfg.dtype)
+    hd, nh, nkv = cfg.hd, cfg.num_heads, cfg.num_kv_heads
+
+    def body(x, layer_and_pool):
+        layer, kp, vp = layer_and_pool
+        y = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (y @ layer["wq"]).reshape(B, S, nh, hd)
+        k = (y @ layer["wk"]).reshape(B, S, nkv, hd)
+        v = (y @ layer["wv"]).reshape(B, S, nkv, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        kp = kp.at[blk_idx, blk_off].set(k.astype(kp.dtype))
+        vp = vp.at[blk_idx, blk_off].set(v.astype(vp.dtype))
+        k_seq = kp[tables].reshape(B, max_blocks * block_size, nkv, hd)
+        v_seq = vp[tables].reshape(B, max_blocks * block_size, nkv, hd)
+        o = _cached_attention(q, k_seq, v_seq, lengths, positions)
+        x = x + (o.reshape(B, S, nh * hd) @ layer["wo"])
+        y = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu(y @ layer["w_gate"])
+        x = x + ((gate * (y @ layer["w_up"])) @ layer["w_down"])
+        return x, (kp, vp)
+
+    x, (out_k, out_v) = jax.lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": out_k, "v": out_v}
+
+
 def _cached_attention(q, k_cache, v_cache, lengths, q_positions):
     """q: [B,S,Hq,D]; caches [B,Smax,Hkv,D]; lengths [B] = valid KV prefix."""
     B, S, Hq, D = q.shape

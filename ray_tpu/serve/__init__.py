@@ -15,6 +15,16 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.openai_api import build_openai_app
+
+
+def __getattr__(name):
+    # jax-heavy engine classes load lazily (importing ray_tpu.serve must not
+    # pull jax/llama)
+    if name in ("PagedLLMConfig", "PagedLLMEngine"):
+        from ray_tpu.serve import llm_paged
+
+        return getattr(llm_paged, name)
+    raise AttributeError(name)
 from ray_tpu.serve.controller import DeploymentHandle, ServeController
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
 
@@ -22,6 +32,7 @@ __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "run", "delete", "status", "shutdown", "start_http_proxy",
     "get_deployment_handle", "build_openai_app",
+    "PagedLLMConfig", "PagedLLMEngine",
     "batch", "DeploymentHandle", "ServeController",
     "multiplexed", "get_multiplexed_model_id",
 ]

@@ -121,6 +121,7 @@ class ServeController:
         self._deployments: dict[str, _DeploymentState] = {}
         self._routes: dict[str, str] = {}  # route_prefix -> deployment name
         self._health_failures: dict[str, int] = {}  # replica -> consecutive fails
+        self._health_probes: dict[str, tuple] = {}  # replica -> (ref, sent_ts)
         self._lock = threading.Lock()
         self._reconcile_lock = threading.Lock()  # serializes reconcile passes
         self._running = True
@@ -284,26 +285,49 @@ class ServeController:
             time.sleep(self.HEALTH_CHECK_PERIOD_S)
 
     def _health_check_tick(self) -> None:
-        """Probe every replica's health_check CONCURRENTLY; consecutive
-        failures tear the replica down and reconcile replaces it (reference:
-        deployment_state.py health-check -> replica restart loop)."""
+        """One-outstanding-probe-per-replica health checking: ticks stay ~1s
+        (a hung replica never stalls probing of the others), a probe only
+        counts as failed when IT exceeds HEALTH_CHECK_TIMEOUT_S, and
+        consecutive failures tear the replica down for reconcile to replace
+        (reference: deployment_state.py async health checks)."""
+        now = time.monotonic()
         with self._lock:
-            probes = [
+            replicas = [
                 (st, r) for st in self._deployments.values() for r in list(st.replicas)
             ]
-        if not probes:
-            return
-        refs = [r.health_check.remote() for _, r in probes]
-        ray_tpu.wait(refs, num_returns=len(refs), timeout=self.HEALTH_CHECK_TIMEOUT_S)
-        for (st, r), ref in zip(probes, refs):
+        live_keys = set()
+        for st, r in replicas:
             key = r._actor_id.hex()
-            try:
-                ray_tpu.get(ref, timeout=0.1)  # already-resolved or timed out
-                self._health_failures.pop(key, None)
+            live_keys.add(key)
+            if key not in self._health_probes:
+                self._health_probes[key] = (r.health_check.remote(), now)
+        for key in list(self._health_probes):  # drop state for vanished replicas
+            if key not in live_keys:
+                del self._health_probes[key]
+        for st, r in replicas:
+            key = r._actor_id.hex()
+            probe = self._health_probes.get(key)
+            if probe is None:
                 continue
-            except ray_tpu.exceptions.ActorDiedError:
-                pass  # definitively dead: replace immediately
-            except Exception:
+            ref, sent = probe
+            ready, _ = ray_tpu.wait([ref], timeout=0)
+            failed: object = False
+            if ready:
+                del self._health_probes[key]
+                try:
+                    ray_tpu.get(ref, timeout=1)
+                    self._health_failures.pop(key, None)
+                    continue
+                except ray_tpu.exceptions.ActorDiedError:
+                    failed = "dead"  # definitively dead: replace immediately
+                except Exception:
+                    failed = True
+            elif now - sent > self.HEALTH_CHECK_TIMEOUT_S:
+                del self._health_probes[key]  # probe expired: counts as failure
+                failed = True
+            if failed is False:
+                continue  # probe still outstanding within its deadline
+            if failed != "dead":
                 n = self._health_failures.get(key, 0) + 1
                 self._health_failures[key] = n
                 if n < self.HEALTH_CHECK_FAILURE_THRESHOLD:

@@ -78,8 +78,7 @@ class LLMEngine:
         self._jnp = jnp
         key = jax.random.PRNGKey(seed)
         self.params = params if params is not None else llama.init(cfg, key)
-        B, S = config.max_batch_size, config.max_seq_len
-        self.cache = llama.init_kv_cache(cfg, B, S)
+        B = config.max_batch_size
         self.lengths = np.zeros(B, dtype=np.int32)
         self.last_tokens = np.zeros((B, 1), dtype=np.int32)
         self.active = np.zeros(B, dtype=bool)
@@ -88,8 +87,18 @@ class LLMEngine:
         self._lock = threading.Lock()
         self._running = True
         self._sample_key = key
+        self._init_backend()  # subclass hook: cache/pool + jitted programs
+        self._loop_thread = threading.Thread(target=self._loop, daemon=True,
+                                             name=type(self).__name__)
+        self._loop_thread.start()
 
-        # --- jitted programs ---
+    def _init_backend(self) -> None:
+        """Dense per-slot KV cache backend (paged subclass overrides)."""
+        jax, jnp = self._jax, self._jnp
+        cfg = self.config.model_config
+        B, S = self.config.max_batch_size, self.config.max_seq_len
+        self.cache = llama.init_kv_cache(cfg, B, S)
+
         def prefill(params, cache, tokens, slot, length):
             # slice this slot's cache, run, write back (single compile per bucket)
             sl = lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
@@ -109,30 +118,31 @@ class LLMEngine:
 
         self._prefill = jax.jit(prefill)
         self._decode = jax.jit(decode, donate_argnums=(1,))
-        self._loop_thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
-        self._loop_thread.start()
 
     # ---- public API ----
+    def _validate(self, prompt_ids, max_new) -> Optional[Exception]:
+        if not prompt_ids:
+            return ValueError("prompt_ids must be non-empty")
+        vocab = self.config.model_config.vocab_size
+        if not all(isinstance(t, (int, np.integer)) and 0 <= t < vocab
+                   for t in prompt_ids):
+            return ValueError("prompt_ids must be ints within the vocabulary")
+        if len(prompt_ids) + max_new > self.config.max_seq_len:
+            return ValueError(
+                f"prompt ({len(prompt_ids)}) + max_new_tokens ({max_new}) exceeds "
+                f"max_seq_len {self.config.max_seq_len}"
+            )
+        return None
+
     def generate(self, prompt_ids: list[int], max_new_tokens: int | None = None) -> Future:
         fut: Future = Future()
         max_new = self.config.max_new_tokens_default if max_new_tokens is None else max_new_tokens
-        if not prompt_ids:
-            fut.set_exception(ValueError("prompt_ids must be non-empty"))
+        err = self._validate(prompt_ids, max_new)
+        if err is not None:
+            fut.set_exception(err)
             return fut
         if max_new <= 0:
             fut.set_result(GenerationResult([], len(prompt_ids), 0, 0.0, 0.0))
-            return fut
-        if not all(isinstance(t, int) and 0 <= t < self.config.model_config.vocab_size
-                   for t in prompt_ids):
-            fut.set_exception(ValueError("prompt_ids must be ints within the vocabulary"))
-            return fut
-        if len(prompt_ids) + max_new > self.config.max_seq_len:
-            fut.set_exception(
-                ValueError(
-                    f"prompt ({len(prompt_ids)}) + max_new_tokens ({max_new}) exceeds "
-                    f"max_seq_len {self.config.max_seq_len}"
-                )
-            )
             return fut
         self._pending.put((list(prompt_ids), max_new, fut, time.monotonic(), None))
         return fut
@@ -145,15 +155,11 @@ class LLMEngine:
         sentinel so consumers never hang."""
         fut: Future = Future()
         max_new = self.config.max_new_tokens_default if max_new_tokens is None else max_new_tokens
-        if not prompt_ids:
-            raise ValueError("prompt_ids must be non-empty")
-        if not all(isinstance(t, int) and 0 <= t < self.config.model_config.vocab_size
-                   for t in prompt_ids):
-            raise ValueError("prompt_ids must be ints within the vocabulary")
+        err = self._validate(prompt_ids, max_new)
+        if err is not None:
+            raise err
         if max_new <= 0:
             return
-        if len(prompt_ids) + max_new > self.config.max_seq_len:
-            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         tq: "queue.Queue" = queue.Queue()
         self._pending.put((list(prompt_ids), max_new, fut, time.monotonic(), tq))
         while True:
@@ -205,13 +211,18 @@ class LLMEngine:
             if not did_work:
                 time.sleep(0.002)
 
+    def _release_slot(self, i: int) -> None:
+        """Free a slot's resources (paged subclass also returns KV blocks and
+        zeroes the slot's table row)."""
+        self.active[i] = False
+        self.slots[i] = None
+
     def _fail_all_active(self, exc: Exception) -> None:
         with self._lock:
             for i in range(self.config.max_batch_size):
                 st = self.slots[i]
                 if st is not None:
-                    self.active[i] = False
-                    self.slots[i] = None
+                    self._release_slot(i)
                     if not st.future.done():
                         st.future.set_exception(exc)
                     if st.token_queue is not None:
@@ -295,8 +306,7 @@ class LLMEngine:
                 finish_reason="stop" if eos else "length",
             )
             with self._lock:
-                self.active[slot] = False
-                self.slots[slot] = None
+                self._release_slot(slot)
             if st.token_queue is not None:
                 st.token_queue.put(None)  # end-of-stream
             if not st.future.done():

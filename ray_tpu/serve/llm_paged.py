@@ -1,0 +1,321 @@
+"""Paged-KV continuous-batching engine + prefill/decode disaggregation.
+
+Parity: the reference delegates both to vLLM (paged KV / automatic prefix
+caching in the engine, PD disaggregation in
+llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py). Here both
+are native:
+
+- ``PagedLLMEngine``: LLMEngine's continuous-batching shell (scheduling,
+  streaming, sampling, finish/fail paths are inherited) over a block-pool KV
+  (models.llama.forward_paged + serve/paged_kv.py allocator). Memory scales
+  with actual tokens reserved per request — many short sequences or few long
+  ones share one pool — and full prompt blocks are content-addressed so
+  shared prefixes prefill once and occupy memory once.
+- ``prefill_extract`` / ``attach_sequence``: the KV handoff pair backing PD
+  disaggregation — a prefill engine computes a sequence's KV pages and ships
+  them (host numpy; cross-host this rides the object plane), a decode engine
+  adopts them and streams tokens. Both run ON the engine thread (the pool is
+  donated through jit calls; foreign-thread mutation would race).
+
+Admission reserves ceil((prompt+max_new)/block) pages upfront, so decode
+never preempts mid-sequence (vLLM-style preemption is a later refinement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.models import llama
+from ray_tpu.serve.llm import LLMConfig, LLMEngine, _Slot
+from ray_tpu.serve.paged_kv import BlockPool, NoFreeBlocks
+
+
+@dataclasses.dataclass
+class PagedLLMConfig(LLMConfig):
+    block_size: int = 16
+    num_blocks: int = 0  # 0 = dense-parity capacity (B * Smax / block_size)
+
+
+class PagedLLMEngine(LLMEngine):
+    """Continuous batching over a paged KV pool with prefix caching."""
+
+    def __init__(self, config: PagedLLMConfig | None = None, params=None, seed: int = 0):
+        # PD ops (prefill_extract / attach) processed on the engine thread
+        self._ops: "queue.Queue" = queue.Queue()
+        super().__init__(config or PagedLLMConfig(), params=params, seed=seed)
+
+    def _init_backend(self) -> None:
+        jax, jnp = self._jax, self._jnp
+        cfg = self.config.model_config
+        B, S, bs = (self.config.max_batch_size, self.config.max_seq_len,
+                    self.config.block_size)
+        if S % bs:
+            raise ValueError(f"max_seq_len {S} must be a block_size {bs} multiple")
+        self.max_blocks_per_seq = S // bs
+        n_blocks = self.config.num_blocks or (B * self.max_blocks_per_seq + 1)
+        self.pool_blocks = n_blocks
+        self.pool = llama.init_kv_pool(cfg, n_blocks, bs)
+        self.allocator = BlockPool(n_blocks, bs)
+        self.tables = np.zeros((B, self.max_blocks_per_seq), dtype=np.int32)
+        self.slot_blocks: list[list[int]] = [[] for _ in range(B)]
+        self.slot_prompts: list[Optional[list[int]]] = [None] * B
+
+        def prefill(params, pool, tokens, table, start_len):
+            # B=1 row: run the suffix, return per-position logits
+            logits, pool = llama.forward_paged(
+                params, tokens, cfg, pool, table, start_len, bs
+            )
+            return logits[0], pool
+
+        def decode(params, pool, last_tokens, lengths, tables):
+            logits, pool = llama.forward_paged(
+                params, last_tokens, cfg, pool, tables, lengths, bs
+            )
+            return logits[:, 0], pool
+
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        self._decode = jax.jit(decode, donate_argnums=(1,))
+
+    # ---- slot lifecycle ----
+    def _release_slot(self, i: int) -> None:
+        """Free blocks AND zero the slot's rows: the batched decode scatters
+        every row each step, so a stale table/length would keep writing into
+        blocks after they're reallocated to other sequences (silent KV
+        corruption). Zeroed rows write into reserved garbage block 0."""
+        super()._release_slot(i)
+        self.tables[i] = 0
+        self.lengths[i] = 0
+        self.last_tokens[i] = 0
+        if self.slot_blocks[i]:
+            self.allocator.free(self.slot_blocks[i])
+            self.slot_blocks[i] = []
+        self.slot_prompts[i] = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "active": int(self.active.sum()),
+                "pending": self._pending.qsize(),
+                **self.allocator.stats(),
+            }
+
+    def kv_memory_bytes(self) -> int:
+        """Persistent KV pool footprint (the headroom metric vs dense)."""
+        cfg = self.config.model_config
+        itemsize = 4 if "float32" in str(cfg.dtype) else 2
+        return (2 * cfg.num_layers * self.pool_blocks * self.config.block_size
+                * cfg.num_kv_heads * cfg.hd * itemsize)
+
+    # ---- engine loop ----
+    def _admit_one(self, prompt, max_new, fut, t_enq, tq, slot) -> bool:
+        jnp = self._jnp
+        bs = self.config.block_size
+        total_blocks = -(-(len(prompt) + max_new) // bs)
+        hit_ids, cached_len = self.allocator.lookup_prefix(prompt)
+        if cached_len >= len(prompt):
+            # whole prompt block-aligned-cached: recompute the last block so
+            # we still have logits to sample the first token from
+            self.allocator.free([hit_ids.pop()])
+            cached_len -= bs
+        try:
+            fresh = self.allocator.alloc(total_blocks - len(hit_ids))
+        except NoFreeBlocks:
+            for b in hit_ids:
+                self.allocator.free([b])
+            return False  # requeue: capacity frees as sequences finish
+        block_ids = hit_ids + fresh
+        suffix = prompt[cached_len:]
+        # clamp the prefill bucket so padded positions stay inside the table
+        bucket = min(self._bucket(len(suffix)),
+                     self.config.max_seq_len - cached_len)
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, : len(suffix)] = suffix
+        table_row = np.zeros((1, self.max_blocks_per_seq), dtype=np.int32)
+        table_row[0, : len(block_ids)] = block_ids
+        try:
+            logits, self.pool = self._prefill(
+                self.params, self.pool, jnp.asarray(padded),
+                jnp.asarray(table_row), jnp.asarray([cached_len], np.int32),
+            )
+            tok = self._sample(np.asarray(logits)[len(suffix) - 1])
+        except Exception as e:  # noqa: BLE001 - bad request: fail, keep serving
+            self.allocator.free(block_ids)
+            if not fut.done():
+                fut.set_exception(e)
+            if tq is not None:
+                tq.put(None)
+            return True
+        self.allocator.register_prefix(prompt, block_ids,
+                                       skip_blocks=cached_len // bs)
+        with self._lock:
+            st = _Slot(fut, max_new, len(prompt), t_enq, tq)
+            st.generated.append(tok)
+            if tq is not None:
+                tq.put(tok)
+            st.first_token_time = time.monotonic()
+            self.slots[slot] = st
+            self.active[slot] = True
+            self.lengths[slot] = len(prompt)
+            self.last_tokens[slot, 0] = tok
+            self.tables[slot] = table_row[0]
+            self.slot_blocks[slot] = block_ids
+            self.slot_prompts[slot] = list(prompt)
+        self._maybe_finish(slot, tok)
+        return True
+
+    def _loop_step(self) -> bool:
+        jnp = self._jnp
+        did_work = False
+        for _ in range(self._ops.qsize()):  # bounded: attach may requeue itself
+            try:
+                kind, payload, fut = self._ops.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                if kind == "prefill_extract":
+                    fut.set_result(self._do_prefill_extract(payload))
+                else:
+                    self._do_attach(payload, fut)
+            except Exception as e:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(e)
+            did_work = True
+        free = [i for i in range(self.config.max_batch_size) if not self.active[i]]
+        requeue = []
+        while free and not self._pending.empty():
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            slot = free.pop(0)
+            if not self._admit_one(*req, slot):
+                requeue.append(req)
+                free.insert(0, slot)
+                break  # pool exhausted: stop admitting this pass
+            did_work = True
+        for req in requeue:
+            self._pending.put(req)
+        if self.active.any():
+            logits, self.pool = self._decode(
+                self.params, self.pool, jnp.asarray(self.last_tokens),
+                jnp.asarray(self.lengths), jnp.asarray(self.tables),
+            )
+            logits_np = np.asarray(logits)
+            with self._lock:
+                for i in range(self.config.max_batch_size):
+                    if not self.active[i]:
+                        continue
+                    tok = self._sample(logits_np[i])
+                    st = self.slots[i]
+                    st.generated.append(tok)
+                    if st.token_queue is not None:
+                        st.token_queue.put(tok)
+                    self.lengths[i] += 1
+                    self.last_tokens[i, 0] = tok
+            for i in range(self.config.max_batch_size):
+                if self.active[i]:
+                    self._maybe_finish(i, self.slots[i].generated[-1])
+            did_work = True
+        return did_work
+
+    # ---- PD disaggregation handoff (reference: pd_server.py + NIXL KV
+    # transfer; here KV pages travel as host arrays over the object plane) ----
+    def prefill_extract(self, prompt_ids: list[int], timeout: float = 120.0) -> dict:
+        """Prefill-only: compute the prompt's KV pages and first token, then
+        release local blocks. Returns a handoff payload for attach_sequence."""
+        fut: Future = Future()
+        self._ops.put(("prefill_extract", list(prompt_ids), fut))
+        return fut.result(timeout=timeout)
+
+    def attach_sequence(self, handoff: dict, max_new_tokens: int) -> Future:
+        """Adopt a prefilled sequence (KV pages + first token) and decode it
+        (the decode half of PD disaggregation)."""
+        fut: Future = Future()
+        self._ops.put(("attach", (handoff, max_new_tokens), fut))
+        return fut
+
+    def _do_prefill_extract(self, prompt_ids: list[int]) -> dict:
+        import jax.numpy as jnp
+
+        bs = self.config.block_size
+        err = self._validate(prompt_ids, 1)
+        if err is not None:
+            raise err
+        n_blocks = -(-len(prompt_ids) // bs)
+        block_ids = self.allocator.alloc(n_blocks)
+        padded_len = min(self._bucket(len(prompt_ids)), self.config.max_seq_len)
+        padded = np.zeros((1, padded_len), dtype=np.int32)
+        padded[0, : len(prompt_ids)] = prompt_ids
+        table_row = np.zeros((1, self.max_blocks_per_seq), dtype=np.int32)
+        table_row[0, :n_blocks] = block_ids
+        try:
+            logits, self.pool = self._prefill(
+                self.params, self.pool, jnp.asarray(padded),
+                jnp.asarray(table_row), jnp.asarray([0], np.int32),
+            )
+            first_tok = self._sample(np.asarray(logits)[len(prompt_ids) - 1])
+            idx = np.asarray(block_ids, dtype=np.int32)
+            kv = {
+                "k": np.asarray(self.pool["k"][:, idx]),  # [L, n, bs, H, D]
+                "v": np.asarray(self.pool["v"][:, idx]),
+            }
+        finally:
+            self.allocator.free(block_ids)
+        return {
+            "kv": kv,
+            "first_token": first_tok,
+            "prompt_len": len(prompt_ids),
+        }
+
+    def _do_attach(self, payload, fut: Future) -> None:
+        import jax.numpy as jnp
+
+        handoff, max_new_tokens = payload
+        prompt_len = handoff["prompt_len"]
+        bs = self.config.block_size
+        if prompt_len <= 0:
+            raise ValueError("handoff prompt_len must be positive")
+        if prompt_len + max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"attached sequence ({prompt_len}+{max_new_tokens}) exceeds "
+                f"max_seq_len {self.config.max_seq_len}"
+            )
+        with self._lock:
+            slot = next(
+                (i for i in range(self.config.max_batch_size)
+                 if not self.active[i] and self.slots[i] is None), None,
+            )
+        if slot is None:
+            # decode side saturated: requeue the op for a later pass
+            self._ops.put(("attach", payload, fut))
+            return
+        n_prefill_blocks = handoff["kv"]["k"].shape[1]
+        total_blocks = -(-(prompt_len + max_new_tokens) // bs)
+        block_ids = self.allocator.alloc(total_blocks)
+        try:
+            idx = np.asarray(block_ids[:n_prefill_blocks], dtype=np.int32)
+            self.pool["k"] = self.pool["k"].at[:, idx].set(
+                jnp.asarray(handoff["kv"]["k"]))
+            self.pool["v"] = self.pool["v"].at[:, idx].set(
+                jnp.asarray(handoff["kv"]["v"]))
+            with self._lock:
+                st = _Slot(fut, max_new_tokens, prompt_len, time.monotonic())
+                st.generated.append(handoff["first_token"])
+                st.first_token_time = time.monotonic()
+                self.slots[slot] = st
+                self.active[slot] = True
+                self.lengths[slot] = prompt_len
+                self.last_tokens[slot, 0] = handoff["first_token"]
+                row = np.zeros(self.max_blocks_per_seq, dtype=np.int32)
+                row[: len(block_ids)] = block_ids
+                self.tables[slot] = row
+                self.slot_blocks[slot] = block_ids
+        except BaseException:
+            self.allocator.free(block_ids)
+            raise

@@ -68,8 +68,10 @@ def build_openai_app(config: "LLMConfig | None" = None, *,
                  ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=64)
     class OpenAIServer:
         def __init__(self, llm_config, tokenizer, model_id: str):
-            from ray_tpu.serve.llm import LLMEngine as _Engine
+            from ray_tpu.serve.llm import LLMEngine as _Dense
+            from ray_tpu.serve.llm_paged import PagedLLMConfig, PagedLLMEngine
 
+            _Engine = PagedLLMEngine if isinstance(llm_config, PagedLLMConfig) else _Dense
             self.engine = _Engine(llm_config)
             self.tok = tokenizer
             self.model_id = model_id
@@ -129,12 +131,32 @@ def build_openai_app(config: "LLMConfig | None" = None, *,
                 },
             }
 
+        def _stream_deltas(self, ids: list[int], max_tokens):
+            """Incremental detokenization: decode the WHOLE generated id list
+            each step and emit the text delta, holding back a trailing
+            partial character (multi-byte/multi-token chars must not split
+            into replacement chars across chunks — vLLM's incremental
+            detokenizer behavior)."""
+            generated: list[int] = []
+            emitted = ""
+            for tok_id in self.engine.generate_stream(ids, max_tokens):
+                generated.append(int(tok_id))
+                text = self.tok.decode(generated)
+                if text.endswith("�"):
+                    text = text[:-1]  # maybe-incomplete char: wait one token
+                if len(text) > len(emitted):
+                    delta, emitted = text[len(emitted):], text
+                    yield delta
+            final = self.tok.decode(generated)
+            if len(final) > len(emitted):
+                yield final[len(emitted):]
+
         def chat_completions_stream(self, body: dict):
             """Generator of OpenAI chat chunks (SSE frames at the proxy)."""
             rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
             prompt = _render_chat(body.get("messages", []))
             ids = self.tok.encode(prompt)
-            for tok_id in self.engine.generate_stream(ids, body.get("max_tokens")):
+            for delta in self._stream_deltas(ids, body.get("max_tokens")):
                 yield {
                     "id": rid,
                     "object": "chat.completion.chunk",
@@ -142,7 +164,7 @@ def build_openai_app(config: "LLMConfig | None" = None, *,
                     "model": body.get("model", self.model_id),
                     "choices": [{
                         "index": 0,
-                        "delta": {"content": self.tok.decode([int(tok_id)])},
+                        "delta": {"content": delta},
                         "finish_reason": None,
                     }],
                 }
@@ -160,14 +182,13 @@ def build_openai_app(config: "LLMConfig | None" = None, *,
             if isinstance(prompt, list):
                 prompt = "".join(prompt)
             ids = self.tok.encode(prompt)
-            for tok_id in self.engine.generate_stream(ids, body.get("max_tokens")):
+            for delta in self._stream_deltas(ids, body.get("max_tokens")):
                 yield {
                     "id": rid,
                     "object": "text_completion",
                     "created": int(time.time()),
                     "model": body.get("model", self.model_id),
-                    "choices": [{"index": 0, "text": self.tok.decode([int(tok_id)]),
-                                 "finish_reason": None}],
+                    "choices": [{"index": 0, "text": delta, "finish_reason": None}],
                 }
             yield {
                 "id": rid,

@@ -1,0 +1,121 @@
+"""Paged-KV engine tests: correctness vs the dense engine, prefix caching,
+memory headroom, PD disaggregation handoff (reference: vLLM paged KV /
+automatic prefix caching / pd_server.py — native here)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.serve.llm import LLMConfig, LLMEngine
+from ray_tpu.serve.llm_paged import PagedLLMConfig, PagedLLMEngine
+
+
+@pytest.fixture(scope="module")
+def shared_params():
+    import jax
+
+    cfg = llama.LlamaConfig.tiny()
+    return cfg, llama.init(cfg, jax.random.PRNGKey(7))
+
+
+def _paged(cfg, params, **kw):
+    pc = PagedLLMConfig(model_config=cfg, max_batch_size=4, max_seq_len=128,
+                        block_size=16, **kw)
+    return PagedLLMEngine(pc, params=params)
+
+
+def test_paged_matches_dense_greedy(shared_params):
+    cfg, params = shared_params
+    dense = LLMEngine(
+        LLMConfig(model_config=cfg, max_batch_size=4, max_seq_len=128),
+        params=params,
+    )
+    paged = _paged(cfg, params)
+    prompts = [[5, 9, 13, 2, 7], [3, 3, 8], list(range(1, 40))]
+    try:
+        for p in prompts:
+            a = dense.generate_sync(p, 12)
+            b = paged.generate_sync(p, 12)
+            assert a.token_ids == b.token_ids, f"prompt {p[:5]}..."
+    finally:
+        dense.shutdown()
+        paged.shutdown()
+
+
+def test_prefix_cache_reuses_blocks(shared_params):
+    cfg, params = shared_params
+    eng = _paged(cfg, params)
+    try:
+        shared_prefix = list(range(1, 33))  # two full 16-token blocks
+        r1 = eng.generate_sync(shared_prefix + [40, 41], 8)
+        s0 = eng.allocator.stats()
+        assert s0["cached_blocks"] >= 2
+        r2 = eng.generate_sync(shared_prefix + [50, 51, 52], 8)
+        s1 = eng.allocator.stats()
+        assert s1["prefix_hits"] >= 1  # second request reused the prefix
+        # same shared context must not change the continuation determinism
+        r3 = eng.generate_sync(shared_prefix + [40, 41], 8)
+        assert r3.token_ids == r1.token_ids
+    finally:
+        eng.shutdown()
+
+
+def test_memory_headroom_vs_dense(shared_params):
+    """VERDICT criterion: >=2x memory headroom at mixed sequence lengths.
+    A paged pool sized at HALF the dense cache serves the same mixed-length
+    workload (memory scales with actual tokens, not slots x max_seq_len)."""
+    cfg, params = shared_params
+    B, S, bs = 4, 128, 16
+    dense_blocks_equiv = B * (S // bs)  # dense reserves B x S always
+    eng = _paged(cfg, params, num_blocks=dense_blocks_equiv // 2 + 1)
+    try:
+        itemsize = 4 if "float32" in str(cfg.dtype) else 2
+        dense_bytes = 2 * cfg.num_layers * B * S * cfg.num_kv_heads * cfg.hd * itemsize
+        assert eng.kv_memory_bytes() < 0.6 * dense_bytes
+        # mixed short sequences: 4 concurrent x (24 prompt + 8 new) = 2 blocks
+        # each -> fits the half-size pool with room to spare
+        futs = [eng.generate(list(range(1, 25)), 8) for _ in range(4)]
+        outs = [f.result(timeout=120) for f in futs]
+        assert all(o.num_generated == 8 for o in outs)
+        # and capacity queues rather than fails when oversubscribed
+        more = [eng.generate([7] * 100, 8) for _ in range(3)]
+        outs2 = [f.result(timeout=240) for f in more]
+        assert all(o.num_generated == 8 for o in outs2)
+    finally:
+        eng.shutdown()
+
+
+def test_blocks_released_on_finish(shared_params):
+    cfg, params = shared_params
+    eng = _paged(cfg, params)
+    try:
+        before = eng.allocator.stats()["allocated_blocks"]
+        eng.generate_sync([4, 5, 6, 7], 4)
+        after = eng.allocator.stats()
+        # non-cacheable remainder blocks return to the free list; only full
+        # prompt blocks may stay cached (ref 0, reusable)
+        assert after["allocated_blocks"] == before
+    finally:
+        eng.shutdown()
+
+
+def test_pd_disaggregation_handoff(shared_params):
+    """Prefill on engine A, decode on engine B -> same tokens as a single
+    engine end-to-end (the PD smoke per VERDICT)."""
+    cfg, params = shared_params
+    prefiller = _paged(cfg, params)
+    decoder = _paged(cfg, params)
+    ref_engine = _paged(cfg, params)
+    prompt = list(range(2, 30))
+    try:
+        expect = ref_engine.generate_sync(prompt, 10).token_ids
+        handoff = prefiller.prefill_extract(prompt)
+        assert handoff["prompt_len"] == len(prompt)
+        fut = decoder.attach_sequence(handoff, 10)
+        got = fut.result(timeout=120)
+        assert got.token_ids == expect
+        assert got.num_prompt_tokens == len(prompt)
+    finally:
+        prefiller.shutdown()
+        decoder.shutdown()
+        ref_engine.shutdown()
