@@ -98,12 +98,26 @@ class PagedLLMEngine(LLMEngine):
         self.slot_prompts[i] = None
 
     def stats(self) -> dict:
+        # keep the base engine's schema (dashboards read active_slots/max_slots
+        # regardless of engine type) and add the allocator's fields
         with self._lock:
             return {
-                "active": int(self.active.sum()),
+                "active_slots": int(self.active.sum()),
+                "max_slots": self.config.max_batch_size,
                 "pending": self._pending.qsize(),
                 **self.allocator.stats(),
             }
+
+    def shutdown(self) -> None:
+        super().shutdown()  # stops the loop + fails active slots
+        # drain queued PD ops so their callers fail fast instead of timing out
+        while True:
+            try:
+                _, _, fut = self._ops.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(RuntimeError("LLM engine shut down"))
 
     def kv_memory_bytes(self) -> int:
         """Persistent KV pool footprint (the headroom metric vs dense)."""
@@ -117,6 +131,16 @@ class PagedLLMEngine(LLMEngine):
         jnp = self._jnp
         bs = self.config.block_size
         total_blocks = -(-(len(prompt) + max_new) // bs)
+        if total_blocks > self.pool_blocks - 1:
+            # can never fit this pool: reject now rather than requeue forever
+            if not fut.done():
+                fut.set_exception(ValueError(
+                    f"request needs {total_blocks} KV blocks but the pool has "
+                    f"{self.pool_blocks - 1}; raise num_blocks or shorten the request"
+                ))
+            if tq is not None:
+                tq.put(None)
+            return True
         hit_ids, cached_len = self.allocator.lookup_prefix(prompt)
         if cached_len >= len(prompt):
             # whole prompt block-aligned-cached: recompute the last block so
@@ -319,3 +343,5 @@ class PagedLLMEngine(LLMEngine):
         except BaseException:
             self.allocator.free(block_ids)
             raise
+        # a 1-token (or 0-token) request is already complete with first_token
+        self._maybe_finish(slot, handoff["first_token"])

@@ -15,10 +15,12 @@ from ray_tpu.train.config import (
 )
 from ray_tpu.train.context import TrainContext, get_context, report
 from ray_tpu.train.controller import TrainController
+from ray_tpu.train.gang import run_jax_gang
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [
+    "run_jax_gang",
     "Checkpoint",
     "CheckpointManager",
     "CheckpointConfig",

@@ -1,0 +1,152 @@
+"""Multi-process SPMD gang: per-worker jax.distributed initialization.
+
+Parity: train/v2/jax/config.py:60 (_setup_jax_distributed_environment) — every
+train worker is an OS process that calls jax.distributed.initialize against
+the rank-0 coordinator, contributing its local devices to ONE global mesh;
+MEGASCALE env vars are injected per worker for multislice (config.py:29-35).
+On real hardware each gang member owns a TPU host's chips; in CI the members
+are CPU processes with virtual devices and the collectives ride Gloo — the
+same activation path either way.
+
+Gang members run as runtime tasks (process workers) that each exec a CLEAN
+interpreter for the jax work: XLA device-count flags and the TPU platform
+choice must be set before jax's first import, and pooled workers may already
+hold an initialized jax.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Callable, Optional
+
+import cloudpickle
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _local_ip() -> str:
+    """An address other hosts' gang members can reach (multi-node clusters);
+    loopback only as a last resort."""
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _gang_member(rank: int, num_workers: int, coordinator: str,
+                 devices_per_worker: int, fn_blob: bytes,
+                 env_extra: dict, use_tpu: bool, timeout: float = 600.0) -> bytes:
+    """Runtime task: exec a clean interpreter for this gang rank's jax work."""
+    payload = {
+        "rank": rank,
+        "num_workers": num_workers,
+        "coordinator": coordinator,
+        "fn_blob": fn_blob,
+    }
+    with tempfile.NamedTemporaryFile(suffix=".in", delete=False) as f:
+        f.write(pickle.dumps(payload))
+        in_path = f.name
+    out_path = in_path + ".out"
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    if use_tpu:
+        env["RAY_TPU_WORKER_TPU"] = "1"
+    else:
+        env["JAX_PLATFORMS"] = "cpu"
+        stripped = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                          env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            stripped + f" --xla_force_host_platform_device_count={devices_per_worker}"
+        ).strip()
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(filter(None, [env.get("PYTHONPATH"), pkg_root]))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.train.gang", in_path, out_path],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"gang rank {rank} failed (rc={proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}"
+            )
+        with open(out_path, "rb") as f:
+            return f.read()
+    finally:
+        for p in (in_path, out_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def _child_main(in_path: str, out_path: str) -> None:
+    with open(in_path, "rb") as f:
+        payload = pickle.load(f)
+    if os.environ.get("RAY_TPU_WORKER_TPU") != "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+    jax.distributed.initialize(
+        payload["coordinator"],
+        num_processes=payload["num_workers"],
+        process_id=payload["rank"],
+    )
+    fn = cloudpickle.loads(payload["fn_blob"])
+    result = fn(payload["rank"])
+    with open(out_path, "wb") as f:
+        f.write(cloudpickle.dumps(result))
+
+
+def run_jax_gang(
+    train_fn: Callable[[int], object],
+    num_workers: int,
+    devices_per_worker: int = 2,
+    use_tpu: bool = False,
+    num_slices: int = 1,
+    slice_id: int = 0,
+    coordinator_port: Optional[int] = None,
+    timeout: float = 600.0,
+) -> list:
+    """Run ``train_fn(rank)`` on a gang of ``num_workers`` OS processes that
+    share one jax.distributed world (reference: the JaxTrainer worker-group
+    backend). Returns each rank's return value, rank-ordered.
+
+    The gang members are submitted as runtime tasks, so worker-crash fault
+    tolerance and scheduling apply; each member execs a clean interpreter for
+    the jax work (device flags must precede jax's first import)."""
+    import ray_tpu
+    from ray_tpu.parallel.mesh import multislice_env
+
+    port = coordinator_port or _free_port()
+    coordinator = f"{_local_ip()}:{port}"
+    fn_blob = cloudpickle.dumps(train_fn)
+    env_extra = {}
+    if num_slices > 1:
+        env_extra = multislice_env(coordinator, num_slices, slice_id)
+    member = ray_tpu.remote(num_cpus=0.1, name="jax_gang_member")(_gang_member)
+    refs = [
+        member.remote(rank, num_workers, coordinator, devices_per_worker,
+                      fn_blob, env_extra, use_tpu, timeout)
+        for rank in range(num_workers)
+    ]
+    blobs = ray_tpu.get(refs, timeout=timeout)
+    return [cloudpickle.loads(b) for b in blobs]
+
+
+if __name__ == "__main__":
+    _child_main(sys.argv[1], sys.argv[2])

@@ -1,0 +1,92 @@
+"""Multi-process SPMD gang tests (reference: train/v2/jax/config.py — per
+worker jax.distributed.initialize; CI analog runs CPU processes with virtual
+devices over Gloo collectives)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train.gang import run_jax_gang
+
+
+@pytest.fixture(autouse=True)
+def _session(ray_start_regular):
+    yield
+
+
+def test_two_process_gang_matches_single_process():
+    """VERDICT criterion: a 2-process CPU-device gang trains the tiny llama
+    with the same loss as single-process execution."""
+
+    def _tiny_losses(rank: int):
+        """Two DP train steps on the tiny llama over the GLOBAL 4-device mesh."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ray_tpu.models import llama
+        from ray_tpu.train import spmd
+
+        cfg = llama.LlamaConfig.tiny()
+        devs = jax.devices()
+        assert len(devs) == 4, f"expected 4 global devices, got {len(devs)}"
+        mesh = Mesh(np.array(devs).reshape(4, 1, 1, 1, 1),
+                    ("data", "fsdp", "tensor", "seq", "expert"))
+        state = spmd.init_state(cfg, jax.random.PRNGKey(0),
+                                optimizer=spmd.make_optimizer(warmup=1))
+        step = spmd.make_train_step(
+            cfg, mesh, optimizer=spmd.make_optimizer(warmup=1)
+        )(state)
+        rng = np.random.default_rng(42)
+        full_tokens = rng.integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+        full_targets = rng.integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+        sh = NamedSharding(mesh, P(("data", "fsdp"), None))
+        per = 4 // jax.process_count()
+        lo = jax.process_index() * per
+
+        def to_global(arr):
+            return jax.make_array_from_process_local_data(
+                sh, np.ascontiguousarray(arr[lo:lo + per]), arr.shape
+            )
+
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, to_global(full_tokens), to_global(full_targets))
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    multi = run_jax_gang(_tiny_losses, num_workers=2, devices_per_worker=2,
+                         timeout=600)
+    assert len(multi) == 2 and multi[0] == pytest.approx(multi[1], rel=1e-6)
+    single = run_jax_gang(_tiny_losses, num_workers=1, devices_per_worker=4,
+                          timeout=600)
+    assert multi[0] == pytest.approx(single[0], rel=1e-5)
+    assert multi[0][-1] < multi[0][0]  # it actually trained (post-warmup)
+
+
+def test_gang_megascale_env_injected():
+    def probe(rank: int):
+        import os
+
+        return {
+            k: os.environ.get(k)
+            for k in ("MEGASCALE_COORDINATOR_ADDRESS", "MEGASCALE_NUM_SLICES",
+                      "MEGASCALE_SLICE_ID")
+        }
+
+    out = run_jax_gang(probe, num_workers=1, devices_per_worker=1,
+                       num_slices=2, slice_id=1, timeout=300)
+    env = out[0]
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"]
+
+
+def test_gang_rank_failure_surfaces():
+    def boom(rank: int):
+        if rank == 1:
+            raise RuntimeError("rank 1 exploded")
+        return "ok"
+
+    with pytest.raises(Exception, match="rank 1"):
+        run_jax_gang(boom, num_workers=2, devices_per_worker=1, timeout=300)
