@@ -1,0 +1,258 @@
+"""DQN on JAX: double Q-learning with a target network + replay actor.
+
+Parity: rllib/algorithms/dqn/ (DQN with target network, double-Q targets,
+epsilon-greedy exploration, replay buffer) over the shared Learner/EnvRunner
+layering (core/learner/learner.py:112, env/single_agent_env_runner.py:68).
+The learner update is one jitted XLA program; experience flows env runners →
+replay buffer actor → learner minibatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import Episode, EnvRunnerGroup
+from ray_tpu.rllib.ppo import _mlp_apply, _mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    """Reference: DQNConfig surface (fluent API below)."""
+
+    env: str | Callable = "CartPole-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 128
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500  # min transitions before updates
+    train_batch_size: int = 64
+    updates_per_iter: int = 64
+    target_update_freq: int = 500  # learner updates between target syncs
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 4000  # env steps to anneal over
+    double_q: bool = True
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "DQNConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: int | None = None) -> "DQNConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "DQNConfig":
+        fields = {f.name for f in dataclasses.fields(self)}
+        for k, v in kw.items():
+            if k not in fields:
+                raise ValueError(f"Unknown training option {k}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQNLearner:
+    """Q-network + target network + jitted double-DQN update
+    (reference: dqn torch_learner loss; here one XLA program)."""
+
+    def __init__(self, cfg: DQNConfig, obs_dim: int, num_actions: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = _mlp_init(key, (obs_dim, *cfg.hidden, num_actions))
+        self.target_params = self.params  # immutable pytrees: rebinding copies
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.num_updates = 0
+
+        def loss_fn(params, target_params, obs, actions, rewards, next_obs, dones):
+            q = _mlp_apply(params, obs, jnp)
+            q_taken = jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
+            q_next_target = _mlp_apply(target_params, next_obs, jnp)
+            if cfg.double_q:
+                # online net picks the argmax, target net evaluates it
+                q_next_online = _mlp_apply(params, next_obs, jnp)
+                best = jnp.argmax(q_next_online, axis=1)
+                q_next = jnp.take_along_axis(q_next_target, best[:, None], axis=1)[:, 0]
+            else:
+                q_next = q_next_target.max(axis=1)
+            target = rewards + cfg.gamma * (1.0 - dones) * q_next
+            td = q_taken - jax.lax.stop_gradient(target)
+            # Huber loss (reference: dqn default)
+            loss = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                             jnp.abs(td) - 0.5).mean()
+            return loss, {"td_error_mean": jnp.abs(td).mean(), "q_mean": q_taken.mean()}
+
+        def update(params, target_params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch["obs"], batch["actions"],
+                batch["rewards"], batch["next_obs"], batch["dones"],
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["loss"] = loss
+            return params, opt_state, metrics
+
+        self._update = jax.jit(update)
+        self._jnp = jnp
+
+    def update(self, batch: dict) -> dict:
+        import jax
+
+        jnp = self._jnp
+        batch = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.int32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "next_obs": jnp.asarray(batch["next_obs"], jnp.float32),
+            "dones": jnp.asarray(batch["dones"], jnp.float32),
+        }
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.target_params, self.opt_state, batch
+        )
+        self.num_updates += 1
+        if self.num_updates % self.cfg.target_update_freq == 0:
+            self.target_params = self.params
+        return {k: float(v) for k, v in metrics.items()}
+
+
+def _episodes_to_transitions(episodes: list[Episode]) -> dict:
+    """SARS'd tuples from episode fragments. The last step of a fragment cut
+    mid-episode has no next_obs recorded — it is dropped (negligible at
+    fragment lengths >> 1)."""
+    obs, actions, rewards, next_obs, dones = [], [], [], [], []
+    for ep in episodes:
+        n = len(ep)
+        terms = ep.terminateds or ep.dones
+        for i in range(n):
+            if ep.dones[i]:
+                # terminated: masked out of the target; truncated: bootstrap
+                # off the last seen obs (the true final_observation is one
+                # step away — close enough for time-limit truncation)
+                nxt = ep.obs[i]
+            elif i + 1 < n:
+                nxt = ep.obs[i + 1]
+            else:
+                continue  # fragment-cut live step: next obs unknown
+            obs.append(ep.obs[i])
+            actions.append(ep.actions[i])
+            rewards.append(ep.rewards[i])
+            next_obs.append(nxt)
+            # Q-targets bootstrap through time-limit TRUNCATION (next state
+            # exists, the env just stopped watching) but not TERMINATION —
+            # rllib's terminated/truncated distinction.
+            dones.append(float(terms[i]))
+    if not obs:
+        return {"obs": np.zeros((0,)), "actions": np.zeros((0,), np.int64),
+                "rewards": np.zeros((0,)), "next_obs": np.zeros((0,)),
+                "dones": np.zeros((0,))}
+    return {
+        "obs": np.asarray(obs, np.float32),
+        "actions": np.asarray(actions, np.int64),
+        "rewards": np.asarray(rewards, np.float32),
+        "next_obs": np.asarray(next_obs, np.float32),
+        "dones": np.asarray(dones, np.float32),
+    }
+
+
+class DQN:
+    """The Algorithm (reference: algorithms/algorithm.py train() loop)."""
+
+    def __init__(self, cfg: DQNConfig):
+        import gymnasium as gym
+
+        self.cfg = cfg
+        env_creator = (cfg.env if callable(cfg.env)
+                       else (lambda name=cfg.env: gym.make(name)))
+        probe = env_creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self.learner = DQNLearner(cfg, obs_dim, num_actions)
+        self.env_steps_total = 0
+
+        import jax
+        import jax.numpy as jnp
+
+        q_apply = jax.jit(lambda p, o: _mlp_apply(p, o, jnp))
+        algo = self
+
+        def policy_fn(params, obs, rng):
+            # epsilon-greedy exploration with annealed epsilon; logprob/value
+            # slots unused by DQN (EnvRunner protocol shared with PPO)
+            eps = algo.epsilon()
+            if rng.random() < eps:
+                action = int(rng.integers(num_actions))
+            else:
+                action = int(np.argmax(np.asarray(q_apply(params, obs[None]))[0]))
+            return action, 0.0, 0.0
+
+        self.runners = EnvRunnerGroup(env_creator, policy_fn,
+                                      num_runners=cfg.num_env_runners)
+        self.runners.sync_weights(self.learner.params)
+        # replay buffer as a runtime actor: collection and learning share it
+        # through the control plane (reference: replay actor pattern)
+        BufferActor = ray_tpu.remote(num_cpus=0)(ReplayBuffer)
+        self.buffer = BufferActor.remote(cfg.buffer_capacity, cfg.seed)
+
+    def epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.env_steps_total / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def train(self) -> dict:
+        """One iteration: collect a fragment per runner, replay-update."""
+        cfg = self.cfg
+        episodes = self.runners.sample(cfg.rollout_fragment_length)
+        self.env_steps_total += sum(len(e) for e in episodes)
+        batch = _episodes_to_transitions(episodes)
+        size = ray_tpu.get(self.buffer.add_batch.remote(batch), timeout=60)
+        metrics: dict = {}
+        updates = 0
+        if size >= cfg.learning_starts:
+            # pipeline: the next minibatch is in flight while this one trains
+            next_ref = self.buffer.sample.remote(cfg.train_batch_size)
+            for _ in range(cfg.updates_per_iter):
+                sample = ray_tpu.get(next_ref, timeout=60)
+                next_ref = self.buffer.sample.remote(cfg.train_batch_size)
+                if not sample:
+                    break
+                metrics = self.learner.update(sample)
+                updates += 1
+            self.runners.sync_weights(self.learner.params)
+        finished = [e for e in episodes if e.dones and e.dones[-1]]
+        return {
+            "env_steps_total": self.env_steps_total,
+            "buffer_size": size,
+            "num_updates": updates,
+            "epsilon": self.epsilon(),
+            "episodes_this_iter": len(finished),
+            "episode_reward_mean": (
+                float(np.mean([e.total_reward() for e in finished]))
+                if finished else float("nan")
+            ),
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        self.runners.stop()
+        try:
+            ray_tpu.kill(self.buffer)
+        except Exception:
+            pass

@@ -24,12 +24,18 @@ class Episode:
     logprobs: list = field(default_factory=list)
     values: list = field(default_factory=list)
     dones: list = field(default_factory=list)
+    # terminated only (no time-limit truncation): off-policy targets bootstrap
+    # through truncation but not termination (rllib's terminated/truncated split)
+    terminateds: list = field(default_factory=list)
     # value of the next obs when a rollout fragment cuts a live episode
     # (reference: rllib bootstraps fragment boundaries with vf(last_obs))
     bootstrap_value: float = 0.0
+    # reward accumulated by this episode in PREVIOUS fragments (an episode can
+    # span rollout fragments; metrics must report the whole episode)
+    reward_offset: float = 0.0
 
     def total_reward(self) -> float:
-        return float(sum(self.rewards))
+        return float(sum(self.rewards)) + self.reward_offset
 
     def __len__(self):
         return len(self.actions)
@@ -44,6 +50,7 @@ class SingleAgentEnvRunner:
         self.params = None
         self.rng = np.random.default_rng(seed)
         self._obs, _ = self.env.reset(seed=seed)
+        self._carry_reward = 0.0  # live episode's reward from prior fragments
 
     def set_weights(self, params) -> None:
         self.params = params
@@ -51,7 +58,7 @@ class SingleAgentEnvRunner:
     def sample(self, num_steps: int) -> list[Episode]:
         """Collect ~num_steps of experience, episode-segmented."""
         episodes: list[Episode] = []
-        ep = Episode()
+        ep = Episode(reward_offset=self._carry_reward)
         steps = 0
         while steps < num_steps:
             action, logprob, value = self.policy_fn(self.params, np.asarray(self._obs), self.rng)
@@ -63,9 +70,11 @@ class SingleAgentEnvRunner:
             ep.logprobs.append(float(logprob))
             ep.values.append(float(value))
             ep.dones.append(done)
+            ep.terminateds.append(bool(terminated))
             steps += 1
             if done:
                 self._obs, _ = self.env.reset()
+                self._carry_reward = 0.0
                 episodes.append(ep)
                 ep = Episode()
             else:
@@ -73,6 +82,7 @@ class SingleAgentEnvRunner:
         if len(ep):
             # live episode cut by the fragment boundary: bootstrap with V(next obs)
             _, _, ep.bootstrap_value = self.policy_fn(self.params, np.asarray(self._obs), self.rng)
+            self._carry_reward = ep.total_reward()
             episodes.append(ep)
         return episodes
 

@@ -59,3 +59,69 @@ def test_gae_shapes_and_terminal_handling():
     # terminal step's advantage excludes bootstrap value
     assert abs(ret[-1] - 1.0 - 0.0) < 1e-6 or ret[-1] == pytest.approx(adv[-1] + 0.5)
     algo.stop()
+
+
+def test_replay_buffer_ring_semantics():
+    from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=10, seed=0)
+    batch = {
+        "obs": np.arange(8, dtype=np.float32).reshape(8, 1),
+        "actions": np.zeros(8, np.int64),
+        "rewards": np.ones(8, np.float32),
+        "next_obs": np.arange(8, dtype=np.float32).reshape(8, 1),
+        "dones": np.zeros(8, np.float32),
+    }
+    assert buf.add_batch(batch) == 8
+    assert buf.add_batch(batch) == 10  # wrapped at capacity
+    s = buf.sample(32)
+    assert s["obs"].shape == (32, 1)
+    assert buf.stats()["added_total"] == 16
+
+
+def test_dqn_learns_cartpole():
+    """Reference parity: DQN with replay + target net learns CartPole above
+    threshold in a bounded number of iterations (algorithms/dqn tests)."""
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=256)
+            .training(lr=5e-4, learning_starts=500, updates_per_iter=128,
+                      target_update_freq=250, epsilon_decay_steps=5000)
+            .build())
+    rewards = []
+    try:
+        for it in range(60):
+            m = algo.train()
+            if m["episodes_this_iter"]:
+                rewards.append(m["episode_reward_mean"])
+            if len(rewards) >= 3 and np.mean(rewards[-3:]) > 120:
+                break
+    finally:
+        algo.stop()
+    assert np.mean(rewards[-3:]) > 120, rewards
+
+
+def test_dqn_double_q_toggle_and_target_sync():
+    from ray_tpu.rllib import DQNConfig, DQNLearner
+
+    cfg = DQNConfig().training(double_q=False, target_update_freq=2)
+    learner = DQNLearner(cfg, obs_dim=4, num_actions=2)
+    batch = {
+        "obs": np.random.randn(16, 4).astype(np.float32),
+        "actions": np.random.randint(0, 2, 16),
+        "rewards": np.ones(16, np.float32),
+        "next_obs": np.random.randn(16, 4).astype(np.float32),
+        "dones": np.zeros(16, np.float32),
+    }
+    import jax
+
+    before = jax.tree.leaves(learner.target_params)[0]
+    learner.update(batch)
+    mid = jax.tree.leaves(learner.target_params)[0]
+    assert np.array_equal(np.asarray(before), np.asarray(mid))  # not yet synced
+    learner.update(batch)
+    after = jax.tree.leaves(learner.target_params)[0]
+    online = jax.tree.leaves(learner.params)[0]
+    assert np.array_equal(np.asarray(after), np.asarray(online))  # synced at freq=2
