@@ -1,0 +1,107 @@
+"""Per-worker log plumbing: worker stdout/stderr land in session log files
+and stream back to the driver.
+
+Parity: python/ray/_private/log_monitor.py — every worker process writes to
+its own files under the session dir; a monitor tails them and forwards new
+lines to the driver's stdout prefixed with the worker identity, so `print`
+inside tasks is visible at the driver (log_to_driver semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Optional, TextIO
+
+
+class LogMonitor:
+    """Tails every *.out/*.err file in a session log dir to a sink stream."""
+
+    def __init__(self, log_dir: str, sink: Optional[TextIO] = None,
+                 poll_interval: float = 0.25):
+        self.log_dir = log_dir
+        self.sink = sink or sys.stdout
+        self.poll_interval = poll_interval
+        self._offsets: dict[str, int] = {}
+        self._partial: dict[str, str] = {}
+        self._running = True
+        self._poll_lock = threading.Lock()  # stop() drains concurrently
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ray_tpu-log-monitor")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self.poll_once()
+            except Exception:
+                pass
+            time.sleep(self.poll_interval)
+
+    def poll_once(self, flush_partial: bool = False) -> int:
+        """Forward any new lines; returns the number forwarded (test hook)."""
+        with self._poll_lock:
+            return self._poll_locked(flush_partial)
+
+    def _poll_locked(self, flush_partial: bool) -> int:
+        forwarded = 0
+        if not os.path.isdir(self.log_dir):
+            return 0
+        for name in sorted(os.listdir(self.log_dir)):
+            if not (name.endswith(".out") or name.endswith(".err")):
+                continue
+            path = os.path.join(self.log_dir, name)
+            try:
+                size = os.path.getsize(path)
+                offset = self._offsets.get(name, 0)
+                if size <= offset:
+                    continue
+                with open(path, "r", errors="replace") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+                    self._offsets[name] = f.tell()
+            except OSError:
+                continue
+            chunk = self._partial.pop(name, "") + chunk
+            lines = chunk.split("\n")
+            if lines and lines[-1]:
+                self._partial[name] = lines[-1]  # hold incomplete tail line
+            for line in lines[:-1]:
+                if not line:
+                    continue
+                tag = name.rsplit(".", 1)[0]
+                stream = "stderr" if name.endswith(".err") else "stdout"
+                try:
+                    self.sink.write(f"({tag} {stream}) {line}\n")
+                    forwarded += 1
+                except Exception:
+                    pass
+        if flush_partial:
+            # final drain: emit held incomplete tail lines (a worker crash
+            # often ends mid-line — its last output must not vanish)
+            for name, tail in sorted(self._partial.items()):
+                if not tail:
+                    continue
+                tag = name.rsplit(".", 1)[0]
+                stream = "stderr" if name.endswith(".err") else "stdout"
+                try:
+                    self.sink.write(f"({tag} {stream}) {tail}\n")
+                    forwarded += 1
+                except Exception:
+                    pass
+            self._partial.clear()
+        if forwarded:
+            try:
+                self.sink.flush()
+            except Exception:
+                pass
+        return forwarded
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self.poll_once(flush_partial=True)  # final drain
+        except Exception:
+            pass

@@ -31,6 +31,22 @@ class _ActorStateShim:
         self.cls = cls
 
 
+class _ClientSubHandle:
+    """Publisher-shaped handle so a worker-side Subscriber.close() routes the
+    unsubscribe through the head."""
+
+    def __init__(self, client: "ClientRuntime", sub_id: str):
+        self._client = client
+        self._sub_id = sub_id
+
+    def unsubscribe(self, sub) -> None:
+        self._client._subscribers.pop(self._sub_id, None)
+        try:
+            self._client._rpc().call("pubsub_unsubscribe", sub=self._sub_id, timeout=10)
+        except Exception:
+            pass
+
+
 class _ClientRefCounter:
     """Process-local refcounts that mirror 0→1 / 1→0 transitions to the head,
     which holds one borrowed ref per (peer, object) while the client holds any
@@ -89,6 +105,7 @@ class ClientRuntime:
         self.is_shutdown = False
         self.reference_counter = _ClientRefCounter(self)
         self._actor_cls_cache: dict[bytes, Any] = {}
+        self._subscribers: dict[str, Any] = {}
         from ray_tpu._private.ids import JobID
 
         self.job_id = JobID.from_random()  # worker-local; head re-keys task ids
@@ -108,11 +125,38 @@ class ClientRuntime:
                 from ray_tpu.core import wire
 
                 self._peer = wire.connect(
-                    self._host, self._port, name=f"worker-{os.getpid()}"
+                    self._host, self._port,
+                    handlers={"pubsub_msg": self._h_pubsub_msg},
+                    name=f"worker-{os.getpid()}",
                 )
                 self._peer.call("hello", token=self._token, kind="worker",
                                 pid=os.getpid(), timeout=10)
             return self._peer
+
+    # ------------------------------------------------------------ pub/sub
+    def _h_pubsub_msg(self, peer, msg):
+        import cloudpickle
+
+        sub = self._subscribers.get(msg.get("sub"))
+        if sub is not None:
+            sub._offer(cloudpickle.loads(msg["blob"]))
+
+    def publish(self, channel: str, message: Any) -> int:
+        import cloudpickle
+
+        return self._rpc().call("pubsub_publish", channel=channel,
+                                blob=cloudpickle.dumps(message), timeout=30)
+
+    def subscribe(self, channel: str):
+        import uuid
+
+        from ray_tpu.core.pubsub import Subscriber
+
+        sub_id = uuid.uuid4().hex
+        sub = Subscriber(_ClientSubHandle(self, sub_id), channel)
+        self._subscribers[sub_id] = sub
+        self._rpc().call("pubsub_subscribe", channel=channel, sub=sub_id, timeout=30)
+        return sub
 
     def _shm(self):
         if self._store is None and self._shm_name:

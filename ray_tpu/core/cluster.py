@@ -91,6 +91,10 @@ class ControlPlane:
 
     def _peer_gone(self, peer: RpcPeer) -> None:
         peer.meta.pop("held_refs", None)  # release the client's borrowed refs
+        try:
+            self.runtime.publisher.unsubscribe_remote(peer)
+        except Exception:
+            pass
         nid = peer.meta.get("node_id")
         if nid is not None:
             with self._hb_lock:
@@ -112,6 +116,23 @@ class ControlPlane:
 
     def _h_ref_drop(self, peer: RpcPeer, msg: dict):
         peer.meta.setdefault("held_refs", {}).pop(msg["oid"], None)
+
+    # ---- pub/sub bridge (reference: src/ray/pubsub long-poll transport ->
+    # pushed notify frames here)
+    def _h_pubsub_publish(self, peer: RpcPeer, msg: dict):
+        import cloudpickle
+
+        return self.runtime.publisher.publish(
+            msg["channel"], cloudpickle.loads(msg["blob"])
+        )
+
+    def _h_pubsub_subscribe(self, peer: RpcPeer, msg: dict):
+        self.runtime.publisher.subscribe_remote(msg["channel"], peer, msg["sub"])
+        return True
+
+    def _h_pubsub_unsubscribe(self, peer: RpcPeer, msg: dict):
+        self.runtime.publisher.unsubscribe_remote(peer, msg.get("sub"))
+        return True
 
     # ------------------------------------------------------------ handlers
     def _handlers(self):
@@ -136,6 +157,9 @@ class ControlPlane:
             "client_stream_done": self._h_client_stream_done,
             "ref_add": self._h_ref_add,
             "ref_drop": self._h_ref_drop,
+            "pubsub_publish": self._h_pubsub_publish,
+            "pubsub_subscribe": self._h_pubsub_subscribe,
+            "pubsub_unsubscribe": self._h_pubsub_unsubscribe,
         }
         return {op: self._authed(op, fn) for op, fn in h.items()}
 
@@ -174,6 +198,9 @@ class ControlPlane:
             "node_id": nid.binary(),
             "shm_name": rt.shm_store.name if rt.shm_store else None,
             "shm_size": rt.config.object_store_memory,
+            # same-host agents write worker logs into the session dir; the
+            # head's LogMonitor tails them to the driver (log_monitor.py)
+            "log_dir": rt.session_log_dir,
         }
 
     def _h_heartbeat(self, peer: RpcPeer, msg: dict):

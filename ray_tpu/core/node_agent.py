@@ -108,6 +108,7 @@ def main() -> None:
         shm_size=reg.get("shm_size") or 0,
         head_addr=args.head,
         token=args.token,
+        log_dir=reg.get("log_dir"),
     )
 
     # Heartbeat until the head goes away, then exit (reference: raylet dies

@@ -194,7 +194,7 @@ class ProcessWorkerPool:
 
     def __init__(self, num_workers: int = 2, shm_name: str | None = None,
                  shm_size: int = 0, head_addr: str | None = None,
-                 token: str | None = None):
+                 token: str | None = None, log_dir: str | None = None):
         # Workers are exec'd fresh (python -m ray_tpu.core.worker_main), never
         # forked: the driver runs many threads (dispatcher, actor loops,
         # JAX/XLA) and fork-with-threads can copy locks mid-acquire; fork-based
@@ -207,6 +207,7 @@ class ProcessWorkerPool:
         self._shm_size = shm_size
         self._head_addr = head_addr
         self._token = token
+        self._log_dir = log_dir
         self._workers: list[_Worker] = []
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -225,9 +226,23 @@ class ProcessWorkerPool:
             cmd += ["--head", self._head_addr]
             if self._token:
                 cmd += ["--token", self._token]
+        stdout = stderr = None
+        if self._log_dir:
+            # per-worker log files tailed back to the driver (reference:
+            # _private/log_monitor.py log_to_driver plumbing); unique per
+            # child via an incrementing spawn counter
+            os.makedirs(self._log_dir, exist_ok=True)
+            self._spawn_seq = getattr(self, "_spawn_seq", 0) + 1
+            base = os.path.join(self._log_dir, f"worker-{os.getpid()}-{self._spawn_seq}")
+            stdout = open(base + ".out", "ab", buffering=0)
+            stderr = open(base + ".err", "ab", buffering=0)
         proc = subprocess.Popen(
-            cmd, pass_fds=(child_s.fileno(),), close_fds=True, env=worker_env()
+            cmd, pass_fds=(child_s.fileno(),), close_fds=True, env=worker_env(),
+            stdout=stdout, stderr=stderr,
         )
+        if stdout is not None:
+            stdout.close()
+            stderr.close()
         child_s.close()
         conn = Connection(parent_s.detach())
         w = _Worker(proc, conn)

@@ -1,0 +1,113 @@
+"""Pub/sub: control-plane channels for lifecycle events and user messages.
+
+Parity: src/ray/pubsub/ (Publisher publisher.h:357 with per-subscriber
+queues; Subscriber subscriber.h:215) and the GCS channels enumerated in
+protobuf/pubsub.proto (GCS_ACTOR/NODE_INFO/... channels). The long-poll gRPC
+transport becomes direct queue delivery in-process and pushed control-plane
+notifications for worker processes (wire.py notify frames).
+
+The runtime publishes its own lifecycle events (reference: GCS publishing on
+actor/node tables):
+- channel "actors": {actor_id, state, name} on every actor state change
+- channel "nodes":  {node_id, event: registered|dead}
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+BUFFER_LIMIT = 10_000  # per-subscriber; oldest dropped beyond (bounded queues)
+
+
+class Subscriber:
+    """A channel subscription; poll() yields published messages in order."""
+
+    def __init__(self, publisher: "Publisher", channel: str):
+        self._publisher = publisher
+        self.channel = channel
+        self._q: "queue.Queue" = queue.Queue(maxsize=BUFFER_LIMIT)
+        self.dropped = 0
+
+    def _offer(self, msg: Any) -> None:
+        try:
+            self._q.put_nowait(msg)
+        except queue.Full:
+            self.dropped += 1
+            try:
+                self._q.get_nowait()  # drop oldest (reference: bounded buffers)
+                self._q.put_nowait(msg)
+            except (queue.Empty, queue.Full):
+                pass  # lost a race with a concurrent publisher: msg dropped
+
+    def poll(self, timeout: float | None = None) -> Optional[Any]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._publisher.unsubscribe(self)
+
+
+class Publisher:
+    """Channel fan-out to local subscribers and remote peers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._local: dict[str, list[Subscriber]] = {}
+        # channel -> list of (peer, sub_id); delivery via peer.notify frames
+        self._remote: dict[str, list[tuple]] = {}
+        self.published_total = 0
+
+    # ---- local (driver / same-process) ----
+    def subscribe(self, channel: str) -> Subscriber:
+        sub = Subscriber(self, channel)
+        with self._lock:
+            self._local.setdefault(channel, []).append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscriber) -> None:
+        with self._lock:
+            subs = self._local.get(sub.channel, [])
+            if sub in subs:
+                subs.remove(sub)
+
+    # ---- remote (worker processes over the control plane) ----
+    def subscribe_remote(self, channel: str, peer, sub_id: str) -> None:
+        with self._lock:
+            self._remote.setdefault(channel, []).append((peer, sub_id))
+
+    def unsubscribe_remote(self, peer, sub_id: str | None = None) -> None:
+        """Drop one subscription, or every subscription of a dead peer."""
+        with self._lock:
+            for channel in list(self._remote):
+                self._remote[channel] = [
+                    (p, s) for (p, s) in self._remote[channel]
+                    if not (p is peer and (sub_id is None or s == sub_id))
+                ]
+
+    # ---- publish ----
+    def publish(self, channel: str, message: Any) -> int:
+        """Deliver to every subscriber; returns the delivery count."""
+        import cloudpickle
+
+        with self._lock:
+            local = list(self._local.get(channel, []))
+            remote = list(self._remote.get(channel, []))
+            self.published_total += 1
+        for sub in local:
+            sub._offer(message)
+        blob = None
+        for peer, sub_id in remote:
+            if peer.closed:
+                self.unsubscribe_remote(peer)
+                continue
+            if blob is None:
+                blob = cloudpickle.dumps(message)
+            try:
+                peer.notify("pubsub_msg", channel=channel, sub=sub_id, blob=blob)
+            except Exception:
+                self.unsubscribe_remote(peer)
+        return len(local) + len(remote)

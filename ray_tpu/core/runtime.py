@@ -183,6 +183,9 @@ class Runtime:
         self.shm_store = None
         import os as _os
 
+        self.session_dir = _os.path.join(
+            config.session_dir_prefix, f"session_{self.job_id.hex()[:12]}"
+        )
         self.spill = None
         if _os.environ.get("RAY_TPU_DISABLE_SHM") != "1":
             try:
@@ -195,8 +198,7 @@ class Runtime:
 
                 self.spill = SpillManager(
                     self.shm_store,
-                    _os.path.join(config.session_dir_prefix,
-                                  f"session_{self.job_id.hex()[:12]}", "spill"),
+                    _os.path.join(self.session_dir, "spill"),
                     threshold=config.object_spill_threshold,
                 )
             except Exception as e:  # pragma: no cover - toolchain missing
@@ -223,6 +225,20 @@ class Runtime:
         # processes connect as clients for nested API calls (reference: the
         # GCS/raylet gRPC mesh — gcs_server.h:99, node_manager.h:144).
         self._agents: dict[NodeID, Any] = {}
+        from ray_tpu.core.pubsub import Publisher
+
+        self.publisher = Publisher()  # GCS channels equivalent (src/ray/pubsub/)
+        self.session_log_dir = _os.path.join(self.session_dir, "logs")
+        self._log_monitor = None
+        if config.log_to_driver:
+            # started eagerly: node-agent pools write into the shared session
+            # log dir even when the driver never spins up a local pool
+            try:
+                from ray_tpu._private.log_monitor import LogMonitor
+
+                self._log_monitor = LogMonitor(self.session_log_dir)
+            except Exception:
+                pass
         self.control_plane = None
         try:
             from ray_tpu.core.cluster import ControlPlane
@@ -621,6 +637,7 @@ class Runtime:
                     shm_size=self.config.object_store_memory,
                     head_addr=self.control_plane.address if self.control_plane else None,
                     token=self.control_plane.token if self.control_plane else None,
+                    log_dir=self.session_log_dir,
                 )
         return pool
 
@@ -632,6 +649,20 @@ class Runtime:
                 return False
             entry.resources_released = True
             return True
+
+    def _publish_actor_event(self, state: "_ActorState") -> None:
+        """GCS_ACTOR_CHANNEL equivalent (pubsub.proto:32): every actor state
+        transition publishes to the 'actors' channel."""
+        try:
+            self.publisher.publish("actors", {
+                "actor_id": state.actor_id.hex(),
+                "class_name": state.cls.__name__,
+                "state": state.state,
+                "name": state.name,
+                "num_restarts": state.num_restarts,
+            })
+        except Exception:
+            pass
 
     def release_blocked_task_resources(self, task_bin: bytes) -> None:
         """A worker announced it is blocked in a nested get/wait: hand its cpus
@@ -658,6 +689,10 @@ class Runtime:
         its in-flight dispatches fail with PeerDisconnected and retry onto
         surviving nodes (reference: node death -> task FT + lineage rebuild)."""
         self._agents.pop(node_id, None)
+        try:
+            self.publisher.publish("nodes", {"node_id": node_id.hex(), "event": "dead"})
+        except Exception:
+            pass
         try:
             self.scheduler.remove_node(node_id)
         except Exception:
@@ -966,6 +1001,7 @@ class Runtime:
                 self._named_actors[key] = actor_id
         with self._lock:
             self._actors[actor_id] = state
+        self._publish_actor_event(state)
         if options.get("lifetime") == "detached" and name:
             # Durable actor metadata (reference: GCS actor table persisted to
             # Redis; detached actors recoverable after head restart).
@@ -1019,11 +1055,13 @@ class Runtime:
         except BaseException as e:  # noqa: BLE001
             state.state = "DEAD"
             state.death_cause = f"__init__ failed: {e!r}"
+            self._publish_actor_event(state)
             self._store_error(spec, TaskError(e, spec.desc()))
             self._drain_mailbox(state, ActorDiedError(state.death_cause))
             self.scheduler.release(state.node_id, state.sched_req)
             return
         state.state = "ALIVE"
+        self._publish_actor_event(state)
         self._store_value(spec.return_ids()[0], None)  # creation done marker
         for i in range(max(1, state.max_concurrency)):
             t = threading.Thread(
@@ -1250,6 +1288,7 @@ class Runtime:
         was_alive = state.state == "ALIVE"
         state.state = "DEAD"
         state.death_cause = "ray_tpu.kill() called"
+        self._publish_actor_event(state)
         if state.name:
             with self._lock:
                 self._named_actors.pop((state.namespace, state.name), None)
@@ -1292,6 +1331,7 @@ class Runtime:
             return False
         state.num_restarts += 1
         state.state = "RESTARTING"
+        self._publish_actor_event(state)
         state.threads = []
         if state.name:
             with self._lock:
@@ -1387,6 +1427,11 @@ class Runtime:
         if pool is not None:
             try:
                 pool.shutdown()
+            except Exception:
+                pass
+        if self._log_monitor is not None:
+            try:
+                self._log_monitor.stop()
             except Exception:
                 pass
         if self.spill is not None:

@@ -117,6 +117,11 @@ class RpcPeer:
                             fut.set_exception(pickle.loads(msg["error"]))
                         else:
                             fut.set_result(msg.get("result"))
+                elif msg.get("id") is None:
+                    # NOTIFICATIONS run inline on the reader so their order is
+                    # preserved (pubsub/heartbeat contracts); handlers must be
+                    # cheap — anything long-running belongs in a request
+                    self._handle(msg)
                 else:
                     threading.Thread(
                         target=self._handle, args=(msg,), daemon=True,

@@ -83,9 +83,10 @@ class Dashboard:
             ])
 
         async def metrics(request):
-            from ray_tpu.util.metrics import prometheus_text
+            from ray_tpu.util.metrics import prometheus_text, system_prometheus_text
 
-            return web.Response(text=prometheus_text(), content_type="text/plain")
+            return web.Response(text=system_prometheus_text() + prometheus_text(),
+                                content_type="text/plain")
 
         async def serve_status(request):
             try:

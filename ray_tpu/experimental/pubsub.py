@@ -1,0 +1,30 @@
+"""Public pub/sub surface: publish/subscribe on control-plane channels.
+
+Parity: src/ray/pubsub (Publisher/Subscriber) + the GCS channels of
+protobuf/pubsub.proto. Works from the driver (direct queues) and from inside
+worker processes (pushed over the control plane). The runtime itself
+publishes lifecycle events on the "actors" and "nodes" channels.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.core import runtime as rt_mod
+from ray_tpu.core.pubsub import Subscriber
+
+
+def publish(channel: str, message: Any) -> int:
+    """Deliver `message` to every subscriber of `channel`; returns count."""
+    rt = rt_mod.get_runtime()
+    if hasattr(rt, "publisher"):
+        return rt.publisher.publish(channel, message)
+    return rt.publish(channel, message)  # worker client runtime
+
+
+def subscribe(channel: str) -> Subscriber:
+    """Subscribe to `channel`; poll() the returned Subscriber for messages."""
+    rt = rt_mod.get_runtime()
+    if hasattr(rt, "publisher"):
+        return rt.publisher.subscribe(channel)
+    return rt.subscribe(channel)  # worker client runtime

@@ -143,9 +143,8 @@ def _episodes_to_transitions(episodes: list[Episode]) -> dict:
         for i in range(n):
             if ep.dones[i]:
                 # terminated: masked out of the target; truncated: bootstrap
-                # off the last seen obs (the true final_observation is one
-                # step away — close enough for time-limit truncation)
-                nxt = ep.obs[i]
+                # from the env's true final observation
+                nxt = ep.final_obs if ep.final_obs is not None else ep.obs[i]
             elif i + 1 < n:
                 nxt = ep.obs[i + 1]
             else:

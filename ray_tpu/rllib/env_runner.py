@@ -30,6 +30,9 @@ class Episode:
     # value of the next obs when a rollout fragment cuts a live episode
     # (reference: rllib bootstraps fragment boundaries with vf(last_obs))
     bootstrap_value: float = 0.0
+    # the env's final observation at episode end (gymnasium returns it from
+    # the terminal step); off-policy targets bootstrap from it on truncation
+    final_obs: object = None
     # reward accumulated by this episode in PREVIOUS fragments (an episode can
     # span rollout fragments; metrics must report the whole episode)
     reward_offset: float = 0.0
@@ -73,6 +76,7 @@ class SingleAgentEnvRunner:
             ep.terminateds.append(bool(terminated))
             steps += 1
             if done:
+                ep.final_obs = np.asarray(nxt)
                 self._obs, _ = self.env.reset()
                 self._carry_reward = 0.0
                 episodes.append(ep)

@@ -94,6 +94,51 @@ def registry_snapshot() -> dict:
     return {name: m.snapshot() for name, m in metrics.items() if hasattr(m, "snapshot")}
 
 
+def system_prometheus_text() -> str:
+    """Runtime-internal gauges in Prometheus exposition format (reference:
+    the metrics agent exports core counters — task/actor/object-store state —
+    alongside user metrics, _private/metrics_agent.py)."""
+    from ray_tpu.core.runtime import get_runtime_or_none
+
+    rt = get_runtime_or_none()
+    if rt is None or not hasattr(rt, "scheduler"):
+        return ""
+    lines = []
+
+    def gauge(name, value, **tags):
+        label = ",".join(f'{k}="{v}"' for k, v in tags.items())
+        lines.append(f"ray_tpu_{name}{{{label}}} {value}" if label
+                     else f"ray_tpu_{name} {value}")
+
+    states: dict[str, int] = {}
+    with rt._lock:
+        for t in rt._tasks.values():
+            states[t.state] = states.get(t.state, 0) + 1
+        actors = list(rt._actors.values())
+    for state, n in sorted(states.items()):
+        gauge("tasks", n, state=state)
+    actor_states: dict[str, int] = {}
+    for a in actors:
+        actor_states[a.state] = actor_states.get(a.state, 0) + 1
+    for state, n in sorted(actor_states.items()):
+        gauge("actors", n, state=state)
+    gauge("nodes", len(rt.scheduler.nodes()))
+    gauge("objects_in_memory_store", rt.memory_store.size())
+    if rt.shm_store is not None:
+        try:
+            for k, v in rt.shm_store.stats().items():
+                gauge(f"shm_{k}", v)
+        except Exception:
+            pass
+    if rt.spill is not None:
+        for k, v in rt.spill.stats().items():
+            gauge(f"spill_{k}", v)
+    pool = getattr(rt, "_proc_pool", None)
+    if pool is not None:
+        gauge("worker_processes_alive", pool.num_alive)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def prometheus_text() -> str:
     """Render the registry in Prometheus exposition format."""
     lines = []

@@ -1,0 +1,96 @@
+"""Worker log plumbing tests (reference: _private/log_monitor.py —
+per-worker stdout/err files tailed to the driver)."""
+
+import io
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import get_runtime
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_worker_prints_land_in_session_logs(session):
+    @ray_tpu.remote
+    def chatty(tag):
+        print(f"hello-from-worker-{tag}")
+        import sys
+
+        print(f"warning-{tag}", file=sys.stderr)
+        return tag
+
+    assert ray_tpu.get(chatty.remote("x1"), timeout=60) == "x1"
+    rt = get_runtime()
+    deadline = time.monotonic() + 15
+    combined = ""
+    while time.monotonic() < deadline:
+        combined = ""
+        if os.path.isdir(rt.session_log_dir):
+            for name in os.listdir(rt.session_log_dir):
+                with open(os.path.join(rt.session_log_dir, name), errors="replace") as f:
+                    combined += f.read()
+        if "hello-from-worker-x1" in combined and "warning-x1" in combined:
+            break
+        time.sleep(0.2)
+    assert "hello-from-worker-x1" in combined
+    assert "warning-x1" in combined
+
+
+def test_log_monitor_forwards_lines(session, tmp_path):
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    sink = io.StringIO()
+    mon = LogMonitor(str(tmp_path), sink=sink, poll_interval=0.05)
+    with open(tmp_path / "worker-123-1.out", "w") as f:
+        f.write("line one\npartial")
+        f.flush()
+    time.sleep(0.3)
+    assert "(worker-123-1 stdout) line one" in sink.getvalue()
+    assert "partial" not in sink.getvalue()  # incomplete line held back
+    with open(tmp_path / "worker-123-1.out", "a") as f:
+        f.write(" done\n")
+    time.sleep(0.3)
+    mon.stop()
+    assert "(worker-123-1 stdout) partial done" in sink.getvalue()
+
+
+def test_driver_sees_worker_prints(session):
+    rt = get_runtime()
+    assert rt._log_monitor is not None  # log_to_driver default starts it
+    sink = io.StringIO()
+    rt._log_monitor.sink = sink
+
+    @ray_tpu.remote
+    def speak():
+        print("VISIBLE-AT-DRIVER")
+        return 1
+
+    assert ray_tpu.get(speak.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if "VISIBLE-AT-DRIVER" in sink.getvalue():
+            return
+        time.sleep(0.2)
+    pytest.fail("worker print never reached the driver log monitor")
+
+
+def test_system_prometheus_metrics(session):
+    from ray_tpu.util.metrics import system_prometheus_text
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(3)], timeout=60)
+    text = system_prometheus_text()
+    assert 'ray_tpu_tasks{state="FINISHED"}' in text
+    assert "ray_tpu_nodes" in text
+    assert "ray_tpu_worker_processes_alive" in text

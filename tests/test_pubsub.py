@@ -1,0 +1,105 @@
+"""Pub/sub tests (reference: src/ray/pubsub + GCS channels in pubsub.proto)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import pubsub
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_local_publish_subscribe(session):
+    sub = pubsub.subscribe("greetings")
+    n = pubsub.publish("greetings", {"msg": "hi"})
+    assert n == 1
+    assert sub.poll(timeout=5) == {"msg": "hi"}
+    sub.close()
+    assert pubsub.publish("greetings", "gone") == 0
+
+
+def test_actor_lifecycle_channel(session):
+    """GCS_ACTOR_CHANNEL parity: actor state transitions publish events."""
+    sub = pubsub.subscribe("actors")
+
+    @ray_tpu.remote
+    class Thing:
+        def ping(self):
+            return 1
+
+    t = Thing.remote()
+    ray_tpu.get(t.ping.remote(), timeout=30)
+    states = []
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and "ALIVE" not in states:
+        ev = sub.poll(timeout=1)
+        if ev and ev["class_name"] == "Thing":
+            states.append(ev["state"])
+    assert "DEPENDENCIES_UNREADY" in states and "ALIVE" in states
+    ray_tpu.kill(t)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        ev = sub.poll(timeout=1)
+        if ev and ev.get("class_name") == "Thing" and ev["state"] == "DEAD":
+            break
+    else:
+        pytest.fail("no DEAD event")
+
+
+def test_worker_process_publishes_driver_receives(session):
+    sub = pubsub.subscribe("from-workers")
+
+    @ray_tpu.remote
+    def announce(x):
+        from ray_tpu.experimental import pubsub as ps
+
+        return ps.publish("from-workers", {"from": "worker", "x": x})
+
+    delivered = ray_tpu.get(announce.remote(7), timeout=60)
+    assert delivered == 1
+    assert sub.poll(timeout=10) == {"from": "worker", "x": 7}
+
+
+def test_worker_subscribes_to_driver_publish(session):
+    @ray_tpu.remote
+    def listen():
+        from ray_tpu.experimental import pubsub as ps
+
+        sub = ps.subscribe("to-workers")
+        ps.publish("worker-ready", True)  # handshake: subscription is live
+        msg = sub.poll(timeout=30)
+        sub.close()
+        return msg
+
+    ready = pubsub.subscribe("worker-ready")
+    ref = listen.remote()
+    assert ready.poll(timeout=30) is True
+    pubsub.publish("to-workers", "payload-123")
+    assert ray_tpu.get(ref, timeout=60) == "payload-123"
+
+
+def test_bounded_buffer_drops_oldest(session):
+    from ray_tpu.core import pubsub as core_ps
+
+    old_limit = core_ps.BUFFER_LIMIT
+    core_ps.BUFFER_LIMIT = 5
+    try:
+        sub = pubsub.subscribe("flood")
+        for i in range(20):
+            pubsub.publish("flood", i)
+        got = []
+        while True:
+            m = sub.poll(timeout=0.1)
+            if m is None:
+                break
+            got.append(m)
+        assert got == list(range(15, 20))  # newest kept, oldest dropped
+        assert sub.dropped == 15
+    finally:
+        core_ps.BUFFER_LIMIT = old_limit
