@@ -59,6 +59,11 @@ class Config:
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0
 
+    # --- memory pressure (reference: memory_monitor + worker_killing_policy_*;
+    #     kill a worker when host usage crosses the threshold; 1.0 disables) ---
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 250
+
     # --- timeouts ---
     get_timeout_default_s: float | None = None
     rpc_connect_timeout_s: float = 10.0

@@ -155,7 +155,11 @@ class ClientRuntime:
         sub_id = uuid.uuid4().hex
         sub = Subscriber(_ClientSubHandle(self, sub_id), channel)
         self._subscribers[sub_id] = sub
-        self._rpc().call("pubsub_subscribe", channel=channel, sub=sub_id, timeout=30)
+        try:
+            self._rpc().call("pubsub_subscribe", channel=channel, sub=sub_id, timeout=30)
+        except BaseException:
+            self._subscribers.pop(sub_id, None)  # failed: don't leak the entry
+            raise
         return sub
 
     def _shm(self):

@@ -1,0 +1,112 @@
+"""Memory-pressure monitor + worker-killing policy.
+
+Parity: src/ray/common/monitors/ (memory monitor sampling host usage) and
+raylet/worker_killing_policy_group_by_owner.cc — when host memory crosses the
+threshold, kill the worker whose task costs the least to sacrifice: prefer the
+NEWEST task that still has retries left (it loses the least progress and comes
+back on its own); fall back to the newest task outright. The kill surfaces as
+a worker-crash system failure, so the normal retry machinery handles recovery.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger("ray_tpu")
+
+
+def host_memory_usage_fraction() -> float:
+    """1 - MemAvailable/MemTotal from /proc/meminfo (no psutil dependency)."""
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                key, _, rest = line.partition(":")
+                info[key] = int(rest.strip().split()[0])
+        total = info.get("MemTotal", 0)
+        avail = info.get("MemAvailable", total)
+        if total <= 0:
+            return 0.0
+        return 1.0 - avail / total
+    except OSError:
+        return 0.0
+
+
+class MemoryMonitor:
+    def __init__(self, runtime, threshold: float, refresh_ms: int,
+                 usage_fn: Optional[Callable[[], float]] = None):
+        self.runtime = runtime
+        self.threshold = threshold
+        self.refresh_s = max(0.05, refresh_ms / 1000.0)
+        self.usage_fn = usage_fn or host_memory_usage_fraction
+        self.kills_total = 0
+        self._running = True
+        self._last_kill = 0.0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ray_tpu-memory-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                usage = self.usage_fn()
+                if usage >= self.threshold:
+                    # one kill per grace window: give freed memory time to show
+                    if time.monotonic() - self._last_kill > 2 * self.refresh_s:
+                        if self.kill_one_worker(usage):
+                            self._last_kill = time.monotonic()
+            except Exception:
+                pass
+            time.sleep(self.refresh_s)
+
+    def kill_one_worker(self, usage: float) -> bool:
+        """Apply the policy: newest retriable task's worker first."""
+        from ray_tpu.core.runtime import _retries_left
+        from ray_tpu._private.ids import TaskID
+
+        rt = self.runtime
+        pool = getattr(rt, "_proc_pool", None)
+        if pool is None:
+            return False
+        running = pool.running_tasks()  # pid -> (task_bin, started)
+        candidates = []
+        for pid, (task_bin, started) in running.items():
+            entry = None
+            if task_bin is not None:
+                try:
+                    with rt._lock:
+                        entry = rt._tasks.get(TaskID(task_bin))
+                except Exception:
+                    entry = None
+            retriable = entry is not None and _retries_left(entry.spec, entry.attempts)
+            candidates.append((retriable, started, pid, entry))
+        if not candidates:
+            return False
+        # prefer retriable, then newest (max start time) — the group-by-owner
+        # policy's retriable-first ordering at session scope
+        candidates.sort(key=lambda c: (not c[0], -c[1]))
+        retriable, started, pid, entry = candidates[0]
+        desc = entry.spec.desc() if entry is not None else "?"
+        task_bin = entry.spec.task_id.binary() if entry is not None else None
+        # pool re-verifies pid->task under its lock: a stale snapshot must not
+        # kill a worker that already moved on to a different task
+        if not pool.kill_task(pid, task_bin):
+            return False
+        logger.warning(
+            "memory usage %.1f%% >= %.1f%%: killed worker %d (task %r, retriable=%s)",
+            usage * 100, self.threshold * 100, pid, desc, retriable,
+        )
+        self.kills_total += 1
+        try:
+            rt.publisher.publish("oom", {
+                "pid": pid, "task": desc, "usage": usage, "retriable": retriable,
+            })
+        except Exception:
+            pass
+        return True

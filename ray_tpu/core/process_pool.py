@@ -209,6 +209,8 @@ class ProcessWorkerPool:
         self._token = token
         self._log_dir = log_dir
         self._workers: list[_Worker] = []
+        self._running_tasks: dict[int, tuple] = {}  # pid -> (task_bin, started)
+        self._spawn_seq = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         for _ in range(num_workers):
@@ -232,7 +234,7 @@ class ProcessWorkerPool:
             # _private/log_monitor.py log_to_driver plumbing); unique per
             # child via an incrementing spawn counter
             os.makedirs(self._log_dir, exist_ok=True)
-            self._spawn_seq = getattr(self, "_spawn_seq", 0) + 1
+            self._spawn_seq += 1
             base = os.path.join(self._log_dir, f"worker-{os.getpid()}-{self._spawn_seq}")
             stdout = open(base + ".out", "ab", buffering=0)
             stderr = open(base + ".err", "ab", buffering=0)
@@ -306,12 +308,35 @@ class ProcessWorkerPool:
             raise ValueError(f"task not serializable for process isolation: {e}") from e
         return self.execute_blob(fn_blob, args_blob, result_oid_bin, timeout, task_bin)
 
+    def running_tasks(self) -> dict:
+        """pid -> (task_bin, start_ts) for in-flight tasks (OOM policy input)."""
+        with self._lock:
+            return dict(self._running_tasks)
+
+    def kill_task(self, pid: int, task_bin) -> bool:
+        """SIGKILL `pid` iff it is STILL running `task_bin` — re-verified under
+        the pool lock so a policy decision made from a stale snapshot can't
+        kill a worker that moved on to a different task."""
+        with self._lock:
+            cur = self._running_tasks.get(pid)
+            if cur is None or cur[0] != task_bin:
+                return False
+            try:
+                os.kill(pid, 9)
+            except OSError:
+                return False
+            return True
+
     def execute_blob(self, fn_blob: bytes, args_blob: bytes,
                      result_oid_bin: bytes | None = None,
                      timeout: float | None = None,
                      task_bin: bytes | None = None):
         """Pre-marshalled form (used by the head dispatcher and node agents)."""
+        import time as _time
+
         w = self._checkout()
+        with self._lock:
+            self._running_tasks[w.proc.pid] = (task_bin, _time.monotonic())
         try:
             req = cloudpickle.dumps(("run", result_oid_bin, fn_blob, args_blob, task_bin))
             try:
@@ -335,6 +360,8 @@ class ProcessWorkerPool:
                 raise _RemoteTaskError(payload, exc_blob=extra)
             return status, payload, extra
         finally:
+            with self._lock:
+                self._running_tasks.pop(w.proc.pid, None)
             if w.is_alive():
                 self._checkin(w)
 

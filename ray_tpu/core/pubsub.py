@@ -90,15 +90,18 @@ class Publisher:
 
     # ---- publish ----
     def publish(self, channel: str, message: Any) -> int:
-        """Deliver to every subscriber; returns the delivery count."""
+        """Deliver to every subscriber; returns the number actually delivered
+        (dead peers are skipped, purged, and not counted)."""
         import cloudpickle
 
         with self._lock:
             local = list(self._local.get(channel, []))
             remote = list(self._remote.get(channel, []))
             self.published_total += 1
+        delivered = 0
         for sub in local:
             sub._offer(message)
+            delivered += 1
         blob = None
         for peer, sub_id in remote:
             if peer.closed:
@@ -108,6 +111,7 @@ class Publisher:
                 blob = cloudpickle.dumps(message)
             try:
                 peer.notify("pubsub_msg", channel=channel, sub=sub_id, blob=blob)
+                delivered += 1
             except Exception:
                 self.unsubscribe_remote(peer)
-        return len(local) + len(remote)
+        return delivered

@@ -230,6 +230,7 @@ class Runtime:
         self.publisher = Publisher()  # GCS channels equivalent (src/ray/pubsub/)
         self.session_log_dir = _os.path.join(self.session_dir, "logs")
         self._log_monitor = None
+        self._memory_monitor = None
         if config.log_to_driver:
             # started eagerly: node-agent pools write into the shared session
             # log dir even when the driver never spins up a local pool
@@ -639,6 +640,13 @@ class Runtime:
                     token=self.control_plane.token if self.control_plane else None,
                     log_dir=self.session_log_dir,
                 )
+                if self.config.memory_usage_threshold < 1.0 and self._memory_monitor is None:
+                    from ray_tpu.core.memory_monitor import MemoryMonitor
+
+                    self._memory_monitor = MemoryMonitor(
+                        self, self.config.memory_usage_threshold,
+                        self.config.memory_monitor_refresh_ms,
+                    )
         return pool
 
     def _claim_release(self, entry: _TaskEntry) -> bool:
@@ -1427,6 +1435,11 @@ class Runtime:
         if pool is not None:
             try:
                 pool.shutdown()
+            except Exception:
+                pass
+        if self._memory_monitor is not None:
+            try:
+                self._memory_monitor.stop()
             except Exception:
                 pass
         if self._log_monitor is not None:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 from typing import Optional
 
@@ -25,6 +26,7 @@ class Dashboard:
         self.job_client = job_client
         self._loop = None
         self._runner = None
+        self._profile_dirs: list[str] = []
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True, name="dashboard")
         self._thread.start()
@@ -99,6 +101,44 @@ class Dashboard:
         async def healthz(request):
             return web.json_response({"status": "ok"})
 
+        async def profile(request):
+            """On-demand accelerator/host profiling (reference: dashboard
+            reporter profile_manager.py:82 py-spy/memray; TPU-native
+            equivalent is a jax profiler XPlane/perfetto capture)."""
+            import asyncio as _aio
+            import tempfile
+            import time as _time
+
+            duration = min(float(request.query.get("duration_s", "1.0")), 30.0)
+
+            def capture():
+                import jax
+                import shutil
+
+                out_dir = tempfile.mkdtemp(prefix="ray_tpu_profile_")
+                with jax.profiler.trace(out_dir):
+                    _time.sleep(duration)
+                files = []
+                for root, _, names in os.walk(out_dir):
+                    files.extend(os.path.join(root, n) for n in names)
+                # capped retention: keep the newest few captures, not /tmp forever
+                self._profile_dirs.append(out_dir)
+                while len(self._profile_dirs) > 5:
+                    shutil.rmtree(self._profile_dirs.pop(0), ignore_errors=True)
+                return out_dir, files
+
+            loop = _aio.get_running_loop()
+            try:
+                out_dir, files = await loop.run_in_executor(None, capture)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)[:300]}, status=500)
+            return web.json_response({
+                "profile_dir": out_dir,
+                "num_files": len(files),
+                "files": files[:50],
+                "duration_s": duration,
+            })
+
         async def start():
             app = web.Application()
             app.router.add_get("/api/cluster_status", cluster_status)
@@ -108,6 +148,7 @@ class Dashboard:
             app.router.add_get("/metrics", metrics)
             app.router.add_get("/api/serve/status", serve_status)
             app.router.add_get("/healthz", healthz)
+            app.router.add_post("/api/profile", profile)
             self._runner = web.AppRunner(app)
             await self._runner.setup()
             site = web.TCPSite(self._runner, self.host, self.port)

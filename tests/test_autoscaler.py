@@ -119,9 +119,16 @@ def test_dashboard_endpoints():
         assert get("/api/v0/tasks/summarize")["by_state"]
         assert get("/healthz") == {"status": "ok"}
         assert get("/api/jobs") == []
-        # metrics endpoint is text
+        # metrics endpoint is text, with system gauges
         with urllib.request.urlopen("http://127.0.0.1:8267/metrics", timeout=10) as r:
             assert r.status == 200
+            assert b"ray_tpu_nodes" in r.read()
+        # profiling endpoint captures a jax XPlane trace
+        req = urllib.request.Request(
+            "http://127.0.0.1:8267/api/profile?duration_s=0.3", method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            prof = json.loads(r.read())
+        assert prof["num_files"] >= 1 and os.path.isdir(prof["profile_dir"])
         # 404 on unknown resource
         try:
             get("/api/v0/bogus")

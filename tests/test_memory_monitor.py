@@ -1,0 +1,119 @@
+"""OOM worker-killing policy tests (reference: memory monitor +
+raylet/worker_killing_policy_group_by_owner.cc)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import get_runtime
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _monitor_with_fake_usage(rt, usage_box):
+    from ray_tpu.core.memory_monitor import MemoryMonitor
+
+    if rt._memory_monitor is not None:
+        rt._memory_monitor.stop()
+    mon = MemoryMonitor(rt, threshold=0.95, refresh_ms=50,
+                        usage_fn=lambda: usage_box["u"])
+    rt._memory_monitor = mon
+    return mon
+
+
+def test_pressure_kills_retriable_task_and_it_recovers(session, counter_file):
+    @ray_tpu.remote(max_retries=2)
+    def slow():
+        n = counter_file()
+        import time as t
+
+        t.sleep(2.0 if n == 1 else 0.1)  # first attempt lingers under pressure
+        return "done"
+
+    ref = slow.remote()
+    rt = get_runtime()
+    deadline = time.monotonic() + 30
+    # wait until the first attempt has demonstrably STARTED (bumped the
+    # counter) so the kill lands mid-execution, not mid-startup
+    while time.monotonic() < deadline and counter_file.count() < 1:
+        time.sleep(0.05)
+    assert counter_file.count() >= 1
+    usage = {"u": 0.99}
+    mon = _monitor_with_fake_usage(rt, usage)
+    try:
+        kill_deadline = time.monotonic() + 15
+        while time.monotonic() < kill_deadline and mon.kills_total == 0:
+            time.sleep(0.05)
+        assert mon.kills_total >= 1
+        usage["u"] = 0.1  # pressure gone: the retry survives
+        assert ray_tpu.get(ref, timeout=60) == "done"
+        assert counter_file.count() >= 2  # first attempt was killed
+    finally:
+        mon.stop()
+
+
+def test_oom_event_published(session):
+    from ray_tpu.experimental import pubsub
+
+    sub = pubsub.subscribe("oom")
+
+    @ray_tpu.remote(max_retries=1)
+    def linger(path):
+        import os
+        import time as t
+
+        if not os.path.exists(path):
+            open(path, "w").close()
+            t.sleep(3.0)
+        return 1
+
+    import os
+    import tempfile
+
+    marker = tempfile.mktemp()
+    ref = linger.remote(marker)
+    rt = get_runtime()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not rt._process_pool().running_tasks():
+        time.sleep(0.05)
+    usage = {"u": 0.99}
+    mon = _monitor_with_fake_usage(rt, usage)
+    try:
+        ev = sub.poll(timeout=15)
+        assert ev is not None and ev["usage"] == 0.99
+        usage["u"] = 0.1
+        assert ray_tpu.get(ref, timeout=60) == 1
+    finally:
+        mon.stop()
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_no_kills_below_threshold(session):
+    rt = get_runtime()
+
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    usage = {"u": 0.5}
+    mon = _monitor_with_fake_usage(rt, usage)
+    try:
+        assert ray_tpu.get([quick.remote() for _ in range(4)], timeout=60) == [1] * 4
+        time.sleep(0.3)
+        assert mon.kills_total == 0
+    finally:
+        mon.stop()
+
+
+def test_host_memory_usage_fraction_sane():
+    from ray_tpu.core.memory_monitor import host_memory_usage_fraction
+
+    u = host_memory_usage_fraction()
+    assert 0.0 <= u < 1.0
