@@ -184,6 +184,9 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     scheduling_strategy=None,
     runtime_env=None,
     max_pending_calls=-1,
+    # True: host the actor in a dedicated OS worker process (crash FT via
+    # max_restarts, no GIL sharing with the driver) — reference default shape
+    isolate_process=False,
 )
 
 

@@ -135,6 +135,43 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             # failure machinery re-runs the task elsewhere
             os._exit(0)
 
+    def _send_result(result, oid_bin) -> None:
+        """Serialize + reply: large results through shm (zero-copy handoff),
+        small inline over the pipe."""
+        import inspect as _inspect
+
+        if _inspect.iscoroutine(result) or _inspect.isgenerator(result):
+            result.close()
+            raise TypeError(
+                "async/generator results are not supported in worker processes"
+            )
+        blob = serialization.serialize_to_bytes(result)
+        if store is not None and len(blob) > 100 * 1024 and oid_bin is not None:
+            from ray_tpu._private.ids import ObjectID
+
+            try:
+                store.put_bytes(ObjectID(oid_bin), blob)
+                _reply(("shm", oid_bin, len(blob)))
+                return
+            except Exception:
+                pass  # store full/unreadable: fall back to the pipe
+        _reply(("val", blob, len(blob)))
+
+    def _send_error(e: BaseException) -> None:
+        try:
+            exc_blob = cloudpickle.dumps(e)
+        except Exception:
+            exc_blob = None
+        _reply(("err", traceback.format_exc(), exc_blob))
+
+    # Dedicated-actor mode: ("actor_init", cls_blob, args_blob, renv)
+    # instantiates the user class IN THIS PROCESS (runtime_env applied for the
+    # actor's lifetime); subsequent ("actor_call", method, args_blob, oid_bin)
+    # invoke methods on the held instance (reference: actors live in their own
+    # worker process, task_receiver.cc).
+    actor_instance = None
+    actor_env_stack = None  # noqa: F841 - held so the env outlives __init__
+
     while True:
         try:
             msg = conn.recv_bytes()
@@ -147,6 +184,38 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             continue
         if req[0] == "exit":
             return
+        if req[0] == "actor_init":
+            try:
+                cls = cloudpickle.loads(req[1])
+                args, kwargs = serialization.deserialize_from_bytes(req[2])
+                args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
+                renv = req[3] if len(req) > 3 else None
+                if renv:
+                    import contextlib
+
+                    from ray_tpu import runtime_env as renv_mod
+
+                    actor_env_stack = contextlib.ExitStack()
+                    actor_env_stack.enter_context(
+                        renv_mod.apply_context(renv_mod.build_context(renv))
+                    )
+                actor_instance = cls(*args, **kwargs)
+                _reply(("ok", None, None))
+            except BaseException as e:  # noqa: BLE001
+                _send_error(e)
+            continue
+        if req[0] == "actor_call":
+            _, method_name, args_blob, oid_bin = req
+            try:
+                if actor_instance is None:
+                    raise RuntimeError("actor_call before actor_init")
+                method = getattr(actor_instance, method_name)
+                args, kwargs = serialization.deserialize_from_bytes(args_blob)
+                args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
+                _send_result(method(*args, **kwargs), oid_bin)
+            except BaseException as e:  # noqa: BLE001
+                _send_error(e)
+            continue
         _, oid_bin, fn_blob, args_blob = req[:4]
         task_bin = req[4] if len(req) > 4 else None
         _set_current_task(task_bin)
@@ -154,27 +223,9 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = serialization.deserialize_from_bytes(args_blob)
             args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
-            result = fn(*args, **kwargs)
-            blob = serialization.serialize_to_bytes(result)
-            sent = False
-            if store is not None and len(blob) > 100 * 1024 and oid_bin is not None:
-                from ray_tpu._private.ids import ObjectID
-
-                try:
-                    store.put_bytes(ObjectID(oid_bin), blob)
-                    _reply(("shm", oid_bin, len(blob)))
-                    sent = True
-                except Exception:
-                    pass  # store full/unreadable: fall back to the pipe
-            if not sent:
-                _reply(("val", blob, len(blob)))
+            _send_result(fn(*args, **kwargs), oid_bin)
         except BaseException as e:  # noqa: BLE001
-            tb = traceback.format_exc()
-            try:
-                exc_blob = cloudpickle.dumps(e)
-            except Exception:
-                exc_blob = None
-            _reply(("err", tb, exc_blob))
+            _send_error(e)
         finally:
             _set_current_task(None)
 
@@ -187,6 +238,96 @@ class _Worker:
 
     def is_alive(self) -> bool:
         return self.proc.poll() is None
+
+
+def spawn_worker_process(shm_name, shm_size, head_addr, token, log_base=None):
+    """Exec a fresh worker (default_worker.py analog); returns (Popen, Connection)."""
+    parent_s, child_s = socket.socketpair()
+    cmd = [
+        sys.executable, "-m", "ray_tpu.core.worker_main",
+        "--fd", str(child_s.fileno()),
+    ]
+    if shm_name:
+        cmd += ["--shm-name", shm_name, "--shm-size", str(shm_size)]
+    if head_addr:
+        cmd += ["--head", head_addr]
+        if token:
+            cmd += ["--token", token]
+    stdout = stderr = None
+    if log_base:
+        # per-worker log files tailed back to the driver (reference:
+        # _private/log_monitor.py log_to_driver plumbing)
+        os.makedirs(os.path.dirname(log_base), exist_ok=True)
+        stdout = open(log_base + ".out", "ab", buffering=0)
+        stderr = open(log_base + ".err", "ab", buffering=0)
+    proc = subprocess.Popen(
+        cmd, pass_fds=(child_s.fileno(),), close_fds=True, env=worker_env(),
+        stdout=stdout, stderr=stderr,
+    )
+    if stdout is not None:
+        stdout.close()
+        stderr.close()
+    child_s.close()
+    return proc, Connection(parent_s.detach())
+
+
+class DedicatedActorWorker:
+    """One exec'd process hosting one actor instance (reference: every actor
+    lives in its own worker process; task_receiver.cc execution)."""
+
+    def __init__(self, shm_name=None, shm_size=0, head_addr=None, token=None,
+                 log_base=None):
+        self.proc, self.conn = spawn_worker_process(
+            shm_name, shm_size, head_addr, token, log_base
+        )
+        self._lock = threading.Lock()
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def is_alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def _roundtrip(self, req: tuple):
+        with self._lock:
+            try:
+                self.conn.send_bytes(cloudpickle.dumps(req))
+                resp = cloudpickle.loads(self.conn.recv_bytes())
+            except (EOFError, OSError, BrokenPipeError) as e:
+                raise WorkerCrashedError(
+                    f"actor worker process died ({type(e).__name__})"
+                ) from e
+        status, payload, extra = resp
+        if status == "err":
+            raise _RemoteTaskError(payload, exc_blob=extra)
+        return status, payload, extra
+
+    def init_actor(self, cls, args_blob: bytes, runtime_env: dict | None = None) -> None:
+        self._roundtrip(("actor_init", cloudpickle.dumps(cls), args_blob, runtime_env))
+
+    def call(self, method_name: str, args_blob: bytes, oid_bin: bytes | None):
+        return self._roundtrip(("actor_call", method_name, args_blob, oid_bin))
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.proc.pid, 9)
+        except OSError:
+            pass
+
+    def shutdown(self) -> None:
+        try:
+            self.conn.send_bytes(cloudpickle.dumps(("exit",)))
+        except Exception:
+            pass
+        try:
+            self.proc.wait(timeout=2)
+        except subprocess.TimeoutExpired:
+            self.proc.terminate()
+        try:
+            self.conn.close()
+        except Exception:
+            pass
 
 
 class ProcessWorkerPool:
@@ -217,36 +358,15 @@ class ProcessWorkerPool:
             self._spawn()
 
     def _spawn(self) -> "_Worker":
-        parent_s, child_s = socket.socketpair()
-        cmd = [
-            sys.executable, "-m", "ray_tpu.core.worker_main",
-            "--fd", str(child_s.fileno()),
-        ]
-        if self._shm_name:
-            cmd += ["--shm-name", self._shm_name, "--shm-size", str(self._shm_size)]
-        if self._head_addr:
-            cmd += ["--head", self._head_addr]
-            if self._token:
-                cmd += ["--token", self._token]
-        stdout = stderr = None
+        self._spawn_seq += 1
+        log_base = None
         if self._log_dir:
-            # per-worker log files tailed back to the driver (reference:
-            # _private/log_monitor.py log_to_driver plumbing); unique per
-            # child via an incrementing spawn counter
-            os.makedirs(self._log_dir, exist_ok=True)
-            self._spawn_seq += 1
-            base = os.path.join(self._log_dir, f"worker-{os.getpid()}-{self._spawn_seq}")
-            stdout = open(base + ".out", "ab", buffering=0)
-            stderr = open(base + ".err", "ab", buffering=0)
-        proc = subprocess.Popen(
-            cmd, pass_fds=(child_s.fileno(),), close_fds=True, env=worker_env(),
-            stdout=stdout, stderr=stderr,
+            log_base = os.path.join(
+                self._log_dir, f"worker-{os.getpid()}-{self._spawn_seq}"
+            )
+        proc, conn = spawn_worker_process(
+            self._shm_name, self._shm_size, self._head_addr, self._token, log_base
         )
-        if stdout is not None:
-            stdout.close()
-            stderr.close()
-        child_s.close()
-        conn = Connection(parent_s.detach())
         w = _Worker(proc, conn)
         self._workers.append(w)
         return w

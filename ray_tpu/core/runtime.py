@@ -159,6 +159,7 @@ class _ActorState:
         self.loop = None  # asyncio loop for async actors
         self.lock = threading.Lock()
         self.pending_count = 0
+        self.proc_worker = None  # DedicatedActorWorker for process actors
 
 
 class Runtime:
@@ -1059,8 +1060,30 @@ class Runtime:
         state.node_id = self._tasks[spec.task_id].node_id
         state.sched_req = _sched_request(spec)
         try:
-            state.instance = state.cls(*args, **kwargs)
+            if state.options.get("isolate_process"):
+                # Dedicated OS worker process hosting the actor (reference:
+                # every actor is its own worker process). Serialized init args
+                # travel with ShmArg markers like process tasks.
+                if state.is_async:
+                    raise NotImplementedError(
+                        "async actors are not supported with isolate_process yet"
+                    )
+                if state.max_concurrency > 1:
+                    logger.warning(
+                        "isolate_process actor %s: max_concurrency=%d downgraded "
+                        "to 1 (method calls serialize on the actor's process)",
+                        state.cls.__name__, state.max_concurrency,
+                    )
+                self._spawn_proc_actor(state, spec)
+            else:
+                state.instance = state.cls(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
+            from ray_tpu.core.process_pool import _RemoteTaskError
+
+            if isinstance(e, _RemoteTaskError):
+                orig = e.original_exception()
+                if orig is not None:
+                    e = orig
             state.state = "DEAD"
             state.death_cause = f"__init__ failed: {e!r}"
             self._publish_actor_event(state)
@@ -1071,13 +1094,38 @@ class Runtime:
         state.state = "ALIVE"
         self._publish_actor_event(state)
         self._store_value(spec.return_ids()[0], None)  # creation done marker
-        for i in range(max(1, state.max_concurrency)):
+        concurrency = 1 if state.proc_worker is not None else max(1, state.max_concurrency)
+        for i in range(concurrency):
             t = threading.Thread(
                 target=self._actor_loop, args=(state,), daemon=True,
                 name=f"ray_tpu-actor-{state.cls.__name__}-{i}",
             )
             state.threads.append(t)
             t.start()
+
+    def _spawn_proc_actor(self, state: _ActorState, spec: TaskSpec) -> None:
+        from ray_tpu.core.process_pool import DedicatedActorWorker
+
+        import os as _os
+
+        log_base = _os.path.join(
+            self.session_log_dir,
+            f"actor-{state.cls.__name__}-{state.actor_id.hex()[:8]}-{state.num_restarts}",
+        )
+        worker = DedicatedActorWorker(
+            shm_name=self.shm_store.name if self.shm_store else None,
+            shm_size=self.config.object_store_memory,
+            head_addr=self.control_plane.address if self.control_plane else None,
+            token=self.control_plane.token if self.control_plane else None,
+            log_base=log_base if self.config.log_to_driver else None,
+        )
+        try:
+            worker.init_actor(state.cls, self._marshal_args(spec),
+                              runtime_env=spec.runtime_env)
+        except BaseException:
+            worker.kill()
+            raise
+        state.proc_worker = worker
 
     def _runtime_env_ctx(self, state: _ActorState):
         """Build (once) the actor's runtime_env context from its creation spec."""
@@ -1115,6 +1163,17 @@ class Runtime:
                 entry.start_time = time.time()
             self._record_event(spec, "RUNNING")
             retrying = False
+            if state.proc_worker is not None:
+                retrying = self._run_proc_actor_task(state, spec, entry)
+                if not retrying:
+                    self.reference_counter.remove_submitted_task_refs(
+                        [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+                    )
+                    with state.lock:
+                        state.pending_count -= 1
+                if state.state != "ALIVE":
+                    return  # incarnation over (death or restart pending)
+                continue
             try:
                 self._maybe_inject_chaos(spec)
                 args, kwargs = self._resolve_args(spec)
@@ -1215,6 +1274,100 @@ class Runtime:
                     with state.lock:
                         state.pending_count -= 1
 
+    def _run_proc_actor_task(self, state: _ActorState, spec: TaskSpec, entry) -> bool:
+        """One actor task on the dedicated worker process. Returns True if the
+        task was re-enqueued (retry or restart replay) and keeps its pins."""
+        from ray_tpu.core.process_pool import WorkerCrashedError, _RemoteTaskError
+
+        rids = spec.return_ids()
+        oid_bin = rids[0].binary() if spec.num_returns == 1 else None
+
+        def _finish(state_str: str) -> None:
+            if entry:
+                entry.state = state_str
+                entry.end_time = time.time()
+            self._record_event(spec, state_str)
+
+        def _retry() -> bool:
+            if entry:
+                entry.attempts += 1
+            self._record_event(spec, "RETRYING")
+            state.mailbox.put((spec, rids[0]))
+            return True
+
+        if isinstance(spec.num_returns, str):
+            # streaming/dynamic generator methods need the in-process stream
+            # machinery; reject clearly rather than failing on pickling
+            self._store_error(spec, TaskError(NotImplementedError(
+                "streaming generator methods are not supported on "
+                "isolate_process actors yet"), spec.desc()))
+            _finish("FAILED")
+            return False
+        try:
+            self._maybe_inject_chaos(spec)
+            args_blob = self._marshal_args(spec)
+            status, payload, size = state.proc_worker.call(
+                spec.method_name, args_blob, oid_bin
+            )
+            self._store_worker_result(spec, rids, status, payload, size)
+            _finish("FINISHED")
+            return False
+        except WorkerCrashedError:
+            state.proc_worker = None
+            if state.state != "ALIVE":
+                # user-initiated kill (or concurrent death handling) already
+                # ran — do NOT resurrect a killed actor from the crash path
+                self._store_error(spec, ActorDiedError(
+                    state.death_cause or "actor was killed"))
+                _finish("FAILED")
+                return False
+            # The actor's process died: release its lease, restart within the
+            # budget (gcs_actor_manager.cc:341 semantics), and replay this
+            # task if max_task_retries allows.
+            if state.node_id is not None and state.sched_req is not None:
+                self.scheduler.release(state.node_id, state.sched_req)
+                state.node_id = None
+                self.scheduler.retry_pending_pgs()
+            attempts = entry.attempts if entry else 0
+            if self.restart_actor(spec.actor_id):
+                if _retries_left(spec, attempts):
+                    return _retry()
+                self._store_error(spec, ActorDiedError(
+                    "actor worker process died (task not retried: max_task_retries)"
+                ))
+                _finish("FAILED")
+                return False
+            state.state = "DEAD"
+            state.death_cause = "actor worker process died"
+            self._publish_actor_event(state)
+            if state.name:
+                with self._lock:
+                    self._named_actors.pop((state.namespace, state.name), None)
+            self._store_error(spec, ActorDiedError(state.death_cause))
+            self._drain_mailbox(state, ActorDiedError(state.death_cause))
+            _finish("FAILED")
+            return False
+        except BaseException as e:  # noqa: BLE001
+            orig = e
+            if isinstance(e, _RemoteTaskError):
+                o = e.original_exception()
+                if o is not None:
+                    orig = o
+            attempts = entry.attempts if entry else 0
+            if (
+                _retries_left(spec, attempts)
+                and _should_retry(spec, orig)
+                and state.state == "ALIVE"
+            ):
+                logger.warning(
+                    "Actor task %s failed (%s); retry %d/%d",
+                    spec.desc(), type(orig).__name__, attempts + 1, spec.max_retries,
+                )
+                return _retry()
+            self._store_error(spec, TaskError(orig, spec.desc()))
+            _finish("FAILED")
+            return False
+
     def _execute_actor_generator(self, spec: TaskSpec, method, args, kwargs) -> None:
         stream_id = spec.return_ids()[0]
         stream = self._streams.setdefault(stream_id, _StreamState())
@@ -1307,6 +1460,9 @@ class Runtime:
                 if store is not None:
                     store.remove_detached_actor(state.namespace, state.name)
         self._drain_mailbox(state, ActorDiedError(state.death_cause))
+        if state.proc_worker is not None:
+            state.proc_worker.kill()
+            state.proc_worker = None
         for _ in state.threads:
             state.mailbox.put(None)
         if state.node_id is not None and state.sched_req is not None:
@@ -1418,6 +1574,12 @@ class Runtime:
     def shutdown(self) -> None:
         self.is_shutdown = True
         for state in list(self._actors.values()):
+            if state.proc_worker is not None:
+                try:
+                    state.proc_worker.shutdown()
+                except Exception:
+                    pass
+                state.proc_worker = None
             for _ in state.threads:
                 state.mailbox.put(None)
         self.scheduler.notify()

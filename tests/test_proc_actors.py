@@ -1,0 +1,140 @@
+"""Process-actor tests: actors hosted in dedicated OS worker processes
+(reference: every actor is its own worker process; restart via
+gcs_actor_manager.cc:341 on worker death)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_process_actor_lives_in_own_process(session):
+    @ray_tpu.remote(isolate_process=True)
+    class Host:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            return os.getpid()
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    a = Host.remote()
+    pid = ray_tpu.get(a.pid.remote(), timeout=60)
+    assert pid != os.getpid()
+    # state persists across calls within the process
+    assert [ray_tpu.get(a.incr.remote(), timeout=30) for _ in range(3)] == [1, 2, 3]
+
+
+def test_process_actor_large_result_via_shm(session):
+    @ray_tpu.remote(isolate_process=True)
+    class Big:
+        def make(self, n):
+            return np.arange(n, dtype=np.float64)
+
+    a = Big.remote()
+    out = ray_tpu.get(a.make.remote(300_000), timeout=60)
+    assert out.shape == (300_000,) and out[12345] == 12345.0
+
+
+def test_process_actor_app_error_keeps_actor_alive(session):
+    @ray_tpu.remote(isolate_process=True)
+    class Moody:
+        def boom(self):
+            raise ValueError("app-level")
+
+        def ok(self):
+            return "fine"
+
+    a = Moody.remote()
+    with pytest.raises(TaskError, match="app-level"):
+        ray_tpu.get(a.boom.remote(), timeout=60)
+    assert ray_tpu.get(a.ok.remote(), timeout=60) == "fine"
+
+
+def test_process_actor_killed_restarts_and_replays(session):
+    @ray_tpu.remote(isolate_process=True, max_restarts=2, max_task_retries=2)
+    class Phoenix:
+        def pid(self):
+            return os.getpid()
+
+        def suicide_then_answer(self, marker):
+            # first incarnation dies mid-call; the restarted one answers
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os.kill(os.getpid(), 9)
+            return "risen"
+
+    import tempfile
+
+    marker = tempfile.mktemp()
+    a = Phoenix.remote()
+    pid1 = ray_tpu.get(a.pid.remote(), timeout=60)
+    try:
+        # dies (kill -9) then replays on the restarted incarnation
+        out = ray_tpu.get(a.suicide_then_answer.remote(marker), timeout=120)
+        assert out == "risen"
+        pid2 = ray_tpu.get(a.pid.remote(), timeout=60)
+        assert pid2 != pid1  # genuinely a new process
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_process_actor_death_without_restart_budget(session):
+    @ray_tpu.remote(isolate_process=True)  # max_restarts=0
+    class Fragile:
+        def die(self):
+            os.kill(os.getpid(), 9)
+
+        def ok(self):
+            return 1
+
+    a = Fragile.remote()
+    assert ray_tpu.get(a.ok.remote(), timeout=60) == 1
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.die.remote(), timeout=60)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.ok.remote(), timeout=30)
+
+
+def test_process_actor_restart_reinitializes_state(session):
+    """Restart re-runs __init__ (metadata durability, not state checkpointing)."""
+
+    @ray_tpu.remote(isolate_process=True, max_restarts=1, max_task_retries=1)
+    class Counted:
+        def __init__(self):
+            self.n = 0
+
+        def incr_or_die(self, die_path):
+            self.n += 1
+            if self.n == 3 and not os.path.exists(die_path):
+                open(die_path, "w").close()
+                os.kill(os.getpid(), 9)
+            return self.n
+
+    import tempfile
+
+    marker = tempfile.mktemp()
+    a = Counted.remote()
+    try:
+        assert ray_tpu.get(a.incr_or_die.remote(marker), timeout=60) == 1
+        assert ray_tpu.get(a.incr_or_die.remote(marker), timeout=60) == 2
+        # third call kills the incarnation; replay on the fresh one sees n=1
+        assert ray_tpu.get(a.incr_or_die.remote(marker), timeout=120) == 1
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
