@@ -554,8 +554,7 @@ class Runtime:
         self._record_event(spec, "RUNNING")
         try:
             if spec.is_actor_creation:
-                args, kwargs = self._resolve_args(spec)
-                self._execute_actor_creation(spec, args, kwargs)
+                self._execute_actor_creation(spec)
                 return  # actor holds its lease until death
             if isinstance(spec.num_returns, str):
                 args, kwargs = self._resolve_args(spec)
@@ -1050,7 +1049,7 @@ class Runtime:
         self.submit_task(spec)
         return actor_id
 
-    def _execute_actor_creation(self, spec: TaskSpec, args, kwargs) -> None:
+    def _execute_actor_creation(self, spec: TaskSpec) -> None:
         state = self._actors[spec.actor_id]
         if state.state == "DEAD":
             # killed while the creation task was queued: don't resurrect
@@ -1074,8 +1073,9 @@ class Runtime:
                         "to 1 (method calls serialize on the actor's process)",
                         state.cls.__name__, state.max_concurrency,
                     )
-                self._spawn_proc_actor(state, spec)
+                self._spawn_proc_actor(state, spec)  # marshals raw refs itself
             else:
+                args, kwargs = self._resolve_args(spec)
                 state.instance = state.cls(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             from ray_tpu.core.process_pool import _RemoteTaskError
@@ -1163,8 +1163,9 @@ class Runtime:
                 entry.start_time = time.time()
             self._record_event(spec, "RUNNING")
             retrying = False
-            if state.proc_worker is not None:
-                retrying = self._run_proc_actor_task(state, spec, entry)
+            proc_worker = state.proc_worker  # snapshot: kill() may null it
+            if proc_worker is not None:
+                retrying = self._run_proc_actor_task(state, spec, entry, proc_worker)
                 if not retrying:
                     self.reference_counter.remove_submitted_task_refs(
                         [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
@@ -1274,7 +1275,8 @@ class Runtime:
                     with state.lock:
                         state.pending_count -= 1
 
-    def _run_proc_actor_task(self, state: _ActorState, spec: TaskSpec, entry) -> bool:
+    def _run_proc_actor_task(self, state: _ActorState, spec: TaskSpec, entry,
+                             proc_worker) -> bool:
         """One actor task on the dedicated worker process. Returns True if the
         task was re-enqueued (retry or restart replay) and keeps its pins."""
         from ray_tpu.core.process_pool import WorkerCrashedError, _RemoteTaskError
@@ -1306,7 +1308,7 @@ class Runtime:
         try:
             self._maybe_inject_chaos(spec)
             args_blob = self._marshal_args(spec)
-            status, payload, size = state.proc_worker.call(
+            status, payload, size = proc_worker.call(
                 spec.method_name, args_blob, oid_bin
             )
             self._store_worker_result(spec, rids, status, payload, size)

@@ -21,6 +21,8 @@ from ray_tpu.data.read_api import (
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 
 from ray_tpu.data import llm  # noqa: F401  (ray.data.llm parity surface)
@@ -30,7 +32,7 @@ __all__ = [
     "Block", "Dataset", "DataIterator",
     "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "from_huggingface", "read_parquet", "read_csv", "read_json", "read_text",
-    "read_binary_files", "read_numpy", "read_images",
+    "read_binary_files", "read_numpy", "read_images", "read_tfrecords", "read_webdataset",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rec

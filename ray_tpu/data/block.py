@@ -28,8 +28,15 @@ class Block:
     def from_rows(rows: list[Row]) -> "Block":
         if not rows:
             return Block({})
-        keys = rows[0].keys()
-        return Block({k: np.asarray([r[k] for r in rows]) for k in keys})
+        # union of keys across rows (sparse rows are legal — TFRecord optional
+        # features, WebDataset optional per-sample files); missing -> None
+        keys: dict = {}
+        for r in rows:
+            for k in r:
+                keys.setdefault(k, None)
+        if all(len(r) == len(keys) for r in rows):
+            return Block({k: np.asarray([r[k] for r in rows]) for k in keys})
+        return Block({k: np.asarray([r.get(k) for r in rows]) for k in keys})
 
     @staticmethod
     def from_items(items: list[Any]) -> "Block":

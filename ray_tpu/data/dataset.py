@@ -340,6 +340,20 @@ class Dataset:
         for i, b in enumerate(self.iter_blocks()):
             b.to_pandas().to_csv(f"{path}/part-{i:05d}.csv", index=False)
 
+    def write_tfrecords(self, path: str) -> None:
+        """Write blocks as TFRecord shards of tf.train.Example (reference:
+        Dataset.write_tfrecords; hermetic codec in data/tfrecords.py)."""
+        import os
+
+        from ray_tpu.data.tfrecords import encode_example, write_tfrecord_file
+
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self.iter_blocks()):
+            write_tfrecord_file(
+                f"{path}/part-{i:05d}.tfrecord",
+                (encode_example(row) for row in b.rows()),
+            )
+
     def write_json(self, path: str) -> None:
         import os
 

@@ -278,3 +278,52 @@ def read_images(paths: str | list[str], *, size: tuple[int, int] | None = None,
             yield Block({"image": arr, "path": np.asarray(okpaths, dtype=object)})
 
     return Dataset(source, (), "read_images")
+
+
+def read_tfrecords(paths: str | list[str], *, batch_rows: int = 1024) -> Dataset:
+    """TFRecord files of tf.train.Example -> rows (reference: read_api.py:2517;
+    hermetic framing/proto codec in data/tfrecords.py — no tensorflow)."""
+    files = _expand_paths(paths, ".tfrecord")
+
+    def source() -> Iterator[Block]:
+        from ray_tpu.data.tfrecords import decode_example, read_tfrecord_file
+
+        rows: list[dict] = []
+        for f in files:
+            for rec in read_tfrecord_file(f):
+                rows.append(decode_example(rec))
+                if len(rows) >= batch_rows:
+                    yield Block.from_rows(rows)
+                    rows = []
+        if rows:
+            yield Block.from_rows(rows)
+
+    return Dataset(source, (), "read_tfrecords")
+
+
+def read_webdataset(paths: str | list[str]) -> Dataset:
+    """WebDataset tar shards -> one row per sample key, columns per extension
+    (reference: read_api.py:2794 read_webdataset)."""
+    import tarfile
+
+    files = _expand_paths(paths, ".tar")
+
+    def source() -> Iterator[Block]:
+        for f in files:
+            rows: dict[str, dict] = {}
+            order: list[str] = []
+            with tarfile.open(f) as tar:
+                for member in tar:
+                    if not member.isfile():
+                        continue
+                    base = os.path.basename(member.name)
+                    key, _, ext = base.partition(".")
+                    sample = rows.get(key)
+                    if sample is None:
+                        sample = rows[key] = {"__key__": key}
+                        order.append(key)
+                    sample[ext] = tar.extractfile(member).read()
+            if order:
+                yield Block.from_rows([rows[k] for k in order])
+
+    return Dataset(source, (), "read_webdataset")

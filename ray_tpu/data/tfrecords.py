@@ -1,0 +1,213 @@
+"""TFRecord file format + tf.train.Example codec — hermetic (no tensorflow).
+
+Parity: ray.data read_tfrecords/write_tfrecords (read_api.py:2517,
+_internal/datasource/tfrecords_datasource.py). The reference requires
+tensorflow/crc32c at runtime; here the record framing (length + masked
+crc32c) and the Example protobuf (Features -> map<string, Feature> with
+bytes/float/int64 lists) are implemented directly, so TFRecord pipelines work
+with zero extra dependencies.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Iterator
+
+import numpy as np
+
+# ------------------------------------------------------------------ crc32c
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78  # Castagnoli, reflected
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------------ framing
+def read_tfrecord_file(path: str, *, verify: bool = True) -> Iterator[bytes]:
+    """Yield raw record payloads (length|len_crc|data|data_crc framing)."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise ValueError(f"truncated TFRecord header in {path}")
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:12])
+            if verify and _masked_crc(header[:8]) != len_crc:
+                raise ValueError(f"corrupt TFRecord length crc in {path}")
+            data = f.read(length)
+            footer = f.read(4)
+            if len(data) < length or len(footer) < 4:
+                raise ValueError(f"truncated TFRecord body in {path}")
+            if verify and _masked_crc(data) != struct.unpack("<I", footer)[0]:
+                raise ValueError(f"corrupt TFRecord data crc in {path}")
+            yield data
+
+
+def write_tfrecord_file(path: str, records: Iterator[bytes]) -> int:
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            header = struct.pack("<Q", len(rec))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(rec)
+            f.write(struct.pack("<I", _masked_crc(rec)))
+            n += 1
+    return n
+
+
+# ------------------------------------------------------------------ protobuf
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        out.append(bits | (0x80 if value else 0))
+        if not value:
+            return bytes(out)
+
+
+def _read_varint(buf: memoryview, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _length_delimited(field: int, payload: bytes) -> bytes:
+    return _varint(field << 3 | 2) + _varint(len(payload)) + payload
+
+
+def encode_example(features: dict[str, Any]) -> bytes:
+    """dict -> serialized tf.train.Example. Values: bytes/str -> bytes_list,
+    float(s) -> float_list, int(s) -> int64_list; numpy arrays by dtype."""
+    feats = bytearray()
+    for name, value in features.items():
+        if isinstance(value, (bytes, str)):
+            value = [value]
+        elif isinstance(value, np.ndarray):
+            value = value.tolist()
+        elif not isinstance(value, (list, tuple)):
+            value = [value]
+        if all(isinstance(v, (bytes, str)) for v in value):
+            inner = b"".join(
+                _length_delimited(1, v.encode() if isinstance(v, str) else v)
+                for v in value
+            )
+            kind = _length_delimited(1, inner)  # BytesList in field 1
+        elif all(isinstance(v, (int, np.integer)) for v in value):
+            packed = b"".join(_varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in value)
+            kind = _length_delimited(3, _length_delimited(1, packed))  # Int64List
+        else:
+            packed = b"".join(struct.pack("<f", float(v)) for v in value)
+            kind = _length_delimited(2, _length_delimited(1, packed))  # FloatList
+        entry = _length_delimited(1, name.encode()) + _length_delimited(2, kind)
+        feats += _length_delimited(1, entry)  # map entry in Features.feature
+    return _length_delimited(1, bytes(feats))  # Example.features
+
+
+def decode_example(data: bytes) -> dict[str, Any]:
+    """Serialized tf.train.Example -> {name: scalar or list}."""
+    buf = memoryview(data)
+
+    def parse_fields(view: memoryview) -> Iterator[tuple[int, int, Any]]:
+        pos = 0
+        while pos < len(view):
+            key, pos = _read_varint(view, pos)
+            field, wire = key >> 3, key & 7
+            if wire == 2:
+                ln, pos = _read_varint(view, pos)
+                yield field, wire, view[pos:pos + ln]
+                pos += ln
+            elif wire == 0:
+                v, pos = _read_varint(view, pos)
+                yield field, wire, v
+            elif wire == 5:
+                yield field, wire, view[pos:pos + 4]
+                pos += 4
+            elif wire == 1:
+                yield field, wire, view[pos:pos + 8]
+                pos += 8
+            else:
+                raise ValueError(f"unsupported wire type {wire}")
+
+    out: dict[str, Any] = {}
+    for field, _, features_view in parse_fields(buf):
+        if field != 1:
+            continue
+        for f2, _, entry in parse_fields(features_view):
+            if f2 != 1:
+                continue
+            name, feature = None, None
+            for f3, _, val in parse_fields(entry):
+                if f3 == 1:
+                    name = bytes(val).decode()
+                elif f3 == 2:
+                    feature = val
+            if name is None or feature is None:
+                continue
+            for kind, _, payload in parse_fields(feature):
+                if kind == 1:  # BytesList
+                    vals = [bytes(v) for f4, _, v in parse_fields(payload) if f4 == 1]
+                elif kind == 2:  # FloatList (packed or repeated)
+                    vals = []
+                    for f4, w4, v in parse_fields(payload):
+                        if f4 != 1:
+                            continue
+                        if w4 == 2:
+                            vals.extend(
+                                struct.unpack(f"<{len(v) // 4}f", bytes(v))
+                            )
+                        else:
+                            vals.append(struct.unpack("<f", bytes(v))[0])
+                elif kind == 3:  # Int64List (packed varints or repeated)
+                    vals = []
+                    for f4, w4, v in parse_fields(payload):
+                        if f4 != 1:
+                            continue
+                        if w4 == 2:
+                            pos = 0
+                            while pos < len(v):
+                                iv, pos = _read_varint(v, pos)
+                                if iv >= 1 << 63:
+                                    iv -= 1 << 64
+                                vals.append(iv)
+                        else:
+                            iv = v if isinstance(v, int) else 0
+                            if iv >= 1 << 63:
+                                iv -= 1 << 64  # two's complement
+                            vals.append(iv)
+                else:
+                    continue
+                out[name] = vals[0] if len(vals) == 1 else vals
+    return out

@@ -1,0 +1,153 @@
+"""Core microbenchmark suite: `python -m ray_tpu.scripts.microbench`.
+
+Parity: python/ray/_private/ray_perf.py:95-252 (the release-tracked
+microbenchmarks: single-client sync tasks, 1:1 actor calls, n:n async actor
+calls, put/get throughput). Prints one JSON line per metric so CI can track
+regressions; `--quick` trims iteration counts for smoke runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _rate(n: int, dt: float) -> float:
+    return round(n / dt, 2) if dt > 0 else 0.0
+
+
+def bench_tasks_sync(n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(isolate_process=False)  # in-process dispatch overhead
+    def nop():
+        return 0
+
+    ray_tpu.get(nop.remote())  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(nop.remote())
+    dt = time.perf_counter() - t0
+    return {"metric": "single_client_tasks_sync", "value": _rate(n, dt), "unit": "tasks/s"}
+
+
+def bench_tasks_async_batch(n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(isolate_process=False)
+    def nop():
+        return 0
+
+    ray_tpu.get(nop.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    return {"metric": "single_client_tasks_async", "value": _rate(n, dt), "unit": "tasks/s"}
+
+
+def bench_process_tasks(n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote  # default: OS worker processes (the honest hot path)
+    def nop():
+        return 0
+
+    ray_tpu.get([nop.remote() for _ in range(4)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    return {"metric": "process_tasks_async", "value": _rate(n, dt), "unit": "tasks/s"}
+
+
+def bench_actor_calls_sync(n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def nop(self):
+            return 0
+
+    a = A.remote()
+    ray_tpu.get(a.nop.remote())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(a.nop.remote())
+    dt = time.perf_counter() - t0
+    return {"metric": "actor_calls_sync_1_1", "value": _rate(n, dt), "unit": "calls/s"}
+
+
+def bench_actor_calls_async(n: int, num_actors: int = 4) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def nop(self):
+            return 0
+
+    actors = [A.remote() for _ in range(num_actors)]
+    ray_tpu.get([a.nop.remote() for a in actors])
+    t0 = time.perf_counter()
+    refs = [actors[i % num_actors].nop.remote() for i in range(n)]
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    return {"metric": "actor_calls_async_n_n", "value": _rate(n, dt), "unit": "calls/s"}
+
+
+def bench_put_gigabytes(total_mb: int) -> dict:
+    import ray_tpu
+
+    chunk = np.random.default_rng(0).standard_normal(1_000_000)  # 8 MB
+    n = max(1, total_mb // 8)
+    refs = []
+    t0 = time.perf_counter()
+    for _ in range(n):
+        refs.append(ray_tpu.put(chunk))
+    dt = time.perf_counter() - t0
+    gb = n * chunk.nbytes / 1e9
+    out = {"metric": "put_throughput", "value": round(gb / dt, 3), "unit": "GB/s"}
+    del refs
+    return out
+
+
+def bench_get_gigabytes(total_mb: int) -> dict:
+    import ray_tpu
+
+    chunk = np.random.default_rng(0).standard_normal(1_000_000)
+    n = max(1, total_mb // 8)
+    refs = [ray_tpu.put(chunk) for _ in range(n)]
+    t0 = time.perf_counter()
+    for r in refs:
+        ray_tpu.get(r)
+    dt = time.perf_counter() - t0
+    gb = n * chunk.nbytes / 1e9
+    return {"metric": "get_throughput_zero_copy", "value": round(gb / dt, 3), "unit": "GB/s"}
+
+
+def run(quick: bool = False) -> list[dict]:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    k = 1 if quick else 10
+    results = [
+        bench_tasks_sync(100 * k),
+        bench_tasks_async_batch(100 * k),
+        bench_process_tasks(50 * k),
+        bench_actor_calls_sync(100 * k),
+        bench_actor_calls_async(100 * k),
+        bench_put_gigabytes(16 * k),
+        bench_get_gigabytes(16 * k),
+    ]
+    for r in results:
+        print(json.dumps(r), flush=True)
+    ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+    run(quick=args.quick)

@@ -237,3 +237,90 @@ def test_stats_pipeline_order_with_limit():
     ds.count()
     s = ds.stats()
     assert s.index("Map") < s.index("Filter")  # pipeline order preserved
+
+
+def test_tfrecords_roundtrip(tmp_path):
+    """Hermetic TFRecord write/read (reference: read_tfrecords/write_tfrecords)."""
+    rows = [
+        {"name": f"item-{i}".encode(), "score": float(i) / 3.0, "count": i, "tags": [i, i * 2]}
+        for i in range(57)
+    ]
+    ds = rdata.from_items(rows, parallelism=4)
+    ds.write_tfrecords(str(tmp_path / "tfr"))
+    import os
+
+    assert any(f.endswith(".tfrecord") for f in os.listdir(tmp_path / "tfr"))
+    back = rdata.read_tfrecords(str(tmp_path / "tfr") + "/*.tfrecord").take_all()
+    assert len(back) == 57
+    by_count = {int(r["count"]): r for r in back}
+    assert by_count[10]["name"] == b"item-10"
+    assert abs(by_count[10]["score"] - 10 / 3.0) < 1e-6
+    assert list(by_count[10]["tags"]) == [10, 20]
+
+
+def test_tfrecords_crc_detects_corruption(tmp_path):
+    from ray_tpu.data.tfrecords import read_tfrecord_file, write_tfrecord_file
+
+    p = str(tmp_path / "x.tfrecord")
+    write_tfrecord_file(p, iter([b"hello-world-payload"]))
+    raw = bytearray(open(p, "rb").read())
+    raw[14] ^= 0xFF  # flip a payload byte
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="crc"):
+        list(read_tfrecord_file(p))
+
+
+def test_read_webdataset(tmp_path):
+    import tarfile
+
+    shard = tmp_path / "shard-000000.tar"
+    with tarfile.open(shard, "w") as tar:
+        for key in ("a", "b"):
+            for ext, payload in (("txt", f"text-{key}".encode()),
+                                 ("cls", b"7")):
+                import io as _io
+
+                info = tarfile.TarInfo(name=f"{key}.{ext}")
+                info.size = len(payload)
+                tar.addfile(info, _io.BytesIO(payload))
+    rows = rdata.read_webdataset(str(shard)).take_all()
+    assert len(rows) == 2
+    assert rows[0]["__key__"] == "a" and rows[0]["txt"] == b"text-a"
+    assert rows[1]["cls"] == b"7"
+
+
+def test_tfrecords_sparse_features_and_negative_ints(tmp_path):
+    """Optional features and negative int64s are legal (review regression)."""
+    from ray_tpu.data.tfrecords import (
+        decode_example,
+        encode_example,
+        read_tfrecord_file,
+        write_tfrecord_file,
+    )
+
+    recs = [
+        encode_example({"a": 1, "b": -5}),
+        encode_example({"a": 2}),  # sparse: no 'b'
+    ]
+    p = str(tmp_path / "sparse.tfrecord")
+    write_tfrecord_file(p, iter(recs))
+    rows = rdata.read_tfrecords(p).take_all()
+    assert len(rows) == 2
+    assert rows[0]["b"] == -5
+    assert rows[1]["b"] is None  # missing feature -> None-filled column
+    assert decode_example(recs[0])["b"] == -5
+
+
+def test_webdataset_optional_files(tmp_path):
+    import io as _io
+    import tarfile
+
+    shard = tmp_path / "opt.tar"
+    with tarfile.open(shard, "w") as tar:
+        for name, payload in (("a.txt", b"A"), ("a.cls", b"1"), ("b.txt", b"B")):
+            info = tarfile.TarInfo(name=name)
+            info.size = len(payload)
+            tar.addfile(info, _io.BytesIO(payload))
+    rows = rdata.read_webdataset(str(shard)).take_all()
+    assert rows[0]["cls"] == b"1"
+    assert rows[1]["cls"] is None  # b has no .cls
