@@ -1,17 +1,19 @@
 """ray_tpu.rllib: reinforcement learning (reference: rllib/).
 
-Algorithms: PPO (on-policy, clipped surrogate + GAE) and DQN (off-policy,
-double-Q + target network + replay buffer actor). The Algorithm/Learner/
-EnvRunner layering mirrors the reference's RLModule/Learner/EnvRunner split
-so further algorithms (SAC/IMPALA) slot into the same structure.
+Algorithms: PPO (on-policy, clipped surrogate + GAE), DQN (off-policy,
+double-Q + target network + replay buffer actor), and discrete SAC (twin Q
+critics, soft targets, learned temperature). The Algorithm/Learner/EnvRunner
+layering mirrors the reference's RLModule/Learner/EnvRunner split so further
+algorithms (IMPALA/APPO) slot into the same structure.
 """
 
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, Episode, SingleAgentEnvRunner
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
 
-__all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer", "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]
+__all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer", "SAC", "SACConfig", "SACLearner", "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rec
 

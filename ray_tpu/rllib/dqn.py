@@ -176,13 +176,12 @@ class DQN:
     def __init__(self, cfg: DQNConfig):
         import gymnasium as gym
 
+        from ray_tpu.rllib.off_policy import probe_env_spaces
+
         self.cfg = cfg
         env_creator = (cfg.env if callable(cfg.env)
                        else (lambda name=cfg.env: gym.make(name)))
-        probe = env_creator()
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
-        probe.close()
+        obs_dim, num_actions = probe_env_spaces(env_creator)
         self.learner = DQNLearner(cfg, obs_dim, num_actions)
         self.env_steps_total = 0
 
@@ -216,38 +215,13 @@ class DQN:
         return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
 
     def train(self) -> dict:
-        """One iteration: collect a fragment per runner, replay-update."""
-        cfg = self.cfg
-        episodes = self.runners.sample(cfg.rollout_fragment_length)
-        self.env_steps_total += sum(len(e) for e in episodes)
-        batch = _episodes_to_transitions(episodes)
-        size = ray_tpu.get(self.buffer.add_batch.remote(batch), timeout=60)
-        metrics: dict = {}
-        updates = 0
-        if size >= cfg.learning_starts:
-            # pipeline: the next minibatch is in flight while this one trains
-            next_ref = self.buffer.sample.remote(cfg.train_batch_size)
-            for _ in range(cfg.updates_per_iter):
-                sample = ray_tpu.get(next_ref, timeout=60)
-                next_ref = self.buffer.sample.remote(cfg.train_batch_size)
-                if not sample:
-                    break
-                metrics = self.learner.update(sample)
-                updates += 1
-            self.runners.sync_weights(self.learner.params)
-        finished = [e for e in episodes if e.dones and e.dones[-1]]
-        return {
-            "env_steps_total": self.env_steps_total,
-            "buffer_size": size,
-            "num_updates": updates,
-            "epsilon": self.epsilon(),
-            "episodes_this_iter": len(finished),
-            "episode_reward_mean": (
-                float(np.mean([e.total_reward() for e in finished]))
-                if finished else float("nan")
-            ),
-            **metrics,
-        }
+        """One iteration: collect a fragment per runner, replay-update
+        (shared loop in rllib/off_policy.py)."""
+        from ray_tpu.rllib.off_policy import off_policy_train_iteration
+
+        out = off_policy_train_iteration(self)
+        out["epsilon"] = self.epsilon()
+        return out
 
     def stop(self) -> None:
         self.runners.stop()

@@ -1,0 +1,57 @@
+"""Shared off-policy machinery: replay-driven train iteration.
+
+Used by DQN and SAC (reference: the replay/update loop both inherit from the
+off-policy Algorithm base in rllib/algorithms/)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+
+
+def off_policy_train_iteration(algo) -> dict:
+    """One iteration: collect a fragment per runner, push transitions to the
+    buffer actor, run pipelined replay updates, sync weights. `algo` provides
+    cfg/runners/buffer/learner/env_steps_total (duck-typed)."""
+    from ray_tpu.rllib.dqn import _episodes_to_transitions
+
+    cfg = algo.cfg
+    episodes = algo.runners.sample(cfg.rollout_fragment_length)
+    algo.env_steps_total += sum(len(e) for e in episodes)
+    batch = _episodes_to_transitions(episodes)
+    size = ray_tpu.get(algo.buffer.add_batch.remote(batch), timeout=60)
+    metrics: dict = {}
+    updates = 0
+    if size >= cfg.learning_starts:
+        # pipeline: the next minibatch is in flight while this one trains
+        next_ref = algo.buffer.sample.remote(cfg.train_batch_size)
+        for _ in range(cfg.updates_per_iter):
+            sample = ray_tpu.get(next_ref, timeout=60)
+            next_ref = algo.buffer.sample.remote(cfg.train_batch_size)
+            if not sample:
+                break
+            metrics = algo.learner.update(sample)
+            updates += 1
+        algo.runners.sync_weights(algo.learner.params)
+    finished = [e for e in episodes if e.dones and e.dones[-1]]
+    return {
+        "env_steps_total": algo.env_steps_total,
+        "buffer_size": size,
+        "num_updates": updates,
+        "episodes_this_iter": len(finished),
+        "episode_reward_mean": (
+            float(np.mean([e.total_reward() for e in finished]))
+            if finished else float("nan")
+        ),
+        **metrics,
+    }
+
+
+def probe_env_spaces(env_creator) -> tuple[int, int]:
+    """(obs_dim, num_actions) from one throwaway env instance."""
+    probe = env_creator()
+    obs_dim = int(np.prod(probe.observation_space.shape))
+    num_actions = int(probe.action_space.n)
+    probe.close()
+    return obs_dim, num_actions

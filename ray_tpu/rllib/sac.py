@@ -1,0 +1,216 @@
+"""Discrete SAC on JAX: twin Q critics, entropy-temperature auto-tuning.
+
+Parity: rllib/algorithms/sac/ (SAC with twin Q networks, soft targets, and
+learned alpha) in its discrete-action form (Christodoulou 2019), over the
+shared Learner/EnvRunner layering like PPO/DQN. One jitted XLA update covers
+actor, both critics, and the temperature.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.ppo import _mlp_apply, _mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+
+@dataclasses.dataclass
+class SACConfig:
+    """Reference: SACConfig surface (fluent API below)."""
+
+    env: str | Callable = "CartPole-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 256
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.01  # soft target update rate
+    target_entropy_scale: float = 0.7  # of max entropy log(|A|)
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    updates_per_iter: int = 64
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "SACConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: int | None = None) -> "SACConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "SACConfig":
+        fields = {f.name for f in dataclasses.fields(self)}
+        for k, v in kw.items():
+            if k not in fields:
+                raise ValueError(f"Unknown training option {k}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SACLearner:
+    """Actor + twin critics + temperature in one jitted update."""
+
+    def __init__(self, cfg: SACConfig, obs_dim: int, num_actions: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        kp, k1, k2 = jax.random.split(key, 3)
+        sizes = (obs_dim, *cfg.hidden, num_actions)
+        self.params = {
+            "pi": _mlp_init(kp, sizes),
+            "q1": _mlp_init(k1, sizes),
+            "q2": _mlp_init(k2, sizes),
+            "log_alpha": jnp.zeros(()),
+        }
+        self.target = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        # per-component learning rates (reference: SAC's separate actor/
+        # critic/alpha optimizers) via multi_transform over top-level keys
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(5.0),
+            optax.multi_transform(
+                {"actor": optax.adam(cfg.actor_lr),
+                 "critic": optax.adam(cfg.critic_lr),
+                 "alpha": optax.adam(cfg.alpha_lr)},
+                {"pi": "actor", "q1": "critic", "q2": "critic",
+                 "log_alpha": "alpha"},
+            ),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        target_entropy = cfg.target_entropy_scale * float(np.log(num_actions))
+        self.num_updates = 0
+
+        def loss_fn(params, target, obs, actions, rewards, next_obs, dones):
+            alpha = jnp.exp(params["log_alpha"])
+            # --- critic targets (soft state value of next state) ---
+            next_logits = _mlp_apply(params["pi"], next_obs, jnp)
+            next_logp = jax.nn.log_softmax(next_logits)
+            next_p = jnp.exp(next_logp)
+            tq1 = _mlp_apply(target["q1"], next_obs, jnp)
+            tq2 = _mlp_apply(target["q2"], next_obs, jnp)
+            tq = jnp.minimum(tq1, tq2)
+            next_v = (next_p * (tq - jax.lax.stop_gradient(alpha) * next_logp)).sum(-1)
+            target_q = jax.lax.stop_gradient(
+                rewards + cfg.gamma * (1.0 - dones) * next_v
+            )
+            q1 = jnp.take_along_axis(
+                _mlp_apply(params["q1"], obs, jnp), actions[:, None], axis=1)[:, 0]
+            q2 = jnp.take_along_axis(
+                _mlp_apply(params["q2"], obs, jnp), actions[:, None], axis=1)[:, 0]
+            critic_loss = ((q1 - target_q) ** 2).mean() + ((q2 - target_q) ** 2).mean()
+            # --- actor: minimize E[alpha*logp - Q] over action distribution ---
+            logits = _mlp_apply(params["pi"], obs, jnp)
+            logp = jax.nn.log_softmax(logits)
+            p = jnp.exp(logp)
+            q_min = jax.lax.stop_gradient(jnp.minimum(
+                _mlp_apply(params["q1"], obs, jnp),
+                _mlp_apply(params["q2"], obs, jnp),
+            ))
+            actor_loss = (p * (jax.lax.stop_gradient(alpha) * logp - q_min)).sum(-1).mean()
+            # --- temperature: match target entropy ---
+            entropy = -(p * logp).sum(-1)
+            alpha_loss = (params["log_alpha"]
+                          * jax.lax.stop_gradient(entropy - target_entropy)).mean()
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "alpha": alpha, "entropy": entropy.mean(),
+            }
+
+        def update(params, target, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target, batch["obs"], batch["actions"], batch["rewards"],
+                batch["next_obs"], batch["dones"],
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # polyak soft target update (reference: tau)
+            target = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                target, {"q1": params["q1"], "q2": params["q2"]},
+            )
+            metrics["total_loss"] = loss
+            return params, target, opt_state, metrics
+
+        self._update = jax.jit(update)
+        self._jnp = jnp
+
+    def update(self, batch: dict) -> dict:
+        jnp = self._jnp
+        b = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.int32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "next_obs": jnp.asarray(batch["next_obs"], jnp.float32),
+            "dones": jnp.asarray(batch["dones"], jnp.float32),
+        }
+        self.params, self.target, self.opt_state, metrics = self._update(
+            self.params, self.target, self.opt_state, b
+        )
+        self.num_updates += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+
+class SAC:
+    """The Algorithm (reference: algorithms/algorithm.py train() loop)."""
+
+    def __init__(self, cfg: SACConfig):
+        import gymnasium as gym
+
+        from ray_tpu.rllib.off_policy import probe_env_spaces
+
+        self.cfg = cfg
+        env_creator = (cfg.env if callable(cfg.env)
+                       else (lambda name=cfg.env: gym.make(name)))
+        obs_dim, num_actions = probe_env_spaces(env_creator)
+        self.learner = SACLearner(cfg, obs_dim, num_actions)
+        self.env_steps_total = 0
+
+        import jax
+        import jax.numpy as jnp
+
+        pi_apply = jax.jit(lambda p, o: _mlp_apply(p, o, jnp))
+
+        def policy_fn(params, obs, rng):
+            # stochastic policy IS the exploration (no epsilon schedule)
+            logits = np.asarray(pi_apply(params["pi"], obs[None]))[0]
+            z = (logits - logits.max()).astype(np.float64)
+            p = np.exp(z)
+            p /= p.sum()  # float64: rng.choice validates the sum at ~1e-8
+            action = int(rng.choice(len(p), p=p))
+            return action, float(np.log(p[action] + 1e-9)), 0.0
+
+        self.runners = EnvRunnerGroup(env_creator, policy_fn,
+                                      num_runners=cfg.num_env_runners)
+        self.runners.sync_weights(self.learner.params)
+        BufferActor = ray_tpu.remote(num_cpus=0)(ReplayBuffer)
+        self.buffer = BufferActor.remote(cfg.buffer_capacity, cfg.seed)
+
+    def train(self) -> dict:
+        from ray_tpu.rllib.off_policy import off_policy_train_iteration
+
+        return off_policy_train_iteration(self)
+
+    def stop(self) -> None:
+        self.runners.stop()
+        try:
+            ray_tpu.kill(self.buffer)
+        except Exception:
+            pass

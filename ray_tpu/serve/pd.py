@@ -49,7 +49,9 @@ def build_pd_deployment(config=None, *, num_replicas: int = 1,
             import time
 
             prompt_ids = body.get("prompt_ids", [])
-            max_tokens = body.get("max_tokens") or 32
+            max_tokens = body.get("max_tokens")
+            if max_tokens is None:
+                max_tokens = 32  # explicit 0 is honored (prefill-only probe)
             t0 = time.monotonic()
             handoff = self.prefill_engine.prefill_extract(prompt_ids)
             ttft = time.monotonic() - t0
