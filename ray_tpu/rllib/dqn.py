@@ -17,6 +17,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rllib.env_runner import Episode, EnvRunnerGroup
 from ray_tpu.rllib.ppo import _mlp_apply, _mlp_init
+from ray_tpu.rllib.off_policy import _episodes_to_transitions  # noqa: F401 (re-export)
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 
 
@@ -132,42 +133,6 @@ class DQNLearner:
         return {k: float(v) for k, v in metrics.items()}
 
 
-def _episodes_to_transitions(episodes: list[Episode]) -> dict:
-    """SARS'd tuples from episode fragments. The last step of a fragment cut
-    mid-episode has no next_obs recorded — it is dropped (negligible at
-    fragment lengths >> 1)."""
-    obs, actions, rewards, next_obs, dones = [], [], [], [], []
-    for ep in episodes:
-        n = len(ep)
-        terms = ep.terminateds or ep.dones
-        for i in range(n):
-            if ep.dones[i]:
-                # terminated: masked out of the target; truncated: bootstrap
-                # from the env's true final observation
-                nxt = ep.final_obs if ep.final_obs is not None else ep.obs[i]
-            elif i + 1 < n:
-                nxt = ep.obs[i + 1]
-            else:
-                continue  # fragment-cut live step: next obs unknown
-            obs.append(ep.obs[i])
-            actions.append(ep.actions[i])
-            rewards.append(ep.rewards[i])
-            next_obs.append(nxt)
-            # Q-targets bootstrap through time-limit TRUNCATION (next state
-            # exists, the env just stopped watching) but not TERMINATION —
-            # rllib's terminated/truncated distinction.
-            dones.append(float(terms[i]))
-    if not obs:
-        return {"obs": np.zeros((0,)), "actions": np.zeros((0,), np.int64),
-                "rewards": np.zeros((0,)), "next_obs": np.zeros((0,)),
-                "dones": np.zeros((0,))}
-    return {
-        "obs": np.asarray(obs, np.float32),
-        "actions": np.asarray(actions, np.int64),
-        "rewards": np.asarray(rewards, np.float32),
-        "next_obs": np.asarray(next_obs, np.float32),
-        "dones": np.asarray(dones, np.float32),
-    }
 
 
 class DQN:

@@ -10,12 +10,49 @@ import numpy as np
 import ray_tpu
 
 
+def _episodes_to_transitions(episodes) -> dict:
+    """SARS'd tuples from episode fragments. The last step of a fragment cut
+    mid-episode has no next_obs recorded — it is dropped (negligible at
+    fragment lengths >> 1)."""
+    obs, actions, rewards, next_obs, dones = [], [], [], [], []
+    for ep in episodes:
+        n = len(ep)
+        terms = ep.terminateds or ep.dones
+        for i in range(n):
+            if ep.dones[i]:
+                # terminated: masked out of the target; truncated: bootstrap
+                # from the env's true final observation
+                nxt = ep.final_obs if ep.final_obs is not None else ep.obs[i]
+            elif i + 1 < n:
+                nxt = ep.obs[i + 1]
+            else:
+                continue  # fragment-cut live step: next obs unknown
+            obs.append(ep.obs[i])
+            actions.append(ep.actions[i])
+            rewards.append(ep.rewards[i])
+            next_obs.append(nxt)
+            # Q-targets bootstrap through time-limit TRUNCATION (next state
+            # exists, the env just stopped watching) but not TERMINATION —
+            # rllib's terminated/truncated distinction.
+            dones.append(float(terms[i]))
+    if not obs:
+        return {"obs": np.zeros((0,)), "actions": np.zeros((0,), np.int64),
+                "rewards": np.zeros((0,)), "next_obs": np.zeros((0,)),
+                "dones": np.zeros((0,))}
+    return {
+        "obs": np.asarray(obs, np.float32),
+        "actions": np.asarray(actions, np.int64),
+        "rewards": np.asarray(rewards, np.float32),
+        "next_obs": np.asarray(next_obs, np.float32),
+        "dones": np.asarray(dones, np.float32),
+    }
+
+
+
 def off_policy_train_iteration(algo) -> dict:
     """One iteration: collect a fragment per runner, push transitions to the
     buffer actor, run pipelined replay updates, sync weights. `algo` provides
     cfg/runners/buffer/learner/env_steps_total (duck-typed)."""
-    from ray_tpu.rllib.dqn import _episodes_to_transitions
-
     cfg = algo.cfg
     episodes = algo.runners.sample(cfg.rollout_fragment_length)
     algo.env_steps_total += sum(len(e) for e in episodes)

@@ -9,6 +9,7 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     run,
     shutdown,
+    start_grpc_proxy,
     start_http_proxy,
     status,
 )
@@ -30,7 +31,7 @@ from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment,
 
 __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
-    "run", "delete", "status", "shutdown", "start_http_proxy",
+    "run", "delete", "status", "shutdown", "start_http_proxy", "start_grpc_proxy",
     "get_deployment_handle", "build_openai_app",
     "PagedLLMConfig", "PagedLLMEngine",
     "batch", "DeploymentHandle", "ServeController",

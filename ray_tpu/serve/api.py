@@ -38,13 +38,15 @@ def _get_or_create_controller():
             # a new session started (possibly resumed from persistence):
             # cached handles point at the dead runtime; stop the old proxy so
             # its port is released instead of serving dead handles
-            old_proxy = _state.get("proxy")
-            if old_proxy is not None:
-                try:
-                    old_proxy.stop()
-                except Exception:
-                    pass
-            _state.update(controller=None, proxy=None, routes={}, _rt=rt)
+            for key in ("proxy", "grpc_proxy"):
+                old = _state.get(key)
+                if old is not None:
+                    try:
+                        old.stop()
+                    except Exception:
+                        pass
+            _state.update(controller=None, proxy=None, grpc_proxy=None,
+                          routes={}, _rt=rt)
         if _state["controller"] is None:
             try:
                 _state["controller"] = ray_tpu.get_actor(CONTROLLER_NAME)
@@ -131,6 +133,9 @@ def shutdown() -> None:
         if _state["proxy"] is not None:
             _state["proxy"].stop()
             _state["proxy"] = None
+        if _state.get("grpc_proxy") is not None:
+            _state["grpc_proxy"].stop()
+            _state["grpc_proxy"] = None
         _state["routes"] = {}
 
 
@@ -259,13 +264,7 @@ class HttpProxy:
         return resp
 
     def _match(self, path: str):
-        best = None
-        # snapshot: run()/delete() rebind the dict rather than mutating it
-        for prefix, handle in list(_state["routes"].items()):
-            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
-                if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, handle)
-        return best if best else (None, None)
+        return _match_route(path)
 
     def stop(self) -> None:
         if self._loop is None:
@@ -283,8 +282,30 @@ class HttpProxy:
             self._loop.call_soon_threadsafe(self._loop.stop)
 
 
+def _match_route(path: str):
+    """Longest-prefix route match over the session route table (shared by the
+    HTTP and gRPC ingresses — reference: proxy_router.py)."""
+    best = None
+    # snapshot: run()/delete() rebind the dict rather than mutating it
+    for prefix, handle in list(_state["routes"].items()):
+        if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+            if best is None or len(prefix) > len(best[0]):
+                best = (prefix, handle)
+    return best if best else (None, None)
+
+
 def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> HttpProxy:
     with _lock:
         if _state["proxy"] is None:
             _state["proxy"] = HttpProxy(host, port)
         return _state["proxy"]
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 9000):
+    """gRPC ingress next to HTTP (reference: gRPCProxy proxy.py:527)."""
+    from ray_tpu.serve.grpc_ingress import GrpcProxy
+
+    with _lock:
+        if _state.get("grpc_proxy") is None:
+            _state["grpc_proxy"] = GrpcProxy(host, port)
+        return _state["grpc_proxy"]

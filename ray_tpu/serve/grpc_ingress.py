@@ -1,0 +1,122 @@
+"""gRPC ingress for serve deployments.
+
+Parity: the reference's gRPCProxy (serve/_private/proxy.py:527) — a second
+ingress protocol next to HTTP, routing to the same deployment handles. The
+wire contract is proto-free (generic byte handlers, JSON payloads) so no
+protoc step is needed:
+
+- /ray_tpu.serve.Serve/Predict : unary-unary. Request bytes = JSON
+  {"route": "/prefix", "body": {...}}; response bytes = JSON result.
+- /ray_tpu.serve.Serve/Stream  : unary-stream. Same request; one JSON frame
+  per yielded item of the deployment's streaming method
+  (body["stream_method"], default "stream_tokens").
+
+Errors surface as gRPC status INTERNAL/NOT_FOUND with the message.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import ray_tpu
+
+SERVICE = "ray_tpu.serve.Serve"
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class GrpcProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        import grpc
+
+        self.host, self.port = host, port
+        self._grpc = grpc
+
+        def match(path: str):
+            from ray_tpu.serve.api import _match_route
+
+            return _match_route(path)
+
+        def parse(request: bytes, context):
+            try:
+                payload = json.loads(request)
+                if not isinstance(payload, dict):
+                    raise ValueError("request must be a JSON object")
+                return payload.get("route", "/"), payload.get("body", {})
+            except (json.JSONDecodeError, UnicodeDecodeError, ValueError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"invalid JSON request: {e}")
+
+        def predict(request: bytes, context) -> bytes:
+            route, body = parse(request, context)
+            prefix, handle = match(route)
+            if handle is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, f"no route for {route!r}")
+            try:
+                result = ray_tpu.get(handle.remote(body), timeout=120)
+                return json.dumps({"result": result}).encode()
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, str(e)[:500])
+
+        def stream(request: bytes, context):
+            route, body = parse(request, context)
+            prefix, handle = match(route)
+            if handle is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, f"no route for {route!r}")
+            method = body.get("stream_method", "stream_tokens")
+            it = handle.stream(body, method_name=method)
+            try:
+                for item in it:
+                    yield json.dumps({"item": item}).encode()
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, str(e)[:500])
+            finally:
+                it.close()
+
+        handlers = grpc.method_handlers_generic_handler(SERVICE, {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                predict, request_deserializer=_identity, response_serializer=_identity
+            ),
+            "Stream": grpc.unary_stream_rpc_method_handler(
+                stream, request_deserializer=_identity, response_serializer=_identity
+            ),
+        })
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=32))
+        self._server.add_generic_rpc_handlers((handlers,))
+        if self._server.add_insecure_port(f"{host}:{port}") == 0:
+            raise RuntimeError(f"gRPC proxy failed to bind {host}:{port}")
+        self._server.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+
+def grpc_predict(address: str, route: str, body: dict, timeout: float = 120.0) -> dict:
+    """Client helper for the proto-free contract."""
+    import grpc
+
+    with grpc.insecure_channel(address) as channel:
+        call = channel.unary_unary(
+            f"/{SERVICE}/Predict",
+            request_serializer=_identity, response_deserializer=_identity,
+        )
+        out = call(json.dumps({"route": route, "body": body}).encode(), timeout=timeout)
+    return json.loads(out)
+
+
+def grpc_stream(address: str, route: str, body: dict, timeout: float = 120.0):
+    import grpc
+
+    with grpc.insecure_channel(address) as channel:
+        call = channel.unary_stream(
+            f"/{SERVICE}/Stream",
+            request_serializer=_identity, response_deserializer=_identity,
+        )
+        for frame in call(json.dumps({"route": route, "body": body}).encode(),
+                          timeout=timeout):
+            yield json.loads(frame)

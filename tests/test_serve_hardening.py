@@ -160,3 +160,33 @@ def test_unhealthy_replica_replaced(session):
         os.unlink(marker)
     # the replacement is healthy and serving
     assert ray_tpu.get(handle.remote("back"), timeout=60) == "back"
+
+
+def test_grpc_ingress_predict_and_stream(session):
+    """gRPC ingress parity (reference: gRPCProxy proxy.py:527): unary predict
+    and server-streaming over the same route table as HTTP."""
+    from ray_tpu.serve.grpc_ingress import grpc_predict, grpc_stream
+
+    @serve.deployment(num_replicas=1)
+    class EchoPlus:
+        def __call__(self, body):
+            return {"sum": sum(body.get("xs", []))}
+
+        def counters(self, body):
+            yield from range(int(body.get("n", 3)))
+
+    serve.run(EchoPlus.bind(), route_prefix="/gx")
+    serve.start_grpc_proxy(port=19444)
+    out = grpc_predict("127.0.0.1:19444", "/gx", {"xs": [1, 2, 3]})
+    assert out == {"result": {"sum": 6}}
+
+    frames = list(grpc_stream("127.0.0.1:19444", "/gx",
+                              {"n": 4, "stream_method": "counters"}))
+    assert [f["item"] for f in frames] == [0, 1, 2, 3]
+
+    import grpc as _grpc
+    import pytest as _pytest
+
+    with _pytest.raises(_grpc.RpcError) as err:
+        grpc_predict("127.0.0.1:19444", "/nope", {})
+    assert err.value.code() == _grpc.StatusCode.NOT_FOUND
