@@ -64,16 +64,53 @@ class JaxTrainer(DataParallelTrainer):
 
     def fit(self) -> Result:
         if self.jax_config.distributed:
-            # Multi-host gangs need per-process workers (jax.distributed +
-            # MEGASCALE env, reference train/v2/jax/config.py:29-65). The
-            # single-controller runtime runs every worker in one process where
-            # jax.distributed.initialize cannot be called per-rank — fail loudly
-            # rather than silently training on a fraction of the mesh.
-            raise NotImplementedError(
-                "JaxConfig(distributed=True) requires the multi-process cluster "
-                "backend (multi-host). In single-controller mode express "
-                "parallelism as mesh axes instead (ray_tpu.parallel.make_mesh); "
-                "multislice env helpers: ray_tpu.parallel.mesh.multislice_env()."
-            )
+            return self._fit_distributed()
         self.train_loop_config["_jax_config"] = self.jax_config
         return super().fit()
+
+    def _fit_distributed(self) -> Result:
+        """Multi-process gang: each worker is an OS process that calls
+        jax.distributed.initialize against the rank-0 coordinator and runs the
+        user's loop over the GLOBAL mesh (reference: train/v2/jax/config.py:60;
+        MEGASCALE multislice env injected per worker for num_slices > 1).
+
+        The worker loop receives (rank, config) when it takes two args (the
+        gang contract), or just config for drop-in single-process loops; its
+        return value lands in Result.metrics["gang"] rank-ordered."""
+        import inspect
+
+        from ray_tpu.train.gang import run_jax_gang
+
+        loop = self.train_loop_per_worker
+        cfg = dict(self.train_loop_config)
+        cfg["_jax_config"] = self.jax_config
+        try:
+            wants_rank = len(inspect.signature(loop).parameters) >= 2
+        except (TypeError, ValueError):  # builtins/partials: assume config-only
+            wants_rank = False
+
+        def member(rank: int):
+            if wants_rank:
+                return loop(rank, cfg)
+            return loop(cfg)
+
+        try:
+            outs = run_jax_gang(
+                member,
+                num_workers=self.scaling_config.num_workers,
+                devices_per_worker=int(
+                    self.scaling_config.worker_resources().get("TPU", 0)
+                ) or 2,
+                use_tpu=self.scaling_config.use_tpu,
+                num_slices=self.jax_config.num_slices,
+                # the JaxConfig default port means "pick a free one" (gangs in
+                # one CI host must not collide); an explicit override is honored
+                coordinator_port=(
+                    self.jax_config.coordinator_port
+                    if self.jax_config.coordinator_port != JaxConfig.coordinator_port
+                    else None
+                ),
+            )
+        except Exception as e:  # noqa: BLE001
+            return Result(metrics={}, checkpoint=None, error=e)
+        return Result(metrics={"gang": outs}, checkpoint=None)

@@ -90,3 +90,29 @@ def test_gang_rank_failure_surfaces():
 
     with pytest.raises(Exception, match="rank 1"):
         run_jax_gang(boom, num_workers=2, devices_per_worker=1, timeout=300)
+
+
+def test_jax_trainer_distributed_gang():
+    """JaxConfig(distributed=True) activates the multi-process gang through
+    the trainer surface (reference: JaxTrainer + jax config.py:60)."""
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.train.config import JaxConfig, ScalingConfig
+
+    def loop(rank, config):
+        import jax
+
+        assert config["tag"] == "gang-run"
+        return {"rank": rank, "procs": jax.process_count(),
+                "devices": len(jax.devices())}
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"tag": "gang-run"},
+        scaling_config=ScalingConfig(num_workers=2),
+        jax_config=JaxConfig(distributed=True),
+    )
+    res = trainer.fit()
+    assert res.error is None, res.error
+    outs = res.metrics["gang"]
+    assert [o["rank"] for o in outs] == [0, 1]
+    assert all(o["procs"] == 2 and o["devices"] == 4 for o in outs)
