@@ -3,8 +3,8 @@
 Algorithms: PPO (on-policy, clipped surrogate + GAE), DQN (off-policy,
 double-Q + target network + replay buffer actor), and discrete SAC (twin Q
 critics, soft targets, learned temperature). The Algorithm/Learner/EnvRunner
-layering mirrors the reference's RLModule/Learner/EnvRunner split so further
-algorithms (IMPALA/APPO) slot into the same structure.
+layering mirrors the reference's RLModule/Learner/EnvRunner split; IMPALA
+(V-trace with stale-broadcast actors) rides the same EnvRunner fleet.
 """
 
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, Episode, SingleAgentEnvRunner
@@ -12,8 +12,9 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner
 
-__all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer", "SAC", "SACConfig", "SACLearner", "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]
+__all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer", "SAC", "SACConfig", "SACLearner", "IMPALA", "IMPALAConfig", "IMPALALearner", "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rec
 

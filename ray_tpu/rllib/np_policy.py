@@ -1,0 +1,34 @@
+"""Numpy-side actor-critic policy for env runners.
+
+Shared by PPO/IMPALA: env-runner actors evaluate the tiny MLP in numpy — no
+jit dispatch per env step, no traced functions shipped to actors (reference:
+env runners hold plain RLModule forward passes)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def np_mlp(layers, x: np.ndarray) -> np.ndarray:
+    """Forward the _mlp_init layer list in numpy (tanh hidden activations)."""
+    for i, layer in enumerate(layers):
+        x = x @ np.asarray(layer["w"]) + np.asarray(layer["b"])
+        if i < len(layers) - 1:
+            x = np.tanh(x)
+    return x
+
+
+def log_softmax(logits: np.ndarray) -> np.ndarray:
+    z = logits - logits.max(axis=-1, keepdims=True)
+    return z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
+
+
+def actor_critic_policy_fn(params, obs, rng):
+    """(action, logprob, value) from {"pi": layers, "vf": layers} params."""
+    logits = np_mlp(params["pi"], obs.astype(np.float64))
+    z = logits - logits.max()
+    p = np.exp(z)
+    p /= p.sum()
+    action = int(rng.choice(len(p), p=p))
+    v = np_mlp(params["vf"], obs.astype(np.float64))
+    return action, float(np.log(p[action] + 1e-12)), float(v[0])

@@ -145,25 +145,10 @@ class PPO:
         num_actions = int(probe.action_space.n)
         self.learner = PPOLearner(cfg, obs_dim, num_actions)
 
-        def policy_fn(params, obs, rng):
-            # numpy-side policy for env runners (no jit: tiny MLP, avoids
-            # shipping traced fns to actors); rng is the runner's own generator
-            # so thread-actors don't share global RNG state
-            x = obs.astype(np.float64)
-            for i, layer in enumerate(params["pi"]):
-                x = x @ np.asarray(layer["w"]) + np.asarray(layer["b"])
-                if i < len(params["pi"]) - 1:
-                    x = np.tanh(x)
-            z = x - x.max()
-            p = np.exp(z) / np.exp(z).sum()
-            action = int(rng.choice(len(p), p=p))
-            logprob = float(np.log(p[action] + 1e-12))
-            v = obs.astype(np.float64)
-            for i, layer in enumerate(params["vf"]):
-                v = v @ np.asarray(layer["w"]) + np.asarray(layer["b"])
-                if i < len(params["vf"]) - 1:
-                    v = np.tanh(v)
-            return action, logprob, float(v[0])
+        # numpy-side policy for env runners (no jit: tiny MLP, avoids
+        # shipping traced fns to actors); rng is the runner's own generator
+        # so thread-actors don't share global RNG state
+        from ray_tpu.rllib.np_policy import actor_critic_policy_fn as policy_fn
 
         self.runner_group = EnvRunnerGroup(env_creator, policy_fn, cfg.num_env_runners)
         self._iteration = 0

@@ -85,7 +85,14 @@ class JaxTrainer(DataParallelTrainer):
         cfg = dict(self.train_loop_config)
         cfg["_jax_config"] = self.jax_config
         try:
-            wants_rank = len(inspect.signature(loop).parameters) >= 2
+            # only REQUIRED positional params count: a defaulted second arg
+            # (e.g. checkpoint_dir=None) keeps the config-only calling shape
+            required = [
+                p for p in inspect.signature(loop).parameters.values()
+                if p.default is inspect.Parameter.empty
+                and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            ]
+            wants_rank = len(required) >= 2
         except (TypeError, ValueError):  # builtins/partials: assume config-only
             wants_rank = False
 
@@ -94,23 +101,29 @@ class JaxTrainer(DataParallelTrainer):
                 return loop(rank, cfg)
             return loop(cfg)
 
-        try:
-            outs = run_jax_gang(
-                member,
-                num_workers=self.scaling_config.num_workers,
-                devices_per_worker=int(
-                    self.scaling_config.worker_resources().get("TPU", 0)
-                ) or 2,
-                use_tpu=self.scaling_config.use_tpu,
-                num_slices=self.jax_config.num_slices,
-                # the JaxConfig default port means "pick a free one" (gangs in
-                # one CI host must not collide); an explicit override is honored
-                coordinator_port=(
-                    self.jax_config.coordinator_port
-                    if self.jax_config.coordinator_port != JaxConfig.coordinator_port
-                    else None
-                ),
-            )
-        except Exception as e:  # noqa: BLE001
-            return Result(metrics={}, checkpoint=None, error=e)
-        return Result(metrics={"gang": outs}, checkpoint=None)
+        # FailureConfig governs the distributed path like every other fit():
+        # a crashed gang restarts whole (gang semantics are all-or-nothing)
+        max_failures = self.run_config.failure_config.max_failures
+        last_err: BaseException | None = None
+        for attempt in range(max_failures + 1):
+            try:
+                outs = run_jax_gang(
+                    member,
+                    num_workers=self.scaling_config.num_workers,
+                    devices_per_worker=int(
+                        self.scaling_config.worker_resources().get("TPU", 0)
+                    ) or 2,
+                    use_tpu=self.scaling_config.use_tpu,
+                    num_slices=self.jax_config.num_slices,
+                    # the JaxConfig default port means "pick a free one" (CI
+                    # gangs must not collide); an explicit override is honored
+                    coordinator_port=(
+                        self.jax_config.coordinator_port
+                        if self.jax_config.coordinator_port != JaxConfig.coordinator_port
+                        else None
+                    ),
+                )
+                return Result(metrics={"gang": outs}, checkpoint=None)
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+        return Result(metrics={}, checkpoint=None, error=last_err)
