@@ -42,6 +42,10 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full": recompute the whole block in backward (max HBM savings);
+    # "dots": save matmul outputs, recompute only elementwise ops (the
+    # usual transformer sweet spot — ~5% extra FLOPs instead of ~33%).
+    remat_policy: str = "full"
 
     @property
     def hd(self) -> int:
@@ -241,7 +245,11 @@ def forward(params, tokens, cfg: LlamaConfig, attn_fn=None, positions=None):
         return _block(cfg, x, layer, positions, attn_fn), None
 
     if cfg.remat:
-        body = jax.checkpoint(body, prevent_cse=False)
+        if cfg.remat_policy not in ("full", "dots"):
+            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if cfg.remat_policy == "dots" else None)
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]

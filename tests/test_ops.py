@@ -32,6 +32,48 @@ def test_flash_gqa_broadcast():
     np.testing.assert_allclose(np.asarray(dense), np.asarray(flash), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grads_match_dense(causal):
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    B, S, H, D = 1, 96, 2, 16  # 96 also exercises the pad-to-block path
+    q = jax.random.normal(ks[0], (B, S, H, D))
+    k = jax.random.normal(ks[1], (B, S, H, D))
+    v = jax.random.normal(ks[2], (B, S, H, D))
+    cot = jax.random.normal(ks[3], (B, S, H, D))
+
+    def loss(fn, *args):
+        return jnp.sum(fn(*args) * cot)
+
+    dq_d, dk_d, dv_d = jax.grad(
+        lambda q, k, v: loss(lambda *a: llama.attention(*a, causal=causal), q, k, v),
+        argnums=(0, 1, 2))(q, k, v)
+    dq_f, dk_f, dv_f = jax.grad(
+        lambda q, k, v: loss(
+            lambda *a: flash_attention(*a, causal=causal, block_q=32, block_k=32),
+            q, k, v),
+        argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq_d), np.asarray(dq_f), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk_d), np.asarray(dk_f), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv_d), np.asarray(dv_f), atol=1e-4)
+
+
+def test_flash_grads_gqa():
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    B, S, D = 1, 64, 16
+    q = jax.random.normal(ks[0], (B, S, 8, D))
+    k = jax.random.normal(ks[1], (B, S, 2, D))
+    v = jax.random.normal(ks[2], (B, S, 2, D))
+
+    def mk(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_d = jax.grad(mk(lambda *a: llama.attention(*a, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    g_f = jax.grad(mk(lambda *a: flash_attention(*a, causal=True, block_q=32, block_k=32)),
+                   argnums=(0, 1, 2))(q, k, v)
+    for d, f in zip(g_d, g_f):
+        np.testing.assert_allclose(np.asarray(d), np.asarray(f), atol=2e-4)
+
+
 def test_flash_as_llama_attn_fn():
     cfg = llama.LlamaConfig.tiny()
     params = llama.init(cfg, jax.random.PRNGKey(0))
