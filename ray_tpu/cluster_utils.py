@@ -42,11 +42,17 @@ class Cluster:
                  slice_name: str | None = None,
                  ici_coords: tuple | None = None,
                  real_process: bool = False,
+                 isolated_plane: bool = False,
                  timeout: float = 60.0) -> NodeID:
         """Reference: cluster_utils.py:208 add_node."""
         res = {"CPU": float(num_cpus), **(resources or {})}
         if num_tpus:
             res["TPU"] = float(num_tpus)
+        if isolated_plane and not real_process:
+            raise ValueError(
+                "isolated_plane requires real_process=True (an in-process "
+                "node has no agent to host a node-local store)"
+            )
         rt = get_runtime()
         if real_process:
             from ray_tpu.core.cluster import start_node_agent
@@ -58,6 +64,7 @@ class Cluster:
                 rt.control_plane.address, rt.control_plane.token,
                 num_cpus=num_cpus, resources=resources, labels=labels,
                 slice_name=slice_name, ici_coords=ici_coords,
+                isolated_plane=isolated_plane,
             )
             deadline = time.monotonic() + timeout
             while time.monotonic() < deadline:

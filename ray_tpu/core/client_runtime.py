@@ -101,6 +101,12 @@ class ClientRuntime:
         self._shm_name, self._shm_size = shm_name, shm_size
         self._peer = None
         self._store = None
+        self._plane_client = None
+        # Which node's object plane this worker lives on (set by the node
+        # agent for isolated-plane nodes; empty on the head's shared plane).
+        self._node_bin = bytes.fromhex(os.environ["RAY_TPU_NODE_ID"]) \
+            if os.environ.get("RAY_TPU_NODE_ID") else None
+        self._plane_mode = os.environ.get("RAY_TPU_PLANE", "shared")
         self._lock = threading.Lock()
         self.is_shutdown = False
         self.reference_counter = _ClientRefCounter(self)
@@ -130,7 +136,8 @@ class ClientRuntime:
                     name=f"worker-{os.getpid()}",
                 )
                 self._peer.call("hello", token=self._token, kind="worker",
-                                pid=os.getpid(), timeout=10)
+                                pid=os.getpid(), node=self._node_bin,
+                                plane=self._plane_mode, timeout=10)
             return self._peer
 
     # ------------------------------------------------------------ pub/sub
@@ -173,6 +180,39 @@ class ClientRuntime:
         return self._store
 
     # ------------------------------------------------------------ objects
+    def _pull_remote(self, oid: ObjectID) -> "bytes | None":
+        """Local-store miss: ask the head directory for holders, chunk-pull
+        from one, and seed the local store with a secondary (unpinned) copy
+        (reference: PullManager pull into local plasma, pull_manager.h:52)."""
+        try:
+            pairs = self._rpc().call("locate_object", oid=oid.binary(), timeout=30)
+        except Exception:
+            return None
+        if not pairs:
+            return None
+        if self._plane_client is None:
+            from ray_tpu.core.object_plane import PlaneClient
+
+            self._plane_client = PlaneClient()
+
+        def report_stale(node_bin):
+            try:
+                self._rpc().notify("object_removed", oid=oid.binary(), node=node_bin)
+            except Exception:
+                pass
+
+        blob = self._plane_client.pull(pairs, oid, on_stale=report_stale)
+        if blob is None:
+            return None
+        store = self._shm()
+        if store is not None:
+            try:
+                store.put_bytes(oid, blob)
+                self._rpc().notify("object_added", oid=oid.binary(), size=len(blob))
+            except Exception:
+                pass  # local store full: serve this get from the pulled bytes
+        return blob
+
     def put(self, value: Any) -> ObjectRef:
         from ray_tpu._private.config import get_config
 
@@ -182,6 +222,10 @@ class ClientRuntime:
             try:
                 oid_bin = self._rpc().call("client_put_alloc", timeout=30)
                 store.put_bytes(ObjectID(oid_bin), blob)
+                if self._plane_mode == "isolated":
+                    # this node holds the primary: pin it locally (the head
+                    # only records the location; plane_free drops the pin)
+                    store.pin(ObjectID(oid_bin))
                 self._rpc().call("client_put_seal", oid=oid_bin, size=len(blob),
                                  timeout=30)
                 return ObjectRef(ObjectID(oid_bin), self)
@@ -209,6 +253,11 @@ class ClientRuntime:
                 store = self._shm()
                 view = store.get_bytes(ref.object_id()) if store is not None else None
                 if view is None:
+                    # not in this node's store: chunk-pull from a holder node
+                    blob = self._pull_remote(ref.object_id())
+                    if blob is not None:
+                        out.append(serialization.deserialize_from_bytes(blob))
+                        continue
                     # segment not attachable (or evicted between reply and read):
                     # re-fetch materialized through the head
                     (kind2, payload2), = self._rpc().call(
@@ -326,6 +375,8 @@ class ClientRuntime:
 
     def shutdown(self) -> None:
         self.is_shutdown = True
+        if self._plane_client is not None:
+            self._plane_client.close()
         if self._peer is not None:
             self._peer.close()
 

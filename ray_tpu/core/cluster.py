@@ -157,6 +157,9 @@ class ControlPlane:
             "client_stream_done": self._h_client_stream_done,
             "ref_add": self._h_ref_add,
             "ref_drop": self._h_ref_drop,
+            "locate_object": self._h_locate_object,
+            "object_added": self._h_object_added,
+            "object_removed": self._h_object_removed,
             "pubsub_publish": self._h_pubsub_publish,
             "pubsub_subscribe": self._h_pubsub_subscribe,
             "pubsub_unsubscribe": self._h_pubsub_unsubscribe,
@@ -176,6 +179,12 @@ class ControlPlane:
             raise PermissionError("bad control-plane token")
         peer.meta["auth"] = True
         peer.meta["kind"] = msg.get("kind", "client")
+        # Workers report which node's object plane they live on ("worker_node",
+        # distinct from the agent's "node_id" meta — a worker disconnect must
+        # not be mistaken for node death in _peer_gone).
+        if msg.get("node"):
+            peer.meta["worker_node"] = NodeID(msg["node"])
+        peer.meta["plane"] = msg.get("plane", "shared")
         return {"ok": True}
 
     def _h_register_node(self, peer: RpcPeer, msg: dict):
@@ -189,6 +198,10 @@ class ControlPlane:
         peer.meta["node_id"] = nid
         peer.meta["pid"] = msg.get("pid")
         rt._agents[nid] = peer
+        if msg.get("plane_addr"):
+            # isolated-object-plane node: its store is served at this endpoint
+            with rt._lock:
+                rt._plane_addrs[nid] = msg["plane_addr"]
         with self._hb_lock:
             self._hb[nid] = time.monotonic()
         rt.scheduler.retry_pending_pgs()
@@ -202,6 +215,30 @@ class ControlPlane:
             # head's LogMonitor tails them to the driver (log_monitor.py)
             "log_dir": rt.session_log_dir,
         }
+
+    # ---- object directory + transfer plane (reference: object_manager.cc
+    # pull protocol + OwnershipObjectDirectory, head-resident here)
+    def _h_locate_object(self, peer: RpcPeer, msg: dict):
+        return self.runtime.plane_holder_addrs(ObjectID(msg["oid"]))
+
+    def _h_object_added(self, peer: RpcPeer, msg: dict):
+        rt = self.runtime
+        oid = ObjectID(msg["oid"])
+        nid = peer.meta.get("worker_node") or peer.meta.get("node_id")
+        if peer.meta.get("plane") == "isolated" and nid is not None:
+            rt.plane_object_added(oid, nid)
+        elif rt.spill is not None and msg.get("size"):
+            # shared plane: the writer sealed into the head segment directly;
+            # account it for spill pressure tracking
+            rt.spill.on_put(oid, msg["size"])
+
+    def _h_object_removed(self, peer: RpcPeer, msg: dict):
+        # explicit node: a puller reporting a STALE directory entry (the
+        # holder answered "don't have it"); otherwise the sender's own node
+        nid = (NodeID(msg["node"]) if msg.get("node")
+               else peer.meta.get("worker_node") or peer.meta.get("node_id"))
+        if nid is not None:
+            self.runtime.plane_object_removed(ObjectID(msg["oid"]), nid)
 
     def _h_heartbeat(self, peer: RpcPeer, msg: dict):
         nid = peer.meta.get("node_id")
@@ -227,10 +264,12 @@ class ControlPlane:
             try:
                 if not msg.get("materialize"):
                     obj = rt.memory_store.get([oid], timeout=msg.get("get_timeout"))[0]
-                    if (
-                        obj.error is None and obj.in_shm
-                        and rt.shm_store is not None and rt.shm_store.contains(oid)
+                    if obj.error is None and obj.in_shm and (
+                        (rt.shm_store is not None and rt.shm_store.contains(oid))
+                        or rt.has_plane_copy(oid)
                     ):
+                        # in the object plane: the worker reads its node store
+                        # or chunk-pulls from a holder (locate_object)
                         out.append(("shm", None))
                         continue
                 val = rt.get([ref], timeout=msg.get("get_timeout"))[0]
@@ -253,15 +292,25 @@ class ControlPlane:
         return oid.binary()
 
     def _h_client_put_seal(self, peer: RpcPeer, msg: dict):
-        """The worker wrote the blob into the shared store itself (zero-copy
-        path); register the object with the head's directory and pin it."""
+        """The worker wrote the blob into its node's store itself (zero-copy
+        path); register the object with the head's directory.
+
+        Shared-plane workers sealed into the head segment: pin it as the
+        primary. Isolated-plane workers sealed (and pinned) into their node's
+        local store: record the location for chunk-pulls."""
         rt = self.runtime
         oid = ObjectID(msg["oid"])
         from ray_tpu.core.object_store import RayObject
 
-        rt.shm_store.pin(oid)
-        if rt.spill is not None:
-            rt.spill.on_put(oid, msg["size"])
+        if peer.meta.get("plane") == "isolated":
+            nid = peer.meta.get("worker_node")
+            if nid is None:
+                raise ValueError("isolated-plane worker did not report its node")
+            rt.plane_object_added(oid, nid)
+        else:
+            rt.shm_store.pin(oid)
+            if rt.spill is not None:
+                rt.spill.on_put(oid, msg["size"])
         rt.memory_store.put(oid, RayObject(size=msg["size"], in_shm=True))
         self._hold_for(peer, [ObjectRef(oid, rt)])
         return True
@@ -372,9 +421,12 @@ def start_node_agent(
     slice_name: str | None = None,
     ici_coords: tuple | None = None,
     name: str = "",
+    isolated_plane: bool = False,
 ) -> subprocess.Popen:
     """Spawn a node-agent OS process that joins the session (reference:
-    services.py:1610 start_raylet)."""
+    services.py:1610 start_raylet). ``isolated_plane=True`` gives the node its
+    own object store + transfer endpoint instead of mapping the head's segment
+    — the cross-host topology (objects then move via chunked pulls)."""
     from ray_tpu.core.process_pool import worker_env
 
     res = {"CPU": float(num_cpus), **(resources or {})}
@@ -385,6 +437,8 @@ def start_node_agent(
         "--resources", json.dumps(res),
         "--labels", json.dumps(labels or {}),
     ]
+    if isolated_plane:
+        cmd += ["--isolated-plane"]
     if slice_name:
         cmd += ["--slice-name", slice_name]
     if ici_coords:

@@ -5,8 +5,13 @@ with the head (GCS equivalent), heartbeats, runs the node's worker pool, and
 executes task dispatches pushed by the head's scheduler. Runs as
 `python -m ray_tpu.core.node_agent --head host:port --token ...`.
 
-Same-host agents share the session's shm object plane (zero-copy results/args);
-the protocol itself is host-agnostic.
+Object plane modes:
+- shared (default): same-host agents map the session's shm segment directly
+  (zero-copy results/args, the multi-raylet-one-machine test topology).
+- --isolated-plane: the node runs its OWN store + a chunked-transfer endpoint
+  (core/object_plane.py) — the cross-host topology, where objects move between
+  nodes via pulls (reference: per-node plasma + ObjectManager,
+  object_manager.cc:369).
 """
 
 from __future__ import annotations
@@ -27,12 +32,14 @@ def main() -> None:
     parser.add_argument("--slice-name", default=None)
     parser.add_argument("--ici-coords", default=None)
     parser.add_argument("--name", default="")
+    parser.add_argument("--isolated-plane", action="store_true")
     args = parser.parse_args()
 
     from ray_tpu.core.worker_main import _pin_worker_jax
 
     _pin_worker_jax()
 
+    from ray_tpu._private.ids import NodeID, ObjectID
     from ray_tpu.core import wire
     from ray_tpu.core.process_pool import (
         ProcessWorkerPool,
@@ -42,6 +49,25 @@ def main() -> None:
 
     host, _, port = args.head.rpartition(":")
     resources = json.loads(args.resources)
+
+    # Isolated object plane: node-local store + transfer endpoint, created
+    # before registration so the head learns the endpoint address with the
+    # node (reference: raylet starts plasma + object manager before
+    # announcing itself to the GCS).
+    local_store = None
+    plane_server = None
+    if args.isolated_plane:
+        from ray_tpu.core.object_plane import ObjectPlaneServer
+        from ray_tpu.core.shm_store import SharedMemoryStore
+
+        store_bytes = int(os.environ.get(
+            "RAY_TPU_PLANE_STORE_BYTES", str(256 * 1024 * 1024)))
+        local_store = SharedMemoryStore(
+            f"/rtpu_node_{os.getpid()}", size=store_bytes, owner=True)
+        # bind all interfaces: cross-host peers must be able to pull from us;
+        # the ADVERTISED host is filled in below from the control-plane
+        # socket's local address (the route other hosts can reach us on)
+        plane_server = ObjectPlaneServer(local_store, host="0.0.0.0")
 
     pool_box: dict = {}
 
@@ -56,8 +82,8 @@ def main() -> None:
             fn = wrap_with_runtime_env(cloudpickle.loads(fn_blob), msg["renv"])
             fn_blob = cloudpickle.dumps(fn)
         try:
-            return pool.execute_blob(fn_blob, msg["args"], msg.get("oid"),
-                                     task_bin=msg.get("task"))
+            status, payload, size = pool.execute_blob(
+                fn_blob, msg["args"], msg.get("oid"), task_bin=msg.get("task"))
         except _RemoteTaskError as e:
             # Unwrap so the ORIGINAL app exception type crosses the wire
             # (picklable) and head-side retry matching behaves like local tasks.
@@ -65,6 +91,26 @@ def main() -> None:
             if orig is not None:
                 raise orig from None
             raise RuntimeError(e.remote_tb) from None
+        if status == "shm" and local_store is not None:
+            # sealed into THIS node's store: pin the primary copy here and
+            # tell the head it's plane-resident (chunk-pullable)
+            local_store.pin(ObjectID(msg["oid"]))
+            return ("plane", payload, size)
+        return (status, payload, size)
+
+    def h_plane_free(peer, msg):
+        """Head dropped the last reference: free the node-held primary."""
+        if local_store is not None:
+            oid = ObjectID(msg["oid"])
+            try:
+                local_store.release(oid)
+            except Exception:
+                pass
+            try:
+                local_store.delete(oid)
+            except Exception:
+                pass
+        return True
 
     def h_kill_worker(peer, msg):
         return pool_box["pool"].kill_random_worker()
@@ -82,6 +128,7 @@ def main() -> None:
         host, int(port),
         handlers={
             "execute_task": h_execute_task,
+            "plane_free": h_plane_free,
             "kill_worker": h_kill_worker,
             "num_alive": h_num_alive,
             "ping": h_ping,
@@ -90,6 +137,10 @@ def main() -> None:
         name=f"agent-{os.getpid()}",
     )
     peer.call("hello", token=args.token, kind="agent", pid=os.getpid(), timeout=10)
+    plane_addr = None
+    if plane_server is not None:
+        _, plane_port = plane_server.server.address
+        plane_addr = f"{peer.local_address[0]}:{plane_port}"
     reg = peer.call(
         "register_node",
         resources=resources,
@@ -98,14 +149,24 @@ def main() -> None:
         ici_coords=tuple(json.loads(args.ici_coords)) if args.ici_coords else None,
         pid=os.getpid(),
         name=args.name,
+        plane_addr=plane_addr,
         timeout=10,
     )
+
+    if args.isolated_plane:
+        shm_name, shm_size = local_store.name, local_store.size
+        # workers of this node resolve/seal against the node-local store and
+        # identify their node to the head (worker_env() copies os.environ)
+        os.environ["RAY_TPU_NODE_ID"] = NodeID(reg["node_id"]).hex()
+        os.environ["RAY_TPU_PLANE"] = "isolated"
+    else:
+        shm_name, shm_size = reg.get("shm_name"), reg.get("shm_size") or 0
 
     num_workers = max(1, int(resources.get("CPU", 1)))
     pool_box["pool"] = ProcessWorkerPool(
         num_workers=num_workers,
-        shm_name=reg.get("shm_name"),
-        shm_size=reg.get("shm_size") or 0,
+        shm_name=shm_name,
+        shm_size=shm_size,
         head_addr=args.head,
         token=args.token,
         log_dir=reg.get("log_dir"),
@@ -126,6 +187,8 @@ def main() -> None:
             pool_box["pool"].shutdown()
         except Exception:
             pass
+        if plane_server is not None:
+            plane_server.close()
     sys.exit(0)
 
 

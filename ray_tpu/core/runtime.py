@@ -205,6 +205,25 @@ class Runtime:
             except Exception as e:  # pragma: no cover - toolchain missing
                 logger.warning("native shm store unavailable (%s); using memory store only", e)
 
+        # Object directory + transfer plane (reference: ObjectManager chunked
+        # push/pull object_manager.cc:369,536 + OwnershipObjectDirectory —
+        # here the directory is head-resident, single-controller style).
+        # _plane_locations: objects whose primary copy lives in a NODE-local
+        # store (isolated-plane agents); the head's own shm/spill holdings are
+        # covered by shm_store.contains/spill.is_spilled.
+        self._plane_locations: dict[ObjectID, set[NodeID]] = {}
+        self._plane_addrs: dict[NodeID, str] = {}
+        self.plane_server = None
+        self.plane_client = None
+        if self.shm_store is not None:
+            try:
+                from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+
+                self.plane_server = ObjectPlaneServer(self.shm_store, spill=self.spill)
+                self.plane_client = PlaneClient()
+            except Exception as e:  # pragma: no cover
+                logger.warning("object plane unavailable: %s", e)
+
         import os
 
         default_cpus = float(os.environ.get("RAY_TPU_NUM_CPUS", max(os.cpu_count() or 1, 8)))
@@ -339,6 +358,11 @@ class Runtime:
                     view = self.shm_store.get_bytes(oid) if self.shm_store else None
                     if view is not None:
                         return serialization.deserialize_from_bytes(view)
+                # Primary copy may live in a node-local store: chunk-pull it
+                # (reference: plasma miss -> Pull from remote ObjectManager).
+                blob = self._pull_from_plane(oid)
+                if blob is not None:
+                    return serialization.deserialize_from_bytes(blob)
                 # Evicted under memory pressure -> recover via lineage
                 # (reference: plasma miss -> FetchOrReconstruct, §3.2.7).
                 self.memory_store.delete([oid])
@@ -370,6 +394,7 @@ class Runtime:
                     if (
                         (self.shm_store is None or not self.shm_store.contains(oid))
                         and not (self.spill is not None and self.spill.is_spilled(oid))
+                        and not self.has_plane_copy(oid)
                     ):
                         self.memory_store.delete([oid])
                         lost = True
@@ -404,6 +429,7 @@ class Runtime:
             self.shm_store.delete(oid)
         if self.spill is not None:
             self.spill.on_delete(oid)  # GC the spill file too
+        self._free_plane_copies(oid)
         with self._lock:
             spec = self._lineage.pop(oid, None)
         if spec is not None:
@@ -419,6 +445,73 @@ class Runtime:
         if self.spill is not None:
             for r in refs:
                 self.spill.on_delete(r.object_id())
+        for r in refs:
+            self._free_plane_copies(r.object_id())
+
+    # ---------------------------------------------------- object plane
+    def plane_object_added(self, oid: ObjectID, node_id: NodeID) -> None:
+        with self._lock:
+            self._plane_locations.setdefault(oid, set()).add(node_id)
+
+    def plane_object_removed(self, oid: ObjectID, node_id: NodeID) -> None:
+        with self._lock:
+            holders = self._plane_locations.get(oid)
+            if holders is not None:
+                holders.discard(node_id)
+                if not holders:
+                    self._plane_locations.pop(oid, None)
+
+    def has_plane_copy(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return bool(self._plane_locations.get(oid))
+
+    def plane_holder_addrs(self, oid: ObjectID, include_head: bool = True) -> list:
+        """(node_bin|None, addr) pairs for object-plane endpoints currently
+        holding `oid` (directory lookup; reference: OwnershipObjectDirectory
+        location subscription). The node token lets pullers report stale
+        entries (holder evicted the copy) for directory invalidation."""
+        with self._lock:
+            nids = list(self._plane_locations.get(oid, ()))
+            pairs = [(n.binary(), self._plane_addrs[n]) for n in nids
+                     if n in self._plane_addrs]
+        if include_head and self.plane_server is not None and (
+            (self.shm_store is not None and self.shm_store.contains(oid))
+            or (self.spill is not None and self.spill.is_spilled(oid))
+        ):
+            pairs.append((None, self.plane_server.address))
+        return pairs
+
+    def _pull_from_plane(self, oid: ObjectID) -> "bytes | None":
+        """Chunk-pull a node-held object into the head's store (secondary,
+        unpinned copy — evictable; the holder keeps the pinned primary)."""
+        if self.plane_client is None:
+            return None
+        pairs = self.plane_holder_addrs(oid, include_head=False)
+        if not pairs:
+            return None
+        blob = self.plane_client.pull(
+            pairs, oid,
+            on_stale=lambda nb: self.plane_object_removed(oid, NodeID(nb)),
+        )
+        if blob is None:
+            return None
+        if self.shm_store is not None:
+            try:
+                self.shm_store.put_bytes(oid, blob)
+            except Exception:
+                pass  # store full: serve this get from the pulled bytes
+        return blob
+
+    def _free_plane_copies(self, oid: ObjectID) -> None:
+        with self._lock:
+            nids = self._plane_locations.pop(oid, set())
+        for nid in nids:
+            agent = self._agents.get(nid)
+            if agent is not None:
+                try:
+                    agent.notify("plane_free", oid=oid.binary())
+                except Exception:
+                    pass
 
     # ------------------------------------------------------------------ recovery
     def _recover_object(self, oid: ObjectID) -> None:
@@ -697,6 +790,14 @@ class Runtime:
         its in-flight dispatches fail with PeerDisconnected and retry onto
         surviving nodes (reference: node death -> task FT + lineage rebuild)."""
         self._agents.pop(node_id, None)
+        # Objects whose only copies lived on the dead node are now lost; the
+        # next access misses the directory and falls to lineage reconstruction.
+        with self._lock:
+            self._plane_addrs.pop(node_id, None)
+            for oid, holders in list(self._plane_locations.items()):
+                holders.discard(node_id)
+                if not holders:
+                    self._plane_locations.pop(oid, None)
         try:
             self.publisher.publish("nodes", {"node_id": node_id.hex(), "event": "dead"})
         except Exception:
@@ -731,8 +832,13 @@ class Runtime:
                 obj = self.memory_store.get_if_exists(oid)
                 if (
                     obj is not None and obj.error is None and obj.in_shm
-                    and self.shm_store is not None and self.shm_store.contains(oid)
+                    and (
+                        (self.shm_store is not None and self.shm_store.contains(oid))
+                        or self.has_plane_copy(oid)
+                    )
                 ):
+                    # In the object plane somewhere: the worker resolves it
+                    # from its node store, or pulls from a holder on miss.
                     return ShmArg(oid.binary())
                 return self.get([a])[0]
             return a
@@ -787,7 +893,18 @@ class Runtime:
             raise RuntimeError(e.remote_tb) from None
         self._store_worker_result(spec, rids, status, payload, size)
 
-    def _store_worker_result(self, spec, rids, status, payload, size) -> None:
+    def _store_worker_result(self, spec, rids, status, payload, size,
+                             node_id: "NodeID | None" = None) -> None:
+        if status == "plane":
+            # Result sealed+pinned in the executing node's local store (its
+            # primary copy); the head records the location and serves gets by
+            # chunk-pulling (reference: task return stays in the executing
+            # node's plasma; the owner tracks its location).
+            self.plane_object_added(rids[0], node_id)
+            self.memory_store.put(rids[0], RayObject(size=size or 0, in_shm=True))
+            with self._lock:
+                self._recovering.discard(rids[0])
+            return
         if status == "shm":
             # worker already sealed the result into the node store (zero-copy handoff)
             self.shm_store.pin(rids[0])
@@ -838,7 +955,8 @@ class Runtime:
             )
         except PeerDisconnected as e:
             raise ActorError(f"node agent died during task: {e}") from e
-        self._store_worker_result(spec, rids, status, payload, size)
+        self._store_worker_result(spec, rids, status, payload, size,
+                                  node_id=entry.node_id)
 
     def _run_user_fn(self, entry: _TaskEntry, fn, args, kwargs):
         if entry.cancelled:
@@ -1595,6 +1713,12 @@ class Runtime:
                 self.control_plane.close()
             except Exception:
                 pass
+        for plane in (self.plane_server, self.plane_client):
+            if plane is not None:
+                try:
+                    plane.close()
+                except Exception:
+                    pass
         pool = getattr(self, "_proc_pool", None)
         if pool is not None:
             try:

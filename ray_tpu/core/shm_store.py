@@ -73,6 +73,7 @@ class SharedMemoryStore:
                  owner: bool = False):
         self._lib = _Lib.get()
         self.name = name
+        self.size = size
         self.owner = owner
         self._handle = self._lib.shm_store_create(name.encode(), size, table_cap)
         if not self._handle:

@@ -75,6 +75,19 @@ class RpcPeer:
     # --- outbound ---
     def call(self, op: str, timeout: float | None = None, **payload) -> Any:
         """Request/response; raises the handler's exception or PeerDisconnected."""
+        mid, fut = self.call_async(op, **payload)
+        try:
+            return fut.result(timeout=timeout)
+        finally:
+            with self._plock:
+                self._pending.pop(mid, None)
+
+    def call_async(self, op: str, **payload) -> tuple[int, Future]:
+        """Fire a request and return (id, Future) without blocking — lets a
+        caller keep a window of requests in flight (the object plane pipelines
+        chunk fetches this way, like the reference's windowed chunked pulls,
+        object_manager.cc:536). Caller must pop self._pending[id] via
+        finish_call() when done."""
         mid = next(self._ids)
         fut: Future = Future()
         with self._plock:
@@ -83,10 +96,17 @@ class RpcPeer:
             self._pending[mid] = fut
         try:
             self._send({"op": op, "id": mid, **payload})
-            return fut.result(timeout=timeout)
-        finally:
+        except BaseException:
+            # e.g. frame-too-large ValueError: the request never left, so the
+            # pending future would otherwise leak for the connection's life
             with self._plock:
                 self._pending.pop(mid, None)
+            raise
+        return mid, fut
+
+    def finish_call(self, mid: int) -> None:
+        with self._plock:
+            self._pending.pop(mid, None)
 
     def notify(self, op: str, **payload) -> None:
         """One-way message (no reply expected)."""
@@ -174,6 +194,12 @@ class RpcPeer:
     @property
     def closed(self) -> bool:
         return self._closed
+
+    @property
+    def local_address(self) -> tuple:
+        """(host, port) of this end of the connection — the routable address
+        peers on the remote side could reach this host at."""
+        return self._sock.getsockname()
 
     def close(self) -> None:
         self._fail(PeerDisconnected(f"{self.name} closed locally"))
