@@ -226,8 +226,19 @@ class ClientRuntime:
                     # this node holds the primary: pin it locally (the head
                     # only records the location; plane_free drops the pin)
                     store.pin(ObjectID(oid_bin))
-                self._rpc().call("client_put_seal", oid=oid_bin, size=len(blob),
-                                 timeout=30)
+                try:
+                    self._rpc().call("client_put_seal", oid=oid_bin,
+                                     size=len(blob), timeout=30)
+                except BaseException:
+                    # head never recorded it -> plane_free will never come;
+                    # drop the local copy or the pin leaks store capacity
+                    if self._plane_mode == "isolated":
+                        try:
+                            store.release(ObjectID(oid_bin))
+                            store.delete(ObjectID(oid_bin))
+                        except Exception:
+                            pass
+                    raise
                 return ObjectRef(ObjectID(oid_bin), self)
             except Exception:
                 # Store full of pinned objects (or the alloc'd entry is

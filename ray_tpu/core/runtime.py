@@ -219,7 +219,12 @@ class Runtime:
             try:
                 from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
 
-                self.plane_server = ObjectPlaneServer(self.shm_store, spill=self.spill)
+                # bind + advertise on the control plane's host: loopback for
+                # single-host sessions, the routable address for multi-host
+                # (remote isolated-plane nodes must be able to dial back here)
+                self.plane_server = ObjectPlaneServer(
+                    self.shm_store, host=config.control_plane_host,
+                    spill=self.spill)
                 self.plane_client = PlaneClient()
             except Exception as e:  # pragma: no cover
                 logger.warning("object plane unavailable: %s", e)

@@ -301,15 +301,17 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
 
 
 def init_kv_pool(cfg: LlamaConfig, num_blocks: int, block_size: int) -> dict:
-    """Paged KV pool: [L, N_blocks, block_size, Hkv, D] per k/v.
+    """Paged KV pool: [L, Hkv, N_blocks, block_size, D] per k/v.
 
     Unlike the dense per-slot cache (init_kv_cache), HBM is allocated in
     block_size-token pages handed out on demand by a host-side allocator
     (serve/paged_kv.py), so memory scales with ACTUAL tokens, full prefix
     blocks are shareable across sequences, and capacity admits many short
     sequences or few long ones interchangeably (vLLM paged-KV semantics,
-    which the reference delegates to vLLM — here native)."""
-    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.hd)
+    which the reference delegates to vLLM — here native). Head-major so a
+    (head, block) pair is one contiguous page tile for the pallas decode
+    kernel (ops/paged_attention.py)."""
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size, cfg.hd)
     return {
         "k": jnp.zeros(shape, dtype=cfg.dtype),
         "v": jnp.zeros(shape, dtype=cfg.dtype),
@@ -317,17 +319,20 @@ def init_kv_pool(cfg: LlamaConfig, num_blocks: int, block_size: int) -> dict:
 
 
 def forward_paged(params, tokens, cfg: LlamaConfig, pool: dict, tables, lengths,
-                  block_size: int):
+                  block_size: int, use_kernel: bool | None = None):
     """Cached forward over a PAGED pool. tokens [B,S] append at positions
     [lengths, lengths+S); tables [B, max_blocks] map sequence-block index ->
     pool block id. Returns (logits [B,S,V], updated pool).
 
-    New K/V scatter into their pages ([B,S]-indexed .at[] scatter); attention
-    reads a gathered per-sequence view (pool[tables] — the transient gather
-    is the same traffic dense attention reads anyway; a pallas kernel that
-    indexes pages in-place is the planned upgrade per PAPERS.md)."""
+    New K/V scatter into their pages ([B,S]-indexed .at[] scatter). The
+    decode step (S==1) runs the pallas paged-attention kernel on TPU —
+    pages are read in place via the scalar-prefetched block table
+    (ops/paged_attention.py). Prefill (and non-TPU fallback) reads a
+    gathered per-sequence view (pool[:, tables])."""
     B, S = tokens.shape
     max_blocks = tables.shape[1]
+    if use_kernel is None:
+        use_kernel = S == 1 and jax.devices()[0].platform in ("tpu", "axon")
     positions = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     seq_blk = positions // block_size
     # Pad positions past the table (bucketed prefill of a near-full sequence)
@@ -341,18 +346,27 @@ def forward_paged(params, tokens, cfg: LlamaConfig, pool: dict, tables, lengths,
     hd, nh, nkv = cfg.hd, cfg.num_heads, cfg.num_kv_heads
 
     def body(x, layer_and_pool):
-        layer, kp, vp = layer_and_pool
+        layer, kp, vp = layer_and_pool  # kp/vp: [Hkv, NB, BS, D]
         y = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
         q = (y @ layer["wq"]).reshape(B, S, nh, hd)
         k = (y @ layer["wk"]).reshape(B, S, nkv, hd)
         v = (y @ layer["wv"]).reshape(B, S, nkv, hd)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        kp = kp.at[blk_idx, blk_off].set(k.astype(kp.dtype))
-        vp = vp.at[blk_idx, blk_off].set(v.astype(vp.dtype))
-        k_seq = kp[tables].reshape(B, max_blocks * block_size, nkv, hd)
-        v_seq = vp[tables].reshape(B, max_blocks * block_size, nkv, hd)
-        o = _cached_attention(q, k_seq, v_seq, lengths, positions)
+        # head-major scatter: kp[h, blk_idx[b,s], blk_off[b,s]] = k[b,s,h]
+        kp = kp.at[:, blk_idx, blk_off].set(k.transpose(2, 0, 1, 3).astype(kp.dtype))
+        vp = vp.at[:, blk_idx, blk_off].set(v.transpose(2, 0, 1, 3).astype(vp.dtype))
+        if use_kernel:
+            from ray_tpu.ops.paged_attention import paged_decode_attention
+
+            o = paged_decode_attention(
+                q[:, 0], kp, vp, tables, lengths + 1)[:, None]  # [B,1,Hq,D]
+        else:
+            k_seq = kp[:, tables].transpose(1, 2, 3, 0, 4).reshape(
+                B, max_blocks * block_size, nkv, hd)
+            v_seq = vp[:, tables].transpose(1, 2, 3, 0, 4).reshape(
+                B, max_blocks * block_size, nkv, hd)
+            o = _cached_attention(q, k_seq, v_seq, lengths, positions)
         x = x + (o.reshape(B, S, nh * hd) @ layer["wo"])
         y = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu(y @ layer["w_gate"])

@@ -286,8 +286,8 @@ class PagedLLMEngine(LLMEngine):
             first_tok = self._sample(np.asarray(logits)[len(prompt_ids) - 1])
             idx = np.asarray(block_ids, dtype=np.int32)
             kv = {
-                "k": np.asarray(self.pool["k"][:, idx]),  # [L, n, bs, H, D]
-                "v": np.asarray(self.pool["v"][:, idx]),
+                "k": np.asarray(self.pool["k"][:, :, idx]),  # [L, H, n, bs, D]
+                "v": np.asarray(self.pool["v"][:, :, idx]),
             }
         finally:
             self.allocator.free(block_ids)
@@ -319,14 +319,14 @@ class PagedLLMEngine(LLMEngine):
             # decode side saturated: requeue the op for a later pass
             self._ops.put(("attach", payload, fut))
             return
-        n_prefill_blocks = handoff["kv"]["k"].shape[1]
+        n_prefill_blocks = handoff["kv"]["k"].shape[2]
         total_blocks = -(-(prompt_len + max_new_tokens) // bs)
         block_ids = self.allocator.alloc(total_blocks)
         try:
             idx = np.asarray(block_ids[:n_prefill_blocks], dtype=np.int32)
-            self.pool["k"] = self.pool["k"].at[:, idx].set(
+            self.pool["k"] = self.pool["k"].at[:, :, idx].set(
                 jnp.asarray(handoff["kv"]["k"]))
-            self.pool["v"] = self.pool["v"].at[:, idx].set(
+            self.pool["v"] = self.pool["v"].at[:, :, idx].set(
                 jnp.asarray(handoff["kv"]["v"]))
             with self._lock:
                 st = _Slot(fut, max_new_tokens, prompt_len, time.monotonic())
