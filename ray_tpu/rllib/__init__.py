@@ -13,8 +13,33 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner
+from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rllib.offline import (
+    BC,
+    CQL,
+    MARWIL,
+    BCConfig,
+    CQLConfig,
+    MARWILConfig,
+    load_offline_data,
+    write_offline_json,
+)
 
-__all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer", "SAC", "SACConfig", "SACLearner", "IMPALA", "IMPALAConfig", "IMPALALearner", "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]
+__all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
+           "ReplayBuffer", "SAC", "SACConfig", "SACLearner",
+           "IMPALA", "IMPALAConfig", "IMPALALearner",
+           "APPO", "APPOConfig", "APPOLearner",
+           "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
+           "load_offline_data", "write_offline_json",
+           "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
+           "MultiAgentPPOConfig",
+           "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rec
 

@@ -1,0 +1,128 @@
+"""APPO on JAX: asynchronous PPO (IMPALA architecture, PPO surrogate).
+
+Parity: rllib/algorithms/appo/ — the actor-learner decoupling and stale weight
+broadcasts of IMPALA, with the clipped PPO surrogate applied to V-trace
+corrected advantages and multiple SGD epochs per collected batch. Where IMPALA
+does one plain policy-gradient step per batch, APPO re-uses each batch for
+several clipped updates (the clip keeps the re-use stable even off-policy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ray_tpu.rllib.env_runner import Episode
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.ppo import _mlp_apply, _mlp_init
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    """Fluent surface mirrors the reference's APPOConfig."""
+
+    clip_param: float = 0.3
+    num_epochs: int = 2
+    minibatch_size: int = 256
+    lr: float = 3e-3
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPOLearner:
+    """Policy + value nets; jitted clipped-surrogate update on V-trace targets."""
+
+    def __init__(self, cfg: APPOConfig, obs_dim: int, num_actions: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        kp, kv = jax.random.split(key)
+        self.params = {
+            "pi": _mlp_init(kp, (obs_dim, *cfg.hidden, num_actions)),
+            "vf": _mlp_init(kv, (obs_dim, *cfg.hidden, 1)),
+        }
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(5.0), optax.adam(cfg.lr)
+        )
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, obs, actions, behavior_logp, vs_targets, advantages):
+            logits = _mlp_apply(params["pi"], obs, jnp)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+            # clipped surrogate vs the BEHAVIOR policy (the stale actor
+            # weights) — the asynchronous analog of PPO's old-policy ratio
+            ratio = jnp.exp(logp - behavior_logp)
+            clipped = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param)
+            pg_loss = -jnp.minimum(ratio * advantages, clipped * advantages).mean()
+            values = _mlp_apply(params["vf"], obs, jnp)[:, 0]
+            vf_loss = ((values - vs_targets) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(axis=1).mean()
+            total = pg_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
+            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch["obs"], batch["actions"], batch["behavior_logp"],
+                batch["vs_targets"], batch["advantages"],
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._update = jax.jit(update)
+        self._jnp = jnp
+
+    def update(self, batch: dict) -> dict:
+        jnp = self._jnp
+        b = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.int32),
+            "behavior_logp": jnp.asarray(batch["behavior_logp"], jnp.float32),
+            "vs_targets": jnp.asarray(batch["vs_targets"], jnp.float32),
+            "advantages": jnp.asarray(batch["advantages"], jnp.float32),
+        }
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, b
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+
+class APPO(IMPALA):
+    """IMPALA's collection loop; PPO-clipped multi-epoch learner."""
+
+    def _make_learner(self, obs_dim: int, num_actions: int):
+        return APPOLearner(self.cfg, obs_dim, num_actions)
+
+    def _episode_batch(self, episodes: list[Episode]) -> dict:
+        batch = super()._episode_batch(episodes)
+        # the surrogate ratio needs the behavior (actor-side) logprobs
+        batch["behavior_logp"] = np.concatenate(
+            [np.asarray(ep.logprobs, np.float32) for ep in episodes if len(ep)]
+        )
+        return batch
+
+    def _update_from_batch(self, batch: dict) -> dict:
+        """Multi-epoch clipped minibatch SGD over the collected batch —
+        IMPALA's train() loop (sampling, broadcasts, metrics) is inherited.
+        Full minibatches only: a variable-size tail would retrace the jitted
+        update (same guard as ppo.py's epoch loop)."""
+        cfg = self.cfg
+        n = len(batch["obs"])
+        rng = np.random.default_rng(cfg.seed + self.iterations)
+        mb = min(cfg.minibatch_size, n)
+        metrics: dict = {}
+        for _ in range(cfg.num_epochs):
+            order = rng.permutation(n)
+            for lo in range(0, n - mb + 1, mb):
+                idx = order[lo:lo + mb]
+                metrics = self.learner.update(
+                    {k: v[idx] for k, v in batch.items()})
+        return metrics

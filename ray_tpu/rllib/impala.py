@@ -155,7 +155,7 @@ class IMPALA:
         env_creator = (cfg.env if callable(cfg.env)
                        else (lambda name=cfg.env: gym.make(name)))
         obs_dim, num_actions = probe_env_spaces(env_creator)
-        self.learner = IMPALALearner(cfg, obs_dim, num_actions)
+        self.learner = self._make_learner(obs_dim, num_actions)
         self.env_steps_total = 0
         self.iterations = 0
 
@@ -166,6 +166,10 @@ class IMPALA:
         self.runners = EnvRunnerGroup(env_creator, policy_fn,
                                       num_runners=cfg.num_env_runners)
         self.runners.sync_weights(self.learner.params)
+
+    def _make_learner(self, obs_dim: int, num_actions: int):
+        """Subclass hook (APPO swaps in its clipped-surrogate learner)."""
+        return IMPALALearner(self.cfg, obs_dim, num_actions)
 
     def _episode_batch(self, episodes: list[Episode]) -> dict:
         cfg = self.cfg
@@ -204,12 +208,17 @@ class IMPALA:
             "advantages": np.concatenate(adv_all).astype(np.float32),
         }
 
+    def _update_from_batch(self, batch: dict) -> dict:
+        """Subclass hook: one plain update here; APPO does clipped
+        multi-epoch minibatch SGD over the same batch."""
+        return self.learner.update(batch)
+
     def train(self) -> dict:
         cfg = self.cfg
         episodes = self.runners.sample(cfg.rollout_fragment_length)
         self.env_steps_total += sum(len(e) for e in episodes)
         batch = self._episode_batch(episodes)
-        metrics = self.learner.update(batch) if len(batch["obs"]) else {}
+        metrics = self._update_from_batch(batch) if len(batch["obs"]) else {}
         self.iterations += 1
         # stale-broadcast: actors keep collecting with old weights between
         # broadcasts — the off-policy gap V-trace corrects

@@ -1,0 +1,229 @@
+"""Multi-agent RL: MultiAgentEnv + per-policy learners over one env fleet.
+
+Parity: rllib/env/multi_agent_env.py (the dict-keyed env API with the
+"__all__" done convention), multi_agent_env_runner.py:73 (per-agent episode
+collection) and the policies/policy_mapping_fn config surface
+(algorithm_config.multi_agent()). Each policy gets its own PPOLearner; one
+shared EnvRunner fleet collects dict-keyed steps and routes each agent's
+trajectory to its mapped policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import Episode
+from ray_tpu.rllib.ppo import PPOConfig, PPOLearner
+
+
+class MultiAgentEnv:
+    """Dict-keyed env ABC (reference: env/multi_agent_env.py).
+
+    reset() -> (obs_dict, info_dict)
+    step(action_dict) -> (obs, rewards, terminateds, truncateds, infos),
+    each keyed by agent id; terminateds/truncateds carry "__all__".
+    """
+
+    possible_agents: list = []
+
+    def reset(self, seed=None):
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class MultiAgentEnvRunner:
+    """One actor stepping a MultiAgentEnv; per-agent Episode segmentation
+    (reference: multi_agent_env_runner.py:73)."""
+
+    def __init__(self, env_creator: Callable, policy_fn: Callable,
+                 policy_mapping: dict, seed: int = 0):
+        self.env = env_creator()
+        self.policy_fn = policy_fn  # (params, obs, rng) -> (action, logp, value)
+        self.policy_mapping = policy_mapping  # agent_id -> policy_id
+        self.weights = {}  # policy_id -> params
+        self.rng = np.random.default_rng(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        # live episodes' reward from PRIOR fragments, per agent (the
+        # single-agent runner's _carry_reward, here dict-keyed)
+        self._carry: dict = {}
+
+    def set_weights(self, weights: dict) -> None:
+        self.weights = weights
+
+    def sample(self, num_steps: int) -> dict:
+        """~num_steps env steps; returns {policy_id: [Episode, ...]}."""
+        out: dict[str, list[Episode]] = {}
+        eps: dict[str, Episode] = {}  # live episode per agent
+        steps = 0
+        while steps < num_steps:
+            actions, logps, values = {}, {}, {}
+            for aid, obs in self._obs.items():
+                pid = self.policy_mapping[aid]
+                a, lp, v = self.policy_fn(
+                    self.weights[pid], np.asarray(obs, np.float64), self.rng)
+                actions[aid], logps[aid], values[aid] = a, lp, v
+            nxt, rews, terms, truncs, _ = self.env.step(actions)
+            for aid in actions:
+                ep = eps.setdefault(
+                    aid, Episode(reward_offset=self._carry.get(aid, 0.0)))
+                done = bool(terms.get(aid) or truncs.get(aid)
+                            or terms.get("__all__") or truncs.get("__all__"))
+                ep.obs.append(np.asarray(self._obs[aid]))
+                ep.actions.append(actions[aid])
+                ep.rewards.append(float(rews.get(aid, 0.0)))
+                ep.logprobs.append(logps[aid])
+                ep.values.append(values[aid])
+                ep.dones.append(done)
+                ep.terminateds.append(bool(terms.get(aid) or terms.get("__all__")))
+            steps += 1
+            if terms.get("__all__") or truncs.get("__all__"):
+                for aid, ep in eps.items():
+                    out.setdefault(self.policy_mapping[aid], []).append(ep)
+                eps = {}
+                self._carry = {}
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        # fragment boundary: bootstrap live episodes with V(current obs) and
+        # carry their reward-so-far into the next fragment's Episode
+        for aid, ep in eps.items():
+            if len(ep):
+                pid = self.policy_mapping[aid]
+                if aid in self._obs:
+                    _, _, ep.bootstrap_value = self.policy_fn(
+                        self.weights[pid],
+                        np.asarray(self._obs[aid], np.float64), self.rng)
+                self._carry[aid] = ep.total_reward()
+                out.setdefault(pid, []).append(ep)
+        return out
+
+    def ping(self) -> str:
+        return "ok"
+
+
+@dataclasses.dataclass
+class MultiAgentPPOConfig(PPOConfig):
+    """policies: {policy_id: (obs_dim, num_actions)};
+    policy_mapping: {agent_id: policy_id} (the reference's policy_mapping_fn,
+    tabulated — mappings here are static per agent id)."""
+
+    policies: dict = dataclasses.field(default_factory=dict)
+    policy_mapping: dict = dataclasses.field(default_factory=dict)
+
+    def multi_agent(self, policies: dict, policy_mapping: dict) -> "MultiAgentPPOConfig":
+        self.policies = policies
+        self.policy_mapping = policy_mapping
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """Independent PPO per policy over a shared multi-agent env fleet."""
+
+    def __init__(self, cfg: MultiAgentPPOConfig):
+        if not cfg.policies or not cfg.policy_mapping:
+            raise ValueError("multi_agent(policies=..., policy_mapping=...) required")
+        if not callable(cfg.env):
+            raise ValueError("MultiAgentPPO needs an env_creator callable")
+        self.cfg = cfg
+        self.learners = {
+            pid: PPOLearner(cfg, obs_dim, num_actions)
+            for pid, (obs_dim, num_actions) in cfg.policies.items()
+        }
+        from ray_tpu.rllib.np_policy import actor_critic_policy_fn
+
+        runner_cls = ray_tpu.remote(num_cpus=1, max_concurrency=2)(MultiAgentEnvRunner)
+        self.runners = [
+            runner_cls.remote(cfg.env, actor_critic_policy_fn,
+                              cfg.policy_mapping, seed=i)
+            for i in range(cfg.num_env_runners)
+        ]
+        self._iteration = 0
+        self._sync()
+
+    def _np_weights(self) -> dict:
+        return {
+            pid: {k: [{kk: np.asarray(vv) for kk, vv in layer.items()}
+                      for layer in v]
+                  for k, v in ln.params.items()}
+            for pid, ln in self.learners.items()
+        }
+
+    def _sync(self) -> None:
+        w = self._np_weights()
+        ray_tpu.get([r.set_weights.remote(w) for r in self.runners])
+
+    def train(self) -> dict:
+        from ray_tpu.rllib.ppo import gae
+
+        cfg = self.cfg
+        self._sync()
+        per_policy: dict[str, list[Episode]] = {}
+        for batch in ray_tpu.get(
+            [r.sample.remote(cfg.rollout_fragment_length) for r in self.runners]
+        ):
+            for pid, eps in batch.items():
+                per_policy.setdefault(pid, []).extend(eps)
+        metrics: dict = {}
+        rewards_all = []
+        for pid, episodes in per_policy.items():
+            obs, actions, logprobs, advs, rets = [], [], [], [], []
+            for ep in episodes:
+                if not len(ep):
+                    continue
+                a, r = gae(cfg, ep)
+                obs.extend(ep.obs)
+                actions.extend(ep.actions)
+                logprobs.extend(ep.logprobs)
+                advs.extend(a)
+                rets.extend(r)
+            if not obs:
+                continue
+            advs = np.asarray(advs, np.float32)
+            advs = (advs - advs.mean()) / (advs.std() + 1e-8)
+            batch = {
+                "obs": np.asarray(obs, np.float32),
+                "actions": np.asarray(actions, np.int32),
+                "logprobs": np.asarray(logprobs, np.float32),
+                "advantages": advs,
+                "returns": np.asarray(rets, np.float32),
+            }
+            # minibatch SGD, full minibatches only (ppo.py's retrace guard)
+            n = len(batch["obs"])
+            mb = min(cfg.minibatch_size, n)
+            idx = np.arange(n)
+            m = {}
+            for _ in range(cfg.num_epochs):
+                np.random.shuffle(idx)
+                for lo in range(0, n - mb + 1, mb):
+                    sel = idx[lo:lo + mb]
+                    m = self.learners[pid].update(
+                        {k: v[sel] for k, v in batch.items()})
+            metrics[pid] = m
+            finished = [e for e in episodes if e.dones and e.dones[-1]]
+            rewards_all += [e.total_reward() for e in finished]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": (float(np.mean(rewards_all))
+                                    if rewards_all else float("nan")),
+            "policies": metrics,
+        }
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

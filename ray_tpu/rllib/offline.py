@@ -1,0 +1,321 @@
+"""Offline RL: train from recorded experience, no environment interaction.
+
+Parity: rllib/offline/ (OfflineData over Ray Data) + the offline algorithm
+family — BC (algorithms/bc/), MARWIL (algorithms/marwil/), and CQL
+(algorithms/cql/, discrete variant). Datasets are JSONL/parquet transition
+rows read through ray_tpu.data (the reference reads SampleBatches through
+Ray Data the same way), or numpy dicts passed directly.
+
+Row schema: {"obs": [...], "action": int, "reward": float,
+"next_obs": [...], "done": 0/1}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.ppo import _mlp_apply, _mlp_init
+
+
+# ------------------------------------------------------------------ data
+def write_offline_json(transitions: dict, path: str) -> int:
+    """Write a transition batch (numpy dict) as JSONL rows; returns row count."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    n = len(transitions["obs"])
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "obs": np.asarray(transitions["obs"][i]).tolist(),
+                "action": int(transitions["actions"][i]),
+                "reward": float(transitions["rewards"][i]),
+                "next_obs": np.asarray(transitions["next_obs"][i]).tolist(),
+                "done": float(transitions["dones"][i]),
+            }) + "\n")
+    return n
+
+
+def load_offline_data(source: Any) -> dict:
+    """Normalize an offline source into a numpy transition dict.
+
+    Accepts a numpy dict, a JSONL path, or a ray_tpu.data Dataset of rows
+    (reference: OfflineData wraps Ray Data datasets, offline/offline_data.py)."""
+    if isinstance(source, dict):
+        return source
+    if isinstance(source, str):
+        from ray_tpu import data
+
+        source = data.read_json(source)
+    rows = source.take_all() if hasattr(source, "take_all") else list(source)
+    return {
+        "obs": np.asarray([r["obs"] for r in rows], np.float32),
+        "actions": np.asarray([r["action"] for r in rows], np.int64),
+        "rewards": np.asarray([r["reward"] for r in rows], np.float32),
+        "next_obs": np.asarray([r["next_obs"] for r in rows], np.float32),
+        "dones": np.asarray([r["done"] for r in rows], np.float32),
+    }
+
+
+# ------------------------------------------------------------------ configs
+@dataclasses.dataclass
+class OfflineConfig:
+    dataset: Any = None  # numpy dict | JSONL path | data.Dataset
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    gamma: float = 0.99
+
+    def offline_data(self, dataset) -> "OfflineConfig":
+        self.dataset = dataset
+        return self
+
+    def training(self, **kw) -> "OfflineConfig":
+        fields = {f.name for f in dataclasses.fields(self)}
+        for k, v in kw.items():
+            if k not in fields:
+                raise ValueError(f"Unknown training option {k}")
+            setattr(self, k, v)
+        return self
+
+
+@dataclasses.dataclass
+class BCConfig(OfflineConfig):
+    def build(self) -> "BC":
+        return BC(self)
+
+
+@dataclasses.dataclass
+class MARWILConfig(OfflineConfig):
+    beta: float = 1.0       # 0 = plain BC; >0 advantage-weights the cloning
+    vf_coeff: float = 1.0
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+@dataclasses.dataclass
+class CQLConfig(OfflineConfig):
+    alpha_cql: float = 1.0  # conservative penalty weight
+    target_update_freq: int = 100
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class _OfflineAlgorithm:
+    """Shared train() loop: minibatch SGD epochs over the fixed dataset."""
+
+    def __init__(self, cfg: OfflineConfig):
+        self.cfg = cfg
+        self.data = load_offline_data(cfg.dataset)
+        if not len(self.data["obs"]):
+            raise ValueError("offline dataset is empty")
+        self.obs_dim = int(self.data["obs"].shape[-1])
+        self.num_actions = int(self.data["actions"].max()) + 1
+        self._rng = np.random.default_rng(cfg.seed)
+        self.updates_total = 0
+        self._build()
+
+    def train(self, num_updates: int = 50) -> dict:
+        n = len(self.data["obs"])
+        bs = min(self.cfg.train_batch_size, n)
+        metrics = {}
+        for _ in range(num_updates):
+            idx = self._rng.integers(0, n, bs)
+            metrics = self._update({k: v[idx] for k, v in self.data.items()})
+            self.updates_total += 1
+        self._policy_np_cache = None  # params changed: invalidate
+        return {"updates_total": self.updates_total, **metrics}
+
+    _policy_np_cache = None
+
+    def compute_single_action(self, obs) -> int:
+        from ray_tpu.rllib.np_policy import np_mlp
+
+        if self._policy_np_cache is None:
+            # device->host conversion once per train() round, not per step
+            self._policy_np_cache = [
+                {k: np.asarray(w) for k, w in layer.items()}
+                for layer in self._policy_params()]
+        return int(np.argmax(
+            np_mlp(self._policy_np_cache, np.asarray(obs, np.float64)[None])[0]))
+
+    def evaluate(self, env_creator, episodes: int = 2, max_steps: int = 500) -> float:
+        """Mean episode reward of the greedy learned policy."""
+        totals = []
+        for ep in range(episodes):
+            env = env_creator()
+            obs, _ = env.reset(seed=self.cfg.seed + ep)
+            total = 0.0
+            for _ in range(max_steps):
+                obs, r, term, trunc, _ = env.step(self.compute_single_action(obs))
+                total += float(r)
+                if term or trunc:
+                    break
+            env.close()
+            totals.append(total)
+        return float(np.mean(totals))
+
+
+class BC(_OfflineAlgorithm):
+    """Behavior cloning: NLL of the logged actions (algorithms/bc/)."""
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        self.params = _mlp_init(jax.random.PRNGKey(cfg.seed),
+                                (self.obs_dim, *cfg.hidden, self.num_actions))
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, obs, actions):
+            logp = jax.nn.log_softmax(_mlp_apply(params, obs, jnp))
+            nll = -jnp.take_along_axis(logp, actions[:, None], axis=1).mean()
+            return nll
+
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._jit_update = jax.jit(update)
+        self._jnp = jnp
+
+    def _policy_params(self):
+        return self.params
+
+    def _update(self, batch) -> dict:
+        jnp = self._jnp
+        self.params, self.opt_state, loss = self._jit_update(
+            self.params, self.opt_state,
+            jnp.asarray(batch["obs"]), jnp.asarray(batch["actions"], jnp.int32))
+        return {"bc_loss": float(loss)}
+
+
+class MARWIL(_OfflineAlgorithm):
+    """Monotonic advantage re-weighted imitation learning (algorithms/marwil/):
+    clone the data policy with per-sample weights exp(beta * advantage), where
+    the advantage baseline V is regressed on observed one-step returns."""
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        kp, kv = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        self.params = {
+            "pi": _mlp_init(kp, (self.obs_dim, *cfg.hidden, self.num_actions)),
+            "vf": _mlp_init(kv, (self.obs_dim, *cfg.hidden, 1)),
+        }
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, obs, actions, rewards, next_obs, dones):
+            v = _mlp_apply(params["vf"], obs, jnp)[:, 0]
+            v_next = jax.lax.stop_gradient(
+                _mlp_apply(params["vf"], next_obs, jnp)[:, 0])
+            target = rewards + cfg.gamma * (1.0 - dones) * v_next
+            vf_loss = ((v - target) ** 2).mean()
+            adv = jax.lax.stop_gradient(target - v)
+            adv = adv / (jnp.abs(adv).mean() + 1e-8)  # scale-free exponent
+            w = jnp.exp(jnp.clip(cfg.beta * adv, -5.0, 5.0))
+            logp = jax.nn.log_softmax(_mlp_apply(params["pi"], obs, jnp))
+            nll = -jnp.take_along_axis(logp, actions[:, None], axis=1)[:, 0]
+            pi_loss = (w * nll).mean()
+            return pi_loss + cfg.vf_coeff * vf_loss, {
+                "pi_loss": pi_loss, "vf_loss": vf_loss}
+
+        def update(params, opt_state, batch):
+            (loss, m), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch["obs"], batch["actions"], batch["rewards"],
+                batch["next_obs"], batch["dones"])
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            m["total_loss"] = loss
+            return optax.apply_updates(params, updates), opt_state, m
+
+        self._jit_update = jax.jit(update)
+        self._jnp = jnp
+
+    def _policy_params(self):
+        return self.params["pi"]
+
+    def _update(self, batch) -> dict:
+        jnp = self._jnp
+        b = {"obs": jnp.asarray(batch["obs"]),
+             "actions": jnp.asarray(batch["actions"], jnp.int32),
+             "rewards": jnp.asarray(batch["rewards"]),
+             "next_obs": jnp.asarray(batch["next_obs"]),
+             "dones": jnp.asarray(batch["dones"])}
+        self.params, self.opt_state, m = self._jit_update(
+            self.params, self.opt_state, b)
+        return {k: float(v) for k, v in m.items()}
+
+
+class CQL(_OfflineAlgorithm):
+    """Conservative Q-learning, discrete (algorithms/cql/): double-Q TD loss
+    plus the conservative gap logsumexp(Q) - Q(a_data), which pushes down
+    Q-values for actions the dataset never took."""
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        self.params = _mlp_init(jax.random.PRNGKey(cfg.seed),
+                                (self.obs_dim, *cfg.hidden, self.num_actions))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, target_params, obs, actions, rewards, next_obs, dones):
+            q = _mlp_apply(params, obs, jnp)
+            q_a = jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
+            # double-Q target: online argmax, target net evaluation
+            next_q_online = _mlp_apply(params, next_obs, jnp)
+            next_a = jnp.argmax(next_q_online, axis=1)
+            next_q_t = _mlp_apply(target_params, next_obs, jnp)
+            next_q = jnp.take_along_axis(next_q_t, next_a[:, None], axis=1)[:, 0]
+            target = rewards + cfg.gamma * (1.0 - dones) * jax.lax.stop_gradient(next_q)
+            td_loss = ((q_a - target) ** 2).mean()
+            cql_gap = (jax.nn.logsumexp(q, axis=1) - q_a).mean()
+            return td_loss + cfg.alpha_cql * cql_gap, {
+                "td_loss": td_loss, "cql_gap": cql_gap}
+
+        def update(params, target_params, opt_state, batch):
+            (loss, m), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch["obs"], batch["actions"],
+                batch["rewards"], batch["next_obs"], batch["dones"])
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            m["total_loss"] = loss
+            return optax.apply_updates(params, updates), opt_state, m
+
+        self._jit_update = jax.jit(update)
+        self._jnp = jnp
+
+    def _policy_params(self):
+        return self.params
+
+    def _update(self, batch) -> dict:
+        jnp = self._jnp
+        b = {"obs": jnp.asarray(batch["obs"]),
+             "actions": jnp.asarray(batch["actions"], jnp.int32),
+             "rewards": jnp.asarray(batch["rewards"]),
+             "next_obs": jnp.asarray(batch["next_obs"]),
+             "dones": jnp.asarray(batch["dones"])}
+        self.params, self.opt_state, m = self._jit_update(
+            self.params, self.target_params, self.opt_state, b)
+        if (self.updates_total + 1) % self.cfg.target_update_freq == 0:
+            import jax
+
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {k: float(v) for k, v in m.items()}

@@ -130,6 +130,23 @@ class PPOLearner:
         return {k: float(v) for k, v in metrics.items()}
 
 
+def gae(cfg, ep: Episode) -> tuple[np.ndarray, np.ndarray]:
+    """Generalized advantage estimation over one episode segment.
+
+    Shared by PPO and MultiAgentPPO; cfg needs .gamma/.lambda_."""
+    rewards = np.asarray(ep.rewards)
+    values = np.asarray(ep.values + [ep.bootstrap_value])
+    adv = np.zeros(len(rewards))
+    last = 0.0
+    for t in reversed(range(len(rewards))):
+        nonterminal = 0.0 if ep.dones[t] else 1.0
+        delta = rewards[t] + cfg.gamma * values[t + 1] * nonterminal - values[t]
+        last = delta + cfg.gamma * cfg.lambda_ * nonterminal * last
+        adv[t] = last
+    returns = adv + values[:-1]
+    return adv, returns
+
+
 class PPO:
     """The Algorithm (reference: algorithms/algorithm.py train() loop)."""
 
@@ -154,19 +171,7 @@ class PPO:
         self._iteration = 0
 
     def _gae(self, ep: Episode) -> tuple[np.ndarray, np.ndarray]:
-        """Generalized advantage estimation over one episode segment."""
-        cfg = self.cfg
-        rewards = np.asarray(ep.rewards)
-        values = np.asarray(ep.values + [ep.bootstrap_value])
-        adv = np.zeros(len(rewards))
-        last = 0.0
-        for t in reversed(range(len(rewards))):
-            nonterminal = 0.0 if ep.dones[t] else 1.0
-            delta = rewards[t] + cfg.gamma * values[t + 1] * nonterminal - values[t]
-            last = delta + cfg.gamma * cfg.lambda_ * nonterminal * last
-            adv[t] = last
-        returns = adv + values[:-1]
-        return adv, returns
+        return gae(self.cfg, ep)
 
     def train(self) -> dict:
         """One iteration: sample -> GAE -> minibatch SGD epochs -> metrics."""
