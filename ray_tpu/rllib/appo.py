@@ -111,18 +111,11 @@ class APPO(IMPALA):
 
     def _update_from_batch(self, batch: dict) -> dict:
         """Multi-epoch clipped minibatch SGD over the collected batch —
-        IMPALA's train() loop (sampling, broadcasts, metrics) is inherited.
-        Full minibatches only: a variable-size tail would retrace the jitted
-        update (same guard as ppo.py's epoch loop)."""
+        IMPALA's train() loop (sampling, broadcasts, metrics) is inherited."""
+        from ray_tpu.rllib.ppo import minibatch_sgd
+
         cfg = self.cfg
-        n = len(batch["obs"])
-        rng = np.random.default_rng(cfg.seed + self.iterations)
-        mb = min(cfg.minibatch_size, n)
-        metrics: dict = {}
-        for _ in range(cfg.num_epochs):
-            order = rng.permutation(n)
-            for lo in range(0, n - mb + 1, mb):
-                idx = order[lo:lo + mb]
-                metrics = self.learner.update(
-                    {k: v[idx] for k, v in batch.items()})
-        return metrics
+        return minibatch_sgd(
+            self.learner.update, batch, cfg.num_epochs, cfg.minibatch_size,
+            rng=np.random.default_rng(cfg.seed + self.iterations),
+        )

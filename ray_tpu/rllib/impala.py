@@ -177,10 +177,9 @@ class IMPALA:
 
         # numpy host pass for the V-trace inputs: episode lengths vary
         # continuously, so a jitted forward would recompile per length
-        params_np = {
-            k: [{n: np.asarray(w) for n, w in layer.items()} for layer in v]
-            for k, v in self.learner.params.items()
-        }
+        from ray_tpu.rllib.np_policy import to_numpy_params
+
+        params_np = to_numpy_params(self.learner.params)
         obs_all, act_all, vs_all, adv_all = [], [], [], []
         for ep in episodes:
             if not len(ep):

@@ -153,12 +153,10 @@ class MultiAgentPPO:
         self._sync()
 
     def _np_weights(self) -> dict:
-        return {
-            pid: {k: [{kk: np.asarray(vv) for kk, vv in layer.items()}
-                      for layer in v]
-                  for k, v in ln.params.items()}
-            for pid, ln in self.learners.items()
-        }
+        from ray_tpu.rllib.np_policy import to_numpy_params
+
+        return {pid: to_numpy_params(ln.params)
+                for pid, ln in self.learners.items()}
 
     def _sync(self) -> None:
         w = self._np_weights()
@@ -192,25 +190,17 @@ class MultiAgentPPO:
                 continue
             advs = np.asarray(advs, np.float32)
             advs = (advs - advs.mean()) / (advs.std() + 1e-8)
-            batch = {
-                "obs": np.asarray(obs, np.float32),
-                "actions": np.asarray(actions, np.int32),
-                "logprobs": np.asarray(logprobs, np.float32),
-                "advantages": advs,
-                "returns": np.asarray(rets, np.float32),
-            }
-            # minibatch SGD, full minibatches only (ppo.py's retrace guard)
-            n = len(batch["obs"])
-            mb = min(cfg.minibatch_size, n)
-            idx = np.arange(n)
-            m = {}
-            for _ in range(cfg.num_epochs):
-                np.random.shuffle(idx)
-                for lo in range(0, n - mb + 1, mb):
-                    sel = idx[lo:lo + mb]
-                    m = self.learners[pid].update(
-                        {k: v[sel] for k, v in batch.items()})
-            metrics[pid] = m
+            from ray_tpu.rllib.ppo import minibatch_sgd
+
+            metrics[pid] = minibatch_sgd(
+                self.learners[pid].update,
+                {"obs": np.asarray(obs, np.float32),
+                 "actions": np.asarray(actions, np.int32),
+                 "logprobs": np.asarray(logprobs, np.float32),
+                 "advantages": advs,
+                 "returns": np.asarray(rets, np.float32)},
+                cfg.num_epochs, cfg.minibatch_size,
+            )
             finished = [e for e in episodes if e.dones and e.dones[-1]]
             rewards_all += [e.total_reward() for e in finished]
         self._iteration += 1

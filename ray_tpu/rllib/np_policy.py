@@ -9,6 +9,16 @@ from __future__ import annotations
 import numpy as np
 
 
+def to_numpy_params(params):
+    """Host-side copy of a {head: [layer dicts]} param tree (or a bare layer
+    list) — the one serialization used everywhere actors receive weights."""
+    if isinstance(params, dict):
+        return {k: [{kk: np.asarray(vv) for kk, vv in layer.items()}
+                    for layer in v]
+                for k, v in params.items()}
+    return [{k: np.asarray(w) for k, w in layer.items()} for layer in params]
+
+
 def np_mlp(layers, x: np.ndarray) -> np.ndarray:
     """Forward the _mlp_init layer list in numpy (tanh hidden activations)."""
     for i, layer in enumerate(layers):

@@ -130,6 +130,27 @@ class PPOLearner:
         return {k: float(v) for k, v in metrics.items()}
 
 
+def minibatch_sgd(update_fn, batch: dict, num_epochs: int, minibatch_size: int,
+                  rng=None) -> dict:
+    """Shared epoch/shuffle/slice loop (PPO, APPO, MultiAgentPPO).
+
+    Full minibatches only: a variable-size tail would retrace the jitted
+    update each iteration (n < minibatch_size falls back to one full batch)."""
+    n = len(batch["obs"])
+    if n == 0:
+        return {}
+    rng = rng or np.random.default_rng()
+    mb = min(minibatch_size, n)
+    idx = np.arange(n)
+    metrics: dict = {}
+    for _ in range(num_epochs):
+        rng.shuffle(idx)
+        for lo in range(0, n - mb + 1, mb):
+            sel = idx[lo:lo + mb]
+            metrics = update_fn({k: v[sel] for k, v in batch.items()})
+    return metrics
+
+
 def gae(cfg, ep: Episode) -> tuple[np.ndarray, np.ndarray]:
     """Generalized advantage estimation over one episode segment.
 
@@ -175,11 +196,10 @@ class PPO:
 
     def train(self) -> dict:
         """One iteration: sample -> GAE -> minibatch SGD epochs -> metrics."""
+        from ray_tpu.rllib.np_policy import to_numpy_params
+
         cfg = self.cfg
-        self.runner_group.sync_weights(
-            {k: [{kk: np.asarray(vv) for kk, vv in layer.items()} for layer in v]
-             for k, v in self.learner.params.items()}
-        )
+        self.runner_group.sync_weights(to_numpy_params(self.learner.params))
         episodes = self.runner_group.sample(cfg.rollout_fragment_length)
         obs, actions, logprobs, advs, rets = [], [], [], [], []
         for ep in episodes:
@@ -197,20 +217,12 @@ class PPO:
         rets = np.asarray(rets, dtype=np.float32)
 
         n = len(obs)
-        idx = np.arange(n)
-        metrics = {}
-        for _ in range(cfg.num_epochs):
-            np.random.shuffle(idx)
-            # full minibatches only: a variable-size tail would retrace the jitted
-            # update each iteration (n < minibatch_size falls back to one batch)
-            step_ranges = (range(0, n - cfg.minibatch_size + 1, cfg.minibatch_size)
-                           if n >= cfg.minibatch_size else range(0, 1))
-            for start in step_ranges:
-                mb = idx[start : start + cfg.minibatch_size] if n >= cfg.minibatch_size else idx
-                metrics = self.learner.update({
-                    "obs": obs[mb], "actions": actions[mb], "logprobs": logprobs[mb],
-                    "advantages": advs[mb], "returns": rets[mb],
-                })
+        metrics = minibatch_sgd(
+            self.learner.update,
+            {"obs": obs, "actions": actions, "logprobs": logprobs,
+             "advantages": advs, "returns": rets},
+            cfg.num_epochs, cfg.minibatch_size,
+        )
         self._iteration += 1
         finished = [ep for ep in episodes if ep.dones and ep.dones[-1]]
         mean_reward = float(np.mean([ep.total_reward() for ep in finished])) if finished else 0.0

@@ -5,14 +5,19 @@ reference python/ray/tune/.
 """
 
 from ray_tpu.tune.schedulers import (
+    PB2,
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
+    create_bohb,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    OptunaSearch,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -24,8 +29,9 @@ from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner, run
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "run",
     "uniform", "loguniform", "randint", "choice", "grid_search",
-    "BasicVariantGenerator", "Searcher",
+    "BasicVariantGenerator", "Searcher", "TPESearcher", "OptunaSearch",
     "ASHAScheduler", "PopulationBasedTraining", "FIFOScheduler", "TrialScheduler",
+    "MedianStoppingRule", "PB2", "create_bohb",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rec

@@ -7,6 +7,7 @@ returns CONTINUE/STOP; PBT additionally mutates lagging trials from leaders.
 
 from __future__ import annotations
 
+import math
 import random
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -135,3 +136,141 @@ class PopulationBasedTraining(TrialScheduler):
             if cur:
                 self._latest[trial_id] = (cur[0], dict(cfg))
         return cfg
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop trials whose running-average is worse than the median of the
+    other trials' running averages at the same step (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 3, min_samples_required: int = 3):
+        self.metric, self.mode = metric, mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._history: dict[str, list[float]] = {}
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        hist = self._history.setdefault(trial_id, [])
+        hist.append(float(val))
+        t = len(hist)
+        if t < self.grace_period:
+            return CONTINUE
+        means = [sum(h[:t]) / min(t, len(h))
+                 for tid, h in self._history.items()
+                 if tid != trial_id and len(h) >= t]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        means.sort()
+        median = means[len(means) // 2]
+        mine = sum(hist) / t
+        worse = mine > median if self.mode == "min" else mine < median
+        return STOP if worse else CONTINUE
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with model-guided exploration (reference: tune/schedulers/pb2.py):
+    instead of random perturbation factors, continuous hyperparams are chosen
+    by GP-UCB over the population's (config -> score improvement) history —
+    a small RBF-kernel GP fit in numpy at each exploit."""
+
+    def __init__(self, *args, ucb_beta: float = 2.0, n_candidates: int = 32, **kw):
+        super().__init__(*args, **kw)
+        self.ucb_beta = ucb_beta
+        self.n_candidates = n_candidates
+        # (normalized config vector, score delta) observations per exploit key
+        self._obs: list[tuple[list, float]] = []
+        self._prev_scores: dict[str, float] = {}
+
+    def _bounds(self):
+        return {k: spec for k, spec in self.mutations.items()
+                if isinstance(spec, tuple) and len(spec) == 2}
+
+    def _norm(self, cfg: dict) -> list:
+        return [(float(cfg.get(k, lo)) - lo) / max(hi - lo, 1e-12)
+                for k, (lo, hi) in sorted(self._bounds().items())]
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        val = result.get(self.metric)
+        if val is not None:
+            prev = self._prev_scores.get(trial_id)
+            if prev is not None:
+                _, cfg = self._latest.get(trial_id, (None, {}))
+                delta = float(val) - prev
+                if self.mode == "min":
+                    delta = -delta
+                self._obs.append((self._norm(cfg), delta))
+                self._obs = self._obs[-64:]  # bounded history
+            self._prev_scores[trial_id] = float(val)
+        return super().on_result(trial_id, result)
+
+    def _gp_fit(self, X, y):
+        """Factor the GP once; returns ucb(x) doing only mat-vec work per
+        candidate (K is shared across all candidates of one exploit)."""
+        import numpy as np
+
+        X, y = np.asarray(X, float), np.asarray(y, float)
+        if not len(X):
+            return lambda x: 0.0
+        ys = (y - y.mean()) / (y.std() + 1e-9)
+        ls, noise = 0.3, 1e-2
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * ls * ls))
+
+        K = k(X, X) + noise * np.eye(len(X))
+        try:
+            K_inv = np.linalg.inv(K)
+        except np.linalg.LinAlgError:
+            return lambda x: 0.0
+        alpha = K_inv @ ys
+        beta = self.ucb_beta
+
+        def ucb(x):
+            kx = k(X, np.asarray(x, float)[None])[:, 0]
+            mu = float(kx @ alpha)
+            var = float(1.0 - kx @ (K_inv @ kx))
+            return mu + beta * math.sqrt(max(var, 1e-12))
+
+        return ucb
+
+    def _perturb(self, config: dict) -> dict:
+        bounds = self._bounds()
+        if not bounds:
+            return super()._perturb(config)
+        out = dict(config)
+        # non-tuple mutations keep PBT behavior
+        for k, spec in self.mutations.items():
+            if callable(spec):
+                out[k] = spec()
+            elif isinstance(spec, list):
+                out[k] = self.rng.choice(spec)
+        ucb = self._gp_fit([v for v, _ in self._obs], [d for _, d in self._obs])
+        best_cfg, best_score = None, float("-inf")
+        for _ in range(self.n_candidates):
+            cand = dict(out)
+            for k, (lo, hi) in bounds.items():
+                cand[k] = self.rng.uniform(lo, hi)
+            score = ucb(self._norm(cand))
+            if score > best_score:
+                best_cfg, best_score = cand, score
+        return best_cfg or out
+
+
+def create_bohb(param_space: dict, metric: str = "loss", mode: str = "min",
+                num_samples: int = 64, max_t: int = 100,
+                reduction_factor: int = 3, seed: int | None = None):
+    """BOHB (reference: tune/schedulers/hb_bohb.py + search/bohb/): HyperBand-
+    style successive halving (ASHA rungs) driven by a model-based sampler
+    (native TPE). Returns (scheduler, searcher) to pass into TuneConfig."""
+    from ray_tpu.tune.search import TPESearcher
+
+    scheduler = ASHAScheduler(metric=metric, mode=mode, max_t=max_t,
+                              reduction_factor=reduction_factor)
+    searcher = TPESearcher(param_space, metric=metric, mode=mode,
+                           num_samples=num_samples, seed=seed)
+    return scheduler, searcher

@@ -123,3 +123,108 @@ def test_result_dataframe():
     )
     df = grid.get_dataframe()
     assert len(df) == 2 and "config/a" in df.columns
+
+
+# ---------------------------------------------------------- round-2 additions
+def test_tpe_searcher_beats_random_on_quadratic(ray_start_regular):
+    """TPE should concentrate samples near the optimum of a smooth objective
+    (reference: search/optuna default sampler behavior)."""
+    import numpy as np
+
+    from ray_tpu.tune.search import TPESearcher
+
+    def objective(config):
+        report({"loss": (config["x"] - 0.7) ** 2})
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    searcher = TPESearcher(space, metric="loss", mode="min", num_samples=40,
+                           n_startup=10, seed=0)
+    grid = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    search_alg=searcher,
+                                    max_concurrent_trials=4),
+    ).fit()
+    best = grid.get_best_result()
+    assert abs(best.config["x"] - 0.7) < 0.15, best.config
+    late = [r.config["x"] for r in grid._results[20:]]
+    assert np.mean(np.abs(np.asarray(late) - 0.7)) < 0.25
+
+
+def test_median_stopping_rule_stops_bad_trials(ray_start_regular):
+    from ray_tpu.tune.schedulers import MedianStoppingRule
+
+    def objective(config):
+        for i in range(10):
+            report({"loss": config["base"] + 0.01 * i})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"base": tune.grid_search([0.1, 0.1, 0.1, 5.0, 5.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min",
+            scheduler=MedianStoppingRule(metric="loss", mode="min",
+                                         grace_period=2, min_samples_required=2),
+            max_concurrent_trials=5),
+    ).fit()
+    stopped = [r for r in grid._results if r.state == "TERMINATED"]
+    assert len(stopped) >= 1  # the 5.0-base trials die early
+    assert all(r.config["base"] == 5.0 for r in stopped)
+
+
+def test_pb2_explores_from_population_model(ray_start_regular):
+    from ray_tpu.tune.schedulers import PB2
+
+    def objective(config):
+        score = 0.0
+        for _ in range(8):
+            # improvement rate depends on lr's closeness to 0.5; exploit
+            # updates mutate the live config dict between reports
+            score += 1.0 - abs(config["lr"] - 0.5)
+            report({"score": score})
+
+    sched = PB2(metric="score", mode="max", perturbation_interval=2,
+                hyperparam_mutations={"lr": (0.0, 1.0)}, seed=0)
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=6,
+                                    scheduler=sched, max_concurrent_trials=6),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] > 4.0, best.metrics
+
+
+def test_bohb_combo_runs(ray_start_regular):
+    from ray_tpu.tune.schedulers import create_bohb
+
+    def objective(config):
+        for i in range(6):
+            report({"loss": (config["x"] - 0.3) ** 2 + 1.0 / (i + 1)})
+
+    space = {"x": tune.uniform(0, 1)}
+    scheduler, searcher = create_bohb(space, metric="loss", mode="min",
+                                      num_samples=12, seed=1)
+    grid = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    scheduler=scheduler, search_alg=searcher,
+                                    max_concurrent_trials=4),
+    ).fit()
+    assert grid.get_best_result().metrics["loss"] < 0.6
+
+
+def test_optuna_adapter_gated_import():
+    from ray_tpu.tune.search import OptunaSearch
+
+    try:
+        import optuna  # noqa: F401
+        has_optuna = True
+    except ImportError:
+        has_optuna = False
+    if has_optuna:
+        s = OptunaSearch({"x": tune.uniform(0, 1)}, num_samples=2)
+        assert s.suggest("t0")
+    else:
+        with pytest.raises(ImportError, match="TPESearcher"):
+            OptunaSearch({"x": tune.uniform(0, 1)})
