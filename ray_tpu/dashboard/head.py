@@ -139,8 +139,14 @@ class Dashboard:
                 "duration_s": duration,
             })
 
+        async def index(request):
+            from ray_tpu.dashboard.ui import INDEX_HTML
+
+            return web.Response(text=INDEX_HTML, content_type="text/html")
+
         async def start():
             app = web.Application()
+            app.router.add_get("/", index)
             app.router.add_get("/api/cluster_status", cluster_status)
             app.router.add_get("/api/v0/{resource}/summarize", state_summarize)
             app.router.add_get("/api/v0/{resource}", state_list)

@@ -1,0 +1,156 @@
+"""Dashboard web UI: one self-contained HTML page over the JSON API.
+
+Parity: the reference's React dashboard client (python/ray/dashboard/client/)
+— re-scoped to a dependency-free page the head serves at "/": stat tiles for
+the headline numbers and tables for nodes / jobs / actors / serve apps,
+polling /api/cluster_status, /api/v0/*, /api/jobs, /api/serve/status.
+
+Design notes (dataviz method): headline numbers are stat tiles, enumerable
+facts are tables; status is never color-alone (dot + label); text wears ink
+tokens; light/dark via prefers-color-scheme.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<style>
+:root {
+  --bg: #faf9f5; --panel: #ffffff; --ink: #1a1a17; --ink-2: #5c5a53;
+  --muted: #8a8778; --line: #e8e6dd; --accent: #2f7ab8;
+  --good: #2e7d32; --warn: #b26a00; --bad: #c62828;
+}
+@media (prefers-color-scheme: dark) {
+  :root {
+    --bg: #16161a; --panel: #1f1f24; --ink: #ececec; --ink-2: #b5b5ad;
+    --muted: #8b8b84; --line: #32323a; --accent: #6aa7d8;
+    --good: #7bc67e; --warn: #e0a95c; --bad: #e57373;
+  }
+}
+* { box-sizing: border-box; }
+body { margin: 0; background: var(--bg); color: var(--ink);
+       font: 14px/1.45 system-ui, -apple-system, sans-serif; }
+header { display: flex; align-items: baseline; gap: 12px;
+         padding: 14px 20px; border-bottom: 1px solid var(--line); }
+header h1 { font-size: 16px; margin: 0; font-weight: 600; }
+header .sub { color: var(--muted); font-size: 12px; }
+main { padding: 16px 20px; max-width: 1200px; margin: 0 auto; }
+.tiles { display: grid; grid-template-columns: repeat(auto-fit, minmax(150px, 1fr));
+         gap: 10px; margin-bottom: 18px; }
+.tile { background: var(--panel); border: 1px solid var(--line);
+        border-radius: 8px; padding: 10px 14px; }
+.tile .v { font-size: 24px; font-weight: 650; letter-spacing: -0.5px; }
+.tile .k { color: var(--ink-2); font-size: 12px; margin-top: 2px; }
+.tile .d { color: var(--muted); font-size: 11px; }
+section { margin-bottom: 20px; }
+section h2 { font-size: 13px; font-weight: 600; color: var(--ink-2);
+             text-transform: uppercase; letter-spacing: 0.06em; margin: 0 0 6px; }
+table { width: 100%; border-collapse: collapse; background: var(--panel);
+        border: 1px solid var(--line); border-radius: 8px; overflow: hidden; }
+th, td { text-align: left; padding: 7px 12px; border-bottom: 1px solid var(--line);
+         font-variant-numeric: tabular-nums; }
+th { color: var(--muted); font-weight: 500; font-size: 12px; }
+tr:last-child td { border-bottom: none; }
+.status { display: inline-flex; align-items: center; gap: 6px; }
+.status .dot { width: 8px; height: 8px; border-radius: 50%; }
+.s-good .dot { background: var(--good); } .s-good { color: var(--good); }
+.s-warn .dot { background: var(--warn); } .s-warn { color: var(--warn); }
+.s-bad .dot { background: var(--bad); } .s-bad { color: var(--bad); }
+.s-muted .dot { background: var(--muted); } .s-muted { color: var(--muted); }
+.empty { color: var(--muted); padding: 10px 12px; }
+code { font-size: 12px; color: var(--ink-2); }
+#err { color: var(--bad); font-size: 12px; margin-left: auto; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="sub">cluster dashboard</span>
+  <span class="sub" id="updated"></span>
+  <span id="err"></span>
+</header>
+<main>
+  <div class="tiles" id="tiles"></div>
+  <section><h2>Nodes</h2><div id="nodes"></div></section>
+  <section><h2>Jobs</h2><div id="jobs"></div></section>
+  <section><h2>Actors</h2><div id="actors"></div></section>
+  <section><h2>Serve applications</h2><div id="serve"></div></section>
+</main>
+<script>
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+
+function statusCell(state) {
+  const up = String(state || "").toUpperCase();
+  const cls = ["ALIVE","RUNNING","FINISHED","SUCCEEDED","COMPLETED","HEALTHY"].includes(up) ? "s-good"
+    : ["PENDING","RESTARTING","DEPLOYING","QUEUED","PENDING_CREATION"].includes(up) ? "s-warn"
+    : ["DEAD","FAILED","ERRORED","UNHEALTHY","STOPPED"].includes(up) ? "s-bad" : "s-muted";
+  return `<span class="status ${cls}"><span class="dot"></span>${esc(up || "?")}</span>`;
+}
+
+function tile(v, k, d) {
+  return `<div class="tile"><div class="v">${esc(v)}</div>` +
+         `<div class="k">${esc(k)}</div><div class="d">${esc(d || "")}</div></div>`;
+}
+
+function table(id, cols, rows) {
+  if (!rows || !rows.length) { $(id).innerHTML = '<div class="empty">none</div>'; return; }
+  $(id).innerHTML = "<table><tr>" + cols.map(c => `<th>${esc(c[0])}</th>`).join("") +
+    "</tr>" + rows.map(r => "<tr>" + cols.map(c =>
+      `<td>${c[2] ? c[2](r) : esc(r[c[1]])}</td>`).join("") + "</tr>").join("") + "</table>";
+}
+
+async function j(url) { const r = await fetch(url); if (!r.ok) throw new Error(url + " " + r.status); return r.json(); }
+
+async function refresh() {
+  try {
+    const [cs, nodes, actors, tasks, objects, jobs, serve] = await Promise.all([
+      j("/api/cluster_status"), j("/api/v0/nodes"), j("/api/v0/actors"),
+      j("/api/v0/tasks/summarize"), j("/api/v0/objects"),
+      j("/api/jobs"), j("/api/serve/status").catch(() => ({applications: {}})),
+    ]);
+    const total = cs.total_resources || {}; const avail = cs.available_resources || {};
+    const usedCpu = ((total.CPU ?? 0) - (avail.CPU ?? 0)).toFixed(1);
+    const taskStates = tasks.by_state || {};
+    const running = taskStates.RUNNING || 0;
+    const alive = actors.filter(a => a.state === "ALIVE").length;
+    $("tiles").innerHTML =
+      tile(nodes.length, "nodes") +
+      tile(`${usedCpu}/${total.CPU ?? 0}`, "CPUs in use") +
+      tile(running, "tasks running",
+           Object.entries(taskStates).map(([k,v]) => `${k}:${v}`).join("  ")) +
+      tile(alive, "actors alive") +
+      tile(objects.length, "objects tracked") +
+      tile(jobs.length, "jobs");
+    table("nodes", [["node", "node_id", r => `<code>${esc(String(r.node_id||"").slice(0,12))}</code>`],
+                    ["state", "alive", r => statusCell(r.alive === false ? "DEAD" : "ALIVE")],
+                    ["resources", "resources_total", r => esc(JSON.stringify(r.resources_total || {}))],
+                    ["available", "resources_available", r => esc(JSON.stringify(r.resources_available || {}))],
+                    ["labels", "labels", r => esc(JSON.stringify(r.labels || {}))]],
+          nodes);
+    table("jobs", [["job", "job_id", r => `<code>${esc(r.job_id || "")}</code>`],
+                   ["status", "status", r => statusCell(r.status)],
+                   ["entrypoint", "entrypoint", r => `<code>${esc(String(r.entrypoint||"").slice(0,60))}</code>`]],
+          jobs);
+    table("actors", [["actor", "actor_id", r => `<code>${esc(String(r.actor_id||"").slice(0,12))}</code>`],
+                     ["class", "class_name"], ["name", "name"],
+                     ["state", "state", r => statusCell(r.state)],
+                     ["restarts", "num_restarts"]],
+          actors);
+    const apps = Object.entries(serve.applications || {}).map(([name, a]) =>
+      ({name, status: a.status, deployments: Object.keys(a.deployments || {}).join(", ")}));
+    table("serve", [["app", "name"], ["status", "status", r => statusCell(r.status)],
+                    ["deployments", "deployments"]], apps);
+    $("updated").textContent = "updated " + new Date().toLocaleTimeString();
+    $("err").textContent = "";
+  } catch (e) { $("err").textContent = e.message; }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -39,3 +39,7 @@ from ray_tpu._private.usage_stats import record_library_usage as _rec
 
 _rec("data")
 del _rec
+
+from ray_tpu.data.read_api import from_torch, read_avro, read_sql  # noqa: E402,F401
+
+__all__ += ["read_avro", "read_sql", "from_torch"]

@@ -1,0 +1,309 @@
+"""Hermetic Avro Object Container File codec (no avro package dependency).
+
+Parity: the reference's avro datasource (_internal/datasource/avro_datasource.py,
+which depends on the fastavro package). Scope: tabular container files —
+record schemas of primitive fields, nullable ["null", X] unions, and arrays
+of primitives; codecs null and deflate. That covers the files the reference's
+tabular read path produces/consumes.
+
+Format (Avro 1.11 spec): magic Obj\\x01, file-metadata map carrying
+avro.schema JSON + avro.codec, 16-byte sync marker, then blocks of
+(count, byte-size, records..., sync).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Iterator
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------- primitives
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def _write_long(buf: io.BytesIO, n: int) -> None:
+    n = _zigzag_encode(n)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def _read_long(buf) -> int:
+    shift, acc = 0, 0
+    while True:
+        (b,) = buf.read(1)
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _zigzag_decode(acc)
+        shift += 7
+
+
+def _write_bytes(buf, data: bytes) -> None:
+    _write_long(buf, len(data))
+    buf.write(data)
+
+
+def _read_bytes(buf) -> bytes:
+    return buf.read(_read_long(buf))
+
+
+# ---------------------------------------------------------------- values
+def _write_value(buf, schema, value) -> None:
+    if isinstance(schema, list):  # union: index then value
+        if value is None and "null" in schema:
+            _write_long(buf, schema.index("null"))
+            return
+        idx = next(i for i, s in enumerate(schema) if s != "null")
+        _write_long(buf, idx)
+        _write_value(buf, schema[idx], value)
+        return
+    t = schema["type"] if isinstance(schema, dict) else schema
+    if t == "null":
+        return
+    if t == "boolean":
+        buf.write(b"\x01" if value else b"\x00")
+    elif t in ("int", "long"):
+        _write_long(buf, int(value))
+    elif t == "float":
+        buf.write(struct.pack("<f", float(value)))
+    elif t == "double":
+        buf.write(struct.pack("<d", float(value)))
+    elif t == "bytes":
+        _write_bytes(buf, bytes(value))
+    elif t == "string":
+        _write_bytes(buf, str(value).encode())
+    elif t == "array":
+        items = list(value)
+        if items:
+            _write_long(buf, len(items))
+            for it in items:
+                _write_value(buf, schema["items"], it)
+        _write_long(buf, 0)
+    elif t == "record":
+        for field in schema["fields"]:
+            _write_value(buf, field["type"], value[field["name"]])
+    else:
+        raise ValueError(f"unsupported avro type for write: {t!r}")
+
+
+def _read_value(buf, schema):
+    if isinstance(schema, list):  # union
+        return _read_value(buf, schema[_read_long(buf)])
+    t = schema["type"] if isinstance(schema, dict) else schema
+    if t == "null":
+        return None
+    if t == "boolean":
+        return buf.read(1) == b"\x01"
+    if t in ("int", "long"):
+        return _read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t == "bytes":
+        return _read_bytes(buf)
+    if t == "string":
+        return _read_bytes(buf).decode()
+    if t == "array":
+        out = []
+        while True:
+            n = _read_long(buf)
+            if n == 0:
+                return out
+            if n < 0:  # block with byte-size prefix
+                _read_long(buf)
+                n = -n
+            for _ in range(n):
+                out.append(_read_value(buf, schema["items"]))
+    if t == "map":
+        out = {}
+        while True:
+            n = _read_long(buf)
+            if n == 0:
+                return out
+            if n < 0:
+                _read_long(buf)
+                n = -n
+            for _ in range(n):
+                out[_read_bytes(buf).decode()] = _read_value(buf, schema["values"])
+    if t == "record":
+        return {f["name"]: _read_value(buf, f["type"]) for f in schema["fields"]}
+    if t == "enum":
+        return schema["symbols"][_read_long(buf)]
+    if t == "fixed":
+        return buf.read(schema["size"])
+    raise ValueError(f"unsupported avro type for read: {t!r}")
+
+
+# ---------------------------------------------------------------- container
+def _value_type(v) -> Any:
+    import numbers
+
+    import numpy as np
+
+    if isinstance(v, np.generic):  # numpy scalars -> python types
+        v = v.item()
+    if isinstance(v, np.ndarray):
+        v = v.tolist()
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, numbers.Integral):
+        return "long"
+    if isinstance(v, numbers.Real):
+        return "double"
+    if isinstance(v, bytes):
+        return "bytes"
+    if isinstance(v, (list, tuple)):
+        et = "double" if (v and isinstance(v[0], float)) else (
+            "long" if (v and isinstance(v[0], (int, bool))) else "string")
+        return {"type": "array", "items": et}
+    return "string"
+
+
+def _merge_types(a, b):
+    if a == b:
+        return a
+    if a == "null" or b == "null":  # widen to a nullable union
+        other = b if a == "null" else a
+        return ["null", other]
+    if isinstance(a, list) and "null" in a:
+        return ["null", _merge_types(next(s for s in a if s != "null"), b)]
+    if isinstance(b, list) and "null" in b:
+        return _merge_types(b, a)
+    if {a, b} == {"long", "double"}:
+        return "double"
+    return "string"  # incompatible: fall back to string coercion
+
+
+def infer_schema(rows, name: str = "Row") -> dict:
+    """Record schema inferred over ALL sampled rows (a dict is treated as a
+    one-row sample): types widen across rows — None anywhere makes a field a
+    nullable union, mixed long/double becomes double, anything else falls
+    back to string."""
+    if isinstance(rows, dict):
+        rows = [rows]
+    types: dict[str, Any] = {}
+    for row in rows:
+        for k, v in row.items():
+            t = _value_type(v)
+            types[k] = t if k not in types else _merge_types(types[k], t)
+    fields = [{"name": str(k), "type": (["null", "string"] if t == "null" else t)}
+              for k, t in types.items()]
+    return {"type": "record", "name": name, "fields": fields}
+
+
+def write_avro_file(path: str, rows: Iterator[dict], schema: dict | None = None,
+                    codec: str = "null", block_rows: int = 1024) -> int:
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported avro codec {codec!r} (null|deflate)")
+    rows = iter(rows)
+    first = next(rows, None)
+    if first is None:
+        raise ValueError("cannot write an empty avro file without a schema")
+    if schema is None:
+        # buffer one block for schema inference over a real sample, not just
+        # the first row (a None in row 1 must not type the column "string")
+        sample = [first]
+        for r in rows:
+            sample.append(r)
+            if len(sample) >= block_rows:
+                break
+        schema = infer_schema(sample)
+        import itertools
+
+        rows = itertools.chain(sample[1:], rows)
+    sync = os.urandom(16)
+    n = 0
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        header = io.BytesIO()
+        meta = {"avro.schema": json.dumps(schema).encode(),
+                "avro.codec": codec.encode()}
+        _write_long(header, len(meta))
+        for k, v in meta.items():
+            _write_bytes(header, k.encode())
+            _write_bytes(header, v)
+        _write_long(header, 0)
+        f.write(header.getvalue())
+        f.write(sync)
+
+        def flush(batch):
+            nonlocal n
+            if not batch:
+                return
+            body = io.BytesIO()
+            for r in batch:
+                _write_value(body, schema, r)
+            payload = body.getvalue()
+            if codec == "deflate":
+                payload = zlib.compress(payload)[2:-4]  # raw deflate per spec
+            blk = io.BytesIO()
+            _write_long(blk, len(batch))
+            _write_long(blk, len(payload))
+            f.write(blk.getvalue())
+            f.write(payload)
+            f.write(sync)
+            n += len(batch)
+
+        batch = [first]
+        for r in rows:
+            batch.append(r)
+            if len(batch) >= block_rows:
+                flush(batch)
+                batch = []
+        flush(batch)
+    return n
+
+
+def read_avro_file(path: str) -> Iterator[dict]:
+    with open(path, "rb") as f:
+        data = f.read()
+    buf = io.BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"{path} is not an avro container file")
+    meta = {}
+    while True:
+        cnt = _read_long(buf)
+        if cnt == 0:
+            break
+        if cnt < 0:
+            _read_long(buf)
+            cnt = -cnt
+        for _ in range(cnt):
+            k = _read_bytes(buf).decode()
+            meta[k] = _read_bytes(buf)
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = buf.read(16)
+    while buf.tell() < len(data):
+        count = _read_long(buf)
+        size = _read_long(buf)
+        payload = buf.read(size)
+        if codec == "deflate":
+            payload = zlib.decompress(payload, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        body = io.BytesIO(payload)
+        for _ in range(count):
+            yield _read_value(body, schema)
+        marker = buf.read(16)
+        if marker != sync:
+            raise ValueError(f"{path}: bad sync marker (corrupt block)")

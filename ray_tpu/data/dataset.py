@@ -354,6 +354,18 @@ class Dataset:
                 (encode_example(row) for row in b.rows()),
             )
 
+    def write_avro(self, path: str, *, codec: str = "null") -> None:
+        """Write blocks as Avro container shards (reference: avro datasink;
+        hermetic codec in data/avro.py)."""
+        import os
+
+        from ray_tpu.data.avro import write_avro_file
+
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self.iter_blocks()):
+            write_avro_file(f"{path}/part-{i:05d}.avro",
+                            (dict(row) for row in b.rows()), codec=codec)
+
     def write_json(self, path: str) -> None:
         import os
 

@@ -327,3 +327,58 @@ def read_webdataset(paths: str | list[str]) -> Dataset:
                 yield Block.from_rows([rows[k] for k in order])
 
     return Dataset(source, (), "read_webdataset")
+
+
+def read_avro(paths: str | list[str]) -> Dataset:
+    """Reference: read_api.read_avro :? (avro datasource via fastavro in
+    _internal/datasource/avro_datasource.py) — hermetic codec here
+    (data/avro.py), one block per file."""
+    files = _expand_paths(paths, ".avro")
+
+    def source() -> Iterator[Block]:
+        import pandas as pd
+
+        from ray_tpu.data.avro import read_avro_file
+
+        for f in files:
+            yield Block.from_pandas(pd.DataFrame(list(read_avro_file(f))))
+
+    return Dataset(source, (), "read_avro")
+
+
+def read_sql(sql: str, connection_factory) -> Dataset:
+    """Reference: read_api.read_sql :3004 — rows from any DB-API connection
+    (sqlite3, etc.). connection_factory() -> connection; the query runs inside
+    the read task."""
+
+    def source() -> Iterator[Block]:
+        import pandas as pd
+
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        yield Block.from_pandas(pd.DataFrame(rows, columns=cols))
+
+    return Dataset(source, (), "read_sql")
+
+
+def from_torch(torch_dataset, *, blocks: int = 8) -> Dataset:
+    """Reference: read_api.from_torch — map-style torch datasets become row
+    blocks ({'item': value} rows, matching the reference's column name)."""
+    n = len(torch_dataset)
+
+    def source() -> Iterator[Block]:
+        import builtins
+
+        per = max(1, -(-n // blocks))
+        for lo in builtins.range(0, n, per):
+            items = [torch_dataset[i]
+                     for i in builtins.range(lo, min(lo + per, n))]
+            yield Block({"item": np.asarray(items, dtype=object)})
+
+    return Dataset(source, (), "from_torch")

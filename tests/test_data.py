@@ -324,3 +324,61 @@ def test_webdataset_optional_files(tmp_path):
     rows = rdata.read_webdataset(str(shard)).take_all()
     assert rows[0]["cls"] == b"1"
     assert rows[1]["cls"] is None  # b has no .cls
+
+
+# ---------------------------------------------------------- round-2 sources
+def test_avro_roundtrip_null_and_deflate(tmp_path, ray_start_regular):
+    from ray_tpu import data
+
+    ds = data.from_items([
+        {"i": i, "x": i * 0.5, "name": f"row{i}", "flag": i % 2 == 0,
+         "vec": [float(i), float(i + 1)]}
+        for i in range(500)
+    ])
+    for codec in ("null", "deflate"):
+        out = str(tmp_path / f"avro_{codec}")
+        ds.write_avro(out, codec=codec)
+        back = data.read_avro(out + "/*.avro").take_all()
+        back.sort(key=lambda r: r["i"])
+        assert len(back) == 500
+        assert back[7]["name"] == "row7"
+        # pandas-backed blocks surface numpy bools (as all readers do);
+        # the codec must preserve boolean TYPE, not degrade to strings
+        assert isinstance(back[7]["flag"], (bool, np.bool_))
+        assert not back[7]["flag"] and back[8]["flag"]
+        assert back[3]["vec"] == [3.0, 4.0]
+        assert abs(back[9]["x"] - 4.5) < 1e-9
+
+
+def test_read_sql_sqlite(tmp_path, ray_start_regular):
+    import sqlite3
+
+    from ray_tpu import data
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, label TEXT, score REAL)")
+    conn.executemany("INSERT INTO items VALUES (?,?,?)",
+                     [(i, f"l{i}", i * 0.1) for i in range(20)])
+    conn.commit()
+    conn.close()
+    ds = data.read_sql("SELECT id, label FROM items WHERE id < 10",
+                       lambda: sqlite3.connect(db))
+    rows = ds.take_all()
+    assert len(rows) == 10 and rows[3]["label"] == "l3"
+
+
+def test_from_torch(ray_start_regular):
+    import torch.utils.data as tud
+
+    from ray_tpu import data
+
+    class Squares(tud.Dataset):
+        def __len__(self):
+            return 17
+
+        def __getitem__(self, i):
+            return i * i
+
+    rows = data.from_torch(Squares(), blocks=4).take_all()
+    assert [r["item"] for r in rows] == [i * i for i in range(17)]
