@@ -1,0 +1,473 @@
+// ray_tpu C++ client API.
+//
+// Parity: the reference's C++ worker API surface (cpp/include/ray/api.h —
+// ray::Init, ray::Task(...).Remote(), ray::Get, actor handles), re-scoped to
+// the cross-language client model: functions/actors are invoked by REGISTERED
+// name on the Python session (the descriptor model of cross_language.py), over
+// the session's JSON-framed xlang endpoint (ray_tpu/experimental/xlang.py).
+// Header-only; no third-party dependencies (a minimal JSON value type and
+// recursive-descent parser are included).
+//
+// Usage:
+//   rtpu::Client c = rtpu::Init("127.0.0.1", port, token);
+//   rtpu::Json r = c.Task("add").Remote(rtpu::Json(1.0), rtpu::Json(2.0));
+//   rtpu::ObjectRef ref = c.Put(rtpu::Json("hello"));
+//   rtpu::Json v = c.Get(ref);
+//   rtpu::Actor a = c.Actor("Counter").Remote();
+//   a.Call("inc");
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace rtpu {
+
+// ----------------------------------------------------------------- JSON
+struct Json {
+  enum Type { Null, Bool, Num, Str, Arr, Obj } type = Null;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  Json() {}
+  Json(bool v) : type(Bool), b(v) {}
+  Json(double v) : type(Num), num(v) {}
+  Json(int v) : type(Num), num(v) {}
+  Json(long v) : type(Num), num(static_cast<double>(v)) {}
+  Json(const char* v) : type(Str), str(v) {}
+  Json(const std::string& v) : type(Str), str(v) {}
+  static Json Array(std::vector<Json> items) {
+    Json j; j.type = Arr; j.arr = std::move(items); return j;
+  }
+  static Json Object() { Json j; j.type = Obj; return j; }
+
+  bool is_null() const { return type == Null; }
+  double AsNum() const {
+    if (type != Num) throw std::runtime_error("json: not a number");
+    return num;
+  }
+  long AsInt() const { return static_cast<long>(AsNum()); }
+  const std::string& AsStr() const {
+    if (type != Str) throw std::runtime_error("json: not a string");
+    return str;
+  }
+  const Json& operator[](const std::string& k) const {
+    static Json null_;
+    auto it = obj.find(k);
+    return it == obj.end() ? null_ : it->second;
+  }
+
+  void Dump(std::ostringstream& o) const {
+    switch (type) {
+      case Null: o << "null"; break;
+      case Bool: o << (b ? "true" : "false"); break;
+      case Num: {
+        if (std::isfinite(num) && num == static_cast<long long>(num) &&
+            std::fabs(num) < 9e15) {
+          o << static_cast<long long>(num);
+        } else {
+          o.precision(17);
+          o << num;
+        }
+        break;
+      }
+      case Str: DumpStr(o, str); break;
+      case Arr: {
+        o << '[';
+        for (size_t i = 0; i < arr.size(); i++) {
+          if (i) o << ',';
+          arr[i].Dump(o);
+        }
+        o << ']';
+        break;
+      }
+      case Obj: {
+        o << '{';
+        bool first = true;
+        for (auto& kv : obj) {
+          if (!first) o << ',';
+          first = false;
+          DumpStr(o, kv.first);
+          o << ':';
+          kv.second.Dump(o);
+        }
+        o << '}';
+        break;
+      }
+    }
+  }
+  std::string Dump() const {
+    std::ostringstream o;
+    Dump(o);
+    return o.str();
+  }
+
+  static void DumpStr(std::ostringstream& o, const std::string& s) {
+    o << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': o << "\\\""; break;
+        case '\\': o << "\\\\"; break;
+        case '\n': o << "\\n"; break;
+        case '\r': o << "\\r"; break;
+        case '\t': o << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            o << buf;
+          } else {
+            o << c;
+          }
+      }
+    }
+    o << '"';
+  }
+};
+
+// Recursive-descent parser (subset sufficient for the xlang protocol:
+// standard JSON with \uXXXX escapes decoded to UTF-8).
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& s) : s_(s) {}
+  Json Parse() {
+    Json v = Value();
+    Ws();
+    if (i_ != s_.size()) throw std::runtime_error("json: trailing data");
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t i_ = 0;
+
+  void Ws() {
+    while (i_ < s_.size() && (s_[i_] == ' ' || s_[i_] == '\t' ||
+                              s_[i_] == '\n' || s_[i_] == '\r'))
+      i_++;
+  }
+  char Peek() {
+    if (i_ >= s_.size()) throw std::runtime_error("json: eof");
+    return s_[i_];
+  }
+  void Expect(char c) {
+    if (Peek() != c) throw std::runtime_error(std::string("json: expected ") + c);
+    i_++;
+  }
+  bool Lit(const char* lit) {
+    size_t n = strlen(lit);
+    if (s_.compare(i_, n, lit) == 0) {
+      i_ += n;
+      return true;
+    }
+    return false;
+  }
+  Json Value() {
+    Ws();
+    char c = Peek();
+    if (c == '{') return ObjectV();
+    if (c == '[') return ArrayV();
+    if (c == '"') {
+      Json j;
+      j.type = Json::Str;
+      j.str = StringV();
+      return j;
+    }
+    if (Lit("true")) return Json(true);
+    if (Lit("false")) return Json(false);
+    if (Lit("null")) return Json();
+    return NumberV();
+  }
+  Json ObjectV() {
+    Expect('{');
+    Json j = Json::Object();
+    Ws();
+    if (Peek() == '}') {
+      i_++;
+      return j;
+    }
+    while (true) {
+      Ws();
+      std::string k = StringV();
+      Ws();
+      Expect(':');
+      j.obj[k] = Value();
+      Ws();
+      if (Peek() == ',') {
+        i_++;
+        continue;
+      }
+      Expect('}');
+      return j;
+    }
+  }
+  Json ArrayV() {
+    Expect('[');
+    Json j;
+    j.type = Json::Arr;
+    Ws();
+    if (Peek() == ']') {
+      i_++;
+      return j;
+    }
+    while (true) {
+      j.arr.push_back(Value());
+      Ws();
+      if (Peek() == ',') {
+        i_++;
+        continue;
+      }
+      Expect(']');
+      return j;
+    }
+  }
+  std::string StringV() {
+    Expect('"');
+    std::string out;
+    while (true) {
+      char c = Peek();
+      i_++;
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = Peek();
+        i_++;
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            unsigned cp = std::stoul(s_.substr(i_, 4), nullptr, 16);
+            i_ += 4;
+            // BMP-only escape decoding (enough for the protocol's ASCII use)
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: out += e;
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+  Json NumberV() {
+    size_t start = i_;
+    while (i_ < s_.size() &&
+           (isdigit(static_cast<unsigned char>(s_[i_])) || s_[i_] == '-' ||
+            s_[i_] == '+' || s_[i_] == '.' || s_[i_] == 'e' || s_[i_] == 'E'))
+      i_++;
+    return Json(std::stod(s_.substr(start, i_ - start)));
+  }
+};
+
+// ----------------------------------------------------------------- client
+struct ObjectRef {
+  std::string id;
+};
+
+class Client;
+
+class TaskCaller {
+ public:
+  TaskCaller(Client* c, std::string func) : c_(c), func_(std::move(func)) {}
+  template <typename... A>
+  Json Remote(A&&... args);  // call-and-wait (reference Task().Remote + Get)
+  template <typename... A>
+  ObjectRef RemoteAsync(A&&... args);  // returns a ref; Get() later
+
+ private:
+  Client* c_;
+  std::string func_;
+};
+
+class Actor {
+ public:
+  Actor() {}
+  Actor(Client* c, std::string id) : c_(c), id_(std::move(id)) {}
+  template <typename... A>
+  Json Call(const std::string& method, A&&... args);
+  void Kill();
+  const std::string& Id() const { return id_; }
+
+ private:
+  Client* c_ = nullptr;  // Call/Kill on a default-constructed Actor throws
+  std::string id_;
+};
+
+class Client {
+ public:
+  Client(const std::string& host, int port, const std::string& token) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host: " + host);
+    if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0)
+      throw std::runtime_error("connect failed");
+    Json hello = Json::Object();
+    hello.obj["op"] = Json("hello");
+    hello.obj["token"] = Json(token);
+    Request(hello);
+  }
+  ~Client() {
+    if (fd_ >= 0) close(fd_);
+  }
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  TaskCaller Task(const std::string& func) { return TaskCaller(this, func); }
+
+  Actor ActorCreate(const std::string& cls, std::vector<Json> args = {}) {
+    Json m = Json::Object();
+    m.obj["op"] = Json("actor_create");
+    m.obj["cls"] = Json(cls);
+    m.obj["args"] = Json::Array(std::move(args));
+    return Actor(this, Request(m)["actor"].AsStr());
+  }
+
+  ObjectRef Put(const Json& value) {
+    Json m = Json::Object();
+    m.obj["op"] = Json("put");
+    m.obj["value"] = value;
+    return ObjectRef{Request(m)["ref"].AsStr()};
+  }
+
+  Json Get(const ObjectRef& ref) {
+    Json m = Json::Object();
+    m.obj["op"] = Json("get");
+    m.obj["ref"] = Json(ref.id);
+    return Request(m);
+  }
+
+  // Release the server-held borrow for a Put()/RemoteAsync() ref; without
+  // this a long-lived client pins every object for the server's lifetime.
+  void Free(const ObjectRef& ref) {
+    Json m = Json::Object();
+    m.obj["op"] = Json("free");
+    m.obj["ref"] = Json(ref.id);
+    Request(m);
+  }
+
+  std::vector<std::string> ListFuncs() {
+    Json m = Json::Object();
+    m.obj["op"] = Json("list_funcs");
+    Json r = Request(m);
+    std::vector<std::string> out;
+    for (auto& f : r["funcs"].arr) out.push_back(f.AsStr());
+    return out;
+  }
+
+  // one in-flight request per client (callers wanting parallelism open
+  // multiple clients — connections are cheap)
+  Json Request(Json msg) {
+    msg.obj["id"] = Json(static_cast<double>(++next_id_));
+    std::string body = msg.Dump();
+    uint32_t n = htonl(static_cast<uint32_t>(body.size()));
+    SendAll(reinterpret_cast<const char*>(&n), 4);
+    SendAll(body.data(), body.size());
+    char hdr[4];
+    RecvAll(hdr, 4);
+    uint32_t len;
+    memcpy(&len, hdr, 4);
+    len = ntohl(len);
+    std::string reply(len, '\0');
+    RecvAll(&reply[0], len);
+    Json r = JsonParser(reply).Parse();
+    if (!r["error"].is_null())
+      throw std::runtime_error("remote error: " + r["error"].AsStr());
+    return r["result"];
+  }
+
+ private:
+  void SendAll(const char* p, size_t n) {
+    while (n) {
+      ssize_t k = send(fd_, p, n, 0);
+      if (k <= 0) throw std::runtime_error("send failed");
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+  }
+  void RecvAll(char* p, size_t n) {
+    while (n) {
+      ssize_t k = recv(fd_, p, n, 0);
+      if (k <= 0) throw std::runtime_error("recv failed (server closed?)");
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+  }
+  int fd_ = -1;
+  uint64_t next_id_ = 0;
+};
+
+template <typename... A>
+Json TaskCaller::Remote(A&&... args) {
+  Json m = Json::Object();
+  m.obj["op"] = Json("call");
+  m.obj["func"] = Json(func_);
+  m.obj["args"] = Json::Array({Json(std::forward<A>(args))...});
+  return c_->Request(m);
+}
+
+template <typename... A>
+ObjectRef TaskCaller::RemoteAsync(A&&... args) {
+  Json m = Json::Object();
+  m.obj["op"] = Json("submit");
+  m.obj["func"] = Json(func_);
+  m.obj["args"] = Json::Array({Json(std::forward<A>(args))...});
+  return ObjectRef{c_->Request(m)["ref"].AsStr()};
+}
+
+template <typename... A>
+Json Actor::Call(const std::string& method, A&&... args) {
+  if (c_ == nullptr) throw std::runtime_error("Actor not initialized");
+  Json m = Json::Object();
+  m.obj["op"] = Json("actor_call");
+  m.obj["actor"] = Json(id_);
+  m.obj["method"] = Json(method);
+  m.obj["args"] = Json::Array({Json(std::forward<A>(args))...});
+  return c_->Request(m);
+}
+
+inline void Actor::Kill() {
+  if (c_ == nullptr) throw std::runtime_error("Actor not initialized");
+  Json m = Json::Object();
+  m.obj["op"] = Json("kill_actor");
+  m.obj["actor"] = Json(id_);
+  c_->Request(m);
+}
+
+inline Client Init(const std::string& host, int port, const std::string& token) {
+  return Client(host, port, token);
+}
+
+}  // namespace rtpu
