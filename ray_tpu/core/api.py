@@ -482,8 +482,11 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
     lifetime: str | None = None,
+    _slice_name: str | None = None,
 ) -> PlacementGroup:
-    """Reference: util/placement_group.py:133; strategies protobuf common.proto:1088."""
+    """Reference: util/placement_group.py:133; strategies protobuf common.proto:1088.
+    ``_slice_name`` pins all bundles to one TPU slice's nodes (whole-slice
+    reservations, util/tpu.py SlicePlacementGroup)."""
     if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
         raise ValueError(f"Invalid placement strategy: {strategy}")
     if not bundles:
@@ -492,7 +495,8 @@ def placement_group(
         if not b or any(v < 0 for v in b.values()):
             raise ValueError(f"Invalid bundle: {b}")
     rt = get_runtime()
-    state = rt.scheduler.create_placement_group(bundles, strategy, name)
+    state = rt.scheduler.create_placement_group(bundles, strategy, name,
+                                                slice_name=_slice_name)
     return PlacementGroup(state)
 
 

@@ -94,6 +94,9 @@ class PlacementGroupState:
     strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
     name: str = ""
     state: str = "PENDING"  # PENDING -> CREATED -> REMOVED
+    # pin every bundle to ONE slice's nodes (whole-slice reservations,
+    # util/tpu.py SlicePlacementGroup semantics)
+    slice_name: "str | None" = None
     ready_event: threading.Event = field(default_factory=threading.Event)
 
     def group_resource_name(self, base: str, index: int | None = None) -> str:
@@ -222,11 +225,13 @@ class ClusterScheduler:
 
     # --- placement groups (2PC: prepare all bundles, then commit) ---
     def create_placement_group(
-        self, bundles: list[dict[str, float]], strategy: str, name: str = ""
+        self, bundles: list[dict[str, float]], strategy: str, name: str = "",
+        slice_name: "str | None" = None,
     ) -> PlacementGroupState:
         pg_id = PlacementGroupID.from_random()
         pg = PlacementGroupState(
-            pg_id, [Bundle(i, ResourceSet(b)) for i, b in enumerate(bundles)], strategy, name
+            pg_id, [Bundle(i, ResourceSet(b)) for i, b in enumerate(bundles)],
+            strategy, name, slice_name=slice_name,
         )
         with self._lock:
             self._pgs[pg_id] = pg
@@ -258,6 +263,8 @@ class ClusterScheduler:
 
     def _plan_bundles(self, pg: PlacementGroupState) -> list[NodeState] | None:
         nodes = [n for n in self._nodes.values() if n.alive]
+        if pg.slice_name is not None:
+            nodes = [n for n in nodes if n.slice_name == pg.slice_name]
         if not nodes:
             return None
         avail = {n.node_id: n.available.copy() for n in nodes}

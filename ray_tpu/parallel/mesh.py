@@ -149,6 +149,36 @@ def multislice_env(coordinator_address: str, num_slices: int, slice_id: int) -> 
     }
 
 
+def dcn_mesh(num_slices: int, ici_axes: "dict[str, int] | None" = None,
+             devices: Optional[Sequence] = None):
+    """Mesh whose LEADING axis spans slices (DCN) and whose remaining axes
+    tile each slice's devices (ICI). Data-parallel gradients reduce over
+    'dcn' via the slower cross-slice links while model axes stay inside a
+    slice — the standard multislice layout (scaling-book recipe; the
+    reference delegates this to the training framework).
+
+    Device order: jax.devices() is process-ordered and multislice gangs
+    launch slice-major (train/gang.py run_multislice_gang), so a contiguous
+    reshape puts each slice's devices on one 'dcn' row.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) % num_slices:
+        raise ValueError(f"{len(devs)} devices not divisible by {num_slices} slices")
+    per_slice = len(devs) // num_slices
+    ici_axes = dict(ici_axes or {"data": per_slice})
+    ici_total = 1
+    for n in ici_axes.values():
+        ici_total *= n
+    if ici_total != per_slice:
+        raise ValueError(f"ici axes {ici_axes} != {per_slice} devices/slice")
+    arr = np.array(devs).reshape(num_slices, *ici_axes.values())
+    return Mesh(arr, ("dcn", *ici_axes.keys()))
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

@@ -4,3 +4,5 @@ from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.queue import Empty, Full, Queue
 
 __all__ = ["ActorPool", "Queue", "Empty", "Full"]
+
+from ray_tpu.util import tpu  # noqa: E402,F401  (slice reservation API)

@@ -1,0 +1,96 @@
+"""TPU slice reservation: whole-slice gang placement.
+
+Parity: python/ray/util/tpu.py — SlicePlacementGroup (:420) reserves an
+entire TPU slice by claiming its head resource and pinning every bundle to
+that slice's nodes via the slice-name label; reserve_tpu_slice
+(_private/accelerators/tpu.py:269) is the claim primitive;
+get_tpu_coordinator_env_vars (:212) builds the MEGASCALE env (here
+parallel.mesh.multislice_env).
+
+In this runtime, nodes carry ``slice_name`` + ICI coordinates at
+registration (core/scheduler.py NodeState); a slice reservation is a
+STRICT_SPREAD placement group label-pinned to one slice's hosts, so the gang
+lands on exactly the slice's nodes and the derived bundle resources give
+each worker its host's chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class SliceInfo:
+    slice_name: str
+    num_hosts: int
+    chips_per_host: int
+    placement_group: object  # PlacementGroup handle reserving the hosts
+
+
+def list_slices() -> dict[str, list]:
+    """slice_name -> that slice's nodes (topology registered at node join)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    out: dict[str, list] = {}
+    for node in get_runtime().scheduler.nodes():
+        if node.slice_name:
+            out.setdefault(node.slice_name, []).append(node)
+    return out
+
+
+def reserve_tpu_slice(slice_name: Optional[str] = None,
+                      chips_per_host: Optional[int] = None,
+                      timeout: float = 60.0) -> SliceInfo:
+    """Reserve one whole slice for a gang (reference: SlicePlacementGroup
+    util/tpu.py:420 + reserve_tpu_slice accelerators/tpu.py:269).
+
+    Picks ``slice_name`` (or the first fully-idle slice), then creates a
+    STRICT_SPREAD placement group with one TPU bundle per host, pinned to
+    the slice, and waits for it to commit. A failed/timed-out reservation
+    removes its pending group (no phantom claims on the slice)."""
+    slices = list_slices()
+    if not slices:
+        raise RuntimeError("no TPU slices registered in this cluster")
+    if slice_name is None:
+        idle = [s for s, nodes in sorted(slices.items())
+                if all(n.available.get("TPU", 0) == n.total.get("TPU", 0)
+                       for n in nodes)]
+        if not idle:
+            raise RuntimeError(
+                f"no fully-idle slice to auto-pick from {sorted(slices)}; "
+                "name one explicitly to queue on it")
+        slice_name = idle[0]
+    if slice_name not in slices:
+        raise ValueError(f"unknown slice {slice_name!r}; have {sorted(slices)}")
+    nodes = slices[slice_name]
+    chips = chips_per_host
+    if chips is None:
+        chips = int(min(n.total.get("TPU", 0) for n in nodes))
+        if chips <= 0:
+            raise ValueError(
+                f"slice {slice_name!r} has nodes without TPU resources; "
+                "fix node registration or pass chips_per_host")
+    from ray_tpu.core.api import placement_group, remove_placement_group
+
+    pg = placement_group(
+        bundles=[{"TPU": float(chips)} for _ in nodes],
+        strategy="STRICT_SPREAD",
+        name=f"slice-{slice_name}",
+        _slice_name=slice_name,
+    )
+    if not pg.wait(timeout):
+        remove_placement_group(pg)  # don't leave a phantom claim queued
+        raise TimeoutError(
+            f"slice {slice_name!r} not reservable within {timeout}s")
+    return SliceInfo(slice_name=slice_name, num_hosts=len(nodes),
+                     chips_per_host=chips, placement_group=pg)
+
+
+def get_tpu_coordinator_env_vars(coordinator_address: str, num_slices: int,
+                                 slice_id: int) -> dict[str, str]:
+    """Reference: util/tpu.py:212 — re-exported from parallel.mesh so the
+    train and serve layers share one MEGASCALE builder."""
+    from ray_tpu.parallel.mesh import multislice_env
+
+    return multislice_env(coordinator_address, num_slices, slice_id)

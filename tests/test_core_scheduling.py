@@ -161,3 +161,53 @@ def test_pending_pg_places_when_resources_free(ray_start_regular):
     assert not pg.wait(0.1)  # blocked by the hog
     assert ray_tpu.get(h) == 1
     assert pg.wait(5)  # placed after release
+
+
+# ------------------------------------------------------- TPU slice reservation
+def test_reserve_tpu_slice_pins_pg_to_one_slice(ray_start_regular):
+    """Reference: util/tpu.py:420 SlicePlacementGroup — a whole-slice gang
+    reservation lands every bundle on the named slice's hosts only."""
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.util import tpu as tpu_util
+
+    rt = get_runtime()
+    a = [rt.scheduler.add_node({"CPU": 1, "TPU": 4}, slice_name="slice-a",
+                               ici_coords=(0, i, 0)) for i in range(2)]
+    b = [rt.scheduler.add_node({"CPU": 1, "TPU": 4}, slice_name="slice-b",
+                               ici_coords=(1, i, 0)) for i in range(2)]
+
+    slices = tpu_util.list_slices()
+    assert set(slices) == {"slice-a", "slice-b"}
+
+    info = tpu_util.reserve_tpu_slice("slice-b", timeout=30)
+    assert info.num_hosts == 2 and info.chips_per_host == 4
+    placed = {bb.node_id for bb in info.placement_group._state.bundles}
+    assert placed == set(b)  # every bundle on slice-b, one per host
+
+    # the other slice remains reservable
+    info_a = tpu_util.reserve_tpu_slice("slice-a", timeout=30)
+    placed_a = {bb.node_id for bb in info_a.placement_group._state.bundles}
+    assert placed_a == set(a)
+
+    with pytest.raises(ValueError, match="unknown slice"):
+        tpu_util.reserve_tpu_slice("slice-z")
+
+
+def test_reserve_tpu_slice_timeout_removes_pending_pg(ray_start_regular):
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.util import tpu as tpu_util
+
+    rt = get_runtime()
+    rt.scheduler.add_node({"CPU": 1, "TPU": 4}, slice_name="slice-busy")
+    first = tpu_util.reserve_tpu_slice("slice-busy", timeout=10)
+    with pytest.raises(TimeoutError):
+        tpu_util.reserve_tpu_slice("slice-busy", timeout=0.3)
+    # the failed attempt must not leave a phantom PENDING group that would
+    # claim the slice when the first reservation releases
+    pending = [p for p in rt.scheduler.placement_groups()
+               if p.state == "PENDING" and p.slice_name == "slice-busy"]
+    assert pending == []
+    import ray_tpu
+    ray_tpu.remove_placement_group(first.placement_group)
+    again = tpu_util.reserve_tpu_slice("slice-busy", timeout=10)
+    assert again.num_hosts == 1

@@ -116,3 +116,45 @@ def test_jax_trainer_distributed_gang():
     outs = res.metrics["gang"]
     assert [o["rank"] for o in outs] == [0, 1]
     assert all(o["procs"] == 2 and o["devices"] == 4 for o in outs)
+
+
+def test_multislice_gang_dcn_mesh():
+    """Multislice activation: 2 slices x 1 host in ONE jax.distributed world,
+    per-slice MEGASCALE env injected, cross-slice dp over the 'dcn' axis
+    (reference: util/tpu.py:212 coordinator env + config.py:29-35 injection)."""
+    from ray_tpu.train.gang import run_multislice_gang
+
+    def member(slice_id: int, rank: int):
+        import os
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.parallel.mesh import dcn_mesh
+
+        assert os.environ["MEGASCALE_SLICE_ID"] == str(slice_id)
+        assert os.environ["MEGASCALE_NUM_SLICES"] == "2"
+        mesh = dcn_mesh(2, {"data": 2})
+        assert mesh.axis_names == ("dcn", "data") and mesh.devices.shape == (2, 2)
+        # a dp reduction spanning BOTH axes: every device contributes its
+        # global position; the psum must see all 4 contributions
+        sh = NamedSharding(mesh, P(("dcn", "data")))
+        x = jax.make_array_from_process_local_data(
+            sh, jnp.arange(2) + 2 * jax.process_index(), (4,))
+
+        @jax.jit
+        def total(v):
+            return v.sum()
+
+        return {"slice_id": slice_id, "rank": rank,
+                "sum": float(total(x)),
+                "num_devices": len(jax.devices())}
+
+    out = run_multislice_gang(member, num_slices=2, hosts_per_slice=1,
+                              devices_per_host=2, timeout=600)
+    assert len(out) == 2  # one member per (slice, host)
+    for r in out:
+        assert r["num_devices"] == 4
+        assert r["sum"] == 6.0  # 0+1+2+3 across both slices
+    assert sorted(r["slice_id"] for r in out) == [0, 1]
