@@ -1,0 +1,132 @@
+"""Mutable shared-memory channels: the compiled-graph transport primitive.
+
+Parity: the reference's experimental mutable plasma objects
+(core_worker/experimental_mutable_object_manager.cc) and the shared-memory
+channels built on them (experimental/channel/shared_memory_channel.py) —
+a fixed buffer written in place per DAG execution, with writer/reader
+synchronization instead of per-call RPC + allocation.
+
+Mechanism here: one POSIX shm segment per channel carrying a seqlock header
+  [u64 version][u64 acked][u64 len][u32 closed]
+and a fixed payload area. The writer bumps version to ODD while copying,
+EVEN when sealed; a reader spins/sleeps until an unseen EVEN version, copies
+out, re-checks the version (seqlock), then stores it into `acked`. The writer
+waits for acked == version before the next write — capacity-1 backpressure,
+exactly the mutable-object semantics (writer blocks until readers consumed).
+
+Single-writer / single-reader per channel (a compiled DAG edge); ping-pong
+pairs give bidirectional driver<->worker loops (dag/__init__.py shm mode).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from multiprocessing import shared_memory
+
+_HDR = struct.Struct("<QQQI")  # version, acked, len, closed
+HEADER_SIZE = _HDR.size
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ShmChannel:
+    def __init__(self, name: str | None = None, capacity: int = 1 << 20,
+                 create: bool = True):
+        if create:
+            self._shm = shared_memory.SharedMemory(
+                name=name, create=True, size=HEADER_SIZE + capacity)
+            _HDR.pack_into(self._shm.buf, 0, 0, 0, 0, 0)
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+        self.name = self._shm.name
+        self.capacity = self._shm.size - HEADER_SIZE
+        self._created = create
+
+    # ------------------------------------------------------------- header
+    def _hdr(self):
+        return _HDR.unpack_from(self._shm.buf, 0)
+
+    def _set_version(self, v: int) -> None:
+        struct.pack_into("<Q", self._shm.buf, 0, v)
+
+    def _set_acked(self, v: int) -> None:
+        struct.pack_into("<Q", self._shm.buf, 8, v)
+
+    def _set_len(self, n: int) -> None:
+        struct.pack_into("<Q", self._shm.buf, 16, n)
+
+    # -------------------------------------------------------------- write
+    def write(self, payload: bytes, timeout: float | None = 30.0) -> None:
+        """Blocks until the previous value was consumed (capacity-1
+        backpressure), then publishes `payload` under the seqlock."""
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"payload {len(payload)} > channel capacity {self.capacity}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:
+            version, acked, _, closed = self._hdr()
+            if closed:
+                raise ChannelClosed(self.name)
+            if acked == version:
+                break
+            spins += 1
+            if spins > 1000:
+                time.sleep(0.0005)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} writer stalled "
+                                   "(reader not consuming)")
+        self._set_version(version + 1)  # odd: write in progress
+        self._shm.buf[HEADER_SIZE:HEADER_SIZE + len(payload)] = payload
+        self._set_len(len(payload))
+        self._set_version(version + 2)  # even: sealed
+
+    # --------------------------------------------------------------- read
+    def read(self, last_version: int = 0,
+             timeout: float | None = 30.0) -> tuple[int, bytes]:
+        """Blocks for a version newer than `last_version`; returns
+        (version, payload) and acks it (unblocking the writer)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:
+            version, _, n, closed = self._hdr()
+            if version > last_version and version % 2 == 0:
+                payload = bytes(self._shm.buf[HEADER_SIZE:HEADER_SIZE + n])
+                v2 = self._hdr()[0]
+                if v2 == version:  # seqlock: unchanged during our copy
+                    self._set_acked(version)
+                    return version, payload
+                continue  # torn read: retry
+            if closed:
+                raise ChannelClosed(self.name)
+            spins += 1
+            if spins > 1000:
+                time.sleep(0.0005)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} reader timed out")
+
+    # ---------------------------------------------------------- lifecycle
+    def close_channel(self) -> None:
+        """Mark closed (wakes both ends with ChannelClosed)."""
+        try:
+            struct.pack_into("<I", self._shm.buf, 24, 1)
+        except (ValueError, TypeError):
+            pass
+
+    def detach(self) -> None:
+        try:
+            self._shm.close()
+        except Exception:
+            pass
+
+    def destroy(self) -> None:
+        self.close_channel()
+        self.detach()
+        if self._created:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass

@@ -14,6 +14,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import ray_tpu
@@ -51,8 +52,13 @@ class DAGNode:
     def _run(self, args, kwargs, input_args):
         raise NotImplementedError
 
-    def experimental_compile(self) -> "CompiledDAG":
-        """Reference: dag_node.py:283."""
+    def experimental_compile(self, channel: str | None = None):
+        """Reference: dag_node.py:283. ``channel="shm"`` runs a function-node
+        pipeline in a dedicated worker process fed by mutable shm channels
+        (no per-execute RPC; core/shm_channel.py) — the compiled-graph
+        data-plane the reference builds on mutable plasma objects."""
+        if channel == "shm":
+            return ShmCompiledDAG(self)
         return CompiledDAG(self)
 
 
@@ -166,6 +172,184 @@ class CompiledDAG:
                 self._results[seq].put(("err", err))
         except queue.Empty:
             pass
+
+
+class ShmCompiledDAG:
+    """Function pipeline on a persistent worker process, driven through two
+    mutable shm channels (reference: compiled graphs over shared-memory
+    channels, experimental/channel/shared_memory_channel.py). Per-execute
+    cost is two channel writes — no task submission, no control plane.
+
+    A drain thread continuously acks the output channel into a result buffer,
+    so the worker never blocks on un-fetched results and any number of
+    executes may be in flight (execute() itself only waits for the worker to
+    pick up the previous input — the natural depth-2 pipeline backpressure)."""
+
+    def __init__(self, output_node: DAGNode, channel_capacity: int = 1 << 20):
+        import subprocess
+        import sys as _sys
+
+        import cloudpickle
+
+        from ray_tpu.core.process_pool import worker_env
+        from ray_tpu.core.shm_channel import ShmChannel
+
+        self._in_ch = ShmChannel(capacity=channel_capacity)
+        self._out_ch = ShmChannel(capacity=channel_capacity)
+        self._proc = None
+        try:
+            self._proc = subprocess.Popen(
+                [_sys.executable, "-m", "ray_tpu.dag.shm_worker",
+                 self._in_ch.name, self._out_ch.name],
+                env=worker_env(),
+            )
+            self._in_ch.write(cloudpickle.dumps(output_node), timeout=60.0)
+        except BaseException:
+            # nothing reaches the caller: clean up or the segments +
+            # subprocess leak with no handle to teardown()
+            if self._proc is not None:
+                self._proc.kill()
+            self._in_ch.destroy()
+            self._out_ch.destroy()
+            raise
+        self._seq = 0
+        self._buffer: dict[int, tuple] = {}
+        self._cond = threading.Condition()  # guards _buffer/_dead ONLY
+        # separate lock for seq allocation + input write: holding _cond
+        # across a (possibly blocking) channel write would starve the drain
+        # thread and deadlock the pipeline (worker can't publish results)
+        self._exec_lock = threading.Lock()
+        self._running = True
+        self._dead: str | None = None
+        self._drain = threading.Thread(target=self._drain_loop, daemon=True)
+        self._drain.start()
+
+    def _drain_loop(self) -> None:
+        """Ack every result as it lands so the worker never stalls on
+        un-fetched outputs; flag worker death promptly for getters."""
+        import cloudpickle
+
+        from ray_tpu.core.shm_channel import ChannelClosed
+
+        last = 0
+        while self._running:
+            try:
+                last, frame = self._out_ch.read(last, timeout=0.5)
+            except TimeoutError:
+                if self._proc.poll() is not None:
+                    with self._cond:
+                        self._dead = (f"shm DAG worker died "
+                                      f"(rc={self._proc.returncode})")
+                        self._cond.notify_all()
+                    return
+                continue
+            except ChannelClosed:
+                with self._cond:
+                    self._dead = "shm DAG channel closed"
+                    self._cond.notify_all()
+                return
+            got_seq, status, payload = cloudpickle.loads(frame)
+            with self._cond:
+                self._buffer[got_seq] = (status, payload)
+                self._cond.notify_all()
+
+    def execute(self, *input_args) -> "CompiledDAGRef":
+        import cloudpickle
+
+        if not self._running:
+            raise RuntimeError("ShmCompiledDAG was torn down")
+        with self._cond:
+            if self._dead:
+                raise RuntimeError(self._dead)
+        with self._exec_lock:
+            seq = self._seq
+            # blocks only until the worker picks up the PREVIOUS input
+            self._in_ch.write(cloudpickle.dumps((seq, input_args)), timeout=60.0)
+            self._seq += 1  # incremented only after the frame is really sent
+        return CompiledDAGRef(self, seq)
+
+    def get(self, seq: int, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while seq not in self._buffer:
+                if self._dead:
+                    raise RuntimeError(self._dead)
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                if remaining == 0.0 or not self._cond.wait(timeout=remaining):
+                    if seq in self._buffer or self._dead:
+                        continue
+                    raise TimeoutError(
+                        f"shm DAG execution {seq} did not finish in {timeout}s")
+            status, payload = self._buffer.pop(seq)
+        if status == "err":
+            raise payload
+        return payload
+
+    def teardown(self) -> None:
+        self._running = False
+        self._in_ch.close_channel()
+        self._out_ch.close_channel()
+        try:
+            self._proc.wait(timeout=5)
+        except Exception:
+            self._proc.kill()
+        self._in_ch.destroy()
+        self._out_ch.destroy()
+
+
+class CollectiveOutputNode(DAGNode):
+    """Gang collective as a DAG node (reference: dag/collective_node.py:212
+    allreduce + CollectiveOutputNode :252): binds one method call per gang
+    actor; the node's value is the elementwise allreduce of all members'
+    outputs — members run concurrently, the reduction happens once."""
+
+    def __init__(self, member_nodes: list, op: str = "sum"):
+        super().__init__(tuple(member_nodes), {})
+        if op not in ("sum", "max", "min"):
+            raise ValueError(f"unsupported collective op {op!r}")
+        if not member_nodes:
+            raise ValueError("collective needs at least one member node")
+        for m in member_nodes:
+            if not isinstance(m, ClassMethodNode):
+                raise ValueError(
+                    f"collective members must be actor-method nodes, "
+                    f"got {type(m).__name__}")
+        self._op = op
+
+    def _exec(self, cache: dict, input_args: tuple):
+        # override: members launch CONCURRENTLY (refs first, one gather),
+        # not sequentially like generic arg evaluation
+        if id(self) in cache:
+            return cache[id(self)]
+        refs = []
+        for m in self._bound_args:
+            args = [a._exec(cache, input_args) if isinstance(a, DAGNode) else a
+                    for a in m._bound_args]
+            kwargs = {k: (v._exec(cache, input_args) if isinstance(v, DAGNode) else v)
+                      for k, v in m._bound_kwargs.items()}
+            refs.append(getattr(m._handle, m._method_name).remote(*args, **kwargs))
+        outs = ray_tpu.get(refs)
+        import numpy as np
+
+        acc = np.asarray(outs[0])
+        for o in outs[1:]:
+            if self._op == "sum":
+                acc = acc + np.asarray(o)
+            elif self._op == "max":
+                acc = np.maximum(acc, np.asarray(o))
+            else:
+                acc = np.minimum(acc, np.asarray(o))
+        cache[id(self)] = acc
+        return acc
+
+    def _run(self, args, kwargs, input_args):  # pragma: no cover - _exec overridden
+        raise AssertionError
+
+
+def allreduce_bind(member_nodes: list, op: str = "sum") -> CollectiveOutputNode:
+    """Reference: collective_node.py allreduce.bind over gang actors."""
+    return CollectiveOutputNode(member_nodes, op)
 
 
 class CompiledDAGRef:

@@ -1,0 +1,71 @@
+"""Compiled-graph worker process: executes a function pipeline fed by
+mutable shm channels (reference: the compiled-graph execution loop living on
+persistent workers with preallocated channels, compiled_dag_node.py — the
+point is NO per-call RPC/scheduling at steady state).
+
+Protocol: the driver sends one INIT frame (cloudpickled output node) on the
+input channel, then per execution a (seq, args) frame; this process replies
+(seq, "ok"/"err", payload) on the output channel. FunctionNodes run their raw
+underlying callables inline — the whole pipeline is local to this process,
+the channels are the only boundary.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _exec_inline(node, cache, input_args):
+    """DAGNode._exec with FunctionNodes unwrapped to their raw callables."""
+    from ray_tpu.dag import DAGNode, FunctionNode, InputNode
+
+    if id(node) in cache:
+        return cache[id(node)]
+    args = [_exec_inline(a, cache, input_args) if isinstance(a, DAGNode) else a
+            for a in node._bound_args]
+    kwargs = {k: (_exec_inline(v, cache, input_args) if isinstance(v, DAGNode) else v)
+              for k, v in node._bound_kwargs.items()}
+    if isinstance(node, InputNode):
+        out = input_args[0] if len(input_args) == 1 else input_args
+    elif isinstance(node, FunctionNode):
+        out = node._fn._fn(*args, **kwargs)  # raw callable, in-process
+    else:
+        raise TypeError(
+            f"shm-compiled pipelines support function/input nodes only, "
+            f"got {type(node).__name__}")
+    cache[id(node)] = out
+    return out
+
+
+def main(in_name: str, out_name: str) -> None:
+    import cloudpickle
+
+    from ray_tpu.core.shm_channel import ChannelClosed, ShmChannel
+
+    cin = ShmChannel(name=in_name, create=False)
+    cout = ShmChannel(name=out_name, create=False)
+    last = 0
+    last, blob = cin.read(last, timeout=60.0)
+    output_node = cloudpickle.loads(blob)
+    try:
+        while True:
+            try:
+                last, frame = cin.read(last, timeout=None)
+            except ChannelClosed:
+                return
+            seq, input_args = cloudpickle.loads(frame)
+            try:
+                result = (seq, "ok", _exec_inline(output_node, {}, input_args))
+            except BaseException as e:  # noqa: BLE001 — error crosses the channel
+                result = (seq, "err", e)
+            try:
+                cout.write(cloudpickle.dumps(result), timeout=None)
+            except ChannelClosed:
+                return
+    finally:
+        cin.detach()
+        cout.detach()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])
