@@ -188,6 +188,7 @@ class Runtime:
             config.session_dir_prefix, f"session_{self.job_id.hex()[:12]}"
         )
         self.spill = None
+        _sweep_stale_node_segments()
         if _os.environ.get("RAY_TPU_DISABLE_SHM") != "1":
             try:
                 from ray_tpu.core.shm_store import SharedMemoryStore
@@ -1754,6 +1755,35 @@ class Runtime:
 
 _RETRY = object()
 _NO_STORE = object()
+
+
+def _sweep_stale_node_segments() -> None:
+    """GC /dev/shm segments leaked by kill -9'd isolated-plane agents: their
+    names carry the owning pid (node_agent.py /rtpu_node_<pid>), so a dead
+    owner means nobody will ever unlink the segment. Swept at session start
+    (reference: ray's session-dir GC of a previous session's leftovers)."""
+    import os as _os
+    import re as _re
+
+    try:
+        names = _os.listdir("/dev/shm")
+    except OSError:
+        return
+    for name in names:
+        m = _re.fullmatch(r"rtpu_node_(\d+)", name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        try:
+            _os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                _os.unlink(_os.path.join("/dev/shm", name))
+                logger.info("swept stale node-store segment %s (pid %d dead)", name, pid)
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # pid exists under another uid: not ours to sweep
 
 
 def _rough_size(value: Any) -> int:

@@ -1,0 +1,64 @@
+"""Perf sweep on the attached TPU (dev tool, one config per process).
+
+Usage: python -m ray_tpu.scripts.tpu_sweep <config>   # A|B|C|D|E
+Same measurement shape as bench.py (init/warmup/timed steps, 6N FLOPs MFU);
+when a config wins, promote it into bench.py's on-chip LlamaConfig.
+Runs exactly one config then exits cleanly — never run two at once and never
+kill it (tunnel discipline: a killed client wedges the tunnel for hours).
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ray_tpu.models import llama
+from ray_tpu.train import spmd
+
+
+def run(name, cfg, batch, seqlen, iters=15):
+    dev = jax.devices()[0]
+    assert dev.platform != "cpu", dev
+    mesh = Mesh(np.asarray([dev]).reshape(1, 1, 1, 1, 1),
+                ("data", "fsdp", "tensor", "seq", "expert"))
+    key = jax.random.PRNGKey(0)
+    with jax.default_device(dev):
+        state = spmd.init_state(cfg, key, optimizer=spmd.make_optimizer(warmup=1))
+        step = spmd.make_train_step(cfg, mesh,
+                                    optimizer=spmd.make_optimizer(warmup=1))(state)
+        tokens = jax.random.randint(key, (batch, seqlen), 0, cfg.vocab_size)
+        targets = jax.random.randint(key, (batch, seqlen), 0, cfg.vocab_size)
+        state, m = step(state, tokens, targets)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, tokens, targets)
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+    tps = batch * seqlen * iters / dt
+    n = llama.param_count_analytic(cfg)
+    print(json.dumps({"config": name, "tokens_per_sec": round(tps, 1),
+                      "mfu_6n": round(tps * 6 * n / 197e12, 4),
+                      "params_m": round(n / 1e6)}), flush=True)
+
+
+BASE = dict(vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+            num_layers=16, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+            rope_theta=10000.0, dtype=jnp.bfloat16)
+BIG = dict(vocab_size=32000, hidden_size=2048, intermediate_size=8192,
+           num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+           rope_theta=10000.0, dtype=jnp.bfloat16)
+
+CONFIGS = {
+    "A": ("A_full_bs8", llama.LlamaConfig(**BASE, remat=True), 8, 2048),
+    "B": ("B_dots_bs8", llama.LlamaConfig(**BASE, remat=True, remat_policy="dots"), 8, 2048),
+    "C": ("C_dots_bs16", llama.LlamaConfig(**BASE, remat=True, remat_policy="dots"), 16, 2048),
+    "D": ("D_big_dots_bs8", llama.LlamaConfig(**BIG, remat=True, remat_policy="dots"), 8, 2048),
+    "E": ("E_big_full_bs16", llama.LlamaConfig(**BIG, remat=True), 16, 2048),
+}
+
+if __name__ == "__main__":
+    run(*CONFIGS[sys.argv[1] if len(sys.argv) > 1 else "A"])

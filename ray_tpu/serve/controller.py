@@ -12,6 +12,8 @@ Parity map (reference python/ray/serve/_private/):
 
 from __future__ import annotations
 
+import logging
+
 import inspect
 import random
 import threading
@@ -21,6 +23,8 @@ from typing import Any, Optional
 
 import ray_tpu
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, DeploymentConfig
+
+logger = logging.getLogger("ray_tpu.serve")
 
 CONTROLLER_NAME = "_serve_controller"
 
@@ -177,6 +181,33 @@ class ServeController:
         (version bump) replaces all running replicas so new code/config serve
         (reference: DeploymentState rolling update — here stop-then-start)."""
         name = deployment.config.name
+        if deployment.config.ray_actor_options.get("isolate_process"):
+            # process replicas can't host streaming-generator methods yet
+            # (runtime limitation) — fail at DEPLOY time, not per request
+            import inspect
+
+            target = deployment.func_or_class
+            gen_methods = [
+                m for m, fn in inspect.getmembers(target, callable)
+                if (m == "__call__" or not m.startswith("_"))
+                and (inspect.isgeneratorfunction(fn)
+                     or inspect.isasyncgenfunction(fn))
+            ] if inspect.isclass(target) else (
+                [target.__name__] if inspect.isgeneratorfunction(target) else []
+            )
+            if gen_methods:
+                raise ValueError(
+                    f"deployment {name!r}: isolate_process replicas do not "
+                    f"support streaming generator handlers yet ({gen_methods})"
+                )
+            if deployment.config.max_ongoing_requests > 1:
+                logger.warning(
+                    "deployment %r: isolate_process replicas serialize "
+                    "requests (max_concurrency=1); max_ongoing_requests=%d "
+                    "will not give intra-replica concurrency — scale "
+                    "num_replicas instead",
+                    name, deployment.config.max_ongoing_requests,
+                )
         old_replicas: list = []
         with self._lock:
             st = self._deployments.get(name)
@@ -387,6 +418,10 @@ class ServeController:
                     num_cpus=opts.get("num_cpus", 1.0),
                     num_tpus=opts.get("num_tpus", 0.0),
                     max_concurrency=max(4, cfg.max_ongoing_requests),
+                    # process-backed replicas: a blocking/CPU-bound handler
+                    # can't stall sibling replicas through the GIL
+                    # (reference: every serve replica is its own worker proc)
+                    isolate_process=opts.get("isolate_process"),
                 )(ReplicaActor)
                 replica = actor_cls.remote(
                     d.func_or_class, d.init_args, d.init_kwargs, cfg.user_config

@@ -131,16 +131,10 @@ def run_jax_gang(
     the jax work (device flags must precede jax's first import)."""
     from ray_tpu.parallel.mesh import multislice_env
 
-    env_extra = {}
-    if num_slices > 1:
-        env_extra = multislice_env("PLACEHOLDER", num_slices, slice_id)
-
     def env_for_rank(rank: int, coordinator: str) -> dict:
-        if not env_extra:
+        if num_slices <= 1:
             return {}
-        out = dict(env_extra)
-        out["MEGASCALE_COORDINATOR_ADDRESS"] = coordinator
-        return out
+        return multislice_env(coordinator, num_slices, slice_id)
 
     return _launch_gang(
         [cloudpickle.dumps(train_fn)] * num_workers, env_for_rank,
@@ -192,7 +186,6 @@ def run_multislice_gang(
     """
     from ray_tpu.parallel.mesh import multislice_env
 
-    total = num_slices * hosts_per_slice
     fn_blobs = []
     for s in range(num_slices):
         for _ in range(hosts_per_slice):

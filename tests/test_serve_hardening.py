@@ -190,3 +190,23 @@ def test_grpc_ingress_predict_and_stream(session):
     with _pytest.raises(_grpc.RpcError) as err:
         grpc_predict("127.0.0.1:19444", "/nope", {})
     assert err.value.code() == _grpc.StatusCode.NOT_FOUND
+
+
+def test_process_backed_replicas(session):
+    """ray_actor_options={'isolate_process': True} puts each replica in its
+    own OS worker process (reference: serve replicas are worker processes)."""
+    import os
+
+    from ray_tpu import serve
+
+    @serve.deployment(name="pidsvc", num_replicas=2,
+                      ray_actor_options={"isolate_process": True, "num_cpus": 0.5})
+    class PidSvc:
+        def __call__(self, request):
+            return {"pid": os.getpid()}
+
+    serve.run(PidSvc.bind(), name="pidapp", route_prefix="/pid")
+    h = serve.get_deployment_handle("pidsvc")
+    pids = {ray_tpu.get(h.remote({}), timeout=60)["pid"] for _ in range(8)}
+    assert all(p != os.getpid() for p in pids)
+    serve.delete("pidapp")
