@@ -184,8 +184,6 @@ class ServeController:
         if deployment.config.ray_actor_options.get("isolate_process"):
             # process replicas can't host streaming-generator methods yet
             # (runtime limitation) — fail at DEPLOY time, not per request
-            import inspect
-
             target = deployment.func_or_class
             gen_methods = [
                 m for m, fn in inspect.getmembers(target, callable)
@@ -193,14 +191,17 @@ class ServeController:
                 and (inspect.isgeneratorfunction(fn)
                      or inspect.isasyncgenfunction(fn))
             ] if inspect.isclass(target) else (
-                [target.__name__] if inspect.isgeneratorfunction(target) else []
+                [target.__name__]
+                if (inspect.isgeneratorfunction(target)
+                    or inspect.isasyncgenfunction(target)) else []
             )
             if gen_methods:
                 raise ValueError(
                     f"deployment {name!r}: isolate_process replicas do not "
                     f"support streaming generator handlers yet ({gen_methods})"
                 )
-            if deployment.config.max_ongoing_requests > 1:
+            if deployment.config.max_ongoing_requests not in (1, 100):
+                # 100 is the dataclass default: warn only on an explicit ask
                 logger.warning(
                     "deployment %r: isolate_process replicas serialize "
                     "requests (max_concurrency=1); max_ongoing_requests=%d "
@@ -354,8 +355,13 @@ class ServeController:
                 except Exception:
                     failed = True
             elif now - sent > self.HEALTH_CHECK_TIMEOUT_S:
-                del self._health_probes[key]  # probe expired: counts as failure
-                failed = True
+                del self._health_probes[key]  # probe expired
+                # process replicas serialize requests ahead of the probe
+                # (max_concurrency=1): a slow handler is not ill-health, so
+                # only a definitive actor death counts for them
+                if st.config.ray_actor_options.get("isolate_process"):
+                    continue
+                failed = True  # thread replicas answer concurrently: a miss counts
             if failed is False:
                 continue  # probe still outstanding within its deadline
             if failed != "dead":

@@ -1,7 +1,7 @@
 """Tombstone (reference parity: python/ray/workflow/__init__.py — the
 workflow library was removed upstream in 2.44 and its import raises)."""
 
-raise RuntimeError(
+raise ModuleNotFoundError(
     "ray_tpu.workflow does not exist: the reference removed Ray Workflows "
     "in 2.44; durable execution belongs to external orchestrators."
 )
