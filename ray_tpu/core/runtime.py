@@ -91,6 +91,8 @@ class TaskSpec:
     method_name: str = ""
     is_actor_creation: bool = False
     runtime_env: dict | None = None
+    # named concurrency group the method executes in (None = default group)
+    concurrency_group: str | None = None
     # None = follow config.task_execution; True/False force process/thread
     isolate_process: bool | None = None
 
@@ -146,10 +148,38 @@ class _ActorState:
         self.max_restarts = options.get("max_restarts", 0)
         self.max_task_retries = options.get("max_task_retries", 0)
         self.max_concurrency = options.get("max_concurrency", 1)
+        # Named concurrency groups (reference: ConcurrencyGroupManager,
+        # core_worker/task_execution/concurrency_group_manager.h): each group
+        # is an independent ordered mailbox served by its own thread pool, so
+        # slow methods in one group never block another group's methods.
+        self.concurrency_groups: dict[str, int] = dict(
+            options.get("concurrency_groups") or {}
+        )
+        if "_default" in self.concurrency_groups:
+            raise ValueError(
+                "'_default' is a reserved concurrency group name; it is the "
+                "implicit group served at max_concurrency"
+            )
+        for _g, _n in self.concurrency_groups.items():
+            if not isinstance(_n, int) or isinstance(_n, bool) or _n < 1:
+                raise ValueError(
+                    f"concurrency group {_g!r} limit must be a positive int, "
+                    f"got {_n!r}"
+                )
         self.num_restarts = 0
         self.state = "DEPENDENCIES_UNREADY"
         self.instance: Any = None
         self.mailbox: "queue.Queue[tuple[TaskSpec, ObjectID] | None]" = queue.Queue()
+        self.mailboxes: dict[str, "queue.Queue"] = {"_default": self.mailbox}
+        for _g in self.concurrency_groups:
+            # Process actors serialize every method on their dedicated worker
+            # (same degradation as max_concurrency>1): group names stay valid
+            # for routing but alias the one served mailbox.
+            self.mailboxes[_g] = (
+                self.mailbox if options.get("isolate_process") else queue.Queue()
+            )
+        # group name -> number of serving threads (poison-pill bookkeeping)
+        self.group_thread_counts: dict[str, int] = {}
         self.threads: list[threading.Thread] = []
         self.node_id: NodeID | None = None
         self.sched_req: SchedulingRequest | None = None
@@ -160,6 +190,25 @@ class _ActorState:
         self.lock = threading.Lock()
         self.pending_count = 0
         self.proc_worker = None  # DedicatedActorWorker for process actors
+
+    def mailbox_for(self, spec: "TaskSpec") -> "queue.Queue":
+        if spec.concurrency_group:
+            mb = self.mailboxes.get(spec.concurrency_group)
+            if mb is None:
+                raise ValueError(
+                    f"Actor {self.cls.__name__} has no concurrency group "
+                    f"{spec.concurrency_group!r} (declared: "
+                    f"{sorted(self.concurrency_groups) or 'none'})"
+                )
+            return mb
+        return self.mailbox
+
+    def poison_all(self) -> None:
+        """One poison pill per serving thread, routed to that thread's mailbox."""
+        for gname, n in self.group_thread_counts.items():
+            mb = self.mailboxes.get(gname, self.mailbox)
+            for _ in range(n):
+                mb.put(None)
 
 
 class Runtime:
@@ -1218,14 +1267,22 @@ class Runtime:
         state.state = "ALIVE"
         self._publish_actor_event(state)
         self._store_value(spec.return_ids()[0], None)  # creation done marker
-        concurrency = 1 if state.proc_worker is not None else max(1, state.max_concurrency)
-        for i in range(concurrency):
-            t = threading.Thread(
-                target=self._actor_loop, args=(state,), daemon=True,
-                name=f"ray_tpu-actor-{state.cls.__name__}-{i}",
-            )
-            state.threads.append(t)
-            t.start()
+        if state.proc_worker is not None:
+            groups = {"_default": 1}  # process actors serialize on their worker
+        else:
+            groups = {"_default": max(1, state.max_concurrency)}
+            for gname, limit in state.concurrency_groups.items():
+                groups[gname] = max(1, int(limit))
+        state.group_thread_counts = groups
+        for gname, concurrency in groups.items():
+            for i in range(concurrency):
+                t = threading.Thread(
+                    target=self._actor_loop, args=(state, state.mailboxes[gname]),
+                    daemon=True,
+                    name=f"ray_tpu-actor-{state.cls.__name__}-{gname}-{i}",
+                )
+                state.threads.append(t)
+                t.start()
 
     def _spawn_proc_actor(self, state: _ActorState, spec: TaskSpec) -> None:
         from ray_tpu.core.process_pool import DedicatedActorWorker
@@ -1264,8 +1321,10 @@ class Runtime:
             state._renv_ctx = cached
         return cached
 
-    def _actor_loop(self, state: _ActorState) -> None:
-        """Per-actor execution loop: ordered mailbox (task_receiver.cc ordered queues)."""
+    def _actor_loop(self, state: _ActorState, mailbox: "queue.Queue") -> None:
+        """Per-actor execution loop: ordered mailbox (task_receiver.cc ordered queues).
+
+        ``mailbox`` is the concurrency-group queue this thread serves."""
         import asyncio
 
         if state.is_async and state.loop is None:
@@ -1274,7 +1333,7 @@ class Runtime:
                     state.loop = asyncio.new_event_loop()
                     threading.Thread(target=state.loop.run_forever, daemon=True).start()
         while True:
-            item = state.mailbox.get()
+            item = mailbox.get()
             if item is None:
                 return
             spec, _ = item
@@ -1384,7 +1443,7 @@ class Runtime:
                         spec.desc(), type(e).__name__, attempts + 1, spec.max_retries,
                     )
                     self._record_event(spec, "RETRYING")
-                    state.mailbox.put((spec, spec.return_ids()[0]))
+                    mailbox.put((spec, spec.return_ids()[0]))
                     continue
                 if entry:
                     entry.state = "FAILED"
@@ -1528,6 +1587,7 @@ class Runtime:
             self._store_error(spec, ActorDiedError(state.death_cause or "actor is dead"))
             return [ObjectRef(r, self) for r in spec.return_ids()]
         spec = self._make_actor_task_spec(actor_id, method_name, args, kwargs, options)
+        mailbox = state.mailbox_for(spec)  # raises on unknown group pre-enqueue
         dep_refs = _ref_args(spec.args, spec.kwargs)
         self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
         with self._lock:
@@ -1539,7 +1599,7 @@ class Runtime:
         with state.lock:
             state.pending_count += 1
         self._record_event(spec, "PENDING")
-        state.mailbox.put((spec, spec.return_ids()[0]))
+        mailbox.put((spec, spec.return_ids()[0]))
         if state.state == "DEAD":
             # Raced with kill_actor's drain: no thread will serve the mailbox now.
             self._drain_mailbox(state, ActorDiedError(state.death_cause or "actor is dead"))
@@ -1562,6 +1622,7 @@ class Runtime:
             method_name=method_name,
             max_retries=options.get("max_task_retries", default_retries),
             retry_exceptions=options.get("retry_exceptions", False),
+            concurrency_group=options.get("concurrency_group"),
         )
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
@@ -1589,8 +1650,7 @@ class Runtime:
         if state.proc_worker is not None:
             state.proc_worker.kill()
             state.proc_worker = None
-        for _ in state.threads:
-            state.mailbox.put(None)
+        state.poison_all()
         if state.node_id is not None and state.sched_req is not None:
             self.scheduler.release(state.node_id, state.sched_req)
             state.node_id = None
@@ -1599,20 +1659,21 @@ class Runtime:
             self.restart_actor(actor_id)
 
     def _drain_mailbox(self, state: _ActorState, err: BaseException) -> None:
-        try:
-            while True:
-                item = state.mailbox.get_nowait()
-                if item is None:
-                    continue
-                spec, _ = item
-                self._store_error(spec, err)
-                self.reference_counter.remove_submitted_task_refs(
-                    [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
-                )
-                with state.lock:
-                    state.pending_count -= 1
-        except queue.Empty:
-            pass
+        for mb in state.mailboxes.values():
+            try:
+                while True:
+                    item = mb.get_nowait()
+                    if item is None:
+                        continue
+                    spec, _ = item
+                    self._store_error(spec, err)
+                    self.reference_counter.remove_submitted_task_refs(
+                        [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+                    )
+                    with state.lock:
+                        state.pending_count -= 1
+            except queue.Empty:
+                pass
 
     def restart_actor(self, actor_id: ActorID) -> bool:
         """Actor restart path (gcs_actor_manager.cc:341 RestartActor...)."""
@@ -1623,6 +1684,7 @@ class Runtime:
         state.state = "RESTARTING"
         self._publish_actor_event(state)
         state.threads = []
+        state.group_thread_counts = {}
         if state.name:
             with self._lock:
                 self._named_actors.setdefault((state.namespace, state.name), actor_id)
@@ -1706,8 +1768,7 @@ class Runtime:
                 except Exception:
                     pass
                 state.proc_worker = None
-            for _ in state.threads:
-                state.mailbox.put(None)
+            state.poison_all()
         self.scheduler.notify()
         for agent in list(self._agents.values()):
             try:

@@ -58,6 +58,9 @@ CONFIGS = {
     "C": ("C_dots_bs16", llama.LlamaConfig(**BASE, remat=True, remat_policy="dots"), 16, 2048),
     "D": ("D_big_dots_bs8", llama.LlamaConfig(**BIG, remat=True, remat_policy="dots"), 8, 2048),
     "E": ("E_big_full_bs16", llama.LlamaConfig(**BIG, remat=True), 16, 2048),
+    "F": ("F_dots_bs12", llama.LlamaConfig(**BASE, remat=True, remat_policy="dots"), 12, 2048),
+    "G": ("G_dots_bs14", llama.LlamaConfig(**BASE, remat=True, remat_policy="dots"), 14, 2048),
+    "H": ("H_noremat_bs8", llama.LlamaConfig(**BASE, remat=False), 8, 2048),
 }
 
 if __name__ == "__main__":
