@@ -41,5 +41,6 @@ _rec("data")
 del _rec
 
 from ray_tpu.data.read_api import from_torch, read_avro, read_sql  # noqa: E402,F401
+from ray_tpu.data.read_api import read_delta, read_iceberg  # noqa: E402,F401
 
-__all__ += ["read_avro", "read_sql", "from_torch"]
+__all__ += ["read_avro", "read_sql", "from_torch", "read_delta", "read_iceberg"]

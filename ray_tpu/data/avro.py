@@ -97,7 +97,8 @@ def _write_value(buf, schema, value) -> None:
         _write_long(buf, 0)
     elif t == "record":
         for field in schema["fields"]:
-            _write_value(buf, field["type"], value[field["name"]])
+            fv = value.get(field["name"]) if isinstance(value, dict) else value[field["name"]]
+            _write_value(buf, field["type"], fv)
     else:
         raise ValueError(f"unsupported avro type for write: {t!r}")
 
@@ -152,7 +153,7 @@ def _read_value(buf, schema):
 
 
 # ---------------------------------------------------------------- container
-def _value_type(v) -> Any:
+def _value_type(v, name: str = "field") -> Any:
     import numbers
 
     import numpy as np
@@ -172,9 +173,19 @@ def _value_type(v) -> Any:
     if isinstance(v, bytes):
         return "bytes"
     if isinstance(v, (list, tuple)):
-        et = "double" if (v and isinstance(v[0], float)) else (
-            "long" if (v and isinstance(v[0], (int, bool))) else "string")
-        return {"type": "array", "items": et}
+        return {"type": "array",
+                "items": _value_type(v[0], f"{name}_item") if v else "string"}
+    if isinstance(v, dict):  # nested record (e.g. Iceberg manifest data_file)
+        # record names must be unique within a schema (Avro spec) — derive
+        # them from the field path so two dict-valued fields don't collide
+        return {
+            "type": "record",
+            "name": f"{name}_rec",
+            "fields": [
+                {"name": str(k), "type": _value_type(x, f"{name}_{k}")}
+                for k, x in v.items()
+            ],
+        }
     return "string"
 
 
@@ -188,8 +199,21 @@ def _merge_types(a, b):
         return ["null", _merge_types(next(s for s in a if s != "null"), b)]
     if isinstance(b, list) and "null" in b:
         return _merge_types(b, a)
-    if {a, b} == {"long", "double"}:
+    if isinstance(a, str) and isinstance(b, str) and {a, b} == {"long", "double"}:
         return "double"
+    if (isinstance(a, dict) and isinstance(b, dict)
+            and a.get("type") == "record" and b.get("type") == "record"):
+        # field-wise merge: fields present in only one side become nullable
+        af = {f["name"]: f["type"] for f in a["fields"]}
+        bf = {f["name"]: f["type"] for f in b["fields"]}
+        fields = []
+        for n in dict.fromkeys(list(af) + list(bf)):
+            if n in af and n in bf:
+                t = _merge_types(af[n], bf[n])
+            else:
+                t = _merge_types("null", af.get(n) or bf.get(n))
+            fields.append({"name": n, "type": t})
+        return {"type": "record", "name": a.get("name", "Rec"), "fields": fields}
     return "string"  # incompatible: fall back to string coercion
 
 
@@ -201,12 +225,21 @@ def infer_schema(rows, name: str = "Row") -> dict:
     if isinstance(rows, dict):
         rows = [rows]
     types: dict[str, Any] = {}
+    seen: dict[str, int] = {}
+    n_rows = 0
     for row in rows:
+        n_rows += 1
         for k, v in row.items():
-            t = _value_type(v)
+            t = _value_type(v, str(k))
             types[k] = t if k not in types else _merge_types(types[k], t)
-    fields = [{"name": str(k), "type": (["null", "string"] if t == "null" else t)}
-              for k, t in types.items()]
+            seen[k] = seen.get(k, 0) + 1
+    fields = []
+    for k, t in types.items():
+        if seen[k] < n_rows:  # absent in some rows ⇒ nullable
+            t = _merge_types("null", t)
+        if t == "null":
+            t = ["null", "string"]
+        fields.append({"name": str(k), "type": t})
     return {"type": "record", "name": name, "fields": fields}
 
 

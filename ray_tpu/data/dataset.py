@@ -243,6 +243,16 @@ class Dataset:
     def count(self) -> int:
         return sum(b.num_rows() for b in self.iter_blocks())
 
+    def to_pandas(self):
+        """Reference: Dataset.to_pandas — materialize every block into one
+        DataFrame (caller asserts the result fits in driver memory)."""
+        import pandas as pd
+
+        frames = [b.to_pandas() for b in self.iter_blocks()]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
     def schema(self) -> dict[str, str]:
         for b in self.iter_blocks():
             return b.schema()

@@ -107,6 +107,13 @@ def _read_parquet_columns(path: str) -> dict:
             for name, col in zip(table.column_names, table.columns)}
 
 
+def _read_parquet(path: str) -> dict:
+    """One decision point for the in-process vs subprocess parquet dispatch."""
+    if os.environ.get("RAY_TPU_PARQUET_INPROC") == "1":
+        return _read_parquet_columns(path)
+    return _read_parquet_subprocess(path)
+
+
 class _ChildDied(IOError):
     pass
 
@@ -173,10 +180,8 @@ def read_parquet(paths: str | list[str]) -> Dataset:
     files = _expand_paths(paths, ".parquet")
 
     def source() -> Iterator[Block]:
-        inproc = os.environ.get("RAY_TPU_PARQUET_INPROC") == "1"
         for f in files:
-            cols = _read_parquet_columns(f) if inproc else _read_parquet_subprocess(f)
-            yield Block.from_numpy(cols)
+            yield Block.from_numpy(_read_parquet(f))
 
     return Dataset(source, (), "read_parquet")
 
@@ -344,6 +349,46 @@ def read_avro(paths: str | list[str]) -> Dataset:
             yield Block.from_pandas(pd.DataFrame(list(read_avro_file(f))))
 
     return Dataset(source, (), "read_avro")
+
+
+def read_delta(table_path: str, *, version: int | None = None) -> Dataset:
+    """Reference: read_api.read_delta :4822 (delta-sharing/deltalake SDK).
+
+    Hermetic: replays the _delta_log JSON commits (+parquet checkpoints)
+    locally — see data/lakehouse.py — then streams one block per live data
+    file. ``version`` time-travels to that commit. Partition values from the
+    log are injected as columns (Hive-style tables omit them from the files).
+    """
+    from ray_tpu.data.lakehouse import delta_active_files
+
+    def source() -> Iterator[Block]:
+        files, parts = delta_active_files(table_path, version=version)
+        for f, pv in zip(files, parts):
+            cols = _read_parquet(f)
+            if pv:
+                n = len(next(iter(cols.values()))) if cols else 0
+                for k, v in pv.items():
+                    if k not in cols:
+                        cols[k] = np.full(n, v)
+            yield Block.from_numpy(cols)
+
+    return Dataset(source, (), "read_delta")
+
+
+def read_iceberg(table_path: str, *, snapshot_id: int | None = None) -> Dataset:
+    """Reference: read_api.read_iceberg :4386 (pyiceberg SDK).
+
+    Hermetic: walks metadata/*.metadata.json → manifest-list avro → manifest
+    avro → parquet data files with the in-repo codecs (data/lakehouse.py).
+    ``snapshot_id`` time-travels to that snapshot.
+    """
+    from ray_tpu.data.lakehouse import iceberg_data_files
+
+    def source() -> Iterator[Block]:
+        for f in iceberg_data_files(table_path, snapshot_id=snapshot_id):
+            yield Block.from_numpy(_read_parquet(f))
+
+    return Dataset(source, (), "read_iceberg")
 
 
 def read_sql(sql: str, connection_factory) -> Dataset:
