@@ -194,7 +194,12 @@ class PagedLLMEngine(LLMEngine):
         return True
 
     def _loop_step(self) -> bool:
-        jnp = self._jnp
+        did_work = self._step_ops()
+        did_work = self._step_admit() or did_work
+        did_work = self._step_decode() or did_work
+        return did_work
+
+    def _step_ops(self) -> bool:
         did_work = False
         for _ in range(self._ops.qsize()):  # bounded: attach may requeue itself
             try:
@@ -210,6 +215,10 @@ class PagedLLMEngine(LLMEngine):
                 if not fut.done():
                     fut.set_exception(e)
             did_work = True
+        return did_work
+
+    def _step_admit(self) -> bool:
+        did_work = False
         free = [i for i in range(self.config.max_batch_size) if not self.active[i]]
         requeue = []
         while free and not self._pending.empty():
@@ -225,28 +234,32 @@ class PagedLLMEngine(LLMEngine):
             did_work = True
         for req in requeue:
             self._pending.put(req)
-        if self.active.any():
-            logits, self.pool = self._decode(
-                self.params, self.pool, jnp.asarray(self.last_tokens),
-                jnp.asarray(self.lengths), jnp.asarray(self.tables),
-            )
-            logits_np = np.asarray(logits)
-            with self._lock:
-                for i in range(self.config.max_batch_size):
-                    if not self.active[i]:
-                        continue
-                    tok = self._sample(logits_np[i])
-                    st = self.slots[i]
-                    st.generated.append(tok)
-                    if st.token_queue is not None:
-                        st.token_queue.put(tok)
-                    self.lengths[i] += 1
-                    self.last_tokens[i, 0] = tok
-            for i in range(self.config.max_batch_size):
-                if self.active[i]:
-                    self._maybe_finish(i, self.slots[i].generated[-1])
-            did_work = True
         return did_work
+
+    def _step_decode(self) -> bool:
+        jnp = self._jnp
+        if not self.active.any():
+            return False
+        logits, self.pool = self._decode(
+            self.params, self.pool, jnp.asarray(self.last_tokens),
+            jnp.asarray(self.lengths), jnp.asarray(self.tables),
+        )
+        logits_np = np.asarray(logits)
+        with self._lock:
+            for i in range(self.config.max_batch_size):
+                if not self.active[i]:
+                    continue
+                tok = self._sample(logits_np[i])
+                st = self.slots[i]
+                st.generated.append(tok)
+                if st.token_queue is not None:
+                    st.token_queue.put(tok)
+                self.lengths[i] += 1
+                self.last_tokens[i, 0] = tok
+        for i in range(self.config.max_batch_size):
+            if self.active[i]:
+                self._maybe_finish(i, self.slots[i].generated[-1])
+        return True
 
     # ---- PD disaggregation handoff (reference: pd_server.py + NIXL KV
     # transfer; here KV pages travel as host arrays over the object plane) ----
@@ -295,9 +308,11 @@ class PagedLLMEngine(LLMEngine):
             "kv": kv,
             "first_token": first_tok,
             "prompt_len": len(prompt_ids),
+            # lets draft-model engines (spec decode) rebuild their own KV
+            "prompt_ids": list(prompt_ids),
         }
 
-    def _do_attach(self, payload, fut: Future) -> None:
+    def _do_attach(self, payload, fut: Future) -> Optional[int]:
         import jax.numpy as jnp
 
         handoff, max_new_tokens = payload
@@ -318,7 +333,7 @@ class PagedLLMEngine(LLMEngine):
         if slot is None:
             # decode side saturated: requeue the op for a later pass
             self._ops.put(("attach", payload, fut))
-            return
+            return None
         n_prefill_blocks = handoff["kv"]["k"].shape[2]
         total_blocks = -(-(prompt_len + max_new_tokens) // bs)
         block_ids = self.allocator.alloc(total_blocks)
@@ -345,3 +360,4 @@ class PagedLLMEngine(LLMEngine):
             raise
         # a 1-token (or 0-token) request is already complete with first_token
         self._maybe_finish(slot, handoff["first_token"])
+        return slot

@@ -1,0 +1,229 @@
+"""Speculative decoding over the paged-KV engine.
+
+Parity: the reference delegates speculative decoding to vLLM
+(`llm/_internal/serve/` engine_kwargs pass-through: speculative_config /
+num_speculative_tokens). Here it is native and TPU-shaped: a small draft
+model proposes K tokens autoregressively (cheap host loop over tiny jitted
+decodes), then the target model scores all K+1 positions in ONE batched
+paged forward — the verify step keeps the MXU busy with a [B, K+1] window
+instead of K+1 sequential [B, 1] decodes.
+
+Greedy invariant: with temperature 0 the committed output is exactly the
+target model's greedy decode REGARDLESS of draft quality — a bad draft only
+costs speed (acceptance drops toward 1 committed token/step, the base decode
+rate), never correctness. Both KV pools share one block allocator: the draft
+pool mirrors the target pool's block ids, so a sequence's table row addresses
+its pages in both.
+
+Rejected-position hygiene: verify writes target KV for all K+1 window
+positions; committing only a prefix leaves stale KV at future positions,
+which the causal position mask already excludes — the next window overwrites
+them (same argument for the draft pool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.models import llama
+from ray_tpu.serve.llm_paged import PagedLLMConfig, PagedLLMEngine
+
+
+@dataclasses.dataclass
+class SpecDecodeConfig(PagedLLMConfig):
+    draft_model_config: Optional[llama.LlamaConfig] = None
+    num_speculative_tokens: int = 4
+
+
+class SpecDecodeLLMEngine(PagedLLMEngine):
+    """Draft-propose / target-verify continuous batching (greedy sampling)."""
+
+    def __init__(self, config: SpecDecodeConfig, params=None,
+                 draft_params=None, seed: int = 0):
+        if config.draft_model_config is None:
+            raise ValueError("SpecDecodeConfig.draft_model_config is required")
+        if config.num_speculative_tokens < 1:
+            raise ValueError("num_speculative_tokens must be >= 1")
+        if config.temperature > 0:
+            raise ValueError(
+                "speculative decoding implements the greedy acceptance rule; "
+                "temperature must be 0"
+            )
+        dm, tm = config.draft_model_config, config.model_config
+        if dm.vocab_size != tm.vocab_size:
+            raise ValueError("draft and target models must share a vocabulary")
+        self._draft_params_init = draft_params
+        super().__init__(config, params=params, seed=seed)
+
+    def _init_backend(self) -> None:
+        super()._init_backend()
+        jax, jnp = self._jax, self._jnp
+        cfg = self.config.model_config
+        dcfg = self.config.draft_model_config
+        bs = self.config.block_size
+        self.draft_params = (self._draft_params_init
+                             if self._draft_params_init is not None
+                             else llama.init(dcfg, jax.random.PRNGKey(7)))
+        # mirror pool: same block ids resolve in both pools via one table
+        self.draft_pool = llama.init_kv_pool(dcfg, self.pool_blocks, bs)
+
+        def draft_prefill(params, pool, tokens, table, start_len):
+            logits, pool = llama.forward_paged(
+                params, tokens, dcfg, pool, table, start_len, bs
+            )
+            return logits[0], pool
+
+        def draft_decode(params, pool, last_tokens, lengths, tables):
+            logits, pool = llama.forward_paged(
+                params, last_tokens, dcfg, pool, tables, lengths, bs
+            )
+            return logits[:, 0], pool
+
+        def draft_decode2(params, pool, window2, lengths, tables):
+            # [B, 2] window: re-process [prev, last] so a fully-accepted prior
+            # step's final proposal (whose draft KV was never written — the
+            # classic bonus-token hole) gets its page filled before proposing
+            logits, pool = llama.forward_paged(
+                params, window2, dcfg, pool, tables, lengths, bs
+            )
+            return logits[:, 1], pool
+
+        def verify(params, pool, window, lengths, tables):
+            # [B, K+1] window scored in one target forward
+            logits, pool = llama.forward_paged(
+                params, window, cfg, pool, tables, lengths, bs
+            )
+            return logits, pool
+
+        self._draft_prefill = jax.jit(draft_prefill, donate_argnums=(1,))
+        self._draft_decode = jax.jit(draft_decode, donate_argnums=(1,))
+        self._draft_decode2 = jax.jit(draft_decode2, donate_argnums=(1,))
+        self._verify = jax.jit(verify, donate_argnums=(1,))
+        # second-to-last committed token per slot (the 2-token window's head)
+        self.prev_tokens = np.zeros((self.config.max_batch_size, 1), dtype=np.int32)
+
+    # ---- admission: also prefill the DRAFT pool for the slot ----
+    def _admit_one(self, prompt, max_new, fut, t_enq, tq, slot) -> bool:
+        jnp = self._jnp
+        admitted = super()._admit_one(prompt, max_new, fut, t_enq, tq, slot)
+        if not admitted or not self.active[slot]:
+            # not admitted, rejected, or already finished (max_new reached)
+            return admitted
+        try:
+            self._draft_prefill_slot(slot, prompt)
+        except Exception as e:  # noqa: BLE001 - fail THIS request, keep serving
+            st = self.slots[slot]
+            with self._lock:
+                self._release_slot(slot)
+            if st is not None:
+                if not st.future.done():
+                    st.future.set_exception(e)
+                if st.token_queue is not None:
+                    st.token_queue.put(None)
+        return True
+
+    def _draft_prefill_slot(self, slot: int, prompt) -> None:
+        """Draft-prefill the WHOLE prompt (start 0): independent of the
+        target's prefix-cache skip, and shared prefix blocks get identical
+        draft KV rewritten, so sharing stays sound."""
+        jnp = self._jnp
+        bucket = min(self._bucket(len(prompt)), self.config.max_seq_len)
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, : len(prompt)] = prompt
+        table_row = self.tables[slot][None, :]
+        _, self.draft_pool = self._draft_prefill(
+            self.draft_params, self.draft_pool, jnp.asarray(padded),
+            jnp.asarray(table_row), jnp.asarray([0], np.int32),
+        )
+        self.prev_tokens[slot, 0] = prompt[-1]
+
+    def _release_slot(self, i: int) -> None:
+        super()._release_slot(i)
+        self.prev_tokens[i] = 0
+
+    def _do_attach(self, payload, fut):
+        """PD attach: also rebuild this sequence's DRAFT KV from the prompt
+        ids carried in the handoff — without it, acceptance collapses to ~0
+        and the decode half of PD becomes slower than plain paged decode."""
+        handoff, _ = payload
+        prompt_ids = handoff.get("prompt_ids")
+        if not prompt_ids:
+            raise NotImplementedError(
+                "speculative decode attach requires 'prompt_ids' in the "
+                "handoff (produced by prefill_extract)"
+            )
+        slot = super()._do_attach(payload, fut)
+        if slot is not None and self.active[slot]:
+            self._draft_prefill_slot(slot, prompt_ids)
+        return slot
+
+    # ---- decode: propose K draft tokens, verify in one target pass ----
+    def _step_decode(self) -> bool:
+        jnp = self._jnp
+        if not self.active.any():
+            return False
+        K = self.config.num_speculative_tokens
+        B = self.config.max_batch_size
+        proposals = np.zeros((B, K), dtype=np.int32)
+        base_lengths = self.lengths.copy()
+        # device residents hoisted out of the loop: tables/lengths don't change
+        # within a step, so upload once and derive shifted lengths on device
+        tables_dev = jnp.asarray(self.tables)
+        base_dev = jnp.asarray(base_lengths)
+        # first draft step: [prev, last] 2-token window (fills any bonus-token
+        # draft-KV hole from a fully-accepted prior step), logits propose p1
+        window2 = np.concatenate([self.prev_tokens, self.last_tokens], axis=1)
+        dlogits, self.draft_pool = self._draft_decode2(
+            self.draft_params, self.draft_pool, jnp.asarray(window2),
+            jnp.maximum(base_dev - 1, 0), tables_dev,
+        )
+        proposals[:, 0] = np.argmax(np.asarray(dlogits), axis=-1)
+        cur = proposals[:, 0:1]
+        for k in range(1, K):
+            dlogits, self.draft_pool = self._draft_decode(
+                self.draft_params, self.draft_pool, jnp.asarray(cur),
+                base_dev + k, tables_dev,
+            )
+            proposals[:, k] = np.argmax(np.asarray(dlogits), axis=-1)
+            cur = proposals[:, k : k + 1]
+        window = np.concatenate([self.last_tokens, proposals], axis=1)  # [B, K+1]
+        logits, self.pool = self._verify(
+            self.params, self.pool, jnp.asarray(window), base_dev, tables_dev,
+        )
+        logits_np = np.asarray(logits)  # [B, K+1, V]
+        target_preds = np.argmax(logits_np, axis=-1)  # [B, K+1]
+        finished = []
+        with self._lock:
+            for i in range(B):
+                if not self.active[i]:
+                    continue
+                st = self.slots[i]
+                # accept proposals while they match the target's greedy choice
+                a = 0
+                while a < K and proposals[i, a] == target_preds[i, a]:
+                    a += 1
+                committed = list(proposals[i, :a]) + [int(target_preds[i, a])]
+                remaining = st.max_new - len(st.generated)
+                committed = committed[: max(0, remaining)]
+                eos = self.config.eos_token_id
+                if eos >= 0 and eos in committed:
+                    committed = committed[: committed.index(eos) + 1]
+                for tok in committed:
+                    st.generated.append(int(tok))
+                    if st.token_queue is not None:
+                        st.token_queue.put(int(tok))
+                self.lengths[i] = base_lengths[i] + len(committed)
+                if len(committed) >= 2:
+                    self.prev_tokens[i, 0] = committed[-2]
+                elif committed:
+                    self.prev_tokens[i, 0] = self.last_tokens[i, 0]
+                if committed:
+                    self.last_tokens[i, 0] = committed[-1]
+                finished.append(i)
+        for i in finished:
+            if self.active[i]:
+                self._maybe_finish(i, self.slots[i].generated[-1])
+        return True
