@@ -255,6 +255,11 @@ class ServeController:
     def get_deployment_names(self) -> list[str]:
         return list(self._deployments)
 
+    def get_request_router(self, name: str) -> str:
+        st = self._deployments.get(name)
+        # getattr: configs restored from pre-field checkpoints lack the attr
+        return getattr(st.config, "request_router", "pow2") if st else "pow2"
+
     def status(self) -> dict:
         out = {}
         with self._lock:
@@ -450,9 +455,14 @@ class Router:
     using locally tracked in-flight counts (replica queue-length cache,
     request_router/common.py:66)."""
 
+    KIND = "pow2"  # config name this class serves (request_router option)
+
     def __init__(self, controller, deployment_name: str):
         self._controller = controller
         self._name = deployment_name
+        # set by _refresh when the deployment's configured request_router no
+        # longer matches this instance; the handle swaps routers on next use
+        self._stale_kind: str | None = None
         self._replicas: list = []
         self._inflight: dict = {}
         self._dead: set = set()  # replicas observed dead; excluded on refresh
@@ -505,13 +515,20 @@ class Router:
         now = time.monotonic()
         if now - self._last_refresh > 0.5 or not self._replicas:
             reps = ray_tpu.get(self._controller.get_replicas.remote(self._name))
+            try:
+                kind = ray_tpu.get(
+                    self._controller.get_request_router.remote(self._name)
+                )
+                self._stale_kind = kind if kind != type(self).KIND else None
+            except Exception:
+                pass  # policy re-check is best-effort; replicas still refresh
             with self._lock:
                 reps = [r for r in reps if self._rkey(r) not in self._dead]
                 self._replicas = reps
                 self._inflight = {self._rkey(r): self._inflight.get(self._rkey(r), 0) for r in reps}
                 self._last_refresh = now
 
-    def pick(self, wait_timeout: float = 30.0):
+    def pick(self, wait_timeout: float = 30.0, hint=None):
         self._refresh()
         if not self._replicas:
             # Replicas may still be starting (deploy in progress, controller
@@ -531,18 +548,27 @@ class Router:
                 raise RuntimeError(f"No replicas for deployment '{self._name}'")
             if len(self._replicas) == 1:
                 return self._replicas[0]
-            a, b = random.sample(self._replicas, 2)
-            return (
-                a
-                if self._inflight.get(self._rkey(a), 0) <= self._inflight.get(self._rkey(b), 0)
-                else b
-            )
+            return self._select(hint)
+
+    def _select(self, hint):
+        """Pick among >=2 replicas (called under self._lock). ``hint`` is the
+        request payload routing context (unused by pow-2; subclasses use it)."""
+        a, b = random.sample(self._replicas, 2)
+        return (
+            a
+            if self._inflight.get(self._rkey(a), 0) <= self._inflight.get(self._rkey(b), 0)
+            else b
+        )
+
+    def _routing_hint(self, method_name: str, args, kwargs):
+        """Request context handed to _select (subclass hook; None = no context)."""
+        return None
 
     def submit_stream(self, method_name: str, args, kwargs):
         """Streaming variant: (ObjectRefGenerator, done_cb). The stream counts as
         in flight until the caller's iterator finishes/closes (done_cb) — long
         token streams stay visible to load balancing and autoscaling."""
-        replica = self.pick()
+        replica = self.pick(hint=self._routing_hint(method_name, args, kwargs))
         key = self._rkey(replica)
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
@@ -566,7 +592,7 @@ class Router:
         # replica death (reference: serve router replica retry on dead actors).
         last_ref = None
         for _ in range(4):
-            replica = self.pick()
+            replica = self.pick(hint=self._routing_hint(method_name, args, kwargs))
             key = self._rkey(replica)
             with self._lock:
                 self._inflight[key] = self._inflight.get(key, 0) + 1
@@ -608,25 +634,49 @@ class _HandleMethod:
         self._method_name = method_name
 
     def remote(self, *args, **kwargs):
-        return self._handle._router.submit(self._method_name, args, kwargs)
+        return self._handle._current_router().submit(self._method_name, args, kwargs)
 
 
 class DeploymentHandle:
     """Reference: serve DeploymentHandle — .remote() through the router."""
 
     def __init__(self, controller, deployment_name: str):
+        from ray_tpu.serve.kv_router import make_router
+
         self._controller = controller
         self._name = deployment_name
-        self._router = Router(controller, deployment_name)
+        try:
+            kind = ray_tpu.get(controller.get_request_router.remote(deployment_name))
+        except Exception:
+            logger.warning(
+                "could not resolve request_router for %r; starting with pow2 "
+                "(the router refresh loop re-checks and swaps if configured "
+                "otherwise)", deployment_name,
+            )
+            kind = "pow2"
+        self._router = make_router(kind, controller, deployment_name)
+
+    def _current_router(self) -> Router:
+        """Swap the router when a redeploy changed the deployment's configured
+        request_router (detected by Router._refresh on its 0.5s cycle)."""
+        stale = self._router._stale_kind
+        if stale:
+            from ray_tpu.serve.kv_router import make_router
+
+            try:
+                self._router = make_router(stale, self._controller, self._name)
+            except ValueError:
+                self._router._stale_kind = None  # unknown kind: keep current
+        return self._router
 
     def remote(self, *args, **kwargs):
-        return self._router.submit("__call__", args, kwargs)
+        return self._current_router().submit("__call__", args, kwargs)
 
     def stream(self, *args, method_name: str = "__call__", **kwargs):
         """Iterate a streaming deployment method's yielded values as they arrive."""
         import ray_tpu as _rt
 
-        gen, done_cb = self._router.submit_stream(method_name, args, kwargs)
+        gen, done_cb = self._current_router().submit_stream(method_name, args, kwargs)
         try:
             for ref in gen:
                 yield _rt.get(ref)

@@ -32,6 +32,9 @@ class DeploymentConfig:
     user_config: Any = None
     health_check_period_s: float = 2.0
     route_prefix: str | None = None
+    # replica-selection policy for handles: "pow2" | "kv_aware"
+    # (reference: pluggable RequestRouter, routing_policies/kv_aware)
+    request_router: str = "pow2"
 
 
 class Deployment:
@@ -70,7 +73,8 @@ class Application:
 def deployment(_func_or_class=None, *, name: str | None = None, num_replicas: int = 1,
                max_ongoing_requests: int = 100, ray_actor_options: dict | None = None,
                autoscaling_config: AutoscalingConfig | dict | None = None,
-               user_config: Any = None, route_prefix: str | None = None):
+               user_config: Any = None, route_prefix: str | None = None,
+               request_router: str = "pow2"):
     """``@serve.deployment`` decorator (reference: serve/api.py)."""
 
     def wrap(target):
@@ -85,6 +89,7 @@ def deployment(_func_or_class=None, *, name: str | None = None, num_replicas: in
             autoscaling_config=autoscaling_config,
             user_config=user_config,
             route_prefix=route_prefix,
+            request_router=request_router,
         )
         return Deployment(target, cfg)
 

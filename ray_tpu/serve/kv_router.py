@@ -1,0 +1,96 @@
+"""KV-cache-aware request routing.
+
+Parity: the reference's `llm/_internal/serve/routing_policies/kv_aware/`
+routes requests to the replica whose paged-KV prefix cache most likely holds
+the request's prompt prefix, so shared-prefix workloads (system prompts,
+few-shot preambles, multi-turn chats) hit the cache instead of re-prefilling
+on a random replica.
+
+Design: the router tracks its own past routing decisions — block-aligned
+prompt-prefix hashes map to the replica that last served them (the same
+content-hash scheme as the engine's allocator, serve/paged_kv.py). On pick,
+the replica holding the LONGEST matching prefix wins, unless its in-flight
+depth exceeds the least-loaded replica by more than ``imbalance_tolerance``
+(cache affinity must not defeat load balancing). No affinity → pow-2
+fallback. State is router-local (no replica RPC on the hot path), sized by
+an LRU bound.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ray_tpu.serve.controller import Router
+
+
+class KVAwareRouter(Router):
+    KIND = "kv_aware"
+
+    def __init__(self, controller, deployment_name: str, *, block_size: int = 16,
+                 max_tracked_prefixes: int = 8192, imbalance_tolerance: int = 4):
+        self.block_size = block_size
+        self.max_tracked_prefixes = max_tracked_prefixes
+        self.imbalance_tolerance = imbalance_tolerance
+        # prefix hash -> replica key, LRU-ordered (most recent last)
+        self._prefix_owner: "OrderedDict[int, str]" = OrderedDict()
+        super().__init__(controller, deployment_name)
+
+    # ---- hint extraction: token-id requests carry their prompt ----
+    def _routing_hint(self, method_name: str, args, kwargs):
+        body = args[0] if args else kwargs.get("body")
+        if isinstance(body, dict):
+            ids = body.get("prompt_ids")
+            if isinstance(ids, (list, tuple)) and ids:
+                return list(ids)
+        return None
+
+    def _block_hashes(self, prompt_ids: list) -> list[int]:
+        """Cumulative content hashes of block-aligned prefixes (longest last),
+        mirroring BlockPool.lookup_prefix's addressing."""
+        out = []
+        h = 0
+        bs = self.block_size
+        for i in range(0, len(prompt_ids) - len(prompt_ids) % bs, bs):
+            h = hash((h, tuple(prompt_ids[i : i + bs])))
+            out.append(h)
+        return out
+
+    def _select(self, hint):
+        # called under self._lock with >=2 replicas
+        if hint:
+            live = {self._rkey(r): r for r in self._replicas}
+            min_load = min(self._inflight.get(k, 0) for k in live)
+            hashes = self._block_hashes(hint)
+            for h in reversed(hashes):  # longest prefix first
+                owner = self._prefix_owner.get(h)
+                if owner is None or owner not in live:
+                    continue
+                if (self._inflight.get(owner, 0)
+                        <= min_load + self.imbalance_tolerance):
+                    self._prefix_owner.move_to_end(h)
+                    self._claim(hashes, owner)
+                    return live[owner]
+                break  # affinity exists but the owner is overloaded: balance
+            chosen = super()._select(None)
+            self._claim(hashes, self._rkey(chosen))
+            return chosen
+        return super()._select(None)
+
+    def _claim(self, hashes: list[int], replica_key: str) -> None:
+        for h in hashes:
+            self._prefix_owner[h] = replica_key
+            self._prefix_owner.move_to_end(h)
+        while len(self._prefix_owner) > self.max_tracked_prefixes:
+            self._prefix_owner.popitem(last=False)
+
+
+ROUTER_CLASSES = {"pow2": Router, "kv_aware": KVAwareRouter}
+
+
+def make_router(kind: str, controller, deployment_name: str) -> Router:
+    cls = ROUTER_CLASSES.get(kind)
+    if cls is None:
+        raise ValueError(
+            f"unknown request_router {kind!r} (known: {sorted(ROUTER_CLASSES)})"
+        )
+    return cls(controller, deployment_name)

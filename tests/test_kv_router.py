@@ -1,0 +1,119 @@
+"""KV-cache-aware routing: shared-prefix requests stick to one replica.
+
+Reference: llm/_internal/serve/routing_policies/kv_aware — cache affinity
+beats random balance for shared-prefix workloads, but never at the cost of
+unbounded load imbalance.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def _session():
+    ray_tpu.init(log_to_driver=False)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _echo_deployment(**opts):
+    @serve.deployment(name="Echo", num_replicas=2, **opts)
+    class Echo:
+        def __init__(self):
+            import os
+
+            self.tag = f"{os.getpid()}-{id(self)}"
+
+        def __call__(self, body):
+            return {"replica": self.tag, "n": len(body.get("prompt_ids", []))}
+
+    return Echo
+
+
+def test_shared_prefix_sticks_to_one_replica():
+    handle = serve.run(_echo_deployment(request_router="kv_aware").bind())
+    sys_prompt = list(range(64))  # 4 blocks of shared prefix
+    replicas = set()
+    for i in range(8):
+        out = ray_tpu.get(handle.remote({"prompt_ids": sys_prompt + [100 + i]}))
+        replicas.add(out["replica"])
+    assert len(replicas) == 1, f"shared-prefix requests split across {replicas}"
+
+
+def test_distinct_prefixes_spread():
+    handle = serve.run(_echo_deployment(request_router="kv_aware").bind())
+    replicas = set()
+    for i in range(12):
+        prompt = [1000 + i] * 32  # no common block prefix
+        out = ray_tpu.get(handle.remote({"prompt_ids": prompt}))
+        replicas.add(out["replica"])
+    assert len(replicas) == 2, "distinct-prefix requests never load-balanced"
+
+
+def test_affinity_yields_under_imbalance():
+    from ray_tpu.serve.kv_router import KVAwareRouter
+
+    class FakeReplica:
+        def __init__(self, key):
+            self._actor_id = type("I", (), {"hex": lambda self2, k=key: k})()
+
+    r = KVAwareRouter.__new__(KVAwareRouter)
+    r.block_size = 16
+    r.max_tracked_prefixes = 100
+    r.imbalance_tolerance = 2
+    from collections import OrderedDict
+    import threading
+    import random as _random
+
+    _random.seed(0)
+    r._prefix_owner = OrderedDict()
+    r._lock = threading.Lock()
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r._replicas = [a, b]
+    r._inflight = {"a": 0, "b": 0}
+    prompt = list(range(32))
+    first = r._select(prompt)
+    key = r._rkey(first)
+    # affinity holds while balanced
+    assert r._rkey(r._select(prompt)) == key
+    # overload the owner beyond tolerance: affinity must yield
+    r._inflight[key] = 10
+    other = "b" if key == "a" else "a"
+    assert r._rkey(r._select(prompt)) == other
+
+
+def test_unknown_router_rejected():
+    from ray_tpu.serve.kv_router import make_router
+
+    with pytest.raises(ValueError, match="unknown request_router"):
+        make_router("nope", None, "d")
+
+
+def test_pow2_default_unchanged():
+    handle = serve.run(_echo_deployment().bind())
+    out = ray_tpu.get(handle.remote({"prompt_ids": [1, 2, 3]}))
+    assert out["n"] == 3
+
+
+def test_redeploy_swaps_router_policy():
+    """A held handle adopts a changed request_router after redeploy (the
+    refresh cycle detects the config change and the handle swaps routers)."""
+    from ray_tpu.serve.kv_router import KVAwareRouter
+
+    Echo = _echo_deployment()
+    handle = serve.run(Echo.bind())
+    assert type(handle._current_router()).KIND == "pow2"
+    ray_tpu.get(handle.remote({"prompt_ids": [1, 2]}))
+    serve.run(Echo.options(request_router="kv_aware").bind())
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        handle._router._last_refresh = 0.0  # force the periodic re-check
+        ray_tpu.get(handle.remote({"prompt_ids": [1, 2]}))
+        if isinstance(handle._current_router(), KVAwareRouter):
+            break
+        time.sleep(0.2)
+    assert isinstance(handle._current_router(), KVAwareRouter)
