@@ -8,10 +8,26 @@ layering mirrors the reference's RLModule/Learner/EnvRunner split; IMPALA
 """
 
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, Episode, SingleAgentEnvRunner
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    FrameStack,
+    NormalizeObs,
+    UnsquashActions,
+    pipeline,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
+from ray_tpu.rllib.sac_continuous import (
+    ContinuousSAC,
+    ContinuousSACConfig,
+    ContinuousSACLearner,
+)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner
 from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rllib.multi_agent import (
@@ -39,7 +55,11 @@ __all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
            "load_offline_data", "write_offline_json",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
            "MultiAgentPPOConfig",
-           "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner"]
+           "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner",
+           "ContinuousSAC", "ContinuousSACConfig", "ContinuousSACLearner",
+           "Connector", "ConnectorPipeline", "FlattenObs", "ClipObs",
+           "NormalizeObs", "FrameStack", "ClipActions", "UnsquashActions",
+           "pipeline"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rec
 

@@ -47,13 +47,23 @@ class Episode:
 class SingleAgentEnvRunner:
     """One actor running one (or vectorized) env with the current policy."""
 
-    def __init__(self, env_creator: Callable, policy_fn: Callable, seed: int = 0):
+    def __init__(self, env_creator: Callable, policy_fn: Callable, seed: int = 0,
+                 env_to_module: Callable | None = None,
+                 module_to_env: Callable | None = None):
         self.env = env_creator()
         self.policy_fn = policy_fn  # (params, obs) -> (action, logprob, value)
         self.params = None
         self.rng = np.random.default_rng(seed)
-        self._obs, _ = self.env.reset(seed=seed)
+        # Connector pipelines (reference: rllib/connectors) — factories so each
+        # runner actor owns its stateful instances (frame stacks, running stats)
+        self._env_to_module = env_to_module() if env_to_module else None
+        self._module_to_env = module_to_env() if module_to_env else None
+        raw, _ = self.env.reset(seed=seed)
+        self._obs = self._process_obs(raw)
         self._carry_reward = 0.0  # live episode's reward from prior fragments
+
+    def _process_obs(self, raw):
+        return self._env_to_module(raw) if self._env_to_module else raw
 
     def set_weights(self, params) -> None:
         self.params = params
@@ -65,10 +75,12 @@ class SingleAgentEnvRunner:
         steps = 0
         while steps < num_steps:
             action, logprob, value = self.policy_fn(self.params, np.asarray(self._obs), self.rng)
-            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            env_action = (self._module_to_env(action) if self._module_to_env
+                          else action)
+            nxt, reward, terminated, truncated, _ = self.env.step(env_action)
             done = bool(terminated or truncated)
             ep.obs.append(np.asarray(self._obs))
-            ep.actions.append(action)
+            ep.actions.append(action)  # module-space: what the learner trains on
             ep.rewards.append(float(reward))
             ep.logprobs.append(float(logprob))
             ep.values.append(float(value))
@@ -76,13 +88,16 @@ class SingleAgentEnvRunner:
             ep.terminateds.append(bool(terminated))
             steps += 1
             if done:
-                ep.final_obs = np.asarray(nxt)
-                self._obs, _ = self.env.reset()
+                ep.final_obs = np.asarray(self._process_obs(nxt))
+                if self._env_to_module is not None:
+                    self._env_to_module.reset()  # drop per-episode state
+                raw, _ = self.env.reset()
+                self._obs = self._process_obs(raw)
                 self._carry_reward = 0.0
                 episodes.append(ep)
                 ep = Episode()
             else:
-                self._obs = nxt
+                self._obs = self._process_obs(nxt)
         if len(ep):
             # live episode cut by the fragment boundary: bootstrap with V(next obs)
             _, _, ep.bootstrap_value = self.policy_fn(self.params, np.asarray(self._obs), self.rng)
@@ -97,9 +112,16 @@ class SingleAgentEnvRunner:
 class EnvRunnerGroup:
     """Fan-out sampling over runner actors (reference: env_runner_group.py:70)."""
 
-    def __init__(self, env_creator: Callable, policy_fn: Callable, num_runners: int = 2):
+    def __init__(self, env_creator: Callable, policy_fn: Callable, num_runners: int = 2,
+                 env_to_module: Callable | None = None,
+                 module_to_env: Callable | None = None):
         runner_cls = ray_tpu.remote(num_cpus=1, max_concurrency=2)(SingleAgentEnvRunner)
-        self.runners = [runner_cls.remote(env_creator, policy_fn, seed=i) for i in range(num_runners)]
+        self.runners = [
+            runner_cls.remote(env_creator, policy_fn, seed=i,
+                              env_to_module=env_to_module,
+                              module_to_env=module_to_env)
+            for i in range(num_runners)
+        ]
 
     def sync_weights(self, params) -> None:
         ray_tpu.get([r.set_weights.remote(params) for r in self.runners])

@@ -10,7 +10,7 @@ import numpy as np
 import ray_tpu
 
 
-def _episodes_to_transitions(episodes) -> dict:
+def _episodes_to_transitions(episodes, action_dtype=np.int64) -> dict:
     """SARS'd tuples from episode fragments. The last step of a fragment cut
     mid-episode has no next_obs recorded — it is dropped (negligible at
     fragment lengths >> 1)."""
@@ -36,12 +36,12 @@ def _episodes_to_transitions(episodes) -> dict:
             # rllib's terminated/truncated distinction.
             dones.append(float(terms[i]))
     if not obs:
-        return {"obs": np.zeros((0,)), "actions": np.zeros((0,), np.int64),
+        return {"obs": np.zeros((0,)), "actions": np.zeros((0,), action_dtype),
                 "rewards": np.zeros((0,)), "next_obs": np.zeros((0,)),
                 "dones": np.zeros((0,))}
     return {
         "obs": np.asarray(obs, np.float32),
-        "actions": np.asarray(actions, np.int64),
+        "actions": np.asarray(actions, action_dtype),
         "rewards": np.asarray(rewards, np.float32),
         "next_obs": np.asarray(next_obs, np.float32),
         "dones": np.asarray(dones, np.float32),
@@ -56,7 +56,9 @@ def off_policy_train_iteration(algo) -> dict:
     cfg = algo.cfg
     episodes = algo.runners.sample(cfg.rollout_fragment_length)
     algo.env_steps_total += sum(len(e) for e in episodes)
-    batch = _episodes_to_transitions(episodes)
+    batch = _episodes_to_transitions(
+        episodes, getattr(algo, "action_dtype", np.int64)
+    )
     size = ray_tpu.get(algo.buffer.add_batch.remote(batch), timeout=60)
     metrics: dict = {}
     updates = 0
@@ -92,3 +94,27 @@ def probe_env_spaces(env_creator) -> tuple[int, int]:
     num_actions = int(probe.action_space.n)
     probe.close()
     return obs_dim, num_actions
+
+
+def probe_env_spaces_continuous(env_creator) -> tuple[int, int, np.ndarray, np.ndarray]:
+    """(obs_dim, act_dim, low, high) for a Box action space."""
+    probe = env_creator()
+    try:
+        space = probe.action_space
+        if not hasattr(space, "high"):
+            raise ValueError(
+                f"continuous algorithm needs a Box action space, got {space}"
+            )
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        act_dim = int(np.prod(space.shape))
+        low = np.asarray(space.low, np.float32).reshape(-1)
+        high = np.asarray(space.high, np.float32).reshape(-1)
+        if not (np.isfinite(low).all() and np.isfinite(high).all()):
+            raise ValueError(
+                f"continuous algorithm needs finite Box bounds, got "
+                f"low={low} high={high} (wrap the env with a bounded action "
+                f"space or rescale)"
+            )
+    finally:
+        probe.close()
+    return obs_dim, act_dim, low, high

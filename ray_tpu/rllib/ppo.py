@@ -35,6 +35,10 @@ class PPOConfig:
     minibatch_size: int = 128
     hidden: tuple = (64, 64)
     seed: int = 0
+    # connector pipeline factories (reference: rllib/connectors) — each env
+    # runner builds its own stateful instances
+    env_to_module: Callable | None = None
+    module_to_env: Callable | None = None
 
     # fluent configuration (reference: AlgorithmConfig.environment/.training/...)
     def environment(self, env) -> "PPOConfig":
@@ -181,6 +185,12 @@ class PPO:
         probe = env_creator()
         obs_dim = int(np.prod(probe.observation_space.shape))
         num_actions = int(probe.action_space.n)
+        if cfg.env_to_module is not None:
+            # the policy consumes CONNECTED observations — probe their shape
+            # through a throwaway pipeline instance
+            sample, _ = probe.reset(seed=0)
+            obs_dim = int(np.prod(np.asarray(cfg.env_to_module()(sample)).shape))
+        probe.close()
         self.learner = PPOLearner(cfg, obs_dim, num_actions)
 
         # numpy-side policy for env runners (no jit: tiny MLP, avoids
@@ -188,7 +198,9 @@ class PPO:
         # so thread-actors don't share global RNG state
         from ray_tpu.rllib.np_policy import actor_critic_policy_fn as policy_fn
 
-        self.runner_group = EnvRunnerGroup(env_creator, policy_fn, cfg.num_env_runners)
+        self.runner_group = EnvRunnerGroup(env_creator, policy_fn, cfg.num_env_runners,
+                                           env_to_module=cfg.env_to_module,
+                                           module_to_env=cfg.module_to_env)
         self._iteration = 0
 
     def _gae(self, ep: Episode) -> tuple[np.ndarray, np.ndarray]:

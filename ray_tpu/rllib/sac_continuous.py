@@ -1,0 +1,243 @@
+"""Continuous-action SAC: tanh-squashed Gaussian policy, twin Q, learned alpha.
+
+Parity: rllib/algorithms/sac/ in its original continuous-control form
+(Haarnoja 2018) — the discrete variant lives in sac.py. Same Learner/
+EnvRunner layering; one jitted XLA update covers both critics, the
+reparameterized actor, and the temperature. Actions map env-range <->
+[-1, 1] at the algorithm boundary, so the learner is scale-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.ppo import _mlp_apply, _mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+
+@dataclasses.dataclass
+class ContinuousSACConfig:
+    env: str | Callable = "Pendulum-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 200
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    target_entropy: Optional[float] = None  # None => -act_dim (SAC default)
+    buffer_capacity: int = 100_000
+    learning_starts: int = 1000
+    train_batch_size: int = 256
+    updates_per_iter: int = 64
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "ContinuousSACConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: int | None = None) -> "ContinuousSACConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "ContinuousSACConfig":
+        fields = {f.name for f in dataclasses.fields(self)}
+        for k, v in kw.items():
+            if k not in fields:
+                raise ValueError(f"Unknown training option {k}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "ContinuousSAC":
+        return ContinuousSAC(self)
+
+
+def _squashed_gaussian(jnp, jax, pi_out, eps):
+    """tanh(mu + std*eps) with its log-prob (change-of-variables corrected)."""
+    mu, log_std = jnp.split(pi_out, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    std = jnp.exp(log_std)
+    raw = mu + std * eps
+    act = jnp.tanh(raw)
+    # N(mu, std) log-density at raw, minus the tanh Jacobian term
+    logp = (-0.5 * ((raw - mu) / std) ** 2 - log_std
+            - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
+    logp -= (2.0 * (jnp.log(2.0) - raw - jax.nn.softplus(-2.0 * raw))).sum(-1)
+    return act, logp
+
+
+class ContinuousSACLearner:
+    """Twin Q(s,a) critics + reparameterized actor + temperature, one jit."""
+
+    def __init__(self, cfg: ContinuousSACConfig, obs_dim: int, act_dim: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        kp, k1, k2, self._key = jax.random.split(key, 4)
+        self.params = {
+            "pi": _mlp_init(kp, (obs_dim, *cfg.hidden, 2 * act_dim)),
+            "q1": _mlp_init(k1, (obs_dim + act_dim, *cfg.hidden, 1)),
+            "q2": _mlp_init(k2, (obs_dim + act_dim, *cfg.hidden, 1)),
+            "log_alpha": jnp.zeros(()),
+        }
+        self.target = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(5.0),
+            optax.multi_transform(
+                {"actor": optax.adam(cfg.actor_lr),
+                 "critic": optax.adam(cfg.critic_lr),
+                 "alpha": optax.adam(cfg.alpha_lr)},
+                {"pi": "actor", "q1": "critic", "q2": "critic",
+                 "log_alpha": "alpha"},
+            ),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                          else -float(act_dim))
+        self.num_updates = 0
+
+        def q_apply(q, obs, act):
+            return _mlp_apply(q, jnp.concatenate([obs, act], axis=-1), jnp)[:, 0]
+
+        def loss_fn(params, target, key, obs, actions, rewards, next_obs, dones):
+            alpha = jnp.exp(params["log_alpha"])
+            k_next, k_pi = jax.random.split(key)
+            B, A = actions.shape
+            # --- critic target: soft Bellman backup through the next action ---
+            next_a, next_logp = _squashed_gaussian(
+                jnp, jax, _mlp_apply(params["pi"], next_obs, jnp),
+                jax.random.normal(k_next, (B, A)),
+            )
+            tq = jnp.minimum(q_apply(target["q1"], next_obs, next_a),
+                             q_apply(target["q2"], next_obs, next_a))
+            target_q = jax.lax.stop_gradient(
+                rewards + cfg.gamma * (1.0 - dones)
+                * (tq - jax.lax.stop_gradient(alpha) * next_logp)
+            )
+            q1 = q_apply(params["q1"], obs, actions)
+            q2 = q_apply(params["q2"], obs, actions)
+            critic_loss = ((q1 - target_q) ** 2).mean() + ((q2 - target_q) ** 2).mean()
+            # --- actor: reparameterized sample through min-Q ---
+            a_pi, logp_pi = _squashed_gaussian(
+                jnp, jax, _mlp_apply(params["pi"], obs, jnp),
+                jax.random.normal(k_pi, (B, A)),
+            )
+            # gradient flows through the ACTION (reparameterization) but must
+            # not reach critic weights — else the actor term inflates Q
+            q1_frozen = jax.lax.stop_gradient(params["q1"])
+            q2_frozen = jax.lax.stop_gradient(params["q2"])
+            q_min = jnp.minimum(q_apply(q1_frozen, obs, a_pi),
+                                q_apply(q2_frozen, obs, a_pi))
+            actor_loss = (jax.lax.stop_gradient(alpha) * logp_pi - q_min).mean()
+            # --- temperature ---
+            alpha_loss = (-params["log_alpha"]
+                          * jax.lax.stop_gradient(logp_pi + target_entropy)).mean()
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "alpha": alpha, "entropy": -logp_pi.mean(),
+            }
+
+        def update(params, target, opt_state, key, batch):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target, key, batch["obs"], batch["actions"],
+                batch["rewards"], batch["next_obs"], batch["dones"],
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                target, {"q1": params["q1"], "q2": params["q2"]},
+            )
+            metrics["total_loss"] = loss
+            return params, target, opt_state, metrics
+
+        self._update = jax.jit(update)
+        self._jax, self._jnp = jax, jnp
+
+    def update(self, batch: dict) -> dict:
+        jnp = self._jnp
+        # actions arrive module-space [-1,1] (UnsquashActions maps to env range
+        # at the runner boundary), so the learner is scale-free
+        b = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.float32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "next_obs": jnp.asarray(batch["next_obs"], jnp.float32),
+            "dones": jnp.asarray(batch["dones"], jnp.float32),
+        }
+        self._key, sub = self._jax.random.split(self._key)
+        self.params, self.target, self.opt_state, metrics = self._update(
+            self.params, self.target, self.opt_state, sub, b
+        )
+        self.num_updates += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+
+class ContinuousSAC:
+    """The Algorithm (reference: algorithms/algorithm.py train() loop)."""
+
+    action_dtype = np.float32  # consulted by off_policy_train_iteration
+
+    def __init__(self, cfg: ContinuousSACConfig):
+        import gymnasium as gym
+
+        from ray_tpu.rllib.off_policy import probe_env_spaces_continuous
+
+        self.cfg = cfg
+        env_creator = (cfg.env if callable(cfg.env)
+                       else (lambda name=cfg.env: gym.make(name)))
+        obs_dim, act_dim, low, high = probe_env_spaces_continuous(env_creator)
+        self.learner = ContinuousSACLearner(cfg, obs_dim, act_dim)
+        self.env_steps_total = 0
+
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.connectors import UnsquashActions, pipeline
+
+        pi_apply = jax.jit(lambda p, o: _mlp_apply(p, o, jnp))
+
+        def policy_fn(params, obs, rng):
+            # module-space action in [-1,1]; the UnsquashActions connector maps
+            # to the env's Box range at the runner boundary, so episodes (and
+            # the replay buffer) hold module-space actions
+            out = np.asarray(pi_apply(params["pi"], obs[None]))[0]
+            mu, log_std = out[:act_dim], out[act_dim:]
+            std = np.exp(np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+            a = np.tanh(mu + std * rng.standard_normal(act_dim))
+            return a.astype(np.float32), 0.0, 0.0
+
+        self.runners = EnvRunnerGroup(
+            env_creator, policy_fn, num_runners=cfg.num_env_runners,
+            module_to_env=pipeline(lambda: UnsquashActions(low, high)),
+        )
+        self.runners.sync_weights(self.learner.params)
+        BufferActor = ray_tpu.remote(num_cpus=0)(ReplayBuffer)
+        self.buffer = BufferActor.remote(cfg.buffer_capacity, cfg.seed)
+
+    def train(self) -> dict:
+        from ray_tpu.rllib.off_policy import off_policy_train_iteration
+
+        return off_policy_train_iteration(self)
+
+    def stop(self) -> None:
+        self.runners.stop()
+        try:
+            ray_tpu.kill(self.buffer)
+        except Exception:
+            pass

@@ -1,0 +1,108 @@
+"""Connector pipelines: obs/action transforms plugged into env runners.
+
+Reference: rllib/connectors — env-to-module (flatten/normalize/frame-stack)
+and module-to-env (clip/unsquash) pipelines, stateful per EnvRunner.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    ConnectorPipeline,
+    FlattenObs,
+    FrameStack,
+    NormalizeObs,
+    UnsquashActions,
+    pipeline,
+)
+
+
+def test_frame_stack_shapes_and_reset():
+    fs = FrameStack(3)
+    o1 = fs(np.array([1.0, 2.0]))
+    assert o1.shape == (6,)
+    assert list(o1) == [0, 0, 0, 0, 1, 2]  # zero-padded at episode start
+    o2 = fs(np.array([3.0, 4.0]))
+    assert list(o2) == [0, 0, 1, 2, 3, 4]
+    fs.reset()
+    o3 = fs(np.array([9.0, 9.0]))
+    assert list(o3) == [0, 0, 0, 0, 9, 9]
+
+
+def test_normalize_obs_standardizes():
+    rng = np.random.default_rng(0)
+    norm = NormalizeObs()
+    outs = [norm(rng.normal(5.0, 3.0, size=4)) for _ in range(2000)]
+    tail = np.stack(outs[500:])
+    assert abs(tail.mean()) < 0.2
+    assert abs(tail.std() - 1.0) < 0.3
+
+
+def test_unsquash_and_clip_actions():
+    un = UnsquashActions(low=[-2.0], high=[2.0])
+    assert np.allclose(un(np.array([0.0])), [0.0])
+    assert np.allclose(un(np.array([1.0])), [2.0])
+    assert np.allclose(un(np.array([5.0])), [2.0])  # clipped into [-1,1] first
+    cl = ClipActions(low=[-1.0], high=[1.0])
+    assert np.allclose(cl(np.array([3.0])), [1.0])
+
+
+def test_pipeline_composition_and_factory_isolation():
+    make = pipeline(lambda: FlattenObs(), lambda: FrameStack(2))
+    p1, p2 = make(), make()
+    assert isinstance(p1, ConnectorPipeline)
+    p1(np.ones((2, 2)))
+    # p2's FrameStack must be untouched by p1's state
+    out = p2(np.zeros((2, 2)))
+    assert out.shape == (8,)
+    assert out.sum() == 0
+
+
+def test_env_runner_applies_pipelines():
+    ray_tpu.init(log_to_driver=False)
+    try:
+        import gymnasium as gym
+
+        from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+
+        seen_dims = []
+
+        def policy_fn(params, obs, rng):
+            seen_dims.append(obs.shape)
+            return int(rng.integers(2)), 0.0, 0.0
+
+        runner = SingleAgentEnvRunner(
+            lambda: gym.make("CartPole-v1"), policy_fn, seed=0,
+            env_to_module=pipeline(lambda: FlattenObs(), lambda: FrameStack(4)),
+        )
+        eps = runner.sample(30)
+        assert all(d == (16,) for d in seen_dims)  # 4 obs x 4 frames
+        assert all(e.obs[0].shape == (16,) for e in eps)
+        # frame stack resets at episode boundaries: first obs of a later
+        # episode has exactly one live frame (3 zero pads)
+        if len(eps) > 1:
+            first = eps[1].obs[0]
+            assert np.allclose(first[:12], 0.0)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_ppo_learns_with_frame_stack():
+    """PPO + frame-stack connector still trains (shapes plumb through probe,
+    learner, and runners); one iteration suffices as an integration check."""
+    ray_tpu.init(log_to_driver=False)
+    try:
+        from ray_tpu.rllib import PPOConfig
+
+        algo = (PPOConfig()
+                .environment("CartPole-v1")
+                .env_runners(2, rollout_fragment_length=64)
+                .training(env_to_module=pipeline(lambda: FlattenObs(),
+                                                 lambda: FrameStack(2)),
+                          minibatch_size=32)
+                .build())
+        m = algo.train()
+        assert np.isfinite(m["pg_loss"])
+    finally:
+        ray_tpu.shutdown()

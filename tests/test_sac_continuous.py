@@ -1,0 +1,103 @@
+"""Continuous-action SAC (tanh-Gaussian, twin Q(s,a), learned alpha).
+
+Reference: rllib/algorithms/sac in its original continuous-control form;
+Pendulum-v1 is the canonical smoke env (random policy ~ -1200/episode,
+learning shows up as a clear upward trend within bounded iterations).
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _session():
+    ray_tpu.init(log_to_driver=False)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_continuous_sac_improves_pendulum():
+    from ray_tpu.rllib import ContinuousSACConfig
+
+    algo = (ContinuousSACConfig()
+            .environment("Pendulum-v1")
+            .env_runners(2, rollout_fragment_length=200)
+            .training(learning_starts=600, updates_per_iter=96,
+                      train_batch_size=128, seed=0)
+            .build())
+    rewards = []
+    try:
+        # ~400 env steps/iter; seed-0 convergence observed at iter ~51 — the
+        # 150-iter cap is ~3x headroom (the whole loop is tens of seconds)
+        for it in range(150):
+            m = algo.train()
+            if m["episodes_this_iter"]:
+                rewards.append(m["episode_reward_mean"])
+            if len(rewards) >= 6 and np.mean(rewards[-3:]) > -350:
+                break
+    finally:
+        algo.stop()
+    late = np.mean(rewards[-3:])
+    # Pendulum: random ~ -1200; a learning agent climbs decisively
+    assert late > -500, f"no convergence: late={late:.0f} n={len(rewards)} {rewards[-10:]}"
+
+
+def test_squashed_gaussian_logp_matches_numeric():
+    """The tanh-corrected log-prob must integrate like a density: compare the
+    analytic correction against a numeric finite-difference Jacobian."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.sac_continuous import _squashed_gaussian
+
+    mu, log_std = 0.3, -0.5
+    pi_out = jnp.asarray([[mu, log_std]])
+    eps = jnp.asarray([[0.7]])
+    act, logp = _squashed_gaussian(jnp, jax, pi_out, eps)
+    std = np.exp(log_std)
+    raw = mu + std * 0.7
+    base = -0.5 * ((raw - mu) / std) ** 2 - log_std - 0.5 * np.log(2 * np.pi)
+    jac = 1.0 - np.tanh(raw) ** 2
+    expected = base - np.log(jac)
+    assert np.allclose(float(act[0, 0]), np.tanh(raw), atol=1e-5)
+    assert np.allclose(float(logp[0]), expected, atol=1e-4)
+
+
+def test_learner_update_moves_toward_reward():
+    """Critic of a 1-step bandit-like batch learns the reward structure and
+    alpha stays finite."""
+    from ray_tpu.rllib.sac_continuous import ContinuousSACConfig, ContinuousSACLearner
+
+    rng = np.random.default_rng(0)
+    learner = ContinuousSACLearner(ContinuousSACConfig(), obs_dim=3, act_dim=1)
+    for _ in range(50):
+        obs = rng.standard_normal((128, 3)).astype(np.float32)
+        act = rng.uniform(-1, 1, (128, 1)).astype(np.float32)
+        batch = {
+            "obs": obs,
+            "actions": act,
+            "rewards": -np.abs(act[:, 0]),  # reward peaks at action 0
+            "next_obs": obs,
+            "dones": np.ones(128, np.float32),
+        }
+        metrics = learner.update(batch)
+    assert np.isfinite(metrics["critic_loss"])
+    assert 0 < metrics["alpha"] < 10
+    # after training, policy mean action should concentrate near 0
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.ppo import _mlp_apply
+
+    out = np.asarray(_mlp_apply(learner.params["pi"],
+                                jnp.asarray(rng.standard_normal((256, 3)),
+                                            jnp.float32), jnp))
+    mean_abs_action = np.abs(np.tanh(out[:, 0])).mean()
+    assert mean_abs_action < 0.5, mean_abs_action
+
+
+def test_box_space_required():
+    from ray_tpu.rllib import ContinuousSACConfig
+
+    with pytest.raises(ValueError, match="Box action space"):
+        ContinuousSACConfig().environment("CartPole-v1").build()
