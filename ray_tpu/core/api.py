@@ -37,10 +37,17 @@ def init(
     labels: dict[str, str] | None = None,
     namespace: str | None = None,
     ignore_reinit_error: bool = False,
+    token: str | None = None,
     _system_config: dict | None = None,
     log_to_driver: bool = True,
 ) -> "RuntimeContext":
     """Start (or connect to) a runtime session.
+
+    ``address="host:port"`` attaches this process as a DRIVER to an existing
+    head started elsewhere (``rtpu start --head`` — the reference's
+    ``ray.init(address=...)`` connect path, worker.py:1978). ``token`` is the
+    head's control-plane token (or env RAY_TPU_TOKEN). Everything submitted
+    runs on the head's cluster; objects move over the wire/object plane.
 
     ``num_nodes > 1`` creates multiple logical nodes in the single-controller
     scheduler — the analog of the reference's in-process multi-raylet test Cluster
@@ -52,6 +59,35 @@ def init(
             if ignore_reinit_error:
                 return RuntimeContext(get_runtime())
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        if address and address not in ("local", "auto"):
+            import os as _os
+
+            from ray_tpu.core.client_runtime import install_client_runtime
+
+            host, _, port = address.rpartition(":")
+            if not host or not port.isdigit():
+                raise ValueError(
+                    f"address must be 'host:port' to attach to a head, got {address!r}"
+                )
+            ignored = {"num_cpus": num_cpus, "num_tpus": num_tpus,
+                       "resources": resources, "labels": labels,
+                       "namespace": namespace, "_system_config": _system_config}
+            ignored = {k: v for k, v in ignored.items()
+                       if v not in (None, {})} | ({"num_nodes": num_nodes}
+                                                  if num_nodes != 1 else {})
+            if ignored:
+                import logging
+
+                logging.getLogger("ray_tpu").warning(
+                    "init(address=...) attaches to an existing head; these "
+                    "arguments configure a head and are ignored here: %s",
+                    sorted(ignored),
+                )
+            client = install_client_runtime(
+                host, int(port), token or _os.environ.get("RAY_TPU_TOKEN"),
+                shm_name=None, shm_size=0,
+            )
+            return RuntimeContext(client)
         cfg = Config().apply_env_overrides().apply_system_config(_system_config)
         set_config(cfg)
         res = dict(resources or {})

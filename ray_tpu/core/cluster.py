@@ -429,6 +429,26 @@ def start_node_agent(
     — the cross-host topology (objects then move via chunked pulls)."""
     from ray_tpu.core.process_pool import worker_env
 
+    cmd = node_agent_argv(head_addr, token, num_cpus=num_cpus,
+                          resources=resources, labels=labels,
+                          slice_name=slice_name, ici_coords=ici_coords,
+                          name=name, isolated_plane=isolated_plane)
+    return subprocess.Popen(cmd, env=worker_env())
+
+
+def node_agent_argv(
+    head_addr: str,
+    token: str,
+    num_cpus: float = 4,
+    resources: dict[str, float] | None = None,
+    labels: dict[str, str] | None = None,
+    slice_name: str | None = None,
+    ici_coords: tuple | None = None,
+    name: str = "",
+    isolated_plane: bool = False,
+) -> list[str]:
+    """The one place the node-agent command line is assembled (used by the
+    in-process spawner above and `rtpu start --address`)."""
     res = {"CPU": float(num_cpus), **(resources or {})}
     cmd = [
         sys.executable, "-m", "ray_tpu.core.node_agent",
@@ -445,4 +465,4 @@ def start_node_agent(
         cmd += ["--ici-coords", json.dumps(list(ici_coords))]
     if name:
         cmd += ["--name", name]
-    return subprocess.Popen(cmd, env=worker_env())
+    return cmd

@@ -92,6 +92,125 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def _session_file() -> str:
+    """Per-user, 0700 session dir: the file holds the control-plane token, so
+    it must not be world-readable (and concurrent users must not collide)."""
+    import os
+
+    d = os.path.join(os.path.expanduser("~"), ".ray_tpu")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    return os.path.join(d, "head_session.json")
+
+
+def cmd_start(args) -> int:
+    """`ray start`-equivalent (reference: scripts.py ray start --head/--address).
+
+    --head: run a standalone head (control plane + scheduler) this process;
+    prints the join command for other hosts and the attach address for
+    drivers, then blocks until SIGINT/SIGTERM.
+    --address: join an existing head as a worker node (this IS the remote
+    host entrypoint; runs the node agent in the foreground).
+    """
+    import os
+    import signal
+
+    if args.head and args.address:
+        print("error: pass --head OR --address, not both", file=sys.stderr)
+        return 2
+    if args.head:
+        # explicit flags override any inherited env (assignment, not setdefault)
+        os.environ["RAY_TPU_CONTROL_PLANE_HOST"] = args.host
+        os.environ["RAY_TPU_CONTROL_PLANE_PORT"] = str(args.port or 0)
+        import ray_tpu
+        from ray_tpu.core import runtime as rt_mod
+
+        ray_tpu.init(num_cpus=args.num_cpus, log_to_driver=False)
+        rt = rt_mod.get_runtime()
+        if rt.control_plane is None:
+            print("error: control plane failed to start", file=sys.stderr)
+            return 1
+        addr = rt.control_plane.address
+        if addr.startswith("0.0.0.0:"):
+            # advertise a routable address, not the wildcard bind
+            import socket
+
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                s.connect(("10.255.255.255", 1))
+                ip = s.getsockname()[0]
+                s.close()
+            except OSError:
+                ip = "127.0.0.1"
+            addr = f"{ip}:{addr.rsplit(':', 1)[1]}"
+        token = rt.control_plane.token
+        info = {"address": addr, "token": token, "pid": os.getpid()}
+        session_file = _session_file()
+        fd = os.open(session_file, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(info, f)
+        print(f"Head started at {addr}")
+        print("Join from another host:")
+        print(f"  python -m ray_tpu.scripts.cli start --address {addr} --token {token}")
+        print("Attach a driver:")
+        print(f"  ray_tpu.init(address={addr!r}, token={token!r})")
+        stop = {"flag": False}
+        signal.signal(signal.SIGTERM, lambda *a: stop.update(flag=True))
+        try:
+            while not stop["flag"]:
+                import time
+
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        ray_tpu.shutdown()
+        try:
+            os.unlink(session_file)
+        except OSError:
+            pass
+        return 0
+    if args.address:
+        token = args.token or os.environ.get("RAY_TPU_TOKEN")
+        if not token:
+            print("error: --token (or RAY_TPU_TOKEN) required to join a head",
+                  file=sys.stderr)
+            return 2
+        from ray_tpu.core.cluster import node_agent_argv
+
+        # cross-host nodes own their object plane; objects move via chunked
+        # pulls (core/object_plane.py)
+        agent_argv = node_agent_argv(
+            args.address, token, num_cpus=float(args.num_cpus or 4),
+            name=args.name or "", isolated_plane=True,
+        )
+        os.execv(sys.executable, agent_argv)
+    print("error: pass --head or --address", file=sys.stderr)
+    return 2
+
+
+def cmd_stop(args) -> int:
+    """Stop the head started by `start --head` (reference: ray stop)."""
+    import os
+    import signal
+
+    session_file = _session_file()
+    try:
+        with open(session_file) as f:
+            info = json.load(f)
+    except OSError:
+        print("No running head session found.")
+        return 0
+    try:
+        os.kill(info["pid"], signal.SIGTERM)
+        print(f"Stopped head pid {info['pid']} ({info['address']})")
+    except ProcessLookupError:
+        print("Head process already gone.")
+    try:
+        os.unlink(session_file)
+    except OSError:
+        pass
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu", description="TPU-native distributed runtime CLI")
     p.add_argument("--num-cpus", type=float, default=None)
@@ -115,7 +234,21 @@ def main(argv=None) -> int:
     jsp.add_argument("--timeout", type=float, default=300.0)
     jsp.add_argument("entrypoint", nargs=argparse.REMAINDER)
 
+    stp = sub.add_parser("start", help="start a head or join one (ray start equiv)")
+    stp.add_argument("--head", action="store_true")
+    stp.add_argument("--address", default=None, help="head host:port to join")
+    stp.add_argument("--token", default=None)
+    stp.add_argument("--host", default="0.0.0.0", help="head bind host")
+    stp.add_argument("--port", type=int, default=0, help="head bind port (0=ephemeral)")
+    stp.add_argument("--name", default=None, help="node name when joining")
+
+    sub.add_parser("stop", help="stop the head started by `start --head`")
+
     args = p.parse_args(argv)
+    if args.cmd == "start":
+        return cmd_start(args)
+    if args.cmd == "stop":
+        return cmd_stop(args)
     if args.cmd == "status":
         return cmd_status(args)
     if args.cmd == "list":

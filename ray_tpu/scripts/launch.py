@@ -1,0 +1,185 @@
+"""Multi-host cluster launcher: `rtpu start` orchestrated over ssh.
+
+Parity: the reference's `ray up` autoscaler launcher + `ray start --address`
+manual assembly (python/ray/scripts/scripts.py, autoscaler/_private/updater.py
+ssh runner). A cluster spec names the head and worker hosts; `up` starts the
+head remotely, reads back its address+token, and joins each worker;
+`down` stops everything.
+
+Providers:
+- ``ssh``: run the CLI on remote hosts over ssh (BatchMode, no prompts).
+- ``local``: spawn the same CLI as local subprocesses — the provider used in
+  tests and on a single machine, exercising exactly the commands ssh would.
+
+Spec (JSON or YAML-subset: JSON is always accepted):
+    {
+      "provider": "ssh" | "local",
+      "head": {"host": "10.0.0.1", "port": 7380, "num_cpus": 8},
+      "workers": [{"host": "10.0.0.2", "num_cpus": 8, "name": "w1"}],
+      "ssh": {"user": "ubuntu", "key": "~/.ssh/id_ed25519",
+              "python": "python3"}
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Any
+
+def _state_file() -> str:
+    d = os.path.join(os.path.expanduser("~"), ".ray_tpu")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    return os.path.join(d, "launch_state.json")
+
+
+def load_spec(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _ssh_base(spec: dict, host: str) -> list[str]:
+    ssh = spec.get("ssh", {})
+    cmd = ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=accept-new"]
+    if ssh.get("key"):
+        cmd += ["-i", os.path.expanduser(ssh["key"])]
+    user = ssh.get("user")
+    cmd.append(f"{user}@{host}" if user else host)
+    return cmd
+
+
+def head_start_command(spec: dict) -> list[str]:
+    head = spec["head"]
+    py = spec.get("ssh", {}).get("python", sys.executable)
+    cmd = [py, "-m", "ray_tpu.scripts.cli"]
+    if head.get("num_cpus"):
+        cmd += ["--num-cpus", str(head["num_cpus"])]
+    cmd += ["start", "--head", "--host", head.get("bind", "0.0.0.0")]
+    if head.get("port"):
+        cmd += ["--port", str(head["port"])]
+    return cmd
+
+
+def worker_join_command(spec: dict, worker: dict, address: str, token: str) -> list[str]:
+    py = spec.get("ssh", {}).get("python", sys.executable)
+    cmd = [py, "-m", "ray_tpu.scripts.cli"]
+    if worker.get("num_cpus"):
+        cmd += ["--num-cpus", str(worker["num_cpus"])]
+    cmd += ["start", "--address", address, "--token", token]
+    if worker.get("name"):
+        cmd += ["--name", worker["name"]]
+    return cmd
+
+
+def _spawn(spec: dict, host: str, argv: list[str], log_path: str) -> subprocess.Popen:
+    # truncate: a stale log from a previous run must never satisfy
+    # _wait_for_head_info with an old address/token
+    log = open(log_path, "wb")
+    if spec.get("provider", "ssh") == "local":
+        return subprocess.Popen(argv, stdout=log, stderr=subprocess.STDOUT)
+    remote = " ".join(argv)
+    return subprocess.Popen(_ssh_base(spec, host) + [remote],
+                            stdout=log, stderr=subprocess.STDOUT)
+
+
+def _wait_for_head_info(log_path: str, timeout: float = 60.0) -> tuple[str, str]:
+    """Parse 'Head started at <addr>' + the join token from the head log."""
+    deadline = time.time() + timeout
+    addr = token = None
+    while time.time() < deadline:
+        try:
+            with open(log_path) as f:
+                text = f.read()
+        except OSError:
+            text = ""
+        m = re.search(r"Head started at (\S+)", text)
+        t = re.search(r"--token (\S+)", text)
+        if m and t:
+            addr, token = m.group(1), t.group(1)
+            break
+        time.sleep(0.25)
+    if not addr:
+        raise TimeoutError(f"head did not report its address within {timeout}s "
+                           f"(see {log_path})")
+    return addr, token
+
+
+def up(spec: dict, log_dir: str = "/tmp") -> dict:
+    """Start head + workers; returns {'address', 'token', 'pids'}."""
+    head_log = os.path.join(log_dir, "ray_tpu_head.log")
+    head_proc = _spawn(spec, spec["head"]["host"], head_start_command(spec), head_log)
+    try:
+        addr, token = _wait_for_head_info(head_log)
+    except TimeoutError:
+        head_proc.terminate()  # never leave a half-started head holding the port
+        try:
+            head_proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            head_proc.kill()
+        raise
+    # a wildcard-advertised local head is joinable at loopback
+    if spec.get("provider") == "local":
+        addr = f"127.0.0.1:{addr.rsplit(':', 1)[1]}"
+    pids = {"head": head_proc.pid}
+    for i, w in enumerate(spec.get("workers", [])):
+        wlog = os.path.join(log_dir, f"ray_tpu_worker{i}.log")
+        proc = _spawn(spec, w["host"], worker_join_command(spec, w, addr, token), wlog)
+        pids[w.get("name") or f"worker{i}"] = proc.pid
+    state = {"address": addr, "token": token, "pids": pids,
+             "provider": spec.get("provider", "ssh")}
+    fd = os.open(_state_file(), os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump(state, f)
+    return state
+
+
+def down(spec: dict | None = None) -> None:
+    """Stop everything started by up() (local provider: by pid; ssh: rtpu stop)."""
+    import signal
+
+    try:
+        with open(_state_file()) as f:
+            state = json.load(f)
+    except OSError:
+        return
+    if state.get("provider") == "local":
+        for pid in state.get("pids", {}).values():
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+    elif spec is not None:
+        py = spec.get("ssh", {}).get("python", "python3")
+        for host in [spec["head"]["host"]] + [w["host"] for w in spec.get("workers", [])]:
+            subprocess.run(_ssh_base(spec, host) + [f"{py} -m ray_tpu.scripts.cli stop"],
+                           timeout=30, capture_output=True)
+    try:
+        os.unlink(_state_file())
+    except OSError:
+        pass
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="ray-tpu-launch")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    upp = sub.add_parser("up")
+    upp.add_argument("spec")
+    dnp = sub.add_parser("down")
+    dnp.add_argument("spec", nargs="?")
+    args = p.parse_args(argv)
+    if args.cmd == "up":
+        state = up(load_spec(args.spec))
+        print(json.dumps(state))
+        return 0
+    down(load_spec(args.spec) if args.spec else None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,163 @@
+"""Cross-host deployment surface: `start --head` / `start --address` CLI,
+driver attach via ray_tpu.init(address=...), and the multi-host launcher.
+
+Reference: `ray start --head` / `ray start --address` (scripts.py), driver
+connect (worker.py:1978), `ray up` (autoscaler launcher). Hosts here are
+local processes — the same commands ssh would run on real machines.
+"""
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ, PYTHONPATH=REPO, PYTHONUNBUFFERED="1")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _wait_head_info(path, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            text = open(path).read()
+        except OSError:
+            text = ""
+        m = re.search(r"Head started at (\S+)", text)
+        t = re.search(r"--token (\S+)", text)
+        if m and t:
+            return m.group(1), t.group(1)
+        time.sleep(0.25)
+    raise TimeoutError(open(path).read() if os.path.exists(path) else "no log")
+
+
+@pytest.fixture
+def head_session(tmp_path):
+    log = tmp_path / "head.log"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "--num-cpus", "4",
+         "start", "--head", "--host", "127.0.0.1"],
+        stdout=open(log, "wb"), stderr=subprocess.STDOUT, env=_env(),
+    )
+    addr, token = _wait_head_info(log)
+    children = []
+    yield {"addr": addr, "token": token, "spawn": children, "tmp": tmp_path}
+    for c in children:
+        c.terminate()
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_head_join_attach_roundtrip(head_session):
+    addr, token = head_session["addr"], head_session["token"]
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "--num-cpus", "3",
+         "start", "--address", addr, "--token", token, "--name", "wk1"],
+        stdout=open(head_session["tmp"] / "wk1.log", "wb"),
+        stderr=subprocess.STDOUT, env=_env(),
+    )
+    head_session["spawn"].append(worker)
+    # drive through a subprocess driver (this pytest process may hold its own
+    # runtime session; attach must work from a fresh interpreter)
+    code = f"""
+import ray_tpu
+ray_tpu.init(address={addr!r}, token={token!r})
+@ray_tpu.remote
+def sq(x):
+    import os
+    return x * x, os.getpid()
+out = ray_tpu.get([sq.remote(i) for i in range(4)], timeout=120)
+assert [o[0] for o in out] == [0, 1, 4, 9]
+assert len({{o[1] for o in out}}) >= 1
+big = ray_tpu.put(bytes(1_000_000))
+assert len(ray_tpu.get(big, timeout=60)) == 1_000_000
+ray_tpu.shutdown()
+print("DRIVER_OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=180, env=_env())
+    assert "DRIVER_OK" in r.stdout, r.stdout + r.stderr
+
+
+def test_attach_rejects_bad_token(head_session):
+    addr = head_session["addr"]
+    code = f"""
+import ray_tpu
+ray_tpu.init(address={addr!r}, token="wrong-token")
+try:
+    ray_tpu.get(ray_tpu.put(1), timeout=20)
+    print("NO_ERROR")
+except Exception as e:
+    print("REJECTED", type(e).__name__)
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120, env=_env())
+    assert "REJECTED" in r.stdout, r.stdout + r.stderr
+
+
+def test_init_address_validation():
+    import ray_tpu
+
+    with pytest.raises(ValueError, match="host:port"):
+        ray_tpu.init(address="not-an-address")
+
+
+def test_launcher_local_provider(tmp_path):
+    from ray_tpu.scripts import launch
+
+    spec = {
+        "provider": "local",
+        "head": {"host": "127.0.0.1", "num_cpus": 4, "bind": "127.0.0.1"},
+        "workers": [{"host": "127.0.0.1", "num_cpus": 2, "name": "w0"}],
+    }
+    state = launch.up(spec, log_dir=str(tmp_path))
+    try:
+        assert state["address"].startswith("127.0.0.1:")
+        assert state["token"]
+        assert set(state["pids"]) == {"head", "w0"}
+        code = f"""
+import ray_tpu
+ray_tpu.init(address={state["address"]!r}, token={state["token"]!r})
+@ray_tpu.remote
+def f():
+    return "up"
+assert ray_tpu.get(f.remote(), timeout=120) == "up"
+print("LAUNCH_OK")
+"""
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=180, env=_env())
+        assert "LAUNCH_OK" in r.stdout, r.stdout + r.stderr
+    finally:
+        launch.down(spec)
+
+
+def test_launcher_ssh_command_construction():
+    """ssh provider builds the exact remote commands (no hosts to run on here)."""
+    from ray_tpu.scripts import launch
+
+    spec = {
+        "provider": "ssh",
+        "head": {"host": "10.0.0.1", "port": 7380, "num_cpus": 8},
+        "workers": [{"host": "10.0.0.2", "num_cpus": 16, "name": "w1"}],
+        "ssh": {"user": "ubuntu", "key": "~/.ssh/id_ed25519", "python": "python3"},
+    }
+    head_cmd = launch.head_start_command(spec)
+    assert head_cmd[:3] == ["python3", "-m", "ray_tpu.scripts.cli"]
+    assert "--head" in head_cmd and "--port" in head_cmd
+    join = launch.worker_join_command(spec, spec["workers"][0],
+                                      "10.0.0.1:7380", "tok123")
+    assert "--address" in join and "10.0.0.1:7380" in join and "tok123" in join
+    base = launch._ssh_base(spec, "10.0.0.2")
+    assert base[0] == "ssh" and base[-1] == "ubuntu@10.0.0.2"
+    assert "-i" in base
