@@ -1,0 +1,16 @@
+"""AIR glue: shared run/scaling/failure configs + experiment-tracking callbacks.
+
+Parity: python/ray/air/ — the configs live in train/config.py (re-exported
+here), integrations under air/integrations (wandb/mlflow logger callbacks).
+"""
+
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.callbacks import Callback  # noqa: F401
+
+__all__ = ["CheckpointConfig", "FailureConfig", "RunConfig", "ScalingConfig",
+           "Callback"]

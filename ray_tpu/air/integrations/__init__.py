@@ -1,0 +1,1 @@
+"""Experiment-tracker integrations (reference: python/ray/air/integrations)."""

@@ -1,0 +1,117 @@
+"""MLflow logger callback.
+
+Parity: python/ray/air/integrations/mlflow.py (MLflowLoggerCallback). Uses
+MlflowClient with explicit run ids (never the fluent active-run stack), so
+concurrent trials each own their run. The mlflow SDK is optional: without it
+the callback writes the mlruns file-store shape (one run directory with
+params/ and metrics/ files) so histories stay inspectable.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any
+
+from ray_tpu.air.callbacks import Callback
+
+
+def _try_import_mlflow():
+    try:
+        import mlflow  # noqa: F401
+
+        return mlflow
+    except ImportError:
+        return None
+
+
+def _safe_key(k: Any) -> str:
+    """Keys become filenames in the offline store: no path separators."""
+    return str(k).replace(os.sep, "__").replace("/", "__")
+
+
+class MLflowLoggerCallback(Callback):
+    def __init__(self, experiment_name: str = "ray_tpu",
+                 tracking_uri: str | None = None, **kwargs):
+        self.experiment_name = experiment_name
+        self.tracking_uri = tracking_uri or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results", "mlruns"
+        )
+        self.kwargs = kwargs
+        self._mlflow = _try_import_mlflow()
+        self._client = None
+        self._experiment_id = None
+        self._run_ids: dict[str, str] = {}   # trial_id -> mlflow run id
+        self._dirs: dict[str, str] = {}      # offline fallback
+        self._steps: dict[str, int] = {}
+        if self._mlflow is not None:
+            from mlflow.tracking import MlflowClient
+
+            self._client = MlflowClient(tracking_uri=self.tracking_uri)
+            exp = self._client.get_experiment_by_name(experiment_name)
+            self._experiment_id = (exp.experiment_id if exp is not None
+                                   else self._client.create_experiment(experiment_name))
+        else:
+            import logging
+
+            logging.getLogger("ray_tpu.air").info(
+                "mlflow is not installed; MLflowLoggerCallback writes the "
+                "mlruns file layout under %s", self.tracking_uri,
+            )
+
+    def on_trial_start(self, trial_id: str, config: dict) -> None:
+        if self._client is not None:
+            run = self._client.create_run(
+                self._experiment_id, run_name=trial_id,
+                tags={"ray_tpu.trial_id": trial_id},
+            )
+            self._run_ids[trial_id] = run.info.run_id
+            for k, v in config.items():
+                self._client.log_param(run.info.run_id, _safe_key(k), v)
+            self._steps[trial_id] = 0
+            return
+        run_dir = os.path.join(self.tracking_uri, self.experiment_name, trial_id)
+        # a re-run with the same ids must not mix old and new histories
+        shutil.rmtree(run_dir, ignore_errors=True)
+        os.makedirs(os.path.join(run_dir, "params"), exist_ok=True)
+        os.makedirs(os.path.join(run_dir, "metrics"), exist_ok=True)
+        for k, v in config.items():
+            with open(os.path.join(run_dir, "params", _safe_key(k)), "w") as f:
+                f.write(str(v))
+        self._dirs[trial_id] = run_dir
+        self._steps[trial_id] = 0
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        numeric = {k: v for k, v in result.items()
+                   if isinstance(v, (int, float)) and v == v}
+        step = self._steps[trial_id] = self._steps.get(trial_id, 0) + 1
+        ts = int(time.time() * 1000)
+        if self._client is not None:
+            run_id = self._run_ids.get(trial_id)
+            if run_id is not None:
+                for k, v in numeric.items():
+                    self._client.log_metric(run_id, _safe_key(k), float(v),
+                                            timestamp=ts, step=step)
+            return
+        run_dir = self._dirs.get(trial_id)
+        if run_dir is None:
+            return
+        for k, v in numeric.items():
+            # mlruns metric file format: "<timestamp> <value> <step>" per line
+            with open(os.path.join(run_dir, "metrics", _safe_key(k)), "a") as f:
+                f.write(f"{ts} {v} {step}\n")
+
+    def on_trial_complete(self, trial_id: str, last_result: dict,
+                          error: str | None = None) -> None:
+        if self._client is not None:
+            run_id = self._run_ids.pop(trial_id, None)
+            if run_id is not None:
+                self._client.set_terminated(
+                    run_id, status="FAILED" if error else "FINISHED"
+                )
+            return
+        run_dir = self._dirs.pop(trial_id, None)
+        if run_dir is not None:
+            with open(os.path.join(run_dir, "status"), "w") as f:
+                f.write("FAILED" if error else "FINISHED")

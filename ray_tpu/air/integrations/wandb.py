@@ -1,0 +1,102 @@
+"""Weights & Biases logger callback.
+
+Parity: python/ray/air/integrations/wandb.py (WandbLoggerCallback). The wandb
+SDK is optional (not in this image — zero egress): without it the callback
+degrades to wandb's own offline layout shape — one directory per trial with
+config + JSONL metric history — so runs remain inspectable and the calling
+code is identical either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from ray_tpu.air.callbacks import Callback
+
+
+def _try_import_wandb():
+    try:
+        import wandb  # noqa: F401
+
+        return wandb
+    except ImportError:
+        return None
+
+
+class WandbLoggerCallback(Callback):
+    def __init__(self, project: str = "ray_tpu", group: str | None = None,
+                 dir: str | None = None, mode: str | None = None, **init_kwargs):
+        self.project = project
+        self.group = group
+        self.dir = dir or os.path.join(os.path.expanduser("~"), "ray_tpu_results",
+                                       "wandb")
+        self.mode = mode
+        self.init_kwargs = init_kwargs
+        self._wandb = _try_import_wandb()
+        self._runs: dict[str, Any] = {}   # trial_id -> wandb run
+        self._files: dict[str, Any] = {}  # trial_id -> offline JSONL handle
+        if self._wandb is None:
+            import logging
+
+            logging.getLogger("ray_tpu.air").info(
+                "wandb is not installed; WandbLoggerCallback logs offline "
+                "JSONL under %s", self.dir,
+            )
+
+    def on_trial_start(self, trial_id: str, config: dict) -> None:
+        if self._wandb is not None:
+            kw = dict(project=self.project, group=self.group, name=trial_id,
+                      config=config, dir=self.dir, mode=self.mode,
+                      **self.init_kwargs)
+            try:
+                # concurrent trials need independent runs; plain reinit=True
+                # FINISHES the previous trial's run (wandb >= 0.19 supports
+                # create_new; the reference isolates runs per-process instead)
+                run = self._wandb.init(reinit="create_new", **kw)
+            except (TypeError, ValueError):
+                import logging
+
+                logging.getLogger("ray_tpu.air").warning(
+                    "this wandb SDK lacks reinit='create_new'; concurrent "
+                    "trials will share/steal the single active run"
+                )
+                run = self._wandb.init(reinit=True, **kw)
+            self._runs[trial_id] = run
+            return
+        run_dir = os.path.join(self.dir, self.project, trial_id)
+        os.makedirs(run_dir, exist_ok=True)
+        with open(os.path.join(run_dir, "config.json"), "w") as f:
+            json.dump(config, f, default=str)
+        # truncate: a re-run with the same trial ids must not mix histories
+        self._files[trial_id] = open(os.path.join(run_dir, "history.jsonl"), "w")
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        numeric = {k: v for k, v in result.items()
+                   if isinstance(v, (int, float)) and v == v}  # drop NaN
+        if self._wandb is not None:
+            run = self._runs.get(trial_id)
+            if run is not None:
+                run.log(numeric)
+            return
+        f = self._files.get(trial_id)
+        if f is not None:
+            f.write(json.dumps(numeric) + "\n")
+            f.flush()
+
+    def on_trial_complete(self, trial_id: str, last_result: dict,
+                          error: str | None = None) -> None:
+        if self._wandb is not None:
+            run = self._runs.pop(trial_id, None)
+            if run is not None:
+                run.finish(exit_code=1 if error else 0)
+            return
+        f = self._files.pop(trial_id, None)
+        if f is not None:
+            f.close()
+
+    def on_experiment_end(self, results) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()

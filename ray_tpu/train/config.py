@@ -54,6 +54,9 @@ class RunConfig:
     storage_path: str | None = None
     failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    # experiment-tracking callbacks (reference: air RunConfig.callbacks —
+    # e.g. air.integrations.wandb.WandbLoggerCallback)
+    callbacks: list | None = None
 
     def resolved_storage_path(self) -> str:
         return self.storage_path or os.path.join(

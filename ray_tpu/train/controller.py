@@ -37,16 +37,29 @@ class TrainController:
         )
 
     def run(self) -> Result:
+        from ray_tpu.air.callbacks import invoke as _cb
+
+        callbacks = list(getattr(self.run_config, "callbacks", None) or [])
+        run_name = self.run_config.name or "train"
+        _cb(callbacks, "setup", run_name)
+        _cb(callbacks, "on_trial_start", run_name, dict(self.train_loop_config))
         failures = 0
         while True:
-            result = self._run_attempt()
+            result = self._run_attempt(callbacks, run_name)
             if result.error is None:
+                _cb(callbacks, "on_trial_complete", run_name, result.metrics, None)
+                _cb(callbacks, "on_experiment_end", result)
                 return result
             failures += 1
             if failures > self.run_config.failure_config.max_failures:
+                _cb(callbacks, "on_trial_complete", run_name, result.metrics,
+                    str(result.error))
+                _cb(callbacks, "on_experiment_end", result)
                 return result
 
-    def _run_attempt(self) -> Result:
+    def _run_attempt(self, callbacks=(), run_name: str = "train") -> Result:
+        from ray_tpu.air.callbacks import invoke as _cb
+
         group = WorkerGroup(self.scaling)
         metrics_history: list[dict] = []
         last_metrics: dict = {}
@@ -66,6 +79,7 @@ class TrainController:
                 for rep in step_reports:
                     last_metrics = rep["metrics"]
                     metrics_history.append(last_metrics)
+                    _cb(callbacks, "on_trial_result", run_name, last_metrics)
                     if rep["checkpoint"]:
                         self.checkpoint_manager.register(
                             Checkpoint(rep["checkpoint"]), last_metrics

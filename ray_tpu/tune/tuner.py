@@ -146,9 +146,13 @@ class Tuner:
         self.run_config = run_config
 
     def fit(self) -> ResultGrid:
+        from ray_tpu.air.callbacks import invoke as _cb
+
         tc = self.tune_config
         searcher = tc.search_alg or BasicVariantGenerator(self.param_space, tc.num_samples)
         scheduler = tc.scheduler or FIFOScheduler()
+        callbacks = list(getattr(self.run_config, "callbacks", None) or [])
+        _cb(callbacks, "setup", getattr(self.run_config, "name", None))
         results: list[TrialResult] = []
         running: dict[str, tuple] = {}  # trial_id -> (actor, TrialResult, iteration)
         trial_counter = 0
@@ -163,6 +167,7 @@ class Tuner:
             trial_counter += 1
             tr = TrialResult(trial_id, dict(cfg), state="RUNNING")
             results.append(tr)
+            _cb(callbacks, "on_trial_start", trial_id, dict(cfg))
             if hasattr(scheduler, "record_config"):
                 scheduler.record_config(trial_id, cfg)
             actor = actor_cls.remote(trial_id, cfg)
@@ -184,6 +189,7 @@ class Tuner:
                     rep.setdefault("training_iteration", iteration)
                     tr.metrics = rep
                     tr.metrics_history.append(rep)
+                    _cb(callbacks, "on_trial_result", tid, rep)
                     searcher.on_trial_complete(tid, rep)
                     decision = scheduler.on_result(tid, rep)
                     new_cfg = scheduler.exploit_config(tid)
@@ -198,10 +204,13 @@ class Tuner:
                     tr.state = "ERRORED" if poll["error"] else (
                         "TERMINATED" if tr.state == "TERMINATED" else "COMPLETED"
                     )
+                    _cb(callbacks, "on_trial_complete", tid, tr.metrics, tr.error)
                     ray_tpu.kill(actor)
                     del running[tid]
             time.sleep(0.02)
-        return ResultGrid(results, tc.metric, tc.mode)
+        grid = ResultGrid(results, tc.metric, tc.mode)
+        _cb(callbacks, "on_experiment_end", grid)
+        return grid
 
 
 def run(trainable: Callable, *, config: dict | None = None, num_samples: int = 1,

@@ -1,0 +1,115 @@
+"""AIR experiment-tracking callbacks: wandb/mlflow loggers through Tune.
+
+Reference: python/ray/air/integrations/{wandb,mlflow}.py attached via
+RunConfig(callbacks=[...]). SDKs are absent in this image, so the offline
+file layouts are exercised (identical calling code either way).
+"""
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.air import RunConfig
+from ray_tpu.air.integrations.mlflow import MLflowLoggerCallback
+from ray_tpu.air.integrations.wandb import WandbLoggerCallback
+
+
+@pytest.fixture(autouse=True)
+def _session():
+    ray_tpu.init(log_to_driver=False)
+    yield
+    ray_tpu.shutdown()
+
+
+def _trainable(config):
+    from ray_tpu import train
+
+    for i in range(3):
+        train.report({"loss": config["x"] / (i + 1), "iter": i})
+
+
+def test_wandb_offline_layout(tmp_path):
+    from ray_tpu import tune
+
+    cb = WandbLoggerCallback(project="proj", dir=str(tmp_path))
+    tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        run_config=RunConfig(name="exp", callbacks=[cb]),
+    ).fit()
+    runs = sorted(os.listdir(tmp_path / "proj"))
+    assert len(runs) == 2
+    for run in runs:
+        cfg = json.load(open(tmp_path / "proj" / run / "config.json"))
+        assert "x" in cfg
+        lines = open(tmp_path / "proj" / run / "history.jsonl").read().splitlines()
+        assert len(lines) == 3
+        assert json.loads(lines[0])["loss"] == cfg["x"]
+
+
+def test_mlflow_offline_layout(tmp_path):
+    from ray_tpu import tune
+
+    cb = MLflowLoggerCallback(experiment_name="exp", tracking_uri=str(tmp_path))
+    tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([4.0])},
+        run_config=RunConfig(callbacks=[cb]),
+    ).fit()
+    run_dir = tmp_path / "exp" / sorted(os.listdir(tmp_path / "exp"))[0]
+    assert (run_dir / "params" / "x").read_text() == "4.0"
+    metric_lines = (run_dir / "metrics" / "loss").read_text().splitlines()
+    assert len(metric_lines) == 3
+    # "<timestamp> <value> <step>" per line
+    ts, val, step = metric_lines[0].split()
+    assert float(val) == 4.0 and step == "1"
+    assert (run_dir / "status").read_text() == "FINISHED"
+
+
+def test_broken_callback_does_not_kill_experiment():
+    from ray_tpu import tune
+    from ray_tpu.air import Callback
+
+    class Broken(Callback):
+        def on_trial_result(self, trial_id, result):
+            raise RuntimeError("tracker outage")
+
+    grid = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0])},
+        run_config=RunConfig(callbacks=[Broken()]),
+    ).fit()
+    assert grid[0].state == "COMPLETED"
+
+
+def test_trainer_honors_callbacks(tmp_path):
+    from ray_tpu import train
+    from ray_tpu.train import DataParallelTrainer
+    from ray_tpu.train.config import ScalingConfig
+
+    cb = WandbLoggerCallback(project="trainproj", dir=str(tmp_path))
+
+    def loop(config):
+        for i in range(2):
+            train.report({"loss": 1.0 / (i + 1)})
+
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="trainrun", callbacks=[cb],
+                             storage_path=str(tmp_path / "ckpt")),
+    )
+    res = trainer.fit()
+    assert res.error is None
+    hist = (tmp_path / "trainproj" / "trainrun" / "history.jsonl").read_text().splitlines()
+    assert len(hist) == 2
+
+
+def test_metric_keys_with_slashes(tmp_path):
+    cb = MLflowLoggerCallback(experiment_name="e", tracking_uri=str(tmp_path))
+    cb.on_trial_start("t0", {"optimizer/lr": 0.1})
+    cb.on_trial_result("t0", {"val/loss": 2.5})
+    cb.on_trial_complete("t0", {"val/loss": 2.5})
+    run_dir = tmp_path / "e" / "t0"
+    assert (run_dir / "params" / "optimizer__lr").read_text() == "0.1"
+    assert "2.5" in (run_dir / "metrics" / "val__loss").read_text()
