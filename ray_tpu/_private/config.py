@@ -64,6 +64,12 @@ class Config:
     memory_usage_threshold: float = 0.95
     memory_monitor_refresh_ms: int = 250
 
+    # --- cgroup2 worker isolation (reference: common/cgroup2/cgroup_manager) ---
+    # opt-in: needs an owned writable cgroup2 subtree (usual inside containers)
+    worker_cgroups_enabled: bool = False
+    worker_memory_limit_bytes: int = 0  # per-worker memory.max (0 = unlimited)
+    worker_cpu_quota: float = 0.0       # per-worker CPUs via cpu.max (0 = unlimited)
+
     # --- timeouts ---
     get_timeout_default_s: float | None = None
     rpc_connect_timeout_s: float = 10.0

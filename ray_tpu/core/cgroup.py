@@ -1,0 +1,173 @@
+"""Cgroup-v2 worker resource isolation.
+
+Parity: src/ray/common/cgroup2/ (CgroupManager + SysFsCgroupDriver +
+FakeCgroupDriver for tests). Workers are plain OS processes; when enabled
+(and the host grants an owned, writable cgroup2 subtree — containers
+usually do), each worker process is moved into its own child cgroup with
+``memory.max`` / ``cpu.max`` derived from its declared resources, so a
+runaway worker is OOM-killed by the kernel inside its own cgroup instead of
+taking the node down. Degrades to a no-op where cgroups are unavailable
+(the OOM-killer policy in core/memory_monitor.py remains the fallback).
+
+Layout mirrors the reference:
+    <root>/ray_tpu_<session>/workers/<worker-id>/
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+CGROUP_ROOT = "/sys/fs/cgroup"
+
+
+class CgroupDriver:
+    """Filesystem operations on the cgroup2 hierarchy (fake-able for tests,
+    reference: common/cgroup2/fake_cgroup_driver.h)."""
+
+    def supported(self) -> bool:
+        raise NotImplementedError
+
+    def create(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def write(self, path: str, control: str, value: str) -> None:
+        raise NotImplementedError
+
+    def read(self, path: str, control: str) -> str:
+        raise NotImplementedError
+
+
+class SysfsCgroupDriver(CgroupDriver):
+    def __init__(self, root: str = CGROUP_ROOT):
+        self.root = root
+
+    def supported(self) -> bool:
+        """cgroup2 mounted AND this process may create subtrees."""
+        ctrl = os.path.join(self.root, "cgroup.controllers")
+        return (os.path.isfile(ctrl)
+                and os.access(self.root, os.W_OK | os.X_OK))
+
+    def create(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        try:
+            os.rmdir(path)  # cgroup dirs are removed with rmdir, never unlink
+        except OSError:
+            pass
+
+    def write(self, path: str, control: str, value: str) -> None:
+        with open(os.path.join(path, control), "w") as f:
+            f.write(value)
+
+    def read(self, path: str, control: str) -> str:
+        with open(os.path.join(path, control)) as f:
+            return f.read().strip()
+
+
+class FakeCgroupDriver(CgroupDriver):
+    """In-memory cgroup tree for unit tests."""
+
+    def __init__(self):
+        self.dirs: set[str] = set()
+        self.files: dict[tuple[str, str], str] = {}
+
+    def supported(self) -> bool:
+        return True
+
+    def create(self, path: str) -> None:
+        self.dirs.add(path)
+
+    def delete(self, path: str) -> None:
+        self.dirs.discard(path)
+        self.files = {k: v for k, v in self.files.items() if k[0] != path}
+
+    def write(self, path: str, control: str, value: str) -> None:
+        if path not in self.dirs:
+            raise FileNotFoundError(path)
+        self.files[(path, control)] = value
+
+    def read(self, path: str, control: str) -> str:
+        return self.files[(path, control)]
+
+
+class CgroupManager:
+    """Owns the session's cgroup subtree; one child cgroup per worker."""
+
+    def __init__(self, session_name: str, driver: Optional[CgroupDriver] = None,
+                 root: str = CGROUP_ROOT):
+        self.driver = driver or SysfsCgroupDriver(root)
+        self.base = os.path.join(root, session_name)
+        self.workers_dir = os.path.join(self.base, "workers")
+        self._worker_paths: dict[str, str] = {}
+        self._ready = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._ready
+
+    def setup(self) -> bool:
+        """Create the session subtree; False (disabled) if unsupported."""
+        if not self.driver.supported():
+            return False
+        try:
+            self.driver.create(self.base)
+            self.driver.create(self.workers_dir)
+            # enable controllers for the workers subtree (cgroup2 requires
+            # explicit delegation down the hierarchy)
+            try:
+                self.driver.write(self.base, "cgroup.subtree_control",
+                                  "+memory +cpu")
+            except OSError:
+                pass  # controller not available: limits that exist still apply
+            self._ready = True
+        except OSError:
+            self._ready = False
+        return self._ready
+
+    def add_worker(self, worker_id: str, pid: int,
+                   memory_bytes: Optional[int] = None,
+                   cpu_quota: Optional[float] = None) -> Optional[str]:
+        """Create the worker's cgroup, apply limits, and move the pid in.
+
+        ``cpu_quota`` is in CPUs (2.0 = two full cores -> cpu.max "200000 100000").
+        Returns the cgroup path, or None when disabled/failed (worker still
+        runs, just unconfined)."""
+        if not self._ready:
+            return None
+        path = os.path.join(self.workers_dir, worker_id)
+        try:
+            self.driver.create(path)
+            if memory_bytes:
+                self.driver.write(path, "memory.max", str(int(memory_bytes)))
+                # kill the worker alone, not the whole subtree's siblings
+                try:
+                    self.driver.write(path, "memory.oom.group", "1")
+                except OSError:
+                    pass
+            if cpu_quota:
+                period = 100_000
+                self.driver.write(path, "cpu.max",
+                                  f"{int(cpu_quota * period)} {period}")
+            self.driver.write(path, "cgroup.procs", str(pid))
+        except OSError:
+            self.driver.delete(path)
+            return None
+        self._worker_paths[worker_id] = path
+        return path
+
+    def remove_worker(self, worker_id: str) -> None:
+        path = self._worker_paths.pop(worker_id, None)
+        if path is not None:
+            self.driver.delete(path)
+
+    def cleanup(self) -> None:
+        for wid in list(self._worker_paths):
+            self.remove_worker(wid)
+        self.driver.delete(self.workers_dir)
+        self.driver.delete(self.base)
+        self._ready = False

@@ -335,7 +335,8 @@ class ProcessWorkerPool:
 
     def __init__(self, num_workers: int = 2, shm_name: str | None = None,
                  shm_size: int = 0, head_addr: str | None = None,
-                 token: str | None = None, log_dir: str | None = None):
+                 token: str | None = None, log_dir: str | None = None,
+                 cgroup_manager=None):
         # Workers are exec'd fresh (python -m ray_tpu.core.worker_main), never
         # forked: the driver runs many threads (dispatcher, actor loops,
         # JAX/XLA) and fork-with-threads can copy locks mid-acquire; fork-based
@@ -354,6 +355,9 @@ class ProcessWorkerPool:
         self._spawn_seq = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # optional cgroup2 confinement (reference: cgroup_manager) — workers
+        # land in per-worker cgroups with memory.max/cpu.max from config
+        self._cgroups = cgroup_manager
         for _ in range(num_workers):
             self._spawn()
 
@@ -367,6 +371,15 @@ class ProcessWorkerPool:
         proc, conn = spawn_worker_process(
             self._shm_name, self._shm_size, self._head_addr, self._token, log_base
         )
+        if self._cgroups is not None and self._cgroups.enabled:
+            from ray_tpu._private.config import get_config
+
+            cfg = get_config()
+            self._cgroups.add_worker(
+                f"worker-{proc.pid}", proc.pid,
+                memory_bytes=cfg.worker_memory_limit_bytes or None,
+                cpu_quota=cfg.worker_cpu_quota or None,
+            )
         w = _Worker(proc, conn)
         self._workers.append(w)
         return w
