@@ -62,14 +62,24 @@ def deserialize(meta: bytes, buffers: Iterable) -> Any:
 
 def serialize_to_bytes(obj: Any) -> bytes:
     """Single-blob form: 4-byte buffer count + lengths header + concatenated payloads."""
+    _, parts = serialize_parts(obj)
+    return b"".join(bytes(p) if not isinstance(p, (bytes, bytearray)) else p for p in parts)
+
+
+def serialize_parts(obj: Any) -> tuple[int, list]:
+    """Like serialize_to_bytes but WITHOUT the final concatenation copy:
+    returns (total_size, parts) where writing the parts back-to-back produces
+    exactly the single-blob format. Lets the shm store scatter-copy large
+    arrays straight into the mapped arena (one memcpy total instead of two)."""
     import struct
 
     meta, bufs = serialize(obj)
-    header = struct.pack(">I", len(bufs)) + b"".join(struct.pack(">Q", len(b)) for b in [meta] + [memoryview(b) for b in bufs])
-    # lengths: meta plus each buffer
-    parts = [header, meta]
-    parts.extend(bytes(b) if not isinstance(b, (bytes, bytearray)) else b for b in bufs)
-    return b"".join(parts)
+    mvs = [memoryview(b).cast("B") for b in bufs]
+    header = struct.pack(">I", len(mvs)) + b"".join(
+        struct.pack(">Q", n) for n in [len(meta)] + [m.nbytes for m in mvs]
+    )
+    parts = [header, meta, *mvs]
+    return len(header) + len(meta) + sum(m.nbytes for m in mvs), parts
 
 
 def deserialize_from_bytes(data) -> Any:

@@ -112,6 +112,14 @@ def main() -> None:
                 pass
         return True
 
+    def h_task_blocked(peer, msg):
+        """Head relays a worker's blocked-in-get announcement: yank the
+        blocked worker's queued tasks so they run on other workers."""
+        pool = pool_box.get("pool")
+        if pool is not None:
+            pool.on_task_blocked(msg["task"])
+        return True
+
     def h_kill_worker(peer, msg):
         return pool_box["pool"].kill_random_worker()
 
@@ -128,6 +136,7 @@ def main() -> None:
         host, int(port),
         handlers={
             "execute_task": h_execute_task,
+            "task_blocked": h_task_blocked,
             "plane_free": h_plane_free,
             "kill_worker": h_kill_worker,
             "num_alive": h_num_alive,

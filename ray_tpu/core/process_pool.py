@@ -1,18 +1,39 @@
-"""Process worker pool: OS-process task execution with crash fault tolerance.
+"""Process worker pool: pipelined OS-process task execution with crash FT.
 
 This is the multi-process half of the execution story (the reference's model:
 N `default_worker.py` processes per node, each embedding a CoreWorker —
 python/ray/_private/workers/default_worker.py:203 + raylet WorkerPool
-worker_pool.h:284). Tasks opted into process isolation run in forked workers:
+worker_pool.h:284). Tasks opted into process isolation run in exec'd workers:
 
 - function/args travel by cloudpickle over a pipe; LARGE results come back
   through the node's shared-memory store (the worker maps the same segment —
   zero-copy handoff, like plasma), small results inline over the pipe.
-- a worker crash (segfault/exit/kill) surfaces as WorkerCrashedError — a
-  system failure that the runtime's retry machinery handles, giving real
-  worker-death fault tolerance (reference: task FT on worker failure).
+- submission is PIPELINED: requests are seq-tagged and pushed to the
+  least-loaded worker without waiting for earlier replies (the reference's
+  lease-reuse + PushNormalTask pipeline, normal_task_submitter.cc:515 — many
+  tasks in flight per leased worker, replies matched by id). A per-worker
+  parent reader thread completes futures as `done` replies arrive.
+- a worker that announces it is BLOCKED in a nested get releases its queued
+  (not-yet-started) tasks back to the pool: the parent sends `cancel` for
+  them; the worker's reader thread answers `skipped` for any it had not
+  started, and those are resubmitted to other workers. This keeps nested
+  task graphs deadlock-free without spawning a worker per blocked task.
+- a worker crash (segfault/exit/kill) fails every in-flight future with
+  WorkerCrashedError — a system failure the runtime's retry machinery
+  handles, giving real worker-death fault tolerance.
 - workers are reused across tasks (lease reuse economics) and respawned on
   death (WorkerPool PopWorker semantics).
+
+Wire protocol (parent -> worker):
+  ("run", seq, oid_bin, fn_blob, args_blob, task_bin)   seq-tagged task
+  ("cancel", seq)                                        yank if unstarted
+  ("actor_init"/"actor_call", ...)                       dedicated actors (unnumbered)
+  ("exit",)
+Worker -> parent:
+  ("start", seq)                        executor began the task (running-set upkeep)
+  ("done", seq, status, payload, extra) status: "val" | "shm" | "err"
+  ("skipped", seq)                      cancel won; parent resubmits elsewhere
+  3-tuple (status, payload, extra)      dedicated-actor replies (unnumbered)
 """
 
 from __future__ import annotations
@@ -22,14 +43,16 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 import traceback
-from dataclasses import dataclass
+from concurrent.futures import Future
+from dataclasses import dataclass, field
 from multiprocessing.connection import Connection
 from typing import Any, Callable, Optional
 
 import cloudpickle
 
-from ray_tpu.exceptions import ActorError
+from ray_tpu.exceptions import ActorError, TaskCancelledError
 
 
 class WorkerCrashedError(ActorError):
@@ -116,7 +139,8 @@ def _client_fetch(oid_bin: bytes):
 
 
 def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
-    """Child: execute (func, args, kwargs) requests until the pipe closes."""
+    """Child: a reader thread drains the pipe (so `cancel` is honored even
+    while a task blocks); the main thread executes requests in arrival order."""
     store = None
     if shm_name:
         try:
@@ -127,17 +151,21 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             store = None
     from ray_tpu._private import serialization
 
+    reply_mu = threading.Lock()
+
     def _reply(payload) -> None:
         try:
-            conn.send_bytes(cloudpickle.dumps(payload))
+            blob = cloudpickle.dumps(payload)
+            with reply_mu:
+                conn.send_bytes(blob)
         except (BrokenPipeError, OSError):
             # parent (driver or node agent) died: exit quietly; the head's
             # failure machinery re-runs the task elsewhere
             os._exit(0)
 
-    def _send_result(result, oid_bin) -> None:
-        """Serialize + reply: large results through shm (zero-copy handoff),
-        small inline over the pipe."""
+    def _result_payload(result, oid_bin):
+        """Serialize a result: large through shm (zero-copy handoff), small
+        inline over the pipe. Returns (status, payload, extra)."""
         import inspect as _inspect
 
         if _inspect.iscoroutine(result) or _inspect.isgenerator(result):
@@ -151,18 +179,61 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
             try:
                 store.put_bytes(ObjectID(oid_bin), blob)
-                _reply(("shm", oid_bin, len(blob)))
-                return
+                return ("shm", oid_bin, len(blob))
             except Exception:
                 pass  # store full/unreadable: fall back to the pipe
-        _reply(("val", blob, len(blob)))
+        return ("val", blob, len(blob))
 
-    def _send_error(e: BaseException) -> None:
+    def _error_payload(e: BaseException):
         try:
             exc_blob = cloudpickle.dumps(e)
         except Exception:
             exc_blob = None
-        _reply(("err", traceback.format_exc(), exc_blob))
+        return ("err", traceback.format_exc(), exc_blob)
+
+    import collections
+
+    pending: "collections.deque" = collections.deque()
+    pend_cv = threading.Condition()
+    cancelled: set[int] = set()  # guarded by pend_cv's lock
+    _reply(("ready",))  # boot handshake: the pool gates growth/rebalance on it
+
+    def _pipe_reader() -> None:
+        """Drains the pipe so `cancel` is honored even while a task blocks:
+        a cancel for a STILL-QUEUED task removes it here and answers
+        `skipped` immediately (the executor may be wedged in a nested get —
+        it can never be relied on to process the yank)."""
+        while True:
+            try:
+                msg = conn.recv_bytes()
+            except (EOFError, OSError):
+                os._exit(0)
+            try:
+                req = cloudpickle.loads(msg)
+            except Exception:
+                # Protocol desync: the parent kills + respawns this worker on
+                # seeing badreq (futures fail as WorkerCrashedError and retry).
+                _reply(("badreq", None))
+                continue
+            if req[0] == "cancel":
+                seq = req[1]
+                removed = False
+                with pend_cv:
+                    for i, r in enumerate(pending):
+                        if r[0] == "run" and r[1] == seq:
+                            del pending[i]
+                            removed = True
+                            break
+                    if not removed:
+                        cancelled.add(seq)
+                if removed:
+                    _reply(("skipped", seq))
+                continue
+            with pend_cv:
+                pending.append(req)
+                pend_cv.notify()
+
+    threading.Thread(target=_pipe_reader, daemon=True, name="pipe-reader").start()
 
     # Dedicated-actor mode: ("actor_init", cls_blob, args_blob, renv)
     # instantiates the user class IN THIS PROCESS (runtime_env applied for the
@@ -173,17 +244,12 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
     actor_env_stack = None  # noqa: F841 - held so the env outlives __init__
 
     while True:
-        try:
-            msg = conn.recv_bytes()
-        except (EOFError, OSError):
-            return
-        try:
-            req = cloudpickle.loads(msg)
-        except Exception:
-            _reply(("err", "request deserialization failed", None))
-            continue
+        with pend_cv:
+            while not pending:
+                pend_cv.wait()
+            req = pending.popleft()
         if req[0] == "exit":
-            return
+            os._exit(0)
         if req[0] == "actor_init":
             try:
                 cls = cloudpickle.loads(req[1])
@@ -202,7 +268,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 actor_instance = cls(*args, **kwargs)
                 _reply(("ok", None, None))
             except BaseException as e:  # noqa: BLE001
-                _send_error(e)
+                _reply(_error_payload(e))
             continue
         if req[0] == "actor_call":
             _, method_name, args_blob, oid_bin = req
@@ -212,32 +278,81 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 method = getattr(actor_instance, method_name)
                 args, kwargs = serialization.deserialize_from_bytes(args_blob)
                 args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
-                _send_result(method(*args, **kwargs), oid_bin)
+                _reply(_result_payload(method(*args, **kwargs), oid_bin))
             except BaseException as e:  # noqa: BLE001
-                _send_error(e)
+                _reply(_error_payload(e))
             continue
-        _, oid_bin, fn_blob, args_blob = req[:4]
-        task_bin = req[4] if len(req) > 4 else None
+        # ("run", seq, oid_bin, fn_blob, args_blob, task_bin)
+        _, seq, oid_bin, fn_blob, args_blob, task_bin = req[:6]
+        with pend_cv:
+            if seq in cancelled:
+                cancelled.discard(seq)
+                skip = True
+            else:
+                skip = False
+        if skip:
+            _reply(("skipped", seq))
+            continue
+        _reply(("start", seq))
         _set_current_task(task_bin)
         try:
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = serialization.deserialize_from_bytes(args_blob)
             args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
-            _send_result(fn(*args, **kwargs), oid_bin)
+            status, payload, extra = _result_payload(fn(*args, **kwargs), oid_bin)
         except BaseException as e:  # noqa: BLE001
-            _send_error(e)
+            status, payload, extra = _error_payload(e)
         finally:
             _set_current_task(None)
+        _reply(("done", seq, status, payload, extra))
+
+
+class _Inflight:
+    """One submitted task: its future, the marshalled request (kept so a
+    `skipped` reply can resubmit it verbatim elsewhere), and flags."""
+
+    __slots__ = ("future", "oid_bin", "fn_blob", "args_blob", "task_bin",
+                 "started", "cancel_sent", "worker", "submit_ts", "user_cancelled")
+
+    def __init__(self, fn_blob, args_blob, oid_bin, task_bin):
+        self.future: Future = Future()
+        self.fn_blob = fn_blob
+        self.args_blob = args_blob
+        self.oid_bin = oid_bin
+        self.task_bin = task_bin
+        self.started = False
+        self.cancel_sent = False
+        self.worker: "_Worker | None" = None
+        self.submit_ts = 0.0
+        self.user_cancelled = False  # skipped -> cancelled, not resubmitted
 
 
 @dataclass
 class _Worker:
     proc: subprocess.Popen
     conn: Any
-    busy: bool = False
+    next_seq: int = 0
+    inflight: dict = field(default_factory=dict)  # seq -> _Inflight
+    blocked: bool = False   # announced blocked-in-get; don't queue more
+    dead: bool = False
+    ready: bool = False     # boot handshake received
+    last_done_ts: float = 0.0  # last completed/skipped task (progress signal)
+    # Connection.send_bytes writes header+body as separate syscalls for big
+    # frames; concurrent senders (dispatcher, monitor, control plane) would
+    # interleave and desync the worker's stream without this.
+    send_mu: threading.Lock = field(default_factory=threading.Lock)
+
+    def send_frame(self, payload) -> None:
+        blob = cloudpickle.dumps(payload)
+        with self.send_mu:
+            self.conn.send_bytes(blob)
 
     def is_alive(self) -> bool:
-        return self.proc.poll() is None
+        return not self.dead and self.proc.poll() is None
+
+    @property
+    def load(self) -> int:
+        return len(self.inflight)
 
 
 def spawn_worker_process(shm_name, shm_size, head_addr, token, log_base=None):
@@ -293,11 +408,19 @@ class DedicatedActorWorker:
         with self._lock:
             try:
                 self.conn.send_bytes(cloudpickle.dumps(req))
-                resp = cloudpickle.loads(self.conn.recv_bytes())
+                while True:
+                    resp = cloudpickle.loads(self.conn.recv_bytes())
+                    if resp[0] != "ready":  # skip the boot handshake
+                        break
             except (EOFError, OSError, BrokenPipeError) as e:
                 raise WorkerCrashedError(
                     f"actor worker process died ({type(e).__name__})"
                 ) from e
+        if resp[0] == "badreq":
+            # protocol desync: the worker couldn't decode our frame — its
+            # stream is untrustworthy; kill so actor-restart machinery runs
+            self.kill()
+            raise WorkerCrashedError("actor worker protocol desync (badreq)")
         status, payload, extra = resp
         if status == "err":
             raise _RemoteTaskError(payload, exc_blob=extra)
@@ -331,7 +454,20 @@ class DedicatedActorWorker:
 
 
 class ProcessWorkerPool:
-    """Parent-side pool (reference: raylet/worker_pool.cc semantics)."""
+    """Parent-side pipelined pool (reference: raylet/worker_pool.cc lease
+    semantics + the core worker's pipelined PushNormalTask submission).
+
+    Submission never blocks on a worker roundtrip: tasks are seq-tagged and
+    queued onto the least-loaded live worker; a per-worker reader thread
+    matches replies to futures. Throughput scales with pipe bandwidth, not
+    worker-spawn latency (the old checkout-or-spawn design paid a ~1s Python
+    boot for every burst that momentarily saturated the pool)."""
+
+    # Growth cap: demand overflow (tasks blocked in nested gets) spawns extra
+    # workers instead of deadlocking — the reference similarly starts new
+    # workers while existing ones are blocked (worker_pool.cc PopWorker +
+    # blocked-task accounting).
+    MAX_WORKERS = int(os.environ.get("RAY_TPU_MAX_PROCESS_WORKERS", "64"))
 
     def __init__(self, num_workers: int = 2, shm_name: str | None = None,
                  shm_size: int = 0, head_addr: str | None = None,
@@ -353,15 +489,90 @@ class ProcessWorkerPool:
         self._workers: list[_Worker] = []
         self._running_tasks: dict[int, tuple] = {}  # pid -> (task_bin, started)
         self._spawn_seq = 0
+        self._shutdown = False
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # optional cgroup2 confinement (reference: cgroup_manager) — workers
         # land in per-worker cgroups with memory.max/cpu.max from config
         self._cgroups = cgroup_manager
-        for _ in range(num_workers):
-            self._spawn()
+        with self._cv:
+            for _ in range(num_workers):
+                self._spawn_locked()
+        threading.Thread(
+            target=self._monitor_loop, daemon=True, name="pool-monitor"
+        ).start()
 
-    def _spawn(self) -> "_Worker":
+    # ---------------------------------------------------------------- monitor
+    # Sustained-demand growth + work rebalancing. Short-task bursts pipeline
+    # onto live workers (no spawn cost on the submit path); tasks that SIT —
+    # every worker loaded for >100ms — indicate long-running work that deserves
+    # true process parallelism, so the pool grows one worker per tick. Queued
+    # tasks stuck behind a long runner get yanked (cancel protocol) whenever an
+    # idle worker could take them.
+    MONITOR_TICK_S = 0.05
+    SUSTAINED_S = 0.1
+
+    def _monitor_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(self.MONITOR_TICK_S)
+            try:
+                self._monitor_tick(time.monotonic())
+            except Exception:  # e.g. Popen EAGAIN under fd pressure — the
+                continue       # monitor must survive to try again next tick
+
+    def _monitor_tick(self, now: float) -> None:
+        to_cancel: list[tuple[_Worker, int]] = []
+        with self._cv:
+            live = [w for w in self._workers if w.is_alive()]
+            if not live:
+                return
+
+            def stalled(w: _Worker) -> bool:
+                # No completion recently AND work is waiting on it: the
+                # current task is long-running or blocked. A worker that is
+                # completing tasks is never stalled, however deep its queue
+                # — that keeps short-task floods pipelining instead of
+                # tripping spawn/migrate churn under CPU contention.
+                return (
+                    (w.blocked or w.load >= 1)
+                    and now - w.last_done_ts > self.SUSTAINED_S
+                    and any(now - i.submit_ts > self.SUSTAINED_S
+                            for i in w.inflight.values())
+                ) or (w.blocked and w.load >= 1)
+
+            idle = [w for w in live if w.ready and w.load == 0 and not w.blocked]
+            booting = [w for w in live if not w.ready]
+            # Grow: every worker is stalled on aged work and nothing is
+            # already booting (growth paced by worker boot time, so a
+            # stall can never storm-spawn).
+            if (not idle and not booting and len(live) < self.MAX_WORKERS
+                    and all(stalled(w) for w in live)):
+                self._spawn_locked()
+            # Rebalance: stale UNSTARTED tasks on stalled workers migrate
+            # to ready idle workers (cancel wins only if unstarted).
+            elif idle:
+                budget = len(idle)
+                for w in live:
+                    if budget <= 0:
+                        break
+                    if w in idle or not stalled(w):
+                        continue
+                    for seq, i in w.inflight.items():
+                        if (not i.started and not i.cancel_sent
+                                and now - i.submit_ts > self.SUSTAINED_S):
+                            i.cancel_sent = True
+                            to_cancel.append((w, seq))
+                            budget -= 1
+                            if budget <= 0:
+                                break
+        for w, seq in to_cancel:
+            try:
+                w.send_frame(("cancel", seq))
+            except (BrokenPipeError, OSError):
+                self._on_worker_death(w)
+
+    # ---------------------------------------------------------------- spawn
+    def _spawn_locked(self) -> "_Worker":
         self._spawn_seq += 1
         log_base = None
         if self._log_dir:
@@ -382,47 +593,154 @@ class ProcessWorkerPool:
             )
         w = _Worker(proc, conn)
         self._workers.append(w)
+        threading.Thread(
+            target=self._reply_reader, args=(w,), daemon=True,
+            name=f"pool-reader-{proc.pid}",
+        ).start()
         return w
 
-    # Growth cap: demand overflow (tasks blocked in nested gets, num_cpus=0
-    # tasks) spawns extra workers instead of deadlocking — the reference
-    # similarly starts new workers while existing ones are blocked
-    # (worker_pool.cc PopWorker + blocked-task accounting).
-    MAX_WORKERS = int(os.environ.get("RAY_TPU_MAX_PROCESS_WORKERS", "64"))
-
-    def _checkout(self) -> _Worker:
-        with self._cv:
-            while True:
-                for w in self._workers:
-                    if not w.busy and w.is_alive():
-                        w.busy = True
-                        return w
-                # replace any dead idle workers, then rescan (the fresh
-                # replacements are idle and claimable)
-                alive = [w for w in self._workers if w.is_alive() or w.busy]
-                if len(alive) != len(self._workers) or len(alive) < self._num:
-                    self._workers = alive
-                    while len(self._workers) < self._num:
-                        self._spawn()
+    # ---------------------------------------------------------- reply plumbing
+    def _reply_reader(self, w: _Worker) -> None:
+        """Parent-side reader for one worker: completes futures as replies
+        arrive (PushNormalTask reply matching)."""
+        while True:
+            try:
+                msg = w.conn.recv_bytes()
+            except (EOFError, OSError):
+                self._on_worker_death(w)
+                return
+            try:
+                resp = cloudpickle.loads(msg)
+            except Exception:
+                resp = ("badreq", None)
+            tag = resp[0]
+            if tag == "badreq" or tag not in ("ready", "start", "done", "skipped"):
+                # Protocol desync (undecodable frame on either side): this
+                # worker's stream can no longer be trusted — kill it; the
+                # EOF path fails its in-flight futures as WorkerCrashedError
+                # so nothing hangs and the runtime's retries recover.
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+                continue
+            if tag == "ready":
+                with self._cv:
+                    w.ready = True
+                    w.last_done_ts = time.monotonic()
+                    self._cv.notify_all()
+            elif tag == "start":
+                with self._lock:
+                    inf = w.inflight.get(resp[1])
+                    if inf is not None:
+                        inf.started = True
+                        self._running_tasks[w.proc.pid] = (inf.task_bin, time.monotonic())
+            elif tag == "done":
+                seq, status, payload, extra = resp[1], resp[2], resp[3], resp[4]
+                with self._cv:
+                    inf = w.inflight.pop(seq, None)
+                    cur = self._running_tasks.get(w.proc.pid)
+                    if inf is not None and cur is not None and cur[0] == inf.task_bin:
+                        self._running_tasks.pop(w.proc.pid, None)
+                    # A finished task means the worker is making progress again
+                    # (a blocked-in-get task only completes after unblocking).
+                    w.blocked = False
+                    w.last_done_ts = time.monotonic()
+                    self._cv.notify_all()
+                if inf is None:
                     continue
-                if len(self._workers) < self.MAX_WORKERS:
-                    w = self._spawn()
-                    w.busy = True
-                    return w
-                self._cv.wait(0.1)
+                if status == "err":
+                    inf.future.set_exception(_RemoteTaskError(payload, exc_blob=extra))
+                else:
+                    inf.future.set_result((status, payload, extra))
+            elif tag == "skipped":
+                with self._cv:
+                    inf = w.inflight.pop(resp[1], None)
+                    w.last_done_ts = time.monotonic()
+                    self._cv.notify_all()
+                if inf is not None and inf.user_cancelled:
+                    if not inf.future.done():
+                        inf.future.set_exception(TaskCancelledError("cancelled"))
+                elif inf is not None:
+                    # cancel won before the task started: run it elsewhere
+                    try:
+                        self._submit_inflight(inf)
+                    except RuntimeError:  # pool shut down mid-migration
+                        if not inf.future.done():
+                            inf.future.set_exception(
+                                WorkerCrashedError("pool shut down during task migration")
+                            )
+                        return
 
-    def _drop_worker(self, w: "_Worker") -> None:
+    def _on_worker_death(self, w: _Worker) -> None:
         with self._cv:
+            if w.dead:
+                return
+            w.dead = True
             if w in self._workers:
                 self._workers.remove(w)
-            while len(self._workers) < self._num:
-                self._spawn()
+            orphans = list(w.inflight.values())
+            w.inflight.clear()
+            self._running_tasks.pop(w.proc.pid, None)
+            # Respawn to the floor — but never during shutdown. Futures are
+            # failed below EITHER way: a blocking execute_blob caller must not
+            # hang because teardown raced a worker EOF.
+            while (not self._shutdown
+                   and sum(1 for x in self._workers if x.is_alive()) < self._num):
+                self._spawn_locked()
             self._cv.notify_all()
+        err = WorkerCrashedError("worker process died while executing task")
+        for inf in orphans:
+            if not inf.future.done():
+                inf.future.set_exception(err)
+        try:
+            w.conn.close()
+        except Exception:
+            pass
 
-    def _checkin(self, w: _Worker) -> None:
+    # ------------------------------------------------------------- submission
+    def _pick_worker_locked(self) -> _Worker:
+        """Least-loaded live worker; blocked workers are a last resort (their
+        current task is stalled in a nested get). Submission itself never
+        spawns (short-task bursts pipeline onto live workers); SUSTAINED
+        demand grows the pool via the monitor thread — the reference raylet
+        similarly starts workers toward the granted lease count over time
+        rather than per-request (worker_pool.cc PopWorker)."""
+        candidates = [w for w in self._workers if w.is_alive() and not w.blocked]
+        if not candidates:
+            live = sum(1 for w in self._workers if w.is_alive())
+            if live < self.MAX_WORKERS:
+                return self._spawn_locked()
+            candidates = [w for w in self._workers if w.is_alive()]
+            if not candidates:
+                return self._spawn_locked()
+        return min(candidates, key=lambda w: w.load)
+
+    def _submit_inflight(self, inf: _Inflight) -> None:
         with self._cv:
-            w.busy = False
-            self._cv.notify_all()
+            if self._shutdown:
+                raise RuntimeError("pool is shut down")
+            w = self._pick_worker_locked()
+            seq = w.next_seq
+            w.next_seq += 1
+            w.inflight[seq] = inf
+            inf.worker = w
+            inf.started = False
+            inf.cancel_sent = False
+            inf.submit_ts = time.monotonic()
+        try:
+            w.send_frame(("run", seq, inf.oid_bin, inf.fn_blob, inf.args_blob, inf.task_bin))
+        except (BrokenPipeError, OSError):
+            self._on_worker_death(w)
+
+    def submit_blob(self, fn_blob: bytes, args_blob: bytes,
+                    result_oid_bin: bytes | None = None,
+                    task_bin: bytes | None = None) -> Future:
+        """Pipelined submission; the future resolves to (status, payload, extra)
+        or raises _RemoteTaskError / WorkerCrashedError."""
+        inf = _Inflight(fn_blob, args_blob, result_oid_bin, task_bin)
+        self._submit_inflight(inf)
+        return inf.future
 
     def execute(self, fn: Callable, args: tuple, kwargs: dict,
                 result_oid_bin: bytes | None = None, timeout: float | None = None,
@@ -441,6 +759,91 @@ class ProcessWorkerPool:
             raise ValueError(f"task not serializable for process isolation: {e}") from e
         return self.execute_blob(fn_blob, args_blob, result_oid_bin, timeout, task_bin)
 
+    def execute_blob(self, fn_blob: bytes, args_blob: bytes,
+                     result_oid_bin: bytes | None = None,
+                     timeout: float | None = None,
+                     task_bin: bytes | None = None):
+        """Blocking form (head dispatcher and node agents): submit + wait."""
+        import concurrent.futures as _cf
+
+        inf = _Inflight(fn_blob, args_blob, result_oid_bin, task_bin)
+        self._submit_inflight(inf)
+        try:
+            return inf.future.result(timeout)
+        except _cf.TimeoutError:
+            # the worker is mid-task; its pipe is now desynced — kill it rather
+            # than let it hand a later task this task's late response. Innocent
+            # pipelined neighbors fail as WorkerCrashedError and retry.
+            w = inf.worker
+            if w is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+            raise TimeoutError(f"process task exceeded {timeout}s") from None
+
+    # ------------------------------------------------------------ blocked flow
+    def on_task_blocked(self, task_bin: bytes) -> None:
+        """The head learned `task_bin` is blocked in a nested get/wait. Mark
+        its worker blocked and yank that worker's queued (unstarted) tasks so
+        they run elsewhere — the pipelined analog of the reference's
+        NotifyDirectCallTaskBlocked worker-release."""
+        to_cancel: list[tuple[_Worker, int]] = []
+        with self._cv:
+            for w in self._workers:
+                if not w.is_alive():
+                    continue
+                for seq, inf in w.inflight.items():
+                    if inf.started and inf.task_bin == task_bin:
+                        w.blocked = True
+                        for s2, inf2 in w.inflight.items():
+                            if not inf2.started and not inf2.cancel_sent:
+                                inf2.cancel_sent = True
+                                to_cancel.append((w, s2))
+                        break
+        for w, seq in to_cancel:
+            try:
+                w.send_frame(("cancel", seq))
+            except (BrokenPipeError, OSError):
+                self._on_worker_death(w)
+
+    def cancel_task(self, task_bin: bytes, force: bool = False) -> bool:
+        """User-requested cancel (ray.cancel). A queued (unstarted) task is
+        yanked via the cancel protocol and its future resolves to
+        TaskCancelledError; a RUNNING task is only interruptible with
+        force=True, which kills its worker (pipelined neighbors fail as
+        WorkerCrashedError and retry — CancelTask semantics,
+        task_receiver.cc force_kill)."""
+        target: _Worker | None = None
+        seq_to_cancel: int | None = None
+        with self._cv:
+            for w in self._workers:
+                for seq, inf in w.inflight.items():
+                    if inf.task_bin == task_bin:
+                        if inf.started:
+                            if force:
+                                try:
+                                    os.kill(w.proc.pid, 9)
+                                except OSError:
+                                    return False
+                                return True
+                            return False
+                        inf.user_cancelled = True
+                        if not inf.cancel_sent:
+                            inf.cancel_sent = True
+                            target, seq_to_cancel = w, seq
+                        break
+                if target is not None:
+                    break
+        if target is not None:
+            try:
+                target.send_frame(("cancel", seq_to_cancel))
+            except (BrokenPipeError, OSError):
+                self._on_worker_death(target)
+            return True
+        return False
+
+    # ------------------------------------------------------------- inspection
     def running_tasks(self) -> dict:
         """pid -> (task_bin, start_ts) for in-flight tasks (OOM policy input)."""
         with self._lock:
@@ -460,44 +863,6 @@ class ProcessWorkerPool:
                 return False
             return True
 
-    def execute_blob(self, fn_blob: bytes, args_blob: bytes,
-                     result_oid_bin: bytes | None = None,
-                     timeout: float | None = None,
-                     task_bin: bytes | None = None):
-        """Pre-marshalled form (used by the head dispatcher and node agents)."""
-        import time as _time
-
-        w = self._checkout()
-        with self._lock:
-            self._running_tasks[w.proc.pid] = (task_bin, _time.monotonic())
-        try:
-            req = cloudpickle.dumps(("run", result_oid_bin, fn_blob, args_blob, task_bin))
-            try:
-                w.conn.send_bytes(req)
-                if timeout is not None and not w.conn.poll(timeout):
-                    # the worker is mid-task; its pipe is now desynced — kill it
-                    # rather than check it back in (a reused worker would hand the
-                    # NEXT task this task's late response)
-                    w.proc.terminate()
-                    self._drop_worker(w)
-                    raise TimeoutError(f"process task exceeded {timeout}s")
-                resp = cloudpickle.loads(w.conn.recv_bytes())
-            except (EOFError, OSError, BrokenPipeError) as e:
-                # worker died mid-task: drop it; capacity respawns immediately
-                self._drop_worker(w)
-                raise WorkerCrashedError(
-                    f"worker process died while executing task ({type(e).__name__})"
-                ) from e
-            status, payload, extra = resp
-            if status == "err":
-                raise _RemoteTaskError(payload, exc_blob=extra)
-            return status, payload, extra
-        finally:
-            with self._lock:
-                self._running_tasks.pop(w.proc.pid, None)
-            if w.is_alive():
-                self._checkin(w)
-
     def kill_random_worker(self) -> int:
         """Chaos hook: SIGKILL one busy-or-idle worker (tests worker-death FT)."""
         with self._lock:
@@ -509,11 +874,12 @@ class ProcessWorkerPool:
         return -1
 
     def shutdown(self) -> None:
+        self._shutdown = True
         with self._lock:
             workers, self._workers = self._workers, []
         for w in workers:
             try:
-                w.conn.send_bytes(cloudpickle.dumps(("exit",)))
+                w.send_frame(("exit",))
             except Exception:
                 pass
             try:

@@ -120,6 +120,9 @@ class _TaskEntry:
     # Set when the task blocked in a nested get and its cpus were handed back
     # (reference: NotifyDirectCallTaskBlocked, raylet_ipc_client.h)
     resources_released: bool = False
+    # Async dispatch already recorded RUNNING + rolled chaos before falling
+    # back to the thread path; don't repeat either.
+    async_prologue_done: bool = False
 
 
 @dataclass
@@ -322,6 +325,9 @@ class Runtime:
             self.control_plane = ControlPlane(self)
         except Exception as e:  # pragma: no cover
             logger.warning("control plane unavailable (%s); nested worker API disabled", e)
+        import weakref
+
+        self._fn_blob_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
         self._dispatcher = threading.Thread(target=self._dispatch_loop, daemon=True, name="ray_tpu-dispatcher")
         self._dispatcher.start()
         self._task_events: list[dict] = []
@@ -347,23 +353,23 @@ class Runtime:
         # boundary (ray_config_def.h:245).
         if self.shm_store is not None and size > self.config.max_inline_object_size:
             try:
-                blob = serialization.serialize_to_bytes(value)
+                total, parts = serialization.serialize_parts(value)
                 try:
-                    self.shm_store.put_bytes(oid, blob)
+                    self.shm_store.put_parts(oid, total, parts)
                 except Exception:
                     # Store full of PINNED (referenced) objects: spill oldest
                     # primaries to disk and retry (local_object_manager.cc:45
                     # semantics), then fall back inline.
-                    if self.spill is None or not self.spill.spill_for(len(blob)):
+                    if self.spill is None or not self.spill.spill_for(total):
                         raise
-                    self.shm_store.put_bytes(oid, blob)
+                    self.shm_store.put_parts(oid, total, parts)
                 # Pin while referenced: LRU eviction must not take objects with
                 # live ObjectRefs (plasma pins primary copies of referenced
                 # objects). Released in _on_ref_zero.
                 self.shm_store.pin(oid)
                 if self.spill is not None:
-                    self.spill.on_put(oid, len(blob))
-                self.memory_store.put(oid, RayObject(size=len(blob), in_shm=True))
+                    self.spill.on_put(oid, total)
+                self.memory_store.put(oid, RayObject(size=total, in_shm=True))
                 return
             except Exception as e:  # store full and unevictable -> inline fallback
                 logger.debug("shm put failed for %s (%s); storing inline", oid.hex()[:8], e)
@@ -664,12 +670,19 @@ class Runtime:
                 entry.start_time = time.time()
                 entry.sched_req = req
                 entry.resources_released = False
-                t = threading.Thread(
-                    target=self._execute_task, args=(entry, req), daemon=True,
-                    name=f"ray_tpu-worker-{entry.spec.desc()[:24]}",
-                )
-                entry.thread = t
-                t.start()
+                if self._can_dispatch_async(entry):
+                    # Local process tasks go straight to the pipelined pool —
+                    # no thread per task; completion arrives via the pool
+                    # reader's callback (reference: PushNormalTask replies
+                    # resolve on the io-service thread, not a per-task thread).
+                    self._submit_process_task_async(entry, req)
+                else:
+                    t = threading.Thread(
+                        target=self._execute_task, args=(entry, req), daemon=True,
+                        name=f"ray_tpu-worker-{entry.spec.desc()[:24]}",
+                    )
+                    entry.thread = t
+                    t.start()
             if len(still_waiting) == len(waiting) and still_waiting:
                 # nothing schedulable: wait for resources/objects to change
                 self.scheduler.wait_for_change(0.02)
@@ -699,7 +712,8 @@ class Runtime:
         spec = entry.spec
         if self.is_shutdown:
             return  # session torn down while this task was in flight
-        self._record_event(spec, "RUNNING")
+        if not entry.async_prologue_done:
+            self._record_event(spec, "RUNNING")
         try:
             if spec.is_actor_creation:
                 self._execute_actor_creation(spec)
@@ -738,15 +752,112 @@ class Runtime:
         except BaseException as e:  # noqa: BLE001
             self._handle_task_failure(entry, e)
         finally:
-            entry.end_time = time.time()
-            if not spec.is_actor_creation and self._claim_release(entry):
-                self.scheduler.release(entry.node_id, req)
-                self.scheduler.retry_pending_pgs()
             # Keep deps pinned across retries; release only at a terminal state.
-            if entry.state in ("FINISHED", "FAILED", "CANCELLED"):
-                self.reference_counter.remove_submitted_task_refs(
-                    [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
-                )
+            self._finalize_entry(entry, req)
+
+    def _can_dispatch_async(self, entry: _TaskEntry) -> bool:
+        """Async (callback) dispatch applies to plain local process tasks; the
+        thread path remains for actors, generators, agent dispatch, and traced
+        tasks (whose span must bracket the full roundtrip)."""
+        spec = entry.spec
+        if spec.is_actor_creation or isinstance(spec.num_returns, str):
+            return False
+        if not self._use_process_execution(spec):
+            return False
+        if self._agents.get(entry.node_id) is not None:
+            return False
+        from ray_tpu.util import tracing
+
+        return not tracing.is_enabled()
+
+    def _submit_process_task_async(self, entry: _TaskEntry, req: SchedulingRequest) -> None:
+        """Marshal + pipeline onto the local pool; the reply callback finishes
+        the task. Runs in the dispatcher thread, so it must never block."""
+        spec = entry.spec
+        self._record_event(spec, "RUNNING")
+        try:
+            if entry.cancelled:
+                raise TaskCancelledError(spec.desc())
+            self._maybe_inject_chaos(spec)
+            fn_blob, args_blob = self._task_blobs(spec)
+        except TaskCancelledError as e:
+            self._store_error(spec, e)
+            entry.state = "CANCELLED"
+            self._record_event(spec, "CANCELLED")
+            self._finalize_entry(entry, req)
+            return
+        except ActorError as e:  # injected chaos: system failure -> retry path
+            self._handle_task_failure(entry, e)
+            self._finalize_entry(entry, req)
+            return
+        except Exception:
+            # Not serializable (closures over locks/queues/live handles):
+            # fall back to the in-process thread path rather than failing.
+            entry.async_prologue_done = True  # RUNNING + chaos already done
+            t = threading.Thread(
+                target=self._execute_task, args=(entry, req), daemon=True,
+                name=f"ray_tpu-worker-{spec.desc()[:24]}",
+            )
+            entry.thread = t
+            t.start()
+            return
+        rids = spec.return_ids()
+        oid_bin = rids[0].binary() if spec.num_returns == 1 else None
+        fut = self._process_pool().submit_blob(
+            fn_blob, args_blob, oid_bin, spec.task_id.binary()
+        )
+        fut.add_done_callback(
+            lambda f: self._complete_process_task(entry, req, rids, f)
+        )
+
+    def _complete_process_task(self, entry: _TaskEntry, req: SchedulingRequest,
+                               rids: list, fut) -> None:
+        """Pool-reader-thread callback: store the result / run the failure
+        machinery, then release resources — the tail of _execute_task."""
+        from ray_tpu.core.process_pool import _RemoteTaskError
+
+        spec = entry.spec
+        try:
+            exc = fut.exception()
+            if exc is not None:
+                if isinstance(exc, _RemoteTaskError):
+                    orig = exc.original_exception()
+                    if orig is not None:
+                        orig.__ray_tpu_remote_tb__ = exc.remote_tb
+                        raise orig from None
+                    raise RuntimeError(exc.remote_tb) from None
+                raise exc
+            status, payload, size = fut.result()
+            self._store_worker_result(spec, rids, status, payload, size)
+            entry.state = "FINISHED"
+            self._record_event(spec, "FINISHED")
+        except TaskCancelledError as e:
+            self._store_error(spec, e)
+            entry.state = "CANCELLED"
+            self._record_event(spec, "CANCELLED")
+        except BaseException as e:  # noqa: BLE001
+            if entry.cancelled:
+                # ray.cancel(force=True) killed the worker mid-task: surface
+                # as cancellation, not a retryable system failure.
+                self._store_error(spec, TaskCancelledError(spec.desc()))
+                entry.state = "CANCELLED"
+                self._record_event(spec, "CANCELLED")
+            else:
+                self._handle_task_failure(entry, e)
+        finally:
+            self._finalize_entry(entry, req)
+
+    def _finalize_entry(self, entry: _TaskEntry, req: SchedulingRequest) -> None:
+        """Release resources + dependency pins at a terminal state (the
+        `finally` of the thread path, shared with async completion)."""
+        entry.end_time = time.time()
+        if not entry.spec.is_actor_creation and self._claim_release(entry):
+            self.scheduler.release(entry.node_id, req)
+            self.scheduler.retry_pending_pgs()
+        if entry.state in ("FINISHED", "FAILED", "CANCELLED"):
+            self.reference_counter.remove_submitted_task_refs(
+                [r.object_id() for r in _ref_args(entry.spec.args, entry.spec.kwargs)]
+            )
 
     def _maybe_inject_chaos(self, spec: TaskSpec) -> None:
         """Config-driven fault injection (reference: src/ray/rpc/rpc_chaos.cc,
@@ -829,8 +940,25 @@ class Runtime:
             tid = TaskID(task_bin)
         except Exception:
             return
+        # Yank the blocked worker's queued-but-unstarted tasks so they run on
+        # other workers (pipelined submission would otherwise queue a task
+        # behind the very task that waits on it).
+        pool = getattr(self, "_proc_pool", None)
+        if pool is not None:
+            try:
+                pool.on_task_blocked(task_bin)
+            except Exception:
+                pass
         with self._lock:
             entry = self._tasks.get(tid)
+        # Agent-hosted workers belong to the AGENT's pool: relay there.
+        if entry is not None and entry.node_id is not None:
+            agent = self._agents.get(entry.node_id)
+            if agent is not None:
+                try:
+                    agent.call("task_blocked", task=task_bin, timeout=5)
+                except Exception:
+                    pass
         if (
             entry is not None and entry.state == "RUNNING"
             and entry.sched_req is not None
@@ -912,7 +1040,20 @@ class Runtime:
             from ray_tpu.core.process_pool import wrap_with_runtime_env
 
             fn = wrap_with_runtime_env(fn, spec.runtime_env)
-        return cloudpickle.dumps(fn), self._marshal_args(spec)
+            return cloudpickle.dumps(fn), self._marshal_args(spec)
+        # Pickle each function ONCE (the reference exports a function to the
+        # GCS function table a single time, not per task — function_manager).
+        try:
+            blob = self._fn_blob_cache.get(fn)
+        except TypeError:  # unhashable callable
+            return cloudpickle.dumps(fn), self._marshal_args(spec)
+        if blob is None:
+            blob = cloudpickle.dumps(fn)
+            try:
+                self._fn_blob_cache[fn] = blob
+            except TypeError:
+                pass
+        return blob, self._marshal_args(spec)
 
     def _execute_in_process(self, entry: _TaskEntry) -> None:
         """Run the task in an OS worker process (crash -> system failure -> retry)."""
@@ -921,7 +1062,8 @@ class Runtime:
         spec = entry.spec
         if entry.cancelled:
             raise TaskCancelledError(spec.desc())
-        self._maybe_inject_chaos(spec)
+        if not entry.async_prologue_done:
+            self._maybe_inject_chaos(spec)
         rids = spec.return_ids()
         oid_bin = rids[0].binary() if spec.num_returns == 1 else None
         try:
@@ -981,7 +1123,8 @@ class Runtime:
         spec = entry.spec
         if entry.cancelled:
             raise TaskCancelledError(spec.desc())
-        self._maybe_inject_chaos(spec)
+        if not entry.async_prologue_done:
+            self._maybe_inject_chaos(spec)
         rids = spec.return_ids()
         oid_bin = rids[0].binary() if spec.num_returns == 1 else None
         try:
@@ -1048,6 +1191,14 @@ class Runtime:
                 entry.attempts, spec.max_retries,
             )
             self._record_event(spec, "RETRYING")
+            # Release THIS attempt's claim before the retry can be granted a
+            # new one: _enqueue first would let the dispatcher overwrite
+            # entry.sched_req/resources_released while the old claim is still
+            # held, leaking capacity (released later against the wrong req).
+            if (not spec.is_actor_creation and entry.sched_req is not None
+                    and self._claim_release(entry)):
+                self.scheduler.release(entry.node_id, entry.sched_req)
+                self.scheduler.retry_pending_pgs()
             self._enqueue(spec)
             return
         entry.state = "FAILED"
@@ -1157,8 +1308,18 @@ class Runtime:
         if entry is None:
             return
         entry.cancelled = True
-        if entry.state == "RUNNING" and entry.thread is not None and force:
-            _async_raise(entry.thread, TaskCancelledError)
+        if entry.state == "RUNNING":
+            if entry.thread is not None and force:
+                _async_raise(entry.thread, TaskCancelledError)
+            elif entry.thread is None:
+                # Async-dispatched process task: yank it from the pool (queued
+                # tasks cancel cleanly; running tasks need force -> worker kill).
+                pool = getattr(self, "_proc_pool", None)
+                if pool is not None:
+                    try:
+                        pool.cancel_task(entry.spec.task_id.binary(), force)
+                    except Exception:
+                        pass
         if entry.state == "PENDING":
             self._finish_cancelled(entry)
 

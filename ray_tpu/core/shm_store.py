@@ -51,6 +51,8 @@ class _Lib:
             lib.shm_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.shm_store_base.restype = ctypes.c_void_p
             lib.shm_store_base.argtypes = [ctypes.c_void_p]
+            lib.shm_store_prefault.restype = ctypes.c_int
+            lib.shm_store_prefault.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
             lib.shm_store_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64 * 4)]
             lib.shm_store_close.argtypes = [ctypes.c_void_p]
             lib.shm_store_unlink.argtypes = [ctypes.c_char_p]
@@ -80,6 +82,34 @@ class SharedMemoryStore:
             raise RuntimeError(f"failed to create/open shm store {name}")
         self._base = self._lib.shm_store_base(self._handle)
         atexit.register(self.close)
+        if owner:
+            self._start_prefault()
+
+    def _start_prefault(self) -> None:
+        """Warm the arena's page tables in the background (one-time, owner-only).
+        Cold shm pages cap puts at ~2 GB/s (zero-fill write faults); prefaulted
+        pages take the same memcpy to ~12 GB/s. MADV_POPULATE_WRITE preserves
+        contents, so racing live writers is safe."""
+        import threading
+
+        def run(handle=self._handle, lib=self._lib, size=self.size):
+            import time
+
+            try:  # background priority: page-zeroing must not starve the session's
+                os.setpriority(os.PRIO_PROCESS, threading.get_native_id(), 19)
+            except (OSError, AttributeError):
+                pass
+            chunk = 64 * 1024 * 1024
+            off = 0
+            while off < size:
+                try:
+                    lib.shm_store_prefault(handle, off, min(chunk, size - off))
+                except Exception:
+                    return
+                off += chunk
+                time.sleep(0.005)  # yield between chunks (kernel zero-fill is heavy)
+
+        threading.Thread(target=run, daemon=True, name="shm-prefault").start()
 
     # --- object lifecycle ---
     def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> None:
@@ -99,6 +129,30 @@ class SharedMemoryStore:
             # abort OUR in-progress create so the entry doesn't stay CREATING
             # forever (the live-writer guard would otherwise block every later
             # put of this oid for the life of the process)
+            self._lib.shm_store_abort(self._handle, oid.binary())
+            raise
+        self._lib.shm_store_seal(self._handle, oid.binary())
+
+    def put_parts(self, oid: ObjectID, total: int, parts: list) -> None:
+        """Scatter-gather put: write pre-laid-out parts (serialization.serialize_parts)
+        back-to-back into the slot — skips the join copy serialize_to_bytes pays."""
+        import numpy as np
+
+        off = self._create_slot(oid, total)
+        if off is None:
+            return  # already sealed (idempotent put)
+        try:
+            dst = np.frombuffer(
+                (ctypes.c_char * total).from_address(self._base + off), dtype=np.uint8
+            )
+            pos = 0
+            for p in parts:
+                src = np.frombuffer(p, dtype=np.uint8)
+                n = src.nbytes
+                if n:
+                    dst[pos:pos + n] = src
+                pos += n
+        except BaseException:
             self._lib.shm_store_abort(self._handle, oid.binary())
             raise
         self._lib.shm_store_seal(self._handle, oid.binary())

@@ -496,6 +496,28 @@ int shm_store_abort(void* handle, const uint8_t* id) {
 
 void* shm_store_base(void* handle) { return ((Store*)handle)->base; }
 
+// Prefault a range of the segment so later memcpys into fresh arena space hit
+// warm page tables instead of zero-fill faults (~2 GB/s cold vs ~12 GB/s warm
+// measured). MADV_POPULATE_WRITE faults pages in WITHOUT altering contents, so
+// it is safe to run concurrently with live writers. Returns 0 on success.
+int shm_store_prefault(void* handle, uint64_t offset, uint64_t length) {
+  Store* s = (Store*)handle;
+  uint64_t seg = s->hdr->segment_size;
+  if (offset >= seg) return 0;
+  if (offset + length > seg) length = seg - offset;
+#ifdef MADV_POPULATE_WRITE
+  if (madvise((char*)s->base + offset, length, MADV_POPULATE_WRITE) == 0) return 0;
+#endif
+  // Fallback (old kernels): read-touch one byte per page. A read fault is not
+  // as effective as a write fault but warms the page tables without the
+  // read-modify-write race a write-touch would have against live writers.
+  volatile char* p = (volatile char*)s->base + offset;
+  volatile char sink = 0;
+  for (uint64_t i = 0; i < length; i += 4096) sink = p[i];
+  (void)sink;
+  return 0;
+}
+
 void shm_store_stats(void* handle, uint64_t* out4) {
   Store* s = (Store*)handle;
   Header* h = s->hdr;
