@@ -95,6 +95,21 @@ class FakeCgroupDriver(CgroupDriver):
         return self.files[(path, control)]
 
 
+def create_if_enabled(session_name: str):
+    """Build + set up a CgroupManager when config.worker_cgroups_enabled; None
+    when disabled or the cgroup2 subtree isn't writable (silent opt-out — the
+    reference likewise degrades without cgroup permissions)."""
+    try:
+        from ray_tpu._private.config import get_config
+
+        if not get_config().worker_cgroups_enabled:
+            return None
+        mgr = CgroupManager(session_name)
+        return mgr if mgr.setup() else None
+    except Exception:
+        return None
+
+
 class CgroupManager:
     """Owns the session's cgroup subtree; one child cgroup per worker."""
 

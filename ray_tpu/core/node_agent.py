@@ -172,6 +172,9 @@ def main() -> None:
         shm_name, shm_size = reg.get("shm_name"), reg.get("shm_size") or 0
 
     num_workers = max(1, int(resources.get("CPU", 1)))
+    from ray_tpu.core import cgroup as cgroup_mod
+
+    cgroups = cgroup_mod.create_if_enabled(f"ray_tpu-agent-{os.getpid()}")
     pool_box["pool"] = ProcessWorkerPool(
         num_workers=num_workers,
         shm_name=shm_name,
@@ -179,6 +182,7 @@ def main() -> None:
         head_addr=args.head,
         token=args.token,
         log_dir=reg.get("log_dir"),
+        cgroup_manager=cgroups,
     )
 
     # Heartbeat until the head goes away, then exit (reference: raylet dies
@@ -196,6 +200,11 @@ def main() -> None:
             pool_box["pool"].shutdown()
         except Exception:
             pass
+        if cgroups is not None:
+            try:  # retire the agent's cgroup subtree (matches head shutdown)
+                cgroups.cleanup()
+            except Exception:
+                pass
         if plane_server is not None:
             plane_server.close()
     sys.exit(0)

@@ -891,6 +891,12 @@ class Runtime:
                 n = self.config.process_workers or int(
                     _os.environ.get("RAY_TPU_PROCESS_WORKERS", "0")
                 ) or min(_os.cpu_count() or 2, 8)
+                # opt-in cgroup2 confinement (reference: cgroup_manager) —
+                # constructed HERE so enabling the config actually takes effect
+                from ray_tpu.core import cgroup as cgroup_mod
+
+                cgroups = cgroup_mod.create_if_enabled(f"ray_tpu-{_os.getpid()}")
+                self._cgroup_manager = cgroups
                 pool = self._proc_pool = ProcessWorkerPool(
                     num_workers=n,
                     shm_name=self.shm_store.name if self.shm_store else None,
@@ -898,6 +904,7 @@ class Runtime:
                     head_addr=self.control_plane.address if self.control_plane else None,
                     token=self.control_plane.token if self.control_plane else None,
                     log_dir=self.session_log_dir,
+                    cgroup_manager=cgroups,
                 )
                 if self.config.memory_usage_threshold < 1.0 and self._memory_monitor is None:
                     from ray_tpu.core.memory_monitor import MemoryMonitor
@@ -1951,6 +1958,12 @@ class Runtime:
         if pool is not None:
             try:
                 pool.shutdown()
+            except Exception:
+                pass
+        cgroups = getattr(self, "_cgroup_manager", None)
+        if cgroups is not None:
+            try:
+                cgroups.cleanup()
             except Exception:
                 pass
         if self._memory_monitor is not None:

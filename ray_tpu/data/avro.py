@@ -192,13 +192,18 @@ def _value_type(v, name: str = "field") -> Any:
 def _merge_types(a, b):
     if a == b:
         return a
-    if a == "null" or b == "null":  # widen to a nullable union
-        other = b if a == "null" else a
-        return ["null", other]
+    # Union operands first: merging "null" into an already-nullable union must
+    # NOT double-wrap (["null", ["null", X]] is invalid Avro for external
+    # readers even though this codec round-trips it).
     if isinstance(a, list) and "null" in a:
+        if b == "null":
+            return a
         return ["null", _merge_types(next(s for s in a if s != "null"), b)]
     if isinstance(b, list) and "null" in b:
         return _merge_types(b, a)
+    if a == "null" or b == "null":  # widen to a nullable union
+        other = b if a == "null" else a
+        return ["null", other]
     if isinstance(a, str) and isinstance(b, str) and {a, b} == {"long", "double"}:
         return "double"
     if (isinstance(a, dict) and isinstance(b, dict)

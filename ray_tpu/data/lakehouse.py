@@ -23,24 +23,61 @@ class DeltaProtocolError(ValueError):
     pass
 
 
-def _delta_log_entries(table_path: str) -> tuple[list[str], dict[int, str]]:
-    """Sorted checkpoint parquet paths + {version: commit-json-path}."""
+def _delta_log_entries(table_path: str) -> tuple[dict[int, list[str]], dict[int, str]]:
+    """{checkpoint version: sorted part paths} + {version: commit-json-path}."""
     log_dir = os.path.join(table_path, "_delta_log")
     if not os.path.isdir(log_dir):
         raise DeltaProtocolError(
             f"{table_path!r} is not a Delta table (no _delta_log/ directory)"
         )
     commits: dict[int, str] = {}
-    checkpoints: list[str] = []
+    checkpoints: dict[int, list[str]] = {}  # version -> part file(s)
     for p in _glob.glob(os.path.join(log_dir, "*")):
         base = os.path.basename(p)
         if base.endswith(".json") and base[: -len(".json")].isdigit():
             commits[int(base[: -len(".json")])] = p
-        elif base.endswith(".checkpoint.parquet"):
-            checkpoints.append(p)
+        elif base.endswith(".parquet") and ".checkpoint" in base:
+            # single-part: NN.checkpoint.parquet
+            # multi-part:  NN.checkpoint.MM.PP.parquet (delta PROTOCOL.md) —
+            # all parts of a version together hold the full state
+            head = base.split(".checkpoint", 1)[0]
+            if head.isdigit():
+                checkpoints.setdefault(int(head), []).append(p)
     if not commits and not checkpoints:
         raise DeltaProtocolError(f"empty _delta_log in {table_path!r}")
-    return sorted(checkpoints), commits
+    for v, parts in checkpoints.items():
+        parts.sort()
+    return checkpoints, commits
+
+
+def _declared_part_count(part_path: str):
+    """PP from NN.checkpoint.MM.PP.parquet; None for single-part checkpoints."""
+    base = os.path.basename(part_path)
+    fields = base[: -len(".parquet")].split(".")
+    if len(fields) == 4 and fields[1] == "checkpoint":
+        try:
+            return int(fields[3])
+        except ValueError:
+            return None
+    return None
+
+
+def _apply_checkpoint_part(path: str, active: dict, _read_parquet) -> None:
+    """Fold one checkpoint parquet (or one part of a multi-part checkpoint)
+    into the active-file map. Checkpoint rows carry one action per row; the
+    'add' struct arrives either flattened (add.path columns) or as an object
+    column of dicts, depending on the writer."""
+    cols = _read_parquet(path)
+    add_paths = cols.get("add.path")
+    if add_paths is None and "add" in cols:
+        for a in cols["add"]:
+            if isinstance(a, dict) and a.get("path"):
+                active[a["path"]] = a.get("partitionValues") or {}
+    elif add_paths is not None:
+        pvals = cols.get("add.partitionValues", [None] * len(add_paths))
+        for pth, pv in zip(add_paths, pvals):
+            if pth is not None:
+                active[str(pth)] = pv if isinstance(pv, dict) else {}
 
 
 def delta_active_files(table_path: str, version: int | None = None) -> tuple[list[str], list[dict]]:
@@ -53,34 +90,32 @@ def delta_active_files(table_path: str, version: int | None = None) -> tuple[lis
     start_version = 0
     active: dict[str, dict] = {}  # relative path -> partitionValues
 
-    use_checkpoint = None
-    if checkpoints:
-        # newest checkpoint at or below the requested version
-        def ckpt_version(p: str) -> int:
-            return int(os.path.basename(p).split(".")[0])
-
-        eligible = [p for p in checkpoints if version is None or ckpt_version(p) <= version]
-        if eligible:
-            use_checkpoint = max(eligible, key=ckpt_version)
-    if use_checkpoint is not None:
+    # newest checkpoint version at or below the requested version
+    eligible = [v for v in checkpoints if version is None or v <= version]
+    ckpt_version_used = max(eligible) if eligible else None
+    if ckpt_version_used is None and commits and 0 not in commits:
+        # pre-checkpoint commits were vacuumed and no checkpoint covers them:
+        # replaying the surviving tail alone would silently drop files
+        raise DeltaProtocolError(
+            f"delta log in {table_path!r} starts at version {min(commits)} "
+            "with no usable checkpoint — cannot reconstruct table state"
+        )
+    if ckpt_version_used is not None:
         from ray_tpu.data.read_api import _read_parquet
 
-        cols = _read_parquet(use_checkpoint)
-        # checkpoint rows: one action per row; 'add' struct flattened by the
-        # parquet reader as add.path / add.partitionValues JSON-ish columns,
-        # or an object column of dicts depending on writer. Handle both.
-        add_paths = cols.get("add.path")
-        if add_paths is None and "add" in cols:
-            for a in cols["add"]:
-                if isinstance(a, dict) and a.get("path"):
-                    active[a["path"]] = a.get("partitionValues") or {}
-        elif add_paths is not None:
-            pvals = cols.get("add.partitionValues", [None] * len(add_paths))
-            for pth, pv in zip(add_paths, pvals):
-                if pth is not None:
-                    active[str(pth)] = pv if isinstance(pv, dict) else {}
-        start_version = int(os.path.basename(use_checkpoint).split(".")[0]) + 1
-
+        parts_list = checkpoints[ckpt_version_used]
+        # multi-part names encode the total (NN.checkpoint.MM.PP.parquet):
+        # an incomplete part set (writer crash mid-checkpoint) must fail, not
+        # silently return a table missing the absent parts' files
+        declared = _declared_part_count(parts_list[0])
+        if declared is not None and len(parts_list) != declared:
+            raise DeltaProtocolError(
+                f"checkpoint {ckpt_version_used} in {table_path!r} has "
+                f"{len(parts_list)}/{declared} parts — incomplete checkpoint"
+            )
+        for part in parts_list:
+            _apply_checkpoint_part(part, active, _read_parquet)
+        start_version = ckpt_version_used + 1
     for v in sorted(commits):
         if v < start_version:
             continue

@@ -77,8 +77,12 @@ def worker_join_command(spec: dict, worker: dict, address: str, token: str) -> l
 
 def _spawn(spec: dict, host: str, argv: list[str], log_path: str) -> subprocess.Popen:
     # truncate: a stale log from a previous run must never satisfy
-    # _wait_for_head_info with an old address/token
-    log = open(log_path, "wb")
+    # _wait_for_head_info with an old address/token. 0600: the head log
+    # carries the control-plane join token — a world-readable log would let
+    # any local user join/control the cluster.
+    fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.fchmod(fd, 0o600)  # O_CREAT's mode is ignored for pre-existing files
+    log = os.fdopen(fd, "wb")
     if spec.get("provider", "ssh") == "local":
         return subprocess.Popen(argv, stdout=log, stderr=subprocess.STDOUT)
     remote = " ".join(argv)
@@ -108,8 +112,12 @@ def _wait_for_head_info(log_path: str, timeout: float = 60.0) -> tuple[str, str]
     return addr, token
 
 
-def up(spec: dict, log_dir: str = "/tmp") -> dict:
-    """Start head + workers; returns {'address', 'token', 'pids'}."""
+def up(spec: dict, log_dir: str | None = None) -> dict:
+    """Start head + workers; returns {'address', 'token', 'pids'}.
+
+    Logs default into the 0700 ~/.ray_tpu dir (they carry the join token)."""
+    if log_dir is None:
+        log_dir = os.path.dirname(_state_file())
     head_log = os.path.join(log_dir, "ray_tpu_head.log")
     head_proc = _spawn(spec, spec["head"]["host"], head_start_command(spec), head_log)
     try:

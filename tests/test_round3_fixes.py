@@ -1,0 +1,144 @@
+"""Round-3 advisor-fix regressions (ADVICE.md round 2)."""
+
+import json
+import os
+
+import pytest
+
+
+def test_avro_merge_null_into_union_no_double_wrap():
+    # None in some rows + absent in others must yield ["null", X], never
+    # ["null", ["null", X]] (invalid Avro for external readers).
+    from ray_tpu.data.avro import _merge_types, infer_schema
+
+    assert _merge_types("null", ["null", "long"]) == ["null", "long"]
+    assert _merge_types(["null", "long"], "null") == ["null", "long"]
+
+    rows = [{"a": 1, "b": 2}, {"a": None}, {"a": 3}]
+    schema = infer_schema(rows)
+    types = {f["name"]: f["type"] for f in schema["fields"]}
+    assert types["a"] == ["null", "long"]
+    assert types["b"] == ["null", "long"]
+    # no nested unions anywhere
+    def flat(t):
+        if isinstance(t, list):
+            assert all(not isinstance(x, list) for x in t), t
+    for t in types.values():
+        flat(t)
+
+
+def test_delta_multipart_checkpoint(tmp_path):
+    # NN.checkpoint.MM.PP.parquet parts must all be read (not silently skipped)
+    np = pytest.importorskip("numpy")
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.lakehouse import delta_active_files
+
+    table = tmp_path / "tbl"
+    log = table / "_delta_log"
+    log.mkdir(parents=True)
+
+    def write_ckpt_part(name, paths):
+        t = pa.table({
+            "add": [{"path": p, "partitionValues": {"d": "1"}} for p in paths],
+        })
+        pq.write_table(t, str(log / name))
+
+    # two-part checkpoint at version 2, plus a later commit
+    write_ckpt_part("00000000000000000002.checkpoint.0000000001.0000000002.parquet",
+                    ["part-a.parquet"])
+    write_ckpt_part("00000000000000000002.checkpoint.0000000002.0000000002.parquet",
+                    ["part-b.parquet"])
+    with open(log / "00000000000000000003.json", "w") as f:
+        f.write(json.dumps({"add": {"path": "part-c.parquet",
+                                    "partitionValues": {}}}) + "\n")
+    paths, parts = delta_active_files(str(table))
+    names = {os.path.basename(p) for p in paths}
+    assert names == {"part-a.parquet", "part-b.parquet", "part-c.parquet"}
+
+
+def test_delta_incomplete_multipart_checkpoint_raises(tmp_path):
+    # only 1 of 2 declared parts present (writer crash): must fail loudly
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.lakehouse import DeltaProtocolError, delta_active_files
+
+    table = tmp_path / "tbl"
+    log = table / "_delta_log"
+    log.mkdir(parents=True)
+    t = pa.table({"add": [{"path": "a.parquet", "partitionValues": {"d": "1"}}]})
+    pq.write_table(
+        t, str(log / "00000000000000000002.checkpoint.0000000001.0000000002.parquet")
+    )
+    with pytest.raises(DeltaProtocolError, match="incomplete checkpoint"):
+        delta_active_files(str(table))
+
+
+def test_delta_vacuumed_log_without_checkpoint_raises(tmp_path):
+    # commits start at v5 with no checkpoint: replay would silently lose the
+    # pre-v5 files — must fail loudly instead
+    from ray_tpu.data.lakehouse import DeltaProtocolError, delta_active_files
+
+    table = tmp_path / "tbl"
+    log = table / "_delta_log"
+    log.mkdir(parents=True)
+    with open(log / "00000000000000000005.json", "w") as f:
+        f.write(json.dumps({"add": {"path": "x.parquet",
+                                    "partitionValues": {}}}) + "\n")
+    with pytest.raises(DeltaProtocolError, match="no usable checkpoint"):
+        delta_active_files(str(table))
+
+
+def test_launcher_logs_are_private(tmp_path):
+    # head log carries the join token: must be 0600
+    from ray_tpu.scripts import launch
+
+    spec = {"provider": "local", "head": {"host": "127.0.0.1"}}
+    log_path = str(tmp_path / "head.log")
+    proc = launch._spawn(spec, "127.0.0.1", ["true"], log_path)
+    proc.wait(timeout=30)
+    mode = os.stat(log_path).st_mode & 0o777
+    assert mode == 0o600
+
+
+def test_cgroup_manager_wired_when_enabled(monkeypatch):
+    # enabling worker_cgroups_enabled must construct + pass a CgroupManager
+    # (round 2 shipped the config as a silent no-op)
+    import ray_tpu.core.runtime as rt_mod
+    from ray_tpu.core import cgroup as cg
+
+    built = {}
+
+    class FakeManager:
+        def __init__(self, name, driver=None, root=None):
+            built["name"] = name
+
+        def setup(self):
+            built["setup"] = True
+            return True
+
+        enabled = True
+
+        def add_worker(self, *a, **k):
+            built.setdefault("workers", 0)
+            built["workers"] += 1
+
+        def cleanup(self):
+            built["cleanup"] = True
+
+    monkeypatch.setattr(cg, "CgroupManager", FakeManager)
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                 _system_config={"worker_cgroups_enabled": True})
+    try:
+        rt = rt_mod.get_runtime_or_none()
+        pool = rt._process_pool()
+        assert built.get("setup") is True
+        assert pool._cgroups is not None
+        assert built.get("workers", 0) >= 1
+    finally:
+        ray_tpu.shutdown()
+    assert built.get("cleanup") is True
