@@ -70,6 +70,10 @@ class Config:
     worker_memory_limit_bytes: int = 0  # per-worker memory.max (0 = unlimited)
     worker_cpu_quota: float = 0.0       # per-worker CPUs via cpu.max (0 = unlimited)
 
+    # --- streaming generators (reference: _generator_backpressure_num_objects;
+    #     max unacked items a worker-process generator keeps in flight; 0 = off) ---
+    generator_backpressure_num_objects: int = 64
+
     # --- timeouts ---
     get_timeout_default_s: float | None = None
     rpc_connect_timeout_s: float = 10.0

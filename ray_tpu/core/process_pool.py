@@ -25,15 +25,24 @@ worker_pool.h:284). Tasks opted into process isolation run in exec'd workers:
   death (WorkerPool PopWorker semantics).
 
 Wire protocol (parent -> worker):
-  ("run", seq, oid_bin, fn_blob, args_blob, task_bin)   seq-tagged task
-  ("cancel", seq)                                        yank if unstarted
-  ("actor_init"/"actor_call", ...)                       dedicated actors (unnumbered)
+  ("run", seq, oid_bin, fn_blob, args_blob, task_bin)      seq-tagged task
+  ("run_gen", seq, task_bin, fn_blob, args_blob, bp)       streaming generator task
+  ("actor_call2", seq, method, args_blob, oid_bin)         seq-tagged actor call
+                                                           (async methods overlap
+                                                           on the worker's loop)
+  ("actor_gen", seq, method, args_blob, task_bin, bp)      generator actor method
+  ("ack", seq, consumed)                go-ahead: consumer progress for a stream
+  ("cancel", seq)                       yank if unstarted; abort a stream
+  ("actor_init", cls, args, renv)       dedicated actors (unnumbered reply)
   ("exit",)
 Worker -> parent:
+  ("ready",)                            boot handshake
   ("start", seq)                        executor began the task (running-set upkeep)
-  ("done", seq, status, payload, extra) status: "val" | "shm" | "err"
+  ("item", seq, index, status, payload, extra)  one generator yield
+  ("done", seq, status, payload, extra) status: "val" | "shm" | "err" | "gen_end"
   ("skipped", seq)                      cancel won; parent resubmits elsewhere
-  3-tuple (status, payload, extra)      dedicated-actor replies (unnumbered)
+  ("badreq", None)                      undecodable frame: parent kills + respawns
+  3-tuple (status, payload, extra)      actor_init reply (unnumbered)
 """
 
 from __future__ import annotations
@@ -195,14 +204,17 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
     pending: "collections.deque" = collections.deque()
     pend_cv = threading.Condition()
-    cancelled: set[int] = set()  # guarded by pend_cv's lock
+    cancelled: set[int] = set()     # guarded by pend_cv's lock
+    gen_consumed: dict[int, int] = {}  # seq -> consumer's acked count (backpressure)
+    _SEQ_TAGGED = ("run", "run_gen", "actor_call2", "actor_gen")
     _reply(("ready",))  # boot handshake: the pool gates growth/rebalance on it
 
     def _pipe_reader() -> None:
-        """Drains the pipe so `cancel` is honored even while a task blocks:
-        a cancel for a STILL-QUEUED task removes it here and answers
+        """Drains the pipe so `cancel`/`ack` are honored even while a task
+        blocks: a cancel for a STILL-QUEUED task removes it here and answers
         `skipped` immediately (the executor may be wedged in a nested get —
-        it can never be relied on to process the yank)."""
+        it can never be relied on to process the yank); acks feed streaming
+        generators' consumed-count backpressure."""
         while True:
             try:
                 msg = conn.recv_bytes()
@@ -215,17 +227,23 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 # seeing badreq (futures fail as WorkerCrashedError and retry).
                 _reply(("badreq", None))
                 continue
+            if req[0] == "ack":
+                with pend_cv:
+                    gen_consumed[req[1]] = max(gen_consumed.get(req[1], 0), req[2])
+                    pend_cv.notify_all()
+                continue
             if req[0] == "cancel":
                 seq = req[1]
                 removed = False
                 with pend_cv:
                     for i, r in enumerate(pending):
-                        if r[0] == "run" and r[1] == seq:
+                        if r[0] in _SEQ_TAGGED and r[1] == seq:
                             del pending[i]
                             removed = True
                             break
                     if not removed:
                         cancelled.add(seq)
+                        pend_cv.notify_all()  # wake a paused generator
                 if removed:
                     _reply(("skipped", seq))
                 continue
@@ -235,26 +253,113 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
     threading.Thread(target=_pipe_reader, daemon=True, name="pipe-reader").start()
 
+    def _check_skip(seq: int) -> bool:
+        with pend_cv:
+            if seq in cancelled:
+                cancelled.discard(seq)
+                return True
+        return False
+
+    def _decode_call(args_blob):
+        args, kwargs = serialization.deserialize_from_bytes(args_blob)
+        return resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
+
+    def _item_oid(task_bin: bytes, index: int) -> bytes:
+        from ray_tpu._private.ids import ObjectID, TaskID
+
+        return ObjectID.for_task_return(TaskID(task_bin), index + 1).binary()
+
+    def _stream_out(seq: int, task_bin: bytes, gen, backpressure: int) -> None:
+        """Drive a (sync) generator, shipping each item as an `item` reply.
+        Consumed-count backpressure: pause while produced - acked >= window
+        (reference: generator_waiter.h:58 TotalNumObjectConsumed wait)."""
+        index = 0
+        for item in gen:
+            status, payload, extra = _result_payload(
+                item, _item_oid(task_bin, index) if task_bin else None
+            )
+            _reply(("item", seq, index, status, payload, extra))
+            index += 1
+            if backpressure > 0:
+                with pend_cv:
+                    while (seq not in cancelled
+                           and index - gen_consumed.get(seq, 0) >= backpressure):
+                        pend_cv.wait(0.5)
+            with pend_cv:
+                was_cancelled = seq in cancelled
+                cancelled.discard(seq)
+            if was_cancelled:
+                # user code (finally blocks) runs OUTSIDE the worker lock:
+                # the pipe reader must keep serving other streams' acks
+                gen.close()
+                raise TaskCancelledError("stream cancelled")
+        _reply(("done", seq, "gen_end", index, None))
+
+    async def _astream_out(seq: int, task_bin: bytes, agen, backpressure: int) -> None:
+        """Async-generator variant of _stream_out (runs on the actor loop)."""
+        import asyncio
+
+        index = 0
+        async for item in agen:
+            status, payload, extra = _result_payload(
+                item, _item_oid(task_bin, index) if task_bin else None
+            )
+            _reply(("item", seq, index, status, payload, extra))
+            index += 1
+            while True:
+                with pend_cv:  # never await under this lock: aclose()/sleep
+                    was_cancelled = seq in cancelled  # happen outside so the
+                    cancelled.discard(seq)            # loop + reader can't freeze
+                    window_open = (backpressure <= 0
+                                   or index - gen_consumed.get(seq, 0) < backpressure)
+                if was_cancelled:
+                    await agen.aclose()
+                    raise TaskCancelledError("stream cancelled")
+                if window_open:
+                    break
+                await asyncio.sleep(0.02)
+        _reply(("done", seq, "gen_end", index, None))
+
     # Dedicated-actor mode: ("actor_init", cls_blob, args_blob, renv)
     # instantiates the user class IN THIS PROCESS (runtime_env applied for the
-    # actor's lifetime); subsequent ("actor_call", method, args_blob, oid_bin)
-    # invoke methods on the held instance (reference: actors live in their own
-    # worker process, task_receiver.cc).
+    # actor's lifetime); subsequent calls invoke methods on the held instance
+    # (reference: actors live in their own worker process, task_receiver.cc).
+    # Async actor methods run CONCURRENTLY on a dedicated asyncio loop thread —
+    # seq-tagged `actor_call2` replies arrive out of order as calls finish.
     actor_instance = None
     actor_env_stack = None  # noqa: F841 - held so the env outlives __init__
+    actor_loop = None
+
+    def _ensure_loop():
+        import asyncio
+
+        nonlocal actor_loop
+        if actor_loop is None:
+            actor_loop = asyncio.new_event_loop()
+            threading.Thread(
+                target=actor_loop.run_forever, daemon=True, name="actor-loop"
+            ).start()
+        return actor_loop
+
+    def _finish_call(seq: int, result, oid_bin) -> None:
+        try:
+            status, payload, extra = _result_payload(result, oid_bin)
+        except BaseException as e:  # noqa: BLE001
+            status, payload, extra = _error_payload(e)
+        _reply(("done", seq, status, payload, extra))
 
     while True:
         with pend_cv:
             while not pending:
                 pend_cv.wait()
             req = pending.popleft()
-        if req[0] == "exit":
+        kind = req[0]
+        if kind == "exit":
             os._exit(0)
-        if req[0] == "actor_init":
+        if kind == "actor_init":
             try:
                 cls = cloudpickle.loads(req[1])
-                args, kwargs = serialization.deserialize_from_bytes(req[2])
-                args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
+                args, kwargs = _decode_call(req[2])
                 renv = req[3] if len(req) > 3 else None
                 if renv:
                     import contextlib
@@ -270,35 +375,123 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             except BaseException as e:  # noqa: BLE001
                 _reply(_error_payload(e))
             continue
-        if req[0] == "actor_call":
+        if kind == "actor_call":  # legacy sync request/reply form
             _, method_name, args_blob, oid_bin = req
             try:
                 if actor_instance is None:
                     raise RuntimeError("actor_call before actor_init")
                 method = getattr(actor_instance, method_name)
-                args, kwargs = serialization.deserialize_from_bytes(args_blob)
-                args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
+                args, kwargs = _decode_call(args_blob)
                 _reply(_result_payload(method(*args, **kwargs), oid_bin))
             except BaseException as e:  # noqa: BLE001
                 _reply(_error_payload(e))
             continue
+        if kind == "actor_call2":
+            # ("actor_call2", seq, method, args_blob, oid_bin)
+            _, seq, method_name, args_blob, oid_bin = req
+            if _check_skip(seq):
+                _reply(("skipped", seq))
+                continue
+            _reply(("start", seq))
+            try:
+                if actor_instance is None:
+                    raise RuntimeError("actor_call before actor_init")
+                method = getattr(actor_instance, method_name)
+                args, kwargs = _decode_call(args_blob)
+                import inspect as _inspect
+
+                if _inspect.iscoroutinefunction(method):
+                    # concurrent: executor moves on; the loop replies on finish
+                    async def _run_async(m=method, a=args, kw=kwargs, s=seq, ob=oid_bin):
+                        try:
+                            result = await m(*a, **kw)
+                        except BaseException as e:  # noqa: BLE001
+                            status, payload, extra = _error_payload(e)
+                            _reply(("done", s, status, payload, extra))
+                            return
+                        _finish_call(s, result, ob)
+
+                    import asyncio
+
+                    asyncio.run_coroutine_threadsafe(_run_async(), _ensure_loop())
+                else:
+                    _finish_call(seq, method(*args, **kwargs), oid_bin)
+            except BaseException as e:  # noqa: BLE001
+                status, payload, extra = _error_payload(e)
+                _reply(("done", seq, status, payload, extra))
+            continue
+        if kind == "actor_gen":
+            # ("actor_gen", seq, method, args_blob, task_bin, backpressure)
+            _, seq, method_name, args_blob, task_bin, bp = req
+            if _check_skip(seq):
+                _reply(("skipped", seq))
+                continue
+            _reply(("start", seq))
+            try:
+                if actor_instance is None:
+                    raise RuntimeError("actor_gen before actor_init")
+                method = getattr(actor_instance, method_name)
+                args, kwargs = _decode_call(args_blob)
+                import inspect as _inspect
+
+                if _inspect.isasyncgenfunction(method):
+                    async def _run_agen(m=method, a=args, kw=kwargs, s=seq,
+                                        tb=task_bin, b=bp):
+                        try:
+                            await _astream_out(s, tb, m(*a, **kw), b)
+                        except BaseException as e:  # noqa: BLE001
+                            status, payload, extra = _error_payload(e)
+                            _reply(("done", s, status, payload, extra))
+                        finally:
+                            # cleaned on the LOOP at stream end — the executor
+                            # popping it early would reset live backpressure
+                            # counts and leak re-added entries
+                            with pend_cv:
+                                gen_consumed.pop(s, None)
+
+                    import asyncio
+
+                    asyncio.run_coroutine_threadsafe(_run_agen(), _ensure_loop())
+                else:
+                    try:
+                        _stream_out(seq, task_bin, method(*args, **kwargs), bp)
+                    finally:
+                        with pend_cv:
+                            gen_consumed.pop(seq, None)
+            except BaseException as e:  # noqa: BLE001
+                status, payload, extra = _error_payload(e)
+                _reply(("done", seq, status, payload, extra))
+            continue
+        if kind == "run_gen":
+            # ("run_gen", seq, task_bin, fn_blob, args_blob, backpressure)
+            _, seq, task_bin, fn_blob, args_blob, bp = req
+            if _check_skip(seq):
+                _reply(("skipped", seq))
+                continue
+            _reply(("start", seq))
+            _set_current_task(task_bin)
+            try:
+                fn = cloudpickle.loads(fn_blob)
+                args, kwargs = _decode_call(args_blob)
+                _stream_out(seq, task_bin, fn(*args, **kwargs), bp)
+            except BaseException as e:  # noqa: BLE001
+                status, payload, extra = _error_payload(e)
+                _reply(("done", seq, status, payload, extra))
+            finally:
+                _set_current_task(None)
+                with pend_cv:
+                    gen_consumed.pop(seq, None)
+            continue
         # ("run", seq, oid_bin, fn_blob, args_blob, task_bin)
         _, seq, oid_bin, fn_blob, args_blob, task_bin = req[:6]
-        with pend_cv:
-            if seq in cancelled:
-                cancelled.discard(seq)
-                skip = True
-            else:
-                skip = False
-        if skip:
+        if _check_skip(seq):
             _reply(("skipped", seq))
             continue
         _reply(("start", seq))
         _set_current_task(task_bin)
         try:
             fn = cloudpickle.loads(fn_blob)
-            args, kwargs = serialization.deserialize_from_bytes(args_blob)
-            args, kwargs = resolve_shm_args(args, kwargs, store, fetch=_client_fetch)
+            args, kwargs = _decode_call(args_blob)
             status, payload, extra = _result_payload(fn(*args, **kwargs), oid_bin)
         except BaseException as e:  # noqa: BLE001
             status, payload, extra = _error_payload(e)
@@ -309,12 +502,17 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
 class _Inflight:
     """One submitted task: its future, the marshalled request (kept so a
-    `skipped` reply can resubmit it verbatim elsewhere), and flags."""
+    `skipped` reply can resubmit it verbatim elsewhere), and flags.
+
+    kind: "run" (plain task) or "gen" (streaming generator — `item` replies
+    stream through on_item before the terminal `done`)."""
 
     __slots__ = ("future", "oid_bin", "fn_blob", "args_blob", "task_bin",
-                 "started", "cancel_sent", "worker", "submit_ts", "user_cancelled")
+                 "started", "cancel_sent", "worker", "submit_ts", "user_cancelled",
+                 "kind", "on_item", "backpressure", "seq")
 
-    def __init__(self, fn_blob, args_blob, oid_bin, task_bin):
+    def __init__(self, fn_blob, args_blob, oid_bin, task_bin, kind="run",
+                 on_item=None, backpressure=0):
         self.future: Future = Future()
         self.fn_blob = fn_blob
         self.args_blob = args_blob
@@ -325,6 +523,20 @@ class _Inflight:
         self.worker: "_Worker | None" = None
         self.submit_ts = 0.0
         self.user_cancelled = False  # skipped -> cancelled, not resubmitted
+        self.kind = kind
+        self.on_item = on_item      # gen: callback(index, status, payload, extra)
+        self.backpressure = backpressure
+        self.seq: int | None = None
+
+    def ack(self, consumed: int) -> None:
+        """Tell the producing worker the consumer has read `consumed` items
+        (releases the generator's backpressure window)."""
+        w, seq = self.worker, self.seq
+        if w is not None and seq is not None and not self.future.done():
+            try:
+                w.send_frame(("ack", seq, consumed))
+            except (BrokenPipeError, OSError):
+                pass
 
 
 @dataclass
@@ -386,16 +598,48 @@ def spawn_worker_process(shm_name, shm_size, head_addr, token, log_base=None):
     return proc, Connection(parent_s.detach())
 
 
+class _ActorCall:
+    """One in-flight dedicated-actor call (seq-matched by the reader)."""
+
+    __slots__ = ("future", "on_item", "worker", "seq")
+
+    def __init__(self, on_item=None):
+        self.future: Future = Future()
+        self.on_item = on_item
+        self.worker = None
+        self.seq: int | None = None
+
+    def ack(self, consumed: int) -> None:
+        w = self.worker
+        if w is not None and self.seq is not None and not self.future.done():
+            try:
+                w._send(("ack", self.seq, consumed))
+            except (BrokenPipeError, OSError):
+                pass
+
+
 class DedicatedActorWorker:
     """One exec'd process hosting one actor instance (reference: every actor
-    lives in its own worker process; task_receiver.cc execution)."""
+    lives in its own worker process; task_receiver.cc execution).
+
+    Calls are seq-tagged (`actor_call2`/`actor_gen`) with a parent reader
+    matching replies — async actor methods execute CONCURRENTLY on the
+    worker's asyncio loop and reply out of order; generator methods stream
+    `item` replies with consumed-count backpressure."""
 
     def __init__(self, shm_name=None, shm_size=0, head_addr=None, token=None,
                  log_base=None):
         self.proc, self.conn = spawn_worker_process(
             shm_name, shm_size, head_addr, token, log_base
         )
-        self._lock = threading.Lock()
+        self._send_mu = threading.Lock()
+        self._mu = threading.Lock()
+        self._calls: dict[int, _ActorCall] = {}
+        self._init_fut: Future | None = None
+        self._seq = 0
+        self._dead = False
+        threading.Thread(target=self._reader, daemon=True,
+                         name=f"actor-reader-{self.proc.pid}").start()
 
     @property
     def pid(self) -> int:
@@ -404,33 +648,126 @@ class DedicatedActorWorker:
     def is_alive(self) -> bool:
         return self.proc.poll() is None
 
-    def _roundtrip(self, req: tuple):
-        with self._lock:
+    def _send(self, payload) -> None:
+        blob = cloudpickle.dumps(payload)
+        with self._send_mu:
+            self.conn.send_bytes(blob)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._mu:
+            self._dead = True
+            calls, self._calls = list(self._calls.values()), {}
+            init_fut, self._init_fut = self._init_fut, None
+        for c in calls:
+            if not c.future.done():
+                c.future.set_exception(exc)
+        if init_fut is not None and not init_fut.done():
+            init_fut.set_exception(exc)
+
+    def _reader(self) -> None:
+        while True:
             try:
-                self.conn.send_bytes(cloudpickle.dumps(req))
-                while True:
-                    resp = cloudpickle.loads(self.conn.recv_bytes())
-                    if resp[0] != "ready":  # skip the boot handshake
-                        break
-            except (EOFError, OSError, BrokenPipeError) as e:
-                raise WorkerCrashedError(
-                    f"actor worker process died ({type(e).__name__})"
-                ) from e
-        if resp[0] == "badreq":
-            # protocol desync: the worker couldn't decode our frame — its
-            # stream is untrustworthy; kill so actor-restart machinery runs
-            self.kill()
-            raise WorkerCrashedError("actor worker protocol desync (badreq)")
-        status, payload, extra = resp
-        if status == "err":
-            raise _RemoteTaskError(payload, exc_blob=extra)
-        return status, payload, extra
+                resp = cloudpickle.loads(self.conn.recv_bytes())
+            except (EOFError, OSError, BrokenPipeError, TypeError, ValueError) as e:
+                # TypeError/ValueError: connection closed under us (teardown)
+                self._fail_all(WorkerCrashedError(
+                    f"actor worker process died ({type(e).__name__})"))
+                return
+            except Exception:
+                resp = ("badreq", None)
+            tag = resp[0]
+            if tag == "ready" or tag == "start":
+                continue
+            if tag == "badreq":
+                # protocol desync: untrustworthy stream — kill so the
+                # actor-restart machinery runs
+                self.kill()
+                self._fail_all(WorkerCrashedError(
+                    "actor worker protocol desync (badreq)"))
+                return
+            if tag == "item":
+                seq, index, status, payload, extra = resp[1:6]
+                with self._mu:
+                    call = self._calls.get(seq)
+                if call is not None and call.on_item is not None:
+                    try:
+                        call.on_item(index, status, payload, extra)
+                    except Exception as e:
+                        with self._mu:
+                            self._calls.pop(seq, None)
+                        try:
+                            self._send(("cancel", seq))
+                        except (BrokenPipeError, OSError):
+                            pass
+                        if not call.future.done():
+                            call.future.set_exception(e)
+                continue
+            if tag == "done" or tag == "skipped":
+                if tag == "skipped":
+                    with self._mu:
+                        call = self._calls.pop(resp[1], None)
+                    if call is not None and not call.future.done():
+                        call.future.set_exception(TaskCancelledError("cancelled"))
+                    continue
+                seq, status, payload, extra = resp[1], resp[2], resp[3], resp[4]
+                with self._mu:
+                    call = self._calls.pop(seq, None)
+                if call is None:
+                    continue
+                if status == "err":
+                    call.future.set_exception(
+                        _RemoteTaskError(payload, exc_blob=extra))
+                else:
+                    call.future.set_result((status, payload, extra))
+                continue
+            # unnumbered 3-tuple: actor_init reply
+            if self._init_fut is not None:
+                status, payload, extra = resp
+                fut, self._init_fut = self._init_fut, None
+                if status == "err":
+                    fut.set_exception(_RemoteTaskError(payload, exc_blob=extra))
+                else:
+                    fut.set_result(None)
 
     def init_actor(self, cls, args_blob: bytes, runtime_env: dict | None = None) -> None:
-        self._roundtrip(("actor_init", cloudpickle.dumps(cls), args_blob, runtime_env))
+        with self._mu:
+            if self._dead:
+                raise WorkerCrashedError("actor worker process died")
+            fut = self._init_fut = Future()
+        try:
+            self._send(("actor_init", cloudpickle.dumps(cls), args_blob, runtime_env))
+        except (BrokenPipeError, OSError) as e:
+            raise WorkerCrashedError("actor worker process died") from e
+        fut.result()
+
+    def submit_call(self, method_name: str, args_blob: bytes,
+                    oid_bin: bytes | None, on_item=None, task_bin: bytes | None = None,
+                    backpressure: int = 0) -> _ActorCall:
+        """Non-blocking seq-tagged call; generator methods pass on_item."""
+        call = _ActorCall(on_item=on_item)
+        with self._mu:
+            if self._dead:
+                raise WorkerCrashedError("actor worker process died")
+            seq = self._seq
+            self._seq += 1
+            self._calls[seq] = call
+            call.worker = self
+            call.seq = seq
+        if on_item is not None:
+            frame = ("actor_gen", seq, method_name, args_blob, task_bin, backpressure)
+        else:
+            frame = ("actor_call2", seq, method_name, args_blob, oid_bin)
+        try:
+            self._send(frame)
+        except (BrokenPipeError, OSError) as e:
+            with self._mu:
+                self._calls.pop(seq, None)
+            raise WorkerCrashedError("actor worker process died") from e
+        return call
 
     def call(self, method_name: str, args_blob: bytes, oid_bin: bytes | None):
-        return self._roundtrip(("actor_call", method_name, args_blob, oid_bin))
+        """Blocking form; raises the remote error / WorkerCrashedError."""
+        return self.submit_call(method_name, args_blob, oid_bin).future.result()
 
     def kill(self) -> None:
         try:
@@ -440,7 +777,7 @@ class DedicatedActorWorker:
 
     def shutdown(self) -> None:
         try:
-            self.conn.send_bytes(cloudpickle.dumps(("exit",)))
+            self._send(("exit",))
         except Exception:
             pass
         try:
@@ -606,7 +943,8 @@ class ProcessWorkerPool:
         while True:
             try:
                 msg = w.conn.recv_bytes()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError, ValueError):
+                # TypeError/ValueError: connection closed under us (teardown)
                 self._on_worker_death(w)
                 return
             try:
@@ -614,7 +952,7 @@ class ProcessWorkerPool:
             except Exception:
                 resp = ("badreq", None)
             tag = resp[0]
-            if tag == "badreq" or tag not in ("ready", "start", "done", "skipped"):
+            if tag == "badreq" or tag not in ("ready", "start", "done", "skipped", "item"):
                 # Protocol desync (undecodable frame on either side): this
                 # worker's stream can no longer be trusted — kill it; the
                 # EOF path fails its in-flight futures as WorkerCrashedError
@@ -635,6 +973,28 @@ class ProcessWorkerPool:
                     if inf is not None:
                         inf.started = True
                         self._running_tasks[w.proc.pid] = (inf.task_bin, time.monotonic())
+            elif tag == "item":
+                # streaming generator item: deliver without completing
+                seq, index, status, payload, extra = resp[1:6]
+                with self._lock:
+                    inf = w.inflight.get(seq)
+                    if inf is not None:
+                        w.last_done_ts = time.monotonic()  # progress signal
+                if inf is not None and inf.on_item is not None:
+                    try:
+                        inf.on_item(index, status, payload, extra)
+                    except Exception as e:
+                        # a dropped item would silently shift every later
+                        # index — abort the stream instead (consumer sees the
+                        # error; retries replay from the start)
+                        with self._cv:
+                            w.inflight.pop(seq, None)
+                        try:
+                            w.send_frame(("cancel", seq))
+                        except (BrokenPipeError, OSError):
+                            pass
+                        if not inf.future.done():
+                            inf.future.set_exception(e)
             elif tag == "done":
                 seq, status, payload, extra = resp[1], resp[2], resp[3], resp[4]
                 with self._cv:
@@ -728,8 +1088,14 @@ class ProcessWorkerPool:
             inf.started = False
             inf.cancel_sent = False
             inf.submit_ts = time.monotonic()
+        inf.seq = seq
+        if inf.kind == "gen":
+            frame = ("run_gen", seq, inf.task_bin, inf.fn_blob, inf.args_blob,
+                     inf.backpressure)
+        else:
+            frame = ("run", seq, inf.oid_bin, inf.fn_blob, inf.args_blob, inf.task_bin)
         try:
-            w.send_frame(("run", seq, inf.oid_bin, inf.fn_blob, inf.args_blob, inf.task_bin))
+            w.send_frame(frame)
         except (BrokenPipeError, OSError):
             self._on_worker_death(w)
 
@@ -741,6 +1107,19 @@ class ProcessWorkerPool:
         inf = _Inflight(fn_blob, args_blob, result_oid_bin, task_bin)
         self._submit_inflight(inf)
         return inf.future
+
+    def submit_generator(self, fn_blob: bytes, args_blob: bytes,
+                         task_bin: bytes, on_item,
+                         backpressure: int = 0) -> _Inflight:
+        """Run a streaming-generator task in a worker: on_item(index, status,
+        payload, extra) fires per yield (reader thread); the returned handle's
+        .future resolves to ("gen_end", count, None) at exhaustion, and
+        .ack(consumed) releases the backpressure window (reference: streaming
+        generators + generator_waiter.h consumed-count flow control)."""
+        inf = _Inflight(fn_blob, args_blob, None, task_bin, kind="gen",
+                        on_item=on_item, backpressure=backpressure)
+        self._submit_inflight(inf)
+        return inf
 
     def execute(self, fn: Callable, args: tuple, kwargs: dict,
                 result_oid_bin: bytes | None = None, timeout: float | None = None,
@@ -827,6 +1206,14 @@ class ProcessWorkerPool:
                                 except OSError:
                                     return False
                                 return True
+                            if inf.kind == "gen":
+                                # a RUNNING stream polls the cancelled set per
+                                # item — a cancel frame aborts it cleanly
+                                inf.user_cancelled = True
+                                if not inf.cancel_sent:
+                                    inf.cancel_sent = True
+                                    target, seq_to_cancel = w, seq
+                                break
                             return False
                         inf.user_cancelled = True
                         if not inf.cancel_sent:
@@ -905,11 +1292,22 @@ def _run_with_env(fn, runtime_env, *args, **kwargs):
         return fn(*args, **kwargs)
 
 
-def wrap_with_runtime_env(fn, runtime_env: dict):
+def _run_with_env_gen(fn, runtime_env, *args, **kwargs):
+    # generator form: the context must stay LIVE across iteration — a plain
+    # `return fn(...)` would tear the env down before the first yield runs
+    from ray_tpu import runtime_env as renv
+
+    ctx = renv.build_context(runtime_env)
+    with renv.apply_context(ctx):
+        yield from fn(*args, **kwargs)
+
+
+def wrap_with_runtime_env(fn, runtime_env: dict, is_generator: bool = False):
     """Picklable wrapper: builds+applies the env inside the worker process."""
     import functools
 
-    return functools.partial(_run_with_env, fn, runtime_env)
+    runner = _run_with_env_gen if is_generator else _run_with_env
+    return functools.partial(runner, fn, runtime_env)
 
 
 class _RemoteTaskError(Exception):

@@ -131,6 +131,9 @@ class _StreamState:
     done: bool = False
     error: BaseException | None = None
     cv: threading.Condition = field(default_factory=threading.Condition)
+    # producing-worker handle while a process worker streams this generator:
+    # consumer progress acks flow back through it (backpressure release)
+    gen_handle: Any = None
 
 
 class _ActorState:
@@ -719,8 +722,12 @@ class Runtime:
                 self._execute_actor_creation(spec)
                 return  # actor holds its lease until death
             if isinstance(spec.num_returns, str):
-                args, kwargs = self._resolve_args(spec)
-                self._execute_generator(entry, args, kwargs)
+                if (self._use_process_execution(spec)
+                        and self._agents.get(entry.node_id) is None):
+                    self._execute_generator_process(entry)
+                else:
+                    args, kwargs = self._resolve_args(spec)
+                    self._execute_generator(entry, args, kwargs)
             elif self._use_process_execution(spec):
                 agent = self._agents.get(entry.node_id)
                 from ray_tpu.util import tracing
@@ -1046,7 +1053,10 @@ class Runtime:
             # reference's per-worker runtime_env model)
             from ray_tpu.core.process_pool import wrap_with_runtime_env
 
-            fn = wrap_with_runtime_env(fn, spec.runtime_env)
+            fn = wrap_with_runtime_env(
+                fn, spec.runtime_env,
+                is_generator=isinstance(spec.num_returns, str),
+            )
             return cloudpickle.dumps(fn), self._marshal_args(spec)
         # Pickle each function ONCE (the reference exports a function to the
         # GCS function table a single time, not per task — function_manager).
@@ -1288,6 +1298,72 @@ class Runtime:
             stream.cv.notify_all()
         self.memory_store.put(stream_id, RayObject(value=index, size=8))
 
+    def _store_stream_item(self, spec: TaskSpec, stream, index: int,
+                           status: str, payload, extra) -> None:
+        """Reader-thread callback: land one generator item (shm-sealed by the
+        worker, or inline) and publish it to the stream."""
+        item_id = ObjectID.for_task_return(spec.task_id, index + 1)
+        if status == "shm":
+            self.shm_store.pin(item_id)
+            if self.spill is not None:
+                self.spill.on_put(item_id, extra or 0)
+            self.memory_store.put(item_id, RayObject(size=extra or 0, in_shm=True))
+        else:
+            self._store_value(item_id, serialization.deserialize_from_bytes(payload))
+        self._add_lineage(item_id, spec)
+        with stream.cv:
+            stream.items.append(item_id)
+            stream.cv.notify_all()
+
+    def _execute_generator_process(self, entry: _TaskEntry) -> None:
+        """Streaming-generator task on an OS worker: items stream back over
+        the worker pipe (consumed-count backpressure) and land in the node
+        store / memory store as they arrive — the reference's streaming
+        generator protocol (task_manager HandleReportGeneratorItemReturns),
+        which works in every worker process, not just in-thread."""
+        from ray_tpu.core.process_pool import _RemoteTaskError
+
+        spec = entry.spec
+        if entry.cancelled:
+            raise TaskCancelledError(spec.desc())
+        self._maybe_inject_chaos(spec)
+        stream_id = spec.return_ids()[0]
+        stream = self._streams[stream_id]
+        with stream.cv:
+            # A retry replays the stream from the start (reference: streaming
+            # generator retry semantics) — clear any partial previous attempt.
+            stream.items.clear()
+            stream.done = False
+            stream.error = None
+            stream.cv.notify_all()
+        try:
+            fn_blob, args_blob = self._task_blobs(spec)
+        except Exception:
+            # Not serializable: run the generator in-thread instead.
+            args, kwargs = self._resolve_args(spec)
+            self._execute_generator(entry, args, kwargs)
+            return
+        handle = self._process_pool().submit_generator(
+            fn_blob, args_blob, spec.task_id.binary(),
+            on_item=lambda i, st, p, e: self._store_stream_item(spec, stream, i, st, p, e),
+            backpressure=self.config.generator_backpressure_num_objects,
+        )
+        stream.gen_handle = handle
+        try:
+            status, count, _ = handle.future.result()
+        except _RemoteTaskError as e:
+            orig = e.original_exception()
+            if orig is not None:
+                orig.__ray_tpu_remote_tb__ = e.remote_tb
+                raise orig from None
+            raise RuntimeError(e.remote_tb) from None
+        finally:
+            stream.gen_handle = None
+        with stream.cv:
+            stream.done = True
+            stream.cv.notify_all()
+        self.memory_store.put(stream_id, RayObject(value=count, size=8))
+
     def next_stream_item(self, stream_id: ObjectID, index: int) -> ObjectRef | None:
         stream = self._streams.get(stream_id)
         if stream is None:
@@ -1295,6 +1371,10 @@ class Runtime:
         with stream.cv:
             while True:
                 if index < len(stream.items):
+                    handle = stream.gen_handle
+                    if handle is not None:
+                        # consumer progressed: release the producer's window
+                        handle.ack(index + 1)
                     return ObjectRef(stream.items[index], self)
                 if stream.done:
                     if stream.error is not None and index == len(stream.items):
@@ -1316,17 +1396,17 @@ class Runtime:
             return
         entry.cancelled = True
         if entry.state == "RUNNING":
+            # Reach the pool in every case: queued tasks are yanked, running
+            # STREAMS abort at the next item (they poll the cancel set), and
+            # force kills the worker. No-op if the task isn't pool-executed.
+            pool = getattr(self, "_proc_pool", None)
+            if pool is not None:
+                try:
+                    pool.cancel_task(entry.spec.task_id.binary(), force)
+                except Exception:
+                    pass
             if entry.thread is not None and force:
                 _async_raise(entry.thread, TaskCancelledError)
-            elif entry.thread is None:
-                # Async-dispatched process task: yank it from the pool (queued
-                # tasks cancel cleanly; running tasks need force -> worker kill).
-                pool = getattr(self, "_proc_pool", None)
-                if pool is not None:
-                    try:
-                        pool.cancel_task(entry.spec.task_id.binary(), force)
-                    except Exception:
-                        pass
         if entry.state == "PENDING":
             self._finish_cancelled(entry)
 
@@ -1403,15 +1483,13 @@ class Runtime:
             if state.options.get("isolate_process"):
                 # Dedicated OS worker process hosting the actor (reference:
                 # every actor is its own worker process). Serialized init args
-                # travel with ShmArg markers like process tasks.
-                if state.is_async:
-                    raise NotImplementedError(
-                        "async actors are not supported with isolate_process yet"
-                    )
-                if state.max_concurrency > 1:
+                # travel with ShmArg markers like process tasks. Async actors
+                # run their methods on an asyncio loop INSIDE the worker
+                # (concurrent, out-of-order seq-tagged replies).
+                if state.max_concurrency > 1 and not state.is_async:
                     logger.warning(
                         "isolate_process actor %s: max_concurrency=%d downgraded "
-                        "to 1 (method calls serialize on the actor's process)",
+                        "to 1 (sync method calls serialize on the actor's process)",
                         state.cls.__name__, state.max_concurrency,
                     )
                 self._spawn_proc_actor(state, spec)  # marshals raw refs itself
@@ -1436,7 +1514,10 @@ class Runtime:
         self._publish_actor_event(state)
         self._store_value(spec.return_ids()[0], None)  # creation done marker
         if state.proc_worker is not None:
-            groups = {"_default": 1}  # process actors serialize on their worker
+            # sync process actors serialize on their worker; ASYNC process
+            # actors overlap max_concurrency calls on the worker's asyncio loop
+            n = max(1, state.max_concurrency) if state.is_async else 1
+            groups = {"_default": n}
         else:
             groups = {"_default": max(1, state.max_concurrency)}
             for gname, limit in state.concurrency_groups.items():
@@ -1626,6 +1707,42 @@ class Runtime:
                     with state.lock:
                         state.pending_count -= 1
 
+    def _run_proc_actor_generator(self, spec: TaskSpec, proc_worker,
+                                  args_blob: bytes) -> None:
+        """Streaming-generator method on a dedicated actor process (sync or
+        async generator; the worker streams `item` replies). Raises on remote
+        failure so _run_proc_actor_task's retry/restart machinery applies."""
+        from ray_tpu.core.process_pool import _RemoteTaskError
+
+        stream_id = spec.return_ids()[0]
+        stream = self._streams[stream_id]
+        with stream.cv:
+            stream.items.clear()
+            stream.done = False
+            stream.error = None
+            stream.cv.notify_all()
+        call = proc_worker.submit_call(
+            spec.method_name, args_blob, None,
+            on_item=lambda i, st, p, e: self._store_stream_item(spec, stream, i, st, p, e),
+            task_bin=spec.task_id.binary(),
+            backpressure=self.config.generator_backpressure_num_objects,
+        )
+        stream.gen_handle = call
+        try:
+            _, count, _ = call.future.result()
+        except _RemoteTaskError as e:
+            orig = e.original_exception()
+            if orig is not None:
+                orig.__ray_tpu_remote_tb__ = e.remote_tb
+                raise orig from None
+            raise RuntimeError(e.remote_tb) from None
+        finally:
+            stream.gen_handle = None
+        with stream.cv:
+            stream.done = True
+            stream.cv.notify_all()
+        self.memory_store.put(stream_id, RayObject(value=count, size=8))
+
     def _run_proc_actor_task(self, state: _ActorState, spec: TaskSpec, entry,
                              proc_worker) -> bool:
         """One actor task on the dedicated worker process. Returns True if the
@@ -1648,21 +1765,18 @@ class Runtime:
             state.mailbox.put((spec, rids[0]))
             return True
 
-        if isinstance(spec.num_returns, str):
-            # streaming/dynamic generator methods need the in-process stream
-            # machinery; reject clearly rather than failing on pickling
-            self._store_error(spec, TaskError(NotImplementedError(
-                "streaming generator methods are not supported on "
-                "isolate_process actors yet"), spec.desc()))
-            _finish("FAILED")
-            return False
         try:
             self._maybe_inject_chaos(spec)
             args_blob = self._marshal_args(spec)
-            status, payload, size = proc_worker.call(
-                spec.method_name, args_blob, oid_bin
-            )
-            self._store_worker_result(spec, rids, status, payload, size)
+            if isinstance(spec.num_returns, str):
+                # streaming/dynamic generator method: items stream back from
+                # the dedicated worker with consumed-count backpressure
+                self._run_proc_actor_generator(spec, proc_worker, args_blob)
+            else:
+                status, payload, size = proc_worker.call(
+                    spec.method_name, args_blob, oid_bin
+                )
+                self._store_worker_result(spec, rids, status, payload, size)
             _finish("FINISHED")
             return False
         except WorkerCrashedError:

@@ -1,0 +1,147 @@
+"""Streaming generators + async actors ON PROCESS WORKERS (round-2 verdict
+item: the default execution mode must run the generator/async patterns Serve
+and Data rely on — reference: streaming-generator machinery works in every
+worker, python/ray/_raylet.pyx:890; async actors run an asyncio loop in their
+own worker process)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+
+def test_streaming_generator_process_task(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming", isolate_process=True)
+    def gen(n):
+        import os
+
+        for i in range(n):
+            yield (i * i, os.getpid())
+
+    import os
+
+    out = [ray_tpu.get(r) for r in gen.remote(5)]
+    assert [v for v, _ in out] == [0, 1, 4, 9, 16]
+    # really ran in another process
+    assert all(pid != os.getpid() for _, pid in out)
+
+
+def test_streaming_generator_large_items_via_shm(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming", isolate_process=True)
+    def gen():
+        for i in range(3):
+            yield np.full(200_000, i, dtype=np.float64)  # >100KB -> shm path
+
+    vals = [ray_tpu.get(r) for r in gen.remote()]
+    for i, v in enumerate(vals):
+        assert v.shape == (200_000,) and v[0] == i
+
+
+def test_streaming_generator_backpressure(ray_start_regular):
+    # many more items than the backpressure window; slow consumer — the
+    # producer must pause and resume (consumed-count flow control), and every
+    # item must arrive in order
+    @ray_tpu.remote(num_returns="streaming", isolate_process=True)
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    refs = gen.remote(200)
+    out = []
+    for k, r in enumerate(refs):
+        if k % 50 == 0:
+            time.sleep(0.05)
+        out.append(ray_tpu.get(r))
+    assert out == list(range(200))
+
+
+def test_streaming_generator_error_mid_stream(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming", isolate_process=True, max_retries=0)
+    def gen():
+        yield 1
+        yield 2
+        raise ValueError("boom")
+
+    it = iter(gen.remote())
+    assert ray_tpu.get(next(it)) == 1
+    assert ray_tpu.get(next(it)) == 2
+    with pytest.raises((TaskError, ValueError)):
+        for r in it:
+            ray_tpu.get(r)
+
+
+def test_async_actor_in_process(ray_start_regular):
+    @ray_tpu.remote(isolate_process=True, max_concurrency=4)
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.4)
+            return x * 2
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    import os
+
+    w = AsyncWorker.remote()
+    assert ray_tpu.get(w.pid.remote(), timeout=60) != os.getpid()
+    t0 = time.monotonic()
+    assert ray_tpu.get([w.work.remote(i) for i in range(4)], timeout=60) == [0, 2, 4, 6]
+    # 4 concurrent 0.4s awaits on the worker's loop: far less than 1.6s serial
+    assert time.monotonic() - t0 < 1.3
+
+
+def test_generator_method_on_process_actor(ray_start_regular):
+    @ray_tpu.remote(isolate_process=True)
+    class Streamer:
+        def __init__(self):
+            self.base = 10
+
+        def stream(self, n):
+            for i in range(n):
+                yield self.base + i
+
+    s = Streamer.options(num_returns="streaming")  # noqa: F841 (method-level below)
+    a = Streamer.remote()
+    refs = a.stream.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in refs] == [10, 11, 12, 13]
+
+
+def test_async_generator_method_on_process_actor(ray_start_regular):
+    @ray_tpu.remote(isolate_process=True)
+    class AStreamer:
+        async def stream(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * 3
+
+    a = AStreamer.remote()
+    refs = a.stream.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in refs] == [0, 3, 6, 9]
+
+
+def test_streaming_generator_retry_after_crash(ray_start_regular, tmp_path):
+    marker = str(tmp_path / "died")
+
+    @ray_tpu.remote(num_returns="streaming", isolate_process=True, max_retries=2)
+    def gen(marker):
+        import os
+
+        yield 1
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os.kill(os.getpid(), 9)
+        yield 2
+        yield 3
+
+    # the stream replays from the start after the worker crash
+    out = [ray_tpu.get(r) for r in gen.remote(marker)]
+    assert out == [1, 2, 3]
