@@ -207,9 +207,16 @@ def auto_attention(q, k, v, causal: bool = True):
 
     on_tpu = _jax.devices()[0].platform in ("tpu", "axon")
     if causal and on_tpu and S >= 1024:
+        import os
+
         from ray_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True, interpret=False)
+        # Tunable flash tile sizes so the perf sweep (scripts/tpu_sweep.py)
+        # can grid-search without code edits; defaults match the kernel's.
+        bq = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q", "128"))
+        bk = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K", "128"))
+        return flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk,
+                               interpret=False)
     return attention(q, k, v, causal=causal)
 
 

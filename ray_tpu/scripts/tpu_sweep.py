@@ -20,6 +20,8 @@ from ray_tpu.train import spmd
 
 
 def run(name, cfg, batch, seqlen, iters=15):
+    import os
+
     dev = jax.devices()[0]
     assert dev.platform != "cpu", dev
     mesh = Mesh(np.asarray([dev]).reshape(1, 1, 1, 1, 1),
@@ -42,7 +44,10 @@ def run(name, cfg, batch, seqlen, iters=15):
     n = llama.param_count_analytic(cfg)
     print(json.dumps({"config": name, "tokens_per_sec": round(tps, 1),
                       "mfu_6n": round(tps * 6 * n / 197e12, 4),
-                      "params_m": round(n / 1e6)}), flush=True)
+                      "params_m": round(n / 1e6),
+                      "flash_bq": os.environ.get("RAY_TPU_FLASH_BLOCK_Q", "128"),
+                      "flash_bk": os.environ.get("RAY_TPU_FLASH_BLOCK_K", "128")}),
+          flush=True)
 
 
 BASE = dict(vocab_size=32000, hidden_size=1024, intermediate_size=4096,
@@ -51,6 +56,16 @@ BASE = dict(vocab_size=32000, hidden_size=1024, intermediate_size=4096,
 BIG = dict(vocab_size=32000, hidden_size=2048, intermediate_size=8192,
            num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=2048,
            rope_theta=10000.0, dtype=jnp.bfloat16)
+
+# ~886M params: hidden 2048 × 16 layers — bigger matmuls, lower attention
+# fraction than BASE; still fits v5e HBM with adamw at bf16 moments.
+BIG16 = dict(vocab_size=32000, hidden_size=2048, intermediate_size=8192,
+             num_layers=16, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+             rope_theta=10000.0, dtype=jnp.bfloat16)
+# ~1.3B params: hidden 4096 × 6 layers — MXU-saturating 4096-wide matmuls.
+HUGE = dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_layers=6, num_heads=32, num_kv_heads=8, max_seq_len=2048,
+            rope_theta=10000.0, dtype=jnp.bfloat16)
 
 CONFIGS = {
     "A": ("A_full_bs8", llama.LlamaConfig(**BASE, remat=True), 8, 2048),
@@ -61,6 +76,13 @@ CONFIGS = {
     "F": ("F_dots_bs12", llama.LlamaConfig(**BASE, remat=True, remat_policy="dots"), 12, 2048),
     "G": ("G_dots_bs14", llama.LlamaConfig(**BASE, remat=True, remat_policy="dots"), 14, 2048),
     "H": ("H_noremat_bs8", llama.LlamaConfig(**BASE, remat=False), 8, 2048),
+    "I": ("I_big16_dots_bs8", llama.LlamaConfig(**BIG16, remat=True, remat_policy="dots"), 8, 2048),
+    "J": ("J_big16_dots_bs16", llama.LlamaConfig(**BIG16, remat=True, remat_policy="dots"), 16, 2048),
+    "K": ("K_big16_full_bs16", llama.LlamaConfig(**BIG16, remat=True), 16, 2048),
+    "L": ("L_huge_dots_bs8", llama.LlamaConfig(**HUGE, remat=True, remat_policy="dots"), 8, 2048),
+    "M": ("M_huge_full_bs8", llama.LlamaConfig(**HUGE, remat=True), 8, 2048),
+    "N": ("N_big_dots_bs16", llama.LlamaConfig(**BIG, remat=True, remat_policy="dots"), 16, 2048),
+    "O": ("O_big16_noremat_bs8", llama.LlamaConfig(**BIG16, remat=False), 8, 2048),
 }
 
 if __name__ == "__main__":

@@ -1,0 +1,216 @@
+"""Persistent TPU tunnel watcher + perf-sweep orchestrator (dev tool).
+
+The tunnel in this environment admits one client at a time and can wedge for
+hours after a killed client (rounds 2 and 3 both lost their live bench to it).
+This watcher turns tunnel acquisition into a background job for the whole
+round: probe cheaply from short-lived subprocesses, and the moment the tunnel
+admits, run the `tpu_sweep` configs one per process in priority order,
+appending raw results to SWEEP_r04.jsonl, regenerating SWEEP_r04.md, and
+refreshing BENCH_CACHE.json whenever a config beats the cached number.
+
+Resume-safe: configs already present in the JSONL are skipped, so the watcher
+can be restarted at any time. Exits 0 when every planned config has a result
+(or a recorded permanent failure, e.g. OOM).
+
+Usage: nohup python -m ray_tpu.scripts.tpu_watch &   (or a background shell)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+JSONL = os.path.join(REPO, "SWEEP_r04.jsonl")
+MD = os.path.join(REPO, "SWEEP_r04.md")
+CACHE = os.path.join(REPO, "BENCH_CACHE.json")
+
+# (plan key, tpu_sweep config letter, extra env). Priority order: most likely
+# winners first so a short tunnel window still improves the headline number.
+PLAN = [
+    ("D", "D", {}),                 # hidden 2048 x 12L, dots remat, bs8
+    ("N", "N", {}),                 # same model, bs16
+    ("I", "I", {}),                 # hidden 2048 x 16L (~886M), bs8
+    ("J", "J", {}),                 # same, bs16
+    ("L", "L", {}),                 # hidden 4096 x 6L (~1.3B), bs8
+    ("D_fb256", "D", {"RAY_TPU_FLASH_BLOCK_Q": "256", "RAY_TPU_FLASH_BLOCK_K": "256"}),
+    ("D_fb512k", "D", {"RAY_TPU_FLASH_BLOCK_Q": "256", "RAY_TPU_FLASH_BLOCK_K": "512"}),
+    ("M", "M", {}),                 # huge, full remat
+    ("E", "E", {}),                 # big, full remat, bs16
+    ("K", "K", {}),                 # big16, full remat, bs16
+    ("C", "C", {}),                 # round-2 family, bs16
+    ("O", "O", {}),                 # big16 no-remat
+    ("B", "B", {}),                 # round-2 winner re-measured (control)
+]
+
+PROBE_TIMEOUT = 150.0
+SWEEP_TIMEOUT = 1500.0
+IDLE_SLEEP = 240.0
+V5E_PEAK = 197e12
+TARGET_MFU = 0.40  # bench.py's vs_baseline denominator
+
+
+def log(msg: str) -> None:
+    sys.stderr.write(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}\n")
+    sys.stderr.flush()
+
+
+def probe() -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
+            env=dict(os.environ), cwd=REPO)
+        lines = (r.stdout or "").strip().splitlines()
+        plat = lines[-1] if lines else ""
+        return r.returncode == 0 and plat not in ("", "cpu")
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def done_keys() -> dict:
+    out = {}
+    try:
+        with open(JSONL) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                out[rec.get("plan_key")] = rec
+    except OSError:
+        pass
+    return out
+
+
+def append(rec: dict) -> None:
+    with open(JSONL, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def regen_md() -> None:
+    recs = list(done_keys().values())
+    ok = [r for r in recs if "tokens_per_sec" in r]
+    ok.sort(key=lambda r: -r["tokens_per_sec"])
+    lines = [
+        "# TPU perf sweep — round 4 (live, one config per process)",
+        "",
+        "| plan | config | flash bq/bk | params (M) | tokens/s/chip | MFU (6N) | vs 40%-MFU bar |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in ok:
+        lines.append(
+            f"| {r['plan_key']} | {r.get('config','?')} | {r.get('flash_bq','128')}/"
+            f"{r.get('flash_bk','128')} | {r.get('params_m','?')} | "
+            f"{r['tokens_per_sec']:.1f} | {r.get('mfu_6n', 0):.4f} | "
+            f"{r.get('mfu_6n', 0)/TARGET_MFU:.4f} |")
+    bad = [r for r in recs if "tokens_per_sec" not in r]
+    if bad:
+        lines += ["", "Failed configs:", ""]
+        for r in bad:
+            lines.append(f"- `{r['plan_key']}`: {r.get('error', 'unknown')}")
+    lines += ["", f"_Regenerated {time.strftime('%Y-%m-%dT%H:%M:%S')} by "
+              "`ray_tpu/scripts/tpu_watch.py`; raw lines in `SWEEP_r04.jsonl`._"]
+    with open(MD, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def update_cache(rec: dict) -> None:
+    vs = rec["mfu_6n"] / TARGET_MFU
+    try:
+        with open(CACHE) as f:
+            cur = json.load(f)
+        if cur.get("vs_baseline", 0) >= vs:
+            return
+    except (OSError, ValueError):
+        pass
+    commit = ""
+    try:
+        commit = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                                capture_output=True, text=True, timeout=10,
+                                cwd=REPO).stdout.strip()
+    except Exception:
+        pass
+    with open(CACHE, "w") as f:
+        json.dump({
+            "metric": "train_tokens_per_sec_per_chip_tpu",
+            "value": round(rec["tokens_per_sec"], 2),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(vs, 4),
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "git_commit": commit,
+            "note": f"tpu_sweep r4 plan {rec['plan_key']} (config {rec.get('config')}, "
+                    f"flash {rec.get('flash_bq')}/{rec.get('flash_bk')})",
+        }, f)
+    log(f"BENCH_CACHE updated: {rec['tokens_per_sec']:.1f} tok/s "
+        f"(vs_baseline {vs:.4f}) from {rec['plan_key']}")
+
+
+def run_config(plan_key: str, letter: str, extra_env: dict) -> bool:
+    """Run one sweep config; returns True if the tunnel still looks usable."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    log(f"running {plan_key} (config {letter}, env {extra_env or '{}'})")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.tpu_sweep", letter],
+            capture_output=True, text=True, timeout=SWEEP_TIMEOUT, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"{plan_key}: TIMED OUT after {SWEEP_TIMEOUT}s — tunnel likely wedged")
+        return False
+    out = (r.stdout or "").strip().splitlines()
+    rec = None
+    for line in out:
+        try:
+            cand = json.loads(line)
+            if "tokens_per_sec" in cand:
+                rec = cand
+        except ValueError:
+            continue
+    if rec is not None:
+        rec["plan_key"] = plan_key
+        rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        append(rec)
+        update_cache(rec)
+        regen_md()
+        log(f"{plan_key}: {rec['tokens_per_sec']:.1f} tok/s (mfu {rec['mfu_6n']:.4f})")
+        return True
+    err = (r.stderr or "").strip().splitlines()
+    tail = " | ".join(err[-3:]) if err else f"rc={r.returncode}, no output"
+    if "RESOURCE_EXHAUSTED" in (r.stderr or "") or "out of memory" in (r.stderr or "").lower():
+        # Permanent for this chip: record so we don't retry forever.
+        append({"plan_key": plan_key, "error": f"OOM: {tail[-300:]}",
+                "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S")})
+        regen_md()
+        log(f"{plan_key}: OOM (recorded, skipping)")
+        return True
+    log(f"{plan_key}: failed rc={r.returncode}: {tail[-300:]}")
+    # Transient (tunnel dropped mid-run etc.) — leave unrecorded for retry.
+    return False
+
+
+def main() -> int:
+    log(f"watcher started, plan={len(PLAN)} configs, pid={os.getpid()}")
+    while True:
+        remaining = [p for p in PLAN if p[0] not in done_keys()]
+        if not remaining:
+            log("plan complete")
+            regen_md()
+            return 0
+        if not probe():
+            log(f"tunnel unavailable ({len(remaining)} configs remaining); "
+                f"sleeping {IDLE_SLEEP:.0f}s")
+            time.sleep(IDLE_SLEEP)
+            continue
+        log(f"tunnel ADMITTED — {len(remaining)} configs to go")
+        for plan_key, letter, extra_env in remaining:
+            if not run_config(plan_key, letter, extra_env):
+                break  # re-probe before burning more configs
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
