@@ -176,6 +176,10 @@ def wait(refs: list[ObjectRef], *, num_returns: int = 1, timeout: float | None =
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    # A streaming task is cancelled through its generator, same as the
+    # reference's ray.cancel(ObjectRefGenerator) (worker.py:3495 accepts both).
+    if isinstance(ref, ObjectRefGenerator):
+        ref = ObjectRef(ref._stream_id, get_runtime())
     get_runtime().cancel(ref, force)
 
 

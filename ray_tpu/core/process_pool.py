@@ -205,6 +205,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
     pending: "collections.deque" = collections.deque()
     pend_cv = threading.Condition()
     cancelled: set[int] = set()     # guarded by pend_cv's lock
+    active_seqs: set[int] = set()   # popped-for-execution, not yet replied done
     gen_consumed: dict[int, int] = {}  # seq -> consumer's acked count (backpressure)
     _SEQ_TAGGED = ("run", "run_gen", "actor_call2", "actor_gen")
     _reply(("ready",))  # boot handshake: the pool gates growth/rebalance on it
@@ -234,6 +235,14 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 continue
             if req[0] == "cancel":
                 seq = req[1]
+                # Frames are ordered on the pipe, so a cancel whose task is no
+                # longer queued means the task already STARTED. A migrate
+                # cancel (pool rebalance / blocked-yank) must then lose — only
+                # a user cancel may abort running work (streams poll the
+                # cancelled set per item). Without the reason tag, a migrate
+                # cancel racing the async `start` reply aborted a running
+                # stream as CANCELLED though nobody asked (advisor r3).
+                reason = req[2] if len(req) > 2 else "user"
                 removed = False
                 with pend_cv:
                     for i, r in enumerate(pending):
@@ -241,7 +250,12 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                             del pending[i]
                             removed = True
                             break
-                    if not removed:
+                    # `run` always precedes `cancel` on the pipe, so a seq
+                    # that is neither queued nor executing has RETIRED — a
+                    # cancel for it is stale (e.g. a user frame chasing a
+                    # migrate frame that already won) and must not enter the
+                    # cancelled set, where nothing would ever consume it.
+                    if not removed and reason == "user" and seq in active_seqs:
                         cancelled.add(seq)
                         pend_cv.notify_all()  # wake a paused generator
                 if removed:
@@ -257,8 +271,17 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         with pend_cv:
             if seq in cancelled:
                 cancelled.discard(seq)
+                active_seqs.discard(seq)
                 return True
         return False
+
+    def _retire(seq: int) -> None:
+        """The seq replied its terminal frame (done/skipped): late cancels for
+        it are stale from here on, and any cancelled-set entry added while it
+        ran was never consumed — drop both so neither set grows unbounded."""
+        with pend_cv:
+            active_seqs.discard(seq)
+            cancelled.discard(seq)
 
     def _decode_call(args_blob):
         args, kwargs = serialization.deserialize_from_bytes(args_blob)
@@ -347,12 +370,17 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         except BaseException as e:  # noqa: BLE001
             status, payload, extra = _error_payload(e)
         _reply(("done", seq, status, payload, extra))
+        _retire(seq)
 
     while True:
         with pend_cv:
             while not pending:
                 pend_cv.wait()
             req = pending.popleft()
+            if req[0] in _SEQ_TAGGED:
+                # mark executing atomically with the dequeue: a cancel frame
+                # must find the seq in exactly one of {pending, active}
+                active_seqs.add(req[1])
         kind = req[0]
         if kind == "exit":
             os._exit(0)
@@ -408,6 +436,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                         except BaseException as e:  # noqa: BLE001
                             status, payload, extra = _error_payload(e)
                             _reply(("done", s, status, payload, extra))
+                            _retire(s)
                             return
                         _finish_call(s, result, ob)
 
@@ -419,6 +448,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             except BaseException as e:  # noqa: BLE001
                 status, payload, extra = _error_payload(e)
                 _reply(("done", seq, status, payload, extra))
+                _retire(seq)
             continue
         if kind == "actor_gen":
             # ("actor_gen", seq, method, args_blob, task_bin, backpressure)
@@ -448,6 +478,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                             # counts and leak re-added entries
                             with pend_cv:
                                 gen_consumed.pop(s, None)
+                            _retire(s)
 
                     import asyncio
 
@@ -458,9 +489,11 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                     finally:
                         with pend_cv:
                             gen_consumed.pop(seq, None)
+                        _retire(seq)
             except BaseException as e:  # noqa: BLE001
                 status, payload, extra = _error_payload(e)
                 _reply(("done", seq, status, payload, extra))
+                _retire(seq)
             continue
         if kind == "run_gen":
             # ("run_gen", seq, task_bin, fn_blob, args_blob, backpressure)
@@ -481,6 +514,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 _set_current_task(None)
                 with pend_cv:
                     gen_consumed.pop(seq, None)
+                _retire(seq)
             continue
         # ("run", seq, oid_bin, fn_blob, args_blob, task_bin)
         _, seq, oid_bin, fn_blob, args_blob, task_bin = req[:6]
@@ -498,6 +532,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         finally:
             _set_current_task(None)
         _reply(("done", seq, status, payload, extra))
+        _retire(seq)
 
 
 class _Inflight:
@@ -508,8 +543,9 @@ class _Inflight:
     stream through on_item before the terminal `done`)."""
 
     __slots__ = ("future", "oid_bin", "fn_blob", "args_blob", "task_bin",
-                 "started", "cancel_sent", "worker", "submit_ts", "user_cancelled",
-                 "kind", "on_item", "backpressure", "seq")
+                 "started", "cancel_sent", "cancel_reason", "worker",
+                 "submit_ts", "user_cancelled", "kind", "on_item",
+                 "backpressure", "seq")
 
     def __init__(self, fn_blob, args_blob, oid_bin, task_bin, kind="run",
                  on_item=None, backpressure=0):
@@ -520,6 +556,7 @@ class _Inflight:
         self.task_bin = task_bin
         self.started = False
         self.cancel_sent = False
+        self.cancel_reason: str | None = None  # "migrate" | "user"
         self.worker: "_Worker | None" = None
         self.submit_ts = 0.0
         self.user_cancelled = False  # skipped -> cancelled, not resubmitted
@@ -862,6 +899,10 @@ class ProcessWorkerPool:
         with self._cv:
             live = [w for w in self._workers if w.is_alive()]
             if not live:
+                # Total loss (e.g. every respawn failed under fd pressure):
+                # rebuild toward the floor rather than staying dead forever.
+                if not self._shutdown and self._num > 0:
+                    self._spawn_locked()
                 return
 
             def stalled(w: _Worker) -> bool:
@@ -879,10 +920,15 @@ class ProcessWorkerPool:
 
             idle = [w for w in live if w.ready and w.load == 0 and not w.blocked]
             booting = [w for w in live if not w.ready]
+            # Restore the floor: _on_worker_death's respawn can fail under
+            # fd/memory pressure (swallowed there so orphan futures still
+            # fail) — the monitor re-tries here, one spawn per tick.
+            if len(live) < self._num and not booting:
+                self._spawn_locked()
             # Grow: every worker is stalled on aged work and nothing is
             # already booting (growth paced by worker boot time, so a
             # stall can never storm-spawn).
-            if (not idle and not booting and len(live) < self.MAX_WORKERS
+            elif (not idle and not booting and len(live) < self.MAX_WORKERS
                     and all(stalled(w) for w in live)):
                 self._spawn_locked()
             # Rebalance: stale UNSTARTED tasks on stalled workers migrate
@@ -898,13 +944,14 @@ class ProcessWorkerPool:
                         if (not i.started and not i.cancel_sent
                                 and now - i.submit_ts > self.SUSTAINED_S):
                             i.cancel_sent = True
+                            i.cancel_reason = "migrate"
                             to_cancel.append((w, seq))
                             budget -= 1
                             if budget <= 0:
                                 break
         for w, seq in to_cancel:
             try:
-                w.send_frame(("cancel", seq))
+                w.send_frame(("cancel", seq, "migrate"))
             except (BrokenPipeError, OSError):
                 self._on_worker_death(w)
 
@@ -1044,10 +1091,16 @@ class ProcessWorkerPool:
             self._running_tasks.pop(w.proc.pid, None)
             # Respawn to the floor — but never during shutdown. Futures are
             # failed below EITHER way: a blocking execute_blob caller must not
-            # hang because teardown raced a worker EOF.
-            while (not self._shutdown
-                   and sum(1 for x in self._workers if x.is_alive()) < self._num):
-                self._spawn_locked()
+            # hang because teardown raced a worker EOF. Popen can raise
+            # (EAGAIN/ENOMEM under pressure); w.dead is already True so this
+            # function won't re-enter — swallow and let the monitor restore
+            # the floor next tick rather than skip failing the orphans.
+            try:
+                while (not self._shutdown
+                       and sum(1 for x in self._workers if x.is_alive()) < self._num):
+                    self._spawn_locked()
+            except Exception:
+                pass
             self._cv.notify_all()
         err = WorkerCrashedError("worker process died while executing task")
         for inf in orphans:
@@ -1087,6 +1140,7 @@ class ProcessWorkerPool:
             inf.worker = w
             inf.started = False
             inf.cancel_sent = False
+            inf.cancel_reason = None
             inf.submit_ts = time.monotonic()
         inf.seq = seq
         if inf.kind == "gen":
@@ -1178,11 +1232,12 @@ class ProcessWorkerPool:
                         for s2, inf2 in w.inflight.items():
                             if not inf2.started and not inf2.cancel_sent:
                                 inf2.cancel_sent = True
+                                inf2.cancel_reason = "migrate"
                                 to_cancel.append((w, s2))
                         break
         for w, seq in to_cancel:
             try:
-                w.send_frame(("cancel", seq))
+                w.send_frame(("cancel", seq, "migrate"))
             except (BrokenPipeError, OSError):
                 self._on_worker_death(w)
 
@@ -1208,23 +1263,28 @@ class ProcessWorkerPool:
                                 return True
                             if inf.kind == "gen":
                                 # a RUNNING stream polls the cancelled set per
-                                # item — a cancel frame aborts it cleanly
+                                # item — a cancel frame aborts it cleanly. A
+                                # prior MIGRATE cancel that lost (task started)
+                                # was a worker-side no-op, so a user cancel
+                                # must still send its own frame.
                                 inf.user_cancelled = True
-                                if not inf.cancel_sent:
+                                if not inf.cancel_sent or inf.cancel_reason == "migrate":
                                     inf.cancel_sent = True
+                                    inf.cancel_reason = "user"
                                     target, seq_to_cancel = w, seq
                                 break
                             return False
                         inf.user_cancelled = True
-                        if not inf.cancel_sent:
+                        if not inf.cancel_sent or inf.cancel_reason == "migrate":
                             inf.cancel_sent = True
+                            inf.cancel_reason = "user"
                             target, seq_to_cancel = w, seq
                         break
                 if target is not None:
                     break
         if target is not None:
             try:
-                target.send_frame(("cancel", seq_to_cancel))
+                target.send_frame(("cancel", seq_to_cancel, "user"))
             except (BrokenPipeError, OSError):
                 self._on_worker_death(target)
             return True

@@ -668,17 +668,32 @@ class Runtime:
                 if node_id is None:
                     still_waiting.append(tid)
                     continue
-                entry.node_id = node_id
-                entry.state = "RUNNING"
-                entry.start_time = time.time()
-                entry.sched_req = req
-                entry.resources_released = False
+                # Grant fields are written under the lock so _finalize_entry's
+                # identity check reads {sched_req, resources_released, node_id}
+                # as one consistent snapshot — a stale attempt's finally racing
+                # this re-grant must see either all of the new grant or none.
+                with self._lock:
+                    entry.node_id = node_id
+                    entry.state = "RUNNING"
+                    entry.start_time = time.time()
+                    entry.sched_req = req
+                    entry.resources_released = False
                 if self._can_dispatch_async(entry):
                     # Local process tasks go straight to the pipelined pool —
                     # no thread per task; completion arrives via the pool
                     # reader's callback (reference: PushNormalTask replies
                     # resolve on the io-service thread, not a per-task thread).
-                    self._submit_process_task_async(entry, req)
+                    # submit can raise (pool shut down racing teardown, Popen
+                    # OSError from a synchronous spawn): an escape here kills
+                    # the dispatcher thread and halts ALL dispatch — route
+                    # through the same failure path as the thread executor.
+                    try:
+                        self._submit_process_task_async(entry, req)
+                    except Exception as e:
+                        try:
+                            self._handle_task_failure(entry, e)
+                        finally:
+                            self._finalize_entry(entry, req)
                 else:
                     t = threading.Thread(
                         target=self._execute_task, args=(entry, req), daemon=True,
@@ -858,8 +873,26 @@ class Runtime:
         """Release resources + dependency pins at a terminal state (the
         `finally` of the thread path, shared with async completion)."""
         entry.end_time = time.time()
-        if not entry.spec.is_actor_creation and self._claim_release(entry):
-            self.scheduler.release(entry.node_id, req)
+        # Identity-check req against the entry's CURRENT grant: after a retry,
+        # _handle_task_failure has already released this attempt's claim and
+        # re-enqueued, and the dispatcher may have granted the NEXT attempt
+        # (resetting resources_released, overwriting sched_req/node_id) before
+        # this finally runs. Claiming then would release the old req against
+        # the new attempt's node — corrupting scheduler capacity — and leave
+        # the new attempt's resources never released.
+        release_node = None
+        with self._lock:
+            if entry.sched_req is not req:
+                # Stale attempt: the current attempt owns ALL finalization —
+                # including the submitted-task ref decrement below, which
+                # would otherwise run once per attempt and double-free
+                # dependency pins shared with still-pending tasks.
+                return
+            if not entry.spec.is_actor_creation and not entry.resources_released:
+                entry.resources_released = True
+                release_node = entry.node_id
+        if release_node is not None:
+            self.scheduler.release(release_node, req)
             self.scheduler.retry_pending_pgs()
         if entry.state in ("FINISHED", "FAILED", "CANCELLED"):
             self.reference_counter.remove_submitted_task_refs(

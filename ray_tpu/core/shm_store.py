@@ -92,7 +92,10 @@ class SharedMemoryStore:
         contents, so racing live writers is safe."""
         import threading
 
-        def run(handle=self._handle, lib=self._lib, size=self.size):
+        self._prefault_stop = threading.Event()
+
+        def run(handle=self._handle, lib=self._lib, size=self.size,
+                stop=self._prefault_stop):
             import time
 
             try:  # background priority: page-zeroing must not starve the session's
@@ -102,6 +105,11 @@ class SharedMemoryStore:
             chunk = 64 * 1024 * 1024
             off = 0
             while off < size:
+                # stop flag: close() (or any future unmap path) must be able
+                # to retire the handle without this thread touching it again —
+                # the raw ctypes handle has no liveness guard of its own.
+                if stop.is_set():
+                    return
                 try:
                     lib.shm_store_prefault(handle, off, min(chunk, size - off))
                 except Exception:
@@ -260,6 +268,9 @@ class SharedMemoryStore:
         zero-copy buffers (and their GC finalizers) may still reference it, so
         the segment is left to die with the process — unlinking the name frees
         the kernel namespace and lets the memory go when the last mapper exits."""
+        stop = getattr(self, "_prefault_stop", None)
+        if stop is not None:
+            stop.set()
         if self._handle and self.owner:
             self.owner = False
             self._lib.shm_store_unlink(self.name.encode())

@@ -1,0 +1,172 @@
+"""Regressions for the round-3 advisor findings (ADVICE.md round 3).
+
+1. A MIGRATE cancel that races a generator's async `start` reply must lose —
+   the running stream completes instead of surfacing TaskCancelledError to a
+   user who never cancelled (process_pool cancel-reason protocol).
+2. `_finalize_entry` must not release a retry's NEW grant against the OLD
+   request when the dispatcher re-granted before the failing attempt's
+   `finally` ran (identity check on entry.sched_req).
+3. `_on_worker_death` must fail orphaned inflight futures even when the
+   respawn loop's Popen raises (fd/memory pressure) — callers blocked on
+   those futures must never hang.
+
+Reference patterns: generator_waiter.h consumed-count backpressure,
+normal_task_submitter.cc retry bookkeeping, worker_pool.cc PopWorker failure
+handling.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.process_pool import WorkerCrashedError
+from ray_tpu.exceptions import TaskCancelledError
+
+
+def _get_pool():
+    from ray_tpu.core.runtime import get_runtime
+
+    return get_runtime()._process_pool()
+
+
+def test_migrate_cancel_loses_to_started_stream(ray_start_regular):
+    """Send a migrate-reason cancel for a stream that already started: the
+    stream must run to completion (pre-fix: aborted as CANCELLED)."""
+
+    @ray_tpu.remote(num_returns="streaming", isolate_process=True)
+    def gen():
+        for i in range(6):
+            time.sleep(0.05)
+            yield i
+
+    stream = gen.remote()
+    it = iter(stream)
+    first = ray_tpu.get(next(it))
+    assert first == 0  # the generator is RUNNING on its worker now
+
+    pool = _get_pool()
+    sent = False
+    deadline = time.time() + 10
+    while not sent and time.time() < deadline:
+        with pool._cv:
+            targets = [
+                (w, seq)
+                for w in pool._workers
+                for seq, inf in w.inflight.items()
+                if inf.kind == "gen"
+            ]
+        for w, seq in targets:
+            w.send_frame(("cancel", seq, "migrate"))  # simulated rebalance race
+            sent = True
+        if not sent:
+            time.sleep(0.02)
+    assert sent, "stream inflight not found"
+
+    got = [first] + [ray_tpu.get(r) for r in it]
+    assert got == list(range(6))
+
+
+def test_user_cancel_still_aborts_started_stream(ray_start_regular):
+    """The user path must keep its teeth: cancel() on a running stream aborts it."""
+
+    @ray_tpu.remote(num_returns="streaming", isolate_process=True)
+    def gen():
+        for i in range(100):
+            time.sleep(0.05)
+            yield i
+
+    stream = gen.remote()
+    it = iter(stream)
+    assert ray_tpu.get(next(it)) == 0
+    ray_tpu.cancel(stream)
+    with pytest.raises(TaskCancelledError):
+        for r in it:
+            ray_tpu.get(r)
+
+
+def test_finalize_entry_skips_stale_request(ray_start_regular):
+    """_finalize_entry invoked with a request that is no longer the entry's
+    current grant must not release (and must leave the claim unclaimed)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+
+    @ray_tpu.remote
+    def probe():
+        return 1
+
+    assert ray_tpu.get(probe.remote(), timeout=60) == 1
+    with rt._lock:
+        entry = next(iter(rt._tasks.values()))
+
+    class _Sched:
+        released = 0
+
+        def release(self, node_id, req):
+            _Sched.released += 1
+
+        def retry_pending_pgs(self):
+            pass
+
+    old_req, old_node = object(), entry.node_id
+    new_req = object()
+    entry.sched_req = new_req  # dispatcher re-granted the retry
+    entry.resources_released = False
+    real_sched = rt.scheduler
+    rt.scheduler = _Sched()
+    try:
+        rt._finalize_entry(entry, old_req)  # stale attempt's finally
+        assert _Sched.released == 0
+        assert entry.resources_released is False  # new attempt's right intact
+        rt._finalize_entry(entry, new_req)  # current attempt finalizes fine
+        assert _Sched.released == 1
+        assert entry.resources_released is True
+    finally:
+        rt.scheduler = real_sched
+        entry.resources_released = True
+
+
+def test_worker_death_with_spawn_failure_fails_futures(ray_start_regular):
+    """Kill a worker while respawn is broken: its inflight futures must still
+    fail as WorkerCrashedError instead of hanging (pre-fix: the spawn OSError
+    escaped before the orphan-failing loop)."""
+    import cloudpickle
+
+    from ray_tpu._private import serialization
+
+    pool = _get_pool()
+
+    def snooze():
+        time.sleep(30)
+        return "done"
+
+    fut = pool.submit_blob(
+        cloudpickle.dumps(snooze), serialization.serialize_to_bytes(((), {}))
+    )
+    deadline = time.time() + 10
+    victim = None
+    while victim is None and time.time() < deadline:
+        with pool._cv:
+            for w in pool._workers:
+                if w.inflight:
+                    victim = w
+                    break
+        time.sleep(0.02)
+    assert victim is not None
+
+    orig_spawn = pool._spawn_locked
+    calls = {"n": 0}
+
+    def broken_spawn():
+        calls["n"] += 1
+        raise OSError("synthetic fd pressure")
+
+    pool._spawn_locked = broken_spawn
+    try:
+        victim.proc.kill()
+        with pytest.raises(WorkerCrashedError):
+            fut.result(timeout=30)
+    finally:
+        pool._spawn_locked = orig_spawn
+    assert calls["n"] >= 1  # the broken respawn actually ran (and was survived)
