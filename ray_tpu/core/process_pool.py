@@ -1130,6 +1130,7 @@ class ProcessWorkerPool:
         return min(candidates, key=lambda w: w.load)
 
     def _submit_inflight(self, inf: _Inflight) -> None:
+        dead: "_Worker | None" = None
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("pool is shut down")
@@ -1142,16 +1143,24 @@ class ProcessWorkerPool:
             inf.cancel_sent = False
             inf.cancel_reason = None
             inf.submit_ts = time.monotonic()
-        inf.seq = seq
-        if inf.kind == "gen":
-            frame = ("run_gen", seq, inf.task_bin, inf.fn_blob, inf.args_blob,
-                     inf.backpressure)
-        else:
-            frame = ("run", seq, inf.oid_bin, inf.fn_blob, inf.args_blob, inf.task_bin)
-        try:
-            w.send_frame(frame)
-        except (BrokenPipeError, OSError):
-            self._on_worker_death(w)
+            inf.seq = seq
+            if inf.kind == "gen":
+                frame = ("run_gen", seq, inf.task_bin, inf.fn_blob, inf.args_blob,
+                         inf.backpressure)
+            else:
+                frame = ("run", seq, inf.oid_bin, inf.fn_blob, inf.args_blob,
+                         inf.task_bin)
+            # The run frame goes out UNDER the registration lock: every cancel
+            # sender discovers the inflight under this same lock, so its
+            # cancel frame can only follow the run frame on the pipe — the
+            # ordering invariant the worker's stale-cancel guard relies on.
+            # (_cv wraps a non-reentrant Lock; death handling moves below.)
+            try:
+                w.send_frame(frame)
+            except (BrokenPipeError, OSError):
+                dead = w
+        if dead is not None:
+            self._on_worker_death(dead)
 
     def submit_blob(self, fn_blob: bytes, args_blob: bytes,
                     result_oid_bin: bytes | None = None,
@@ -1282,13 +1291,21 @@ class ProcessWorkerPool:
                         break
                 if target is not None:
                     break
-        if target is not None:
-            try:
-                target.send_frame(("cancel", seq_to_cancel, "user"))
-            except (BrokenPipeError, OSError):
-                self._on_worker_death(target)
-            return True
-        return False
+            # Send under the same lock that published the inflight: keeps the
+            # cancel frame strictly after its run frame (see _submit_inflight).
+            dead: "_Worker | None" = None
+            if target is not None:
+                try:
+                    target.send_frame(("cancel", seq_to_cancel, "user"))
+                except (BrokenPipeError, OSError):
+                    dead = target
+        if target is None:
+            return False
+        if dead is not None:
+            # worker died under us — its inflight futures fail (task is
+            # effectively cancelled from the caller's perspective)
+            self._on_worker_death(dead)
+        return True
 
     # ------------------------------------------------------------- inspection
     def running_tasks(self) -> dict:

@@ -8,6 +8,8 @@ collectives that ride ICI.
 
 Axis convention (order matters — leading axes get the slower links):
   data   — pure data parallel (gradient psum over DCN/ICI)
+  pipe   — pipeline parallel (stage-neighbor activation ppermute, lowest
+           bandwidth need of any axis, so it rides the slowest links after data)
   fsdp   — data parallel with sharded params/optimizer (ZeRO-3 style all-gather)
   tensor — megatron-style tensor parallel (activations psum within a layer)
   seq    — sequence/context parallel (ring attention over ICI neighbors)
@@ -27,7 +29,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-AXES = ("data", "fsdp", "tensor", "seq", "expert")
+AXES = ("data", "pipe", "fsdp", "tensor", "seq", "expert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,6 +37,7 @@ class MeshSpec:
     """Logical parallelism layout. -1 on `data` means 'absorb remaining devices'."""
 
     data: int = -1
+    pipe: int = 1
     fsdp: int = 1
     tensor: int = 1
     seq: int = 1
@@ -77,6 +80,7 @@ def make_mesh(
     n_devices: int | None = None,
     *,
     data: int = -1,
+    pipe: int = 1,
     fsdp: int = 1,
     tensor: int = 1,
     seq: int = 1,
@@ -95,7 +99,8 @@ def make_mesh(
                 devices = cpu
     if n_devices is not None:
         devices = devices[:n_devices]
-    return MeshSpec(data, fsdp, tensor, seq, expert).build(devices)
+    return MeshSpec(data=data, pipe=pipe, fsdp=fsdp, tensor=tensor, seq=seq,
+                    expert=expert).build(devices)
 
 
 def single_device_mesh():

@@ -46,35 +46,38 @@ def init_state(cfg: llama.LlamaConfig, key, optimizer=None) -> TrainState:
     return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
 
 
+def mirror_opt_shardings(opt_state, params, param_sh, rep):
+    """Sharding tree for an optax state: any subtree whose pytree STRUCTURE
+    mirrors the params (adam mu/nu, etc.) gets the param sharding tree; other
+    leaves (step counts) replicate. Structure matching is unambiguous where
+    shape matching is not — e.g. wq and wo share [L, h, h] but carry
+    transposed PartitionSpecs, so a shape-keyed map silently missharded one
+    of them and paid resharding collectives every optimizer step."""
+    pdef = jax.tree.structure(params)
+
+    def rec(node):
+        if jax.tree.structure(node) == pdef:
+            return param_sh
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(rec(c) for c in node))
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(c) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return rep
+
+    return rec(opt_state)
+
+
 def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh, state: TrainState) -> TrainState:
     """Sharding tree for TrainState: params by logical axes; opt_state mirrors params."""
     ax = llama.logical_axes(cfg)
     param_sh = shd.tree_shardings(mesh, ax)
-
-    def opt_sharding(leaf_path_value):
-        return leaf_path_value
-
-    # optax states mirror param pytrees; map matching leaves to the param sharding,
-    # scalars to replicated.
-    def mirror(tree):
-        flat_params, treedef = jax.tree.flatten(state.params)
-        flat_sh = jax.tree.leaves(param_sh)
-        shape_to_sh = {}
-        for p, s in zip(flat_params, flat_sh):
-            shape_to_sh.setdefault(p.shape, s)
-        rep = shd.replicated(mesh)
-
-        def pick(leaf):
-            if hasattr(leaf, "shape") and leaf.shape in shape_to_sh and len(leaf.shape) > 0:
-                return shape_to_sh[leaf.shape]
-            return rep
-
-        return jax.tree.map(pick, tree)
-
+    rep = shd.replicated(mesh)
     return TrainState(
         params=param_sh,
-        opt_state=mirror(state.opt_state),
-        step=shd.replicated(mesh),
+        opt_state=mirror_opt_shardings(state.opt_state, state.params, param_sh, rep),
+        step=rep,
     )
 
 

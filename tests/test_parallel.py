@@ -17,7 +17,8 @@ def cpu_mesh(**axes):
 
 
 def test_mesh_spec_resolve():
-    assert MeshSpec(data=-1, tensor=2).resolve(8) == dict(data=4, fsdp=1, tensor=2, seq=1, expert=1)
+    assert MeshSpec(data=-1, tensor=2).resolve(8) == dict(
+        data=4, pipe=1, fsdp=1, tensor=2, seq=1, expert=1)
     with pytest.raises(ValueError):
         MeshSpec(data=3, tensor=3).resolve(8)
 
