@@ -352,6 +352,9 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
     actor_instance = None
     actor_env_stack = None  # noqa: F841 - held so the env outlives __init__
     actor_loop = None
+    actor_pool = None  # sync-method thread pool when max_concurrency > 1
+    # (reference: concurrency_group_manager.cc runs sync calls on a pool of
+    # max_concurrency threads inside the worker; user code owns its locking)
 
     def _ensure_loop():
         import asyncio
@@ -372,6 +375,11 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         _reply(("done", seq, status, payload, extra))
         _retire(seq)
 
+    def _finish_err(seq: int, e: BaseException) -> None:
+        status, payload, extra = _error_payload(e)
+        _reply(("done", seq, status, payload, extra))
+        _retire(seq)
+
     while True:
         with pend_cv:
             while not pending:
@@ -389,6 +397,12 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 cls = cloudpickle.loads(req[1])
                 args, kwargs = _decode_call(req[2])
                 renv = req[3] if len(req) > 3 else None
+                mc = req[4] if len(req) > 4 else 1
+                if mc > 1:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    actor_pool = ThreadPoolExecutor(
+                        max_workers=mc, thread_name_prefix="actor-sync")
                 if renv:
                     import contextlib
 
@@ -434,21 +448,30 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                         try:
                             result = await m(*a, **kw)
                         except BaseException as e:  # noqa: BLE001
-                            status, payload, extra = _error_payload(e)
-                            _reply(("done", s, status, payload, extra))
-                            _retire(s)
+                            _finish_err(s, e)
                             return
                         _finish_call(s, result, ob)
 
                     import asyncio
 
                     asyncio.run_coroutine_threadsafe(_run_async(), _ensure_loop())
+                elif actor_pool is not None:
+                    # sync method on the pool: the executor moves on, replies
+                    # arrive out of order as calls finish (same contract as
+                    # async methods — the parent matches by seq)
+                    def _run_pooled(m=method, a=args, kw=kwargs, s=seq, ob=oid_bin):
+                        try:
+                            result = m(*a, **kw)
+                        except BaseException as e:  # noqa: BLE001
+                            _finish_err(s, e)
+                            return
+                        _finish_call(s, result, ob)
+
+                    actor_pool.submit(_run_pooled)
                 else:
                     _finish_call(seq, method(*args, **kwargs), oid_bin)
             except BaseException as e:  # noqa: BLE001
-                status, payload, extra = _error_payload(e)
-                _reply(("done", seq, status, payload, extra))
-                _retire(seq)
+                _finish_err(seq, e)
             continue
         if kind == "actor_gen":
             # ("actor_gen", seq, method, args_blob, task_bin, backpressure)
@@ -595,6 +618,13 @@ class _Worker:
         blob = cloudpickle.dumps(payload)
         with self.send_mu:
             self.conn.send_bytes(blob)
+
+    def send_frame_locked(self, payload) -> None:
+        """Send with send_mu ALREADY HELD by the caller (ordered-handoff
+        pattern: acquire send_mu under the pool lock, write after releasing
+        it — frame order is pinned without blocking pipe I/O under the
+        pool-global lock)."""
+        self.conn.send_bytes(cloudpickle.dumps(payload))
 
     def is_alive(self) -> bool:
         return not self.dead and self.proc.poll() is None
@@ -766,13 +796,15 @@ class DedicatedActorWorker:
                 else:
                     fut.set_result(None)
 
-    def init_actor(self, cls, args_blob: bytes, runtime_env: dict | None = None) -> None:
+    def init_actor(self, cls, args_blob: bytes, runtime_env: dict | None = None,
+                   max_concurrency: int = 1) -> None:
         with self._mu:
             if self._dead:
                 raise WorkerCrashedError("actor worker process died")
             fut = self._init_fut = Future()
         try:
-            self._send(("actor_init", cloudpickle.dumps(cls), args_blob, runtime_env))
+            self._send(("actor_init", cloudpickle.dumps(cls), args_blob,
+                        runtime_env, max_concurrency))
         except (BrokenPipeError, OSError) as e:
             raise WorkerCrashedError("actor worker process died") from e
         fut.result()
@@ -1150,15 +1182,21 @@ class ProcessWorkerPool:
             else:
                 frame = ("run", seq, inf.oid_bin, inf.fn_blob, inf.args_blob,
                          inf.task_bin)
-            # The run frame goes out UNDER the registration lock: every cancel
-            # sender discovers the inflight under this same lock, so its
-            # cancel frame can only follow the run frame on the pipe — the
-            # ordering invariant the worker's stale-cancel guard relies on.
-            # (_cv wraps a non-reentrant Lock; death handling moves below.)
-            try:
-                w.send_frame(frame)
-            except (BrokenPipeError, OSError):
-                dead = w
+            # Ordered handoff: acquire the worker's send lock WHILE the
+            # registration lock is held, but do the (blocking) pipe write
+            # after releasing it. Every cancel sender discovers the inflight
+            # under _cv and then queues on send_mu, so its cancel frame can
+            # only follow this run frame — the ordering invariant the
+            # worker's stale-cancel guard relies on — while reader threads
+            # (which need _cv to resolve futures) never wait behind pipe
+            # backpressure.
+            w.send_mu.acquire()
+        try:
+            w.send_frame_locked(frame)
+        except (BrokenPipeError, OSError):
+            dead = w
+        finally:
+            w.send_mu.release()
         if dead is not None:
             self._on_worker_death(dead)
 
@@ -1291,16 +1329,20 @@ class ProcessWorkerPool:
                         break
                 if target is not None:
                     break
-            # Send under the same lock that published the inflight: keeps the
-            # cancel frame strictly after its run frame (see _submit_inflight).
+            # Ordered handoff (see _submit_inflight): grab the worker's send
+            # lock under _cv so this cancel queues strictly after the task's
+            # run frame, then write outside the pool lock.
             dead: "_Worker | None" = None
             if target is not None:
-                try:
-                    target.send_frame(("cancel", seq_to_cancel, "user"))
-                except (BrokenPipeError, OSError):
-                    dead = target
+                target.send_mu.acquire()
         if target is None:
             return False
+        try:
+            target.send_frame_locked(("cancel", seq_to_cancel, "user"))
+        except (BrokenPipeError, OSError):
+            dead = target
+        finally:
+            target.send_mu.release()
         if dead is not None:
             # worker died under us — its inflight futures fail (task is
             # effectively cancelled from the caller's perspective)

@@ -1519,12 +1519,6 @@ class Runtime:
                 # travel with ShmArg markers like process tasks. Async actors
                 # run their methods on an asyncio loop INSIDE the worker
                 # (concurrent, out-of-order seq-tagged replies).
-                if state.max_concurrency > 1 and not state.is_async:
-                    logger.warning(
-                        "isolate_process actor %s: max_concurrency=%d downgraded "
-                        "to 1 (sync method calls serialize on the actor's process)",
-                        state.cls.__name__, state.max_concurrency,
-                    )
                 self._spawn_proc_actor(state, spec)  # marshals raw refs itself
             else:
                 args, kwargs = self._resolve_args(spec)
@@ -1546,13 +1540,11 @@ class Runtime:
         state.state = "ALIVE"
         self._publish_actor_event(state)
         self._store_value(spec.return_ids()[0], None)  # creation done marker
-        if state.proc_worker is not None:
-            # sync process actors serialize on their worker; ASYNC process
-            # actors overlap max_concurrency calls on the worker's asyncio loop
-            n = max(1, state.max_concurrency) if state.is_async else 1
-            groups = {"_default": n}
-        else:
-            groups = {"_default": max(1, state.max_concurrency)}
+        # max_concurrency calls overlap inside the worker for process actors
+        # (asyncio loop or sync-method thread pool) — the head needs matching
+        # mailbox threads either way to keep that many in flight
+        groups = {"_default": max(1, state.max_concurrency)}
+        if state.proc_worker is None:
             for gname, limit in state.concurrency_groups.items():
                 groups[gname] = max(1, int(limit))
         state.group_thread_counts = groups
@@ -1583,8 +1575,11 @@ class Runtime:
             log_base=log_base if self.config.log_to_driver else None,
         )
         try:
+            # sync methods overlap on a worker-side thread pool when
+            # max_concurrency > 1 (reference: concurrency_group_manager.cc)
             worker.init_actor(state.cls, self._marshal_args(spec),
-                              runtime_env=spec.runtime_env)
+                              runtime_env=spec.runtime_env,
+                              max_concurrency=state.max_concurrency)
         except BaseException:
             worker.kill()
             raise

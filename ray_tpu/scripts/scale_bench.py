@@ -1,0 +1,214 @@
+"""Scale-envelope bench: nodes / actors / queued tasks / placement groups.
+
+Mirrors the reference's scalability envelope
+(/root/reference/release/benchmarks/README.md:11-14 — 2,000 nodes, 40K
+actors, 10K running tasks, 1K placement groups, 1M queued tasks on one node)
+at single-host scale, and its distributed_test.py measurement shape. Each
+section prints a JSON line; `python -m ray_tpu.scripts.scale_bench` writes
+the markdown table the round report embeds (SCALE_r04.md).
+
+Run on a quiet machine: the numbers are a capacity envelope (does it work,
+where's the knee), not a latency benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import resource
+import time
+
+import ray_tpu
+from ray_tpu.core.runtime import get_runtime
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def bench_nodes(n: int, real: int) -> list[dict]:
+    """n logical (in-process) nodes + `real` OS-process node agents: register
+    them all, then prove SPREAD scheduling lands tasks on every node."""
+    from ray_tpu.cluster_utils import Cluster
+
+    out = []
+    cluster = Cluster()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        cluster.add_node(num_cpus=4)
+    dt = time.perf_counter() - t0
+    out.append({"metric": "logical_nodes_registered", "n": n,
+                "rate_per_s": round(n / dt, 1), "secs": round(dt, 3)})
+
+    if real:
+        t0 = time.perf_counter()
+        ok = 0
+        for _ in range(real):
+            try:
+                cluster.add_node(num_cpus=1, real_process=True, timeout=120.0)
+                ok += 1
+            except (RuntimeError, TimeoutError) as e:
+                out.append({"metric": "real_agent_register_failed_at", "n": ok,
+                            "error": str(e)[:120]})
+                break
+        dt = time.perf_counter() - t0
+        out.append({"metric": "real_node_agents_registered", "n": ok,
+                    "rate_per_s": round(ok / max(dt, 1e-9), 2),
+                    "secs": round(dt, 2)})
+
+    # prove the scheduler spreads across the enlarged cluster (placement is
+    # attributed head-side via the task-state API, like the reference's
+    # `ray list tasks` node_id column)
+    @ray_tpu.remote(scheduling_strategy="SPREAD", num_cpus=0.5)
+    def spread_probe():
+        return 0
+
+    t0 = time.perf_counter()
+    total_nodes = len(get_runtime().scheduler.nodes())
+    refs = [spread_probe.remote() for _ in range(min(4 * total_nodes, 800))]
+    ray_tpu.get(refs, timeout=600)
+    dt = time.perf_counter() - t0
+    from ray_tpu.util import state
+
+    used = {t["node_id"] for t in state.list_tasks(limit=10_000)
+            if t["name"].startswith("spread_probe") and t["node_id"]}
+    out.append({"metric": "spread_nodes_used", "n": len(used),
+                "total_nodes": total_nodes, "tasks": len(refs),
+                "secs": round(dt, 2)})
+    return out
+
+
+def bench_actors(n: int) -> list[dict]:
+    """n live in-head actors: creation rate, one ping through every mailbox."""
+
+    @ray_tpu.remote(num_cpus=0.001)
+    class Cell:
+        def __init__(self, i):
+            self.i = i
+
+        def ping(self):
+            return self.i
+
+    t0 = time.perf_counter()
+    actors = [Cell.remote(i) for i in range(n)]
+    create_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    ping_dt = time.perf_counter() - t0
+    assert got == list(range(n))
+    out = [{"metric": "live_actors", "n": n,
+            "create_rate_per_s": round(n / create_dt, 1),
+            "ping_all_rate_per_s": round(n / ping_dt, 1),
+            "rss_mb": round(_rss_mb(), 1)}]
+    for a in actors:
+        ray_tpu.kill(a)
+    return out
+
+
+def bench_queued_tasks(n: int) -> list[dict]:
+    """n tasks queued behind a tiny resource budget: submission rate with the
+    queue deep, then drain throughput once capacity opens."""
+
+    @ray_tpu.remote(num_cpus=4, resources={"gate": 1})
+    def nop():
+        return 0
+
+    # every task needs the 'gate' resource; none exists yet -> all queue
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n)]
+    submit_dt = time.perf_counter() - t0
+    depth_rss = _rss_mb()
+
+    # open the gate: one node with gate:4 drains 4-wide
+    rt = get_runtime()
+    rt.scheduler.add_node({"CPU": 16.0, "gate": 4.0})
+    rt.scheduler.retry_pending_pgs()
+    t0 = time.perf_counter()
+    ray_tpu.get(refs, timeout=3600)
+    drain_dt = time.perf_counter() - t0
+    return [{"metric": "queued_tasks", "n": n,
+             "submit_rate_per_s": round(n / submit_dt, 1),
+             "queue_depth_rss_mb": round(depth_rss, 1),
+             "drain_rate_per_s": round(n / drain_dt, 1)}]
+
+
+def bench_placement_groups(n: int) -> list[dict]:
+    """n simultaneous 1-bundle PGs on a cluster with room for all of them."""
+    rt = get_runtime()
+    for _ in range(max(0, n // 100)):
+        rt.scheduler.add_node({"CPU": 128.0})
+    t0 = time.perf_counter()
+    pgs = [ray_tpu.placement_group([{"CPU": 1}]) for _ in range(n)]
+    for pg in pgs:
+        pg.wait(timeout_seconds=600)
+    dt = time.perf_counter() - t0
+    out = [{"metric": "simultaneous_pgs", "n": n,
+            "create_ready_rate_per_s": round(n / dt, 1),
+            "secs": round(dt, 2)}]
+    t0 = time.perf_counter()
+    for pg in pgs:
+        ray_tpu.remove_placement_group(pg)
+    out.append({"metric": "pg_remove_rate_per_s",
+                "value": round(n / (time.perf_counter() - t0), 1)})
+    return out
+
+
+def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int) -> list[dict]:
+    results = []
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    for section, fn in (
+        ("nodes", lambda: bench_nodes(nodes, real_agents)),
+        ("actors", lambda: bench_actors(actors)),
+        ("queued_tasks", lambda: bench_queued_tasks(tasks)),
+        ("placement_groups", lambda: bench_placement_groups(pgs)),
+    ):
+        t0 = time.perf_counter()
+        try:
+            rows = fn()
+        except Exception as e:  # record the knee instead of dying
+            rows = [{"metric": f"{section}_FAILED", "error": f"{type(e).__name__}: {e}"[:200]}]
+        for r in rows:
+            r["section"] = section
+            print(json.dumps(r), flush=True)
+        results.extend(rows)
+        print(f"# {section} took {time.perf_counter() - t0:.1f}s rss={_rss_mb():.0f}MB",
+              flush=True)
+    ray_tpu.shutdown()
+    return results
+
+
+def write_md(results: list[dict], path: str, args) -> None:
+    ref = "/root/reference/release/benchmarks/README.md:11-14"
+    lines = [
+        "# Scale envelope — round 4 (single host, 1 shared CPU core)",
+        "",
+        f"Reference envelope ({ref}): 2,000 nodes / 40K actors / 10K running tasks"
+        " / 1K PGs on a 64x64-core cluster; 1M queued tasks on one m4.16xlarge.",
+        "This table is the same envelope measured on ONE shared core — the",
+        "single-controller design's capacity, not a cluster claim.",
+        "",
+        "| metric | value |",
+        "|---|---|",
+    ]
+    for r in results:
+        m = r.pop("metric")
+        r.pop("section", None)
+        lines.append(f"| {m} | {json.dumps(r)} |")
+    lines += ["", f"_Args: {vars(args)}; regenerate with "
+              "`python -m ray_tpu.scripts.scale_bench`._"]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=60)
+    ap.add_argument("--real-agents", type=int, default=8)
+    ap.add_argument("--actors", type=int, default=1000)
+    ap.add_argument("--tasks", type=int, default=100_000)
+    ap.add_argument("--pgs", type=int, default=1000)
+    ap.add_argument("--md", default="SCALE_r04.md")
+    a = ap.parse_args()
+    res = run(a.nodes, a.real_agents, a.actors, a.tasks, a.pgs)
+    if a.md:
+        write_md(res, a.md, a)

@@ -23,6 +23,27 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
+# Smoke tier: `pytest -m fast` runs these modules (<2 min together) — the
+# analog of the reference's small-size test tags (BUILD `size = "small"`).
+# Keep this list to modules with no heavy jax compiles or process gangs.
+_FAST_MODULES = {
+    "test_core_tasks",
+    "test_core_actors",
+    "test_core_objects",
+    "test_core_scheduling",
+    "test_dag",
+    "test_pubsub",
+    "test_misc_parity",
+    "test_round4_fixes",
+    "test_util",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _FAST_MODULES:
+            item.add_marker(pytest.mark.fast)
+
 
 @pytest.fixture
 def ray_start_regular():

@@ -138,3 +138,35 @@ def test_process_actor_restart_reinitializes_state(session):
     finally:
         if os.path.exists(marker):
             os.unlink(marker)
+
+
+def test_proc_actor_sync_max_concurrency(ray_start_regular):
+    """Sync methods on an isolate_process actor overlap up to max_concurrency
+    on the worker-side thread pool (reference: concurrency_group_manager.cc) —
+    previously they silently serialized with only a log warning."""
+    import threading
+
+    @ray_tpu.remote(isolate_process=True, max_concurrency=4)
+    class Overlap:
+        def __init__(self):
+            self.active = 0
+            self.peak = 0
+            self.lock = threading.Lock()
+
+        def hit(self):
+            import time as _t
+
+            with self.lock:
+                self.active += 1
+                self.peak = max(self.peak, self.active)
+            _t.sleep(0.3)
+            with self.lock:
+                self.active -= 1
+            return 1
+
+        def peak_seen(self):
+            return self.peak
+
+    a = Overlap.remote()
+    assert sum(ray_tpu.get([a.hit.remote() for _ in range(4)], timeout=60)) == 4
+    assert ray_tpu.get(a.peak_seen.remote(), timeout=30) >= 2
