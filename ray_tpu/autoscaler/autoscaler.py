@@ -31,6 +31,9 @@ class AutoscalingConfig:
     idle_timeout_s: float = 60.0
     upscaling_speed: int = 2  # max launches per tick per type
     tick_interval_s: float = 1.0
+    # An instance stuck in REQUESTED/ALLOCATED longer than this is abandoned
+    # and relaunch-eligible (reference: reconciler stuck-instance handling)
+    boot_timeout_s: float = 300.0
 
 
 class Autoscaler:
@@ -117,7 +120,18 @@ class Autoscaler:
                         decisions["launched"][nt.name] = launched + 1
                         break
 
-        # 3) idle nodes -> terminate after timeout (never below min_workers)
+        # 3) stuck boots -> abandon (relaunch happens via demand next tick)
+        now_wall = time.time()
+        for inst in instances:
+            if (inst.status in (InstanceStatus.REQUESTED, InstanceStatus.ALLOCATED)
+                    and now_wall - inst.launch_time > self.config.boot_timeout_s):
+                self.provider.terminate([inst.instance_id])
+                decisions["terminated"].append(inst.instance_id)
+
+        # 4) idle nodes -> DRAIN (cordon) after the timeout, then terminate
+        # only once the cordoned node is verifiably still idle — the two-step
+        # protocol of the reference's v2 reconciler (drain-before-terminate),
+        # so a task placed in the idle-check window is never yanked.
         rt = self._rt()
         now = time.monotonic()
         by_node_id = {i.node_id_hex: i for i in instances if i.node_id_hex}
@@ -125,6 +139,19 @@ class Autoscaler:
             nid = node.node_id.hex()
             inst = by_node_id.get(nid)
             if inst is None or not node.alive:
+                continue
+            if inst.status == InstanceStatus.DRAINING:
+                if rt.scheduler.node_is_idle(node.node_id):
+                    self.provider.terminate([inst.instance_id])
+                    self.terminate_count += 1
+                    decisions["terminated"].append(inst.instance_id)
+                else:
+                    # work is still finishing on the cordoned node; keep
+                    # waiting (or un-cordon if new demand has nowhere to go)
+                    if not self._feasible_without(node) and self.get_pending_demand():
+                        rt.scheduler.undrain_node(node.node_id)
+                        inst.status = InstanceStatus.RUNNING
+                self._idle_since.pop(nid, None)
                 continue
             busy = any(node.total.get(k, 0) != node.available.get(k, 0) for k in node.total)
             if busy:
@@ -136,11 +163,17 @@ class Autoscaler:
                          and i.status == InstanceStatus.RUNNING]
             if (now - first_idle >= self.config.idle_timeout_s and nt is not None
                     and len(same_type) > nt.min_workers):
-                self.provider.terminate([inst.instance_id])
-                self.terminate_count += 1
-                decisions["terminated"].append(inst.instance_id)
+                if rt.scheduler.drain_node(node.node_id):
+                    inst.status = InstanceStatus.DRAINING
+                    decisions.setdefault("draining", []).append(inst.instance_id)
                 self._idle_since.pop(nid, None)
         return decisions
+
+    def _feasible_without(self, node) -> bool:
+        """Is any OTHER live node able to take new work? (If not, and demand
+        exists, an un-idle draining node should be un-cordoned.)"""
+        return any(n.alive and not n.draining and n.node_id != node.node_id
+                   for n in self._rt().scheduler.nodes())
 
     # ---- loop ----
     def start(self) -> None:

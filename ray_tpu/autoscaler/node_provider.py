@@ -17,11 +17,14 @@ from typing import Optional
 
 
 class InstanceStatus(str, Enum):
-    # v2 instance FSM (reference: autoscaler/v2 instance_manager states)
+    # v2 instance FSM (reference: autoscaler/v2 instance_manager states:
+    # QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING -> RAY_STOPPING ->
+    # TERMINATED, reconciler.py:59)
     QUEUED = "QUEUED"
     REQUESTED = "REQUESTED"
     ALLOCATED = "ALLOCATED"
     RUNNING = "RUNNING"
+    DRAINING = "DRAINING"  # cordoned; running work finishing (RAY_STOPPING)
     STOPPING = "STOPPING"
     TERMINATED = "TERMINATED"
 
@@ -84,9 +87,11 @@ class FakeNodeProvider(NodeProvider):
         with self._lock:
             if inst.status == InstanceStatus.TERMINATED:
                 return  # terminated while booting: never join the cluster
-        resources = dict(self.node_type_resources[inst.node_type].get("resources", {}))
-        labels = dict(self.node_type_resources[inst.node_type].get("labels", {}))
-        node_id = self._rt().scheduler.add_node(resources, labels=labels)
+        cfg = self.node_type_resources[inst.node_type]
+        resources = dict(cfg.get("resources", {}))
+        labels = dict(cfg.get("labels", {}))
+        node_id = self._rt().scheduler.add_node(
+            resources, labels=labels, slice_name=cfg.get("slice_name"))
         ghost = False
         with self._lock:
             if inst.status == InstanceStatus.TERMINATED:

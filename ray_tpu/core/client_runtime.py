@@ -215,8 +215,10 @@ class ClientRuntime:
 
     def put(self, value: Any) -> ObjectRef:
         from ray_tpu._private.config import get_config
+        from ray_tpu.core.object_ref import collect_serialized_refs
 
-        blob = serialization.serialize_to_bytes(value)
+        with collect_serialized_refs() as contained:
+            blob = serialization.serialize_to_bytes(value)
         store = self._shm()
         if store is not None and len(blob) > get_config().max_inline_object_size:
             try:
@@ -227,8 +229,11 @@ class ClientRuntime:
                     # only records the location; plane_free drops the pin)
                     store.pin(ObjectID(oid_bin))
                 try:
+                    # contained: refs serialized inside the opaque blob — the
+                    # head pins them for the blob's lifetime (AddNestedObjectIds)
                     self._rpc().call("client_put_seal", oid=oid_bin,
-                                     size=len(blob), timeout=30)
+                                     size=len(blob), contained=contained,
+                                     timeout=30)
                 except BaseException:
                     # head never recorded it -> plane_free will never come;
                     # drop the local copy or the pin leaks store capacity

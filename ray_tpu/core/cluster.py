@@ -311,6 +311,13 @@ class ControlPlane:
             rt.shm_store.pin(oid)
             if rt.spill is not None:
                 rt.spill.on_put(oid, msg["size"])
+        if msg.get("contained"):
+            # Refs serialized inside the opaque blob live while it does.
+            # Registered only after validation/record above: a failed seal
+            # must not leave orphaned nested_holders on the inner objects
+            # (the outer oid would never zero-fire to release them).
+            rt.reference_counter.add_nested_refs(
+                oid, [ObjectID(b) for b in msg["contained"]])
         rt.memory_store.put(oid, RayObject(size=msg["size"], in_shm=True))
         self._hold_for(peer, [ObjectRef(oid, rt)])
         return True

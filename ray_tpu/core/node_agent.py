@@ -74,6 +74,14 @@ def main() -> None:
     def h_execute_task(peer, msg):
         """Head-pushed task dispatch (reference: raylet grants a lease and the
         spec lands on a pooled worker, task_receiver.cc:228)."""
+        # Registration precedes pool creation (the pool needs the head's shm
+        # name from the register reply), so a fast dispatch can land in the
+        # boot window — wait for the pool rather than failing the task.
+        deadline = time.monotonic() + 30.0
+        while "pool" not in pool_box:
+            if time.monotonic() > deadline:
+                raise RuntimeError("node agent worker pool did not come up")
+            time.sleep(0.02)
         pool = pool_box["pool"]
         fn_blob = msg["fn"]
         if msg.get("renv"):
@@ -82,7 +90,7 @@ def main() -> None:
             fn = wrap_with_runtime_env(cloudpickle.loads(fn_blob), msg["renv"])
             fn_blob = cloudpickle.dumps(fn)
         try:
-            status, payload, size = pool.execute_blob(
+            status, payload, size, contained = pool.execute_blob(
                 fn_blob, msg["args"], msg.get("oid"), task_bin=msg.get("task"))
         except _RemoteTaskError as e:
             # Unwrap so the ORIGINAL app exception type crosses the wire
@@ -95,8 +103,8 @@ def main() -> None:
             # sealed into THIS node's store: pin the primary copy here and
             # tell the head it's plane-resident (chunk-pullable)
             local_store.pin(ObjectID(msg["oid"]))
-            return ("plane", payload, size)
-        return (status, payload, size)
+            return ("plane", payload, size, contained)
+        return (status, payload, size, contained)
 
     def h_plane_free(peer, msg):
         """Head dropped the last reference: free the node-held primary."""

@@ -18,6 +18,28 @@ from typing import TYPE_CHECKING, Any, Iterator
 
 from ray_tpu._private.ids import ObjectID
 
+# Thread-local contained-ref collector: while a `collect_serialized_refs()`
+# scope is active on this thread, every ObjectRef that passes through
+# __reduce__ records its id — how a worker reports which refs it serialized
+# into a result blob (reference: the borrowing protocol's contained-object
+# reporting, reference_counter.cc AddNestedObjectIds).
+_serialize_collector = threading.local()
+
+
+class collect_serialized_refs:
+    """Context manager: `with collect_serialized_refs() as refs:` — `refs`
+    accumulates the binary ids of every ObjectRef serialized on this thread
+    inside the scope."""
+
+    def __enter__(self) -> list:
+        self._prev = getattr(_serialize_collector, "refs", None)
+        _serialize_collector.refs = out = []
+        return out
+
+    def __exit__(self, *exc) -> None:
+        _serialize_collector.refs = self._prev
+        return None
+
 if TYPE_CHECKING:
     from ray_tpu.core.runtime import Runtime
 
@@ -66,8 +88,9 @@ class ObjectRef:
     def __reduce__(self):
         # Crossing a process/task boundary: the receiver re-binds to its runtime and
         # becomes a borrower (reference: reference_counter borrowing protocol).
-        from ray_tpu.core import runtime as rt_mod
-
+        col = getattr(_serialize_collector, "refs", None)
+        if col is not None:
+            col.append(self._id.binary())
         return (_rehydrate_ref, (self._id.binary(),))
 
     # --- awaiting ---

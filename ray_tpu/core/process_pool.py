@@ -39,7 +39,7 @@ Worker -> parent:
   ("ready",)                            boot handshake
   ("start", seq)                        executor began the task (running-set upkeep)
   ("item", seq, index, status, payload, extra)  one generator yield
-  ("done", seq, status, payload, extra) status: "val" | "shm" | "err" | "gen_end"
+   ("done", seq, status, payload, extra[, contained]) status: "val" | "shm" | "err" | "gen_end"
   ("skipped", seq)                      cancel won; parent resubmits elsewhere
   ("badreq", None)                      undecodable frame: parent kills + respawns
   3-tuple (status, payload, extra)      actor_init reply (unnumbered)
@@ -174,24 +174,31 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
     def _result_payload(result, oid_bin):
         """Serialize a result: large through shm (zero-copy handoff), small
-        inline over the pipe. Returns (status, payload, extra)."""
+        inline over the pipe. Returns (status, payload, extra, contained) —
+        `contained` lists binary ids of ObjectRefs serialized inside the
+        blob, so the head can hold them while the blob lives (the head never
+        deserializes shm results; without the report, the refs inside would
+        dangle once this worker's borrows drop)."""
         import inspect as _inspect
+
+        from ray_tpu.core.object_ref import collect_serialized_refs
 
         if _inspect.iscoroutine(result) or _inspect.isgenerator(result):
             result.close()
             raise TypeError(
                 "async/generator results are not supported in worker processes"
             )
-        blob = serialization.serialize_to_bytes(result)
+        with collect_serialized_refs() as contained:
+            blob = serialization.serialize_to_bytes(result)
         if store is not None and len(blob) > 100 * 1024 and oid_bin is not None:
             from ray_tpu._private.ids import ObjectID
 
             try:
                 store.put_bytes(ObjectID(oid_bin), blob)
-                return ("shm", oid_bin, len(blob))
+                return ("shm", oid_bin, len(blob), contained)
             except Exception:
                 pass  # store full/unreadable: fall back to the pipe
-        return ("val", blob, len(blob))
+        return ("val", blob, len(blob), contained)
 
     def _error_payload(e: BaseException):
         try:
@@ -298,10 +305,10 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         (reference: generator_waiter.h:58 TotalNumObjectConsumed wait)."""
         index = 0
         for item in gen:
-            status, payload, extra = _result_payload(
+            status, payload, extra, contained = _result_payload(
                 item, _item_oid(task_bin, index) if task_bin else None
             )
-            _reply(("item", seq, index, status, payload, extra))
+            _reply(("item", seq, index, status, payload, extra, contained))
             index += 1
             if backpressure > 0:
                 with pend_cv:
@@ -324,10 +331,10 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
         index = 0
         async for item in agen:
-            status, payload, extra = _result_payload(
+            status, payload, extra, contained = _result_payload(
                 item, _item_oid(task_bin, index) if task_bin else None
             )
-            _reply(("item", seq, index, status, payload, extra))
+            _reply(("item", seq, index, status, payload, extra, contained))
             index += 1
             while True:
                 with pend_cv:  # never await under this lock: aclose()/sleep
@@ -368,11 +375,12 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         return actor_loop
 
     def _finish_call(seq: int, result, oid_bin) -> None:
+        contained = None
         try:
-            status, payload, extra = _result_payload(result, oid_bin)
+            status, payload, extra, contained = _result_payload(result, oid_bin)
         except BaseException as e:  # noqa: BLE001
             status, payload, extra = _error_payload(e)
-        _reply(("done", seq, status, payload, extra))
+        _reply(("done", seq, status, payload, extra, contained))
         _retire(seq)
 
     def _finish_err(seq: int, e: BaseException) -> None:
@@ -414,17 +422,6 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                     )
                 actor_instance = cls(*args, **kwargs)
                 _reply(("ok", None, None))
-            except BaseException as e:  # noqa: BLE001
-                _reply(_error_payload(e))
-            continue
-        if kind == "actor_call":  # legacy sync request/reply form
-            _, method_name, args_blob, oid_bin = req
-            try:
-                if actor_instance is None:
-                    raise RuntimeError("actor_call before actor_init")
-                method = getattr(actor_instance, method_name)
-                args, kwargs = _decode_call(args_blob)
-                _reply(_result_payload(method(*args, **kwargs), oid_bin))
             except BaseException as e:  # noqa: BLE001
                 _reply(_error_payload(e))
             continue
@@ -546,15 +543,17 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             continue
         _reply(("start", seq))
         _set_current_task(task_bin)
+        contained = None
         try:
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = _decode_call(args_blob)
-            status, payload, extra = _result_payload(fn(*args, **kwargs), oid_bin)
+            status, payload, extra, contained = _result_payload(
+                fn(*args, **kwargs), oid_bin)
         except BaseException as e:  # noqa: BLE001
             status, payload, extra = _error_payload(e)
         finally:
             _set_current_task(None)
-        _reply(("done", seq, status, payload, extra))
+        _reply(("done", seq, status, payload, extra, contained))
         _retire(seq)
 
 
@@ -754,11 +753,12 @@ class DedicatedActorWorker:
                 return
             if tag == "item":
                 seq, index, status, payload, extra = resp[1:6]
+                contained = resp[6] if len(resp) > 6 else None
                 with self._mu:
                     call = self._calls.get(seq)
                 if call is not None and call.on_item is not None:
                     try:
-                        call.on_item(index, status, payload, extra)
+                        call.on_item(index, status, payload, extra, contained)
                     except Exception as e:
                         with self._mu:
                             self._calls.pop(seq, None)
@@ -777,6 +777,7 @@ class DedicatedActorWorker:
                         call.future.set_exception(TaskCancelledError("cancelled"))
                     continue
                 seq, status, payload, extra = resp[1], resp[2], resp[3], resp[4]
+                contained = resp[5] if len(resp) > 5 else None
                 with self._mu:
                     call = self._calls.pop(seq, None)
                 if call is None:
@@ -785,7 +786,7 @@ class DedicatedActorWorker:
                     call.future.set_exception(
                         _RemoteTaskError(payload, exc_blob=extra))
                 else:
-                    call.future.set_result((status, payload, extra))
+                    call.future.set_result((status, payload, extra, contained))
                 continue
             # unnumbered 3-tuple: actor_init reply
             if self._init_fut is not None:
@@ -1055,13 +1056,14 @@ class ProcessWorkerPool:
             elif tag == "item":
                 # streaming generator item: deliver without completing
                 seq, index, status, payload, extra = resp[1:6]
+                contained = resp[6] if len(resp) > 6 else None
                 with self._lock:
                     inf = w.inflight.get(seq)
                     if inf is not None:
                         w.last_done_ts = time.monotonic()  # progress signal
                 if inf is not None and inf.on_item is not None:
                     try:
-                        inf.on_item(index, status, payload, extra)
+                        inf.on_item(index, status, payload, extra, contained)
                     except Exception as e:
                         # a dropped item would silently shift every later
                         # index — abort the stream instead (consumer sees the
@@ -1076,6 +1078,7 @@ class ProcessWorkerPool:
                             inf.future.set_exception(e)
             elif tag == "done":
                 seq, status, payload, extra = resp[1], resp[2], resp[3], resp[4]
+                contained = resp[5] if len(resp) > 5 else None
                 with self._cv:
                     inf = w.inflight.pop(seq, None)
                     cur = self._running_tasks.get(w.proc.pid)
@@ -1091,7 +1094,7 @@ class ProcessWorkerPool:
                 if status == "err":
                     inf.future.set_exception(_RemoteTaskError(payload, exc_blob=extra))
                 else:
-                    inf.future.set_result((status, payload, extra))
+                    inf.future.set_result((status, payload, extra, contained))
             elif tag == "skipped":
                 with self._cv:
                     inf = w.inflight.pop(resp[1], None)

@@ -31,10 +31,21 @@ class Reference:
     borrowers: set = field(default_factory=set)
     # Lineage: number of downstream objects whose reconstruction depends on this one
     lineage_refs: int = 0
+    # The producing task is still in flight: its return object must survive
+    # even if every consumer ref is momentarily dropped (reference: the
+    # TaskManager holds return references for pending tasks,
+    # task_manager.cc AddPendingTask) — closes the in-transit race where a
+    # borrower's drop lands before the next holder registers.
+    pending_returns: int = 0
+    # This object is serialized INSIDE other live objects (reference:
+    # ReferenceCounter::AddNestedObjectIds — the outer object's owner holds
+    # a reference on the inner until the outer goes out of scope).
+    nested_holders: int = 0
     pinned: bool = False  # pinned primary copy (e.g. while spilling)
 
     def total(self) -> int:
-        return self.local_refs + self.submitted_task_refs + len(self.borrowers) + self.lineage_refs
+        return (self.local_refs + self.submitted_task_refs + len(self.borrowers)
+                + self.lineage_refs + self.pending_returns + self.nested_holders)
 
 
 class ReferenceCounter:
@@ -42,6 +53,9 @@ class ReferenceCounter:
         self._lock = threading.Lock()
         self._refs: dict[ObjectID, Reference] = {}
         self._on_zero: list[Callable[[ObjectID], None]] = []
+        # outer object -> ObjectIDs serialized inside it (released, possibly
+        # cascading, when the outer hits zero)
+        self._nested: dict[ObjectID, list[ObjectID]] = {}
 
     def add_on_zero_callback(self, cb: Callable[[ObjectID], None]) -> None:
         self._on_zero.append(cb)
@@ -85,6 +99,31 @@ class ReferenceCounter:
             zero = r.total() == 0 and not r.pinned
         if zero:
             self._fire_zero(oid)
+
+    # --- pending task returns ---
+    def add_pending_return(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._ref(oid).pending_returns += 1
+
+    def remove_pending_return(self, oid: ObjectID) -> None:
+        self._decrement(oid, "pending_returns")
+
+    # --- nested objects (refs serialized inside another object's value) ---
+    def add_nested_refs(self, outer: ObjectID, inners: list[ObjectID]) -> None:
+        """The value stored under `outer` embeds serialized refs to `inners`:
+        hold each inner until `outer` itself is released (reference:
+        reference_counter.cc AddNestedObjectIds)."""
+        if not inners:
+            return
+        with self._lock:
+            for oid in inners:
+                self._ref(oid).nested_holders += 1
+            self._nested.setdefault(outer, []).extend(inners)
+
+    def _release_nested(self, outer: ObjectID) -> None:
+        inners = self._nested.pop(outer, None)
+        for oid in inners or ():
+            self._decrement(oid, "nested_holders")  # may cascade
 
     # --- lineage pinning ---
     def add_lineage_ref(self, oid: ObjectID) -> None:
@@ -133,6 +172,7 @@ class ReferenceCounter:
                 cb(oid)
             except Exception:
                 pass
+        self._release_nested(oid)  # refs embedded in this value die with it
 
     # --- introspection (state API / tests) ---
     def ref_count(self, oid: ObjectID) -> int:

@@ -301,6 +301,9 @@ class Runtime:
         self._lock = threading.Lock()
         self._put_index = 0
         self._recovering: set[ObjectID] = set()
+        # task -> return ids pinned while the task is in flight (released
+        # exactly once by whichever store path lands first)
+        self._pending_return_pins: dict[TaskID, list[ObjectID]] = {}
         self._pending_queue: "queue.Queue[TaskID]" = queue.Queue()
         # Control plane: node agents register + heartbeat here; worker
         # processes connect as clients for nested API calls (reference: the
@@ -614,6 +617,12 @@ class Runtime:
         return_ids = spec.return_ids()
         for rid in return_ids:
             self._add_lineage(rid, spec)
+        # Actor creations store their marker via _store_value directly (no
+        # _store_returns/_store_error), so a pin would never release — and
+        # the marker needs no in-transit protection (the creating driver
+        # holds the actor handle).
+        if not isinstance(spec.num_returns, str) and not spec.is_actor_creation:
+            self._pin_pending_returns(spec.task_id, return_ids)
         with self._lock:
             self._tasks[spec.task_id] = _TaskEntry(spec)
         if isinstance(spec.num_returns, str):
@@ -849,8 +858,9 @@ class Runtime:
                         raise orig from None
                     raise RuntimeError(exc.remote_tb) from None
                 raise exc
-            status, payload, size = fut.result()
-            self._store_worker_result(spec, rids, status, payload, size)
+            status, payload, size, contained = fut.result()
+            self._store_worker_result(spec, rids, status, payload, size,
+                                      contained=contained)
             entry.state = "FINISHED"
             self._record_event(spec, "FINISHED")
         except TaskCancelledError as e:
@@ -1126,7 +1136,7 @@ class Runtime:
             self._store_returns(spec, result)
             return
         try:
-            status, payload, size = self._process_pool().execute_blob(
+            status, payload, size, contained = self._process_pool().execute_blob(
                 fn_blob, args_blob, result_oid_bin=oid_bin,
                 task_bin=spec.task_id.binary(),
             )
@@ -1138,10 +1148,22 @@ class Runtime:
                 orig.__ray_tpu_remote_tb__ = e.remote_tb
                 raise orig from None
             raise RuntimeError(e.remote_tb) from None
-        self._store_worker_result(spec, rids, status, payload, size)
+        self._store_worker_result(spec, rids, status, payload, size,
+                                  contained=contained)
 
     def _store_worker_result(self, spec, rids, status, payload, size,
-                             node_id: "NodeID | None" = None) -> None:
+                             node_id: "NodeID | None" = None,
+                             contained: "list[bytes] | None" = None) -> None:
+        # Refs serialized inside an opaque (never head-deserialized) result
+        # blob: register them as nested holders of the result BEFORE the
+        # result becomes visible, so they outlive the producing worker's
+        # borrow (reference: ReferenceCounter::AddNestedObjectIds fed by the
+        # worker's contained-ref report). Inline "val" results don't need
+        # this — the head deserializes them, and the rehydrated refs hold
+        # local references for the stored value's lifetime.
+        if contained:
+            self.reference_counter.add_nested_refs(
+                rids[0], [ObjectID(b) for b in contained])
         if status == "plane":
             # Result sealed+pinned in the executing node's local store (its
             # primary copy); the head records the location and serves gets by
@@ -1151,6 +1173,7 @@ class Runtime:
             self.memory_store.put(rids[0], RayObject(size=size or 0, in_shm=True))
             with self._lock:
                 self._recovering.discard(rids[0])
+            self._release_pending_returns(spec.task_id)
             return
         if status == "shm":
             # worker already sealed the result into the node store (zero-copy handoff)
@@ -1160,6 +1183,7 @@ class Runtime:
             self.memory_store.put(rids[0], RayObject(size=size or 0, in_shm=True))
             with self._lock:
                 self._recovering.discard(rids[0])
+            self._release_pending_returns(spec.task_id)
             return
         result = serialization.deserialize_from_bytes(payload)
         self._store_returns(spec, result)
@@ -1197,14 +1221,16 @@ class Runtime:
             self._store_returns(spec, result)
             return
         try:
-            status, payload, size = agent.call(
+            res = agent.call(
                 "execute_task", fn=fn_blob, args=args_blob, oid=oid_bin,
                 task=spec.task_id.binary(), renv=None, timeout=None,
             )
         except PeerDisconnected as e:
             raise ActorError(f"node agent died during task: {e}") from e
+        status, payload, size = res[0], res[1], res[2]
+        contained = res[3] if len(res) > 3 else None
         self._store_worker_result(spec, rids, status, payload, size,
-                                  node_id=entry.node_id)
+                                  node_id=entry.node_id, contained=contained)
 
     def _run_user_fn(self, entry: _TaskEntry, fn, args, kwargs):
         if entry.cancelled:
@@ -1256,12 +1282,31 @@ class Runtime:
         self._record_event(spec, "FAILED")
         self._store_error(spec, TaskError(exc, spec.desc()))
 
+    def _pin_pending_returns(self, task_id: TaskID, rids: list[ObjectID]) -> None:
+        """Hold the task's return objects while it is in flight (reference:
+        TaskManager return refs) — a consumer-side drop racing the result's
+        arrival must not delete a return that is still being produced."""
+        with self._lock:
+            self._pending_return_pins[task_id] = list(rids)
+        for rid in rids:
+            self.reference_counter.add_pending_return(rid)
+
+    def _release_pending_returns(self, task_id: TaskID) -> None:
+        """Idempotent (keyed pop): called from BOTH the success and error
+        store paths, which can each run once for the same task."""
+        with self._lock:
+            rids = self._pending_return_pins.pop(task_id, None)
+        for rid in rids or ():
+            self.reference_counter.remove_pending_return(rid)
+
     def _store_returns(self, spec: TaskSpec, result: Any) -> None:
         rids = spec.return_ids()
         if spec.num_returns == 1 or isinstance(spec.num_returns, str):
             self._store_value(rids[0], result)
+            self._release_pending_returns(spec.task_id)
             return
         if spec.num_returns == 0:
+            self._release_pending_returns(spec.task_id)
             return
         if not isinstance(result, (tuple, list)) or len(result) != spec.num_returns:
             raise ValueError(
@@ -1269,6 +1314,7 @@ class Runtime:
             )
         for rid, val in zip(rids, result):
             self._store_value(rid, val)
+        self._release_pending_returns(spec.task_id)
 
     def _store_error(self, spec: TaskSpec, err: BaseException) -> None:
         with self._lock:
@@ -1276,6 +1322,7 @@ class Runtime:
                 self._recovering.discard(rid)
         for rid in spec.return_ids():
             self.memory_store.put(rid, RayObject(error=err))
+        self._release_pending_returns(spec.task_id)
         stream = self._streams.get(spec.return_ids()[0])
         if stream is not None:
             with stream.cv:
@@ -1332,10 +1379,15 @@ class Runtime:
         self.memory_store.put(stream_id, RayObject(value=index, size=8))
 
     def _store_stream_item(self, spec: TaskSpec, stream, index: int,
-                           status: str, payload, extra) -> None:
+                           status: str, payload, extra,
+                           contained: "list[bytes] | None" = None) -> None:
         """Reader-thread callback: land one generator item (shm-sealed by the
         worker, or inline) and publish it to the stream."""
         item_id = ObjectID.for_task_return(spec.task_id, index + 1)
+        if contained:
+            # refs serialized inside an opaque item blob live while the item does
+            self.reference_counter.add_nested_refs(
+                item_id, [ObjectID(b) for b in contained])
         if status == "shm":
             self.shm_store.pin(item_id)
             if self.spill is not None:
@@ -1378,12 +1430,12 @@ class Runtime:
             return
         handle = self._process_pool().submit_generator(
             fn_blob, args_blob, spec.task_id.binary(),
-            on_item=lambda i, st, p, e: self._store_stream_item(spec, stream, i, st, p, e),
+            on_item=lambda i, st, p, e, c=None: self._store_stream_item(spec, stream, i, st, p, e, c),
             backpressure=self.config.generator_backpressure_num_objects,
         )
         stream.gen_handle = handle
         try:
-            status, count, _ = handle.future.result()
+            status, count = handle.future.result()[:2]
         except _RemoteTaskError as e:
             orig = e.original_exception()
             if orig is not None:
@@ -1751,13 +1803,13 @@ class Runtime:
             stream.cv.notify_all()
         call = proc_worker.submit_call(
             spec.method_name, args_blob, None,
-            on_item=lambda i, st, p, e: self._store_stream_item(spec, stream, i, st, p, e),
+            on_item=lambda i, st, p, e, c=None: self._store_stream_item(spec, stream, i, st, p, e, c),
             task_bin=spec.task_id.binary(),
             backpressure=self.config.generator_backpressure_num_objects,
         )
         stream.gen_handle = call
         try:
-            _, count, _ = call.future.result()
+            count = call.future.result()[1]
         except _RemoteTaskError as e:
             orig = e.original_exception()
             if orig is not None:
@@ -1801,10 +1853,11 @@ class Runtime:
                 # the dedicated worker with consumed-count backpressure
                 self._run_proc_actor_generator(spec, proc_worker, args_blob)
             else:
-                status, payload, size = proc_worker.call(
-                    spec.method_name, args_blob, oid_bin
-                )
-                self._store_worker_result(spec, rids, status, payload, size)
+                res = proc_worker.call(spec.method_name, args_blob, oid_bin)
+                status, payload, size = res[0], res[1], res[2]
+                contained = res[3] if len(res) > 3 else None
+                self._store_worker_result(spec, rids, status, payload, size,
+                                          contained=contained)
             _finish("FINISHED")
             return False
         except WorkerCrashedError:
@@ -1900,6 +1953,8 @@ class Runtime:
         mailbox = state.mailbox_for(spec)  # raises on unknown group pre-enqueue
         dep_refs = _ref_args(spec.args, spec.kwargs)
         self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
+        if not isinstance(spec.num_returns, str):
+            self._pin_pending_returns(spec.task_id, spec.return_ids())
         with self._lock:
             self._tasks[spec.task_id] = _TaskEntry(spec)
         for rid in spec.return_ids():

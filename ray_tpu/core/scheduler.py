@@ -59,6 +59,10 @@ class NodeState:
     # TPU topology (SURVEY §7.3): slice name + torus coordinates for ICI-aware packing
     slice_name: str | None = None
     ici_coords: tuple[int, int, int] | None = None
+    # Cordoned for graceful shutdown: no NEW placements; existing work runs
+    # to completion (reference: autoscaler v2 drain protocol / DrainNode rpc,
+    # node_manager.cc HandleDrainRaylet)
+    draining: bool = False
 
     def utilization(self) -> float:
         tot = sum(v for v in self.total.values() if v > 0)
@@ -137,6 +141,32 @@ class ClusterScheduler:
                 n.alive = False
             self._lock.notify_all()
 
+    def drain_node(self, node_id: NodeID) -> bool:
+        """Cordon: stop placing new work on the node; running work finishes.
+        Returns False for unknown/dead nodes. (Reference: DrainNode rpc /
+        autoscaler v2 drain-before-terminate.)"""
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None or not n.alive:
+                return False
+            n.draining = True
+            return True
+
+    def undrain_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is not None:
+                n.draining = False
+                self._lock.notify_all()
+
+    def node_is_idle(self, node_id: NodeID) -> bool:
+        """Nothing currently placed: available == total on every resource."""
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None:
+                return True
+            return all(n.available.get(k, 0.0) == v for k, v in n.total.items())
+
     def nodes(self) -> list[NodeState]:
         with self._lock:
             return [n for n in self._nodes.values()]
@@ -192,7 +222,7 @@ class ClusterScheduler:
         return out
 
     def _feasible(self, node: NodeState, resources: ResourceSet, req: SchedulingRequest) -> bool:
-        if not node.alive:
+        if not node.alive or node.draining:
             return False
         if req.label_selector:
             for k, v in req.label_selector.items():
@@ -262,7 +292,7 @@ class ClusterScheduler:
             return True
 
     def _plan_bundles(self, pg: PlacementGroupState) -> list[NodeState] | None:
-        nodes = [n for n in self._nodes.values() if n.alive]
+        nodes = [n for n in self._nodes.values() if n.alive and not n.draining]
         if pg.slice_name is not None:
             nodes = [n for n in nodes if n.slice_name == pg.slice_name]
         if not nodes:

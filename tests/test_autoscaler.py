@@ -79,7 +79,8 @@ def test_idle_nodes_terminated():
     assert len(provider.non_terminated_instances()) == 1
     scaler.reconcile()  # records idle_since
     time.sleep(0.3)
-    scaler.reconcile()  # terminates
+    scaler.reconcile()  # cordons (DRAINING)
+    scaler.reconcile()  # verifies still idle -> terminates
     assert len(provider.non_terminated_instances()) == 0
 
 
@@ -262,3 +263,81 @@ def test_tpu_vm_provider_tracks_instances():
     p.terminate([insts[0].instance_id])
     assert len(p.non_terminated_instances()) == 1
     assert len(calls) == 3  # 2 creates + 1 delete
+
+
+def test_pending_slice_pg_provisions_fake_slice_and_drains():
+    """E2E (reference: autoscaler/v2 reconciler.py:59 + scheduler.py:895):
+    a PENDING whole-slice placement group drives demand-based launch of fake
+    v5p hosts that join the named slice; once the PG is released and the
+    hosts idle past the timeout, the reconciler cordons (DRAINING) then
+    terminates them — drain-before-terminate, never a hard yank."""
+    from ray_tpu.autoscaler.node_provider import InstanceStatus
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    provider = FakeNodeProvider(
+        {
+            "v5p-host": {
+                "resources": {"CPU": 8.0, "TPU": 4.0},
+                "labels": {"tpu-slice": "fake-v5p-16"},
+                "slice_name": "fake-v5p-16",
+            }
+        },
+        runtime=rt,
+    )
+    cfg = AutoscalingConfig(
+        node_types=[NodeTypeConfig("v5p-host", {"CPU": 8.0, "TPU": 4.0},
+                                   min_workers=0, max_workers=4)],
+        idle_timeout_s=0.2,
+        tick_interval_s=0.05,
+    )
+    scaler = Autoscaler(cfg, provider, runtime=rt)
+
+    # whole-slice reservation: one TPU bundle per host, pinned to the slice
+    pg = ray_tpu.placement_group(
+        [{"TPU": 4.0}, {"TPU": 4.0}], strategy="STRICT_SPREAD",
+        _slice_name="fake-v5p-16",
+    )
+    assert not pg.wait(timeout_seconds=0.1)  # no such nodes yet -> pending
+
+    deadline = time.time() + 20
+    while time.time() < deadline and not pg.wait(timeout_seconds=0.05):
+        scaler.reconcile()
+        time.sleep(0.05)
+    assert pg.wait(timeout_seconds=1), "slice PG never became ready"
+    hosts = [n for n in rt.scheduler.nodes()
+             if n.alive and n.slice_name == "fake-v5p-16"]
+    assert len(hosts) >= 2
+
+    # release the slice -> hosts idle -> DRAINING -> terminated
+    ray_tpu.remove_placement_group(pg)
+    saw_draining = False
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        scaler.reconcile()
+        insts = provider.non_terminated_instances()
+        if any(i.status == InstanceStatus.DRAINING for i in insts):
+            saw_draining = True
+        if not insts:
+            break
+        time.sleep(0.05)
+    assert saw_draining, "reconciler never cordoned the idle hosts"
+    assert provider.non_terminated_instances() == []
+
+
+def test_drained_node_gets_no_new_work():
+    """A cordoned node must reject new placements while alive."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    nid = rt.scheduler.add_node({"CPU": 4.0, "gpu_like": 1.0})
+    assert rt.scheduler.drain_node(nid)
+
+    @ray_tpu.remote(num_cpus=1, resources={"gpu_like": 1})
+    def probe():
+        return 1
+
+    ready, not_ready = ray_tpu.wait([probe.remote()], timeout=1.0)
+    assert not ready  # only feasible node is cordoned -> stays queued
+    rt.scheduler.undrain_node(nid)
+    assert ray_tpu.get(not_ready[0], timeout=30) == 1
