@@ -7,6 +7,7 @@ task pipelines; `iter_batches(batch_format="jax")` lands batches in HBM.
 
 from ray_tpu.data.block import Block
 from ray_tpu.data.dataset import DataIterator, Dataset
+from ray_tpu.data.executor import ActorPoolStrategy
 from ray_tpu.data.read_api import (
     from_arrow,
     from_huggingface,

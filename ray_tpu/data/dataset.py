@@ -22,7 +22,12 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 import numpy as np
 
 from ray_tpu.data.block import Block, Row
-from ray_tpu.data.executor import OutputSplitter, PhysicalOp, execute_streaming
+from ray_tpu.data.executor import (
+    ActorPoolStrategy,
+    OutputSplitter,
+    PhysicalOp,
+    execute_streaming,
+)
 
 
 @dataclass(frozen=True)
@@ -45,11 +50,18 @@ class Dataset:
         return Dataset(self._source_fn, self._ops + (op,), self._name)
 
     def map_batches(self, fn: Callable, *, batch_size: int | None = None,
-                    batch_format: str = "numpy", num_cpus: float = 1.0, **_) -> "Dataset":
-        """Reference: dataset.py:531."""
+                    batch_format: str = "numpy", num_cpus: float = 1.0,
+                    compute=None, memory_budget_bytes: int | None = None,
+                    **_) -> "Dataset":
+        """Reference: dataset.py:531. ``compute=ActorPoolStrategy(...)`` runs
+        `fn` (a callable class is constructed once per pool actor) on
+        long-lived actors; ``memory_budget_bytes`` caps the stage's in-flight
+        input bytes (memory-aware backpressure)."""
         return self._append(LogicalOp("map_batches", fn,
                                       dict(batch_size=batch_size, batch_format=batch_format,
-                                           num_cpus=num_cpus), name=getattr(fn, "__name__", "fn")))
+                                           num_cpus=num_cpus, compute=compute,
+                                           memory_budget_bytes=memory_budget_bytes),
+                                      name=getattr(fn, "__name__", "fn")))
 
     def map(self, fn: Callable[[Row], Row], **kw) -> "Dataset":
         return self._append(LogicalOp("map", fn, kw, name=getattr(fn, "__name__", "fn")))
@@ -185,9 +197,33 @@ class Dataset:
     @staticmethod
     def _compile_op(op: LogicalOp) -> PhysicalOp:
         if op.kind == "map_batches":
+            compute = op.kwargs.get("compute") or "tasks"
+            factory = None
+            transform = None
+            if isinstance(compute, ActorPoolStrategy):
+                # a class UDF constructs once per pool actor; a plain callable
+                # is shared as-is (reference: compute.py ActorPoolStrategy)
+                fn, kw = op.fn, op.kwargs
+
+                def factory(fn=fn, kw=kw):
+                    udf = fn() if isinstance(fn, type) else fn
+                    return _make_map_batches(udf, kw)
+
+            elif isinstance(op.fn, type):
+                # reference compute.py raises the same requirement: a class
+                # UDF needs actor-pool compute (stateless tasks would
+                # construct it per batch — or worse, WITH the batch)
+                raise ValueError(
+                    f"map_batches got the class {op.fn.__name__!r}; callable-"
+                    "class UDFs require compute=ActorPoolStrategy(...)")
+            else:
+                transform = _make_map_batches(op.fn, op.kwargs)
             return PhysicalOp(f"MapBatches({op.name})",
-                              _make_map_batches(op.fn, op.kwargs),
-                              num_cpus=op.kwargs.get("num_cpus", 1.0))
+                              transform,
+                              num_cpus=op.kwargs.get("num_cpus", 1.0),
+                              compute=compute,
+                              transform_factory=factory,
+                              memory_budget_bytes=op.kwargs.get("memory_budget_bytes"))
         if op.kind == "map":
             return PhysicalOp(f"Map({op.name})", _make_row_op(op.fn, "map"))
         if op.kind == "flat_map":

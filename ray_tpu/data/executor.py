@@ -32,13 +32,36 @@ class OpStats:
 
 
 @dataclass
+class ActorPoolStrategy:
+    """Run a stage's UDF on a pool of long-lived actors instead of stateless
+    tasks — stateful/expensive-to-construct UDFs (model replicas, tokenizers)
+    initialize once per actor (reference:
+    data/_internal/execution/operators/actor_pool_map_operator.py +
+    ActorPoolStrategy in compute.py)."""
+
+    size: int = 2
+    max_tasks_in_flight_per_actor: int = 2
+
+
+@dataclass
 class PhysicalOp:
-    """One pipeline stage: Block -> list[Block] executed as a ray_tpu task."""
+    """One pipeline stage: Block -> list[Block] executed as a ray_tpu task
+    (or on an actor pool, see `compute`)."""
 
     name: str
     transform: Callable[[Block], list[Block]]
     num_cpus: float = 1.0
     max_in_flight: int = 4
+    # "tasks" | ActorPoolStrategy — the reference's compute strategy knob
+    compute: Any = "tasks"
+    # Constructed once per pool actor (stateful UDFs); falls back to
+    # `transform` when None.
+    transform_factory: Callable[[], Callable[[Block], list[Block]]] | None = None
+    # Memory-aware backpressure: stop pulling upstream while the estimated
+    # bytes of in-flight input blocks exceed this budget (reference:
+    # streaming_executor_state.py:841 under_resource_limits +
+    # backpressure_policy/). None = window-only backpressure.
+    memory_budget_bytes: int | None = None
 
 
 def execute_streaming(
@@ -49,9 +72,10 @@ def execute_streaming(
 ) -> Iterator[Block]:
     """Run blocks from `source` through `ops`, yielding result blocks.
 
-    Each op keeps ≤ max_in_flight tasks outstanding; completed blocks flow to
-    the next op without waiting for stage completion (streaming, not bulk).
-    Per-op counters land in `stats_sink` (reference: data stats.py).
+    Each op keeps ≤ max_in_flight tasks outstanding (and ≤ its memory
+    budget); completed blocks flow to the next op without waiting for stage
+    completion (streaming, not bulk). Per-op counters land in `stats_sink`
+    (reference: data stats.py).
     """
     # NOTE: not a generator — stats register eagerly (in pipeline order) even
     # though block flow is lazy; the inner generator does the streaming.
@@ -64,40 +88,90 @@ def execute_streaming(
     return stream
 
 
+class _TransformActor:
+    """Pool actor hosting one constructed-once transform (reference:
+    actor_pool_map_operator's _MapWorker)."""
+
+    def __init__(self, factory):
+        self._transform = factory()
+
+    def run(self, block):
+        return self._transform(block)
+
+
 def _apply_op(
     upstream: Iterator[Block], op: PhysicalOp, stats: OpStats, preserve_order: bool
 ) -> Iterator[Block]:
-    remote_fn = ray_tpu.remote(num_cpus=op.num_cpus, name=f"data::{op.name}")(
-        _run_transform
-    )
-    in_flight: list = []
+    pool = None
+    loads: dict = {}
+    if isinstance(op.compute, ActorPoolStrategy):
+        factory = op.transform_factory or (lambda t=op.transform: t)
+        actor_cls = ray_tpu.remote(num_cpus=op.num_cpus)(_TransformActor)
+        pool = [actor_cls.remote(factory) for _ in range(max(1, op.compute.size))]
+        loads = {i: 0 for i in range(len(pool))}
+        window = len(pool) * max(1, op.compute.max_tasks_in_flight_per_actor)
+    else:
+        remote_fn = ray_tpu.remote(num_cpus=op.num_cpus, name=f"data::{op.name}")(
+            _run_transform
+        )
+        window = op.max_in_flight
+
+    def submit(blk):
+        if pool is None:
+            return remote_fn.remote(op.transform, blk), None
+        idx = min(loads, key=loads.get)  # least-loaded actor
+        loads[idx] += 1
+        return pool[idx].run.remote(blk), idx
+
+    in_flight: list = []   # [(ref, actor_idx|None, est_bytes)]
+    in_flight_bytes = 0
     upstream_done = False
     up = iter(upstream)
-    while True:
-        # fill the window (backpressure bound)
-        while not upstream_done and len(in_flight) < op.max_in_flight:
-            try:
-                blk = next(up)
-            except StopIteration:
-                upstream_done = True
-                break
-            stats.blocks_in += 1
-            in_flight.append(remote_fn.remote(op.transform, blk))
-        if not in_flight:
-            if upstream_done:
-                return
-            continue
-        if preserve_order:
-            ready_ref = in_flight.pop(0)
+    try:
+        while True:
+            # fill the window (concurrency AND memory backpressure; always
+            # admit one so an over-budget single block still makes progress)
+            while not upstream_done and len(in_flight) < window and (
+                op.memory_budget_bytes is None
+                or in_flight_bytes < op.memory_budget_bytes
+                or not in_flight
+            ):
+                try:
+                    blk = next(up)
+                except StopIteration:
+                    upstream_done = True
+                    break
+                stats.blocks_in += 1
+                est = blk.size_bytes()
+                ref, idx = submit(blk)
+                in_flight.append((ref, idx, est))
+                in_flight_bytes += est
+            if not in_flight:
+                if upstream_done:
+                    return
+                continue
+            if preserve_order:
+                ready_ref, idx, est = in_flight.pop(0)
+            else:
+                ready, _ = ray_tpu.wait([r for r, _, _ in in_flight],
+                                        num_returns=1, timeout=None)
+                pos = next(i for i, (r, _, _) in enumerate(in_flight)
+                           if r == ready[0])
+                ready_ref, idx, est = in_flight.pop(pos)
+            in_flight_bytes -= est
+            if idx is not None:
+                loads[idx] -= 1
             out_blocks = ray_tpu.get(ready_ref)
-        else:
-            ready, _ = ray_tpu.wait(in_flight, num_returns=1, timeout=None)
-            in_flight.remove(ready[0])
-            out_blocks = ray_tpu.get(ready[0])
-        for b in out_blocks:
-            stats.blocks_out += 1
-            stats.rows_out += b.num_rows()
-            yield b
+            for b in out_blocks:
+                stats.blocks_out += 1
+                stats.rows_out += b.num_rows()
+                yield b
+    finally:
+        for a in pool or ():
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
 
 
 def _run_transform(transform: Callable[[Block], list[Block]], block: Block) -> list[Block]:

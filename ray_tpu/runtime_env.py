@@ -43,6 +43,13 @@ class RuntimeEnvPlugin:
     def delete_uri(self, uri: str) -> None:
         pass
 
+    def cache_key(self, value: Any) -> Optional[str]:
+        """Extra component for the build_context memo key. Plugins whose
+        environments are CONTENT-addressed (uv/working_dir) return their
+        content hash here so an edited source tree misses the context cache
+        instead of silently reusing the stale environment."""
+        return None
+
 
 class RuntimeEnvContext:
     """Accumulated environment changes applied around task execution."""
@@ -108,6 +115,13 @@ class WorkingDirPlugin(RuntimeEnvPlugin):
     def delete_uri(self, uri: str) -> None:
         dest = os.path.join(self.CACHE, uri.split("//")[1])
         shutil.rmtree(dest, ignore_errors=True)
+        _drop_cached_contexts_referencing(dest)
+
+    def cache_key(self, value) -> Optional[str]:
+        try:
+            return self.uri_for(value)
+        except OSError:
+            return None
 
 
 class PyModulesPlugin(RuntimeEnvPlugin):
@@ -155,8 +169,130 @@ class PipPlugin(RuntimeEnvPlugin):
 
 
 class UvPlugin(PipPlugin):
+    """REAL uv installs (reference: runtime_env/uv.py): each distinct spec
+    list gets a venv keyed by its content hash, created once and reused by
+    every task/worker that names the same spec (reference: uri_cache.py).
+
+    Hermetic by construction: installs run `--offline` (this image has no
+    egress), so specs must be local paths / wheels / sdists — exactly what
+    the tests exercise. Packages land in a plain `--target` directory (no
+    venv: a venv would chain to the BASE interpreter and lose the driver
+    env's setuptools/numpy) that is appended to the task's py_paths, which
+    both the in-process and OS-worker execution paths apply — task code
+    sees the env's packages ON TOP of the driver environment.
+
+    An explicit `UvPlugin.installer` hook still overrides (operator-supplied
+    installer for networked environments)."""
+
     name = "uv"
     installer: Optional[Callable] = None  # independent of PipPlugin.installer
+    CACHE = os.path.join(tempfile.gettempdir(), "ray_tpu_runtime_env", "uv_envs")
+
+    # setuptools writes these into the SOURCE tree on no-isolation builds;
+    # hashing them would give every install a fresh key (cache never hits)
+    _HASH_EXCLUDE = ("build", "dist", "__pycache__", ".git")
+
+    def uri_for(self, specs: list) -> str:
+        h = hashlib.sha256()
+        h.update(sys.version.encode())
+        for s in sorted(specs):
+            h.update(b"\0" + s.encode())
+            # local paths install by content, so the content keys the env
+            p = s.split("==")[0]
+            if os.path.isdir(p):
+                # walk LAZILY: pruning dirs[:] only affects traversal when
+                # the generator hasn't been exhausted (sorted(os.walk()) would
+                # materialize everything first and ignore the prune); sorting
+                # dirs in place also makes the traversal order deterministic
+                for root, dirs, files in os.walk(p):
+                    dirs[:] = sorted(d for d in dirs
+                                     if d not in self._HASH_EXCLUDE
+                                     and not d.endswith(".egg-info"))
+                    for f in sorted(files):
+                        fp = os.path.join(root, f)
+                        h.update(fp.encode())
+                        with open(fp, "rb") as fh:
+                            h.update(fh.read())
+            elif os.path.isfile(p):
+                with open(p, "rb") as fh:
+                    h.update(fh.read())
+        return f"uv://{h.hexdigest()[:16]}"
+
+    def create(self, value, context):
+        if not value:
+            return
+        if type(self).installer is not None:  # operator hook wins
+            prefix = type(self).installer(value)
+            if prefix:
+                context.py_paths.append(prefix)
+            return
+        uv = shutil.which("uv")
+        if uv is None:
+            raise RuntimeError(
+                "runtime_env 'uv' requires the uv binary (not found on PATH) "
+                "or a UvPlugin.installer hook")
+        uri = self.uri_for(value)
+        env_dir = os.path.join(self.CACHE, uri.split("//")[1])
+        marker = os.path.join(env_dir, ".ray_tpu_ok")
+        if not os.path.exists(marker):
+            import subprocess
+
+            os.makedirs(self.CACHE, exist_ok=True)
+            tmp = f"{env_dir}.tmp-{uuid.uuid4().hex[:8]}"
+            try:
+                os.makedirs(tmp, exist_ok=True)
+                subprocess.run(
+                    [uv, "pip", "install", "--python", sys.executable,
+                     "--target", tmp, "--offline", "--no-build-isolation",
+                     *value],
+                    check=True, capture_output=True, text=True, timeout=600)
+                with open(os.path.join(tmp, ".ray_tpu_ok"), "w") as f:
+                    f.write(uri)
+                try:
+                    os.rename(tmp, env_dir)  # atomic publish; loser cleans up
+                except OSError:
+                    shutil.rmtree(tmp, ignore_errors=True)
+            except subprocess.CalledProcessError as e:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"uv install failed for {value}: {e.stderr[-500:]}") from e
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+        os.utime(env_dir)  # LRU touch for gc()
+        context.py_paths.append(env_dir)
+
+    def cache_key(self, value) -> Optional[str]:
+        try:
+            return self.uri_for(value)
+        except OSError:
+            return None
+
+    def delete_uri(self, uri: str) -> None:
+        path = os.path.join(self.CACHE, uri.split("//")[1])
+        shutil.rmtree(path, ignore_errors=True)
+        _drop_cached_contexts_referencing(path)
+
+    @classmethod
+    def gc(cls, max_envs: int = 8) -> list[str]:
+        """Evict least-recently-used COMPLETED envs beyond `max_envs`
+        (reference: uri_cache.py size-bounded eviction). In-progress
+        `.tmp-*` install dirs are never counted or touched. Returns removed
+        env names; memoized contexts referencing them are invalidated so the
+        next task rebuilds instead of importing from a deleted path."""
+        try:
+            entries = [(os.path.getmtime(os.path.join(cls.CACHE, d)), d)
+                       for d in os.listdir(cls.CACHE) if ".tmp-" not in d]
+        except OSError:
+            return []
+        entries.sort(reverse=True)  # newest first
+        removed = []
+        for _, d in entries[max_envs:]:
+            path = os.path.join(cls.CACHE, d)
+            shutil.rmtree(path, ignore_errors=True)
+            _drop_cached_contexts_referencing(path)
+            removed.append(d)
+        return removed
 
 
 _PLUGINS: dict[str, RuntimeEnvPlugin] = {
@@ -184,15 +320,33 @@ _CTX_CACHE: dict[str, RuntimeEnvContext] = {}
 _CTX_CACHE_LOCK = threading.Lock()
 
 
+def _drop_cached_contexts_referencing(path: str) -> None:
+    """Evict memoized contexts whose py_paths point inside `path` (the env
+    was deleted; serving the cached context would ImportError forever)."""
+    with _CTX_CACHE_LOCK:
+        stale = [k for k, ctx in _CTX_CACHE.items()
+                 if any(p == path or p.startswith(path + os.sep)
+                        for p in ctx.py_paths)]
+        for k in stale:
+            _CTX_CACHE.pop(k, None)
+
+
 def build_context(runtime_env: dict) -> RuntimeEnvContext:
     """Build (memoized) — identical runtime_env dicts share one context, so the
     working_dir content hash/copy is paid once per env, not once per task
-    (reference: URI-keyed caching in runtime_env/packaging.py)."""
+    (reference: URI-keyed caching in runtime_env/packaging.py). Plugins with
+    content-addressed environments extend the key via cache_key() so edits to
+    a referenced source tree rebuild instead of reusing the stale context."""
     import json
 
     try:
         key = json.dumps(runtime_env, sort_keys=True, default=repr)
-    except TypeError:
+        for k, v in sorted((runtime_env or {}).items()):
+            plugin = _PLUGINS.get(k)
+            extra = plugin.cache_key(v) if plugin is not None else None
+            if extra:
+                key += f"|{k}={extra}"
+    except (TypeError, OSError):
         key = None
     if key is not None:
         with _CTX_CACHE_LOCK:

@@ -341,3 +341,104 @@ def test_drained_node_gets_no_new_work():
     assert not ready  # only feasible node is cordoned -> stays queued
     rt.scheduler.undrain_node(nid)
     assert ray_tpu.get(not_ready[0], timeout=30) == 1
+
+
+# ---------------------------------------------------------------- runtime_env uv
+
+
+def _make_tiny_pkg(root, version="9.9.1"):
+    import pathlib
+
+    pkg = pathlib.Path(root) / "rtpkg_tiny"
+    (pkg / "rtpkg_tiny").mkdir(parents=True)
+    (pkg / "rtpkg_tiny" / "__init__.py").write_text(
+        f'__version__ = "{version}"\n')
+    (pkg / "pyproject.toml").write_text(
+        '[build-system]\nrequires = ["setuptools"]\n'
+        'build-backend = "setuptools.build_meta"\n'
+        f'[project]\nname = "rtpkg-tiny"\nversion = "{version}"\n')
+    return str(pkg)
+
+
+def test_runtime_env_uv_real_install(tmp_path):
+    """runtime_env uv performs a REAL (hermetic, --offline local-path)
+    install into a spec-hash-keyed cached env; the task imports a package
+    the driver does not have (reference: runtime_env/uv.py + uri_cache.py).
+    Done-criterion test from VERDICT r3 #8."""
+    import shutil as _shutil
+
+    if _shutil.which("uv") is None:
+        pytest.skip("uv binary not in image")
+    from ray_tpu.runtime_env import UvPlugin
+
+    pkg = _make_tiny_pkg(tmp_path)
+
+    with pytest.raises(ImportError):
+        import rtpkg_tiny  # noqa: F401 - driver must not see it
+
+    @ray_tpu.remote(isolate_process=True, runtime_env={"uv": [pkg]})
+    def probe():
+        import rtpkg_tiny
+
+        return rtpkg_tiny.__version__
+
+    assert ray_tpu.get(probe.remote(), timeout=180) == "9.9.1"
+
+    # cached reuse: same spec resolves to the same env dir (one entry)
+    plugin = UvPlugin()
+    uri = plugin.uri_for([pkg])
+    env_dir = os.path.join(UvPlugin.CACHE, uri.split("//")[1])
+    assert os.path.exists(os.path.join(env_dir, ".ray_tpu_ok"))
+    before = os.path.getmtime(env_dir)
+    assert ray_tpu.get(probe.remote(), timeout=60) == "9.9.1"
+    assert os.path.exists(env_dir)  # no rebuild churn
+    assert os.path.getmtime(env_dir) >= before  # LRU touch
+
+    # targeted eviction of OUR env only (gc() eviction is covered by
+    # test_uv_gc_lru below against an isolated cache — a blanket
+    # gc(max_envs=0) here would wipe envs shared with concurrent runs)
+    plugin.delete_uri(uri)
+    assert not os.path.exists(env_dir)
+
+
+def test_runtime_env_uv_content_keyed(tmp_path):
+    """Changing the package CONTENT changes the env key (content-addressed,
+    like the reference's working_dir packaging)."""
+    pkg = _make_tiny_pkg(tmp_path, version="1.0.0")
+    from ray_tpu.runtime_env import UvPlugin
+
+    plugin = UvPlugin()
+    u1 = plugin.uri_for([pkg])
+    with open(os.path.join(pkg, "rtpkg_tiny", "__init__.py"), "a") as f:
+        f.write("extra = 1\n")
+    assert plugin.uri_for([pkg]) != u1
+
+
+def test_uv_gc_lru(tmp_path, monkeypatch):
+    """gc() evicts oldest completed envs beyond the cap, never .tmp dirs,
+    and invalidates memoized contexts referencing evicted envs."""
+    from ray_tpu import runtime_env as renv
+    from ray_tpu.runtime_env import UvPlugin
+
+    monkeypatch.setattr(UvPlugin, "CACHE", str(tmp_path / "uv_envs"))
+    cache = tmp_path / "uv_envs"
+    cache.mkdir()
+    for i, name in enumerate(["aaa", "bbb", "ccc"]):
+        d = cache / name
+        d.mkdir()
+        (d / ".ray_tpu_ok").write_text(f"uv://{name}")
+        os.utime(d, (i, i))  # aaa oldest
+    (cache / "ddd.tmp-deadbeef").mkdir()  # in-progress install
+
+    # a memoized context pointing at the oldest env
+    ctx = renv.RuntimeEnvContext()
+    ctx.py_paths.append(str(cache / "aaa"))
+    with renv._CTX_CACHE_LOCK:
+        renv._CTX_CACHE["synthetic"] = ctx
+
+    removed = UvPlugin.gc(max_envs=2)
+    assert removed == ["aaa"]
+    assert (cache / "bbb").exists() and (cache / "ccc").exists()
+    assert (cache / "ddd.tmp-deadbeef").exists()  # never touched
+    with renv._CTX_CACHE_LOCK:
+        assert "synthetic" not in renv._CTX_CACHE  # stale context dropped
