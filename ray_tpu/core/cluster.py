@@ -245,6 +245,11 @@ class ControlPlane:
         if nid is not None:
             with self._hb_lock:
                 self._hb[nid] = time.monotonic()
+            stats = msg.get("stats")
+            if stats:
+                # per-node physical stats for the dashboard/state API
+                # (reference: reporter agent -> GcsNodeResourceInfo)
+                self.runtime.node_stats[nid] = {**stats, "ts": time.time()}
         return True
 
     # ---- worker/client object plane

@@ -193,13 +193,56 @@ def main() -> None:
         cgroup_manager=cgroups,
     )
 
+    def _node_stats() -> dict:
+        """Per-node physical stats shipped with every heartbeat (reference:
+        dashboard/modules/reporter agent — psutil loop; here plain /proc
+        reads so agents stay dependency-free)."""
+        st: dict = {"pid": os.getpid()}
+        try:
+            with open("/proc/loadavg") as f:
+                st["load1"] = float(f.read().split()[0])
+        except (OSError, ValueError):
+            pass
+        try:
+            mem = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    mem[k] = int(v.split()[0])
+            st["mem_total_mb"] = mem.get("MemTotal", 0) // 1024
+            st["mem_available_mb"] = mem.get("MemAvailable", 0) // 1024
+        except (OSError, ValueError):
+            pass
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        st["agent_rss_mb"] = int(line.split()[1]) // 1024
+                        break
+        except (OSError, ValueError):
+            pass
+        pool = pool_box.get("pool")
+        if pool is not None:
+            try:
+                st["workers_alive"] = pool.num_alive
+            except Exception:
+                pass
+        if local_store is not None:
+            try:
+                s = local_store.stats()
+                st["store_used_mb"] = int(s["bytes_in_use"]) >> 20
+                st["store_cap_mb"] = int(s["arena_size"]) >> 20
+            except Exception:
+                pass
+        return st
+
     # Heartbeat until the head goes away, then exit (reference: raylet dies
     # when the GCS connection is lost).
     period = float(os.environ.get("RAY_TPU_AGENT_HEARTBEAT_PERIOD_S", "0.5"))
     try:
         while not peer.closed:
             try:
-                peer.notify("heartbeat")
+                peer.notify("heartbeat", stats=_node_stats())
             except wire.PeerDisconnected:
                 break
             time.sleep(period)

@@ -304,6 +304,9 @@ class Runtime:
         # task -> return ids pinned while the task is in flight (released
         # exactly once by whichever store path lands first)
         self._pending_return_pins: dict[TaskID, list[ObjectID]] = {}
+        # node -> latest heartbeat-reported physical stats (dashboard's
+        # per-node rows; reference: reporter agent feed)
+        self.node_stats: dict[NodeID, dict] = {}
         self._pending_queue: "queue.Queue[TaskID]" = queue.Queue()
         # Control plane: node agents register + heartbeat here; worker
         # processes connect as clients for nested API calls (reference: the
@@ -1030,6 +1033,7 @@ class Runtime:
         its in-flight dispatches fail with PeerDisconnected and retry onto
         surviving nodes (reference: node death -> task FT + lineage rebuild)."""
         self._agents.pop(node_id, None)
+        self.node_stats.pop(node_id, None)  # no live-looking stats on a dead row
         # Objects whose only copies lived on the dead node are now lost; the
         # next access misses the directory and falls to lineage reconstruction.
         with self._lock:
@@ -2109,6 +2113,40 @@ class Runtime:
                 }
                 for t in self._tasks.values()
             ]
+
+    def task_detail(self, task_id_hex: str) -> dict | None:
+        """Single-task drill-down: spec metadata + the state-transition
+        timeline (reference: `ray get tasks <id>` over gcs_task_manager's
+        per-task events)."""
+        try:
+            tid = TaskID(bytes.fromhex(task_id_hex))
+        except ValueError:
+            return None
+        with self._lock:
+            entry = self._tasks.get(tid)
+            if entry is None:
+                return None
+            events = [dict(e) for e in self._task_events
+                      if e["task_id"] == task_id_hex]
+        spec = entry.spec
+        return {
+            "task_id": task_id_hex,
+            "name": spec.desc(),
+            "state": entry.state,
+            "attempts": entry.attempts,
+            "node_id": entry.node_id.hex() if entry.node_id else None,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "resources": dict(spec.resources or {}),
+            "num_returns": spec.num_returns,
+            "isolate_process": bool(spec.isolate_process),
+            "runtime_env": bool(spec.runtime_env),
+            "start_time": entry.start_time,
+            "end_time": entry.end_time,
+            "duration_s": (round(entry.end_time - entry.start_time, 4)
+                           if entry.start_time and entry.end_time else None),
+            "error": entry.error,
+            "events": events,
+        }
 
     def list_actors(self) -> list[dict]:
         return [

@@ -63,6 +63,14 @@ class Dashboard:
                 return web.json_response({"error": f"unknown resource {resource}"}, status=404)
             return web.json_response(jsonable(fn()))
 
+        async def task_detail(request):
+            from ray_tpu.util import state as st
+
+            detail = st.get_task(request.match_info["task_id"])
+            if detail is None:
+                return web.json_response({"error": "unknown task"}, status=404)
+            return web.json_response(jsonable(detail))
+
         async def state_summarize(request):
             from ray_tpu.util import state as st
 
@@ -149,6 +157,7 @@ class Dashboard:
             app.router.add_get("/", index)
             app.router.add_get("/api/cluster_status", cluster_status)
             app.router.add_get("/api/v0/{resource}/summarize", state_summarize)
+            app.router.add_get("/api/v0/tasks/{task_id:[0-9a-f]{16,}}", task_detail)
             app.router.add_get("/api/v0/{resource}", state_list)
             app.router.add_get("/api/jobs", jobs)
             app.router.add_get("/metrics", metrics)

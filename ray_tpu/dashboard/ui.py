@@ -74,6 +74,10 @@ code { font-size: 12px; color: var(--ink-2); }
 <main>
   <div class="tiles" id="tiles"></div>
   <section><h2>Nodes</h2><div id="nodes"></div></section>
+  <section><h2>Tasks <button id="profbtn" style="float:right;font-size:11px">capture 2s jax profile</button></h2>
+    <div id="tasks"></div>
+    <pre id="taskdetail" style="display:none;background:var(--panel);border:1px solid var(--line);border-radius:8px;padding:10px;font-size:11px;overflow:auto;max-height:320px"></pre>
+  </section>
   <section><h2>Jobs</h2><div id="jobs"></div></section>
   <section><h2>Actors</h2><div id="actors"></div></section>
   <section><h2>Serve applications</h2><div id="serve"></div></section>
@@ -107,9 +111,9 @@ async function j(url) { const r = await fetch(url); if (!r.ok) throw new Error(u
 
 async function refresh() {
   try {
-    const [cs, nodes, actors, tasks, objects, jobs, serve] = await Promise.all([
+    const [cs, nodes, actors, tasks, taskRows, objects, jobs, serve] = await Promise.all([
       j("/api/cluster_status"), j("/api/v0/nodes"), j("/api/v0/actors"),
-      j("/api/v0/tasks/summarize"), j("/api/v0/objects"),
+      j("/api/v0/tasks/summarize"), j("/api/v0/tasks"), j("/api/v0/objects"),
       j("/api/jobs"), j("/api/serve/status").catch(() => ({applications: {}})),
     ]);
     const total = cs.total_resources || {}; const avail = cs.available_resources || {};
@@ -125,12 +129,31 @@ async function refresh() {
       tile(alive, "actors alive") +
       tile(objects.length, "objects tracked") +
       tile(jobs.length, "jobs");
+    const nodeStat = (r, k, f) => r.stats && r.stats[k] != null ? (f ? f(r.stats[k], r.stats) : r.stats[k]) : "–";
     table("nodes", [["node", "node_id", r => `<code>${esc(String(r.node_id||"").slice(0,12))}</code>`],
-                    ["state", "alive", r => statusCell(r.alive === false ? "DEAD" : "ALIVE")],
+                    ["state", "alive", r => statusCell(r.alive === false ? "DEAD" : r.draining ? "DRAINING" : "ALIVE")],
                     ["resources", "resources_total", r => esc(JSON.stringify(r.resources_total || {}))],
                     ["available", "resources_available", r => esc(JSON.stringify(r.resources_available || {}))],
+                    ["load", "stats", r => esc(nodeStat(r, "load1"))],
+                    ["mem free", "stats", r => esc(nodeStat(r, "mem_available_mb",
+                        (v, s) => `${(v/1024).toFixed(1)}/${((s.mem_total_mb||0)/1024).toFixed(1)} GB`))],
+                    ["workers", "stats", r => esc(nodeStat(r, "workers_alive"))],
                     ["labels", "labels", r => esc(JSON.stringify(r.labels || {}))]],
           nodes);
+    const recent = taskRows.slice(-25).reverse();
+    table("tasks", [["task", "task_id", r => `<a href="#" data-task="${esc(r.task_id)}"><code>${esc(String(r.task_id||"").slice(0,12))}</code></a>`],
+                    ["name", "name"],
+                    ["state", "state", r => statusCell(r.state)],
+                    ["attempts", "attempts"],
+                    ["node", "node_id", r => `<code>${esc(String(r.node_id||"").slice(0,12))}</code>`]],
+          recent);
+    document.querySelectorAll("[data-task]").forEach(a => a.onclick = async (e) => {
+      e.preventDefault();
+      const d = await j("/api/v0/tasks/" + a.dataset.task);
+      const el = $("taskdetail");
+      el.style.display = "block";
+      el.textContent = JSON.stringify(d, null, 2);
+    });
     table("jobs", [["job", "job_id", r => `<code>${esc(r.job_id || "")}</code>`],
                    ["status", "status", r => statusCell(r.status)],
                    ["entrypoint", "entrypoint", r => `<code>${esc(String(r.entrypoint||"").slice(0,60))}</code>`]],
@@ -148,6 +171,16 @@ async function refresh() {
     $("err").textContent = "";
   } catch (e) { $("err").textContent = e.message; }
 }
+$("profbtn").onclick = async () => {
+  $("profbtn").disabled = true; $("profbtn").textContent = "capturing…";
+  try {
+    const r = await fetch("/api/profile?duration_s=2", {method: "POST"});
+    const d = await r.json();
+    if (!r.ok) throw new Error(d.error || r.status);
+    $("profbtn").textContent = `saved ${d.num_files} file(s): ${d.profile_dir}`;
+  } catch (e) { $("profbtn").textContent = "profile failed: " + e.message; }
+  setTimeout(() => { $("profbtn").textContent = "capture 2s jax profile"; $("profbtn").disabled = false; }, 6000);
+};
 refresh();
 setInterval(refresh, 2000);
 </script>

@@ -16,11 +16,18 @@ from ray_tpu.core.runtime import get_runtime
 
 def list_tasks(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
     tasks = get_runtime().list_tasks()
-    return _apply_filters(tasks, filters)[:limit]
+    # newest entries win the cap (submission order is insertion order): a
+    # head that has run >limit tasks must still surface CURRENT work
+    return _apply_filters(tasks, filters)[-limit:]
 
 
 def list_actors(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
     return _apply_filters(get_runtime().list_actors(), filters)[:limit]
+
+
+def get_task(task_id: str) -> dict | None:
+    """Single-task drill-down (reference: `ray get tasks <id>`)."""
+    return get_runtime().task_detail(task_id)
 
 
 def list_nodes(limit: int = 1000) -> list[dict]:
@@ -29,9 +36,13 @@ def list_nodes(limit: int = 1000) -> list[dict]:
         {
             "node_id": n.node_id.hex(),
             "alive": n.alive,
+            "draining": n.draining,
             "resources_total": dict(n.total),
             "resources_available": dict(n.available),
             "labels": dict(n.labels),
+            # latest heartbeat-reported physical stats (real node agents
+            # only; logical in-process nodes have none)
+            "stats": rt.node_stats.get(n.node_id),
         }
         for n in rt.scheduler.nodes()
     ][:limit]
