@@ -25,7 +25,12 @@ class _Lib:
         if cls._instance is None:
             from ray_tpu.native.build import build_library
 
-            path = build_library("shm_store")
+            # RAY_TPU_SHM_SANITIZE=address|thread loads an instrumented build
+            # (sanitizer stress harness; requires the matching runtime
+            # preloaded — native/build.py sanitizer_env)
+            path = build_library(
+                "shm_store",
+                sanitize=os.environ.get("RAY_TPU_SHM_SANITIZE") or None)
             lib = ctypes.CDLL(path)
             lib.shm_store_create.restype = ctypes.c_void_p
             lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]

@@ -80,17 +80,102 @@ class Dashboard:
                 return web.json_response({"error": f"no summary for {resource}"}, status=404)
             return web.json_response(jsonable(fn()))
 
+        def _job_dict(j):
+            return {
+                "job_id": j.job_id, "status": j.status.value,
+                "entrypoint": j.entrypoint, "start_time": j.start_time,
+                "end_time": j.end_time, "metadata": j.metadata,
+                "returncode": j.returncode,
+            }
+
         async def jobs(request):
             if self.job_client is None:
                 return web.json_response([])
-            return web.json_response([
-                {
-                    "job_id": j.job_id, "status": j.status.value,
-                    "entrypoint": j.entrypoint, "start_time": j.start_time,
-                    "end_time": j.end_time,
-                }
-                for j in self.job_client.list_jobs()
-            ])
+            return web.json_response(
+                [_job_dict(j) for j in self.job_client.list_jobs()])
+
+        # Job REST API (reference: dashboard/modules/job/job_head.py routes —
+        # POST /api/jobs/ submit, GET info, GET logs, tail, POST stop)
+        async def job_submit(request):
+            if self.job_client is None:
+                return web.json_response({"error": "no job manager"}, status=503)
+            import asyncio
+
+            try:
+                body = await request.json()
+                entrypoint = body["entrypoint"]
+            except (ValueError, KeyError, TypeError) as e:
+                return web.json_response(
+                    {"error": f"bad request: {e!r}"}, status=400)
+            try:
+                job_id = await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: self.job_client.submit_job(
+                        entrypoint=entrypoint,
+                        runtime_env=body.get("runtime_env"),
+                        metadata=body.get("metadata"),
+                        submission_id=body.get("submission_id"),
+                    ))
+            except ValueError as e:  # duplicate submission_id, bad env
+                return web.json_response({"error": str(e)}, status=409)
+            return web.json_response({"job_id": job_id})
+
+        async def job_info(request):
+            try:
+                info = self.job_client.get_job_info(request.match_info["job_id"])
+            except (ValueError, AttributeError):
+                return web.json_response({"error": "unknown job"}, status=404)
+            return web.json_response(_job_dict(info))
+
+        async def job_logs(request):
+            try:
+                logs = self.job_client.get_job_logs(request.match_info["job_id"])
+            except (ValueError, AttributeError):
+                return web.json_response({"error": "unknown job"}, status=404)
+            return web.json_response({"logs": logs})
+
+        async def job_logs_tail(request):
+            """Chunked streaming of new log output until the job finishes (or
+            the ?timeout_s deadline — the client's deadline rides along)."""
+            import asyncio
+
+            job_id = request.match_info["job_id"]
+            try:
+                timeout_s = min(float(request.query.get("timeout_s", 300.0)), 86400.0)
+            except ValueError:
+                timeout_s = 300.0
+            try:
+                self.job_client.get_job_info(job_id)
+            except (ValueError, AttributeError):
+                return web.json_response({"error": "unknown job"}, status=404)
+            resp = web.StreamResponse()
+            resp.content_type = "text/plain"
+            await resp.prepare(request)
+            loop = asyncio.get_event_loop()
+            gen = self.job_client.tail_job_logs(job_id, timeout=timeout_s)
+            try:
+                while True:
+                    # the generator heartbeats "" on idle, so this loop ticks
+                    # even when the job is quiet — letting us notice a gone
+                    # client instead of pinning an executor thread for the
+                    # rest of the deadline
+                    chunk = await loop.run_in_executor(None, lambda: next(gen, None))
+                    if chunk is None:
+                        break
+                    if request.transport is None or request.transport.is_closing():
+                        break
+                    if chunk:
+                        await resp.write(chunk.encode())
+            finally:
+                gen.close()
+            await resp.write_eof()
+            return resp
+
+        async def job_stop(request):
+            try:
+                stopped = self.job_client.stop_job(request.match_info["job_id"])
+            except (ValueError, AttributeError):
+                return web.json_response({"error": "unknown job"}, status=404)
+            return web.json_response({"stopped": bool(stopped)})
 
         async def metrics(request):
             from ray_tpu.util.metrics import prometheus_text, system_prometheus_text
@@ -160,6 +245,11 @@ class Dashboard:
             app.router.add_get("/api/v0/tasks/{task_id:[0-9a-f]{16,}}", task_detail)
             app.router.add_get("/api/v0/{resource}", state_list)
             app.router.add_get("/api/jobs", jobs)
+            app.router.add_post("/api/jobs", job_submit)
+            app.router.add_get("/api/jobs/{job_id}/logs/tail", job_logs_tail)
+            app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
+            app.router.add_post("/api/jobs/{job_id}/stop", job_stop)
+            app.router.add_get("/api/jobs/{job_id}", job_info)
             app.router.add_get("/metrics", metrics)
             app.router.add_get("/api/serve/status", serve_status)
             app.router.add_get("/healthz", healthz)

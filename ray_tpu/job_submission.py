@@ -82,15 +82,42 @@ class _Supervisor:
 
 
 class JobSubmissionClient:
-    """Reference: JobSubmissionClient (dashboard/modules/job/sdk.py:37)."""
+    """Reference: JobSubmissionClient (dashboard/modules/job/sdk.py:37).
+
+    Two modes, like the reference:
+    - local (address=None): supervises driver subprocesses in this process;
+    - REST (address="http://host:port"): proxies every call to a dashboard's
+      /api/jobs endpoints (submit from anywhere, job_head.py parity).
+    """
 
     def __init__(self, address: str | None = None, log_dir: str | None = None):
+        self._address = address.rstrip("/") if address else None
         self._jobs: dict[str, _Supervisor] = {}
         self._log_dir = log_dir or "/tmp/ray_tpu/job_logs"
-        os.makedirs(self._log_dir, exist_ok=True)
+        if self._address is None:
+            os.makedirs(self._log_dir, exist_ok=True)
+
+    # ---- REST proxy mode -------------------------------------------------
+    def _http(self, method: str, path: str, body: dict | None = None):
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self._address}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            data = r.read()
+        return json.loads(data) if data else None
 
     def submit_job(self, *, entrypoint: str, runtime_env: dict | None = None,
                    metadata: dict | None = None, submission_id: str | None = None) -> str:
+        if self._address is not None:
+            return self._http("POST", "/api/jobs", {
+                "entrypoint": entrypoint, "runtime_env": runtime_env,
+                "metadata": metadata, "submission_id": submission_id,
+            })["job_id"]
         job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
         if job_id in self._jobs:
             raise ValueError(f"Job {job_id} already exists")
@@ -101,12 +128,24 @@ class JobSubmissionClient:
         return job_id
 
     def get_job_status(self, job_id: str) -> JobStatus:
+        if self._address is not None:
+            return JobStatus(self._http("GET", f"/api/jobs/{job_id}")["status"])
         return self._job(job_id).info.status
 
     def get_job_info(self, job_id: str) -> JobInfo:
+        if self._address is not None:
+            d = self._http("GET", f"/api/jobs/{job_id}")
+            return JobInfo(job_id=d["job_id"], entrypoint=d["entrypoint"],
+                           status=JobStatus(d["status"]),
+                           start_time=d.get("start_time", 0.0),
+                           end_time=d.get("end_time", 0.0),
+                           metadata=d.get("metadata") or {},
+                           returncode=d.get("returncode"))
         return self._job(job_id).info
 
     def get_job_logs(self, job_id: str) -> str:
+        if self._address is not None:
+            return self._http("GET", f"/api/jobs/{job_id}/logs")["logs"]
         info = self._job(job_id).info
         if not info.log_path or not os.path.exists(info.log_path):
             return ""
@@ -114,27 +153,61 @@ class JobSubmissionClient:
             return f.read()
 
     def tail_job_logs(self, job_id: str, timeout: float = 60.0):
-        """Generator yielding new log lines until the job finishes."""
+        """Generator yielding new log chunks until the job finishes. In REST
+        mode this streams the dashboard's chunked /logs/tail response
+        (reference: job_head.py tail_job_logs websocket, as HTTP chunks)."""
+        if self._address is not None:
+            import urllib.request
+
+            # the DEADLINE rides as a query param (server-side cutoff); the
+            # socket timeout is per-read and padded so a quiet-but-alive job
+            # ends via the server's clean EOF, not a client TimeoutError
+            req = urllib.request.Request(
+                f"{self._address}/api/jobs/{job_id}/logs/tail"
+                f"?timeout_s={timeout:g}")
+            with urllib.request.urlopen(req, timeout=timeout + 30) as r:
+                while True:
+                    chunk = r.read(4096)
+                    if not chunk:
+                        return
+                    yield chunk.decode(errors="replace")
         info = self._job(job_id).info
         deadline = time.monotonic() + timeout
         pos = 0
         while time.monotonic() < deadline:
+            # status snapshot BEFORE the read: if the job went terminal, the
+            # read below still captures everything it wrote — checking after
+            # would race the final lines into a dropped chunk
+            done = info.status in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                                   JobStatus.STOPPED)
+            chunk = ""
             if info.log_path and os.path.exists(info.log_path):
                 with open(info.log_path) as f:
                     f.seek(pos)
                     chunk = f.read()
                     pos = f.tell()
-                if chunk:
-                    yield chunk
-            if info.status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+            # idle heartbeat: an empty chunk keeps pull-based consumers (the
+            # REST tail handler) ticking so they can notice disconnects
+            yield chunk
+            if done:
                 return
             time.sleep(0.2)
 
     def stop_job(self, job_id: str) -> bool:
+        if self._address is not None:
+            return bool(self._http("POST", f"/api/jobs/{job_id}/stop")["stopped"])
         self._job(job_id).stop()
         return True
 
     def list_jobs(self) -> list[JobInfo]:
+        if self._address is not None:
+            return [JobInfo(job_id=d["job_id"], entrypoint=d["entrypoint"],
+                            status=JobStatus(d["status"]),
+                            start_time=d.get("start_time", 0.0),
+                            end_time=d.get("end_time", 0.0),
+                            metadata=d.get("metadata") or {},
+                            returncode=d.get("returncode"))
+                    for d in self._http("GET", "/api/jobs")]
         return [s.info for s in self._jobs.values()]
 
     def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> JobStatus:

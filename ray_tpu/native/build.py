@@ -14,27 +14,58 @@ import sys
 _NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def build_library(name: str, extra_flags: list[str] | None = None) -> str:
-    """Compile ray_tpu/native/<name>.cpp to a cached .so; returns its path."""
+def build_library(name: str, extra_flags: list[str] | None = None,
+                  sanitize: str | None = None) -> str:
+    """Compile ray_tpu/native/<name>.cpp to a cached .so; returns its path.
+
+    ``sanitize`` in {"address", "thread"} builds an instrumented variant
+    (reference: the TSAN/ASAN bazel configs, .bazelrc:119-139) — the store's
+    race/leak surface is its shared header mutex + arena bookkeeping, which
+    the sanitizer stress harness (tests/test_sanitizers.py) drives hard.
+    The instrumented .so must be loaded with the matching runtime preloaded
+    (see sanitizer_env())."""
     src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
     with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    out = os.path.join(_NATIVE_DIR, f"lib{name}-{digest}.so")
+    tag = f"-{sanitize}" if sanitize else ""
+    out = os.path.join(_NATIVE_DIR, f"lib{name}{tag}-{digest}.so")
     if os.path.exists(out):
         return out
+    san_flags = []
+    if sanitize:
+        if sanitize not in ("address", "thread"):
+            raise ValueError(f"unknown sanitizer {sanitize!r}")
+        san_flags = [f"-fsanitize={sanitize}", "-g", "-fno-omit-frame-pointer"]
     cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-        "-o", out, src, "-lpthread", "-lrt",
+        "g++", "-O1" if sanitize else "-O2", "-std=c++17", "-shared", "-fPIC",
+        *san_flags, "-o", out, src, "-lpthread", "-lrt",
     ] + (extra_flags or [])
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as e:
         raise RuntimeError(f"native build failed for {name}:\n{e.stderr}") from e
-    # clean stale builds
+    # clean stale builds (of the SAME variant only)
+    prefix = f"lib{name}{tag}-"
     for f in os.listdir(_NATIVE_DIR):
-        if f.startswith(f"lib{name}-") and f != os.path.basename(out):
+        if f.startswith(prefix) and f != os.path.basename(out):
             try:
                 os.unlink(os.path.join(_NATIVE_DIR, f))
             except OSError:
                 pass
     return out
+
+
+def sanitizer_env(sanitize: str) -> dict:
+    """Env for a python subprocess that dlopens a sanitized .so: the matching
+    runtime must be preloaded (the host interpreter isn't instrumented)."""
+    lib = {"address": "libasan.so", "thread": "libtsan.so"}[sanitize]
+    path = subprocess.run(["gcc", f"-print-file-name={lib}"],
+                          capture_output=True, text=True).stdout.strip()
+    if not path or not os.path.exists(path):
+        raise FileNotFoundError(f"{lib} not found (gcc sanitizer runtime)")
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = path
+    # leak checking sees the whole (uninstrumented) interpreter — noise only
+    env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=0:exitcode=66"
+    env["TSAN_OPTIONS"] = "halt_on_error=0:exitcode=66:report_signal_unsafe=0"
+    return env

@@ -488,3 +488,41 @@ def test_dashboard_node_stats_and_task_drilldown():
         assert "data-task" in page and "taskdetail" in page
     finally:
         dash.stop()
+
+
+def test_job_rest_api_submit_logs_tail_stop():
+    """Job REST parity (reference: dashboard/modules/job/job_head.py):
+    submit over HTTP, poll status, fetch + tail logs, stop a running job —
+    all through JobSubmissionClient(address=...) proxying the dashboard."""
+    from ray_tpu.dashboard.head import Dashboard
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    dash = Dashboard(port=8269, job_client=JobSubmissionClient())
+    try:
+        client = JobSubmissionClient(address="http://127.0.0.1:8269")
+        jid = client.submit_job(
+            entrypoint="python -c \"import time\nfor i in range(20):\n    print('line', i, flush=True)\n    time.sleep(0.05)\"",
+            metadata={"who": "rest-test"})
+        assert client.get_job_status(jid) in (JobStatus.PENDING, JobStatus.RUNNING)
+        tail = "".join(client.tail_job_logs(jid, timeout=60))
+        assert "line 0" in tail and "line 19" in tail
+        assert client.wait_until_finished(jid, timeout=30) == JobStatus.SUCCEEDED
+        assert "line 5" in client.get_job_logs(jid)
+        info = client.get_job_info(jid)
+        assert info.metadata == {"who": "rest-test"} and info.returncode == 0
+        assert any(j.job_id == jid for j in client.list_jobs())
+
+        # stop a long-running job over REST
+        jid2 = client.submit_job(entrypoint="python -c 'import time; time.sleep(60)'")
+        time.sleep(0.5)
+        assert client.stop_job(jid2)
+        assert client.wait_until_finished(jid2, timeout=15) == JobStatus.STOPPED
+
+        # 404 for unknown jobs
+        try:
+            client.get_job_info("nope")
+            assert False
+        except Exception:
+            pass
+    finally:
+        dash.stop()

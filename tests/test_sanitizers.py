@@ -1,0 +1,79 @@
+"""Sanitizer builds of the native shm store (reference: the TSAN/ASAN bazel
+configs, /root/reference/.bazelrc:119-139 + *_SANITIZER test tags).
+
+The store is the framework's only hand-written concurrent native code: a
+process-shared header mutex guarding an arena + LRU table, raced by every
+worker process. Each test builds an instrumented .so, preloads the matching
+gcc runtime into a fresh interpreter, and drives a multi-threaded
+put/get/evict/abort stress; any sanitizer report fails the run (exitcode 66
+via ASAN_OPTIONS/TSAN_OPTIONS).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+STRESS = r"""
+import os, threading
+import numpy as np
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.core.shm_store import SharedMemoryStore
+
+store = SharedMemoryStore(f"san-{os.getpid()}", size=8 * 1024 * 1024,
+                          table_cap=512, owner=True)
+errs = []
+
+def worker(tid):
+    try:
+        for i in range(120):
+            oid = ObjectID(bytes([tid]) * 2 + i.to_bytes(4, "big") + b"\0" * 22)
+            data = np.full(512 + (i % 7) * 128, tid, dtype=np.uint8)
+            store.put_bytes(oid, data.tobytes())
+            view = store.get_bytes(oid)
+            if view is not None:
+                assert bytes(view[:4]) == bytes([tid]) * 4
+                store.release(oid)
+            if i % 9 == 0:
+                store.delete(oid)
+            if i % 17 == 0:
+                store.stats()
+    except Exception as e:  # noqa: BLE001
+        errs.append(e)
+
+threads = [threading.Thread(target=worker, args=(t,)) for t in range(1, 5)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert not errs, errs
+store.close()
+print("STRESS-OK")
+"""
+
+
+def _run_sanitized(mode: str) -> subprocess.CompletedProcess:
+    from ray_tpu.native.build import build_library, sanitizer_env
+
+    build_library("shm_store", sanitize=mode)  # build in THIS process (fast path)
+    env = sanitizer_env(mode)
+    env["RAY_TPU_SHM_SANITIZE"] = mode
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-c", STRESS], env=env,
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+@pytest.mark.parametrize("mode,marker", [
+    ("address", "AddressSanitizer"),
+    ("thread", "ThreadSanitizer"),
+])
+def test_shm_store_stress_under_sanitizer(mode, marker):
+    r = _run_sanitized(mode)
+    report = r.stdout + r.stderr
+    assert "STRESS-OK" in r.stdout, report[-2000:]
+    assert r.returncode == 0, f"sanitizer exit {r.returncode}:\n{report[-3000:]}"
+    assert marker not in report, report[-3000:]
