@@ -124,6 +124,19 @@ class ClientRuntime:
         except Exception:
             pass  # best effort; the head also drops borrows on disconnect
 
+    # ---- remote pdb registration (util/rpdb.py; reference: ray debug)
+    def debug_register(self, session: dict) -> None:
+        self._rpc().call("debug_register", session=session, timeout=10)
+
+    def debug_unregister(self, session_id: str) -> None:
+        try:
+            self._rpc().call("debug_unregister", id=session_id, timeout=10)
+        except Exception:
+            pass
+
+    def debug_list(self) -> list:
+        return self._rpc().call("debug_list", timeout=10)
+
     # ------------------------------------------------------------ transport
     def _rpc(self):
         with self._lock:

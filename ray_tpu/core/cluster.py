@@ -91,6 +91,8 @@ class ControlPlane:
 
     def _peer_gone(self, peer: RpcPeer) -> None:
         peer.meta.pop("held_refs", None)  # release the client's borrowed refs
+        for sid in peer.meta.pop("debug_sessions", ()):  # dead worker's pdbs
+            self.runtime.debug_sessions.pop(sid, None)
         try:
             self.runtime.publisher.unsubscribe_remote(peer)
         except Exception:
@@ -116,6 +118,21 @@ class ControlPlane:
 
     def _h_ref_drop(self, peer: RpcPeer, msg: dict):
         peer.meta.setdefault("held_refs", {}).pop(msg["oid"], None)
+
+    # ---- remote pdb session registry (reference: ray debug session list)
+    def _h_debug_register(self, peer: RpcPeer, msg: dict):
+        session = dict(msg["session"])
+        self.runtime.debug_sessions[session["id"]] = session
+        peer.meta.setdefault("debug_sessions", set()).add(session["id"])
+        return True
+
+    def _h_debug_unregister(self, peer: RpcPeer, msg: dict):
+        self.runtime.debug_sessions.pop(msg["id"], None)
+        peer.meta.setdefault("debug_sessions", set()).discard(msg["id"])
+        return True
+
+    def _h_debug_list(self, peer: RpcPeer, msg: dict):
+        return list(self.runtime.debug_sessions.values())
 
     # ---- pub/sub bridge (reference: src/ray/pubsub long-poll transport ->
     # pushed notify frames here)
@@ -157,6 +174,9 @@ class ControlPlane:
             "client_stream_done": self._h_client_stream_done,
             "ref_add": self._h_ref_add,
             "ref_drop": self._h_ref_drop,
+            "debug_register": self._h_debug_register,
+            "debug_unregister": self._h_debug_unregister,
+            "debug_list": self._h_debug_list,
             "locate_object": self._h_locate_object,
             "object_added": self._h_object_added,
             "object_removed": self._h_object_removed,

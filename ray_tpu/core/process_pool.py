@@ -207,6 +207,17 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             exc_blob = None
         return ("err", traceback.format_exc(), exc_blob)
 
+    def _maybe_post_mortem(e: BaseException) -> None:
+        """RAY_TPU_POST_MORTEM=1 parks failing tasks (plain AND streaming)
+        in the remote debugger before the error reply ships."""
+        if os.environ.get("RAY_TPU_POST_MORTEM") == "1":
+            try:
+                from ray_tpu.util import rpdb
+
+                rpdb.maybe_post_mortem(e)
+            except Exception:
+                pass
+
     import collections
 
     pending: "collections.deque" = collections.deque()
@@ -528,6 +539,8 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 args, kwargs = _decode_call(args_blob)
                 _stream_out(seq, task_bin, fn(*args, **kwargs), bp)
             except BaseException as e:  # noqa: BLE001
+                if not isinstance(e, TaskCancelledError):
+                    _maybe_post_mortem(e)
                 status, payload, extra = _error_payload(e)
                 _reply(("done", seq, status, payload, extra))
             finally:
@@ -550,6 +563,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             status, payload, extra, contained = _result_payload(
                 fn(*args, **kwargs), oid_bin)
         except BaseException as e:  # noqa: BLE001
+            _maybe_post_mortem(e)
             status, payload, extra = _error_payload(e)
         finally:
             _set_current_task(None)

@@ -307,6 +307,8 @@ class Runtime:
         # node -> latest heartbeat-reported physical stats (dashboard's
         # per-node rows; reference: reporter agent feed)
         self.node_stats: dict[NodeID, dict] = {}
+        # active remote-pdb sessions (reference: ray debug's session list)
+        self.debug_sessions: dict[str, dict] = {}
         self._pending_queue: "queue.Queue[TaskID]" = queue.Queue()
         # Control plane: node agents register + heartbeat here; worker
         # processes connect as clients for nested API calls (reference: the
@@ -1249,17 +1251,29 @@ class Runtime:
         return self._run_user_fn_inner(entry, fn, args, kwargs)
 
     def _run_user_fn_inner(self, entry: _TaskEntry, fn, args, kwargs):
-        if entry.spec.runtime_env:
-            from ray_tpu import runtime_env as renv
+        try:
+            if entry.spec.runtime_env:
+                from ray_tpu import runtime_env as renv
 
-            # cache the built context on the spec: retries (and the working_dir
-            # content hash inside build_context) don't re-pay per attempt
-            ctx = getattr(entry.spec, "_renv_ctx", None)
-            if ctx is None:
-                ctx = entry.spec._renv_ctx = renv.build_context(entry.spec.runtime_env)
-            with renv.apply_context(ctx):
-                return fn(*args, **kwargs)
-        return fn(*args, **kwargs)
+                # cache the built context on the spec: retries (and the working_dir
+                # content hash inside build_context) don't re-pay per attempt
+                ctx = getattr(entry.spec, "_renv_ctx", None)
+                if ctx is None:
+                    ctx = entry.spec._renv_ctx = renv.build_context(entry.spec.runtime_env)
+                with renv.apply_context(ctx):
+                    return fn(*args, **kwargs)
+            return fn(*args, **kwargs)
+        except Exception as e:
+            # RAY_TPU_POST_MORTEM=1 drops into the remote debugger at the
+            # raise point before the error propagates (reference: RAY_DEBUG
+            # post-mortem; checked lazily so the hot path pays nothing)
+            import os as _os
+
+            if _os.environ.get("RAY_TPU_POST_MORTEM") == "1":
+                from ray_tpu.util import rpdb
+
+                rpdb.maybe_post_mortem(e)
+            raise
 
     def _handle_task_failure(self, entry: _TaskEntry, exc: BaseException) -> None:
         spec = entry.spec

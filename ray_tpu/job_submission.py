@@ -100,6 +100,7 @@ class JobSubmissionClient:
     # ---- REST proxy mode -------------------------------------------------
     def _http(self, method: str, path: str, body: dict | None = None):
         import json
+        import urllib.error
         import urllib.request
 
         req = urllib.request.Request(
@@ -107,8 +108,19 @@ class JobSubmissionClient:
             data=json.dumps(body).encode() if body is not None else None,
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=30) as r:
-            data = r.read()
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                data = r.read()
+        except urllib.error.HTTPError as e:
+            # keep the local-mode error contract: client errors surface as
+            # ValueError (unknown job, duplicate submission_id, bad request)
+            if e.code in (400, 404, 409):
+                try:
+                    detail = json.loads(e.read()).get("error", "")
+                except Exception:
+                    detail = ""
+                raise ValueError(detail or f"HTTP {e.code} on {path}") from None
+            raise
         return json.loads(data) if data else None
 
     def submit_job(self, *, entrypoint: str, runtime_env: dict | None = None,

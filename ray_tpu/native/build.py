@@ -44,10 +44,14 @@ def build_library(name: str, extra_flags: list[str] | None = None,
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as e:
         raise RuntimeError(f"native build failed for {name}:\n{e.stderr}") from e
-    # clean stale builds (of the SAME variant only)
+    # clean stale builds of the SAME variant only: after the prefix there
+    # must be just a digest (a '-' would mean a different variant's tag,
+    # e.g. plain 'libx-' also prefixes 'libx-address-...')
     prefix = f"lib{name}{tag}-"
     for f in os.listdir(_NATIVE_DIR):
-        if f.startswith(prefix) and f != os.path.basename(out):
+        rest = f[len(prefix):-3] if f.endswith(".so") else ""
+        if (f.startswith(prefix) and f != os.path.basename(out)
+                and rest and "-" not in rest):
             try:
                 os.unlink(os.path.join(_NATIVE_DIR, f))
             except OSError:

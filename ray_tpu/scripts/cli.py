@@ -14,7 +14,15 @@ import sys
 def _init_session(args):
     import ray_tpu
 
-    ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
+    # --address attaches to a RUNNING head as a client (the only way CLI
+    # commands can see that head's live state — a bare init() would start a
+    # fresh in-process runtime with empty tables)
+    addr = getattr(args, "address", None)
+    if addr:
+        ray_tpu.init(address=addr, token=getattr(args, "token", None),
+                     ignore_reinit_error=True)
+    else:
+        ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
 
 
 def cmd_status(args) -> int:
@@ -61,6 +69,33 @@ def cmd_timeline(args) -> int:
     out = args.output or "timeline.json"
     state.timeline(out)
     print(f"Wrote Chrome trace to {out} (open chrome://tracing)")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """List active remote-pdb sessions; attach to one (reference: ray debug)."""
+    from ray_tpu.util import rpdb
+
+    _init_session(args)
+    sessions = rpdb.list_sessions()
+    if not sessions:
+        print("no active debugger sessions")
+        return 0
+    target = None
+    if args.session_id:
+        target = next((s for s in sessions if s["id"] == args.session_id), None)
+        if target is None:
+            print(f"unknown session {args.session_id}")
+    elif len(sessions) == 1:
+        target = sessions[0]
+    if target is None:
+        for s in sessions:
+            print(f"{s['id']}  pid={s['pid']}  {s['host']}:{s['port']}  "
+                  f"{s['reason']}")
+        return 0
+    print(f"attaching to {target['id']} ({target['reason']}) — "
+          "'c' continues the task, Ctrl-D detaches")
+    rpdb.attach(target)
     return 0
 
 
@@ -214,6 +249,10 @@ def cmd_stop(args) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu", description="TPU-native distributed runtime CLI")
     p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--address", default=None,
+                   help="attach to a running head (host:port) instead of "
+                        "starting an in-process session")
+    p.add_argument("--token", default=None, help="session token for --address")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sub.add_parser("status", help="cluster resource status")
@@ -244,6 +283,11 @@ def main(argv=None) -> int:
 
     sub.add_parser("stop", help="stop the head started by `start --head`")
 
+    dp = sub.add_parser("debug", help="list / attach to remote pdb sessions "
+                        "(reference: `ray debug`)")
+    dp.add_argument("session_id", nargs="?", default=None,
+                    help="attach to this session (default: the only one, or list)")
+
     args = p.parse_args(argv)
     if args.cmd == "start":
         return cmd_start(args)
@@ -259,6 +303,8 @@ def main(argv=None) -> int:
         return cmd_timeline(args)
     if args.cmd == "job":
         return cmd_job_submit(args)
+    if args.cmd == "debug":
+        return cmd_debug(args)
     return 1
 
 

@@ -1,0 +1,228 @@
+"""Remote pdb for tasks/actors: `ray_tpu.util.rpdb.set_trace()` inside any
+task opens a debugger on a local socket and registers it with the head so
+`ray_tpu debug` (scripts/cli.py) can list and attach.
+
+Parity: python/ray/util/rpdb.py (RemotePdb + _driver_set_trace) and the
+`ray debug` CLI (scripts/scripts.py debug) — re-scoped to the
+single-controller runtime: sessions register over the existing control
+plane (worker client RPC) or directly on the head runtime, and attach is a
+plain TCP text protocol (telnet-compatible, like the reference's).
+
+Post-mortem: set RAY_TPU_POST_MORTEM=1 and task exceptions drop into the
+debugger at the raise point before propagating (reference: RAY_DEBUG
+post-mortem mode).
+"""
+
+from __future__ import annotations
+
+import os
+import pdb
+import socket
+import sys
+import threading
+import uuid
+
+
+class _SocketIO:
+    """File-like adapter pdb can use for stdin/stdout over one connection."""
+
+    def __init__(self, conn: socket.socket):
+        self._file = conn.makefile("rw", buffering=1, errors="replace")
+
+    def readline(self, *a):
+        return self._file.readline(*a)
+
+    def read(self, *a):
+        return self._file.read(*a)
+
+    def write(self, data):
+        try:
+            self._file.write(data)
+        except (BrokenPipeError, OSError):
+            raise
+        return len(data)
+
+    def flush(self):
+        try:
+            self._file.flush()
+        except (BrokenPipeError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self._file.close()
+        except OSError:
+            pass
+
+
+class RemotePdb(pdb.Pdb):
+    """Pdb bound to an accepted TCP connection (reference: rpdb.py:88)."""
+
+    def __init__(self, conn: socket.socket):
+        self._io = _SocketIO(conn)
+        super().__init__(stdin=self._io, stdout=self._io)
+        self.prompt = "(ray_tpu-pdb) "
+
+    def do_continue(self, arg):
+        try:
+            return super().do_continue(arg)
+        finally:
+            self._io.close()
+
+    do_c = do_cont = do_continue
+
+
+def _register(session: dict) -> None:
+    from ray_tpu.core import runtime as rt_mod
+
+    rt = rt_mod.get_runtime_or_none()
+    if rt is None:
+        return
+    try:
+        if hasattr(rt, "debug_register"):  # client runtime in a worker
+            rt.debug_register(session)
+        else:  # in-head task
+            rt.debug_sessions[session["id"]] = session
+    except Exception:
+        pass
+
+
+def _unregister(session_id: str) -> None:
+    from ray_tpu.core import runtime as rt_mod
+
+    rt = rt_mod.get_runtime_or_none()
+    if rt is None:
+        return
+    try:
+        if hasattr(rt, "debug_unregister"):
+            rt.debug_unregister(session_id)
+        else:
+            rt.debug_sessions.pop(session_id, None)
+    except Exception:
+        pass
+
+
+def _advertise_host() -> str:
+    """The address other NODES can reach this process at: the local address
+    of a route toward the head (no traffic sent), falling back to loopback
+    for headless/single-host runs."""
+    from ray_tpu.core import runtime as rt_mod
+
+    rt = rt_mod.get_runtime_or_none()
+    h = getattr(rt, "_host", None)  # client runtime: the head's host
+    p = getattr(rt, "_port", 80)
+    try:
+        if h and h not in ("127.0.0.1", "localhost"):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((h, int(p)))
+                return s.getsockname()[0]
+            finally:
+                s.close()
+    except (OSError, ValueError):
+        pass
+    return "127.0.0.1"
+
+
+def set_trace(frame=None, *, reason: str = "breakpoint", exc_info=None) -> None:
+    """Open a listener, announce the session, BLOCK until a client attaches,
+    then hand this thread to pdb. The task resumes on `continue`."""
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    # bind all interfaces, advertise a cross-node-reachable address — a
+    # loopback advertisement would send remote attachers to THEIR own host
+    listener.bind(("0.0.0.0", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    host = _advertise_host()
+    session = {
+        "id": uuid.uuid4().hex[:12],
+        "pid": os.getpid(),
+        "host": host,
+        "port": port,
+        "reason": reason,
+        "thread": threading.current_thread().name,
+    }
+    _register(session)
+    sys.stderr.write(
+        f"ray_tpu rpdb: waiting for attach at {host}:{port} "
+        f"(`ray_tpu debug` or `nc {host} {port}`)\n")
+    sys.stderr.flush()
+    try:
+        conn, _ = listener.accept()
+    finally:
+        listener.close()
+        _unregister(session["id"])
+    dbg = RemotePdb(conn)
+    if exc_info is not None:
+        dbg.reset()
+        dbg.interaction(None, exc_info[2])
+    else:
+        dbg.set_trace(frame or sys._getframe().f_back)
+
+
+def post_mortem_enabled() -> bool:
+    return os.environ.get("RAY_TPU_POST_MORTEM") == "1"
+
+
+def maybe_post_mortem(exc: BaseException) -> None:
+    """Called by executors on task failure when post-mortem mode is on."""
+    if not post_mortem_enabled():
+        return
+    tb = exc.__traceback__
+    if tb is None:
+        return
+    set_trace(reason=f"post-mortem: {type(exc).__name__}: {exc}",
+              exc_info=(type(exc), exc, tb))
+
+
+def list_sessions() -> list[dict]:
+    """Active debugger sessions cluster-wide — straight from the head when
+    in-process, via RPC from attached clients (`ray_tpu debug --address`)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    if hasattr(rt, "debug_list"):  # client runtime: ask the head
+        return rt.debug_list()
+    return list(getattr(rt, "debug_sessions", {}).values())
+
+
+def attach(session: dict, stdin=None, stdout=None) -> None:
+    """Interactive attach: bridge local stdin/stdout to the session socket
+    until the debugger disconnects (the CLI's `ray_tpu debug` body)."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    conn = socket.create_connection((session["host"], session["port"]), timeout=10)
+    conn.settimeout(0.2)
+    stop = threading.Event()
+
+    def pump_in():
+        while not stop.is_set():
+            line = stdin.readline()
+            if not line:
+                break
+            try:
+                conn.sendall(line.encode())
+            except OSError:
+                break
+
+    t = threading.Thread(target=pump_in, daemon=True)
+    t.start()
+    try:
+        while True:
+            try:
+                data = conn.recv(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            stdout.write(data.decode(errors="replace"))
+            stdout.flush()
+    finally:
+        stop.set()
+        try:
+            conn.close()
+        except OSError:
+            pass

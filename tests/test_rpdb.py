@@ -1,0 +1,84 @@
+"""Remote debugger (reference: python/ray/util/rpdb.py + `ray debug`)."""
+
+import socket
+import threading
+import time
+
+import ray_tpu
+from ray_tpu.util import rpdb
+
+
+def _drive_pdb(host, port, commands, out: list):
+    conn = socket.create_connection((host, port), timeout=15)
+    f = conn.makefile("rw", buffering=1, errors="replace")
+    for cmd in commands:
+        # read until a prompt, then issue the next command
+        buf = ""
+        while "(ray_tpu-pdb) " not in buf:
+            ch = f.read(1)
+            if not ch:
+                break
+            buf += ch
+        out.append(buf)
+        f.write(cmd + "\n")
+        f.flush()
+    conn.close()
+
+
+def test_breakpoint_in_task_attach_inspect_continue(ray_start_regular):
+    """A task hits set_trace; the session registers with the head; an
+    attached client inspects a local and continues; the task completes."""
+
+    @ray_tpu.remote
+    def buggy():
+        secret = 41
+        rpdb.set_trace()
+        return secret + 1
+
+    ref = buggy.remote()
+    deadline = time.time() + 30
+    sessions = []
+    while time.time() < deadline and not sessions:
+        sessions = rpdb.list_sessions()
+        time.sleep(0.05)
+    assert sessions, "session never registered"
+    s = sessions[0]
+    assert s["reason"] == "breakpoint" and s["pid"]
+
+    out: list = []
+    t = threading.Thread(target=_drive_pdb,
+                         args=(s["host"], s["port"], ["p secret", "c"], out),
+                         daemon=True)
+    t.start()
+    assert ray_tpu.get(ref, timeout=30) == 42  # task resumed by `c`
+    t.join(timeout=10)
+    transcript = "".join(out)
+    assert "41" in transcript  # `p secret` printed through the socket
+    # session unregistered once attached
+    assert not rpdb.list_sessions()
+
+
+def test_post_mortem_on_failure(ray_start_regular, monkeypatch):
+    """RAY_TPU_POST_MORTEM=1: a failing task parks in the debugger at the
+    raise point; after the client continues, the error propagates normally."""
+    import pytest
+
+    monkeypatch.setenv("RAY_TPU_POST_MORTEM", "1")
+
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        denom = 0
+        return 1 / denom
+
+    ref = boom.remote()
+    deadline = time.time() + 30
+    sessions = []
+    while time.time() < deadline and not sessions:
+        sessions = rpdb.list_sessions()
+        time.sleep(0.05)
+    assert sessions and "post-mortem" in sessions[0]["reason"]
+    out: list = []
+    _drive_pdb(sessions[0]["host"], sessions[0]["port"], ["p denom", "c"], out)
+    with pytest.raises(Exception, match="division"):
+        ray_tpu.get(ref, timeout=30)
+    assert "0" in "".join(out)

@@ -56,8 +56,11 @@ print("STRESS-OK")
 def _run_sanitized(mode: str) -> subprocess.CompletedProcess:
     from ray_tpu.native.build import build_library, sanitizer_env
 
-    build_library("shm_store", sanitize=mode)  # build in THIS process (fast path)
-    env = sanitizer_env(mode)
+    try:
+        env = sanitizer_env(mode)
+        build_library("shm_store", sanitize=mode)  # build here (fast path)
+    except (FileNotFoundError, RuntimeError) as e:
+        pytest.skip(f"sanitizer toolchain unavailable: {e}")
     env["RAY_TPU_SHM_SANITIZE"] = mode
     env["JAX_PLATFORMS"] = "cpu"
     return subprocess.run(
