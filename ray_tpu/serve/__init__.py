@@ -11,6 +11,8 @@ from ray_tpu.serve.api import (
     shutdown,
     start_grpc_proxy,
     start_http_proxy,
+    start_proxies,
+    stop_proxies,
     status,
 )
 from ray_tpu.serve.batching import batch
@@ -32,6 +34,7 @@ from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment,
 __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "run", "delete", "status", "shutdown", "start_http_proxy", "start_grpc_proxy",
+    "start_proxies", "stop_proxies",
     "get_deployment_handle", "build_openai_app",
     "PagedLLMConfig", "PagedLLMEngine",
     "batch", "DeploymentHandle", "ServeController",

@@ -121,6 +121,7 @@ def status() -> dict:
 
 
 def shutdown() -> None:
+    stop_proxies()
     with _lock:
         c = _state["controller"]
         if c is not None:
@@ -147,11 +148,15 @@ class HttpProxy:
     longest matching prefix (proxy_router.py).
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 route_lookup=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.host = host
         self.port = port
+        # pluggable router: per-node proxy actors resolve routes against
+        # their own controller-synced table instead of this process's _state
+        self._route_lookup = route_lookup
         self._loop = None
         self._runner = None
         # dedicated pool for long-lived SSE polls so streams can't starve the
@@ -217,6 +222,8 @@ class HttpProxy:
             await self._runner.setup()
             site = web.TCPSite(self._runner, self.host, self.port)
             await site.start()
+            if self.port == 0:  # read back the OS-assigned ephemeral port
+                self.port = site._server.sockets[0].getsockname()[1]
             self._started.set()
 
         self._loop = asyncio.new_event_loop()
@@ -264,6 +271,8 @@ class HttpProxy:
         return resp
 
     def _match(self, path: str):
+        if self._route_lookup is not None:
+            return self._route_lookup(path)
         return _match_route(path)
 
     def stop(self) -> None:
@@ -282,12 +291,12 @@ class HttpProxy:
             self._loop.call_soon_threadsafe(self._loop.stop)
 
 
-def _match_route(path: str):
-    """Longest-prefix route match over the session route table (shared by the
-    HTTP and gRPC ingresses — reference: proxy_router.py)."""
+def _match_route(path: str, routes: dict | None = None):
+    """Longest-prefix route match (shared by the HTTP and gRPC ingresses and
+    the per-node proxy actors — reference: proxy_router.py)."""
     best = None
     # snapshot: run()/delete() rebind the dict rather than mutating it
-    for prefix, handle in list(_state["routes"].items()):
+    for prefix, handle in list((_state["routes"] if routes is None else routes).items()):
         if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
             if best is None or len(prefix) > len(best[0]):
                 best = (prefix, handle)
@@ -299,6 +308,118 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> HttpProxy:
         if _state["proxy"] is None:
             _state["proxy"] = HttpProxy(host, port)
         return _state["proxy"]
+
+
+class _ProxyActor:
+    """One ingress per placement (reference: _private/proxy.py — a proxy
+    ACTOR on every node, any node's address serves traffic). Runs in its own
+    process (isolate_process) with a controller-synced route table; requests
+    route to replicas through deployment handles over the worker's client
+    runtime, so the data plane no longer funnels through the head's single
+    aiohttp loop."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 refresh_s: float = 1.0):
+        import ray_tpu as _ray
+
+        self._controller = _ray.get_actor(CONTROLLER_NAME)
+        self._routes: dict = {}
+        self._refresh_s = refresh_s
+        self._stop = threading.Event()
+        self._sync()  # serve correctly from the first request
+        threading.Thread(target=self._sync_loop, daemon=True,
+                         name="proxy-route-sync").start()
+        self._proxy = HttpProxy(host, port, route_lookup=self._lookup)
+
+    def _sync(self) -> None:
+        routes = ray_tpu.get(self._controller.get_routes.remote())
+        # Reuse existing handles: DeploymentHandle construction is expensive
+        # (controller RPC + a router watcher thread that lives as long as the
+        # handle) — rebuilding per refresh would leak a thread per route per
+        # tick and reset the router's in-flight balancing counts.
+        prev = self._routes
+        new_table = {}
+        for prefix, name in routes.items():
+            cur = prev.get(prefix)
+            if cur is not None and cur.deployment_name == name:
+                new_table[prefix] = cur
+            else:
+                new_table[prefix] = DeploymentHandle(self._controller, name)
+        self._routes = new_table
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self._refresh_s):
+            try:
+                self._sync()
+            except Exception:
+                pass  # controller briefly unavailable; keep the last table
+
+    def _lookup(self, path: str):
+        return _match_route(path, self._routes)
+
+    def address(self) -> tuple:
+        import socket as _socket
+
+        host = self._proxy.host
+        if host == "0.0.0.0":
+            host = _socket.gethostbyname(_socket.gethostname())
+        return (host, self._proxy.port)
+
+    def ready(self) -> bool:
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._proxy.stop()
+
+
+def start_proxies(count: int = 2, base_port: int = 8100) -> list[tuple]:
+    """Start `count` SPREAD-placed proxy actors (one per node when nodes are
+    available) and return their (host, port) addresses. The reference runs
+    exactly this shape: a proxy actor per node behind any load balancer.
+    Safe to call again (names are unique per call); a failed boot is killed
+    rather than leaked."""
+    import uuid as _uuid
+
+    addrs = []
+    for i in range(count):
+        actor = ray_tpu.remote(
+            isolate_process=True, num_cpus=0.5,
+            scheduling_strategy="SPREAD",
+            name=f"SERVE_PROXY:{_uuid.uuid4().hex[:6]}:{i}",
+        )(_ProxyActor).remote(port=base_port + i)
+        with _lock:
+            # registered BEFORE the readiness wait: a concurrent
+            # stop_proxies/shutdown can always find (and kill) it
+            _state.setdefault("proxy_actors", []).append(actor)
+        try:
+            ray_tpu.get(actor.ready.remote(), timeout=60)
+            addrs.append(tuple(ray_tpu.get(actor.address.remote(), timeout=30)))
+        except Exception:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+            with _lock:
+                acts = _state.get("proxy_actors", [])
+                if actor in acts:
+                    acts.remove(actor)
+            raise
+    return addrs
+
+
+def stop_proxies() -> None:
+    with _lock:
+        actors = _state.pop("proxy_actors", [])
+    for a in actors:
+        try:
+            ray_tpu.get(a.stop.remote(), timeout=10)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
 
 
 def start_grpc_proxy(host: str = "127.0.0.1", port: int = 9000):

@@ -395,3 +395,56 @@ def test_sse_error_surfaces_as_frame():
         frames = [ln.decode().strip() for ln in r if ln.strip()]
     assert any("error" in f for f in frames)
     assert frames[-1] == "data: [DONE]"
+
+
+def test_per_node_proxy_actors():
+    """Per-node proxy parity (reference: _private/proxy.py — proxy actor per
+    node; serve/api.py:4 documented this as the known delta): SPREAD-placed
+    proxy ACTORS in their own processes route to deployments via the
+    controller-synced table; traffic through every proxy address works."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, body):
+            return {"echo": body["x"], "who": "echo"}
+
+    serve.run(Echo.bind(), route_prefix="/echo")
+    addrs = serve.start_proxies(count=2, base_port=8130)
+    try:
+        assert len(addrs) == 2
+        for host, port in addrs:
+            host = "127.0.0.1" if host in ("0.0.0.0",) else host
+            req = urllib.request.Request(
+                f"http://{host}:{port}/echo", method="POST",
+                data=_json.dumps({"x": 5}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = _json.loads(r.read())
+            assert out == {"result": {"echo": 5, "who": "echo"}}
+        # a route added AFTER the proxies started becomes visible via sync
+        @serve.deployment
+        class Late:
+            def __call__(self, body):
+                return {"late": True}
+
+        serve.run(Late.bind(), route_prefix="/late", name="late")
+        host, port = addrs[0]
+        host = "127.0.0.1" if host == "0.0.0.0" else host
+        deadline = time.time() + 15
+        ok = False
+        while time.time() < deadline and not ok:
+            try:
+                req = urllib.request.Request(
+                    f"http://{host}:{port}/late", method="POST", data=b"{}",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    ok = _json.loads(r.read()) == {"result": {"late": True}}
+            except Exception:
+                time.sleep(0.3)
+        assert ok, "late route never propagated to the proxy actor"
+    finally:
+        serve.stop_proxies()
