@@ -156,17 +156,24 @@ def main():
     init_guard.set()
     on_chip = platform != "cpu"
 
-    # ~350M params fits v5e (16G) with bf16 params + adam states + remat.
-    # Candidates tried in order: "dots" remat (saves matmul outputs, ~1/3
-    # less backward recompute) first, full remat as the known-good fallback
-    # if the lighter policy doesn't fit/compile on this chip.
+    # Candidates tried in order (first that fits/compiles wins). The round-4
+    # sweep family (scripts/tpu_sweep.py): bigger hidden sizes raise MFU —
+    # larger matmuls amortize better on the MXU and shrink the attention
+    # fraction — so the 2048-wide configs lead; the round-2/3 measured
+    # config (dots bs8, hidden 1024, 0.83x) remains the known-good fallback.
     base = dict(
         vocab_size=32000, hidden_size=1024, intermediate_size=4096,
         num_layers=16, num_heads=16, num_kv_heads=8, max_seq_len=2048,
         rope_theta=10000.0, dtype=jnp.bfloat16, remat=True,
     )
+    big = dict(
+        vocab_size=32000, hidden_size=2048, intermediate_size=8192,
+        num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+        rope_theta=10000.0, dtype=jnp.bfloat16, remat=True,
+    )
     if on_chip:
         candidates = [
+            (llama.LlamaConfig(**big, remat_policy="dots"), 8, 2048, 20),
             (llama.LlamaConfig(**base, remat_policy="dots"), 8, 2048, 20),
             (llama.LlamaConfig(**base), 8, 2048, 20),
         ]

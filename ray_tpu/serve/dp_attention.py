@@ -1,0 +1,191 @@
+"""Gang-scheduled data-parallel attention ranks for LLM serving.
+
+Parity: the reference's DP server
+(/root/reference/python/ray/llm/_internal/serve/deployments/dp/dp_server.py:126
+DPServer + dp_rank assignment over a placement group): for MoE models, N
+attention-DP ranks each own their KV cache and request stream, but must STEP
+IN LOCKSTEP — expert layers all-to-all across ranks every decode round, so an
+idle rank still runs a dummy batch rather than stalling the collective.
+
+TPU-native shape: each rank is a PagedLLMEngine in external-step mode hosted
+by an actor; the group reserves one STRICT_PACK placement-group bundle per
+rank (gang placement) and a coordinator thread drives one synchronized
+`step_once` barrier per round — `ray_tpu.get([rank.step.remote() ...])` IS
+the lockstep. Idle ranks burn a dummy decode (same program, zeroed rows) so
+the round structure matches what XLA's expert all-to-all needs on real
+multi-chip meshes, where the per-rank engines share one jitted SPMD program.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Optional
+
+import ray_tpu
+
+
+class _DPRank:
+    """One attention-DP rank: engine + request registry (actor body)."""
+
+    def __init__(self, llm_config, seed: int = 0):
+        from ray_tpu.serve.llm_paged import PagedLLMEngine
+
+        self.engine = PagedLLMEngine(llm_config, seed=seed, external_step=True)
+        self._futs: dict[str, Future] = {}
+
+    def submit(self, prompt_ids: list[int], max_new_tokens: int) -> str:
+        rid = uuid.uuid4().hex[:12]
+        self._futs[rid] = self.engine.generate(prompt_ids, max_new_tokens)
+        return rid
+
+    def step(self) -> int:
+        """One lockstep round: real work if any, else a DUMMY decode (idle
+        ranks must keep collective cadence — dp_server.py's dummy batches).
+        A dummy-decode failure propagates: it invalidates the donated pool,
+        so hiding it would turn every later request into a silent failure.
+        Returns active + queued sequences after the round."""
+        did = self.engine.step_once()
+        if not did:
+            self.engine.dummy_decode()
+        return self.active_count()
+
+    def poll(self, rid: str):
+        fut = self._futs.get(rid)
+        if fut is None:
+            raise KeyError(f"unknown request {rid}")
+        if not fut.done():
+            return None
+        self._futs.pop(rid, None)
+        exc = fut.exception()
+        if exc is not None:
+            raise exc
+        r = fut.result()
+        return {"token_ids": r.token_ids, "prompt_len": r.num_prompt_tokens}
+
+    def cancel(self, rid: str) -> bool:
+        """Reap an abandoned request (client timed out): free its decode slot
+        so it stops consuming lockstep rounds, and drop the future."""
+        fut = self._futs.pop(rid, None)
+        if fut is None:
+            return False
+        eng = self.engine
+        with eng._lock:
+            for i, st in enumerate(eng.slots):
+                if st is not None and st.future is fut:
+                    eng._release_slot(i)
+                    break
+        if not fut.done():
+            fut.set_exception(TimeoutError("request cancelled by client timeout"))
+        return True
+
+    def active_count(self) -> int:
+        return int(self.engine.active.sum()) + self.engine._pending.qsize()
+
+    def shutdown(self) -> None:
+        self.engine.shutdown()
+
+
+class DPAttentionGroup:
+    """N gang-placed DP ranks stepping in lockstep (reference: DPServer)."""
+
+    def __init__(self, llm_config, dp_size: int = 2, use_placement_group: bool = True,
+                 round_interval_s: float = 0.0):
+        self._pg = None
+        if use_placement_group:
+            # the gang reservation: all ranks or none (a partially-placed DP
+            # group would deadlock its own lockstep barrier)
+            self._pg = ray_tpu.placement_group(
+                [{"CPU": 1}] * dp_size, strategy="STRICT_PACK")
+            if not self._pg.wait(timeout_seconds=60):
+                raise TimeoutError("DP gang placement group never became ready")
+        self.ranks = []
+        for i in range(dp_size):
+            opts = dict(num_cpus=1)
+            if self._pg is not None:
+                opts["scheduling_strategy"] = ray_tpu.PlacementGroupSchedulingStrategy(
+                    placement_group=self._pg, placement_group_bundle_index=i)
+            self.ranks.append(
+                ray_tpu.remote(**opts)(_DPRank).remote(llm_config, seed=i))
+        self._interval = round_interval_s
+        self._running = True
+        self.rounds = 0
+        self.healthy = True
+        self.last_error: Optional[str] = None
+        self._thread = threading.Thread(target=self._drive, daemon=True,
+                                        name="dp-attention-coordinator")
+        self._thread.start()
+
+    # ---- routing (least-loaded rank takes the new request) ----
+    def generate(self, prompt_ids: list[int], max_new_tokens: int = 16,
+                 timeout: float = 120.0) -> dict:
+        if not self.healthy:
+            raise RuntimeError(f"DP group unhealthy: {self.last_error}")
+        loads = ray_tpu.get([r.active_count.remote() for r in self.ranks])
+        rank = self.ranks[loads.index(min(loads))]
+        rid = ray_tpu.get(rank.submit.remote(list(prompt_ids), max_new_tokens))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            out = ray_tpu.get(rank.poll.remote(rid))
+            if out is not None:
+                return out
+            time.sleep(0.01)
+        # reap: an abandoned sequence would hold its slot to max_new_tokens,
+        # burning lockstep rounds for every rank, and leak its future
+        try:
+            ray_tpu.get(rank.cancel.remote(rid), timeout=10)
+        except Exception:
+            pass
+        raise TimeoutError("DP generate timed out")
+
+    def _drive(self) -> None:
+        import logging
+
+        log = logging.getLogger("ray_tpu.serve.dp_attention")
+        idle = False
+        while self._running:
+            try:
+                if idle:
+                    # a fully-idle group has no collective to keep in step —
+                    # cheap probe instead of a full dummy round on every rank
+                    counts = ray_tpu.get(
+                        [r.active_count.remote() for r in self.ranks], timeout=60)
+                    if sum(counts) == 0:
+                        time.sleep(0.02)
+                        continue
+                # the barrier: every rank steps exactly once per round
+                counts = ray_tpu.get([r.step.remote() for r in self.ranks],
+                                     timeout=120)
+                self.rounds += 1
+                self.healthy = True
+                idle = sum(counts) == 0
+            except Exception as e:  # noqa: BLE001
+                if not self._running:
+                    return
+                # visible degradation: a dead rank stalls the whole gang (by
+                # design — the collective needs every rank); flag + log it
+                self.healthy = False
+                self.last_error = repr(e)
+                log.warning("DP lockstep round failed: %r", e)
+                time.sleep(0.5)
+            if self._interval:
+                time.sleep(self._interval)
+
+    def shutdown(self) -> None:
+        self._running = False
+        for r in self.ranks:
+            try:
+                ray_tpu.get(r.shutdown.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        if self._pg is not None:
+            try:
+                ray_tpu.remove_placement_group(self._pg)
+            except Exception:
+                pass

@@ -68,7 +68,8 @@ class _Slot:
 class LLMEngine:
     """Continuous-batching generation engine (vLLM-engine equivalent, jax-native)."""
 
-    def __init__(self, config: LLMConfig, params=None, seed: int = 0):
+    def __init__(self, config: LLMConfig, params=None, seed: int = 0,
+                 external_step: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -88,9 +89,21 @@ class LLMEngine:
         self._running = True
         self._sample_key = key
         self._init_backend()  # subclass hook: cache/pool + jitted programs
-        self._loop_thread = threading.Thread(target=self._loop, daemon=True,
-                                             name=type(self).__name__)
-        self._loop_thread.start()
+        # external_step: no internal loop thread — a coordinator drives the
+        # engine via step_once() (DP-attention rank lockstep, dp_attention.py)
+        self._loop_thread = None
+        if not external_step:
+            self._loop_thread = threading.Thread(target=self._loop, daemon=True,
+                                                 name=type(self).__name__)
+            self._loop_thread.start()
+
+    def step_once(self) -> bool:
+        """One admit/decode round under external control; True if work ran."""
+        try:
+            return self._loop_step()
+        except Exception as e:  # noqa: BLE001 - engine must survive any request
+            self._fail_all_active(e)
+            return True
 
     def _init_backend(self) -> None:
         """Dense per-slot KV cache backend (paged subclass overrides)."""

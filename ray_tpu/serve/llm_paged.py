@@ -45,10 +45,12 @@ class PagedLLMConfig(LLMConfig):
 class PagedLLMEngine(LLMEngine):
     """Continuous batching over a paged KV pool with prefix caching."""
 
-    def __init__(self, config: PagedLLMConfig | None = None, params=None, seed: int = 0):
+    def __init__(self, config: PagedLLMConfig | None = None, params=None, seed: int = 0,
+                 external_step: bool = False):
         # PD ops (prefill_extract / attach) processed on the engine thread
         self._ops: "queue.Queue" = queue.Queue()
-        super().__init__(config or PagedLLMConfig(), params=params, seed=seed)
+        super().__init__(config or PagedLLMConfig(), params=params, seed=seed,
+                         external_step=external_step)
 
     def _init_backend(self) -> None:
         jax, jnp = self._jax, self._jnp
@@ -81,6 +83,17 @@ class PagedLLMEngine(LLMEngine):
 
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
         self._decode = jax.jit(decode, donate_argnums=(1,))
+
+    def dummy_decode(self) -> None:
+        """Cadence-keeping round for DP-attention lockstep (dp_attention.py):
+        decode the zeroed batch — inactive rows write into the reserved
+        garbage block 0, burning a real round's FLOPs/collective shape.
+        Lives HERE with the jit definition because `_decode` donates the
+        pool: the returned pool must be rebound, and a failure after
+        dispatch invalidates the donated buffer — fatal for the engine, so
+        it propagates instead of being swallowed."""
+        _, self.pool = self._decode(self.params, self.pool, self.last_tokens,
+                                    self.lengths, self.tables)
 
     # ---- slot lifecycle ----
     def _release_slot(self, i: int) -> None:

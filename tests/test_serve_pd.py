@@ -1,6 +1,8 @@
 """PD-disaggregated serve deployment tests (reference: serving_patterns/
 prefill_decode/pd_server.py)."""
 
+import time
+
 import pytest
 
 import ray_tpu
@@ -42,3 +44,52 @@ def test_pd_deployment_matches_single_engine(session):
 
     stats = ray_tpu.get(handle.stats.remote(), timeout=30)
     assert "prefill" in stats and "decode" in stats
+
+
+def test_dp_attention_gang_lockstep(ray_start_regular):
+    """DP-attention ranks (reference: dp_server.py:126): gang-placed rank
+    actors step in lockstep; an idle rank keeps cadence with dummy decodes;
+    requests route to the least-loaded rank and complete correctly."""
+    from ray_tpu.serve.dp_attention import DPAttentionGroup
+    from ray_tpu.serve.llm_paged import PagedLLMConfig
+    from ray_tpu.models import llama
+
+    cfg = PagedLLMConfig(
+        model_config=llama.LlamaConfig.tiny(), max_batch_size=2,
+        max_seq_len=64, block_size=16, temperature=0.0,
+    )
+    group = DPAttentionGroup(cfg, dp_size=2)
+    try:
+        # single request: only ONE rank has work, the other must dummy-step
+        out = group.generate([1, 2, 3, 4], max_new_tokens=5, timeout=60)
+        assert len(out["token_ids"]) == 5 and out["prompt_len"] == 4
+        assert group.rounds >= 5  # one lockstep round per decoded token
+        # fully idle group: rounds stop (no collective to keep in step),
+        # the coordinator only probes
+        time.sleep(0.5)
+        r0 = group.rounds
+        time.sleep(0.4)
+        assert group.rounds == r0
+
+        # concurrent requests spread across ranks and all complete
+        import threading as _t
+
+        results = []
+        errs = []
+
+        def one(i):
+            try:
+                results.append(group.generate([1 + i, 2, 3], max_new_tokens=4,
+                                              timeout=60))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [_t.Thread(target=one, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=90)
+        assert not errs and len(results) == 4
+        assert all(len(r["token_ids"]) == 4 for r in results)
+    finally:
+        group.shutdown()
