@@ -28,6 +28,7 @@ import ctypes
 import dataclasses
 import inspect
 import logging
+import os
 import queue
 import threading
 import time
@@ -359,6 +360,17 @@ class Runtime:
             self.memory_store.put(oid, RayObject(error=value))
             return
         size = _rough_size(value)
+        # Device-resident tensors stay on device (reference: experimental/rdt
+        # GPU-to-GPU transport that bypasses plasma): promoting a jax.Array
+        # to shm would pay a device->host copy even when every consumer is
+        # in-process (one process per chip: in-process IS on-chip). The
+        # memory store holds the ARRAY REFERENCE; cross-process consumers
+        # fall back transparently — arg marshaling / client gets serialize
+        # via _to_host at the boundary. HBM residency is the caller's budget
+        # (these objects never spill).
+        if _is_device_array(value):
+            self.memory_store.put(oid, RayObject(value=value, size=size))
+            return
         # Promote large objects to the shared-memory store (plasma path); the
         # memory store keeps only a marker. Reference: max_direct_call_object_size
         # boundary (ray_config_def.h:245).
@@ -1086,7 +1098,16 @@ class Runtime:
                     # In the object plane somewhere: the worker resolves it
                     # from its node store, or pulls from a holder on miss.
                     return ShmArg(oid.binary())
-                return self.get([a])[0]
+                val = self.get([a])[0]
+                if _is_device_array(val):
+                    # host snapshot at the process boundary: shipping the
+                    # live jax.Array would make the worker's unpickle import
+                    # jax (multi-second, and a fresh interpreter may probe
+                    # TPU platforms — one process per chip)
+                    import numpy as _np
+
+                    return _np.asarray(val)
+                return val
             return a
 
         args = tuple(conv(a) for a in spec.args)
@@ -2268,6 +2289,26 @@ def _sweep_stale_node_segments() -> None:
                 pass
         except PermissionError:
             pass  # pid exists under another uid: not ours to sweep
+
+
+def _is_device_array(value: Any) -> bool:
+    """True for jax.Arrays living on a REAL accelerator. CPU-backed arrays
+    are excluded: there is no device->host copy to avoid, and keeping them
+    inline would bypass the shm zero-copy path AND push a jax-importing
+    pickle into every consumer worker. RAY_TPU_RDT_CPU=1 opts CPU backends
+    in (tests exercise the resident path without a chip). Reuses the
+    serialization module's no-import jax type probe."""
+    from ray_tpu._private.serialization import _jax_array_types
+
+    types = _jax_array_types()
+    if not types or not isinstance(value, types):
+        return False
+    if os.environ.get("RAY_TPU_RDT_CPU") == "1":
+        return True
+    try:
+        return all(d.platform != "cpu" for d in value.devices())
+    except Exception:
+        return False
 
 
 def _rough_size(value: Any) -> int:

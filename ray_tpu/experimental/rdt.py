@@ -1,0 +1,50 @@
+"""Device-tensor transport (RDT equivalent).
+
+Parity: the reference's `ray.experimental` RDT / GPU-object path — GPU
+tensors move actor-to-actor without a plasma round-trip (collective or
+p2p transports chosen per topology). The TPU-native mapping:
+
+- **On-chip (in-process)**: one process owns each chip, so every thread
+  actor / compiled-graph stage in that process shares the chip.
+  `ray_tpu.put(jax_array)` keeps the buffer DEVICE-RESIDENT and consumers
+  receive the same `jax.Array` by reference — zero copies, zero host
+  traffic (runtime._store_value's device branch).
+- **Cross-chip (one jitted program)**: collectives belong to XLA — shard
+  over a Mesh and let `psum`/`ppermute` ride ICI. RDT's collective
+  transport has no user-level equivalent here BY DESIGN (SURVEY §2.6).
+- **Cross-process / cross-host**: arg marshaling and client gets serialize
+  through `_to_host` at the boundary — the DCN path, paid only when a
+  device object actually leaves the process (e.g. the paged-KV handoff in
+  serve/pd.py ships KV blocks this way).
+
+This module is the thin API + introspection over that behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+
+
+def device_put(value: Any) -> ObjectRef:
+    """Store a device array without leaving the device (alias of
+    `ray_tpu.put` — the runtime keeps ACCELERATOR-backed jax.Arrays resident
+    automatically; CPU-backed arrays take the normal shm path, where a host
+    snapshot is strictly better). This name documents intent at call sites.
+
+    ALIASING: the stored object IS the caller's buffer — no snapshot is
+    taken. Donating the array to a jitted call (donate_argnums) after
+    putting it invalidates the stored object ("Array has been deleted" on
+    get). Snapshot first (`jnp.copy`) if the buffer will be donated."""
+    return ray_tpu.put(value)
+
+
+def is_device_resident(ref: ObjectRef) -> bool:
+    """True if the object is held as a live device buffer (in-process
+    reference), False if it lives in shm/host memory."""
+    from ray_tpu.core.runtime import _is_device_array, get_runtime
+
+    obj = get_runtime().memory_store.get_if_exists(ref.object_id())
+    return obj is not None and _is_device_array(obj.value)
