@@ -84,6 +84,9 @@ class Config:
     # --- task events / observability (reference: task_event_buffer.h) ---
     task_events_enabled: bool = True
     task_events_max_buffer: int = 10000
+    # Export-event pipeline (reference: export API JSONL files under the
+    # session dir for external ingestion); env: RAY_TPU_EXPORT_EVENTS_ENABLED
+    export_events_enabled: bool = False
 
     # --- logging ---
     log_to_driver: bool = True

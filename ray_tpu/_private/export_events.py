@@ -1,0 +1,115 @@
+"""Export-event pipeline: schema'd JSONL event files for external ingestion.
+
+Parity: the reference's export API (src/ray/util/event.cc RayExportEvent +
+python exportable events — task/actor/node/driver-job state transitions
+written as JSON lines under the session dir, consumed by external
+observability pipelines rather than the in-process dashboard).
+
+Config-gated (config.export_events_enabled / env
+RAY_TPU_EXPORT_EVENTS_ENABLED):
+every emit appends one line to `<dir>/export_<source>.jsonl` with the
+reference's envelope shape {event_id, timestamp, source_type, event_data}.
+Files rotate at `max_bytes` (one `.1` generation, like the reference's
+size-capped event logs)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any
+
+_LOCK = threading.Lock()
+_WRITERS: dict[str, "_Writer"] = {}
+_DIR: str | None = None
+MAX_BYTES = 8 * 1024 * 1024
+
+
+class _Writer:
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def emit(self, line: str) -> None:
+        with self._lock:
+            if self._f.tell() + len(line) > MAX_BYTES:
+                self._f.close()
+                try:  # one rotated generation, reference-style size cap
+                    os.replace(self.path, self.path + ".1")
+                except OSError:
+                    pass
+                self._f = open(self.path, "a", buffering=1)
+            self._f.write(line)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+_ENABLED = False
+
+
+def _compute_enabled() -> bool:
+    """One flag, one override tier: config.export_events_enabled (env form
+    RAY_TPU_EXPORT_EVENTS_ENABLED via Config.apply_env_overrides — parsed
+    like every other config boolean)."""
+    try:
+        from ray_tpu._private.config import get_config
+
+        return bool(getattr(get_config(), "export_events_enabled", False))
+    except Exception:
+        return False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def configure(session_dir: str) -> None:
+    """Point the pipeline at this session's export dir and refresh the
+    enabled decision (called by init; safe across re-inits — prior sessions'
+    writers are closed so events never land in an old session's files)."""
+    global _DIR, _ENABLED
+    with _LOCK:
+        for w in _WRITERS.values():
+            w.close()
+        _WRITERS.clear()
+        _DIR = os.path.join(session_dir, "export_events")
+        _ENABLED = _compute_enabled()
+
+
+def emit(source_type: str, event_data: dict[str, Any]) -> None:
+    """Append one export event; a single boolean check when disabled (the
+    default — this sits on the task-transition hot path). Never raises into
+    the runtime paths that call it."""
+    if not _ENABLED:
+        return
+    try:
+        with _LOCK:
+            d = _DIR or os.path.join("/tmp/ray_tpu", "export_events")
+            w = _WRITERS.get(source_type)
+            if w is None:
+                os.makedirs(d, exist_ok=True)
+                w = _WRITERS[source_type] = _Writer(
+                    os.path.join(d, f"export_{source_type}.jsonl"))
+        w.emit(json.dumps({
+            "event_id": uuid.uuid4().hex,
+            "timestamp": time.time(),
+            "source_type": source_type,
+            "event_data": event_data,
+        }, default=str) + "\n")
+    except Exception:
+        pass
+
+
+def shutdown() -> None:
+    with _LOCK:
+        for w in _WRITERS.values():
+            w.close()
+        _WRITERS.clear()

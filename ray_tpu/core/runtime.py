@@ -319,6 +319,9 @@ class Runtime:
 
         self.publisher = Publisher()  # GCS channels equivalent (src/ray/pubsub/)
         self.session_log_dir = _os.path.join(self.session_dir, "logs")
+        from ray_tpu._private import export_events as _export
+
+        _export.configure(self.session_dir)
         self._log_monitor = None
         self._memory_monitor = None
         if config.log_to_driver:
@@ -994,6 +997,13 @@ class Runtime:
     def _publish_actor_event(self, state: "_ActorState") -> None:
         """GCS_ACTOR_CHANNEL equivalent (pubsub.proto:32): every actor state
         transition publishes to the 'actors' channel."""
+        from ray_tpu._private import export_events
+
+        export_events.emit("actor", {
+            "actor_id": state.actor_id.hex(), "class_name": state.cls.__name__,
+            "state": state.state, "name": state.name,
+            "num_restarts": state.num_restarts,
+        })
         try:
             self.publisher.publish("actors", {
                 "actor_id": state.actor_id.hex(),
@@ -1048,6 +1058,9 @@ class Runtime:
         surviving nodes (reference: node death -> task FT + lineage rebuild)."""
         self._agents.pop(node_id, None)
         self.node_stats.pop(node_id, None)  # no live-looking stats on a dead row
+        from ray_tpu._private import export_events
+
+        export_events.emit("node", {"node_id": node_id.hex(), "state": "DEAD"})
         # Objects whose only copies lived on the dead node are now lost; the
         # next access misses the directory and falls to lineage reconstruction.
         with self._lock:
@@ -2117,6 +2130,13 @@ class Runtime:
     # ------------------------------------------------------------------ events / state API
     def _record_event(self, spec: TaskSpec, state: str) -> None:
         """Reference: TaskEventBuffer (task_event_buffer.h:305) → gcs_task_manager."""
+        from ray_tpu._private import export_events
+
+        # export pipeline is independent of the in-memory buffer gate below
+        export_events.emit("task", {
+            "task_id": spec.task_id.hex(), "name": spec.desc(), "state": state,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+        })
         if not self.config.task_events_enabled:
             return
         with self._lock:

@@ -70,6 +70,12 @@ class _Supervisor:
         self.info.end_time = time.time()
         if self.info.status != JobStatus.STOPPED:
             self.info.status = JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+        from ray_tpu._private import export_events
+
+        export_events.emit("driver_job", {
+            "job_id": self.info.job_id, "status": self.info.status.value,
+            "entrypoint": self.info.entrypoint, "returncode": rc,
+        })
 
     def stop(self) -> None:
         if self.proc and self.proc.poll() is None:
