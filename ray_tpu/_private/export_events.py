@@ -35,7 +35,7 @@ class _Writer:
 
     def emit(self, line: str) -> None:
         with self._lock:
-            if self._f.tell() + len(line) > MAX_BYTES:
+            if self._f.tell() + len(line.encode("utf-8")) > MAX_BYTES:
                 self._f.close()
                 try:  # one rotated generation, reference-style size cap
                     os.replace(self.path, self.path + ".1")
@@ -91,13 +91,18 @@ def emit(source_type: str, event_data: dict[str, Any]) -> None:
     if not _ENABLED:
         return
     try:
-        with _LOCK:
-            d = _DIR or os.path.join("/tmp/ray_tpu", "export_events")
-            w = _WRITERS.get(source_type)
-            if w is None:
-                os.makedirs(d, exist_ok=True)
-                w = _WRITERS[source_type] = _Writer(
-                    os.path.join(d, f"export_{source_type}.jsonl"))
+        # lock-free fast path: dict reads are atomic under the GIL, and the
+        # writer exists after the first event per source — only a miss takes
+        # the global lock (this sits on the task-transition hot path)
+        w = _WRITERS.get(source_type)
+        if w is None:
+            with _LOCK:
+                w = _WRITERS.get(source_type)
+                if w is None:
+                    assert _DIR is not None  # configure() precedes _ENABLED
+                    os.makedirs(_DIR, exist_ok=True)
+                    w = _WRITERS[source_type] = _Writer(
+                        os.path.join(_DIR, f"export_{source_type}.jsonl"))
         w.emit(json.dumps({
             "event_id": uuid.uuid4().hex,
             "timestamp": time.time(),
@@ -109,7 +114,12 @@ def emit(source_type: str, event_data: dict[str, Any]) -> None:
 
 
 def shutdown() -> None:
+    """Close writers and disable: a daemon thread finishing after
+    Runtime.shutdown (e.g. a job supervisor's _wait) must not resurrect
+    export files in the dead session's dir."""
+    global _ENABLED
     with _LOCK:
+        _ENABLED = False
         for w in _WRITERS.values():
             w.close()
         _WRITERS.clear()

@@ -999,19 +999,16 @@ class Runtime:
         transition publishes to the 'actors' channel."""
         from ray_tpu._private import export_events
 
-        export_events.emit("actor", {
-            "actor_id": state.actor_id.hex(), "class_name": state.cls.__name__,
-            "state": state.state, "name": state.name,
+        payload = {
+            "actor_id": state.actor_id.hex(),
+            "class_name": state.cls.__name__,
+            "state": state.state,
+            "name": state.name,
             "num_restarts": state.num_restarts,
-        })
+        }
+        export_events.emit("actor", payload)
         try:
-            self.publisher.publish("actors", {
-                "actor_id": state.actor_id.hex(),
-                "class_name": state.cls.__name__,
-                "state": state.state,
-                "name": state.name,
-                "num_restarts": state.num_restarts,
-            })
+            self.publisher.publish("actors", payload)
         except Exception:
             pass
 
@@ -2219,6 +2216,9 @@ class Runtime:
     # ------------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:
         self.is_shutdown = True
+        from ray_tpu._private import export_events
+
+        export_events.shutdown()  # close writers; late daemon emits no-op
         for state in list(self._actors.values()):
             if state.proc_worker is not None:
                 try:

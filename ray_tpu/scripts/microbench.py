@@ -78,6 +78,7 @@ def bench_actor_calls_sync(n: int) -> dict:
     for _ in range(n):
         ray_tpu.get(a.nop.remote())
     dt = time.perf_counter() - t0
+    ray_tpu.kill(a)  # actors hold CPU capacity; repeats would exhaust it
     return {"metric": "actor_calls_sync_1_1", "value": _rate(n, dt), "unit": "calls/s"}
 
 
@@ -95,6 +96,8 @@ def bench_actor_calls_async(n: int, num_actors: int = 4) -> dict:
     refs = [actors[i % num_actors].nop.remote() for i in range(n)]
     ray_tpu.get(refs)
     dt = time.perf_counter() - t0
+    for a in actors:  # release held CPU capacity before the next repeat
+        ray_tpu.kill(a)
     return {"metric": "actor_calls_async_n_n", "value": _rate(n, dt), "unit": "calls/s"}
 
 
