@@ -82,13 +82,25 @@ def test_asha_stops_bad_trials():
 
 
 def test_pbt_exploits_leader():
+    import threading
+
     sched = tune.PopulationBasedTraining(
         metric="score", mode="max", perturbation_interval=2,
         hyperparam_mutations={"lr": (0.001, 1.0)}, seed=0,
     )
+    # Exploitation needs OVERLAPPING trials (a lagger sees a leader's
+    # result). Under heavy load the 4 trial threads can end up scheduled
+    # back-to-back and finish before any peer reports — the barrier forces
+    # one round of overlap; the timeout keeps capacity hiccups from
+    # deadlocking the test (it then just runs like before).
+    gate = threading.Barrier(4)
 
     def objective(config):
         lr = config["lr"]
+        try:
+            gate.wait(timeout=20)
+        except threading.BrokenBarrierError:
+            pass
         for i in range(1, 9):
             # score improves faster with higher lr (toy)
             report({"score": lr * i, "training_iteration": i})
