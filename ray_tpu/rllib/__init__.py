@@ -28,6 +28,8 @@ from ray_tpu.rllib.sac_continuous import (
     ContinuousSACConfig,
     ContinuousSACLearner,
 )
+from ray_tpu.rllib.tqc import TQC, TQCConfig
+from ray_tpu.rllib.iql import IQL, IQLConfig
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner
 from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rllib.multi_agent import (
@@ -56,7 +58,7 @@ __all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
            "MultiAgentPPOConfig",
            "EnvRunnerGroup", "Episode", "SingleAgentEnvRunner",
-           "ContinuousSAC", "ContinuousSACConfig", "ContinuousSACLearner",
+           "ContinuousSAC", "ContinuousSACConfig", "TQC", "TQCConfig", "IQL", "IQLConfig", "ContinuousSACLearner",
            "Connector", "ConnectorPipeline", "FlattenObs", "ClipObs",
            "NormalizeObs", "FrameStack", "ClipActions", "UnsquashActions",
            "pipeline"]

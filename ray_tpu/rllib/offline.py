@@ -39,11 +39,14 @@ def write_offline_json(transitions: dict, path: str) -> int:
     return n
 
 
-def load_offline_data(source: Any) -> dict:
+def load_offline_data(source: Any, action_dtype=None) -> dict:
     """Normalize an offline source into a numpy transition dict.
 
     Accepts a numpy dict, a JSONL path, or a ray_tpu.data Dataset of rows
-    (reference: OfflineData wraps Ray Data datasets, offline/offline_data.py)."""
+    (reference: OfflineData wraps Ray Data datasets, offline/offline_data.py).
+    ``action_dtype`` defaults to the data's own type — continuous actions
+    loaded from JSONL must NOT truncate to integers; discrete consumers
+    (BC/MARWIL/CQL) pass np.int64 explicitly."""
     if isinstance(source, dict):
         return source
     if isinstance(source, str):
@@ -53,7 +56,7 @@ def load_offline_data(source: Any) -> dict:
     rows = source.take_all() if hasattr(source, "take_all") else list(source)
     return {
         "obs": np.asarray([r["obs"] for r in rows], np.float32),
-        "actions": np.asarray([r["action"] for r in rows], np.int64),
+        "actions": np.asarray([r["action"] for r in rows], action_dtype),
         "rewards": np.asarray([r["reward"] for r in rows], np.float32),
         "next_obs": np.asarray([r["next_obs"] for r in rows], np.float32),
         "dones": np.asarray([r["done"] for r in rows], np.float32),
@@ -112,7 +115,7 @@ class _OfflineAlgorithm:
 
     def __init__(self, cfg: OfflineConfig):
         self.cfg = cfg
-        self.data = load_offline_data(cfg.dataset)
+        self.data = load_offline_data(cfg.dataset, action_dtype=np.int64)
         if not len(self.data["obs"]):
             raise ValueError("offline dataset is empty")
         self.obs_dim = int(self.data["obs"].shape[-1])

@@ -192,6 +192,7 @@ class ContinuousSAC:
     """The Algorithm (reference: algorithms/algorithm.py train() loop)."""
 
     action_dtype = np.float32  # consulted by off_policy_train_iteration
+    learner_cls: "type | None" = None  # TQC swaps in its quantile learner
 
     def __init__(self, cfg: ContinuousSACConfig):
         import gymnasium as gym
@@ -202,7 +203,8 @@ class ContinuousSAC:
         env_creator = (cfg.env if callable(cfg.env)
                        else (lambda name=cfg.env: gym.make(name)))
         obs_dim, act_dim, low, high = probe_env_spaces_continuous(env_creator)
-        self.learner = ContinuousSACLearner(cfg, obs_dim, act_dim)
+        learner_cls = type(self).learner_cls or ContinuousSACLearner
+        self.learner = learner_cls(cfg, obs_dim, act_dim)
         self.env_steps_total = 0
 
         import jax

@@ -1,0 +1,147 @@
+"""TQC: Truncated Quantile Critics (Kuznetsov et al. 2020).
+
+Parity: the rllib algorithm family's continuous-control tail (the reference
+ships SAC; TQC is its distributional successor used by SB3/contrib and named
+in the round verdicts as a missing family). Design: SAC's actor/temperature
+machinery (sac_continuous.py) with the twin scalar critics replaced by M
+quantile critics of K atoms each; the Bellman target pools all M*K next-state
+atoms, sorts, and DROPS the top d-per-net atoms — truncating the
+overestimation tail that max-entropy bootstrapping amplifies. One jitted XLA
+update covers all critics (vmapped over the critic axis), the actor, and
+alpha.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ray_tpu.rllib.ppo import _mlp_apply, _mlp_init
+from ray_tpu.rllib.sac_continuous import (
+    ContinuousSAC,
+    ContinuousSACConfig,
+    ContinuousSACLearner,
+    _squashed_gaussian,
+)
+
+
+@dataclasses.dataclass
+class TQCConfig(ContinuousSACConfig):
+    num_critics: int = 5                 # M
+    num_quantiles: int = 25              # K atoms per critic
+    top_quantiles_to_drop_per_net: int = 2  # d — the truncation knob
+
+    def build(self) -> "TQC":
+        return TQC(self)
+
+
+class TQCLearner:
+    """M vmapped quantile critics + SAC actor/alpha in one jitted update."""
+
+    def __init__(self, cfg: TQCConfig, obs_dim: int, act_dim: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        M, K = cfg.num_critics, cfg.num_quantiles
+        n_drop = cfg.top_quantiles_to_drop_per_net * M
+        n_keep = M * K - n_drop
+        key = jax.random.PRNGKey(cfg.seed)
+        kp, kq, self._key = jax.random.split(key, 3)
+        q_trees = [
+            _mlp_init(k, (obs_dim + act_dim, *cfg.hidden, K))
+            for k in jax.random.split(kq, M)
+        ]
+        stack = lambda *xs: jnp.stack(xs)  # noqa: E731 - leafwise critic axis
+        self.params = {
+            "pi": _mlp_init(kp, (obs_dim, *cfg.hidden, 2 * act_dim)),
+            "qs": jax.tree.map(stack, *q_trees),
+            "log_alpha": jnp.zeros(()),
+        }
+        self.target = {"qs": self.params["qs"]}
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(5.0),
+            optax.multi_transform(
+                {"actor": optax.adam(cfg.actor_lr),
+                 "critic": optax.adam(cfg.critic_lr),
+                 "alpha": optax.adam(cfg.alpha_lr)},
+                {"pi": "actor", "qs": "critic", "log_alpha": "alpha"},
+            ),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                          else -float(act_dim))
+        self.num_updates = 0
+        taus = (jnp.arange(K, dtype=jnp.float32) + 0.5) / K  # quantile midpoints
+
+        def z_all(qs, obs, act):
+            """[M, B, K] atoms from the stacked critic trees."""
+            x = jnp.concatenate([obs, act], axis=-1)
+            return jax.vmap(lambda q: _mlp_apply(q, x, jnp))(qs)
+
+        def loss_fn(params, target, key, obs, actions, rewards, next_obs, dones):
+            alpha = jnp.exp(params["log_alpha"])
+            k_next, k_pi = jax.random.split(key)
+            B, A = actions.shape
+            # --- truncated distributional target ---
+            next_a, next_logp = _squashed_gaussian(
+                jnp, jax, _mlp_apply(params["pi"], next_obs, jnp),
+                jax.random.normal(k_next, (B, A)),
+            )
+            nz = z_all(target["qs"], next_obs, next_a)          # [M, B, K]
+            pooled = jnp.sort(nz.transpose(1, 0, 2).reshape(B, M * K), axis=1)
+            kept = pooled[:, :n_keep]                            # drop the top tail
+            y = jax.lax.stop_gradient(
+                rewards[:, None] + cfg.gamma * (1.0 - dones[:, None])
+                * (kept - jax.lax.stop_gradient(alpha) * next_logp[:, None])
+            )                                                    # [B, n_keep]
+            # --- quantile Huber regression, every critic against every kept atom ---
+            z = z_all(params["qs"], obs, actions)                # [M, B, K]
+            delta = y[None, :, None, :] - z[:, :, :, None]       # [M, B, K, n_keep]
+            ad = jnp.abs(delta)
+            huber = jnp.where(ad <= 1.0, 0.5 * delta ** 2, ad - 0.5)
+            w = jnp.abs(taus[None, None, :, None]
+                        - (delta < 0.0).astype(jnp.float32))
+            critic_loss = (w * huber).mean()
+            # --- actor: maximize the UNtruncated mean of all atoms ---
+            a_pi, logp_pi = _squashed_gaussian(
+                jnp, jax, _mlp_apply(params["pi"], obs, jnp),
+                jax.random.normal(k_pi, (B, A)),
+            )
+            q_pi = z_all(jax.lax.stop_gradient(params["qs"]), obs, a_pi).mean(
+                axis=(0, 2))                                     # [B]
+            actor_loss = (jax.lax.stop_gradient(alpha) * logp_pi - q_pi).mean()
+            alpha_loss = (-params["log_alpha"]
+                          * jax.lax.stop_gradient(logp_pi + target_entropy)).mean()
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "alpha": alpha, "entropy": -logp_pi.mean(),
+            }
+
+        def update(params, target, opt_state, key, batch):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target, key, batch["obs"], batch["actions"],
+                batch["rewards"], batch["next_obs"], batch["dones"],
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                target, {"qs": params["qs"]},
+            )
+            metrics["total_loss"] = loss
+            return params, target, opt_state, metrics
+
+        self._update = jax.jit(update)
+        self._jax, self._jnp = jax, jnp
+
+    # Same host-side batch marshaling as the SAC learner — the jitted
+    # kernels differ, the update() contract doesn't.
+    update = ContinuousSACLearner.update
+
+
+class TQC(ContinuousSAC):
+    """SAC shell + TQC learner (same runners/buffer/off-policy loop)."""
+
+    learner_cls = TQCLearner
