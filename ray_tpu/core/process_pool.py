@@ -371,6 +371,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
     actor_env_stack = None  # noqa: F841 - held so the env outlives __init__
     actor_loop = None
     actor_pool = None  # sync-method thread pool when max_concurrency > 1
+    actor_group_pools: dict = {}  # named concurrency group -> its own pool
     # (reference: concurrency_group_manager.cc runs sync calls on a pool of
     # max_concurrency threads inside the worker; user code owns its locking)
 
@@ -417,11 +418,19 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 args, kwargs = _decode_call(req[2])
                 renv = req[3] if len(req) > 3 else None
                 mc = req[4] if len(req) > 4 else 1
-                if mc > 1:
+                groups = req[5] if len(req) > 5 else None
+                if mc > 1 or groups:
                     from concurrent.futures import ThreadPoolExecutor
 
                     actor_pool = ThreadPoolExecutor(
-                        max_workers=mc, thread_name_prefix="actor-sync")
+                        max_workers=max(mc, 1), thread_name_prefix="actor-sync")
+                    # one pool per named concurrency group: a slow method in
+                    # one group never exhausts another group's threads
+                    # (reference: concurrency_group_manager.cc per-group pools)
+                    for gname, limit in (groups or {}).items():
+                        actor_group_pools[gname] = ThreadPoolExecutor(
+                            max_workers=max(int(limit), 1),
+                            thread_name_prefix=f"actor-{gname}")
                 if renv:
                     import contextlib
 
@@ -437,8 +446,9 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 _reply(_error_payload(e))
             continue
         if kind == "actor_call2":
-            # ("actor_call2", seq, method, args_blob, oid_bin)
-            _, seq, method_name, args_blob, oid_bin = req
+            # ("actor_call2", seq, method, args_blob, oid_bin[, group])
+            _, seq, method_name, args_blob, oid_bin = req[:5]
+            call_group = req[5] if len(req) > 5 else None
             if _check_skip(seq):
                 _reply(("skipped", seq))
                 continue
@@ -463,10 +473,10 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                     import asyncio
 
                     asyncio.run_coroutine_threadsafe(_run_async(), _ensure_loop())
-                elif actor_pool is not None:
-                    # sync method on the pool: the executor moves on, replies
-                    # arrive out of order as calls finish (same contract as
-                    # async methods — the parent matches by seq)
+                elif actor_pool is not None or call_group is not None:
+                    # sync method on the (group's) pool: the executor moves
+                    # on, replies arrive out of order as calls finish (same
+                    # contract as async methods — the parent matches by seq)
                     def _run_pooled(m=method, a=args, kw=kwargs, s=seq, ob=oid_bin):
                         try:
                             result = m(*a, **kw)
@@ -475,15 +485,20 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                             return
                         _finish_call(s, result, ob)
 
-                    actor_pool.submit(_run_pooled)
+                    pool_for = actor_group_pools.get(call_group) or actor_pool
+                    if pool_for is None:
+                        _run_pooled()
+                    else:
+                        pool_for.submit(_run_pooled)
                 else:
                     _finish_call(seq, method(*args, **kwargs), oid_bin)
             except BaseException as e:  # noqa: BLE001
                 _finish_err(seq, e)
             continue
         if kind == "actor_gen":
-            # ("actor_gen", seq, method, args_blob, task_bin, backpressure)
-            _, seq, method_name, args_blob, task_bin, bp = req
+            # ("actor_gen", seq, method, args_blob, task_bin, bp[, group])
+            _, seq, method_name, args_blob, task_bin, bp = req[:6]
+            gen_group = req[6] if len(req) > 6 else None
             if _check_skip(seq):
                 _reply(("skipped", seq))
                 continue
@@ -515,12 +530,29 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
                     asyncio.run_coroutine_threadsafe(_run_agen(), _ensure_loop())
                 else:
-                    try:
-                        _stream_out(seq, task_bin, method(*args, **kwargs), bp)
-                    finally:
-                        with pend_cv:
-                            gen_consumed.pop(seq, None)
-                        _retire(seq)
+                    def _run_sync_gen(m=method, a=args, kw=kwargs, s=seq,
+                                      tb=task_bin, b=bp):
+                        try:
+                            try:
+                                _stream_out(s, tb, m(*a, **kw), b)
+                            finally:
+                                with pend_cv:
+                                    gen_consumed.pop(s, None)
+                                _retire(s)
+                        except BaseException as e:  # noqa: BLE001
+                            status, payload, extra = _error_payload(e)
+                            _reply(("done", s, status, payload, extra))
+                            _retire(s)
+
+                    # a GROUPED streaming method runs on its group's pool so
+                    # a long-lived stream never wedges the executor loop that
+                    # dispatches every other group (_stream_out only touches
+                    # pend_cv-guarded state + the locked _reply — thread-safe)
+                    gp = actor_group_pools.get(gen_group)
+                    if gp is not None:
+                        gp.submit(_run_sync_gen)
+                    else:
+                        _run_sync_gen()
             except BaseException as e:  # noqa: BLE001
                 status, payload, extra = _error_payload(e)
                 _reply(("done", seq, status, payload, extra))
@@ -812,22 +844,24 @@ class DedicatedActorWorker:
                     fut.set_result(None)
 
     def init_actor(self, cls, args_blob: bytes, runtime_env: dict | None = None,
-                   max_concurrency: int = 1) -> None:
+                   max_concurrency: int = 1,
+                   concurrency_groups: dict | None = None) -> None:
         with self._mu:
             if self._dead:
                 raise WorkerCrashedError("actor worker process died")
             fut = self._init_fut = Future()
         try:
             self._send(("actor_init", cloudpickle.dumps(cls), args_blob,
-                        runtime_env, max_concurrency))
+                        runtime_env, max_concurrency, concurrency_groups))
         except (BrokenPipeError, OSError) as e:
             raise WorkerCrashedError("actor worker process died") from e
         fut.result()
 
     def submit_call(self, method_name: str, args_blob: bytes,
                     oid_bin: bytes | None, on_item=None, task_bin: bytes | None = None,
-                    backpressure: int = 0) -> _ActorCall:
-        """Non-blocking seq-tagged call; generator methods pass on_item."""
+                    backpressure: int = 0, group: str | None = None) -> _ActorCall:
+        """Non-blocking seq-tagged call; generator methods pass on_item;
+        `group` selects the worker-side concurrency-group pool."""
         call = _ActorCall(on_item=on_item)
         with self._mu:
             if self._dead:
@@ -838,9 +872,10 @@ class DedicatedActorWorker:
             call.worker = self
             call.seq = seq
         if on_item is not None:
-            frame = ("actor_gen", seq, method_name, args_blob, task_bin, backpressure)
+            frame = ("actor_gen", seq, method_name, args_blob, task_bin,
+                     backpressure, group)
         else:
-            frame = ("actor_call2", seq, method_name, args_blob, oid_bin)
+            frame = ("actor_call2", seq, method_name, args_blob, oid_bin, group)
         try:
             self._send(frame)
         except (BrokenPipeError, OSError) as e:
@@ -849,9 +884,11 @@ class DedicatedActorWorker:
             raise WorkerCrashedError("actor worker process died") from e
         return call
 
-    def call(self, method_name: str, args_blob: bytes, oid_bin: bytes | None):
+    def call(self, method_name: str, args_blob: bytes, oid_bin: bytes | None,
+             group: str | None = None):
         """Blocking form; raises the remote error / WorkerCrashedError."""
-        return self.submit_call(method_name, args_blob, oid_bin).future.result()
+        return self.submit_call(method_name, args_blob, oid_bin,
+                                group=group).future.result()
 
     def kill(self) -> None:
         try:

@@ -179,12 +179,11 @@ class _ActorState:
         self.mailbox: "queue.Queue[tuple[TaskSpec, ObjectID] | None]" = queue.Queue()
         self.mailboxes: dict[str, "queue.Queue"] = {"_default": self.mailbox}
         for _g in self.concurrency_groups:
-            # Process actors serialize every method on their dedicated worker
-            # (same degradation as max_concurrency>1): group names stay valid
-            # for routing but alias the one served mailbox.
-            self.mailboxes[_g] = (
-                self.mailbox if options.get("isolate_process") else queue.Queue()
-            )
+            # every group is an independent ordered mailbox — for process
+            # actors the worker mirrors the groups with per-group thread
+            # pools (process_pool.py actor_init), so a slow method in one
+            # group never blocks another group's methods there either
+            self.mailboxes[_g] = queue.Queue()
         # group name -> number of serving threads (poison-pill bookkeeping)
         self.group_thread_counts: dict[str, int] = {}
         self.threads: list[threading.Thread] = []
@@ -1643,11 +1642,11 @@ class Runtime:
         self._store_value(spec.return_ids()[0], None)  # creation done marker
         # max_concurrency calls overlap inside the worker for process actors
         # (asyncio loop or sync-method thread pool) — the head needs matching
-        # mailbox threads either way to keep that many in flight
+        # mailbox threads either way to keep that many in flight; named
+        # groups get their own mailbox threads for BOTH actor kinds
         groups = {"_default": max(1, state.max_concurrency)}
-        if state.proc_worker is None:
-            for gname, limit in state.concurrency_groups.items():
-                groups[gname] = max(1, int(limit))
+        for gname, limit in state.concurrency_groups.items():
+            groups[gname] = max(1, int(limit))
         state.group_thread_counts = groups
         for gname, concurrency in groups.items():
             for i in range(concurrency):
@@ -1680,7 +1679,8 @@ class Runtime:
             # max_concurrency > 1 (reference: concurrency_group_manager.cc)
             worker.init_actor(state.cls, self._marshal_args(spec),
                               runtime_env=spec.runtime_env,
-                              max_concurrency=state.max_concurrency)
+                              max_concurrency=state.max_concurrency,
+                              concurrency_groups=state.concurrency_groups or None)
         except BaseException:
             worker.kill()
             raise
@@ -1855,6 +1855,7 @@ class Runtime:
             on_item=lambda i, st, p, e, c=None: self._store_stream_item(spec, stream, i, st, p, e, c),
             task_bin=spec.task_id.binary(),
             backpressure=self.config.generator_backpressure_num_objects,
+            group=spec.concurrency_group,
         )
         stream.gen_handle = call
         try:
@@ -1891,7 +1892,9 @@ class Runtime:
             if entry:
                 entry.attempts += 1
             self._record_event(spec, "RETRYING")
-            state.mailbox.put((spec, rids[0]))
+            # replay into the task's OWN group mailbox — the default queue
+            # would occupy another group's serving thread for the rerun
+            state.mailbox_for(spec).put((spec, rids[0]))
             return True
 
         try:
@@ -1902,7 +1905,8 @@ class Runtime:
                 # the dedicated worker with consumed-count backpressure
                 self._run_proc_actor_generator(spec, proc_worker, args_blob)
             else:
-                res = proc_worker.call(spec.method_name, args_blob, oid_bin)
+                res = proc_worker.call(spec.method_name, args_blob, oid_bin,
+                                       group=spec.concurrency_group)
                 status, payload, size = res[0], res[1], res[2]
                 contained = res[3] if len(res) > 3 else None
                 self._store_worker_result(spec, rids, status, payload, size,

@@ -31,7 +31,9 @@ def write_offline_json(transitions: dict, path: str) -> int:
         for i in range(n):
             f.write(json.dumps({
                 "obs": np.asarray(transitions["obs"][i]).tolist(),
-                "action": int(transitions["actions"][i]),
+                # preserve numeric kind: continuous actions must not
+                # truncate (the loader mirrors this via action_dtype)
+                "action": (np.asarray(transitions["actions"][i]).tolist()),
                 "reward": float(transitions["rewards"][i]),
                 "next_obs": np.asarray(transitions["next_obs"][i]).tolist(),
                 "done": float(transitions["dones"][i]),

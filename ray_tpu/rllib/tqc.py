@@ -44,6 +44,11 @@ class TQCLearner:
 
         self.cfg = cfg
         M, K = cfg.num_critics, cfg.num_quantiles
+        if not 0 <= cfg.top_quantiles_to_drop_per_net < K:
+            raise ValueError(
+                f"top_quantiles_to_drop_per_net={cfg.top_quantiles_to_drop_per_net}"
+                f" must be in [0, num_quantiles={K}) — dropping every atom"
+                " leaves an empty target (NaN losses)")
         n_drop = cfg.top_quantiles_to_drop_per_net * M
         n_keep = M * K - n_drop
         key = jax.random.PRNGKey(cfg.seed)

@@ -125,7 +125,10 @@ def test_reserved_default_group_name_rejected():
         ray_tpu.remote(concurrency_groups={"_default": 2})(type("B", (), {})).remote()
 
 
-def test_proc_actor_groups_degrade_to_serial():
+def test_proc_actor_grouped_method_basic():
+    """Grouped methods on process actors route through their worker-side
+    pool and return correctly (full isolation semantics are asserted by
+    test_process_actor_concurrency_groups_isolate below)."""
     @ray_tpu.remote(isolate_process=True, concurrency_groups={"io": 2})
     class A:
         @ray_tpu.method(concurrency_group="io")
@@ -140,3 +143,83 @@ def test_proc_actor_groups_degrade_to_serial():
 def test_bad_group_limit_rejected_at_creation():
     with pytest.raises(ValueError, match="positive int"):
         ray_tpu.remote(concurrency_groups={"io": "two"})(type("C", (), {})).remote()
+
+
+def test_process_actor_concurrency_groups_isolate(ray_start_regular):
+    """Named groups on an isolate_process actor run on separate worker-side
+    thread pools: a slow 'io' method must not block a 'compute' method, and
+    each group's limit bounds its own overlap (reference:
+    concurrency_group_manager.cc per-group pools — previously process actors
+    aliased every group to one serial mailbox)."""
+    import threading
+    import time as _t
+
+    @ray_tpu.remote(isolate_process=True,
+                    concurrency_groups={"io": 2, "compute": 1})
+    class Split:
+        def __init__(self):
+            self.peak = {"io": 0, "compute": 0}
+            self.live = {"io": 0, "compute": 0}
+            self.mu = threading.Lock()
+
+        def _track(self, g, sec):
+            with self.mu:
+                self.live[g] += 1
+                self.peak[g] = max(self.peak[g], self.live[g])
+            _t.sleep(sec)
+            with self.mu:
+                self.live[g] -= 1
+            return g
+
+        @ray_tpu.method(concurrency_group="io")
+        def slow_io(self):
+            return self._track("io", 0.8)
+
+        @ray_tpu.method(concurrency_group="compute")
+        def quick(self):
+            return self._track("compute", 0.05)
+
+        def peaks(self):
+            return self.peak
+
+    a = Split.remote()
+    assert ray_tpu.get(a.peaks.remote(), timeout=60)  # exclude worker boot
+    t0 = _t.monotonic()
+    ios = [a.slow_io.remote() for _ in range(2)]
+    _t.sleep(0.1)  # io calls are running now
+    assert ray_tpu.get(a.quick.remote(), timeout=30) == "compute"
+    quick_latency = _t.monotonic() - t0
+    assert quick_latency < 0.7, f"compute blocked behind io: {quick_latency:.2f}s"
+    assert ray_tpu.get(ios, timeout=30) == ["io", "io"]
+    peaks = ray_tpu.get(a.peaks.remote(), timeout=30)
+    assert peaks["io"] == 2  # both io calls overlapped (limit 2 honored+used)
+
+
+def test_proc_actor_grouped_stream_does_not_block_other_group(ray_start_regular):
+    """A long-lived GROUPED streaming method runs on its group's pool, so
+    the executor keeps dispatching other groups (pre-fix: sync generators
+    held the worker's executor loop for their whole lifetime)."""
+    import time as _t
+
+    @ray_tpu.remote(isolate_process=True,
+                    concurrency_groups={"stream": 1, "ctl": 1})
+    class Feed:
+        @ray_tpu.method(concurrency_group="stream")
+        def ticks(self, n):
+            for i in range(n):
+                _t.sleep(0.15)
+                yield i
+
+        @ray_tpu.method(concurrency_group="ctl")
+        def ping(self):
+            return "pong"
+
+    a = Feed.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"  # warm boot
+    gen = a.ticks.options(num_returns="streaming").remote(10)
+    it = iter(gen)
+    assert ray_tpu.get(next(it), timeout=30) == 0  # stream is LIVE
+    t0 = _t.monotonic()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    assert _t.monotonic() - t0 < 1.0  # did not wait for the 1.5s stream
+    assert [ray_tpu.get(r) for r in it] == list(range(1, 10))
