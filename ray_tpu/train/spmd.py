@@ -120,6 +120,25 @@ def make_train_step(
     return compile_step
 
 
+def make_auto_train_step(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer=None,
+    attn_fn: Callable | None = None,
+    num_microbatches: int = 2,
+) -> Callable:
+    """Pick the right train step for the mesh's layout: the GPipe pipeline
+    step when a `pipe` axis > 1 is present (parallel/pipeline.py — the
+    reference delegates PP to its engines, vllm_models.py:251), the
+    single-program SPMD step otherwise. Both return compile_step(state)."""
+    if dict(mesh.shape).get("pipe", 1) > 1:
+        from ray_tpu.parallel.pipeline import make_pp_train_step
+
+        return make_pp_train_step(cfg, mesh, num_microbatches,
+                                  optimizer=optimizer, attn_fn=attn_fn)
+    return make_train_step(cfg, mesh, optimizer=optimizer, attn_fn=attn_fn)
+
+
 jax.tree_util.register_dataclass(
     TrainState, data_fields=["params", "opt_state", "step"], meta_fields=[]
 )
