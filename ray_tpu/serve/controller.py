@@ -493,17 +493,26 @@ class Router:
             refs = [ref for _, ref in outstanding]
             try:
                 ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.2)
+                if ready:
+                    # sweep EVERYTHING that's done this tick: retiring one
+                    # completion per iteration lets bursts of fast calls
+                    # accumulate stale in-flight counts
+                    ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                            timeout=0)
             except Exception:
                 continue
             done_set = set(ready)
             still = []
             for key, ref in outstanding:
                 if ref in done_set:
-                    with self._lock:
-                        self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+                    self._retire_inflight(key)
                 else:
                     still.append((key, ref))
             outstanding = still
+
+    def _retire_inflight(self, key: str) -> None:
+        with self._lock:
+            self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
 
     @staticmethod
     def _rkey(replica) -> str:
@@ -581,8 +590,7 @@ class Router:
         def done_cb():
             if not done["d"]:
                 done["d"] = True
-                with self._lock:
-                    self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+                self._retire_inflight(key)
 
         return gen, done_cb
 
@@ -597,11 +605,22 @@ class Router:
             with self._lock:
                 self._inflight[key] = self._inflight.get(key, 0) + 1
             ref = replica.handle_request.remote(method_name, args, kwargs)
-            self._completions.put((key, ref))
             self._maybe_report()
             last_ref = ref
-            ready, _ = ray_tpu.wait([ref], timeout=0)
+            try:
+                ready, _ = ray_tpu.wait([ref], timeout=0)
+            except Exception:
+                # probe failure must not leak the in-flight count: hand the
+                # ref to the watcher, which owns retirement from here
+                self._completions.put((key, ref))
+                raise
             if ready:
+                # ALREADY done at submit time (sub-ms actor calls): retire the
+                # in-flight count inline instead of queueing for the watcher —
+                # a burst of fast sequential calls could otherwise pile up
+                # watcher-lagged counts and trip the KV router's imbalance
+                # rebalance though the replica is actually idle.
+                self._retire_inflight(key)
                 try:
                     ray_tpu.get(ref)
                 except ray_tpu.exceptions.ActorDiedError:
@@ -612,6 +631,9 @@ class Router:
                     continue
                 except Exception:
                     pass  # app error: surfaces at the caller's get
+                return ref
+            # still running: the watcher owns the decrement on completion
+            self._completions.put((key, ref))
             return ref
         return last_ref
 

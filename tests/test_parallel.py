@@ -25,7 +25,8 @@ def test_mesh_spec_resolve():
 
 def test_mesh_build_axes():
     mesh = cpu_mesh(data=2, fsdp=2, tensor=2)
-    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "seq": 1, "expert": 1}
+    assert mesh.shape == {"data": 2, "pipe": 1, "fsdp": 2, "tensor": 2,
+                          "seq": 1, "expert": 1}
 
 
 def test_multislice_env_complete():
