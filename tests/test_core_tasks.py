@@ -85,9 +85,12 @@ def test_wait_semantics(ray_start_regular):
         time.sleep(t)
         return t
 
+    # generous margins: wait() returns the moment `fast` completes (~0.1s
+    # normally), but a loaded 1-core box can delay worker boot by seconds —
+    # only the ORDERING is under test, so the window must dwarf the load
     fast = sleepy.remote(0.05)
-    slow = sleepy.remote(5.0)
-    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=2)
+    slow = sleepy.remote(60.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=20)
     assert ready == [fast] and not_ready == [slow]
     ray_tpu.cancel(slow, force=True)
 
