@@ -35,6 +35,7 @@ _FAST_MODULES = {
     "test_pubsub",
     "test_misc_parity",
     "test_round4_fixes",
+    "test_rpdb",
     "test_util",
 }
 
