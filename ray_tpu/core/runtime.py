@@ -378,7 +378,9 @@ class Runtime:
         # boundary (ray_config_def.h:245).
         if self.shm_store is not None and size > self.config.max_inline_object_size:
             try:
-                total, parts = serialization.serialize_parts(value)
+                from ray_tpu.core.object_ref import collect_serialized_refs
+                with collect_serialized_refs() as contained:
+                    total, parts = serialization.serialize_parts(value)
                 try:
                     self.shm_store.put_parts(oid, total, parts)
                 except Exception:
@@ -394,6 +396,13 @@ class Runtime:
                 self.shm_store.pin(oid)
                 if self.spill is not None:
                     self.spill.on_put(oid, total)
+                if contained:
+                    # Refs pickled inside the shm blob must outlive the blob:
+                    # a later get() rehydrates them, so hold them as nested
+                    # until the outer oid's count zeroes (mirrors the client
+                    # put path, cluster.py _h_client_put_seal).
+                    self.reference_counter.add_nested_refs(
+                        oid, [ObjectID(b) for b in contained])
                 self.memory_store.put(oid, RayObject(size=total, in_shm=True))
                 return
             except Exception as e:  # store full and unevictable -> inline fallback

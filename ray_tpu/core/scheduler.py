@@ -165,7 +165,11 @@ class ClusterScheduler:
             n = self._nodes.get(node_id)
             if n is None:
                 return True
-            return all(n.available.get(k, 0.0) == v for k, v in n.total.items())
+            # Epsilon comparison: fractional resources (num_cpus=0.1 cycles)
+            # accumulate float error; exact equality could wedge a DRAINING
+            # node as never-idle.
+            return all(abs(n.available.get(k, 0.0) - v) < EPS
+                       for k, v in n.total.items())
 
     def nodes(self) -> list[NodeState]:
         with self._lock:

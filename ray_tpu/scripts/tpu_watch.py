@@ -24,8 +24,8 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-JSONL = os.path.join(REPO, "SWEEP_r04.jsonl")
-MD = os.path.join(REPO, "SWEEP_r04.md")
+JSONL = os.path.join(REPO, "SWEEP_r05.jsonl")
+MD = os.path.join(REPO, "SWEEP_r05.md")
 CACHE = os.path.join(REPO, "BENCH_CACHE.json")
 
 # (plan key, tpu_sweep config letter, extra env). Priority order: most likely

@@ -318,7 +318,7 @@ class _ProxyActor:
     runtime, so the data plane no longer funnels through the head's single
     aiohttp loop."""
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  refresh_s: float = 1.0):
         import ray_tpu as _ray
 
@@ -373,13 +373,29 @@ class _ProxyActor:
         self._proxy.stop()
 
 
-def start_proxies(count: int = 2, base_port: int = 8100) -> list[tuple]:
+def start_proxies(count: int = 2, base_port: int = 8100,
+                  host: str = "127.0.0.1") -> list[tuple]:
     """Start `count` SPREAD-placed proxy actors (one per node when nodes are
     available) and return their (host, port) addresses. The reference runs
     exactly this shape: a proxy actor per node behind any load balancer.
-    Safe to call again (names are unique per call); a failed boot is killed
-    rather than leaked."""
+    Binds loopback by default (reference HTTP ingress default); pass
+    host="0.0.0.0" to expose the data plane. Safe to call again (names are
+    unique per call); a failed boot is killed rather than leaked."""
     import uuid as _uuid
+
+    if host in ("127.0.0.1", "localhost"):
+        try:
+            n_nodes = len(ray_tpu.nodes())
+        except Exception:
+            n_nodes = 1
+        if n_nodes > 1:
+            import warnings
+
+            warnings.warn(
+                "start_proxies(host='127.0.0.1') on a multi-node cluster: "
+                "proxies placed on other nodes will only accept loopback "
+                "traffic there; pass host='0.0.0.0' to serve cross-node "
+                "ingress", stacklevel=2)
 
     addrs = []
     for i in range(count):
@@ -387,7 +403,7 @@ def start_proxies(count: int = 2, base_port: int = 8100) -> list[tuple]:
             isolate_process=True, num_cpus=0.5,
             scheduling_strategy="SPREAD",
             name=f"SERVE_PROXY:{_uuid.uuid4().hex[:6]}:{i}",
-        )(_ProxyActor).remote(port=base_port + i)
+        )(_ProxyActor).remote(port=base_port + i, host=host)
         with _lock:
             # registered BEFORE the readiness wait: a concurrent
             # stop_proxies/shutdown can always find (and kill) it

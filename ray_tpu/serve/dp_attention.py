@@ -71,12 +71,7 @@ class _DPRank:
         fut = self._futs.pop(rid, None)
         if fut is None:
             return False
-        eng = self.engine
-        with eng._lock:
-            for i, st in enumerate(eng.slots):
-                if st is not None and st.future is fut:
-                    eng._release_slot(i)
-                    break
+        self.engine.cancel_future(fut)
         if not fut.done():
             fut.set_exception(TimeoutError("request cancelled by client timeout"))
         return True

@@ -230,6 +230,18 @@ class LLMEngine:
         self.active[i] = False
         self.slots[i] = None
 
+    def cancel_future(self, fut) -> bool:
+        """Cancel the in-flight request whose slot holds `fut`: release the
+        slot (and its KV blocks, in the paged engine) under the engine lock.
+        Public so callers (DP ranks, routers) never touch slot internals.
+        Returns False if the future holds no slot (finished or still queued)."""
+        with self._lock:
+            for i, st in enumerate(self.slots):
+                if st is not None and st.future is fut:
+                    self._release_slot(i)
+                    return True
+        return False
+
     def _fail_all_active(self, exc: Exception) -> None:
         with self._lock:
             for i in range(self.config.max_batch_size):

@@ -124,32 +124,53 @@ def _advertise_host() -> str:
     return "127.0.0.1"
 
 
+def external_attach_enabled() -> bool:
+    """Cross-node attach is an explicit opt-in (RAY_TPU_DEBUGGER_EXTERNAL=1).
+    Default is loopback-only, matching the reference's localhost default."""
+    return os.environ.get("RAY_TPU_DEBUGGER_EXTERNAL") == "1"
+
+
 def set_trace(frame=None, *, reason: str = "breakpoint", exc_info=None) -> None:
     """Open a listener, announce the session, BLOCK until a client attaches,
-    then hand this thread to pdb. The task resumes on `continue`."""
+    then hand this thread to pdb. The task resumes on `continue`.
+
+    The listener binds 127.0.0.1 unless RAY_TPU_DEBUGGER_EXTERNAL=1; either
+    way the first line an attacher sends must be the per-session token (the
+    token travels to attachers over the authenticated control plane, so a
+    network peer who can merely reach the port cannot drive pdb)."""
+    external = external_attach_enabled()
     listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    # bind all interfaces, advertise a cross-node-reachable address — a
-    # loopback advertisement would send remote attachers to THEIR own host
-    listener.bind(("0.0.0.0", 0))
+    listener.bind(("0.0.0.0" if external else "127.0.0.1", 0))
     listener.listen(1)
     port = listener.getsockname()[1]
-    host = _advertise_host()
+    host = _advertise_host() if external else "127.0.0.1"
+    token = uuid.uuid4().hex
     session = {
         "id": uuid.uuid4().hex[:12],
         "pid": os.getpid(),
         "host": host,
         "port": port,
         "reason": reason,
+        "token": token,
         "thread": threading.current_thread().name,
     }
     _register(session)
     sys.stderr.write(
         f"ray_tpu rpdb: waiting for attach at {host}:{port} "
-        f"(`ray_tpu debug` or `nc {host} {port}`)\n")
+        f"(`ray_tpu debug`)\n")
     sys.stderr.flush()
+    conn = None
     try:
-        conn, _ = listener.accept()
+        while conn is None:
+            cand, _ = listener.accept()
+            if _check_token(cand, token):
+                conn = cand
+            else:
+                try:
+                    cand.close()
+                except OSError:
+                    pass
     finally:
         listener.close()
         _unregister(session["id"])
@@ -159,6 +180,29 @@ def set_trace(frame=None, *, reason: str = "breakpoint", exc_info=None) -> None:
         dbg.interaction(None, exc_info[2])
     else:
         dbg.set_trace(frame or sys._getframe().f_back)
+
+
+def _check_token(conn: socket.socket, token: str) -> bool:
+    """Read exactly up to the first newline (the attach token) with a short
+    deadline; reject mismatches so unauthenticated peers never reach the
+    debugger. Byte-at-a-time so pipelined pdb input behind the token
+    (`printf 'TOKEN\\nc\\n' | nc ...`) stays in the socket for pdb."""
+    conn.settimeout(10.0)
+    try:
+        buf = b""
+        while len(buf) < 256:
+            ch = conn.recv(1)
+            if not ch:
+                return False
+            if ch == b"\n":
+                break
+            buf += ch
+        ok = buf.decode(errors="replace").strip() == token
+        if ok:
+            conn.settimeout(None)
+        return ok
+    except (OSError, UnicodeDecodeError):
+        return False
 
 
 def post_mortem_enabled() -> bool:
@@ -192,7 +236,21 @@ def attach(session: dict, stdin=None, stdout=None) -> None:
     until the debugger disconnects (the CLI's `ray_tpu debug` body)."""
     stdin = stdin or sys.stdin
     stdout = stdout or sys.stdout
-    conn = socket.create_connection((session["host"], session["port"]), timeout=10)
+    try:
+        conn = socket.create_connection((session["host"], session["port"]),
+                                        timeout=10)
+    except OSError as e:
+        if session["host"] in ("127.0.0.1", "localhost"):
+            raise ConnectionError(
+                f"debug session {session['id']} advertises a loopback address "
+                f"({session['host']}:{session['port']}); if the breakpoint is "
+                "on another node, restart the worker with "
+                "RAY_TPU_DEBUGGER_EXTERNAL=1 to allow cross-node attach"
+            ) from e
+        raise
+    tok = session.get("token")
+    if tok:
+        conn.sendall(tok.encode() + b"\n")
     conn.settimeout(0.2)
     stop = threading.Event()
 

@@ -8,8 +8,10 @@ import ray_tpu
 from ray_tpu.util import rpdb
 
 
-def _drive_pdb(host, port, commands, out: list):
+def _drive_pdb(host, port, commands, out: list, token=None):
     conn = socket.create_connection((host, port), timeout=15)
+    if token:
+        conn.sendall(token.encode() + b"\n")
     f = conn.makefile("rw", buffering=1, errors="replace")
     for cmd in commands:
         # read until a prompt, then issue the next command
@@ -47,7 +49,7 @@ def test_breakpoint_in_task_attach_inspect_continue(ray_start_regular):
 
     out: list = []
     t = threading.Thread(target=_drive_pdb,
-                         args=(s["host"], s["port"], ["p secret", "c"], out),
+                         args=(s["host"], s["port"], ["p secret", "c"], out, s.get("token")),
                          daemon=True)
     t.start()
     assert ray_tpu.get(ref, timeout=30) == 42  # task resumed by `c`
@@ -78,7 +80,8 @@ def test_post_mortem_on_failure(ray_start_regular, monkeypatch):
         time.sleep(0.05)
     assert sessions and "post-mortem" in sessions[0]["reason"]
     out: list = []
-    _drive_pdb(sessions[0]["host"], sessions[0]["port"], ["p denom", "c"], out)
+    _drive_pdb(sessions[0]["host"], sessions[0]["port"], ["p denom", "c"], out,
+               sessions[0].get("token"))
     with pytest.raises(Exception, match="division"):
         ray_tpu.get(ref, timeout=30)
     assert "0" in "".join(out)
