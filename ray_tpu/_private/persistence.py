@@ -1,17 +1,31 @@
-"""Control-plane persistence: session metadata that survives driver restarts.
+"""Control-plane persistence: head state that survives head crashes.
 
 Parity: the reference's GCS fault tolerance — metadata tables checkpointed to
-an external Redis (gcs/store_client/redis_store_client.h, gcs_table_storage.cc)
-so a restarted head recovers jobs/actors/KV; the serve controller additionally
-checkpoints its app state into the internal KV and reloads it on restart
-(serve/_private/controller.py:124-133, storage/kv_store.py:24).
+an external Redis (gcs/store_client/redis_store_client.h,
+gcs_table_storage.cc:200) so a restarted GCS recovers jobs/actors/PGs/KV and
+raylets/clients reconnect (gcs_rpc_client/rpc_client.h:622). The serve
+controller additionally checkpoints its app state into the internal KV and
+reloads it on restart (serve/_private/controller.py:124-133).
 
-Here the backing store is a pickle file under a user-chosen directory
-(`_system_config={"gcs_storage_path": ...}`): every internal-KV mutation and
-detached-actor registration writes through; `ray_tpu.init()` with the same
-storage path restores the KV and re-creates named detached actors from their
-recorded creation specs (the serve controller then self-heals its apps from
-its KV checkpoint).
+Here the backing store is an APPEND LOG under a user-chosen directory
+(`_system_config={"gcs_storage_path": ...}` or RAY_TPU_GCS_STORAGE_PATH):
+every control-plane mutation appends one pickled record; load replays the
+log over the last snapshot and compacts. Tables:
+
+- ``kv``              internal KV (serve checkpoints live here)
+- ``detached_actors`` named detached actor creation specs
+- ``session``         control-plane identity: auth token (so agents/clients
+                      reconnect to a restarted head without re-keying)
+- ``pgs``             placement-group specs (restored PENDING; they re-place
+                      as agents re-register)
+- ``jobs``            job-submission metadata snapshots
+- ``plane``           object-plane locations {oid: {node_bin: size}} — lets a
+                      restarted head serve pre-crash ObjectRefs by
+                      chunk-pulling from surviving node stores
+
+A head crash (kill -9) mid-append leaves at most one truncated record; replay
+stops at the first bad frame (write-ahead semantics: the acknowledged state
+is always recovered).
 """
 
 from __future__ import annotations
@@ -24,29 +38,105 @@ from typing import Any, Optional
 
 logger = logging.getLogger("ray_tpu")
 
+_TABLES = ("kv", "detached_actors", "session", "pgs", "jobs", "plane")
+
 
 class GcsStore:
-    """Durable map of {kv: {(ns, key): val}, detached_actors: {key: spec}}."""
+    """Durable control-plane tables over snapshot + append log."""
 
     def __init__(self, path: str):
         self.dir = path
-        self.file = os.path.join(path, "gcs_store.pkl")
+        self.snap_file = os.path.join(path, "gcs_store.pkl")
+        self.log_file = os.path.join(path, "gcs_log.pkl")
         self._lock = threading.Lock()
-        self._data: dict[str, dict] = {"kv": {}, "detached_actors": {}}
+        self._data: dict[str, dict] = {t: {} for t in _TABLES}
         os.makedirs(path, exist_ok=True)
-        if os.path.exists(self.file):
-            try:
-                with open(self.file, "rb") as f:
-                    self._data = pickle.load(f)
-            except Exception as e:
-                logger.warning("gcs store at %s unreadable (%s); starting fresh",
-                               self.file, e)
+        self._load()
+        # Compact: fold the replayed log into a fresh snapshot, then start a
+        # new log (bounds replay time across repeated restarts).
+        self._write_snapshot()
+        self._log_fh = open(self.log_file, "wb")
 
-    def _flush(self) -> None:
-        tmp = self.file + ".tmp"
+    # ------------------------------------------------------------ load/save
+    def _load(self) -> None:
+        if os.path.exists(self.snap_file):
+            try:
+                with open(self.snap_file, "rb") as f:
+                    snap = pickle.load(f)
+                for t in _TABLES:
+                    self._data[t] = snap.get(t, {})
+            except Exception as e:
+                logger.warning("gcs snapshot at %s unreadable (%s); starting fresh",
+                               self.snap_file, e)
+        if os.path.exists(self.log_file):
+            try:
+                with open(self.log_file, "rb") as f:
+                    while True:
+                        try:
+                            table, op, key, val = pickle.load(f)
+                        except EOFError:
+                            break
+                        except Exception:
+                            # torn tail record from a crash mid-append
+                            logger.info("gcs log: stopping replay at torn record")
+                            break
+                        self._apply(table, op, key, val)
+            except OSError as e:
+                logger.warning("gcs log at %s unreadable: %s", self.log_file, e)
+
+    def _apply(self, table: str, op: str, key, val) -> None:
+        tab = self._data.setdefault(table, {})
+        if op == "put":
+            tab[key] = val
+        elif op == "del":
+            tab.pop(key, None)
+        elif op == "plane_add":  # plane table: key=oid_bin, val=(node_bin, size)
+            node_bin, size = val
+            tab.setdefault(key, {})[node_bin] = size
+        elif op == "plane_del":
+            holders = tab.get(key)
+            if holders is not None:
+                holders.pop(val, None)
+                if not holders:
+                    tab.pop(key, None)
+
+    def _write_snapshot(self) -> None:
+        tmp = self.snap_file + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(self._data, f)
-        os.replace(tmp, self.file)  # atomic: a crash never corrupts the store
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_file)  # atomic: a crash never corrupts it
+        # log folded into the snapshot -> truncate
+        with open(self.log_file, "wb"):
+            pass
+
+    # Compact when the log outgrows this (bounds replay time and disk for
+    # long-lived heads with churny writers, e.g. per-task plane records).
+    _COMPACT_BYTES = 64 * 1024 * 1024
+
+    def _append(self, table: str, op: str, key, val=None) -> None:
+        """Apply + durably log one mutation (write-through, like the
+        reference's per-mutation Redis writes). Periodically folds the log
+        into the snapshot in-session."""
+        with self._lock:
+            self._apply(table, op, key, val)
+            try:
+                pickle.dump((table, op, key, val), self._log_fh)
+                self._log_fh.flush()
+                if self._log_fh.tell() >= self._COMPACT_BYTES:
+                    self._log_fh.close()
+                    self._write_snapshot()  # truncates the log file
+                    self._log_fh = open(self.log_file, "wb")
+            except (OSError, ValueError) as e:
+                logger.warning("gcs log append failed: %s", e)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._log_fh.close()
+            except OSError:
+                pass
 
     # ---- internal KV write-through ----
     def kv_snapshot(self) -> dict:
@@ -54,15 +144,19 @@ class GcsStore:
             return dict(self._data["kv"])
 
     def kv_put(self, fk: tuple, value: bytes) -> None:
-        with self._lock:
-            self._data["kv"][fk] = value
-            self._flush()
+        self._append("kv", "put", fk, value)
 
     def kv_del(self, fks: list) -> None:
+        for fk in fks:
+            self._append("kv", "del", fk)
+
+    # ---- session identity ----
+    def session_meta(self) -> dict:
         with self._lock:
-            for fk in fks:
-                self._data["kv"].pop(fk, None)
-            self._flush()
+            return dict(self._data["session"])
+
+    def set_session_meta(self, key: str, value: Any) -> None:
+        self._append("session", "put", key, value)
 
     # ---- detached actors ----
     def record_detached_actor(self, namespace: str, name: str, cls, args, kwargs,
@@ -84,18 +178,45 @@ class GcsStore:
         except Exception as e:
             logger.warning("detached actor %s/%s not persistable: %s", namespace, name, e)
             return
-        with self._lock:
-            self._data["detached_actors"][(namespace, name)] = blob
-            self._flush()
+        self._append("detached_actors", "put", (namespace, name), blob)
 
     def remove_detached_actor(self, namespace: str, name: str) -> None:
-        with self._lock:
-            if self._data["detached_actors"].pop((namespace, name), None) is not None:
-                self._flush()
+        self._append("detached_actors", "del", (namespace, name))
 
     def detached_actors(self) -> dict:
         with self._lock:
             return dict(self._data["detached_actors"])
+
+    # ---- placement groups ----
+    def record_pg(self, pg_id_bin: bytes, spec: dict) -> None:
+        """spec: {bundles: [dict], strategy, name, slice_name}."""
+        self._append("pgs", "put", pg_id_bin, spec)
+
+    def remove_pg(self, pg_id_bin: bytes) -> None:
+        self._append("pgs", "del", pg_id_bin)
+
+    def pgs(self) -> dict:
+        with self._lock:
+            return dict(self._data["pgs"])
+
+    # ---- jobs ----
+    def record_job(self, job_id: str, info: dict) -> None:
+        self._append("jobs", "put", job_id, info)
+
+    def jobs(self) -> dict:
+        with self._lock:
+            return dict(self._data["jobs"])
+
+    # ---- object-plane locations ----
+    def plane_add(self, oid_bin: bytes, node_bin: bytes, size: int) -> None:
+        self._append("plane", "plane_add", oid_bin, (node_bin, size))
+
+    def plane_remove(self, oid_bin: bytes, node_bin: bytes) -> None:
+        self._append("plane", "plane_del", oid_bin, node_bin)
+
+    def plane_snapshot(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._data["plane"].items()}
 
 
 _store: Optional[GcsStore] = None
@@ -107,14 +228,19 @@ def get_store() -> Optional[GcsStore]:
 
 def set_store(store: Optional[GcsStore]) -> None:
     global _store
+    if _store is not None and store is not _store:
+        _store.close()
     _store = store
 
 
 def restore_session(runtime) -> int:
-    """Recreate named detached actors from the durable store (reference: GCS
-    restart reconstructing actor metadata; here the actors re-run __init__,
-    and checkpoint-aware actors like the serve controller self-heal from the
-    restored internal KV). Returns the number restored."""
+    """Rebuild a restarted head's control-plane state from the durable store
+    (reference: GCS restart reconstructing its tables from Redis). Restores,
+    in dependency order: internal KV, object-plane locations (pre-crash refs
+    become chunk-pullable again once their node agents re-register), PGs
+    (PENDING; they place as agents register), then named detached actors
+    (whose __init__ may read KV checkpoints — e.g. the serve controller
+    self-heals its apps). Returns the number of detached actors restored."""
     import cloudpickle
 
     store = get_store()
@@ -124,6 +250,29 @@ def restore_session(runtime) -> int:
     from ray_tpu.experimental import internal_kv
 
     internal_kv._load_snapshot(store.kv_snapshot())
+
+    # Object-plane locations: seed markers so get() on pre-crash refs pulls
+    # from surviving node stores instead of raising ObjectLostError.
+    from ray_tpu._private.ids import NodeID, ObjectID
+    from ray_tpu.core.object_store import RayObject
+
+    for oid_bin, holders in store.plane_snapshot().items():
+        oid = ObjectID(oid_bin)
+        size = 0
+        for node_bin, sz in holders.items():
+            runtime.plane_object_added(oid, NodeID(node_bin), size=sz,
+                                       _persist=False)
+            size = max(size, sz)
+        if not runtime.memory_store.contains(oid):
+            runtime.memory_store.put(oid, RayObject(size=size, in_shm=True))
+
+    # Placement groups: same ids, PENDING until nodes re-register.
+    for pg_id_bin, spec in store.pgs().items():
+        try:
+            runtime.scheduler.restore_placement_group(pg_id_bin, spec)
+        except Exception as e:
+            logger.warning("failed to restore PG %s: %s", pg_id_bin.hex()[:12], e)
+
     restored = 0
     for (namespace, name), blob in store.detached_actors().items():
         try:

@@ -102,15 +102,19 @@ def init(
 
             res["CPU"] = float(os.environ.get("RAY_TPU_NUM_CPUS", max(os.cpu_count() or 1, 8)))
         node_labels = [dict(labels or {}) for _ in range(num_nodes)]
-        rt = Runtime(cfg, num_nodes=num_nodes, resources_per_node=res, node_labels=node_labels)
-        rt_mod.set_runtime(rt)
         if cfg.gcs_storage_path:
-            # Durable control plane: restore internal KV + named detached
-            # actors recorded by a previous session at this storage path
-            # (reference: GCS restart with Redis persistence).
+            # Open the durable store BEFORE the runtime: the control plane
+            # reuses the persisted auth token so agents/clients of a crashed
+            # head can reconnect to its replacement (reference: GCS restart
+            # with Redis persistence, gcs_rpc_client auto-reconnect).
             from ray_tpu._private import persistence
 
             persistence.set_store(persistence.GcsStore(cfg.gcs_storage_path))
+        rt = Runtime(cfg, num_nodes=num_nodes, resources_per_node=res, node_labels=node_labels)
+        rt_mod.set_runtime(rt)
+        if cfg.gcs_storage_path:
+            from ray_tpu._private import persistence
+
             restored = persistence.restore_session(rt)
             if restored:
                 import logging

@@ -78,6 +78,15 @@ class _ClientRefCounter:
             if n == 0:
                 self._client._notify_ref("ref_drop", oid)
 
+    def held_oids(self) -> list[bytes]:
+        """Binary ids of every object this process still references — re-sent
+        with hello so a RESTARTED head re-establishes its per-client borrows
+        (reference: workers re-publishing their borrows after GCS restart;
+        without this the first touch of a restored object zero-fires and
+        frees it under the client)."""
+        with self._lock:
+            return [oid.binary() for oid in self._counts]
+
     # lineage/submitted-task refs are head-side concerns; no-ops here
     def add_submitted_task_refs(self, oids) -> None:
         pass
@@ -119,8 +128,16 @@ class ClientRuntime:
     def _notify_ref(self, op: str, oid: ObjectID) -> None:
         if self.is_shutdown:
             return
+        # Runs UNDER the refcounter lock — must not take the client lock
+        # (hello snapshots held refs under the client lock: taking them in
+        # the opposite order here would deadlock). Uses the live peer if one
+        # exists; otherwise best-effort skip (the next hello re-reports the
+        # full held set anyway).
+        peer = self._peer
+        if peer is None or peer.closed:
+            return
         try:
-            self._rpc().notify(op, oid=oid.binary())
+            peer.notify(op, oid=oid.binary())
         except Exception:
             pass  # best effort; the head also drops borrows on disconnect
 
@@ -138,20 +155,63 @@ class ClientRuntime:
         return self._rpc().call("debug_list", timeout=10)
 
     # ------------------------------------------------------------ transport
-    def _rpc(self):
-        with self._lock:
-            if self._peer is None or self._peer.closed:
-                from ray_tpu.core import wire
+    def _rpc(self, retry_connect: bool = True):
+        """Connected peer, reconnecting lazily. With ``retry_connect`` a head
+        that is briefly unreachable — e.g. crashed and restarting on the same
+        address with its durable store — is retried for up to
+        RAY_TPU_HEAD_RECONNECT_S (reference: the GCS client's auto-reconnect,
+        gcs_rpc_client/rpc_client.h:622)."""
+        import time
 
-                self._peer = wire.connect(
-                    self._host, self._port,
-                    handlers={"pubsub_msg": self._h_pubsub_msg},
-                    name=f"worker-{os.getpid()}",
-                )
-                self._peer.call("hello", token=self._token, kind="worker",
-                                pid=os.getpid(), node=self._node_bin,
-                                plane=self._plane_mode, timeout=10)
+        from ray_tpu.core import wire
+
+        deadline = None
+        with self._lock:
+            while self._peer is None or self._peer.closed:
+                try:
+                    peer = wire.connect(
+                        self._host, self._port,
+                        handlers={"pubsub_msg": self._h_pubsub_msg},
+                        name=f"worker-{os.getpid()}",
+                    )
+                    try:
+                        peer.call("hello", token=self._token, kind="worker",
+                                  pid=os.getpid(), node=self._node_bin,
+                                  plane=self._plane_mode,
+                                  held=self.reference_counter.held_oids(),
+                                  timeout=10)
+                    except BaseException:
+                        peer.close()  # don't leak the socket + reader thread
+                        raise
+                    self._peer = peer
+                    break
+                except (OSError, ConnectionError) as e:
+                    if not retry_connect or self.is_shutdown:
+                        raise
+                    if deadline is None:
+                        deadline = time.monotonic() + float(
+                            os.environ.get("RAY_TPU_HEAD_RECONNECT_S", "30"))
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.3)
             return self._peer
+
+    def _call_retrying(self, op: str, timeout=None, **payload):
+        """Call an IDEMPOTENT op, retrying through head restarts: a mid-call
+        disconnect re-issues the request against the reconnected head."""
+        import time
+
+        from ray_tpu.core.wire import PeerDisconnected
+
+        deadline = time.monotonic() + float(
+            os.environ.get("RAY_TPU_HEAD_RECONNECT_S", "30"))
+        while True:
+            try:
+                return self._rpc().call(op, timeout=timeout, **payload)
+            except (PeerDisconnected, ConnectionError, OSError):
+                if self.is_shutdown or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.3)
 
     # ------------------------------------------------------------ pub/sub
     def _h_pubsub_msg(self, peer, msg):
@@ -198,7 +258,7 @@ class ClientRuntime:
         from one, and seed the local store with a secondary (unpinned) copy
         (reference: PullManager pull into local plasma, pull_manager.h:52)."""
         try:
-            pairs = self._rpc().call("locate_object", oid=oid.binary(), timeout=30)
+            pairs = self._call_retrying("locate_object", oid=oid.binary(), timeout=30)
         except Exception:
             return None
         if not pairs:
@@ -267,7 +327,7 @@ class ClientRuntime:
         return ObjectRef(ObjectID(oid_bin), self)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
-        entries = self._rpc().call(
+        entries = self._call_retrying(
             "client_get",
             oids=[r.object_id().binary() for r in refs],
             get_timeout=timeout,
@@ -305,7 +365,7 @@ class ClientRuntime:
         return out
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
-        ready_bins, not_ready_bins = self._rpc().call(
+        ready_bins, not_ready_bins = self._call_retrying(
             "client_wait",
             oids=[r.object_id().binary() for r in refs],
             num_returns=num_returns, wait_timeout=timeout, fetch_local=fetch_local,
@@ -373,8 +433,8 @@ class ClientRuntime:
         return [ObjectRef(ObjectID(b), self) for b in ref_bins]
 
     def get_actor(self, name: str, namespace: str = "default") -> ActorID:
-        return ActorID(self._rpc().call("client_get_actor", name=name,
-                                        namespace=namespace, timeout=30))
+        return ActorID(self._call_retrying("client_get_actor", name=name,
+                                           namespace=namespace, timeout=30))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._rpc().call("client_kill_actor", actor=actor_id.binary(),

@@ -46,7 +46,17 @@ logger = logging.getLogger("ray_tpu")
 class ControlPlane:
     def __init__(self, runtime: "Runtime"):
         self.runtime = runtime
-        self.token = secrets.token_hex(16)
+        # Durable sessions keep one token across head restarts so surviving
+        # agents/clients re-authenticate against the replacement head
+        # (reference: GCS clients reconnect with unchanged credentials,
+        # gcs_rpc_client/rpc_client.h:622).
+        from ray_tpu._private import persistence
+
+        store = persistence.get_store()
+        persisted = store.session_meta().get("token") if store is not None else None
+        self.token = persisted or secrets.token_hex(16)
+        if store is not None and persisted is None:
+            store.set_session_meta("token", self.token)
         cfg = runtime.config
         self._hb: dict[NodeID, float] = {}
         self._hb_lock = threading.Lock()
@@ -205,15 +215,36 @@ class ControlPlane:
         if msg.get("node"):
             peer.meta["worker_node"] = NodeID(msg["node"])
         peer.meta["plane"] = msg.get("plane", "shared")
+        # Borrows the client still holds (re-sent on every hello): a client
+        # reconnecting to a RESTARTED head re-establishes its per-client
+        # refs so restored objects don't zero-fire on first touch.
+        for b in msg.get("held") or ():
+            self._hold_for(peer, [ObjectRef(ObjectID(b), self.runtime)])
         return {"ok": True}
 
     def _h_register_node(self, peer: RpcPeer, msg: dict):
         rt = self.runtime
+        # Agents present a stable node id (generated once per agent process)
+        # so re-registration — with THIS head after a transient drop, or with
+        # a REPLACEMENT head after a crash — preserves identity and keeps
+        # persisted object-plane locations valid (reference: raylet node ids
+        # surviving GCS restart, gcs_node_manager.cc re-registration).
+        nid = NodeID(msg["node_id"]) if msg.get("node_id") else None
+        if nid is not None and nid in rt._agents:
+            stale = rt._agents.get(nid)
+            if stale is not None and stale is not peer:
+                stale.meta.pop("node_id", None)  # don't double-fire node death
+                stale.close()
+            try:
+                rt.scheduler.remove_node(nid)
+            except Exception:
+                pass
         nid = rt.scheduler.add_node(
             msg["resources"],
             labels=msg.get("labels"),
             slice_name=msg.get("slice_name"),
             ici_coords=msg.get("ici_coords"),
+            node_id=nid,
         )
         peer.meta["node_id"] = nid
         peer.meta["pid"] = msg.get("pid")
@@ -222,6 +253,15 @@ class ControlPlane:
             # isolated-object-plane node: its store is served at this endpoint
             with rt._lock:
                 rt._plane_addrs[nid] = msg["plane_addr"]
+        # Re-announced plane objects (agent survived a head crash): restore
+        # directory entries + get()-able markers for the primaries it pins.
+        for oid_bin, size in msg.get("plane_objects") or ():
+            oid = ObjectID(oid_bin)
+            rt.plane_object_added(oid, nid, size=size)
+            if not rt.memory_store.contains(oid):
+                from ray_tpu.core.object_store import RayObject
+
+                rt.memory_store.put(oid, RayObject(size=size, in_shm=True))
         with self._hb_lock:
             self._hb[nid] = time.monotonic()
         rt.scheduler.retry_pending_pgs()
@@ -246,7 +286,7 @@ class ControlPlane:
         oid = ObjectID(msg["oid"])
         nid = peer.meta.get("worker_node") or peer.meta.get("node_id")
         if peer.meta.get("plane") == "isolated" and nid is not None:
-            rt.plane_object_added(oid, nid)
+            rt.plane_object_added(oid, nid, size=msg.get("size") or 0)
         elif rt.spill is not None and msg.get("size"):
             # shared plane: the writer sealed into the head segment directly;
             # account it for spill pressure tracking
@@ -331,7 +371,7 @@ class ControlPlane:
             nid = peer.meta.get("worker_node")
             if nid is None:
                 raise ValueError("isolated-plane worker did not report its node")
-            rt.plane_object_added(oid, nid)
+            rt.plane_object_added(oid, nid, size=msg.get("size") or 0)
         else:
             rt.shm_store.pin(oid)
             if rt.spill is not None:

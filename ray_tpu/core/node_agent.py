@@ -70,6 +70,12 @@ def main() -> None:
         plane_server = ObjectPlaneServer(local_store, host="0.0.0.0")
 
     pool_box: dict = {}
+    # Primary copies this agent pins in its local store, re-announced on
+    # re-registration so a restarted head can serve pre-crash refs
+    # (oid_bin -> size). Task results only; worker client-puts are tracked
+    # head-side in the durable plane table.
+    pinned_objects: dict = {}
+    pinned_lock = __import__("threading").Lock()
 
     def h_execute_task(peer, msg):
         """Head-pushed task dispatch (reference: raylet grants a lease and the
@@ -103,11 +109,15 @@ def main() -> None:
             # sealed into THIS node's store: pin the primary copy here and
             # tell the head it's plane-resident (chunk-pullable)
             local_store.pin(ObjectID(msg["oid"]))
+            with pinned_lock:
+                pinned_objects[msg["oid"]] = size
             return ("plane", payload, size, contained)
         return (status, payload, size, contained)
 
     def h_plane_free(peer, msg):
         """Head dropped the last reference: free the node-held primary."""
+        with pinned_lock:
+            pinned_objects.pop(msg["oid"], None)
         if local_store is not None:
             oid = ObjectID(msg["oid"])
             try:
@@ -140,35 +150,52 @@ def main() -> None:
     def h_shutdown(peer, msg):
         os._exit(0)
 
-    peer = wire.connect(
-        host, int(port),
-        handlers={
-            "execute_task": h_execute_task,
-            "task_blocked": h_task_blocked,
-            "plane_free": h_plane_free,
-            "kill_worker": h_kill_worker,
-            "num_alive": h_num_alive,
-            "ping": h_ping,
-            "shutdown": h_shutdown,
-        },
-        name=f"agent-{os.getpid()}",
-    )
-    peer.call("hello", token=args.token, kind="agent", pid=os.getpid(), timeout=10)
-    plane_addr = None
-    if plane_server is not None:
-        _, plane_port = plane_server.server.address
-        plane_addr = f"{peer.local_address[0]}:{plane_port}"
-    reg = peer.call(
-        "register_node",
-        resources=resources,
-        labels=json.loads(args.labels),
-        slice_name=args.slice_name,
-        ici_coords=tuple(json.loads(args.ici_coords)) if args.ici_coords else None,
-        pid=os.getpid(),
-        name=args.name,
-        plane_addr=plane_addr,
-        timeout=10,
-    )
+    # Stable node identity for this agent process: survives head restarts so
+    # the head's persisted object-plane locations keep naming this node
+    # (reference: raylet NodeID, constant for the raylet's lifetime).
+    node_id = NodeID.from_random()
+    handlers = {
+        "execute_task": h_execute_task,
+        "task_blocked": h_task_blocked,
+        "plane_free": h_plane_free,
+        "kill_worker": h_kill_worker,
+        "num_alive": h_num_alive,
+        "ping": h_ping,
+        "shutdown": h_shutdown,
+    }
+
+    def connect_and_register():
+        """One connect+hello+register round; returns (peer, reg-reply)."""
+        peer = wire.connect(host, int(port), handlers=handlers,
+                            name=f"agent-{os.getpid()}")
+        try:
+            peer.call("hello", token=args.token, kind="agent", pid=os.getpid(),
+                      timeout=10)
+            plane_addr = None
+            if plane_server is not None:
+                _, plane_port = plane_server.server.address
+                plane_addr = f"{peer.local_address[0]}:{plane_port}"
+            with pinned_lock:
+                plane_objects = list(pinned_objects.items())
+            reg = peer.call(
+                "register_node",
+                resources=resources,
+                labels=json.loads(args.labels),
+                slice_name=args.slice_name,
+                ici_coords=tuple(json.loads(args.ici_coords)) if args.ici_coords else None,
+                pid=os.getpid(),
+                name=args.name,
+                node_id=node_id.binary(),
+                plane_addr=plane_addr,
+                plane_objects=plane_objects,
+                timeout=10,
+            )
+        except BaseException:
+            peer.close()
+            raise
+        return peer, reg
+
+    peer, reg = connect_and_register()
 
     if args.isolated_plane:
         shm_name, shm_size = local_store.name, local_store.size
@@ -183,15 +210,19 @@ def main() -> None:
     from ray_tpu.core import cgroup as cgroup_mod
 
     cgroups = cgroup_mod.create_if_enabled(f"ray_tpu-agent-{os.getpid()}")
-    pool_box["pool"] = ProcessWorkerPool(
-        num_workers=num_workers,
-        shm_name=shm_name,
-        shm_size=shm_size,
-        head_addr=args.head,
-        token=args.token,
-        log_dir=reg.get("log_dir"),
-        cgroup_manager=cgroups,
-    )
+
+    def make_pool(shm_name, shm_size, log_dir):
+        return ProcessWorkerPool(
+            num_workers=num_workers,
+            shm_name=shm_name,
+            shm_size=shm_size,
+            head_addr=args.head,
+            token=args.token,
+            log_dir=log_dir,
+            cgroup_manager=cgroups,
+        )
+
+    pool_box["pool"] = make_pool(shm_name, shm_size, reg.get("log_dir"))
 
     def _node_stats() -> dict:
         """Per-node physical stats shipped with every heartbeat (reference:
@@ -236,15 +267,49 @@ def main() -> None:
                 pass
         return st
 
-    # Heartbeat until the head goes away, then exit (reference: raylet dies
-    # when the GCS connection is lost).
+    # Heartbeat; on head loss, try to reconnect to the SAME address for a
+    # grace window — a restarted head (durable GCS store, same token)
+    # re-registers this node and its pinned plane objects. Exceeding the
+    # window, exit like the reference raylet does when the GCS is gone
+    # (reference: gcs_rpc_client reconnection with a bounded retry budget).
     period = float(os.environ.get("RAY_TPU_AGENT_HEARTBEAT_PERIOD_S", "0.5"))
+    reconnect_s = float(os.environ.get("RAY_TPU_HEAD_RECONNECT_S", "60"))
     try:
-        while not peer.closed:
+        while True:
             try:
                 peer.notify("heartbeat", stats=_node_stats())
             except wire.PeerDisconnected:
-                break
+                pass
+            if peer.closed:
+                if reconnect_s <= 0:
+                    break
+                deadline = time.monotonic() + reconnect_s
+                print(f"node agent: head connection lost; reconnecting for up "
+                      f"to {reconnect_s:.0f}s", file=sys.stderr, flush=True)
+                while time.monotonic() < deadline:
+                    try:
+                        peer, reg = connect_and_register()
+                        break
+                    except Exception:
+                        time.sleep(0.5)
+                if peer.closed:
+                    break  # window exhausted
+                # A new head means a new shared shm segment / log dir: rebuild
+                # the worker pool when the segment changed (isolated-plane
+                # agents keep their node-local store and warm workers).
+                new_shm = (local_store.name if args.isolated_plane
+                           else reg.get("shm_name"))
+                if not args.isolated_plane and new_shm != shm_name:
+                    shm_name = new_shm
+                    shm_size = reg.get("shm_size") or 0
+                    try:
+                        pool_box["pool"].shutdown()
+                    except Exception:
+                        pass
+                    pool_box["pool"] = make_pool(shm_name, shm_size,
+                                                 reg.get("log_dir"))
+                print("node agent: re-registered with head", file=sys.stderr,
+                      flush=True)
             time.sleep(period)
     finally:
         try:

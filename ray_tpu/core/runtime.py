@@ -51,6 +51,7 @@ from ray_tpu.core.scheduler import (
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorError,
+    GetTimeoutError,
     ObjectLostError,
     TaskCancelledError,
     TaskError,
@@ -426,6 +427,11 @@ class Runtime:
                     continue
                 val = self._resolve_obj(oid, obj)
                 if val is _RETRY:
+                    # The marker may be instantly re-readable (e.g. a plane
+                    # holder mid-reconnect): enforce the deadline here or the
+                    # retry loop would spin past it.
+                    if deadline is not None and time.monotonic() >= deadline:
+                        raise GetTimeoutError(f"Get timed out waiting for {oid.hex()}")
                     continue
                 out.append(val)
                 break
@@ -458,6 +464,14 @@ class Runtime:
                 blob = self._pull_from_plane(oid)
                 if blob is not None:
                     return serialization.deserialize_from_bytes(blob)
+                if self.has_plane_copy(oid):
+                    # The directory still names a holder but none is dialable
+                    # right now — e.g. its agent is mid-reconnect after a head
+                    # restart. The object isn't lost; wait for the holder
+                    # within the caller's deadline (reference: PullManager
+                    # retries while the location subscription lists copies).
+                    time.sleep(0.05)
+                    return _RETRY
                 # Evicted under memory pressure -> recover via lineage
                 # (reference: plasma miss -> FetchOrReconstruct, §3.2.7).
                 self.memory_store.delete([oid])
@@ -544,9 +558,16 @@ class Runtime:
             self._free_plane_copies(r.object_id())
 
     # ---------------------------------------------------- object plane
-    def plane_object_added(self, oid: ObjectID, node_id: NodeID) -> None:
+    def plane_object_added(self, oid: ObjectID, node_id: NodeID,
+                           size: int = 0, _persist: bool = True) -> None:
         with self._lock:
             self._plane_locations.setdefault(oid, set()).add(node_id)
+        if _persist:
+            from ray_tpu._private import persistence
+
+            store = persistence.get_store()
+            if store is not None:
+                store.plane_add(oid.binary(), node_id.binary(), size)
 
     def plane_object_removed(self, oid: ObjectID, node_id: NodeID) -> None:
         with self._lock:
@@ -555,6 +576,11 @@ class Runtime:
                 holders.discard(node_id)
                 if not holders:
                     self._plane_locations.pop(oid, None)
+        from ray_tpu._private import persistence
+
+        store = persistence.get_store()
+        if store is not None:
+            store.plane_remove(oid.binary(), node_id.binary())
 
     def has_plane_copy(self, oid: ObjectID) -> bool:
         with self._lock:
@@ -600,7 +626,12 @@ class Runtime:
     def _free_plane_copies(self, oid: ObjectID) -> None:
         with self._lock:
             nids = self._plane_locations.pop(oid, set())
+        from ray_tpu._private import persistence
+
+        store = persistence.get_store()
         for nid in nids:
+            if store is not None:
+                store.plane_remove(oid.binary(), nid.binary())
             agent = self._agents.get(nid)
             if agent is not None:
                 try:
@@ -1068,12 +1099,18 @@ class Runtime:
         export_events.emit("node", {"node_id": node_id.hex(), "state": "DEAD"})
         # Objects whose only copies lived on the dead node are now lost; the
         # next access misses the directory and falls to lineage reconstruction.
+        from ray_tpu._private import persistence
+
+        store = persistence.get_store()
         with self._lock:
             self._plane_addrs.pop(node_id, None)
             for oid, holders in list(self._plane_locations.items()):
-                holders.discard(node_id)
-                if not holders:
-                    self._plane_locations.pop(oid, None)
+                if node_id in holders:
+                    holders.discard(node_id)
+                    if store is not None:
+                        store.plane_remove(oid.binary(), node_id.binary())
+                    if not holders:
+                        self._plane_locations.pop(oid, None)
         try:
             self.publisher.publish("nodes", {"node_id": node_id.hex(), "event": "dead"})
         except Exception:
@@ -1214,7 +1251,7 @@ class Runtime:
             # primary copy); the head records the location and serves gets by
             # chunk-pulling (reference: task return stays in the executing
             # node's plasma; the owner tracks its location).
-            self.plane_object_added(rids[0], node_id)
+            self.plane_object_added(rids[0], node_id, size=size or 0)
             self.memory_store.put(rids[0], RayObject(size=size or 0, in_shm=True))
             with self._lock:
                 self._recovering.discard(rids[0])

@@ -126,8 +126,12 @@ class ClusterScheduler:
         labels: dict[str, str] | None = None,
         slice_name: str | None = None,
         ici_coords: tuple[int, int, int] | None = None,
+        node_id: NodeID | None = None,
     ) -> NodeID:
-        nid = NodeID.from_random()
+        # node_id: agents keep a stable identity across head restarts (like
+        # raylet node ids) so persisted object-plane locations stay valid
+        # when they re-register with a replacement head.
+        nid = node_id or NodeID.from_random()
         rs = ResourceSet(resources)
         with self._lock:
             self._nodes[nid] = NodeState(nid, rs.copy(), rs.copy(), dict(labels or {}), True, slice_name, ici_coords)
@@ -201,6 +205,13 @@ class ClusterScheduler:
             if req.placement_group is not None:
                 resources = self._pg_wildcard_resources(req)
             node.available.add(resources)
+            # Clamp to totals: a node re-registration (fresh NodeState at
+            # full availability) can race releases from tasks leased under
+            # the PREVIOUS registration; without the clamp those releases
+            # mint phantom capacity.
+            for k, total in node.total.items():
+                if node.available.get(k, 0.0) > total:
+                    node.available[k] = total
             self._lock.notify_all()
 
     def wait_for_change(self, timeout: float = 1.0) -> None:
@@ -269,8 +280,32 @@ class ClusterScheduler:
         )
         with self._lock:
             self._pgs[pg_id] = pg
+        from ray_tpu._private import persistence
+
+        store = persistence.get_store()
+        if store is not None:
+            store.record_pg(pg_id.binary(), {
+                "bundles": [dict(b) for b in bundles], "strategy": strategy,
+                "name": name, "slice_name": slice_name,
+            })
         self._try_place_pg(pg)
         return pg
+
+    def restore_placement_group(self, pg_id_bin: bytes, spec: dict) -> None:
+        """Recreate a persisted PG under its ORIGINAL id, PENDING — clients
+        holding pre-crash PG handles keep working; placement happens as node
+        agents re-register (reference: GCS restart replaying the placement
+        group table, gcs_placement_group_manager)."""
+        pg_id = PlacementGroupID(pg_id_bin)
+        with self._lock:
+            if pg_id in self._pgs:
+                return
+            self._pgs[pg_id] = PlacementGroupState(
+                pg_id,
+                [Bundle(i, ResourceSet(b)) for i, b in enumerate(spec["bundles"])],
+                spec["strategy"], spec.get("name", ""),
+                slice_name=spec.get("slice_name"),
+            )
 
     def _try_place_pg(self, pg: PlacementGroupState) -> bool:
         """Reserve all bundles per strategy; roll back on failure (prepare phase)."""
@@ -390,6 +425,11 @@ class ClusterScheduler:
             pg.state = "REMOVED"
             self._pgs.pop(pg.pg_id, None)
             self._lock.notify_all()
+        from ray_tpu._private import persistence
+
+        store = persistence.get_store()
+        if store is not None:
+            store.remove_pg(pg.pg_id.binary())
         self.retry_pending_pgs()
 
     def retry_pending_pgs(self) -> None:

@@ -38,6 +38,22 @@ class JobInfo:
     returncode: int | None = None
 
 
+def _persist_job(info: "JobInfo") -> None:
+    """Write-through to the durable GCS store (reference: the job table in
+    gcs_table_storage.cc — a restarted head lists pre-crash jobs; their
+    supervisor subprocesses died with it, so RUNNING snapshots read FAILED)."""
+    from ray_tpu._private import persistence
+
+    store = persistence.get_store()
+    if store is not None:
+        status = info.status.value
+        if status == JobStatus.RUNNING.value:
+            persisted = dict(vars(info), status=JobStatus.FAILED.value)
+        else:
+            persisted = dict(vars(info), status=status)
+        store.record_job(info.job_id, persisted)
+
+
 class _Supervisor:
     """Reference: JobSupervisor — owns the driver subprocess."""
 
@@ -58,6 +74,7 @@ class _Supervisor:
         logf = open(self.info.log_path, "w")
         self.info.status = JobStatus.RUNNING
         self.info.start_time = time.time()
+        _persist_job(self.info)
         self.proc = subprocess.Popen(
             self.info.entrypoint, shell=True, cwd=cwd, env=env,
             stdout=logf, stderr=subprocess.STDOUT,
@@ -70,6 +87,7 @@ class _Supervisor:
         self.info.end_time = time.time()
         if self.info.status != JobStatus.STOPPED:
             self.info.status = JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+        _persist_job(self.info)
         from ray_tpu._private import export_events
 
         export_events.emit("driver_job", {
@@ -226,7 +244,23 @@ class JobSubmissionClient:
                             metadata=d.get("metadata") or {},
                             returncode=d.get("returncode"))
                     for d in self._http("GET", "/api/jobs")]
-        return [s.info for s in self._jobs.values()]
+        out = {jid: s.info for jid, s in self._jobs.items()}
+        # Pre-crash jobs from the durable store (their supervisors are gone).
+        from ray_tpu._private import persistence
+
+        store = persistence.get_store()
+        if store is not None:
+            for jid, d in store.jobs().items():
+                if jid not in out:
+                    out[jid] = JobInfo(
+                        job_id=d["job_id"], entrypoint=d["entrypoint"],
+                        status=JobStatus(d["status"]),
+                        start_time=d.get("start_time", 0.0),
+                        end_time=d.get("end_time", 0.0),
+                        log_path=d.get("log_path", ""),
+                        metadata=d.get("metadata") or {},
+                        returncode=d.get("returncode"))
+        return list(out.values())
 
     def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> JobStatus:
         deadline = time.monotonic() + timeout

@@ -30,7 +30,7 @@ def test_rpdb_binds_loopback_and_requires_token(ray_start_regular):
         return val
 
     ref = buggy.remote()
-    deadline = time.time() + 30
+    deadline = time.time() + 60
     sessions = []
     while time.time() < deadline and not sessions:
         sessions = rpdb.list_sessions()
@@ -67,7 +67,7 @@ def test_rpdb_binds_loopback_and_requires_token(ray_start_regular):
 
     t = threading.Thread(target=drive, daemon=True)
     t.start()
-    assert ray_tpu.get(ref, timeout=30) == 7
+    assert ray_tpu.get(ref, timeout=60) == 7
     t.join(timeout=10)
 
 
