@@ -1,0 +1,293 @@
+"""GCE TPU-VM node provider: launch/terminate real TPU slices over the
+Cloud TPU REST API.
+
+Parity: python/ray/autoscaler/_private/gcp/node_provider.py (GCPNodeProvider)
++ gcp/node.py (GCPTPU resource: create/delete/list via the tpu v2 REST
+surface, operation polling) + gcp/config.py (bootstrap). Re-scoped TPU-first:
+node types ARE accelerator types (``v5p-8``, ``v6e-16``…), one instance = one
+slice, and the bootstrap script joins the cluster with
+``ray_tpu start --address <head> --token <token>`` (the repo's raylet-join
+entrypoint) instead of a ray-specific image.
+
+The HTTP layer is injectable (``transport``) so unit tests run against
+recorded responses with zero egress; production uses urllib against
+``tpu.googleapis.com`` with a token from the GCE metadata server or an
+operator-provided ``token_provider``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Callable, Optional
+
+from ray_tpu.autoscaler.node_provider import Instance, InstanceStatus, NodeProvider
+
+logger = logging.getLogger("ray_tpu")
+
+TPU_API = "https://tpu.googleapis.com/v2"
+METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                      "instance/service-accounts/default/token")
+
+# TPU node state -> instance FSM (reference: gcp/node.py GCPTPUNode.is_running
+# / autoscaler v2 reconciler states, reconciler.py:59)
+_TPU_STATE_MAP = {
+    "CREATING": InstanceStatus.REQUESTED,
+    "STARTING": InstanceStatus.ALLOCATED,
+    "READY": InstanceStatus.RUNNING,
+    "RESTARTING": InstanceStatus.ALLOCATED,
+    "STOPPING": InstanceStatus.STOPPING,
+    "STOPPED": InstanceStatus.STOPPING,
+    "DELETING": InstanceStatus.STOPPING,
+    "TERMINATED": InstanceStatus.TERMINATED,
+    "PREEMPTED": InstanceStatus.TERMINATED,
+}
+
+
+def _default_transport(method: str, url: str, body: Optional[dict],
+                       headers: dict) -> tuple[int, dict]:
+    """urllib transport (production path; tests inject a fake)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, method=method, data=data, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read())
+        except Exception:
+            detail = {"error": {"message": str(e)}}
+        return e.code, detail
+
+
+def metadata_token_provider() -> str:
+    """Access token from the GCE metadata server (the default when the head
+    itself runs on a GCE/TPU VM, like the reference's VM-default credentials)."""
+    req = urllib.request.Request(METADATA_TOKEN_URL,
+                                 headers={"Metadata-Flavor": "Google"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())["access_token"]
+
+
+class TpuVmApi:
+    """Thin Cloud-TPU v2 REST client: create/get/list/delete + operation
+    polling (reference: gcp/node.py GCPTPU wait_for_operation)."""
+
+    def __init__(self, project: str, zone: str,
+                 transport: Callable = _default_transport,
+                 token_provider: Callable[[], str] = metadata_token_provider,
+                 poll_interval_s: float = 2.0):
+        self.project = project
+        self.zone = zone
+        self._transport = transport
+        self._token_provider = token_provider
+        self._poll_interval_s = poll_interval_s
+
+    @property
+    def parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        url = f"{TPU_API}/{path}" if not path.startswith("http") else path
+        headers = {"Content-Type": "application/json",
+                   "Authorization": f"Bearer {self._token_provider()}"}
+        status, payload = self._transport(method, url, body, headers)
+        if status >= 400:
+            msg = payload.get("error", {}).get("message", str(payload))[:300]
+            raise RuntimeError(f"TPU API {method} {path}: HTTP {status}: {msg}")
+        return payload
+
+    def create_node(self, node_id: str, accelerator_type: str,
+                    runtime_version: str, startup_script: str = "",
+                    labels: Optional[dict] = None,
+                    spot: bool = False) -> dict:
+        body = {
+            "acceleratorType": accelerator_type,
+            "runtimeVersion": runtime_version,
+            "labels": labels or {},
+            "metadata": ({"startup-script": startup_script}
+                         if startup_script else {}),
+        }
+        if spot:
+            body["schedulingConfig"] = {"spot": True}
+        return self._call("POST", f"{self.parent}/nodes?nodeId={node_id}", body)
+
+    def get_node(self, node_id: str) -> dict:
+        return self._call("GET", f"{self.parent}/nodes/{node_id}")
+
+    def list_nodes(self) -> list[dict]:
+        out, token = [], None
+        while True:
+            path = f"{self.parent}/nodes"
+            if token:
+                path += f"?pageToken={token}"
+            page = self._call("GET", path)
+            out.extend(page.get("nodes", []))
+            token = page.get("nextPageToken")
+            if not token:
+                return out
+
+    def delete_node(self, node_id: str) -> dict:
+        return self._call("DELETE", f"{self.parent}/nodes/{node_id}")
+
+    def wait_operation(self, op: dict, timeout_s: float = 600.0) -> dict:
+        """Poll a long-running operation to completion (create/delete)."""
+        deadline = time.monotonic() + timeout_s
+        while not op.get("done"):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"TPU operation {op.get('name')} timed out")
+            time.sleep(self._poll_interval_s)
+            op = self._call("GET", op["name"])
+        if "error" in op:
+            raise RuntimeError(f"TPU operation failed: {op['error']}")
+        return op
+
+
+def join_startup_script(head_address: str, token: str,
+                        num_cpus: int = 4) -> str:
+    """The bootstrap a freshly-created TPU VM runs to join the cluster —
+    the repo's `ray start --address` analog, shipped as VM startup metadata
+    (reference: gcp/config.py injecting the ray bootstrap into user-data)."""
+    return (
+        "#!/bin/bash\n"
+        f"python3 -m ray_tpu.scripts.cli start --address {head_address} "
+        f"--token {token} --num-cpus {num_cpus} "
+        ">> /var/log/ray_tpu_join.log 2>&1 &\n"
+    )
+
+
+class GceTpuNodeProvider(NodeProvider):
+    """NodeProvider over real TPU-VM slices.
+
+    launch() creates slices whose startup script joins this cluster's head;
+    non_terminated_instances() reconciles against the live API list (filtered
+    by the cluster label), mapping TPU states onto the instance FSM — the
+    autoscaler's reconcile loop then sees cloud truth, not just local intent
+    (reference: GCPNodeProvider.non_terminated_nodes + v2 reconciler)."""
+
+    CLUSTER_LABEL = "ray-tpu-cluster"
+
+    def __init__(self, project: str, zone: str, cluster_name: str,
+                 head_address: str, cluster_token: str,
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 api: Optional[TpuVmApi] = None,
+                 transport: Callable = _default_transport,
+                 token_provider: Callable[[], str] = metadata_token_provider,
+                 spot: bool = False):
+        self.api = api or TpuVmApi(project, zone, transport=transport,
+                                   token_provider=token_provider)
+        self.cluster_name = cluster_name
+        self.head_address = head_address
+        self.cluster_token = cluster_token
+        self.runtime_version = runtime_version
+        self.spot = spot
+        self._instances: dict[str, Instance] = {}
+        self._lock = threading.Lock()
+
+    def launch(self, node_type: str, count: int) -> list[Instance]:
+        out = []
+        for _ in range(count):
+            name = f"raytpu-{self.cluster_name}-{uuid.uuid4().hex[:6]}"
+            op = self.api.create_node(
+                name, accelerator_type=node_type,
+                runtime_version=self.runtime_version,
+                startup_script=join_startup_script(self.head_address,
+                                                   self.cluster_token),
+                labels={self.CLUSTER_LABEL: self.cluster_name,
+                        "ray-tpu-node-type": node_type.replace(".", "-")},
+                spot=self.spot,
+            )
+            inst = Instance(name, node_type, InstanceStatus.REQUESTED)
+            with self._lock:
+                self._instances[name] = inst
+            # operations complete in the background; the reconcile in
+            # non_terminated_instances picks up READY (don't block launch)
+            threading.Thread(target=self._await_create, args=(name, op),
+                             daemon=True).start()
+            out.append(inst)
+        return out
+
+    def _await_create(self, name: str, op: dict) -> None:
+        try:
+            self.api.wait_operation(op)
+            with self._lock:
+                inst = self._instances.get(name)
+                if inst is not None and inst.status == InstanceStatus.REQUESTED:
+                    inst.status = InstanceStatus.ALLOCATED
+        except Exception as e:
+            logger.warning("TPU slice %s failed to create: %s", name, e)
+            with self._lock:
+                inst = self._instances.get(name)
+                if inst is not None:
+                    inst.status = InstanceStatus.TERMINATED
+
+    def terminate(self, instance_ids: list[str]) -> None:
+        for name in instance_ids:
+            try:
+                op = self.api.delete_node(name)
+                self.api.wait_operation(op, timeout_s=300)
+            except Exception as e:
+                logger.warning("TPU slice %s delete failed: %s", name, e)
+            with self._lock:
+                inst = self._instances.get(name)
+                if inst is not None:
+                    inst.status = InstanceStatus.TERMINATED
+
+    def non_terminated_instances(self) -> list[Instance]:
+        """Reconcile local intent with the cloud list: adopt foreign-created
+        nodes carrying our cluster label, advance states, and drop nodes the
+        cloud no longer reports."""
+        try:
+            live = {
+                n["name"].rsplit("/", 1)[-1]: n
+                for n in self.api.list_nodes()
+                if n.get("labels", {}).get(self.CLUSTER_LABEL) == self.cluster_name
+            }
+        except Exception as e:
+            logger.warning("TPU list failed (%s); serving cached view", e)
+            with self._lock:
+                return [i for i in self._instances.values()
+                        if i.status != InstanceStatus.TERMINATED]
+        with self._lock:
+            for name, node in live.items():
+                mapped = _TPU_STATE_MAP.get(node.get("state", ""),
+                                            InstanceStatus.REQUESTED)
+                inst = self._instances.get(name)
+                if inst is None:
+                    inst = self._instances[name] = Instance(
+                        name,
+                        node.get("labels", {}).get("ray-tpu-node-type",
+                                                   node.get("acceleratorType", "")),
+                        mapped)
+                elif inst.status != InstanceStatus.TERMINATED:
+                    inst.status = mapped
+            for name, inst in self._instances.items():
+                if name not in live and inst.status in (
+                        InstanceStatus.ALLOCATED, InstanceStatus.RUNNING,
+                        InstanceStatus.STOPPING):
+                    # cloud no longer reports it (deleted/preempted out-of-band)
+                    inst.status = InstanceStatus.TERMINATED
+            return [i for i in self._instances.values()
+                    if i.status != InstanceStatus.TERMINATED]
+
+    # ---- operator conveniences -------------------------------------------
+    def node_ips(self, instance_id: str) -> list[str]:
+        """Internal IPs of a slice's workers (networkEndpoints of the node;
+        reference: gcp/node.py GCPTPUNode.get_internal_ips)."""
+        node = self.api.get_node(instance_id)
+        return [ep.get("ipAddress", "") for ep in node.get("networkEndpoints", [])]
+
+    def ssh_join_command(self, instance_id: str) -> list[str]:
+        """Manual-bootstrap fallback (startup scripts need image support):
+        the gcloud ssh line an operator runs to join a slice by hand."""
+        join = (f"python3 -m ray_tpu.scripts.cli start "
+                f"--address {self.head_address} --token {self.cluster_token}")
+        return ["gcloud", "compute", "tpus", "tpu-vm", "ssh", instance_id,
+                f"--zone={self.api.zone}", f"--project={self.api.project}",
+                "--worker=all", f"--command={join}"]

@@ -79,7 +79,12 @@ def main() -> None:
 
     def h_execute_task(peer, msg):
         """Head-pushed task dispatch (reference: raylet grants a lease and the
-        spec lands on a pooled worker, task_receiver.cc:228)."""
+        spec lands on a pooled worker, task_receiver.cc:228). Returns a
+        Future — the wire layer sends the reply when the pool finishes, so
+        any number of pushed tasks pipeline through one connection without
+        holding an agent thread each (lease-reuse push model)."""
+        from concurrent.futures import Future as _Future
+
         # Registration precedes pool creation (the pool needs the head's shm
         # name from the register reply), so a fast dispatch can land in the
         # boot window — wait for the pool rather than failing the task.
@@ -95,24 +100,38 @@ def main() -> None:
 
             fn = wrap_with_runtime_env(cloudpickle.loads(fn_blob), msg["renv"])
             fn_blob = cloudpickle.dumps(fn)
-        try:
-            status, payload, size, contained = pool.execute_blob(
-                fn_blob, msg["args"], msg.get("oid"), task_bin=msg.get("task"))
-        except _RemoteTaskError as e:
-            # Unwrap so the ORIGINAL app exception type crosses the wire
-            # (picklable) and head-side retry matching behaves like local tasks.
-            orig = e.original_exception()
-            if orig is not None:
-                raise orig from None
-            raise RuntimeError(e.remote_tb) from None
-        if status == "shm" and local_store is not None:
-            # sealed into THIS node's store: pin the primary copy here and
-            # tell the head it's plane-resident (chunk-pullable)
-            local_store.pin(ObjectID(msg["oid"]))
-            with pinned_lock:
-                pinned_objects[msg["oid"]] = size
-            return ("plane", payload, size, contained)
-        return (status, payload, size, contained)
+        out: _Future = _Future()
+
+        def _done(f):
+            try:
+                status, payload, size, contained = f.result()
+            except _RemoteTaskError as e:
+                # Unwrap so the ORIGINAL app exception type crosses the wire
+                # (picklable) and head-side retry matching behaves like local
+                # tasks.
+                orig = e.original_exception()
+                out.set_exception(
+                    orig if orig is not None else RuntimeError(e.remote_tb))
+                return
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+                return
+            try:
+                if status == "shm" and local_store is not None:
+                    # sealed into THIS node's store: pin the primary copy here
+                    # and tell the head it's plane-resident (chunk-pullable)
+                    local_store.pin(ObjectID(msg["oid"]))
+                    with pinned_lock:
+                        pinned_objects[msg["oid"]] = size
+                    out.set_result(("plane", payload, size, contained))
+                else:
+                    out.set_result((status, payload, size, contained))
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        pool.submit_blob(fn_blob, msg["args"], msg.get("oid"),
+                         task_bin=msg.get("task")).add_done_callback(_done)
+        return out
 
     def h_plane_free(peer, msg):
         """Head dropped the last reference: free the node-held primary."""

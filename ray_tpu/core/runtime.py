@@ -846,15 +846,17 @@ class Runtime:
             self._finalize_entry(entry, req)
 
     def _can_dispatch_async(self, entry: _TaskEntry) -> bool:
-        """Async (callback) dispatch applies to plain local process tasks; the
-        thread path remains for actors, generators, agent dispatch, and traced
-        tasks (whose span must bracket the full roundtrip)."""
+        """Async (callback) dispatch applies to plain process tasks — local
+        pool AND node agents (the lease-reuse push model: the head streams
+        execute_task frames down the agent's standing connection and replies
+        resolve on its reader thread, normal_task_submitter.cc:141,515 —
+        no per-task head thread, no blocking round-trip). The thread path
+        remains for actors, generators, and traced tasks (whose span must
+        bracket the full roundtrip)."""
         spec = entry.spec
         if spec.is_actor_creation or isinstance(spec.num_returns, str):
             return False
         if not self._use_process_execution(spec):
-            return False
-        if self._agents.get(entry.node_id) is not None:
             return False
         from ray_tpu.util import tracing
 
@@ -893,12 +895,71 @@ class Runtime:
             return
         rids = spec.return_ids()
         oid_bin = rids[0].binary() if spec.num_returns == 1 else None
+        agent = self._agents.get(entry.node_id)
+        if agent is not None:
+            # Agent-bound: push down the standing connection (lease reuse) and
+            # finish on the reply callback — the wire layer keeps any number
+            # of requests in flight per agent (call_async), so dispatch
+            # throughput is bounded by frame serialization, not round-trips.
+            try:
+                mid, fut = agent.call_async(
+                    "execute_task", fn=fn_blob, args=args_blob, oid=oid_bin,
+                    task=spec.task_id.binary(), renv=None,
+                )
+            except Exception as e:  # peer closed racing dispatch
+                from ray_tpu.core.wire import PeerDisconnected
+
+                if isinstance(e, PeerDisconnected):
+                    # same wrap as the sync path: agent death is a retryable
+                    # system fault, not a terminal task error
+                    e = ActorError(f"node agent died during task: {e}")
+                self._handle_task_failure(entry, e)
+                self._finalize_entry(entry, req)
+                return
+            fut.add_done_callback(
+                lambda f: self._complete_agent_task(entry, req, rids, f)
+            )
+            return
         fut = self._process_pool().submit_blob(
             fn_blob, args_blob, oid_bin, spec.task_id.binary()
         )
         fut.add_done_callback(
             lambda f: self._complete_process_task(entry, req, rids, f)
         )
+
+    def _complete_agent_task(self, entry: _TaskEntry, req: SchedulingRequest,
+                             rids: list, fut) -> None:
+        """Agent-reader-thread callback: the tail of _execute_on_agent for
+        pushed dispatches."""
+        from ray_tpu.core.wire import PeerDisconnected
+
+        spec = entry.spec
+        try:
+            exc = fut.exception()
+            if exc is not None:
+                if isinstance(exc, PeerDisconnected):
+                    raise ActorError(f"node agent died during task: {exc}") from exc
+                raise exc
+            res = fut.result()
+            status, payload, size = res[0], res[1], res[2]
+            contained = res[3] if len(res) > 3 else None
+            self._store_worker_result(spec, rids, status, payload, size,
+                                      node_id=entry.node_id, contained=contained)
+            entry.state = "FINISHED"
+            self._record_event(spec, "FINISHED")
+        except TaskCancelledError as e:
+            self._store_error(spec, e)
+            entry.state = "CANCELLED"
+            self._record_event(spec, "CANCELLED")
+        except BaseException as e:  # noqa: BLE001
+            if entry.cancelled:
+                self._store_error(spec, TaskCancelledError(spec.desc()))
+                entry.state = "CANCELLED"
+                self._record_event(spec, "CANCELLED")
+            else:
+                self._handle_task_failure(entry, e)
+        finally:
+            self._finalize_entry(entry, req)
 
     def _complete_process_task(self, entry: _TaskEntry, req: SchedulingRequest,
                                rids: list, fut) -> None:

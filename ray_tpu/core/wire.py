@@ -158,19 +158,45 @@ class RpcPeer:
                 raise ValueError(f"unknown rpc op {op!r}")
             result = handler(self, msg)
             if mid is not None:
+                if isinstance(result, Future):
+                    # Deferred reply: the handler pipelined the work (e.g. a
+                    # node agent queuing onto its worker pool) — send the
+                    # frame when the future resolves, freeing this thread.
+                    result.add_done_callback(
+                        lambda f, mid=mid: self._send_deferred_reply(mid, f))
+                    return
                 self._send({"reply_to": mid, "result": result})
         except PeerDisconnected:
             pass
         except BaseException as e:  # noqa: BLE001 — ship the error back
             if mid is not None:
-                try:
-                    blob = pickle.dumps(e)
-                except Exception:
-                    blob = pickle.dumps(RuntimeError(f"{type(e).__name__}: {e}"))
-                try:
-                    self._send({"reply_to": mid, "error": blob})
-                except PeerDisconnected:
-                    pass
+                self._send_error_reply(mid, e)
+
+    def _send_deferred_reply(self, mid: int, fut: Future) -> None:
+        try:
+            result = fut.result()
+        except PeerDisconnected:
+            return
+        except BaseException as e:  # noqa: BLE001
+            self._send_error_reply(mid, e)
+            return
+        try:
+            self._send({"reply_to": mid, "result": result})
+        except PeerDisconnected:
+            pass
+        except BaseException as e:  # noqa: BLE001 — e.g. frame-too-large:
+            # the caller must get SOMETHING or its future hangs forever
+            self._send_error_reply(mid, e)
+
+    def _send_error_reply(self, mid: int, e: BaseException) -> None:
+        try:
+            blob = pickle.dumps(e)
+        except Exception:
+            blob = pickle.dumps(RuntimeError(f"{type(e).__name__}: {e}"))
+        try:
+            self._send({"reply_to": mid, "error": blob})
+        except PeerDisconnected:
+            pass
 
     def _fail(self, exc: Exception) -> None:
         with self._plock:

@@ -26,12 +26,95 @@ class Dashboard:
         self.job_client = job_client
         self._loop = None
         self._runner = None
-        self._profile_dirs: list[str] = []
+        self._profile_artifacts: dict[str, str] = {}  # id -> zip path
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True, name="dashboard")
         self._thread.start()
         if not self._started.wait(10):
             raise RuntimeError("dashboard failed to start")
+
+    def _capture_profile(self, duration: float,
+                         node_hex: "str | None") -> tuple:
+        """Run a jax.profiler XPlane capture — head-local, or inside a worker
+        pinned to `node_hex` — and archive it as a downloadable zip.
+        Reference: profile_manager.py:82 (on-demand py-spy/memray captures
+        stored + linked from the dashboard), re-aimed at the accelerator."""
+        import shutil
+        import tempfile
+        import uuid as _uuid
+
+        if node_hex:
+            import ray_tpu
+
+            @ray_tpu.remote(num_cpus=0,
+                            scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(
+                                node_id=node_hex, soft=False))
+            def _worker_capture(secs: float) -> bytes:
+                import glob as _glob
+                import io
+                import time as _t
+                import zipfile
+
+                import jax
+
+                d = tempfile.mkdtemp(prefix="ray_tpu_profile_")
+                try:
+                    with jax.profiler.trace(d):
+                        _t.sleep(secs)
+                    buf = io.BytesIO()
+                    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                        for p in _glob.glob(os.path.join(d, "**"), recursive=True):
+                            if os.path.isfile(p):
+                                z.write(p, os.path.relpath(p, d))
+                    return buf.getvalue()
+                finally:
+                    shutil.rmtree(d, ignore_errors=True)
+
+            blob = ray_tpu.get(_worker_capture.remote(duration),
+                               timeout=duration + 120)
+            art_id = f"profile-{node_hex[:8]}-{_uuid.uuid4().hex[:6]}"
+            path = os.path.join(tempfile.gettempdir(), f"{art_id}.zip")
+            with open(path, "wb") as f:
+                f.write(blob)
+            n_files = self._register_artifact(art_id, path)
+            return art_id, path, n_files
+
+        import time as _time
+        import zipfile
+
+        import jax
+
+        out_dir = tempfile.mkdtemp(prefix="ray_tpu_profile_")
+        try:
+            with jax.profiler.trace(out_dir):
+                _time.sleep(duration)
+            art_id = f"profile-head-{_uuid.uuid4().hex[:6]}"
+            path = os.path.join(tempfile.gettempdir(), f"{art_id}.zip")
+            with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+                for root, _, names in os.walk(out_dir):
+                    for n in names:
+                        p = os.path.join(root, n)
+                        z.write(p, os.path.relpath(p, out_dir))
+            n_files = self._register_artifact(art_id, path)
+            return art_id, path, n_files
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+    def _register_artifact(self, art_id: str, path: str) -> int:
+        import zipfile
+
+        with zipfile.ZipFile(path) as z:
+            n_files = len(z.namelist())
+        self._profile_artifacts[art_id] = path
+        # capped retention, like the capture dirs before it
+        while len(self._profile_artifacts) > 8:
+            old_id = next(iter(self._profile_artifacts))
+            old = self._profile_artifacts.pop(old_id)
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+        return n_files
 
     def _serve(self) -> None:
         from aiohttp import web
@@ -197,40 +280,47 @@ class Dashboard:
         async def profile(request):
             """On-demand accelerator/host profiling (reference: dashboard
             reporter profile_manager.py:82 py-spy/memray; TPU-native
-            equivalent is a jax profiler XPlane/perfetto capture)."""
+            equivalent is a jax profiler XPlane/perfetto capture). With
+            ?node=<hex> the capture runs in a WORKER on that node (the task is
+            node-affinity pinned); artifacts are stored head-side and served
+            from /api/profile/artifacts/<id>."""
             import asyncio as _aio
-            import tempfile
-            import time as _time
 
             duration = min(float(request.query.get("duration_s", "1.0")), 30.0)
-
-            def capture():
-                import jax
-                import shutil
-
-                out_dir = tempfile.mkdtemp(prefix="ray_tpu_profile_")
-                with jax.profiler.trace(out_dir):
-                    _time.sleep(duration)
-                files = []
-                for root, _, names in os.walk(out_dir):
-                    files.extend(os.path.join(root, n) for n in names)
-                # capped retention: keep the newest few captures, not /tmp forever
-                self._profile_dirs.append(out_dir)
-                while len(self._profile_dirs) > 5:
-                    shutil.rmtree(self._profile_dirs.pop(0), ignore_errors=True)
-                return out_dir, files
+            node_hex = request.query.get("node")
 
             loop = _aio.get_running_loop()
             try:
-                out_dir, files = await loop.run_in_executor(None, capture)
+                art_id, zip_path, n_files = await loop.run_in_executor(
+                    None, self._capture_profile, duration, node_hex)
             except Exception as e:  # noqa: BLE001
                 return web.json_response({"error": str(e)[:300]}, status=500)
             return web.json_response({
-                "profile_dir": out_dir,
-                "num_files": len(files),
-                "files": files[:50],
+                "artifact_id": art_id,
+                "artifact_url": f"/api/profile/artifacts/{art_id}",
+                "num_files": n_files,
+                "node": node_hex or "head",
                 "duration_s": duration,
+                "hint": "unzip and open with xprof / tensorboard profile "
+                        "plugin (XPlane) or ui.perfetto.dev (trace.json.gz)",
             })
+
+        async def profile_artifacts(request):
+            return web.json_response({"artifacts": [
+                {"artifact_id": aid,
+                 "artifact_url": f"/api/profile/artifacts/{aid}",
+                 "bytes": os.path.getsize(p)}
+                for aid, p in self._profile_artifacts.items()
+            ]})
+
+        async def profile_artifact_get(request):
+            aid = request.match_info["artifact_id"]
+            path = self._profile_artifacts.get(aid)
+            if path is None or not os.path.exists(path):
+                return web.json_response({"error": "unknown artifact"}, status=404)
+            return web.FileResponse(
+                path, headers={"Content-Disposition":
+                               f'attachment; filename="{aid}.zip"'})
 
         async def index(request):
             from ray_tpu.dashboard.ui import INDEX_HTML
@@ -254,6 +344,9 @@ class Dashboard:
             app.router.add_get("/api/serve/status", serve_status)
             app.router.add_get("/healthz", healthz)
             app.router.add_post("/api/profile", profile)
+            app.router.add_get("/api/profile/artifacts", profile_artifacts)
+            app.router.add_get("/api/profile/artifacts/{artifact_id}",
+                               profile_artifact_get)
             self._runner = web.AppRunner(app)
             await self._runner.setup()
             site = web.TCPSite(self._runner, self.host, self.port)

@@ -177,7 +177,7 @@ $("profbtn").onclick = async () => {
     const r = await fetch("/api/profile?duration_s=2", {method: "POST"});
     const d = await r.json();
     if (!r.ok) throw new Error(d.error || r.status);
-    $("profbtn").textContent = `saved ${d.num_files} file(s): ${d.profile_dir}`;
+    $("profbtn").innerHTML = `<a href="${d.artifact_url}">download ${d.artifact_id} (${d.num_files} files)</a>`;
   } catch (e) { $("profbtn").textContent = "profile failed: " + e.message; }
   setTimeout(() => { $("profbtn").textContent = "capture 2s jax profile"; $("profbtn").disabled = false; }, 6000);
 };

@@ -132,6 +132,52 @@ def bench_queued_tasks(n: int) -> list[dict]:
              "drain_rate_per_s": round(n / drain_dt, 1)}]
 
 
+def bench_dispatch(n_agents: int, tasks_per_agent: int = 20) -> list[dict]:
+    """Steady-state dispatch throughput to REAL node agents (the round-4
+    knee: 5.6 tasks/s at 50 agents with the synchronous per-task round-trip;
+    the pushed lease-reuse path pipelines frames down each agent's standing
+    connection). Warms every agent's pool first so the number measures
+    dispatch, not process spawn."""
+    from ray_tpu.cluster_utils import Cluster
+
+    out = []
+    cluster = Cluster()
+    t0 = time.perf_counter()
+    # A dedicated resource pins the probe tasks to THESE agents: without it,
+    # SPREAD lets logical nodes / the head absorb tasks and the number stops
+    # measuring the pushed agent path.
+    nids = [cluster.add_node(num_cpus=1, real_process=True,
+                             resources={"dispatchbench": 1})
+            for _ in range(n_agents)]
+    reg_dt = time.perf_counter() - t0
+    out.append({"metric": "dispatch_agents_registered", "n": len(nids),
+                "secs": round(reg_dt, 2)})
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD", num_cpus=1,
+                    resources={"dispatchbench": 1})
+    def nop():
+        return 0
+
+    # warm: boot every agent's worker pool (one task each, pinned by SPREAD)
+    ray_tpu.get([nop.remote() for _ in range(n_agents)], timeout=900)
+    # measure: many in-flight pushed dispatches across all agents
+    n_tasks = n_agents * tasks_per_agent
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n_tasks)]
+    submit_dt = time.perf_counter() - t0
+    ray_tpu.get(refs, timeout=1800)
+    total_dt = time.perf_counter() - t0
+    out.append({
+        "metric": "agent_dispatch",
+        "agents": n_agents,
+        "tasks": n_tasks,
+        "submit_rate_per_s": round(n_tasks / max(submit_dt, 1e-9), 1),
+        "dispatch_rate_per_s": round(n_tasks / max(total_dt, 1e-9), 1),
+        "secs": round(total_dt, 2),
+    })
+    return out
+
+
 def bench_placement_groups(n: int) -> list[dict]:
     """n simultaneous 1-bundle PGs on a cluster with room for all of them."""
     rt = get_runtime()
@@ -153,11 +199,13 @@ def bench_placement_groups(n: int) -> list[dict]:
     return out
 
 
-def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int) -> list[dict]:
+def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int,
+        dispatch_agents: int = 0) -> list[dict]:
     results = []
     ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
     for section, fn in (
         ("nodes", lambda: bench_nodes(nodes, real_agents)),
+        ("dispatch", lambda: bench_dispatch(dispatch_agents) if dispatch_agents else []),
         ("actors", lambda: bench_actors(actors)),
         ("queued_tasks", lambda: bench_queued_tasks(tasks)),
         ("placement_groups", lambda: bench_placement_groups(pgs)),
@@ -180,7 +228,7 @@ def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int) -> list
 def write_md(results: list[dict], path: str, args) -> None:
     ref = "/root/reference/release/benchmarks/README.md:11-14"
     lines = [
-        "# Scale envelope — round 4 (single host, 1 shared CPU core)",
+        "# Scale envelope — round 5 (single host, 1 shared CPU core)",
         "",
         f"Reference envelope ({ref}): 2,000 nodes / 40K actors / 10K running tasks"
         " / 1K PGs on a 64x64-core cluster; 1M queued tasks on one m4.16xlarge.",
@@ -207,8 +255,10 @@ if __name__ == "__main__":
     ap.add_argument("--actors", type=int, default=1000)
     ap.add_argument("--tasks", type=int, default=100_000)
     ap.add_argument("--pgs", type=int, default=1000)
-    ap.add_argument("--md", default="SCALE_r04.md")
+    ap.add_argument("--dispatch-agents", type=int, default=0)
+    ap.add_argument("--md", default="SCALE_r05.md")
     a = ap.parse_args()
-    res = run(a.nodes, a.real_agents, a.actors, a.tasks, a.pgs)
+    res = run(a.nodes, a.real_agents, a.actors, a.tasks, a.pgs,
+              dispatch_agents=a.dispatch_agents)
     if a.md:
         write_md(res, a.md, a)

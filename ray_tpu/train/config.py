@@ -21,6 +21,11 @@ class ScalingConfig:
     placement_strategy: str = "PACK"
     # TPU topology request (reference: SlicePlacementGroup util/tpu.py:420)
     topology: str | None = None  # e.g. "v5p-16"
+    # Host each worker actor in its own OS process (reference: train workers
+    # are always separate processes; here in-head actors are the lightweight
+    # default and this opts into real process isolation — required for
+    # worker-death fault-tolerance semantics to be meaningful)
+    isolate_workers: bool = False
 
     def worker_resources(self) -> dict[str, float]:
         res = dict(self.resources_per_worker or {})
@@ -35,6 +40,9 @@ class FailureConfig:
     """Reference: air/config.py FailureConfig; train/v2 failure_handling."""
 
     max_failures: int = 0  # retries of the whole worker group
+    # Preemptions budget separately (reference: spot reclaim doesn't consume
+    # the failure budget); -1 = unlimited
+    max_preemption_failures: int = -1
 
 
 @dataclasses.dataclass

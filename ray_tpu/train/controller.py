@@ -1,18 +1,49 @@
 """TrainController: the state machine driving a worker-group run.
 
-Parity: train/v2/_internal/execution/controller/controller.py:105 (TrainController;
-control loop :706, run :763) — polls workers, aggregates reports, applies the
-FailurePolicy (restart the group ≤ max_failures), registers checkpoints.
+Parity: train/v2/_internal/execution/controller/controller.py:105
+(TrainController; control loop :706, run :763) — polls workers INDIVIDUALLY,
+aggregates reports, classifies failures (worker death vs preemption vs user
+error), applies the FailurePolicy (failure_policy.py) and a ScalingPolicy
+(resize the next attempt when capacity changed), and registers checkpoints.
+
+State machine (reference TrainControllerState):
+    INITIALIZING -> RUNNING -> { FINISHED | RESTARTING | ERRORED }
+    RESTARTING -> RUNNING (fresh gang, possibly resized)
 """
 
 from __future__ import annotations
 
+import enum
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import FailureConfig, Result, RunConfig, ScalingConfig
+from ray_tpu.train.failure_policy import (
+    FailureDecision,
+    FailureKind,
+    FailurePolicy,
+    classify_failure,
+)
 from ray_tpu.train.worker_group import WorkerGroup
+
+
+class ControllerState(enum.Enum):
+    INITIALIZING = "INITIALIZING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    FINISHED = "FINISHED"
+    ERRORED = "ERRORED"
+
+
+class FixedScalingPolicy:
+    """Always the configured size (reference: fixed scaling policy)."""
+
+    def __init__(self, num_workers: int):
+        self.num_workers = num_workers
+
+    def workers_for_next_attempt(self) -> int:
+        return self.num_workers
 
 
 class TrainController:
@@ -24,17 +55,30 @@ class TrainController:
         train_loop_config: dict,
         scaling: ScalingConfig,
         run_config: RunConfig,
+        scaling_policy=None,
     ):
         self.train_fn = train_fn
         self.train_loop_config = train_loop_config
         self.scaling = scaling
         self.run_config = run_config
+        # Policy split (reference v2 design): failure policy decides
+        # retry-vs-raise; scaling policy sizes each attempt independently.
+        self.failure_policy = FailurePolicy(run_config.failure_config
+                                            or FailureConfig())
+        self.scaling_policy = scaling_policy or FixedScalingPolicy(
+            scaling.num_workers)
+        self.state = ControllerState.INITIALIZING
+        self.state_history: list[tuple[str, str]] = []  # (state, detail)
         self.checkpoint_manager = CheckpointManager(
             run_config.resolved_storage_path(),
             num_to_keep=run_config.checkpoint_config.num_to_keep,
             score_attribute=run_config.checkpoint_config.checkpoint_score_attribute,
             score_order=run_config.checkpoint_config.checkpoint_score_order,
         )
+
+    def _transition(self, state: ControllerState, detail: str = "") -> None:
+        self.state = state
+        self.state_history.append((state.value, detail))
 
     def run(self) -> Result:
         from ray_tpu.air.callbacks import invoke as _cb
@@ -43,56 +87,92 @@ class TrainController:
         run_name = self.run_config.name or "train"
         _cb(callbacks, "setup", run_name)
         _cb(callbacks, "on_trial_start", run_name, dict(self.train_loop_config))
-        failures = 0
         while True:
-            result = self._run_attempt(callbacks, run_name)
+            n = self.scaling_policy.workers_for_next_attempt()
+            result, failure_kind = self._run_attempt(n, callbacks, run_name)
             if result.error is None:
+                self._transition(ControllerState.FINISHED)
                 _cb(callbacks, "on_trial_complete", run_name, result.metrics, None)
                 _cb(callbacks, "on_experiment_end", result)
                 return result
-            failures += 1
-            if failures > self.run_config.failure_config.max_failures:
+            decision = self.failure_policy.decide(failure_kind)
+            if decision == FailureDecision.RAISE:
+                self._transition(ControllerState.ERRORED,
+                                 f"{failure_kind.value}: {result.error}")
                 _cb(callbacks, "on_trial_complete", run_name, result.metrics,
                     str(result.error))
                 _cb(callbacks, "on_experiment_end", result)
                 return result
+            # RETRY: fresh gang next loop; a preemption notice is consumed so
+            # the next attempt doesn't immediately re-classify as preempted.
+            from ray_tpu.train.elastic import get_preemption_handler
 
-    def _run_attempt(self, callbacks=(), run_name: str = "train") -> Result:
+            get_preemption_handler().clear()
+            self._transition(ControllerState.RESTARTING, failure_kind.value)
+
+    def _run_attempt(self, num_workers: int, callbacks=(),
+                     run_name: str = "train") -> tuple[Result, Optional[FailureKind]]:
         from ray_tpu.air.callbacks import invoke as _cb
 
-        group = WorkerGroup(self.scaling)
+        scaling = self.scaling
+        if num_workers != scaling.num_workers:
+            import dataclasses
+
+            scaling = dataclasses.replace(scaling, num_workers=num_workers)
+        group = WorkerGroup(scaling)
         metrics_history: list[dict] = []
         last_metrics: dict = {}
         error: BaseException | None = None
+        failure_kind: Optional[FailureKind] = None
         try:
             group.start()
             group.run(self.train_fn, self.train_loop_config)
+            self._transition(ControllerState.RUNNING, f"{num_workers} workers")
             while True:
-                statuses = group.poll()
+                statuses = group.poll_individual()
                 # aggregate rank reports; rank 0's metrics win (reference:
                 # controller aggregates polls, rank-0 checkpoint registered)
-                step_reports: list[dict] = []
-                for rank, st in enumerate(statuses):
+                for st in statuses:
+                    if st["rank"] != 0:
+                        continue
                     for rep in st["reports"]:
-                        if rank == 0:
-                            step_reports.append(rep)
-                for rep in step_reports:
-                    last_metrics = rep["metrics"]
-                    metrics_history.append(last_metrics)
-                    _cb(callbacks, "on_trial_result", run_name, last_metrics)
-                    if rep["checkpoint"]:
-                        self.checkpoint_manager.register(
-                            Checkpoint(rep["checkpoint"]), last_metrics
-                        )
+                        last_metrics = rep["metrics"]
+                        metrics_history.append(last_metrics)
+                        _cb(callbacks, "on_trial_result", run_name, last_metrics)
+                        if rep["checkpoint"]:
+                            self.checkpoint_manager.register(
+                                Checkpoint(rep["checkpoint"]), last_metrics
+                            )
+                dead = [st for st in statuses if st["dead"]]
+                if dead:
+                    # a gang member died: the collective is broken — restart
+                    # the whole group (SPMD semantics), classified as a
+                    # system fault, naming the dead ranks
+                    ranks = [st["rank"] for st in dead]
+                    cause = dead[0].get("death_error")
+                    error = RuntimeError(
+                        f"train worker rank(s) {ranks} died: {cause}")
+                    failure_kind = classify_failure(cause)
+                    if failure_kind == FailureKind.USER_ERROR:
+                        # a dead actor is never a user error; an unrecognized
+                        # cause still means the process is gone
+                        failure_kind = FailureKind.WORKER_DIED
+                    break
                 errs = [st["error"] for st in statuses if st["error"]]
                 if errs:
-                    error = RuntimeError(f"{len(errs)} train worker(s) failed:\n" + errs[0])
+                    # the train_fn raised in-process: user error (string tb)
+                    error = RuntimeError(
+                        f"{len(errs)} train worker(s) failed:\n" + errs[0])
+                    failure_kind = classify_failure(None)
+                    if failure_kind != FailureKind.PREEMPTED:
+                        failure_kind = FailureKind.USER_ERROR
                     break
                 if all(st["finished"] for st in statuses):
                     break
                 time.sleep(self.POLL_INTERVAL_S)
         except BaseException as e:  # noqa: BLE001
             error = e
+            failure_kind = classify_failure(e)
         finally:
             group.shutdown()
         return Result(
@@ -100,4 +180,4 @@ class TrainController:
             checkpoint=self.checkpoint_manager.latest_checkpoint(),
             error=error,
             metrics_history=metrics_history,
-        )
+        ), failure_kind

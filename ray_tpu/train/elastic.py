@@ -108,7 +108,7 @@ def run_elastic(
             train_fn, dict(config or {}, _elastic_attempt=attempt, _num_workers=n),
             scaling, run_config or RunConfig(name="elastic"),
         )
-        last = controller._run_attempt()
+        last, _kind = controller._run_attempt(n)
         if last.error is None:
             return last
         get_preemption_handler().clear()

@@ -1,0 +1,70 @@
+"""Failure classification + policy for the train controller.
+
+Parity: train/v2/_internal/execution/failure_handling/failure_policy.py
+(DefaultFailurePolicy: FailureDecision from worker-group errors, counting
+retries against FailureConfig) and the controller's distinction between
+worker-process death, spot preemption, and user train_fn errors
+(controller.py:706 control loop). Separated from the controller so scaling
+policy and failure policy compose independently (the v2 design's split).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from ray_tpu.train.config import FailureConfig
+
+
+class FailureKind(enum.Enum):
+    WORKER_DIED = "worker_died"    # actor/process/node death — system fault
+    PREEMPTED = "preempted"        # provider reclaimed capacity (spot/TPU)
+    USER_ERROR = "user_error"      # train_fn raised
+
+
+class FailureDecision(enum.Enum):
+    RETRY = "retry"    # restart the worker group (fresh gang)
+    RAISE = "raise"    # terminal: surface the error
+
+
+def classify_failure(err) -> FailureKind:
+    """Map an attempt error to its kind. Worker-side user tracebacks arrive
+    as strings from poll(); actor/system faults arrive as raised exceptions."""
+    from ray_tpu.exceptions import ActorDiedError, ActorError
+
+    from ray_tpu.train.elastic import get_preemption_handler
+
+    if get_preemption_handler().should_checkpoint_and_exit():
+        return FailureKind.PREEMPTED
+    if isinstance(err, (ActorDiedError, ActorError)):
+        return FailureKind.WORKER_DIED
+    if isinstance(err, (ConnectionError, OSError)):
+        return FailureKind.WORKER_DIED
+    return FailureKind.USER_ERROR
+
+
+@dataclass
+class FailurePolicy:
+    """Decides RETRY vs RAISE per failure kind.
+
+    - user errors and worker deaths draw from ``max_failures``
+    - preemptions draw from ``max_preemption_failures`` (default unlimited,
+      matching the reference: losing spot capacity shouldn't burn the
+      failure budget)
+    """
+
+    config: FailureConfig
+    counts: dict = field(default_factory=lambda: {k: 0 for k in FailureKind})
+
+    def decide(self, kind: FailureKind) -> FailureDecision:
+        self.counts[kind] += 1
+        if kind == FailureKind.PREEMPTED:
+            limit = getattr(self.config, "max_preemption_failures", -1)
+            if limit is not None and limit >= 0 and self.counts[kind] > limit:
+                return FailureDecision.RAISE
+            return FailureDecision.RETRY
+        budget_used = (self.counts[FailureKind.WORKER_DIED]
+                       + self.counts[FailureKind.USER_ERROR])
+        if budget_used > self.config.max_failures:
+            return FailureDecision.RAISE
+        return FailureDecision.RETRY

@@ -111,7 +111,11 @@ class WorkerGroup:
             raise RuntimeError(
                 f"Train placement group ({n} x {res}) could not be placed"
             )
-        actor_cls = ray_tpu.remote(**{"num_cpus": res.get("CPU", 1.0), "num_tpus": res.get("TPU", 0.0), "max_concurrency": 4})(RayTrainWorker)
+        opts = {"num_cpus": res.get("CPU", 1.0),
+                "num_tpus": res.get("TPU", 0.0), "max_concurrency": 4}
+        if getattr(self.scaling, "isolate_workers", False):
+            opts["isolate_process"] = True
+        actor_cls = ray_tpu.remote(**opts)(RayTrainWorker)
         self.workers = [
             actor_cls.options(
                 scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(
@@ -126,6 +130,34 @@ class WorkerGroup:
 
     def poll(self) -> list[dict]:
         return ray_tpu.get([w.poll.remote() for w in self.workers])
+
+    def poll_individual(self, timeout: float = 30.0) -> list[dict]:
+        """Per-worker polls with failure ISOLATION: a dead rank yields
+        {"dead": True, "death_error": exc} instead of failing the whole poll,
+        so the controller can tell worker death from user error and report
+        WHICH rank died (reference: controller polls workers individually and
+        aggregates WorkerGroupPollStatus, controller.py:706)."""
+        from ray_tpu.exceptions import GetTimeoutError
+
+        refs = [w.poll.remote() for w in self.workers]
+        out = []
+        for rank, ref in enumerate(refs):
+            try:
+                st = ray_tpu.get(ref, timeout=timeout)
+            except GetTimeoutError:
+                # Slow, not dead: a train_fn can starve the poll (GIL held in
+                # a long jax compile / checkpoint write). Report no-news and
+                # let the next tick catch up — restarting a healthy gang on a
+                # slow poll would destroy progress.
+                st = {"reports": [], "finished": False, "error": None,
+                      "result": None}
+            except BaseException as e:  # noqa: BLE001 — actor/system death
+                st = {"reports": [], "finished": True, "error": None,
+                      "result": None, "dead": True, "death_error": e}
+            st.setdefault("dead", False)
+            st["rank"] = rank
+            out.append(st)
+        return out
 
     def shutdown(self) -> None:
         for w in self.workers:

@@ -129,7 +129,28 @@ def test_dashboard_endpoints():
             "http://127.0.0.1:8267/api/profile?duration_s=0.3", method="POST")
         with urllib.request.urlopen(req, timeout=60) as r:
             prof = json.loads(r.read())
-        assert prof["num_files"] >= 1 and os.path.isdir(prof["profile_dir"])
+        assert prof["num_files"] >= 1 and prof["node"] == "head"
+        # the artifact is listed and downloadable as a zip
+        arts = get("/api/profile/artifacts")["artifacts"]
+        assert any(a["artifact_id"] == prof["artifact_id"] for a in arts)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:8267{prof['artifact_url']}", timeout=30) as r:
+            blob = r.read()
+        import io
+        import zipfile
+
+        assert zipfile.ZipFile(io.BytesIO(blob)).namelist()
+        # worker-targeted capture: pinned to a chosen node (the head node here)
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        node_hex = rt.scheduler.nodes()[0].node_id.hex()
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:8267/api/profile?duration_s=0.3&node={node_hex}",
+            method="POST")
+        with urllib.request.urlopen(req2, timeout=120) as r:
+            prof2 = json.loads(r.read())
+        assert prof2["node"] == node_hex and prof2["num_files"] >= 1
         # 404 on unknown resource
         try:
             get("/api/v0/bogus")

@@ -1,0 +1,150 @@
+"""Train controller state machine: per-worker failure classification,
+FailurePolicy budgets, scaling-policy resize between attempts, and a chaos
+test that SIGKILLs one gang member mid-run.
+
+Reference: train/v2/_internal/execution/controller/controller.py:706 (control
+loop polling workers individually), failure_handling/failure_policy.py.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.controller import ControllerState, TrainController
+from ray_tpu.train.failure_policy import (
+    FailureDecision,
+    FailureKind,
+    FailurePolicy,
+    classify_failure,
+)
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _run_cfg(tmp_path, max_failures=0):
+    return RunConfig(name="t", storage_path=str(tmp_path),
+                     failure_config=FailureConfig(max_failures=max_failures))
+
+
+def test_chaos_kill_one_gang_member_restarts_group(session, tmp_path):
+    """SIGKILL rank 1 mid-run: classified WORKER_DIED (not user error),
+    the gang restarts fresh, and the retry completes."""
+    marker = str(tmp_path / "killed_once")
+
+    def train_fn(config):
+        from ray_tpu.train.context import get_context
+
+        ctx = get_context()
+        for step in range(5):
+            if (ctx.rank == 1 and step == 2
+                    and not os.path.exists(config["marker"])):
+                open(config["marker"], "w").close()
+                os.kill(os.getpid(), 9)  # chaos: kill this gang member
+            if ctx.rank == 0:
+                ctx.report_fn({"step": step}, None)
+
+    ctl = TrainController(
+        train_fn, {"marker": marker},
+        ScalingConfig(num_workers=2, resources_per_worker={"CPU": 0.5},
+                      isolate_workers=True),
+        _run_cfg(tmp_path, max_failures=1),
+    )
+    result = ctl.run()
+    assert result.error is None, result.error
+    assert os.path.exists(marker)  # the kill really happened
+    assert ctl.failure_policy.counts[FailureKind.WORKER_DIED] == 1
+    assert ctl.failure_policy.counts[FailureKind.USER_ERROR] == 0
+    states = [s for s, _ in ctl.state_history]
+    assert "RESTARTING" in states and states[-1] == "FINISHED"
+
+
+def test_user_error_fails_fast_with_zero_budget(session, tmp_path):
+    def train_fn():
+        raise ValueError("bad hyperparameters")
+
+    ctl = TrainController(
+        train_fn, {},
+        ScalingConfig(num_workers=2, resources_per_worker={"CPU": 0.5}),
+        _run_cfg(tmp_path, max_failures=0),
+    )
+    result = ctl.run()
+    assert result.error is not None and "bad hyperparameters" in str(result.error)
+    assert ctl.state == ControllerState.ERRORED
+    assert ctl.failure_policy.counts[FailureKind.USER_ERROR] == 1
+    # exactly one attempt: zero budget means no restart
+    assert [s for s, _ in ctl.state_history].count("RESTARTING") == 0
+
+
+def test_scaling_policy_resizes_retry(session, tmp_path):
+    """Capacity lost between attempts: the scaling policy shrinks the gang
+    and the retry completes at the smaller size."""
+    sizes = []
+
+    class ShrinkOnRetry:
+        def __init__(self):
+            self.calls = 0
+
+        def workers_for_next_attempt(self):
+            self.calls += 1
+            n = 3 if self.calls == 1 else 2
+            sizes.append(n)
+            return n
+
+    def train_fn(config):
+        from ray_tpu.train.context import get_context
+
+        ctx = get_context()
+        if ctx.world_size == 3:
+            raise RuntimeError("simulated lost capacity at size 3")
+        if ctx.rank == 0:
+            ctx.report_fn({"world": ctx.world_size}, None)
+
+    ctl = TrainController(
+        train_fn, {},
+        ScalingConfig(num_workers=3, resources_per_worker={"CPU": 0.5}),
+        _run_cfg(tmp_path, max_failures=1),
+        scaling_policy=ShrinkOnRetry(),
+    )
+    result = ctl.run()
+    assert result.error is None, result.error
+    assert sizes == [3, 2]
+    assert result.metrics["world"] == 2
+
+
+@pytest.mark.fast
+def test_failure_policy_budgets():
+    pol = FailurePolicy(FailureConfig(max_failures=1))
+    assert pol.decide(FailureKind.WORKER_DIED) == FailureDecision.RETRY
+    assert pol.decide(FailureKind.USER_ERROR) == FailureDecision.RAISE  # budget spent
+    # preemptions never draw from the failure budget by default
+    pol2 = FailurePolicy(FailureConfig(max_failures=0))
+    for _ in range(5):
+        assert pol2.decide(FailureKind.PREEMPTED) == FailureDecision.RETRY
+    assert pol2.decide(FailureKind.USER_ERROR) == FailureDecision.RAISE
+    # bounded preemption budget
+    pol3 = FailurePolicy(FailureConfig(max_failures=0, max_preemption_failures=1))
+    assert pol3.decide(FailureKind.PREEMPTED) == FailureDecision.RETRY
+    assert pol3.decide(FailureKind.PREEMPTED) == FailureDecision.RAISE
+
+
+@pytest.mark.fast
+def test_classify_failure_kinds():
+    from ray_tpu.exceptions import ActorDiedError
+
+    assert classify_failure(ActorDiedError("x")) == FailureKind.WORKER_DIED
+    assert classify_failure(ConnectionError("gone")) == FailureKind.WORKER_DIED
+    assert classify_failure(ValueError("user bug")) == FailureKind.USER_ERROR
+    from ray_tpu.train.elastic import get_preemption_handler
+
+    get_preemption_handler().notify_preemption()
+    try:
+        assert classify_failure(ValueError("any")) == FailureKind.PREEMPTED
+    finally:
+        get_preemption_handler().clear()
