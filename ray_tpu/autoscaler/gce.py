@@ -228,16 +228,28 @@ class GceTpuNodeProvider(NodeProvider):
                     inst.status = InstanceStatus.TERMINATED
 
     def terminate(self, instance_ids: list[str]) -> None:
+        """Fire the deletes and return — operation completion is observed by
+        the reconcile in non_terminated_instances (a synchronous wait here
+        would stall the autoscaler's tick for up to minutes per slice)."""
         for name in instance_ids:
             try:
                 op = self.api.delete_node(name)
-                self.api.wait_operation(op, timeout_s=300)
             except Exception as e:
                 logger.warning("TPU slice %s delete failed: %s", name, e)
+                op = None
             with self._lock:
                 inst = self._instances.get(name)
                 if inst is not None:
                     inst.status = InstanceStatus.TERMINATED
+            if op is not None:
+                threading.Thread(target=self._await_delete, args=(name, op),
+                                 daemon=True).start()
+
+    def _await_delete(self, name: str, op: dict) -> None:
+        try:
+            self.api.wait_operation(op, timeout_s=300)
+        except Exception as e:
+            logger.warning("TPU slice %s delete did not complete: %s", name, e)
 
     def non_terminated_instances(self) -> list[Instance]:
         """Reconcile local intent with the cloud list: adopt foreign-created

@@ -306,6 +306,7 @@ class ClientRuntime:
                     # head pins them for the blob's lifetime (AddNestedObjectIds)
                     self._rpc().call("client_put_seal", oid=oid_bin,
                                      size=len(blob), contained=contained,
+                                     task=getattr(self, "_current_task", None),
                                      timeout=30)
                 except BaseException:
                     # head never recorded it -> plane_free will never come;
@@ -323,7 +324,9 @@ class ClientRuntime:
                 # unusable): route through the head, which spills/falls back
                 # inline — a worker put must degrade, not fail.
                 pass
-        oid_bin = self._rpc().call("client_put", blob=blob, timeout=120)
+        oid_bin = self._rpc().call(
+            "client_put", blob=blob,
+            task=getattr(self, "_current_task", None), timeout=120)
         return ObjectRef(ObjectID(oid_bin), self)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
@@ -363,6 +366,75 @@ class ClientRuntime:
             else:
                 out.append(serialization.deserialize_from_bytes(payload))
         return out
+
+    def get_async(self, ref: ObjectRef):
+        """Future-based get over the control plane: the head defers its reply
+        until the object is ready (wire deferred futures), so neither side
+        parks a thread per pending request (reference: the async GetAsync
+        path of the CoreWorker memory store, served remotely)."""
+        from concurrent.futures import Future
+
+        out: Future = Future()
+        peer = self._rpc()
+        mid, rfut = peer.call_async(
+            "client_get", oids=[ref.object_id().binary()], get_timeout=None)
+
+        def done(f):
+            # the consumer may have cancelled (asyncio.wait_for timeout):
+            # settle only a live future
+            def settle(setter, v):
+                if not out.done():
+                    try:
+                        setter(v)
+                    except Exception:
+                        pass  # lost the race with cancellation
+
+            try:
+                entries = f.result()
+            except BaseException as e:  # noqa: BLE001
+                settle(out.set_exception, e)
+                return
+            (kind, payload), = entries
+            if kind == "err":
+                settle(out.set_exception, cloudpickle.loads(payload))
+            elif kind == "val":
+                try:
+                    settle(out.set_result,
+                           serialization.deserialize_from_bytes(payload))
+                except BaseException as e:  # noqa: BLE001
+                    settle(out.set_exception, e)
+            else:
+                # shm marker: the store/pull resolution can block — bounded
+                # work on a small shared pool, not a per-request wait
+                self._async_pool().submit(self._finish_async_get, ref, out)
+
+        rfut.add_done_callback(done)
+        return out
+
+    def _finish_async_get(self, ref, out) -> None:
+        try:
+            val = self.get([ref], timeout=120)[0]
+        except BaseException as e:  # noqa: BLE001
+            if not out.done():
+                try:
+                    out.set_exception(e)
+                except Exception:
+                    pass
+            return
+        if not out.done():
+            try:
+                out.set_result(val)
+            except Exception:
+                pass  # cancelled between the check and the set
+
+    def _async_pool(self):
+        pool = getattr(self, "_async_pool_obj", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._async_pool_obj = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="async-get")
+        return pool
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         ready_bins, not_ready_bins = self._call_retrying(

@@ -315,6 +315,36 @@ class ControlPlane:
     # ---- worker/client object plane
     def _h_client_get(self, peer: RpcPeer, msg: dict):
         rt = self.runtime
+        # Single-object pending get without a blocking deadline: defer the
+        # reply via a wire Future fired by the store's ready-callback — no
+        # head thread parks per in-flight client get (the serve proxies'
+        # reactor path; reference: GetAsync + gRPC async replies).
+        if (len(msg["oids"]) == 1 and msg.get("get_timeout") is None
+                and not msg.get("task") and not msg.get("materialize")):
+            oid = ObjectID(msg["oids"][0])
+            if not rt.memory_store.contains(oid):
+                from concurrent.futures import Future
+
+                out: Future = Future()
+
+                def finish(oid=oid):
+                    if out.done():
+                        return
+                    try:
+                        out.set_result(self._client_get_entries(
+                            peer, [oid], None, False))
+                    except BaseException as e:  # noqa: BLE001
+                        if not out.done():
+                            out.set_exception(e)
+
+                def on_obj(_obj):
+                    # runs on the PUTTING thread (agent reader / pool reply):
+                    # serialization of a large value must not stall it — hand
+                    # off to the shared resolve pool
+                    rt._async_resolve_pool().submit(finish)
+
+                rt.memory_store.on_ready(oid, on_obj)
+                return out
         if msg.get("task") and any(
             not rt.memory_store.contains(ObjectID(b)) for b in msg["oids"]
         ):
@@ -322,13 +352,19 @@ class ControlPlane:
             # resources (reference: NotifyDirectCallTaskBlocked fires on
             # unready objects, not on every fetch).
             rt.release_blocked_task_resources(msg["task"])
+        return self._client_get_entries(
+            peer, [ObjectID(b) for b in msg["oids"]],
+            msg.get("get_timeout"), bool(msg.get("materialize")))
+
+    def _client_get_entries(self, peer: RpcPeer, oids, get_timeout,
+                            materialize: bool) -> list:
+        rt = self.runtime
         out = []
-        for ob in msg["oids"]:
-            oid = ObjectID(ob)
+        for oid in oids:
             ref = ObjectRef(oid, rt)
             try:
-                if not msg.get("materialize"):
-                    obj = rt.memory_store.get([oid], timeout=msg.get("get_timeout"))[0]
+                if not materialize:
+                    obj = rt.memory_store.get([oid], timeout=get_timeout)[0]
                     if obj.error is None and obj.in_shm and (
                         (rt.shm_store is not None and rt.shm_store.contains(oid))
                         or rt.has_plane_copy(oid)
@@ -337,7 +373,7 @@ class ControlPlane:
                         # or chunk-pulls from a holder (locate_object)
                         out.append(("shm", None))
                         continue
-                val = rt.get([ref], timeout=msg.get("get_timeout"))[0]
+                val = rt.get([ref], timeout=get_timeout)[0]
                 out.append(("val", serialization.serialize_to_bytes(val)))
             except BaseException as e:  # noqa: BLE001
                 out.append(("err", cloudpickle.dumps(e)))
@@ -347,6 +383,10 @@ class ControlPlane:
         value = serialization.deserialize_from_bytes(msg["blob"])
         ref = self.runtime.put(value)
         self._hold_for(peer, [ref])
+        if msg.get("task"):
+            # puts made mid-task stay pinned until the task's result (and its
+            # contained-refs report) is processed — see hold_put_for_task
+            self.runtime.hold_put_for_task(msg["task"], ref.object_id())
         return ref.object_id().binary()
 
     def _h_client_put_alloc(self, peer: RpcPeer, msg: dict):
@@ -385,6 +425,8 @@ class ControlPlane:
                 oid, [ObjectID(b) for b in msg["contained"]])
         rt.memory_store.put(oid, RayObject(size=msg["size"], in_shm=True))
         self._hold_for(peer, [ObjectRef(oid, rt)])
+        if msg.get("task"):
+            rt.hold_put_for_task(msg["task"], oid)
         return True
 
     def _h_client_wait(self, peer: RpcPeer, msg: dict):

@@ -59,12 +59,32 @@ class MemoryStore:
         self._objects: dict[ObjectID, RayObject] = {}
         self._cv = threading.Condition(self._lock)
         self._deleted: set[ObjectID] = set()
+        # ready-callbacks: async consumers (serve proxy reactor) register
+        # instead of parking a thread in get() (reference: the CoreWorker
+        # memory store's GetAsync callbacks, memory_store.h:48)
+        self._ready_cbs: dict[ObjectID, list[Callable]] = {}
 
     def put(self, object_id: ObjectID, obj: RayObject) -> None:
         with self._cv:
             self._objects[object_id] = obj
             self._deleted.discard(object_id)
+            cbs = self._ready_cbs.pop(object_id, ())
             self._cv.notify_all()
+        for cb in cbs:
+            try:
+                cb(obj)
+            except Exception:
+                pass
+
+    def on_ready(self, object_id: ObjectID, cb: Callable) -> None:
+        """Invoke cb(RayObject) when the object arrives (immediately if
+        present). Callbacks run on the putting thread — keep them short."""
+        with self._cv:
+            obj = self._objects.get(object_id)
+            if obj is None:
+                self._ready_cbs.setdefault(object_id, []).append(cb)
+                return
+        cb(obj)
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -122,11 +142,20 @@ class MemoryStore:
             return ready, not_ready
 
     def delete(self, object_ids: Iterable[ObjectID]) -> None:
+        fired = []
         with self._cv:
             for oid in object_ids:
                 self._objects.pop(oid, None)
                 self._deleted.add(oid)
+                for cb in self._ready_cbs.pop(oid, ()):
+                    # a deferred waiter must get a terminal answer, not hang
+                    fired.append((cb, oid))
             self._cv.notify_all()
+        for cb, oid in fired:
+            try:
+                cb(RayObject(error=ObjectLostError(oid.hex())))
+            except Exception:
+                pass
 
     def evict(self, object_ids: Iterable[ObjectID]) -> None:
         """Simulate loss (for lineage-reconstruction tests and memory pressure)."""

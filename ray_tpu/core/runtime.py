@@ -269,6 +269,9 @@ class Runtime:
         # store (isolated-plane agents); the head's own shm/spill holdings are
         # covered by shm_store.contains/spill.is_spilled.
         self._plane_locations: dict[ObjectID, set[NodeID]] = {}
+        # worker puts pinned until their task's result is processed (closes
+        # the ref_drop-vs-result borrow race; see hold_put_for_task)
+        self._task_put_holds: dict[bytes, list] = {}
         self._plane_addrs: dict[NodeID, str] = {}
         self.plane_server = None
         self.plane_client = None
@@ -437,6 +440,50 @@ class Runtime:
                 break
         return out
 
+    def get_async(self, ref: ObjectRef):
+        """Future-based get for reactor-style consumers (the serve proxy):
+        no thread parks while the object is pending — a ready-callback fires
+        on arrival and a small shared pool does the bounded resolve work.
+        Reference: CoreWorkerMemoryStore::GetAsync (memory_store.h:48)."""
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        oid = ref.object_id()
+
+        def on_obj(_obj):
+            if not fut.done():
+                self._async_resolve_pool().submit(self._finish_async_get,
+                                                  ref, fut)
+        self.memory_store.on_ready(oid, on_obj)
+        return fut
+
+    def _finish_async_get(self, ref: ObjectRef, fut) -> None:
+        try:
+            # object already arrived (on_ready fired): this returns without
+            # blocking except rare shm-miss recovery
+            val = self.get([ref], timeout=120)[0]
+        except BaseException as e:  # noqa: BLE001
+            if not fut.done():
+                try:
+                    fut.set_exception(e)
+                except Exception:
+                    pass  # cancelled (e.g. asyncio.wait_for timeout)
+            return
+        if not fut.done():
+            try:
+                fut.set_result(val)
+            except Exception:
+                pass
+
+    def _async_resolve_pool(self):
+        pool = getattr(self, "_async_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._async_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="async-get")
+        return pool
+
     _sentinel = object()
 
     def _resolve_obj(self, oid: ObjectID, obj: RayObject):
@@ -556,6 +603,25 @@ class Runtime:
                 self.spill.on_delete(r.object_id())
         for r in refs:
             self._free_plane_copies(r.object_id())
+
+    # ------------------------------------------------- in-flight put holds
+    def hold_put_for_task(self, task_bin: bytes, oid: ObjectID) -> None:
+        """Pin an object a WORKER client_put() while executing `task_bin`
+        until that task's result is processed. Closes the borrow race: the
+        worker's ref_drop (its own connection) can outrun the task result
+        carrying the contained-refs report (the pool pipe), and without this
+        hold the zero-fire frees the object before add_nested_refs runs.
+        Reference: borrowers keep references until the owner has recorded
+        the containment (reference_counter.cc borrowing protocol)."""
+        ref = ObjectRef(oid, self)
+        with self._lock:
+            self._task_put_holds.setdefault(task_bin, []).append(ref)
+
+    def release_task_put_holds(self, task_bin: "bytes | None") -> None:
+        if not task_bin:
+            return
+        with self._lock:
+            self._task_put_holds.pop(task_bin, None)  # ref GC drops the holds
 
     # ---------------------------------------------------- object plane
     def plane_object_added(self, oid: ObjectID, node_id: NodeID,
@@ -1025,6 +1091,7 @@ class Runtime:
             self.scheduler.release(release_node, req)
             self.scheduler.retry_pending_pgs()
         if entry.state in ("FINISHED", "FAILED", "CANCELLED"):
+            self.release_task_put_holds(entry.spec.task_id.binary())
             self.reference_counter.remove_submitted_task_refs(
                 [r.object_id() for r in _ref_args(entry.spec.args, entry.spec.kwargs)]
             )
@@ -1297,6 +1364,19 @@ class Runtime:
     def _store_worker_result(self, spec, rids, status, payload, size,
                              node_id: "NodeID | None" = None,
                              contained: "list[bytes] | None" = None) -> None:
+        try:
+            self._store_worker_result_inner(spec, rids, status, payload, size,
+                                            node_id, contained)
+        finally:
+            # Now (and only now) it is safe to let go of the objects this
+            # task client_put() mid-flight: their nested/value holds are
+            # registered above, so the producing worker's racing ref_drop
+            # can no longer zero-fire them (see hold_put_for_task).
+            self.release_task_put_holds(spec.task_id.binary())
+
+    def _store_worker_result_inner(self, spec, rids, status, payload, size,
+                                   node_id: "NodeID | None" = None,
+                                   contained: "list[bytes] | None" = None) -> None:
         # Refs serialized inside an opaque (never head-deserialized) result
         # blob: register them as nested holders of the result BEFORE the
         # result becomes visible, so they outlive the producing worker's
@@ -1472,6 +1552,7 @@ class Runtime:
         self._release_pending_returns(spec.task_id)
 
     def _store_error(self, spec: TaskSpec, err: BaseException) -> None:
+        self.release_task_put_holds(spec.task_id.binary())
         with self._lock:
             for rid in spec.return_ids():
                 self._recovering.discard(rid)
@@ -1532,6 +1613,7 @@ class Runtime:
             stream.done = True
             stream.cv.notify_all()
         self.memory_store.put(stream_id, RayObject(value=index, size=8))
+        self.release_task_put_holds(spec.task_id.binary())
 
     def _store_stream_item(self, spec: TaskSpec, stream, index: int,
                            status: str, payload, extra,
@@ -1603,6 +1685,7 @@ class Runtime:
             stream.done = True
             stream.cv.notify_all()
         self.memory_store.put(stream_id, RayObject(value=count, size=8))
+        self.release_task_put_holds(spec.task_id.binary())
 
     def next_stream_item(self, stream_id: ObjectID, index: int) -> ObjectRef | None:
         stream = self._streams.get(stream_id)
@@ -1979,6 +2062,7 @@ class Runtime:
             stream.done = True
             stream.cv.notify_all()
         self.memory_store.put(stream_id, RayObject(value=count, size=8))
+        self.release_task_put_holds(spec.task_id.binary())
 
     def _run_proc_actor_task(self, state: _ActorState, spec: TaskSpec, entry,
                              proc_worker) -> bool:
@@ -2098,6 +2182,7 @@ class Runtime:
             stream.done = True
             stream.cv.notify_all()
         self.memory_store.put(stream_id, RayObject(value=index, size=8))
+        self.release_task_put_holds(spec.task_id.binary())
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs, options: dict) -> list[ObjectRef]:
         """Reference: CoreWorker::SubmitActorTask (core_worker.cc:2386) via

@@ -31,28 +31,39 @@ def _split_by_index(block: Block, idx: np.ndarray, P: int):
 
 
 def _map_partition(block: Block, part_fn, P: int, block_idx: int):
-    """One map task per input block -> P partition slices."""
+    """One map task per input block -> P partition-slice REFS.
+
+    The slices are ray_tpu.put() from INSIDE the mapper: on an isolated-plane
+    node that seals them into the node-LOCAL store (the head records only
+    locations), and reducers pull their slices holder->consumer through the
+    object plane — the head never carries block bytes, so the exchange
+    scales past the head's memory budget (reference: hash_shuffle.py
+    emitting block refs; object_manager.cc:369 pull protocol)."""
     idx = part_fn(block, block_idx)
-    return _split_by_index(block, np.asarray(idx, dtype=np.int64), P)
+    outs = _split_by_index(block, np.asarray(idx, dtype=np.int64), P)
+    if P == 1:
+        outs = [outs]
+    return [ray_tpu.put(o) for o in outs]
 
 
 def _scatter(blocks: Iterator[Block], part_fn, P: int, map_task):
-    """MAP stage shared by exchange() and join_exchange(): one task per block,
-    one return per partition. Returns (per-partition ref lists, n_blocks,
+    """MAP stage shared by exchange() and join_exchange(): one task per block
+    returning P slice refs (tiny — the slices themselves stay in the
+    mappers' node stores). Returns (per-partition ref lists, n_blocks,
     schema of the first non-empty block)."""
     partitions: list[list] = [[] for _ in range(P)]
+    ref_lists = []
     n_blocks = 0
     schema: dict | None = None
     for b in blocks:
         if schema is None and b.num_rows() > 0:
             schema = {k: v.dtype for k, v in b.columns.items()}
-        if P == 1:
-            refs = [map_task.remote(b, part_fn, P, n_blocks)]
-        else:
-            refs = map_task.options(num_returns=P).remote(b, part_fn, P, n_blocks)
-        for i, r in enumerate(refs):
-            partitions[i].append(r)
+        ref_lists.append(map_task.remote(b, part_fn, P, n_blocks))
         n_blocks += 1
+    for r in ref_lists:
+        slice_refs = ray_tpu.get(r, timeout=600)  # P refs, metadata-sized
+        for i, pref in enumerate(slice_refs):
+            partitions[i].append(pref)
     return partitions, n_blocks, schema
 
 

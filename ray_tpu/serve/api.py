@@ -140,6 +140,25 @@ def shutdown() -> None:
         _state["routes"] = {}
 
 
+async def _await_ref(ref, timeout: float):
+    """Await an ObjectRef on the reactor: the runtime's future-based get
+    parks NO thread per in-flight request (reference: the asyncio router of
+    serve/_private/router.py:614 — replica replies resolve on the event
+    loop). Falls back to an executor get for runtimes without get_async."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    ga = getattr(rt, "get_async", None)
+    if ga is not None:
+        try:
+            return await asyncio.wait_for(asyncio.wrap_future(ga(ref)),
+                                          timeout)
+        except asyncio.TimeoutError as e:
+            raise TimeoutError(f"request timed out after {timeout}s") from e
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, lambda: ray_tpu.get(ref, timeout=timeout))
+
+
 # ------------------------------------------------------------------ HTTP proxy
 class HttpProxy:
     """aiohttp ingress: POST <route_prefix> with JSON body -> handle.remote(body).
@@ -192,11 +211,8 @@ class HttpProxy:
                     body = {**body, "stream_method": stream_method}
                     return await self._stream_response(request, handle, body)
                 ref = getattr(handle, method).remote(body)
-                loop = asyncio.get_running_loop()
                 try:
-                    result = await loop.run_in_executor(
-                        None, lambda: ray_tpu.get(ref, timeout=120)
-                    )
+                    result = await _await_ref(ref, timeout=120)
                 except Exception as e:  # noqa: BLE001
                     return web.json_response(
                         {"error": {"message": str(e)[:500], "type": type(e).__name__}},
@@ -206,9 +222,8 @@ class HttpProxy:
             if isinstance(body, dict) and body.get("stream"):
                 return await self._stream_response(request, handle, body)
             ref = handle.remote(body)
-            loop = asyncio.get_running_loop()
             try:
-                result = await loop.run_in_executor(None, lambda: ray_tpu.get(ref, timeout=60))
+                result = await _await_ref(ref, timeout=60)
             except Exception as e:  # noqa: BLE001
                 return web.json_response({"error": str(e)[:500]}, status=500)
             if isinstance(result, (dict, list, str, int, float)) or result is None:
@@ -319,7 +334,7 @@ class _ProxyActor:
     aiohttp loop."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 refresh_s: float = 1.0):
+                 refresh_s: float = 10.0):
         import ray_tpu as _ray
 
         self._controller = _ray.get_actor(CONTROLLER_NAME)
@@ -327,12 +342,39 @@ class _ProxyActor:
         self._refresh_s = refresh_s
         self._stop = threading.Event()
         self._sync()  # serve correctly from the first request
+        # Long-poll equivalent: the controller PUSHES route-table updates
+        # over pubsub (reference: long_poll.py:318 LongPollHost); the
+        # periodic sync is only a slow self-heal fallback now.
+        self._sub = None
+        try:
+            from ray_tpu.experimental import pubsub
+
+            self._sub = pubsub.subscribe("serve:routes")
+            threading.Thread(target=self._push_loop, daemon=True,
+                             name="proxy-route-push").start()
+        except Exception:
+            pass
         threading.Thread(target=self._sync_loop, daemon=True,
                          name="proxy-route-sync").start()
         self._proxy = HttpProxy(host, port, route_lookup=self._lookup)
 
+    def _push_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                routes = self._sub.poll(timeout=1.0)
+            except Exception:
+                continue
+            if routes is None:
+                continue
+            try:
+                self._apply_routes(routes)
+            except Exception:
+                pass
+
     def _sync(self) -> None:
-        routes = ray_tpu.get(self._controller.get_routes.remote())
+        self._apply_routes(ray_tpu.get(self._controller.get_routes.remote()))
+
+    def _apply_routes(self, routes: dict) -> None:
         # Reuse existing handles: DeploymentHandle construction is expensive
         # (controller RPC + a router watcher thread that lives as long as the
         # handle) — rebuilding per refresh would leak a thread per route per
@@ -370,6 +412,11 @@ class _ProxyActor:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._sub is not None:
+            try:
+                self._sub.close()
+            except Exception:
+                pass
         self._proxy.stop()
 
 

@@ -231,6 +231,19 @@ class ServeController:
                 pass
         self._checkpoint()
         self._reconcile_once()
+        self._publish_routes()
+
+    def _publish_routes(self) -> None:
+        """Push the route table to subscribed proxies (reference: the
+        controller's LongPollHost broadcasting route/replica updates,
+        serve/_private/long_poll.py:318 — here a pubsub push over the
+        control plane instead of a hanging GET)."""
+        try:
+            from ray_tpu.experimental import pubsub
+
+            pubsub.publish("serve:routes", self.get_routes())
+        except Exception:
+            pass  # proxies fall back to their slow reconcile poll
 
     def get_routes(self) -> dict[str, str]:
         with self._lock:
@@ -240,6 +253,7 @@ class ServeController:
         with self._lock:
             st = self._deployments.pop(name, None)
             self._routes = {p: n for p, n in self._routes.items() if n != name}
+        self._publish_routes()
         if st:
             for r in st.replicas:
                 try:

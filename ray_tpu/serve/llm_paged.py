@@ -40,6 +40,12 @@ from ray_tpu.serve.paged_kv import BlockPool, NoFreeBlocks
 class PagedLLMConfig(LLMConfig):
     block_size: int = 16
     num_blocks: int = 0  # 0 = dense-parity capacity (B * Smax / block_size)
+    # PD handoff transport: "host" ships KV as numpy in the handoff dict;
+    # "device" keeps KV device-resident and ships only a transfer TICKET —
+    # the decode engine pulls the pages device->device over the jax transfer
+    # server (experimental/rdt.py offer_device/pull_device; reference:
+    # rdt/nixl_tensor_transport.py)
+    kv_transfer: str = "host"
 
 
 class PagedLLMEngine(LLMEngine):
@@ -311,14 +317,28 @@ class PagedLLMEngine(LLMEngine):
             )
             first_tok = self._sample(np.asarray(logits)[len(prompt_ids) - 1])
             idx = np.asarray(block_ids, dtype=np.int32)
-            kv = {
-                "k": np.asarray(self.pool["k"][:, :, idx]),  # [L, H, n, bs, D]
-                "v": np.asarray(self.pool["v"][:, :, idx]),
-            }
+            if self.config.kv_transfer == "device":
+                # the gather creates independent device arrays (pool blocks
+                # free below); only a tiny ticket crosses the control plane —
+                # the decode side pulls the pages device->device
+                from ray_tpu.experimental import rdt
+
+                kv_ticket = rdt.offer_device(
+                    {"k": self.pool["k"][:, :, idx],
+                     "v": self.pool["v"][:, :, idx]})
+                kv = None
+            else:
+                kv_ticket = None
+                kv = {
+                    "k": np.asarray(self.pool["k"][:, :, idx]),  # [L, H, n, bs, D]
+                    "v": np.asarray(self.pool["v"][:, :, idx]),
+                }
         finally:
             self.allocator.free(block_ids)
         return {
             "kv": kv,
+            "kv_ticket": kv_ticket,
+            "n_prefill_blocks": len(block_ids),
             "first_token": first_tok,
             "prompt_len": len(prompt_ids),
             # lets draft-model engines (spec decode) rebuild their own KV
@@ -347,15 +367,32 @@ class PagedLLMEngine(LLMEngine):
             # decode side saturated: requeue the op for a later pass
             self._ops.put(("attach", payload, fut))
             return None
-        n_prefill_blocks = handoff["kv"]["k"].shape[2]
+        kv = handoff.get("kv")
+        if kv is None and handoff.get("kv_ticket") is not None:
+            # device path: pull the pages straight into THIS process's
+            # device memory over the transfer connection (no host pickle).
+            # NOTE the validations above run BEFORE the pull so a rejected
+            # handoff never consumes the one-shot ticket... but an
+            # early-raise DOES strand the producer-side pin (offer_device
+            # has no cancel — see rdt.offer_device); keep validation errors
+            # rare by validating prompt_len/max_new at submission time.
+            from ray_tpu.experimental import rdt
+
+            kv = rdt.pull_device(handoff["kv_ticket"])
+            expect = handoff.get("n_prefill_blocks")
+            if expect is not None and kv["k"].shape[2] != expect:
+                raise ValueError(
+                    f"KV ticket shape mismatch: pulled {kv['k'].shape[2]} "
+                    f"blocks, handoff says {expect}")
+        n_prefill_blocks = kv["k"].shape[2]
         total_blocks = -(-(prompt_len + max_new_tokens) // bs)
         block_ids = self.allocator.alloc(total_blocks)
         try:
             idx = np.asarray(block_ids[:n_prefill_blocks], dtype=np.int32)
             self.pool["k"] = self.pool["k"].at[:, :, idx].set(
-                jnp.asarray(handoff["kv"]["k"]))
+                jnp.asarray(kv["k"]))
             self.pool["v"] = self.pool["v"].at[:, :, idx].set(
-                jnp.asarray(handoff["kv"]["v"]))
+                jnp.asarray(kv["v"]))
             with self._lock:
                 st = _Slot(fut, max_new_tokens, prompt_len, time.monotonic())
                 st.generated.append(handoff["first_token"])

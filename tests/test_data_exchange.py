@@ -131,3 +131,66 @@ def test_join_left_with_disjoint_right_schema_complete():
     # and the joined dataset survives a downstream exchange (sort)
     srows = left.join(right, on="k", how="left").sort("k").take_all()
     assert [r["k"] for r in srows] == list(range(10))
+
+
+def test_distributed_exchange_through_object_plane():
+    """Verdict r4 item 5: shuffle data moves agent->agent through the object
+    plane — slices live in node-LOCAL stores (pulls by location, not via the
+    head) and the total exchanged volume exceeds the head's store budget.
+    Reference: hash_shuffle.py block-ref emission + object_manager.cc:369."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.data.block import Block
+    from ray_tpu.data.exchange import exchange, hash_partitioner
+
+    ray_tpu.shutdown()
+    # head store far smaller than the exchanged volume: if block bytes
+    # transited/parked in the head segment, this run could not complete
+    ray_tpu.init(num_cpus=0.5,
+                 _system_config={"object_store_memory": 16 * 1024 * 1024})
+    cluster = Cluster()
+    try:
+        for _ in range(2):
+            cluster.add_node(num_cpus=2, real_process=True,
+                             isolated_plane=True)
+        rt = get_runtime()
+
+        # 16 x ~4MB blocks = 64MB through a 16MB head store. Head has 0.5
+        # CPU and tasks need 1: every map/reduce runs on the agents.
+        n_blocks, rows_per = 16, 500_000
+        blocks = [
+            Block({"k": np.arange(rows_per, dtype=np.int64) % 8,
+                   "v": np.full(rows_per, i, dtype=np.int64)})
+            for i in range(n_blocks)
+        ]
+        from ray_tpu.data.exchange import _map_partition, _reduce_partition
+
+        map_task = ray_tpu.remote(name="data::exchange_map")(_map_partition)
+        reduce_task = ray_tpu.remote(name="data::exchange_reduce")(_reduce_partition)
+        from ray_tpu.data.exchange import _scatter
+
+        partitions, n, _schema = _scatter(iter(blocks),
+                                          hash_partitioner("k", 4), 4, map_task)
+        assert n == n_blocks
+        # the ~1MB slices were sealed into the AGENTS' node-local stores:
+        # the head's plane directory must list them (pull-by-location), and
+        # they must live on BOTH agent nodes
+        slice_oids = {r.object_id() for parts in partitions for r in parts}
+        located = {oid for oid in slice_oids if rt._plane_locations.get(oid)}
+        assert len(located) >= len(slice_oids) // 2, (
+            f"only {len(located)}/{len(slice_oids)} slices plane-resident")
+        holder_nodes = {nid for oid in located
+                        for nid in rt._plane_locations[oid]}
+        assert len(holder_nodes) >= 2, "slices did not spread over both agents"
+
+        out = []
+        for parts in partitions:
+            out.append(ray_tpu.get(
+                reduce_task.remote(lambda bs: Block.concat(bs), *parts),
+                timeout=300))
+        total = sum(b.num_rows() for b in out)
+        assert total == n_blocks * rows_per
+    finally:
+        cluster.shutdown()
+        ray_tpu.shutdown()
