@@ -1,0 +1,48 @@
+"""Shared future plumbing for the async-get paths (Runtime.get_async and
+ClientRuntime.get_async): settle-if-live semantics and the small bounded
+resolve pool, in one place so a fix lands on both runtimes."""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable
+
+_pool_lock = threading.Lock()
+
+
+def settle(fut: Future, setter: Callable, value) -> None:
+    """Complete `fut` unless the consumer already cancelled it (e.g.
+    asyncio.wait_for timed out and cancelled the wrapped future) — the
+    check+set race resolves to a silent no-op, never InvalidStateError."""
+    if fut.done():
+        return
+    try:
+        setter(value)
+    except Exception:
+        pass  # lost the race with cancellation
+
+
+def finish_get(runtime, ref, fut: Future, timeout: float = 120.0) -> None:
+    """Resolve-and-settle: the bounded tail of an async get, run on the
+    resolve pool once the object is known to exist."""
+    try:
+        val = runtime.get([ref], timeout=timeout)[0]
+    except BaseException as e:  # noqa: BLE001
+        settle(fut, fut.set_exception, e)
+        return
+    settle(fut, fut.set_result, val)
+
+
+def resolve_pool(owner) -> ThreadPoolExecutor:
+    """A lazily-created 4-thread pool attached to `owner` — bounded resolve
+    work (deserialize / plane pull), never per-request blocking waits."""
+    pool = getattr(owner, "_shared_resolve_pool", None)
+    if pool is None:
+        with _pool_lock:
+            pool = getattr(owner, "_shared_resolve_pool", None)
+            if pool is None:
+                pool = ThreadPoolExecutor(max_workers=4,
+                                          thread_name_prefix="async-get")
+                owner._shared_resolve_pool = pool
+    return pool

@@ -379,62 +379,32 @@ class ClientRuntime:
         mid, rfut = peer.call_async(
             "client_get", oids=[ref.object_id().binary()], get_timeout=None)
 
+        from ray_tpu._private import futures as _futs
+
         def done(f):
             # the consumer may have cancelled (asyncio.wait_for timeout):
             # settle only a live future
-            def settle(setter, v):
-                if not out.done():
-                    try:
-                        setter(v)
-                    except Exception:
-                        pass  # lost the race with cancellation
-
             try:
                 entries = f.result()
             except BaseException as e:  # noqa: BLE001
-                settle(out.set_exception, e)
+                _futs.settle(out, out.set_exception, e)
                 return
             (kind, payload), = entries
             if kind == "err":
-                settle(out.set_exception, cloudpickle.loads(payload))
+                _futs.settle(out, out.set_exception, cloudpickle.loads(payload))
             elif kind == "val":
                 try:
-                    settle(out.set_result,
-                           serialization.deserialize_from_bytes(payload))
+                    _futs.settle(out, out.set_result,
+                                 serialization.deserialize_from_bytes(payload))
                 except BaseException as e:  # noqa: BLE001
-                    settle(out.set_exception, e)
+                    _futs.settle(out, out.set_exception, e)
             else:
                 # shm marker: the store/pull resolution can block — bounded
                 # work on a small shared pool, not a per-request wait
-                self._async_pool().submit(self._finish_async_get, ref, out)
+                _futs.resolve_pool(self).submit(_futs.finish_get, self, ref, out)
 
         rfut.add_done_callback(done)
         return out
-
-    def _finish_async_get(self, ref, out) -> None:
-        try:
-            val = self.get([ref], timeout=120)[0]
-        except BaseException as e:  # noqa: BLE001
-            if not out.done():
-                try:
-                    out.set_exception(e)
-                except Exception:
-                    pass
-            return
-        if not out.done():
-            try:
-                out.set_result(val)
-            except Exception:
-                pass  # cancelled between the check and the set
-
-    def _async_pool(self):
-        pool = getattr(self, "_async_pool_obj", None)
-        if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            pool = self._async_pool_obj = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="async-get")
-        return pool
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         ready_bins, not_ready_bins = self._call_retrying(

@@ -78,12 +78,17 @@ class MemoryStore:
 
     def on_ready(self, object_id: ObjectID, cb: Callable) -> None:
         """Invoke cb(RayObject) when the object arrives (immediately if
-        present). Callbacks run on the putting thread — keep them short."""
+        present; immediately with an ObjectLostError payload if it was
+        already deleted — a waiter must never hang on a lost object).
+        Callbacks run on the putting thread — keep them short."""
         with self._cv:
             obj = self._objects.get(object_id)
             if obj is None:
-                self._ready_cbs.setdefault(object_id, []).append(cb)
-                return
+                if object_id in self._deleted:
+                    obj = RayObject(error=ObjectLostError(object_id.hex()))
+                else:
+                    self._ready_cbs.setdefault(object_id, []).append(cb)
+                    return
         cb(obj)
 
     def contains(self, object_id: ObjectID) -> bool:

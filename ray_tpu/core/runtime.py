@@ -450,39 +450,18 @@ class Runtime:
         fut: Future = Future()
         oid = ref.object_id()
 
+        from ray_tpu._private import futures as _futs
+
         def on_obj(_obj):
             if not fut.done():
-                self._async_resolve_pool().submit(self._finish_async_get,
-                                                  ref, fut)
+                _futs.resolve_pool(self).submit(_futs.finish_get, self, ref, fut)
         self.memory_store.on_ready(oid, on_obj)
         return fut
 
-    def _finish_async_get(self, ref: ObjectRef, fut) -> None:
-        try:
-            # object already arrived (on_ready fired): this returns without
-            # blocking except rare shm-miss recovery
-            val = self.get([ref], timeout=120)[0]
-        except BaseException as e:  # noqa: BLE001
-            if not fut.done():
-                try:
-                    fut.set_exception(e)
-                except Exception:
-                    pass  # cancelled (e.g. asyncio.wait_for timeout)
-            return
-        if not fut.done():
-            try:
-                fut.set_result(val)
-            except Exception:
-                pass
-
     def _async_resolve_pool(self):
-        pool = getattr(self, "_async_pool", None)
-        if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        from ray_tpu._private import futures as _futs
 
-            pool = self._async_pool = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="async-get")
-        return pool
+        return _futs.resolve_pool(self)
 
     _sentinel = object()
 

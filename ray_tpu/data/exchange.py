@@ -60,10 +60,15 @@ def _scatter(blocks: Iterator[Block], part_fn, P: int, map_task):
             schema = {k: v.dtype for k, v in b.columns.items()}
         ref_lists.append(map_task.remote(b, part_fn, P, n_blocks))
         n_blocks += 1
-    for r in ref_lists:
-        slice_refs = ray_tpu.get(r, timeout=600)  # P refs, metadata-sized
-        for i, pref in enumerate(slice_refs):
-            partitions[i].append(pref)
+    # harvest in COMPLETION order (a slow mapper doesn't head-of-line block
+    # collecting the fast ones' metadata)
+    pending = list(ref_lists)
+    while pending:
+        ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=600)
+        for r in ready:
+            slice_refs = ray_tpu.get(r, timeout=600)  # P refs, metadata-sized
+            for i, pref in enumerate(slice_refs):
+                partitions[i].append(pref)
     return partitions, n_blocks, schema
 
 

@@ -31,6 +31,8 @@ from ray_tpu.rllib.sac_continuous import (
 from ray_tpu.rllib.tqc import TQC, TQCConfig
 from ray_tpu.rllib.iql import IQL, IQLConfig
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner
+from ray_tpu.rllib.dreamerv3 import DreamerV3, DreamerV3Config
+from ray_tpu.rllib.learner_group import LearnerGroup
 from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rllib.multi_agent import (
     MultiAgentEnv,
@@ -52,6 +54,7 @@ from ray_tpu.rllib.offline import (
 __all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
            "ReplayBuffer", "SAC", "SACConfig", "SACLearner",
            "IMPALA", "IMPALAConfig", "IMPALALearner",
+           "DreamerV3", "DreamerV3Config", "LearnerGroup",
            "APPO", "APPOConfig", "APPOLearner",
            "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
            "load_offline_data", "write_offline_json",

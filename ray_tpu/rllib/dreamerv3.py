@@ -1,0 +1,412 @@
+"""DreamerV3: model-based RL — RSSM world model + imagination actor-critic.
+
+Parity: rllib/algorithms/dreamerv3/ (DreamerV3Config, the RSSM world model of
+utils/summaries + torch/dreamerv3_torch_model, and the imagined-rollout
+actor/critic losses). Re-designed jax-first: the RSSM unrolls under
+``lax.scan`` (one XLA program for the whole sequence — no per-step Python),
+categorical latents use straight-through gradients, and the three optimizers
+(world model / actor / critic) are independent optax chains, matching the
+reference's training split.
+
+Kept small and dependency-free on purpose (vector observations; symlog
+targets; KL balancing with free bits; lambda-returns over imagined
+trajectories) — the algorithmic shape of DreamerV3 at unit-test scale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+from ray_tpu.rllib.env_runner import Episode  # noqa: F401 (public surface)
+
+
+@dataclasses.dataclass
+class DreamerV3Config:
+    """Reference: dreamerv3/dreamerv3.py DreamerV3Config."""
+
+    env: str | Callable = "CartPole-v1"
+    seed: int = 0
+    # world model
+    deter_dim: int = 64          # GRU / deterministic state
+    stoch_classes: int = 8       # categorical latent: classes per group
+    stoch_groups: int = 4        # ... and groups
+    hidden: int = 64
+    free_bits: float = 1.0
+    kl_dyn_scale: float = 0.5
+    kl_rep_scale: float = 0.1
+    wm_lr: float = 3e-4
+    # actor critic (imagination)
+    horizon: int = 8
+    gamma: float = 0.985
+    lambda_: float = 0.95
+    entropy_coeff: float = 3e-3
+    actor_lr: float = 1e-4
+    critic_lr: float = 1e-4
+    # replay / batching
+    batch_size: int = 8
+    batch_length: int = 16
+    buffer_capacity: int = 200   # episodes
+    collect_episodes: int = 4
+    max_episode_len: int = 200
+
+    def environment(self, env) -> "DreamerV3Config":
+        self.env = env
+        return self
+
+    def training(self, **kw) -> "DreamerV3Config":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown training option {k}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DreamerV3":
+        return DreamerV3(self)
+
+
+def _symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def _symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def _linear(key, m, n, scale=1.0):
+    import jax
+
+    return {"w": jax.random.normal(key, (m, n)) * np.sqrt(scale / m),
+            "b": np.zeros(n) * 0.0}
+
+
+def _mlp(key, sizes):
+    import jax
+
+    keys = jax.random.split(key, len(sizes) - 1)
+    return [_linear(k, m, n, 2.0) for k, m, n in
+            zip(keys, sizes[:-1], sizes[1:])]
+
+
+def _apply_mlp(params, x, jnp, act=True):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if act and i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class DreamerV3:
+    """The Algorithm (reference: dreamerv3.py training_step): collect with
+    the filtering policy, train the world model on replayed sequences, train
+    actor+critic on imagined rollouts from posterior states."""
+
+    def __init__(self, cfg: DreamerV3Config):
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        self._env_creator = (cfg.env if callable(cfg.env)
+                             else (lambda: gym.make(cfg.env)))
+        probe = self._env_creator()
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        self.num_actions = int(probe.action_space.n)
+        probe.close()
+
+        Z = cfg.stoch_classes * cfg.stoch_groups
+        D, H, A = cfg.deter_dim, cfg.hidden, self.num_actions
+        key = jax.random.PRNGKey(cfg.seed)
+        ks = jax.random.split(key, 12)
+        self.wm = {
+            "enc": _mlp(ks[0], (self.obs_dim, H, H)),
+            # GRU over [z, a] with hidden D (fused gates)
+            "gru_x": _linear(ks[1], Z + A, 3 * D),
+            "gru_h": _linear(ks[2], D, 3 * D),
+            "prior": _mlp(ks[3], (D, H, Z)),
+            "post": _mlp(ks[4], (D + H, H, Z)),
+            "dec": _mlp(ks[5], (D + Z, H, self.obs_dim)),
+            "rew": _mlp(ks[6], (D + Z, H, 1)),
+            "cont": _mlp(ks[7], (D + Z, H, 1)),
+        }
+        self.actor = _mlp(ks[8], (D + Z, H, A))
+        self.critic = _mlp(ks[9], (D + Z, H, 1))
+        self.wm_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                  optax.adam(cfg.wm_lr))
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.wm_state = self.wm_opt.init(self.wm)
+        self.actor_state = self.actor_opt.init(self.actor)
+        self.critic_state = self.critic_opt.init(self.critic)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self._buffer: list[dict] = []  # episodes of {obs, actions, rewards, dones}
+        self._iteration = 0
+        self._build_programs(jax, jnp)
+
+    # ------------------------------------------------------------ programs
+    def _build_programs(self, jax, jnp):
+        cfg = self.cfg
+        Z = cfg.stoch_classes * cfg.stoch_groups
+        G, C = cfg.stoch_groups, cfg.stoch_classes
+        A = self.num_actions
+
+        def gru(wm, h, x):
+            gates = x @ wm["gru_x"]["w"] + wm["gru_x"]["b"] \
+                + h @ wm["gru_h"]["w"] + wm["gru_h"]["b"]
+            r, u, c = jnp.split(gates, 3, axis=-1)
+            r, u = jax.nn.sigmoid(r), jax.nn.sigmoid(u)
+            cand = jnp.tanh(r * c)
+            return u * h + (1 - u) * cand
+
+        def sample_st(logits, key):
+            """Straight-through categorical sample per latent group."""
+            lg = logits.reshape(logits.shape[:-1] + (G, C))
+            idx = jax.random.categorical(key, lg, axis=-1)
+            onehot = jax.nn.one_hot(idx, C)
+            probs = jax.nn.softmax(lg, axis=-1)
+            st = onehot + probs - jax.lax.stop_gradient(probs)
+            return st.reshape(st.shape[:-2] + (Z,))
+
+        def kl_cat(lhs_logits, rhs_logits):
+            """KL(lhs || rhs) summed over groups, free-bits clipped."""
+            lp = jax.nn.log_softmax(lhs_logits.reshape(
+                lhs_logits.shape[:-1] + (G, C)), axis=-1)
+            rp = jax.nn.log_softmax(rhs_logits.reshape(
+                rhs_logits.shape[:-1] + (G, C)), axis=-1)
+            kl = (jnp.exp(lp) * (lp - rp)).sum(-1).sum(-1)
+            return jnp.maximum(kl, cfg.free_bits)
+
+        def observe(wm, obs_seq, act_seq, key):
+            """Filter a [B, T, ...] batch through the RSSM (posterior)."""
+            B = obs_seq.shape[0]
+            embed = _apply_mlp(wm["enc"], obs_seq, jnp)  # [B,T,H]
+            h0 = jnp.zeros((B, cfg.deter_dim))
+            z0 = jnp.zeros((B, Z))
+            keys = jax.random.split(key, obs_seq.shape[1])
+
+            def step(carry, inp):
+                h, z = carry
+                emb_t, act_t, k = inp
+                h = gru(wm, h, jnp.concatenate([z, act_t], -1))
+                prior_logits = _apply_mlp(wm["prior"], h, jnp)
+                post_logits = _apply_mlp(
+                    wm["post"], jnp.concatenate([h, emb_t], -1), jnp)
+                z = sample_st(post_logits, k)
+                return (h, z), (h, z, prior_logits, post_logits)
+
+            (_, _), (hs, zs, priors, posts) = jax.lax.scan(
+                step, (h0, z0),
+                (embed.swapaxes(0, 1), act_seq.swapaxes(0, 1), keys))
+            # back to [B, T, ...]
+            return (hs.swapaxes(0, 1), zs.swapaxes(0, 1),
+                    priors.swapaxes(0, 1), posts.swapaxes(0, 1))
+
+        def wm_loss(wm, batch, key):
+            obs, acts = batch["obs"], batch["actions"]
+            # PREVIOUS action drives the transition into step t (matches the
+            # collector: h_{t+1} = gru(h_t, [z_t, a_t]) with a_t sampled
+            # AFTER observing o_t); without the shift the filter would
+            # condition step t's posterior on the action taken at t — a
+            # temporal leak the imagination rollout can't reproduce.
+            prev_acts = jnp.concatenate(
+                [jnp.zeros_like(acts[:, :1]), acts[:, :-1]], axis=1)
+            hs, zs, priors, posts = observe(wm, obs, prev_acts, key)
+            feat = jnp.concatenate([hs, zs], -1)
+            recon = _apply_mlp(wm["dec"], feat, jnp)
+            rew_hat = _apply_mlp(wm["rew"], feat, jnp)[..., 0]
+            cont_hat = _apply_mlp(wm["cont"], feat, jnp)[..., 0]
+            recon_l = ((recon - _symlog(obs)) ** 2).sum(-1).mean()
+            rew_l = ((rew_hat - _symlog(batch["rewards"])) ** 2).mean()
+            cont_t = 1.0 - batch["dones"]
+            cont_l = optax_sigmoid_bce(cont_hat, cont_t).mean()
+            dyn = kl_cat(jax.lax.stop_gradient(posts), priors).mean()
+            rep = kl_cat(posts, jax.lax.stop_gradient(priors)).mean()
+            loss = (recon_l + rew_l + cont_l
+                    + cfg.kl_dyn_scale * dyn + cfg.kl_rep_scale * rep)
+            return loss, {"wm_loss": loss, "recon": recon_l, "reward": rew_l,
+                          "continue": cont_l, "kl_dyn": dyn, "kl_rep": rep,
+                          "hs": hs, "zs": zs}
+
+        import optax
+
+        def optax_sigmoid_bce(logits, labels):
+            return optax.sigmoid_binary_cross_entropy(logits, labels)
+
+        def imagine(wm, actor, h, z, key):
+            """Roll the PRIOR forward under the actor for `horizon` steps."""
+            keys = jax.random.split(key, cfg.horizon)
+
+            def step(carry, k):
+                h, z = carry
+                feat = jnp.concatenate([h, z], -1)
+                ka, kz = jax.random.split(k)
+                logits = _apply_mlp(actor, feat, jnp)
+                a = jax.nn.one_hot(
+                    jax.random.categorical(ka, logits, axis=-1), A)
+                h = gru(wm, h, jnp.concatenate([z, a], -1))
+                z = sample_st(_apply_mlp(wm["prior"], h, jnp), kz)
+                logp = jax.nn.log_softmax(logits)
+                ent = -(jnp.exp(logp) * logp).sum(-1)
+                return (h, z), (h, z, ent)
+
+            (_, _), (hs, zs, ents) = jax.lax.scan(step, (h, z), keys)
+            return hs, zs, ents  # [T, N, ...]
+
+        def lambda_returns(rews, conts, values):
+            def step(nxt, inp):
+                r, c, v_next = inp
+                ret = r + cfg.gamma * c * (
+                    (1 - cfg.lambda_) * v_next + cfg.lambda_ * nxt)
+                return ret, ret
+
+            last = values[-1]
+            _, rets = jax.lax.scan(
+                step, last, (rews[:-1], conts[:-1], values[1:]), reverse=True)
+            return rets  # [T-1, N]
+
+        def ac_loss(actor, critic, wm, starts_h, starts_z, key):
+            hs, zs, ents = imagine(wm, actor, starts_h, starts_z, key)
+            feat = jnp.concatenate([hs, zs], -1)
+            sg_feat = jax.lax.stop_gradient(feat)
+            rews = _symexp(_apply_mlp(wm["rew"], feat, jnp)[..., 0])
+            conts = jax.nn.sigmoid(_apply_mlp(wm["cont"], feat, jnp)[..., 0])
+            values = _apply_mlp(critic, sg_feat, jnp)[..., 0]
+            rets = lambda_returns(rews, conts, values)
+            # actor: maximize imagined lambda-returns (dynamics backprop
+            # through the straight-through latents) + entropy
+            actor_l = -(rets.mean() + cfg.entropy_coeff * ents.mean())
+            # critic: regress on stop-gradient returns
+            critic_l = ((values[:-1] - jax.lax.stop_gradient(rets)) ** 2).mean()
+            return actor_l, critic_l, rets
+
+        def ac_update(actor, critic, a_state, c_state, wm, sh, sz, key):
+            def a_fn(a):
+                al, _, rets = ac_loss(a, critic, wm, sh, sz, key)
+                return al, rets
+
+            (al, rets), a_grads = jax.value_and_grad(a_fn, has_aux=True)(actor)
+
+            def c_fn(c):
+                _, cl, _ = ac_loss(actor, c, wm, sh, sz, key)
+                return cl
+
+            cl, c_grads = jax.value_and_grad(c_fn)(critic)
+            au, a_state = self.actor_opt.update(a_grads, a_state, actor)
+            cu, c_state = self.critic_opt.update(c_grads, c_state, critic)
+            import optax as _ox
+
+            return (_ox.apply_updates(actor, au),
+                    _ox.apply_updates(critic, cu),
+                    a_state, c_state,
+                    {"actor_loss": al, "critic_loss": cl,
+                     "imagined_return": rets.mean()})
+
+        def wm_update(wm, state, batch, key):
+            (loss, aux), grads = jax.value_and_grad(wm_loss, has_aux=True)(
+                wm, batch, key)
+            updates, state = self.wm_opt.update(grads, state, wm)
+            import optax as _ox
+
+            return _ox.apply_updates(wm, updates), state, aux
+
+        self._wm_update = jax.jit(wm_update)
+        self._ac_update = jax.jit(ac_update)
+
+        def policy_step(wm, actor, h, z, obs, key):
+            """One filtering + acting step for the collector."""
+            emb = _apply_mlp(wm["enc"], obs, jnp)
+            post = _apply_mlp(wm["post"], jnp.concatenate([h, emb], -1), jnp)
+            kz, ka = jax.random.split(key)
+            z = sample_st(post, kz)
+            logits = _apply_mlp(actor, jnp.concatenate([h, z], -1), jnp)
+            a = jax.random.categorical(ka, logits, axis=-1)
+            h_next = gru(wm, h, jnp.concatenate(
+                [z, jax.nn.one_hot(a, A)], -1))
+            return h_next, z, a
+
+        self._policy_step = jax.jit(policy_step)
+
+    # ------------------------------------------------------------ data
+    def _collect(self) -> float:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        total = 0.0
+        for _ in range(cfg.collect_episodes):
+            env = self._env_creator()
+            obs, _ = env.reset(seed=int(self._np_rng.integers(1 << 30)))
+            h = jnp.zeros((1, cfg.deter_dim))
+            z = jnp.zeros((1, cfg.stoch_classes * cfg.stoch_groups))
+            ep = {"obs": [], "actions": [], "rewards": [], "dones": []}
+            for _t in range(cfg.max_episode_len):
+                self._rng, k = jax.random.split(self._rng)
+                o = jnp.asarray(np.asarray(obs, np.float32))[None]
+                h, z, a = self._policy_step(self.wm, self.actor, h, z, o, k)
+                act = int(a[0])
+                nxt, rew, term, trunc, _ = env.step(act)
+                ep["obs"].append(np.asarray(obs, np.float32))
+                ep["actions"].append(act)
+                ep["rewards"].append(float(rew))
+                ep["dones"].append(bool(term))
+                total += float(rew)
+                obs = nxt
+                if term or trunc:
+                    break
+            env.close()
+            self._buffer.append({k2: np.asarray(v) for k2, v in ep.items()})
+            if len(self._buffer) > cfg.buffer_capacity:
+                self._buffer.pop(0)
+        return total / cfg.collect_episodes
+
+    def _sample_batch(self) -> dict:
+        cfg = self.cfg
+        B, T = cfg.batch_size, cfg.batch_length
+        obs = np.zeros((B, T, self.obs_dim), np.float32)
+        acts = np.zeros((B, T, self.num_actions), np.float32)
+        rews = np.zeros((B, T), np.float32)
+        dones = np.zeros((B, T), np.float32)
+        for b in range(B):
+            ep = self._buffer[self._np_rng.integers(len(self._buffer))]
+            L = len(ep["rewards"])
+            lo = self._np_rng.integers(max(1, L - T + 1))
+            sl = slice(lo, lo + T)
+            n = len(ep["rewards"][sl])
+            obs[b, :n] = ep["obs"][sl]
+            onehot = np.eye(self.num_actions, dtype=np.float32)[ep["actions"][sl]]
+            acts[b, :n] = onehot
+            rews[b, :n] = ep["rewards"][sl]
+            dones[b, :n] = ep["dones"][sl]
+        return {"obs": obs, "actions": acts, "rewards": rews, "dones": dones}
+
+    # ------------------------------------------------------------ train
+    def train(self) -> dict:
+        import jax
+
+        mean_reward = self._collect()
+        batch = self._sample_batch()
+        self._rng, k1, k2 = jax.random.split(self._rng, 3)
+        self.wm, self.wm_state, aux = self._wm_update(
+            self.wm, self.wm_state, batch, k1)
+        # imagination starts: flatten the posterior states
+        hs, zs = aux.pop("hs"), aux.pop("zs")
+        sh = hs.reshape(-1, hs.shape[-1])
+        sz = zs.reshape(-1, zs.shape[-1])
+        (self.actor, self.critic, self.actor_state, self.critic_state,
+         ac_metrics) = self._ac_update(
+            self.actor, self.critic, self.actor_state, self.critic_state,
+            self.wm, sh, sz, k2)
+        self._iteration += 1
+        out = {k: float(v) for k, v in {**aux, **ac_metrics}.items()}
+        out["episode_reward_mean"] = mean_reward
+        out["training_iteration"] = self._iteration
+        out["buffer_episodes"] = len(self._buffer)
+        return out

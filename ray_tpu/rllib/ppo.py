@@ -35,6 +35,9 @@ class PPOConfig:
     minibatch_size: int = 128
     hidden: tuple = (64, 64)
     seed: int = 0
+    # >1: data-parallel learner workers with synchronous gradient averaging
+    # (reference: core/learner/learner_group.py:100 LearnerGroup)
+    num_learners: int = 1
     # connector pipeline factories (reference: rllib/connectors) — each env
     # runner builds its own stateful instances
     env_to_module: Callable | None = None
@@ -114,17 +117,27 @@ class PPOLearner:
             total = pg_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
             return total, {"pg_loss": pg_loss, "vf_loss": vf_loss, "entropy": entropy}
 
-        def update(params, opt_state, batch):
+        def grads_of(params, batch):
             (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch["obs"], batch["actions"], batch["logprobs"],
                 batch["advantages"], batch["returns"],
             )
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        def update(params, opt_state, batch):
+            grads, metrics = grads_of(params, batch)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            metrics["total_loss"] = loss
             return params, opt_state, metrics
 
+        def apply(params, opt_state, grads):
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
         self._update = jax.jit(update)
+        self._grads = jax.jit(grads_of)
+        self._apply = jax.jit(apply)
         self._jnp = jnp
 
     def update(self, batch: dict) -> dict:
@@ -132,6 +145,21 @@ class PPOLearner:
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         self.params, self.opt_state, metrics = self._update(self.params, self.opt_state, batch)
         return {k: float(v) for k, v in metrics.items()}
+
+    # --- distributed data-parallel protocol (LearnerGroup; reference:
+    # core/learner/learner.py compute_gradients/apply_gradients split) ---
+    def compute_grads(self, batch: dict) -> tuple:
+        import jax
+
+        jnp = self._jnp
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        grads, metrics = self._grads(self.params, batch)
+        return (jax.tree.map(lambda g: np.asarray(g), grads),
+                {k: float(v) for k, v in metrics.items()})
+
+    def apply_grads(self, grads) -> None:
+        self.params, self.opt_state = self._apply(self.params, self.opt_state,
+                                                  grads)
 
 
 def minibatch_sgd(update_fn, batch: dict, num_epochs: int, minibatch_size: int,
@@ -192,6 +220,13 @@ class PPO:
             obs_dim = int(np.prod(np.asarray(cfg.env_to_module()(sample)).shape))
         probe.close()
         self.learner = PPOLearner(cfg, obs_dim, num_actions)
+        self.learner_group = None
+        if cfg.num_learners > 1:
+            from ray_tpu.rllib.learner_group import LearnerGroup
+
+            self.learner_group = LearnerGroup(
+                lambda: PPOLearner(cfg, obs_dim, num_actions),
+                num_learners=cfg.num_learners)
 
         # numpy-side policy for env runners (no jit: tiny MLP, avoids
         # shipping traced fns to actors); rng is the runner's own generator
@@ -229,12 +264,18 @@ class PPO:
         rets = np.asarray(rets, dtype=np.float32)
 
         n = len(obs)
+        update_fn = (self.learner_group.update if self.learner_group is not None
+                     else self.learner.update)
         metrics = minibatch_sgd(
-            self.learner.update,
+            update_fn,
             {"obs": obs, "actions": actions, "logprobs": logprobs,
              "advantages": advs, "returns": rets},
             cfg.num_epochs, cfg.minibatch_size,
         )
+        if self.learner_group is not None:
+            # runner weight sync reads self.learner.params: adopt the group's
+            # (identical-across-replicas) parameters
+            self.learner.params = self.learner_group.get_params()
         self._iteration += 1
         finished = [ep for ep in episodes if ep.dones and ep.dones[-1]]
         mean_reward = float(np.mean([ep.total_reward() for ep in finished])) if finished else 0.0
@@ -248,3 +289,5 @@ class PPO:
 
     def stop(self) -> None:
         self.runner_group.stop()
+        if self.learner_group is not None:
+            self.learner_group.shutdown()

@@ -6,6 +6,7 @@ gcp/node.py GCPTPU (create/delete/list + operation polling).
 import json
 import re
 import threading
+import time
 
 import pytest
 
@@ -125,13 +126,14 @@ def test_reconcile_advances_fsm_to_running_and_terminates():
     assert prov.non_terminated_instances()[0].status == InstanceStatus.RUNNING
     assert prov.node_ips(inst.instance_id) == ["10.0.0.7"]
 
-    # terminate polls its delete op: complete it from another thread
-    t = threading.Timer(0.05, svc.finish_ops)
-    t.start()
+    # terminate fires the delete and returns; the op completes asynchronously
     prov.terminate([inst.instance_id])
-    t.cancel()
-    assert prov.non_terminated_instances() == []
-    assert svc.nodes == {}
+    assert prov.non_terminated_instances() == []  # local intent immediate
+    svc.finish_ops()
+    deadline = time.time() + 5
+    while svc.nodes and time.time() < deadline:
+        time.sleep(0.02)
+    assert svc.nodes == {}  # cloud-side delete observed
 
 
 @pytest.mark.fast

@@ -1,0 +1,105 @@
+"""Multi-learner data parallelism (reference: rllib/core/learner/
+learner_group.py:100 — N learner workers, synchronous gradient averaging,
+bitwise-identical replicas)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.learner_group import LearnerGroup
+from ray_tpu.rllib.ppo import PPOConfig, PPOLearner
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _batch(n, obs_dim=4, num_actions=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "obs": rng.normal(size=(n, obs_dim)).astype(np.float32),
+        "actions": rng.integers(0, num_actions, size=n).astype(np.int32),
+        "logprobs": np.log(np.full(n, 0.5, dtype=np.float32)),
+        "advantages": rng.normal(size=n).astype(np.float32),
+        "returns": rng.normal(size=n).astype(np.float32),
+    }
+
+
+def test_group_update_matches_single_learner(session):
+    """Example-weighted gradient averaging over shards == one learner seeing
+    the full batch (the DDP contract), to float tolerance."""
+    cfg = PPOConfig(seed=3)
+    batch = _batch(64)
+
+    single = PPOLearner(cfg, 4, 2)
+    single.update(batch)
+
+    group = LearnerGroup(lambda: PPOLearner(PPOConfig(seed=3), 4, 2),
+                         num_learners=2)
+    try:
+        group.update(batch)
+        import jax
+
+        gp = group.get_params()
+        flat_g = jax.tree.leaves(gp)
+        flat_s = [np.asarray(x) for x in jax.tree.leaves(single.params)]
+        for a, b in zip(flat_g, flat_s):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+    finally:
+        group.shutdown()
+
+
+def test_replicas_stay_identical_across_steps(session):
+    group = LearnerGroup(lambda: PPOLearner(PPOConfig(seed=1), 4, 2),
+                         num_learners=3)
+    try:
+        for step in range(3):
+            group.update(_batch(48, seed=step))
+        import jax
+
+        params = [ray_tpu.get(w.get_params.remote(), timeout=120)
+                  for w in group.workers]
+        for other in params[1:]:
+            for a, b in zip(jax.tree.leaves(params[0]), jax.tree.leaves(other)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        group.shutdown()
+
+
+def test_ppo_trains_with_learner_group(session):
+    """End-to-end: PPO with num_learners=2 improves CartPole reward shape
+    and runs the full sample->update loop through the group."""
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=128)
+            .training(num_epochs=2, minibatch_size=64, num_learners=2)
+            .build())
+    out = algo.train()
+    assert "total_loss" in out and np.isfinite(out["total_loss"])
+    out2 = algo.train()
+    assert np.isfinite(out2["total_loss"])
+
+
+def test_dreamerv3_trains(session):
+    """DreamerV3 (reference: rllib/algorithms/dreamerv3): world model loss
+    decreases and the imagination actor-critic produces finite updates."""
+    from ray_tpu.rllib import DreamerV3Config
+
+    algo = (DreamerV3Config()
+            .environment("CartPole-v1")
+            .training(batch_size=4, batch_length=12, horizon=5,
+                      collect_episodes=2, max_episode_len=60,
+                      deter_dim=32, hidden=32, stoch_groups=4,
+                      stoch_classes=4)
+            .build())
+    first = algo.train()
+    assert np.isfinite(first["wm_loss"]) and first["episode_reward_mean"] > 0
+    for _ in range(3):
+        out = algo.train()
+    assert np.isfinite(out["actor_loss"]) and np.isfinite(out["critic_loss"])
+    # the world model must actually be learning its replay distribution
+    assert out["wm_loss"] < first["wm_loss"]
+    assert out["buffer_episodes"] == 8

@@ -1,0 +1,98 @@
+"""Torch DDP backend over the runtime's gang machinery.
+
+Reference: train/torch/config.py:144 (_TorchBackend process-group setup),
+train_loop_utils.py (prepare_model / prepare_data_loader),
+torch/xla/config.py:20 (TPU backend gating).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train.torch_backend import TorchConfig, TorchTrainer, run_torch_gang
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_torch_gang_allreduce(session):
+    """Two DDP ranks over gloo: an all_reduce proves one shared world."""
+
+    def fn(rank):
+        import torch
+        import torch.distributed as dist
+
+        t = torch.tensor([float(rank + 1)])
+        dist.all_reduce(t)
+        return float(t.item())
+
+    out = run_torch_gang(fn, num_workers=2, timeout=300)
+    assert out == [3.0, 3.0]  # 1 + 2 on both ranks
+
+
+def test_torch_trainer_ddp_training_step(session):
+    """TorchTrainer end-to-end: DDP-wrapped linear model takes one synced
+    step; gradients averaged across ranks -> identical weights."""
+
+    def train_loop(config):
+        import torch
+        import torch.distributed as dist
+
+        from ray_tpu.train.torch_backend import prepare_model
+
+        torch.manual_seed(0)  # same init on every rank
+        model = prepare_model(torch.nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        rank = dist.get_rank()
+        # different data per rank: DDP must average the gradients
+        x = torch.full((8, 4), float(rank + 1))
+        y = torch.zeros(8, 1)
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        w = model.module.weight.detach().numpy().copy()
+        return {"loss": float(loss.item()), "w0": float(w[0, 0]),
+                "rank": rank}
+
+    from ray_tpu.train.config import ScalingConfig
+
+    trainer = TorchTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        torch_config=TorchConfig(backend="gloo"),
+    )
+    res = trainer.fit()
+    assert res.error is None, res.error
+    assert "loss" in res.metrics and res.metrics["loss"] > 0
+
+
+def test_prepare_data_loader_shards_per_rank(session):
+    def fn(rank):
+        import torch
+        from torch.utils.data import DataLoader, TensorDataset
+
+        from ray_tpu.train.torch_backend import prepare_data_loader
+
+        ds = TensorDataset(torch.arange(20).float().unsqueeze(1))
+        loader = prepare_data_loader(DataLoader(ds, batch_size=5))
+        seen = []
+        for (batch,) in loader:
+            seen.extend(int(v) for v in batch.flatten())
+        return sorted(seen)
+
+    shards = run_torch_gang(fn, num_workers=2, timeout=300)
+    # each rank sees half the dataset; together they cover everything
+    assert len(shards[0]) == 10 and len(shards[1]) == 10
+    assert sorted(shards[0] + shards[1]) == list(range(20))
+
+
+@pytest.mark.fast
+def test_backend_resolution_gated():
+    cfg = TorchConfig()  # auto
+    # torch_xla absent in this image -> gloo; explicit choices pass through
+    assert cfg.resolved_backend() == "gloo"
+    assert TorchConfig(backend="nccl").resolved_backend() == "nccl"
