@@ -84,6 +84,10 @@ class Config:
     # --- task events / observability (reference: task_event_buffer.h) ---
     task_events_enabled: bool = True
     task_events_max_buffer: int = 10000
+    # terminal task-table entries kept for the state API / drilldowns; beyond
+    # this, oldest finished entries are GC'd (reference: GcsTaskManager's
+    # bounded task storage, gcs_task_manager.h)
+    task_table_max_size: int = 20000
     # Export-event pipeline (reference: export API JSONL files under the
     # session dir for external ingestion); env: RAY_TPU_EXPORT_EVENTS_ENABLED
     export_events_enabled: bool = False

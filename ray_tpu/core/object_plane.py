@@ -26,8 +26,10 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.core import wire
 from ray_tpu.exceptions import ObjectLostError
 
-CHUNK_BYTES = 1 << 20
-WINDOW = 8
+import os as _os
+
+CHUNK_BYTES = int(_os.environ.get("RAY_TPU_PLANE_CHUNK_BYTES", str(1 << 20)))
+WINDOW = int(_os.environ.get("RAY_TPU_PLANE_WINDOW", "8"))
 
 
 class ObjectPlaneServer:
@@ -104,11 +106,19 @@ class ObjectPlaneServer:
 
 class PlaneClient:
     """Pull-side: cached connections + windowed chunk pipeline with holder
-    failover (reference: PullManager's retrying pull loop)."""
+    failover (reference: PullManager's retrying pull loop), under a global
+    concurrent-pull bound so a burst of gets can't saturate the NIC/head
+    (reference: pull_manager.h's bytes-being-pulled admission bound —
+    expressed here as max simultaneous object pulls, env-tunable)."""
 
-    def __init__(self):
+    def __init__(self, max_concurrent_pulls: int | None = None):
+        import os as _os
+
         self._peers: dict[str, wire.RpcPeer] = {}
         self._lock = threading.Lock()
+        n = max_concurrent_pulls or int(
+            _os.environ.get("RAY_TPU_PLANE_MAX_PULLS", "4"))
+        self._pull_gate = threading.BoundedSemaphore(max(1, n))
 
     def _peer(self, addr: str) -> wire.RpcPeer:
         with self._lock:
@@ -138,6 +148,12 @@ class PlaneClient:
         directory entry (reference: object directory location invalidation
         after a failed pull)."""
         oid_bin = oid.binary()
+        with self._pull_gate:
+            return self._pull_gated(addrs, oid_bin, chunk_bytes, window,
+                                    timeout, on_stale)
+
+    def _pull_gated(self, addrs, oid_bin, chunk_bytes, window, timeout,
+                    on_stale) -> Optional[bytes]:
         for entry in addrs:
             token, addr = entry if isinstance(entry, tuple) else (None, entry)
             try:

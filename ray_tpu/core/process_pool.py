@@ -672,7 +672,18 @@ class _Worker:
         self.conn.send_bytes(cloudpickle.dumps(payload))
 
     def is_alive(self) -> bool:
+        """Authoritative liveness (monitor / slow paths): includes an OS
+        poll to catch a process that died without its pipe EOF being seen."""
         return not self.dead and self.proc.poll() is None
+
+    def is_alive_fast(self) -> bool:
+        """Flag-only liveness for the SUBMISSION hot path. proc.poll() is a
+        waitpid syscall — at per-task frequency it was ~75% of dispatch time
+        (the round-4 microbench regression). The reply reader flips `dead`
+        on pipe EOF within the same tick; the tiny race window (send to a
+        just-died worker) is already covered by WorkerCrashedError
+        migration/retry."""
+        return not self.dead
 
     @property
     def load(self) -> int:
@@ -1205,7 +1216,8 @@ class ProcessWorkerPool:
         demand grows the pool via the monitor thread — the reference raylet
         similarly starts workers toward the granted lease count over time
         rather than per-request (worker_pool.cc PopWorker)."""
-        candidates = [w for w in self._workers if w.is_alive() and not w.blocked]
+        candidates = [w for w in self._workers
+                      if w.is_alive_fast() and not w.blocked]
         if not candidates:
             live = sum(1 for w in self._workers if w.is_alive())
             if live < self.MAX_WORKERS:

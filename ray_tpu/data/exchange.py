@@ -17,6 +17,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data.block import Block
+from ray_tpu.exceptions import GetTimeoutError
 
 DEFAULT_PARTITIONS = 8
 
@@ -61,14 +62,23 @@ def _scatter(blocks: Iterator[Block], part_fn, P: int, map_task):
         ref_lists.append(map_task.remote(b, part_fn, P, n_blocks))
         n_blocks += 1
     # harvest in COMPLETION order (a slow mapper doesn't head-of-line block
-    # collecting the fast ones' metadata)
+    # collecting the fast ones' metadata) but PLACE by block index —
+    # within-partition slice order must be deterministic or seeded shuffles
+    # and stable-sort tie order change run to run
+    block_idx = {r: i for i, r in enumerate(ref_lists)}
+    slots: list[list | None] = [None] * n_blocks
     pending = list(ref_lists)
     while pending:
         ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=600)
+        if not ready:
+            raise GetTimeoutError(
+                f"exchange map stage stalled: {len(pending)} mapper(s) not "
+                "done after 600s")
         for r in ready:
-            slice_refs = ray_tpu.get(r, timeout=600)  # P refs, metadata-sized
-            for i, pref in enumerate(slice_refs):
-                partitions[i].append(pref)
+            slots[block_idx[r]] = ray_tpu.get(r, timeout=600)  # P small refs
+    for slice_refs in slots:
+        for i, pref in enumerate(slice_refs):
+            partitions[i].append(pref)
     return partitions, n_blocks, schema
 
 

@@ -272,31 +272,31 @@ class DreamerV3:
                 step, last, (rews[:-1], conts[:-1], values[1:]), reverse=True)
             return rets  # [T-1, N]
 
-        def ac_loss(actor, critic, wm, starts_h, starts_z, key):
-            hs, zs, ents = imagine(wm, actor, starts_h, starts_z, key)
-            feat = jnp.concatenate([hs, zs], -1)
-            sg_feat = jax.lax.stop_gradient(feat)
-            rews = _symexp(_apply_mlp(wm["rew"], feat, jnp)[..., 0])
-            conts = jax.nn.sigmoid(_apply_mlp(wm["cont"], feat, jnp)[..., 0])
-            values = _apply_mlp(critic, sg_feat, jnp)[..., 0]
-            rets = lambda_returns(rews, conts, values)
-            # actor: maximize imagined lambda-returns (dynamics backprop
-            # through the straight-through latents) + entropy
-            actor_l = -(rets.mean() + cfg.entropy_coeff * ents.mean())
-            # critic: regress on stop-gradient returns
-            critic_l = ((values[:-1] - jax.lax.stop_gradient(rets)) ** 2).mean()
-            return actor_l, critic_l, rets
-
         def ac_update(actor, critic, a_state, c_state, wm, sh, sz, key):
+            # ONE imagination rollout per step: the actor grad owns it (the
+            # rollout depends on the actor's sampled actions); the critic
+            # regresses against the SAME rollout's stop-gradient features —
+            # re-imagining for the critic would double the dominant cost.
             def a_fn(a):
-                al, _, rets = ac_loss(a, critic, wm, sh, sz, key)
-                return al, rets
+                hs, zs, ents = imagine(wm, a, sh, sz, key)
+                feat = jnp.concatenate([hs, zs], -1)
+                rews = _symexp(_apply_mlp(wm["rew"], feat, jnp)[..., 0])
+                conts = jax.nn.sigmoid(
+                    _apply_mlp(wm["cont"], feat, jnp)[..., 0])
+                values = _apply_mlp(
+                    critic, jax.lax.stop_gradient(feat), jnp)[..., 0]
+                rets = lambda_returns(rews, conts, values)
+                actor_l = -(rets.mean() + cfg.entropy_coeff * ents.mean())
+                return actor_l, (rets, feat)
 
-            (al, rets), a_grads = jax.value_and_grad(a_fn, has_aux=True)(actor)
+            (al, (rets, feat)), a_grads = jax.value_and_grad(
+                a_fn, has_aux=True)(actor)
+            sg_feat = jax.lax.stop_gradient(feat)
+            sg_rets = jax.lax.stop_gradient(rets)
 
             def c_fn(c):
-                _, cl, _ = ac_loss(actor, c, wm, sh, sz, key)
-                return cl
+                values = _apply_mlp(c, sg_feat, jnp)[..., 0]
+                return ((values[:-1] - sg_rets) ** 2).mean()
 
             cl, c_grads = jax.value_and_grad(c_fn)(critic)
             au, a_state = self.actor_opt.update(a_grads, a_state, actor)

@@ -59,9 +59,22 @@ class LearnerGroup:
         cls = ray_tpu.remote(num_cpus=num_cpus_per_learner,
                              max_concurrency=2)(_LearnerWorker)
         self.workers = [cls.remote(blob) for _ in range(num_learners)]
-        # same factory + same seed => identical initial replicas; assert via
-        # first get_params (cheap) rather than trusting it silently
         self.num_learners = num_learners
+        # replica-identity check: gradient averaging is only valid against
+        # IDENTICAL parameters — an unseeded factory silently trains garbage
+        if num_learners > 1:
+            import jax
+
+            all_params = ray_tpu.get(
+                [w.get_params.remote() for w in self.workers], timeout=300)
+            base = jax.tree.leaves(all_params[0])
+            for rank, other in enumerate(all_params[1:], start=1):
+                for a, b in zip(base, jax.tree.leaves(other)):
+                    if not np.array_equal(np.asarray(a), np.asarray(b)):
+                        raise ValueError(
+                            "learner replicas diverge at init (rank 0 vs "
+                            f"rank {rank}): the learner_factory must produce "
+                            "deterministic (seeded) parameters")
 
     def update(self, batch: dict) -> dict:
         """One data-parallel step: shard -> per-learner grads -> example-

@@ -62,21 +62,28 @@ def prepare_model(model, device=None):
 
 def prepare_data_loader(data_loader):
     """Re-wrap a DataLoader with a DistributedSampler so each rank sees its
-    shard (reference: train_loop_utils.py prepare_data_loader)."""
+    shard (reference: train_loop_utils.py prepare_data_loader). The
+    loader's OWN shuffle setting propagates into the sampler (an ordered
+    validation loader stays ordered per-shard); call
+    ``loader.sampler.set_epoch(epoch)`` per epoch for fresh shuffles, as
+    with any DistributedSampler."""
     import torch.distributed as dist
-    from torch.utils.data import DataLoader
+    from torch.utils.data import DataLoader, RandomSampler
     from torch.utils.data.distributed import DistributedSampler
 
     if not (dist.is_available() and dist.is_initialized()
             and dist.get_world_size() > 1):
         return data_loader
+    shuffled = isinstance(data_loader.sampler, RandomSampler)
     sampler = DistributedSampler(data_loader.dataset,
                                  num_replicas=dist.get_world_size(),
-                                 rank=dist.get_rank())
+                                 rank=dist.get_rank(),
+                                 shuffle=shuffled)
     return DataLoader(data_loader.dataset,
                       batch_size=data_loader.batch_size,
                       sampler=sampler,
-                      num_workers=0,
+                      num_workers=data_loader.num_workers,
+                      pin_memory=data_loader.pin_memory,
                       collate_fn=data_loader.collate_fn,
                       drop_last=data_loader.drop_last)
 
