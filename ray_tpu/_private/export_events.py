@@ -24,6 +24,10 @@ from typing import Any
 _LOCK = threading.Lock()
 _WRITERS: dict[str, "_Writer"] = {}
 _DIR: str | None = None
+# Non-owner processes (pool workers joining via RAY_TPU_SESSION_DIR) write
+# per-pid files: _Writer's tell()-based rotation is single-process-only, and
+# concurrent os.replace() rotations would clobber each other's .1 files.
+_SUFFIX = ""
 MAX_BYTES = 8 * 1024 * 1024
 
 
@@ -71,16 +75,18 @@ def enabled() -> bool:
     return _ENABLED
 
 
-def configure(session_dir: str) -> None:
+def configure(session_dir: str, owner: bool = True) -> None:
     """Point the pipeline at this session's export dir and refresh the
     enabled decision (called by init; safe across re-inits — prior sessions'
-    writers are closed so events never land in an old session's files)."""
-    global _DIR, _ENABLED
+    writers are closed so events never land in an old session's files).
+    Non-owner joiners (workers) get per-pid file names."""
+    global _DIR, _ENABLED, _SUFFIX
     with _LOCK:
         for w in _WRITERS.values():
             w.close()
         _WRITERS.clear()
         _DIR = os.path.join(session_dir, "export_events")
+        _SUFFIX = "" if owner else f"_{os.getpid()}"
         _ENABLED = _compute_enabled()
 
 
@@ -102,13 +108,21 @@ def emit(source_type: str, event_data: dict[str, Any]) -> None:
                     assert _DIR is not None  # configure() precedes _ENABLED
                     os.makedirs(_DIR, exist_ok=True)
                     w = _WRITERS[source_type] = _Writer(
-                        os.path.join(_DIR, f"export_{source_type}.jsonl"))
+                        os.path.join(_DIR, f"export_{source_type}{_SUFFIX}.jsonl"))
+        event_id = uuid.uuid4().hex
+        ts = time.time()
         w.emit(json.dumps({
-            "event_id": uuid.uuid4().hex,
-            "timestamp": time.time(),
+            "event_id": event_id,
+            "timestamp": ts,
             "source_type": source_type,
             "event_data": event_data,
         }, default=str) + "\n")
+        # optional OTLP sink (RAY_TPU_OTLP_FILE / RAY_TPU_OTLP_ENDPOINT):
+        # the same event as an OpenTelemetry LogRecord
+        from ray_tpu._private import otel
+
+        if otel.configured():
+            otel.emit_log(source_type, event_data, event_id=event_id, ts=ts)
     except Exception:
         pass
 

@@ -1,0 +1,195 @@
+"""OTLP emission for export events and tracing spans.
+
+Parity: the reference's OpenTelemetry wiring (src/ray/util/event.cc export
+sinks + the dashboard's OTel collector guidance) — here a dependency-free
+OTLP/JSON encoder: events become OTLP LogRecords and tracing spans become
+OTLP Spans, shipped either to a file (`RAY_TPU_OTLP_FILE`) or POSTed to an
+OTLP/HTTP collector endpoint (`RAY_TPU_OTLP_ENDPOINT`, e.g.
+http://localhost:4318). Zero-egress environments use the file sink; the
+JSON shape follows opentelemetry-proto's JSON mapping so a collector's
+filelogreceiver ingests it unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+import uuid
+from typing import Any, Optional
+
+_LOCK = threading.Lock()
+_STATE: dict = {"file": None, "endpoint": None, "configured": False}
+
+_SERVICE_RESOURCE = {
+    "attributes": [
+        {"key": "service.name", "value": {"stringValue": "ray_tpu"}},
+    ]
+}
+
+
+def _attr(key: str, value: Any) -> dict:
+    if isinstance(value, bool):
+        v = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
+
+
+def configured() -> bool:
+    # lock-free fast path: this sits on task-completion and span-exit hot
+    # paths; the flag flip in _ensure is a benign one-time race
+    if not _STATE["configured"]:
+        _ensure()
+    return _STATE["file"] is not None or _STATE["endpoint"] is not None
+
+
+def _ensure() -> None:
+    with _LOCK:
+        if _STATE["configured"]:
+            return
+        path = os.environ.get("RAY_TPU_OTLP_FILE")
+        if path:
+            try:
+                _STATE["file"] = open(path, "a", buffering=1)
+            except OSError:
+                _STATE["file"] = None
+        _STATE["endpoint"] = os.environ.get("RAY_TPU_OTLP_ENDPOINT") or None
+        _STATE["configured"] = True
+
+
+def _ship(kind: str, payload: dict) -> None:
+    """Enqueue for the background shipper (kind: 'logs' or 'traces' — the
+    OTLP/HTTP path suffix). NEVER blocks the caller: file writes and HTTP
+    POSTs happen on the shipper thread, and a full queue drops (the
+    reference batches/destages for exactly this reason)."""
+    q = _shipper_queue()
+    try:
+        q.put_nowait((kind, payload))
+    except Exception:
+        pass  # queue full: drop rather than stall a task/span hot path
+
+
+def _shipper_queue():
+    q = _STATE.get("queue")
+    if q is None:
+        with _LOCK:
+            q = _STATE.get("queue")
+            if q is None:
+                import queue as _qmod
+
+                q = _STATE["queue"] = _qmod.Queue(maxsize=10_000)
+                t = threading.Thread(target=_shipper_loop, args=(q,),
+                                     daemon=True, name="otlp-shipper")
+                _STATE["thread"] = t
+                t.start()
+    return q
+
+
+def _shipper_loop(q) -> None:
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        kind, payload = item
+        line = json.dumps(payload, separators=(",", ":"))
+        f = _STATE["file"]
+        if f is not None:
+            try:
+                f.write(line + "\n")
+            except (OSError, ValueError):
+                pass
+        ep = _STATE["endpoint"]
+        if ep is not None:
+            try:
+                req = urllib.request.Request(
+                    f"{ep.rstrip('/')}/v1/{kind}", method="POST",
+                    data=line.encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=5).close()
+            except Exception:
+                pass  # collector down: drop, never stall
+
+
+def emit_log(source_type: str, event_data: dict, event_id: str | None = None,
+             ts: float | None = None) -> None:
+    """One export event -> one OTLP LogRecord (resourceLogs envelope)."""
+    if not configured():
+        return
+    ts_ns = str(int((ts if ts is not None else time.time()) * 1e9))
+    record = {
+        "timeUnixNano": ts_ns,
+        "severityNumber": 9,  # INFO
+        "severityText": "INFO",
+        "body": {"stringValue": source_type},
+        "attributes": [_attr("event.id", event_id or uuid.uuid4().hex)]
+        + [_attr(f"ray_tpu.{k}", v) for k, v in event_data.items()
+           if v is not None],
+    }
+    _ship("logs", {
+        "resourceLogs": [{
+            "resource": _SERVICE_RESOURCE,
+            "scopeLogs": [{
+                "scope": {"name": "ray_tpu.export_events"},
+                "logRecords": [record],
+            }],
+        }]
+    })
+
+
+def emit_span(name: str, start_s: float, end_s: float,
+              attributes: Optional[dict] = None,
+              trace_id: str | None = None, span_id: str | None = None,
+              parent_span_id: str | None = None) -> None:
+    """One tracing span -> one OTLP Span (resourceSpans envelope)."""
+    if not configured():
+        return
+    span = {
+        "traceId": trace_id or uuid.uuid4().hex,
+        "spanId": (span_id or uuid.uuid4().hex)[:16],
+        "name": name,
+        "kind": 1,  # INTERNAL
+        "startTimeUnixNano": str(int(start_s * 1e9)),
+        "endTimeUnixNano": str(int(end_s * 1e9)),
+        "attributes": [_attr(k, v) for k, v in (attributes or {}).items()
+                       if v is not None],
+    }
+    if parent_span_id:
+        span["parentSpanId"] = parent_span_id[:16]
+    _ship("traces", {
+        "resourceSpans": [{
+            "resource": _SERVICE_RESOURCE,
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.tracing"},
+                "spans": [span],
+            }],
+        }]
+    })
+
+
+def shutdown() -> None:
+    # drain: the shipper flushes queued records before the file closes
+    q = _STATE.get("queue")
+    t = _STATE.get("thread")
+    if q is not None:
+        try:
+            q.put(None, timeout=1)
+        except Exception:
+            pass
+        if t is not None:
+            t.join(timeout=5)
+    with _LOCK:
+        f = _STATE["file"]
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        _STATE.update(file=None, endpoint=None, configured=False,
+                      queue=None, thread=None)

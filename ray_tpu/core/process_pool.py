@@ -99,6 +99,29 @@ def resolve_shm_args(args, kwargs, store, fetch=None):
     return tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
 
 
+def _emit_profile_event(task_bin, exec_t0: float, status) -> None:
+    """Worker-side profile event (reference: the TaskEventBuffer's
+    worker-recorded profile events batched to the GCS —
+    task_event_buffer.h:305): the WORKER's own wall-clock execution window,
+    distinct from the head's dispatch-side RUNNING/FINISHED stamps, written
+    to the session's export pipeline. Config-gated and line-buffered —
+    effectively free when export events are off."""
+    try:
+        from ray_tpu._private import export_events
+
+        if not export_events.enabled():
+            return
+        export_events.emit("task_profile", {
+            "task_id": task_bin.hex() if task_bin else None,
+            "worker_pid": os.getpid(),
+            "exec_start": exec_t0,
+            "exec_end": time.time(),
+            "status": status if isinstance(status, str) else "err",
+        })
+    except Exception:
+        pass
+
+
 def worker_env() -> dict:
     """Child env hygiene for session-spawned processes (workers, node agents).
 
@@ -386,17 +409,29 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             ).start()
         return actor_loop
 
+    exec_starts: dict = {}  # seq -> (wall start, id_bin) for profile events
+
+    def _note_start(seq: int, id_bin) -> None:
+        exec_starts[seq] = (time.time(), id_bin)
+
+    def _profile_done(seq: int, status) -> None:
+        started = exec_starts.pop(seq, None)
+        if started is not None:
+            _emit_profile_event(started[1], started[0], status)
+
     def _finish_call(seq: int, result, oid_bin) -> None:
         contained = None
         try:
             status, payload, extra, contained = _result_payload(result, oid_bin)
         except BaseException as e:  # noqa: BLE001
             status, payload, extra = _error_payload(e)
+        _profile_done(seq, status)
         _reply(("done", seq, status, payload, extra, contained))
         _retire(seq)
 
     def _finish_err(seq: int, e: BaseException) -> None:
         status, payload, extra = _error_payload(e)
+        _profile_done(seq, status)
         _reply(("done", seq, status, payload, extra))
         _retire(seq)
 
@@ -453,6 +488,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 _reply(("skipped", seq))
                 continue
             _reply(("start", seq))
+            _note_start(seq, oid_bin)
             try:
                 if actor_instance is None:
                     raise RuntimeError("actor_call before actor_init")
@@ -503,6 +539,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 _reply(("skipped", seq))
                 continue
             _reply(("start", seq))
+            _note_start(seq, task_bin)
             try:
                 if actor_instance is None:
                     raise RuntimeError("actor_gen before actor_init")
@@ -566,6 +603,8 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 continue
             _reply(("start", seq))
             _set_current_task(task_bin)
+            gen_t0 = time.time()
+            gen_status = "gen_end"
             try:
                 fn = cloudpickle.loads(fn_blob)
                 args, kwargs = _decode_call(args_blob)
@@ -574,9 +613,11 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 if not isinstance(e, TaskCancelledError):
                     _maybe_post_mortem(e)
                 status, payload, extra = _error_payload(e)
+                gen_status = status
                 _reply(("done", seq, status, payload, extra))
             finally:
                 _set_current_task(None)
+                _emit_profile_event(task_bin, gen_t0, gen_status)
                 with pend_cv:
                     gen_consumed.pop(seq, None)
                 _retire(seq)
@@ -589,6 +630,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         _reply(("start", seq))
         _set_current_task(task_bin)
         contained = None
+        exec_t0 = time.time()
         try:
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = _decode_call(args_blob)
@@ -599,6 +641,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             status, payload, extra = _error_payload(e)
         finally:
             _set_current_task(None)
+            _emit_profile_event(task_bin, exec_t0, status)
         _reply(("done", seq, status, payload, extra, contained))
         _retire(seq)
 

@@ -325,6 +325,14 @@ class Runtime:
         from ray_tpu._private import export_events as _export
 
         _export.configure(self.session_dir)
+        # workers join the export pipeline (worker-side batched profile
+        # events; reference: TaskEventBuffer's worker profile events) —
+        # worker_env() copies os.environ into spawned processes. The enabled
+        # flag must travel too: _system_config only mutates THIS process's
+        # Config, and workers rebuild theirs from env.
+        _os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if config.export_events_enabled:
+            _os.environ["RAY_TPU_EXPORT_EVENTS_ENABLED"] = "1"
         self._log_monitor = None
         self._memory_monitor = None
         if config.log_to_driver:

@@ -42,6 +42,19 @@ def main() -> None:
 
     _pin_worker_jax()
 
+    import os as _os
+
+    if _os.environ.get("RAY_TPU_SESSION_DIR"):
+        # join the session's export-event pipeline: workers write their own
+        # batched profile events (reference: worker-side TaskEventBuffer)
+        try:
+            from ray_tpu._private import export_events
+
+            export_events.configure(_os.environ["RAY_TPU_SESSION_DIR"],
+                                    owner=False)
+        except Exception:
+            pass
+
     from multiprocessing.connection import Connection
 
     conn = Connection(args.fd)

@@ -71,8 +71,21 @@ class _Tracer:
 _tracer = _Tracer()
 
 
+def _otel_processor(s: "Span") -> None:
+    from ray_tpu._private import otel
+
+    if otel.configured():
+        otel.emit_span(s.name, s.start_ns / 1e9, s.end_ns / 1e9,
+                       attributes=s.attributes, trace_id=s.trace_id,
+                       span_id=s.span_id, parent_span_id=s.parent_id)
+
+
 def enable_tracing() -> None:
-    """Reference: `ray start --tracing-startup-hook` opt-in."""
+    """Reference: `ray start --tracing-startup-hook` opt-in. OTLP export
+    rides the processor hook when a sink is configured
+    (RAY_TPU_OTLP_FILE / RAY_TPU_OTLP_ENDPOINT)."""
+    if _otel_processor not in _tracer._processors:
+        _tracer.add_span_processor(_otel_processor)
     _tracer.enabled = True
 
 
