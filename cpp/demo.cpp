@@ -36,6 +36,13 @@ int main(int argc, char** argv) {
     printf("counter=%ld\n", a.Call("value").AsInt());
     a.Kill();
 
+    // typed task API: native C++ types in and out, no Json at the call site
+    double tsum = c.TypedTask<double>("add").Remote(10, 5);
+    printf("typed add(10,5)=%g\n", tsum);
+    rtpu::TypedRef<long> tr = c.TypedTask<long>("square").RemoteAsync(6);
+    printf("typed square(6)=%ld\n", c.Get(tr));
+    c.Free(tr);  // release the server-held borrow
+
     // error propagation
     try {
       c.Task("boom").Remote();

@@ -31,6 +31,7 @@
 #include <memory>
 #include <sstream>
 #include <stdexcept>
+#include <type_traits>
 #include <string>
 #include <vector>
 
@@ -286,8 +287,76 @@ class JsonParser {
   }
 };
 
+// -------------------------------------------------------- typed conversions
+// The typed task API (reference: cpp/include/ray/api.h — ray::Task(fn)
+// .Remote(native args) with typed ObjectRef<T> returns): native C++ values
+// convert to/from the wire Json automatically, so call sites never touch
+// Json when they don't want to.
+inline Json ToJson(const Json& v) { return v; }
+inline Json ToJson(bool v) { return Json(v); }
+inline Json ToJson(const char* v) { return Json(v); }
+inline Json ToJson(const std::string& v) { return Json(v); }
+template <typename T,
+          typename std::enable_if<std::is_arithmetic<T>::value, int>::type = 0>
+Json ToJson(T v) { return Json(static_cast<double>(v)); }
+template <typename T>
+Json ToJson(const std::map<std::string, T>& v);  // fwd: vector<map<...>> args
+template <typename T>
+Json ToJson(const std::vector<T>& v) {
+  std::vector<Json> items;
+  items.reserve(v.size());
+  for (const auto& x : v) items.push_back(ToJson(x));
+  return Json::Array(std::move(items));
+}
+template <typename T>
+Json ToJson(const std::map<std::string, T>& v) {
+  Json o = Json::Object();
+  for (const auto& kv : v) o.obj[kv.first] = ToJson(kv.second);
+  return o;
+}
+
+template <typename T>
+struct FromJsonImpl;
+template <> struct FromJsonImpl<Json> {
+  static Json Get(const Json& j) { return j; }
+};
+template <> struct FromJsonImpl<double> {
+  static double Get(const Json& j) { return j.AsNum(); }
+};
+template <> struct FromJsonImpl<long> {
+  static long Get(const Json& j) { return j.AsInt(); }
+};
+template <> struct FromJsonImpl<int> {
+  static int Get(const Json& j) { return static_cast<int>(j.AsInt()); }
+};
+template <> struct FromJsonImpl<bool> {
+  static bool Get(const Json& j) {
+    if (j.type != Json::Bool) throw std::runtime_error("json: not a bool");
+    return j.b;
+  }
+};
+template <> struct FromJsonImpl<std::string> {
+  static std::string Get(const Json& j) { return j.AsStr(); }
+};
+template <typename T> struct FromJsonImpl<std::vector<T>> {
+  static std::vector<T> Get(const Json& j) {
+    if (j.type != Json::Arr) throw std::runtime_error("json: not an array");
+    std::vector<T> out;
+    out.reserve(j.arr.size());
+    for (const auto& x : j.arr) out.push_back(FromJsonImpl<T>::Get(x));
+    return out;
+  }
+};
+template <typename T>
+T FromJson(const Json& j) { return FromJsonImpl<T>::Get(j); }
+
 // ----------------------------------------------------------------- client
 struct ObjectRef {
+  std::string id;
+};
+
+template <typename T>
+struct TypedRef {  // typed ObjectRef (reference: ray::ObjectRef<T>)
   std::string id;
 };
 
@@ -304,6 +373,27 @@ class TaskCaller {
  private:
   Client* c_;
   std::string func_;
+};
+
+// Typed task caller: native args in, R out (reference: the templated
+// ray::Task(fn).Remote() whose ObjectRef carries the return type).
+template <typename R>
+class TypedTaskCaller {
+ public:
+  TypedTaskCaller(Client* c, std::string func)
+      : inner_(c, std::move(func)) {}
+  template <typename... A>
+  R Remote(A&&... args) {
+    return FromJson<R>(inner_.Remote(ToJson(std::forward<A>(args))...));
+  }
+  template <typename... A>
+  TypedRef<R> RemoteAsync(A&&... args) {
+    return TypedRef<R>{
+        inner_.RemoteAsync(ToJson(std::forward<A>(args))...).id};
+  }
+
+ private:
+  TaskCaller inner_;
 };
 
 class Actor {
@@ -347,6 +437,13 @@ class Client {
 
   TaskCaller Task(const std::string& func) { return TaskCaller(this, func); }
 
+  // Typed variant: rtpu::Json never appears at the call site —
+  //   double r = c.TypedTask<double>("add").Remote(3, 4);
+  template <typename R>
+  TypedTaskCaller<R> TypedTask(const std::string& func) {
+    return TypedTaskCaller<R>(this, func);
+  }
+
   Actor ActorCreate(const std::string& cls, std::vector<Json> args = {}) {
     Json m = Json::Object();
     m.obj["op"] = Json("actor_create");
@@ -369,6 +466,11 @@ class Client {
     return Request(m);
   }
 
+  template <typename T>
+  T Get(const TypedRef<T>& ref) {
+    return FromJson<T>(Get(ObjectRef{ref.id}));
+  }
+
   // Release the server-held borrow for a Put()/RemoteAsync() ref; without
   // this a long-lived client pins every object for the server's lifetime.
   void Free(const ObjectRef& ref) {
@@ -377,6 +479,9 @@ class Client {
     m.obj["ref"] = Json(ref.id);
     Request(m);
   }
+
+  template <typename T>
+  void Free(const TypedRef<T>& ref) { Free(ObjectRef{ref.id}); }
 
   std::vector<std::string> ListFuncs() {
     Json m = Json::Object();

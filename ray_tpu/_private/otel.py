@@ -94,7 +94,15 @@ def _shipper_queue():
 
 def _shipper_loop(q) -> None:
     while True:
-        item = q.get()
+        try:
+            item = q.get(timeout=1.0)
+        except Exception:
+            # generation check: a shutdown that couldn't enqueue the
+            # sentinel (full queue) reset _STATE — exit instead of shipping
+            # a dead session's records into the next session's sink
+            if _STATE.get("queue") is not q:
+                return
+            continue
         if item is None:
             return
         kind, payload = item
@@ -179,9 +187,19 @@ def shutdown() -> None:
     t = _STATE.get("thread")
     if q is not None:
         try:
-            q.put(None, timeout=1)
+            q.put_nowait(None)
         except Exception:
-            pass
+            # full queue: drop backlog so the sentinel fits — a fast drain
+            # beats shipping stale records into the next session
+            try:
+                while True:
+                    q.get_nowait()
+            except Exception:
+                pass
+            try:
+                q.put_nowait(None)
+            except Exception:
+                pass
         if t is not None:
             t.join(timeout=5)
     with _LOCK:

@@ -488,7 +488,9 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 _reply(("skipped", seq))
                 continue
             _reply(("start", seq))
-            _note_start(seq, oid_bin)
+            # return oid = task_id(24B) + index: record the TASK id so
+            # profile events join against task state events
+            _note_start(seq, oid_bin[:24] if oid_bin else None)
             try:
                 if actor_instance is None:
                     raise RuntimeError("actor_call before actor_init")
@@ -550,12 +552,15 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 if _inspect.isasyncgenfunction(method):
                     async def _run_agen(m=method, a=args, kw=kwargs, s=seq,
                                         tb=task_bin, b=bp):
+                        gen_status = "gen_end"
                         try:
                             await _astream_out(s, tb, m(*a, **kw), b)
                         except BaseException as e:  # noqa: BLE001
                             status, payload, extra = _error_payload(e)
+                            gen_status = status
                             _reply(("done", s, status, payload, extra))
                         finally:
+                            _profile_done(s, gen_status)
                             # cleaned on the LOOP at stream end — the executor
                             # popping it early would reset live backpressure
                             # counts and leak re-added entries
@@ -569,6 +574,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 else:
                     def _run_sync_gen(m=method, a=args, kw=kwargs, s=seq,
                                       tb=task_bin, b=bp):
+                        gen_status = "gen_end"
                         try:
                             try:
                                 _stream_out(s, tb, m(*a, **kw), b)
@@ -578,8 +584,11 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                                 _retire(s)
                         except BaseException as e:  # noqa: BLE001
                             status, payload, extra = _error_payload(e)
+                            gen_status = status
                             _reply(("done", s, status, payload, extra))
                             _retire(s)
+                        finally:
+                            _profile_done(s, gen_status)
 
                     # a GROUPED streaming method runs on its group's pool so
                     # a long-lived stream never wedges the executor loop that

@@ -330,9 +330,13 @@ class Runtime:
         # worker_env() copies os.environ into spawned processes. The enabled
         # flag must travel too: _system_config only mutates THIS process's
         # Config, and workers rebuild theirs from env.
-        _os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
-        if config.export_events_enabled:
-            _os.environ["RAY_TPU_EXPORT_EVENTS_ENABLED"] = "1"
+        self._session_env_vars: list[str] = []
+        for var, val in (("RAY_TPU_SESSION_DIR", self.session_dir),
+                         ("RAY_TPU_EXPORT_EVENTS_ENABLED",
+                          "1" if config.export_events_enabled else None)):
+            if val is not None and _os.environ.get(var) != val:
+                _os.environ[var] = val
+                self._session_env_vars.append(var)  # ours to clean up
         self._log_monitor = None
         self._memory_monitor = None
         if config.log_to_driver:
@@ -2425,6 +2429,12 @@ class Runtime:
         from ray_tpu._private import export_events
 
         export_events.shutdown()  # close writers; late daemon emits no-op
+        # don't leak OUR session env into later sessions / user subprocesses
+        # (user-set values are left alone)
+        import os as _os
+
+        for var in getattr(self, "_session_env_vars", ()):
+            _os.environ.pop(var, None)
         for state in list(self._actors.values()):
             if state.proc_worker is not None:
                 try:

@@ -99,19 +99,17 @@ def test_worker_side_profile_events(tmp_path, monkeypatch):
         import glob
         import time as _t
 
-        path = None
+        events = []
         deadline = _t.time() + 30
         while _t.time() < deadline:
             # per-pid files: workers are non-owner joiners of the pipeline
             hits = glob.glob(f"{session_dir}/**/export_task_profile*.jsonl",
                              recursive=True)
-            if hits:
-                path = hits[0]
-                events = [json.loads(l) for l in open(path)]
-                if events:
-                    break
+            events = [json.loads(l) for p in hits for l in open(p)]
+            if events:
+                break
             _t.sleep(0.1)
-        assert path is not None, "no worker profile events emitted"
+        assert events, "no worker profile events emitted"
         ev = events[-1]["event_data"]
         assert ev["worker_pid"] != None  # noqa: E711
         assert ev["exec_end"] >= ev["exec_start"]

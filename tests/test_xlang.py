@@ -130,3 +130,6 @@ def test_cpp_client_end_to_end(xserver, tmp_path):
     assert "add(3,4)=7" in run.stdout
     assert "counter=2" in run.stdout
     assert "put/get=héllo ray" in run.stdout
+    # typed task API: native C++ types, no Json at the call site
+    assert "typed add(10,5)=15" in run.stdout
+    assert "typed square(6)=36" in run.stdout
