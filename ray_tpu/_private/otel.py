@@ -186,22 +186,24 @@ def shutdown() -> None:
     q = _STATE.get("queue")
     t = _STATE.get("thread")
     if q is not None:
-        try:
-            q.put_nowait(None)
-        except Exception:
-            # full queue: drop backlog so the sentinel fits — a fast drain
-            # beats shipping stale records into the next session
-            try:
-                while True:
-                    q.get_nowait()
-            except Exception:
-                pass
+        sent = False
+        for _ in range(3):  # producers can refill between drain and put
             try:
                 q.put_nowait(None)
+                sent = True
+                break
             except Exception:
-                pass
+                # full queue: drop backlog so the sentinel fits — a fast
+                # drain beats shipping stale records into the next session
+                try:
+                    while True:
+                        q.get_nowait()
+                except Exception:
+                    pass
         if t is not None:
-            t.join(timeout=5)
+            # no sentinel landed: don't burn 5s — the generation check ends
+            # the thread once _STATE resets below
+            t.join(timeout=5 if sent else 0.2)
     with _LOCK:
         f = _STATE["file"]
         if f is not None:

@@ -185,8 +185,13 @@ class _ActorState:
             # pools (process_pool.py actor_init), so a slow method in one
             # group never blocks another group's methods there either
             self.mailboxes[_g] = queue.Queue()
-        # group name -> number of serving threads (poison-pill bookkeeping)
+        # group name -> number of serving threads (poison-pill bookkeeping);
+        # limits = max_concurrency per group (threads grow on demand to it)
         self.group_thread_counts: dict[str, int] = {}
+        self.group_thread_limits: dict[str, int] = {}
+        # threads currently processing an item (elastic growth only adds a
+        # thread when every existing one is busy AND items are waiting)
+        self.group_busy: dict[str, int] = {}
         self.threads: list[threading.Thread] = []
         self.node_id: NodeID | None = None
         self.sched_req: SchedulingRequest | None = None
@@ -1849,20 +1854,55 @@ class Runtime:
         # max_concurrency calls overlap inside the worker for process actors
         # (asyncio loop or sync-method thread pool) — the head needs matching
         # mailbox threads either way to keep that many in flight; named
-        # groups get their own mailbox threads for BOTH actor kinds
+        # groups get their own mailbox threads for BOTH actor kinds.
+        # Threads grow ON DEMAND up to the limit (submit_actor_task grows one
+        # when the mailbox backs up): an actor with max_concurrency=600 (a
+        # serve replica sized by max_ongoing_requests) must not park 600
+        # threads at creation — only under real concurrent load.
         groups = {"_default": max(1, state.max_concurrency)}
         for gname, limit in state.concurrency_groups.items():
             groups[gname] = max(1, int(limit))
-        state.group_thread_counts = groups
+        state.group_thread_limits = groups
+        state.group_thread_counts = {g: 0 for g in groups}
         for gname, concurrency in groups.items():
-            for i in range(concurrency):
-                t = threading.Thread(
-                    target=self._actor_loop, args=(state, state.mailboxes[gname]),
-                    daemon=True,
-                    name=f"ray_tpu-actor-{state.cls.__name__}-{gname}-{i}",
-                )
-                state.threads.append(t)
-                t.start()
+            for _ in range(min(concurrency, 4)):
+                self._spawn_actor_thread(state, gname)
+
+    def _spawn_actor_thread(self, state: _ActorState, gname: str) -> None:
+        """Start one mailbox-serving thread for `gname` (caller checks the
+        group's limit under state.lock or at creation)."""
+        i = state.group_thread_counts.get(gname, 0)
+        state.group_thread_counts[gname] = i + 1
+        t = threading.Thread(
+            target=self._actor_loop,
+            args=(state, state.mailboxes[gname], gname),
+            daemon=True,
+            name=f"ray_tpu-actor-{state.cls.__name__}-{gname}-{i}",
+        )
+        state.threads.append(t)
+        t.start()
+
+    def _maybe_grow_actor_threads(self, state: _ActorState, spec) -> None:
+        self._grow_if_backlogged(state, spec.concurrency_group or "_default")
+
+    def _grow_if_backlogged(self, state: _ActorState, gname: str) -> None:
+        """Elastic mailbox serving: one more thread when calls are queueing
+        and every existing thread is stuck IN a call (sync methods blocking);
+        async callback completion keeps threads un-busy, so a burst doesn't
+        spawn hundreds of threads. Called from submit AND from each busy
+        pickup, so the chain reaches the group limit without further
+        submissions."""
+        limits = getattr(state, "group_thread_limits", None)
+        if limits is None:
+            return
+        mb = state.mailboxes.get(gname, state.mailbox)
+        with state.lock:
+            spawned = state.group_thread_counts.get(gname, 0)
+            if (state.state == "ALIVE"
+                    and spawned < limits.get(gname, 1)
+                    and mb.qsize() > 0
+                    and state.group_busy.get(gname, 0) >= spawned):
+                self._spawn_actor_thread(state, gname)
 
     def _spawn_proc_actor(self, state: _ActorState, spec: TaskSpec) -> None:
         from ray_tpu.core.process_pool import DedicatedActorWorker
@@ -1905,7 +1945,8 @@ class Runtime:
             state._renv_ctx = cached
         return cached
 
-    def _actor_loop(self, state: _ActorState, mailbox: "queue.Queue") -> None:
+    def _actor_loop(self, state: _ActorState, mailbox: "queue.Queue",
+                    gname: str = "_default") -> None:
         """Per-actor execution loop: ordered mailbox (task_receiver.cc ordered queues).
 
         ``mailbox`` is the concurrency-group queue this thread serves."""
@@ -1916,10 +1957,23 @@ class Runtime:
                 if state.loop is None:
                     state.loop = asyncio.new_event_loop()
                     threading.Thread(target=state.loop.run_forever, daemon=True).start()
+        busy_marked = False
         while True:
+            if busy_marked:
+                with state.lock:
+                    state.group_busy[gname] = state.group_busy.get(gname, 1) - 1
+                busy_marked = False
             item = mailbox.get()
             if item is None:
                 return
+            with state.lock:
+                state.group_busy[gname] = state.group_busy.get(gname, 0) + 1
+            busy_marked = True
+            # growth must be reachable WITHOUT another submit: a burst that
+            # queued while threads were idle re-checks here, and each newly
+            # busy pickup with backlog chains the next spawn — so queued
+            # work can never strand behind blocked threads
+            self._grow_if_backlogged(state, gname)
             spec, _ = item
             entry = self._tasks.get(spec.task_id)
             if entry is not None and entry.cancelled:
@@ -1940,6 +1994,10 @@ class Runtime:
                     with state.lock:
                         state.pending_count -= 1
                 if state.state != "ALIVE":
+                    if busy_marked:
+                        with state.lock:
+                            state.group_busy[gname] = state.group_busy.get(gname, 1) - 1
+                        busy_marked = False
                     return  # incarnation over (death or restart pending)
                 continue
             try:
@@ -1995,7 +2053,30 @@ class Runtime:
                                 return await _m(*a, **kw)
 
                 if is_coro:
-                    fut = asyncio.run_coroutine_threadsafe(method(*args, **kwargs), state.loop)
+                    group_limit = (state.concurrency_groups.get(gname)
+                                   if gname != "_default"
+                                   else state.max_concurrency) or 1
+                    if group_limit > 1:
+                        # CALLBACK completion: this mailbox thread moves on
+                        # immediately — ONE thread serves every in-flight
+                        # coroutine instead of parking a thread per call
+                        # (reference: the asyncio replica model; overlapping
+                        # completion is the max_concurrency>1 contract).
+                        # Admission is bounded PER GROUP, and the permit is
+                        # taken BEFORE the coroutine is scheduled so in-flight
+                        # never exceeds the declared limit.
+                        sem = self._actor_async_sem(state, gname, group_limit)
+                        sem.acquire()
+                        fut = asyncio.run_coroutine_threadsafe(
+                            method(*args, **kwargs), state.loop)
+                        retrying = True  # callback owns dep/pending bookkeeping
+                        fut.add_done_callback(
+                            lambda f, spec=spec, entry=entry, mailbox=mailbox:
+                            self._finish_async_actor_call(
+                                state, spec, entry, mailbox, sem, f))
+                        continue
+                    fut = asyncio.run_coroutine_threadsafe(
+                        method(*args, **kwargs), state.loop)
                     result = fut.result()
                 elif is_gen:
                     self._execute_actor_generator(spec, method, args, kwargs)
@@ -2041,6 +2122,71 @@ class Runtime:
                     )
                     with state.lock:
                         state.pending_count -= 1
+
+    def _actor_async_sem(self, state: _ActorState, gname: str, limit: int):
+        """Per-GROUP in-flight bound for callback-completed async calls."""
+        sems = getattr(state, "_async_sems", None)
+        if sems is None:
+            with state.lock:
+                sems = getattr(state, "_async_sems", None)
+                if sems is None:
+                    sems = state._async_sems = {}
+        with state.lock:
+            sem = sems.get(gname)
+            if sem is None:
+                sem = sems[gname] = threading.BoundedSemaphore(max(1, limit))
+        return sem
+
+    def _finish_async_actor_call(self, state: _ActorState, spec, entry,
+                                 mailbox, sem, fut) -> None:
+        """Event-loop callback: the tail of _actor_loop for async methods
+        completed without a parked thread (store/fail/retry + bookkeeping)."""
+        retrying = False
+        try:
+            try:
+                result = fut.result()
+            except BaseException as e:  # noqa: BLE001
+                attempts = entry.attempts if entry else 0
+                if (_retries_left(spec, attempts) and _should_retry(spec, e)
+                        and state.state == "ALIVE"):
+                    if entry:
+                        entry.attempts += 1
+                    retrying = True
+                    logger.warning(
+                        "Actor task %s failed (%s); retry %d/%d",
+                        spec.desc(), type(e).__name__, attempts + 1,
+                        spec.max_retries,
+                    )
+                    self._record_event(spec, "RETRYING")
+                    mailbox.put((spec, spec.return_ids()[0]))
+                    return
+                if entry:
+                    entry.state = "FAILED"
+                    entry.end_time = time.time()
+                self._record_event(spec, "FAILED")
+                self._store_error(spec, TaskError(e, spec.desc()))
+                return
+            try:
+                self._store_returns(spec, result)
+            except BaseException as e:  # noqa: BLE001 — e.g. unserializable
+                if entry:
+                    entry.state = "FAILED"
+                    entry.end_time = time.time()
+                self._record_event(spec, "FAILED")
+                self._store_error(spec, TaskError(e, spec.desc()))
+                return
+            if entry:
+                entry.state = "FINISHED"
+                entry.end_time = time.time()
+            self._record_event(spec, "FINISHED")
+        finally:
+            sem.release()
+            if not retrying:
+                self.reference_counter.remove_submitted_task_refs(
+                    [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+                )
+                with state.lock:
+                    state.pending_count -= 1
 
     def _run_proc_actor_generator(self, spec: TaskSpec, proc_worker,
                                   args_blob: bytes) -> None:
@@ -2225,11 +2371,17 @@ class Runtime:
         with state.lock:
             state.pending_count += 1
         self._record_event(spec, "PENDING")
+        # The caller's refs must exist BEFORE the task can complete: a fast
+        # method finishing between the enqueue and the ref construction would
+        # otherwise drop the return's pending-pin to zero and free the fresh
+        # result under the caller.
+        out_refs = [ObjectRef(r, self) for r in spec.return_ids()]
         mailbox.put((spec, spec.return_ids()[0]))
+        self._maybe_grow_actor_threads(state, spec)
         if state.state == "DEAD":
             # Raced with kill_actor's drain: no thread will serve the mailbox now.
             self._drain_mailbox(state, ActorDiedError(state.death_cause or "actor is dead"))
-        return [ObjectRef(r, self) for r in spec.return_ids()]
+        return out_refs
 
     def _make_actor_task_spec(self, actor_id, method_name, args, kwargs, options) -> TaskSpec:
         # Per-call max_task_retries overrides the actor-level default
@@ -2311,6 +2463,7 @@ class Runtime:
         self._publish_actor_event(state)
         state.threads = []
         state.group_thread_counts = {}
+        state.group_busy = {}
         if state.name:
             with self._lock:
                 self._named_actors.setdefault((state.namespace, state.name), actor_id)

@@ -58,6 +58,7 @@ class RuntimeEnvContext:
         self.env_vars: dict[str, str] = {}
         self.py_paths: list[str] = []
         self.working_dir: str | None = None
+        self.profiler_dir: str | None = None  # jax XPlane capture around the task
 
 
 class EnvVarsPlugin(RuntimeEnvPlugin):
@@ -295,9 +296,37 @@ class UvPlugin(PipPlugin):
         return removed
 
 
+class ProfilerPlugin(RuntimeEnvPlugin):
+    """Per-task accelerator profiling (reference: the runtime_env nsight/
+    profiler plugins, runtime_env/nsight.py — GPU profilers attached around
+    the worker; the TPU-native equivalent is a jax profiler XPlane capture
+    scoped to the task's execution). Usage:
+
+        @ray_tpu.remote(runtime_env={"profiler": {"dir": "/tmp/prof"}})
+        def step(...): ...
+
+    Artifacts land under dir/ (open with xprof / tensorboard's profile
+    plugin); concurrent captures in one process are skipped, not errors
+    (jax allows one active trace per process)."""
+
+    name = "profiler"
+    priority = 90  # innermost: wraps only the user code, after env/paths
+
+    def validate(self, value):
+        if not isinstance(value, dict) or not isinstance(value.get("dir"), str):
+            raise ValueError('profiler must be {"dir": <output path>}')
+        mode = value.get("mode", "jax")
+        if mode != "jax":
+            raise ValueError(f"unsupported profiler mode {mode!r} (only 'jax')")
+        return value
+
+    def create(self, value, context):
+        context.profiler_dir = value["dir"]
+
+
 _PLUGINS: dict[str, RuntimeEnvPlugin] = {
     p.name: p for p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
-                        PipPlugin(), UvPlugin())
+                        PipPlugin(), UvPlugin(), ProfilerPlugin())
 }
 
 
@@ -383,9 +412,26 @@ def apply_context(ctx: RuntimeEnvContext):
                 sys.path.insert(0, p)
         if ctx.working_dir:
             os.chdir(ctx.working_dir)
+    profiling = False
+    if ctx.profiler_dir:
+        try:
+            import jax
+
+            os.makedirs(ctx.profiler_dir, exist_ok=True)
+            jax.profiler.start_trace(ctx.profiler_dir)
+            profiling = True
+        except Exception:
+            profiling = False  # another trace active / no backend: skip
     try:
         yield
     finally:
+        if profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
         with _APPLY_LOCK:
             for k, v in saved_env.items():
                 if v is None:

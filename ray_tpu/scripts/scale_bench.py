@@ -178,6 +178,47 @@ def bench_dispatch(n_agents: int, tasks_per_agent: int = 20) -> list[dict]:
     return out
 
 
+def bench_broadcast(n_agents: int, mb: int = 64) -> list[dict]:
+    """One large driver object consumed on every node (reference envelope:
+    1 GiB broadcast to 50+ nodes, release/benchmarks/README.md:20 — scaled
+    to this box). Consumers resolve the arg through the object plane; a node
+    that pulled seeds its local store and announces the copy, so later
+    pullers can fetch from ANY holder, not just the head."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+
+    out = []
+    cluster = Cluster()
+    for _ in range(n_agents):
+        cluster.add_node(num_cpus=1, real_process=True, isolated_plane=True,
+                         resources={"bcast": 1})
+
+    blob = np.random.default_rng(0).integers(
+        0, 255, size=mb * 1024 * 1024, dtype=np.uint8)
+    ref = ray_tpu.put(blob)
+
+    @ray_tpu.remote(num_cpus=1, resources={"bcast": 1},
+                    scheduling_strategy="SPREAD")
+    def consume(x):
+        return int(x.nbytes)
+
+    t0 = time.perf_counter()
+    sizes = ray_tpu.get([consume.remote(ref) for _ in range(n_agents)],
+                        timeout=1800)
+    dt = time.perf_counter() - t0
+    assert all(s == mb * 1024 * 1024 for s in sizes)
+    out.append({
+        "metric": "object_broadcast",
+        "agents": n_agents,
+        "object_mb": mb,
+        "total_moved_mb": mb * n_agents,
+        "secs": round(dt, 2),
+        "agg_bandwidth_mb_s": round(mb * n_agents / max(dt, 1e-9), 1),
+    })
+    return out
+
+
 def bench_placement_groups(n: int) -> list[dict]:
     """n simultaneous 1-bundle PGs on a cluster with room for all of them."""
     rt = get_runtime()
@@ -200,12 +241,15 @@ def bench_placement_groups(n: int) -> list[dict]:
 
 
 def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int,
-        dispatch_agents: int = 0) -> list[dict]:
+        dispatch_agents: int = 0, broadcast_agents: int = 0,
+        broadcast_mb: int = 64) -> list[dict]:
     results = []
     ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
     for section, fn in (
         ("nodes", lambda: bench_nodes(nodes, real_agents)),
         ("dispatch", lambda: bench_dispatch(dispatch_agents) if dispatch_agents else []),
+        ("broadcast", lambda: bench_broadcast(broadcast_agents, broadcast_mb)
+                      if broadcast_agents else []),
         ("actors", lambda: bench_actors(actors)),
         ("queued_tasks", lambda: bench_queued_tasks(tasks)),
         ("placement_groups", lambda: bench_placement_groups(pgs)),
@@ -256,9 +300,13 @@ if __name__ == "__main__":
     ap.add_argument("--tasks", type=int, default=100_000)
     ap.add_argument("--pgs", type=int, default=1000)
     ap.add_argument("--dispatch-agents", type=int, default=0)
+    ap.add_argument("--broadcast-agents", type=int, default=0)
+    ap.add_argument("--broadcast-mb", type=int, default=64)
     ap.add_argument("--md", default="SCALE_r05.md")
     a = ap.parse_args()
     res = run(a.nodes, a.real_agents, a.actors, a.tasks, a.pgs,
-              dispatch_agents=a.dispatch_agents)
+              dispatch_agents=a.dispatch_agents,
+              broadcast_agents=a.broadcast_agents,
+              broadcast_mb=a.broadcast_mb)
     if a.md:
         write_md(res, a.md, a)

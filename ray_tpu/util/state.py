@@ -109,10 +109,48 @@ def timeline(path: str | None = None) -> list[dict]:
                     "args": {"state": ev["state"]},
                 }
             )
+    trace.extend(_worker_profile_events())
     if path:
         with open(path, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def _worker_profile_events() -> list[dict]:
+    """Worker-side execution windows from the export pipeline (when export
+    events are on): one 'exec' lane per worker pid, so the timeline shows
+    the dispatch-side span AND the worker's own wall-clock window
+    (reference: ray timeline merging worker profile events)."""
+    import glob
+    import os
+
+    from ray_tpu._private import export_events
+
+    if not export_events.enabled() or export_events._DIR is None:
+        return []
+    out: list[dict] = []
+    try:
+        for p in glob.glob(os.path.join(export_events._DIR,
+                                        "export_task_profile*.jsonl")):
+            with open(p) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)["event_data"]
+                    except (ValueError, KeyError):
+                        continue
+                    out.append({
+                        "name": f"exec:{(ev.get('task_id') or '?')[:12]}",
+                        "cat": "worker_exec",
+                        "ph": "X",
+                        "ts": int(ev["exec_start"] * 1e6),
+                        "dur": int((ev["exec_end"] - ev["exec_start"]) * 1e6),
+                        "pid": 2,  # separate track group from head-side spans
+                        "tid": ev.get("worker_pid") or 0,
+                        "args": {"status": ev.get("status")},
+                    })
+    except OSError:
+        pass
+    return out
 
 
 def _apply_filters(rows: list[dict], filters) -> list[dict]:

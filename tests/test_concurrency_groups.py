@@ -223,3 +223,59 @@ def test_proc_actor_grouped_stream_does_not_block_other_group(ray_start_regular)
     assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
     assert _t.monotonic() - t0 < 1.0  # did not wait for the 1.5s stream
     assert [ray_tpu.get(r) for r in it] == list(range(1, 10))
+
+
+def test_elastic_threads_never_strand_queued_calls(ray_start_regular):
+    """Round-5 elastic mailbox threads: blocked sync calls must not strand a
+    queued unblocking call (growth chains from each busy pickup, not only
+    from submissions)."""
+    import threading as _th
+
+    @ray_tpu.remote(max_concurrency=8)
+    class Gate:
+        def __init__(self):
+            self.ev = _th.Event()
+
+        def blocked(self):
+            self.ev.wait(30)
+            return "released"
+
+        def release(self):
+            self.ev.set()
+            return "set"
+
+    g = Gate.remote()
+    blocked = [g.blocked.remote() for _ in range(5)]  # > initial 4 threads
+    import time as _t
+
+    _t.sleep(0.3)  # let the blockers occupy/queue
+    rel = g.release.remote()  # no further submits after this one
+    assert ray_tpu.get(rel, timeout=30) == "set"
+    assert ray_tpu.get(blocked, timeout=60) == ["released"] * 5
+
+
+def test_async_group_limit_respected(ray_start_regular):
+    """Callback-completed async methods stay bounded by their concurrency
+    GROUP's limit, not the actor-wide max_concurrency."""
+    import asyncio as _aio
+
+    @ray_tpu.remote(max_concurrency=16, concurrency_groups={"io": 2})
+    class A:
+        def __init__(self):
+            self.active = 0
+            self.peak = 0
+
+        @ray_tpu.method(concurrency_group="io")
+        async def io_call(self):
+            self.active += 1
+            self.peak = max(self.peak, self.active)
+            await _aio.sleep(0.05)
+            self.active -= 1
+            return self.peak
+
+        def peak_seen(self):
+            return self.peak
+
+    a = A.remote()
+    ray_tpu.get([a.io_call.remote() for _ in range(10)], timeout=60)
+    assert ray_tpu.get(a.peak_seen.remote(), timeout=30) <= 2

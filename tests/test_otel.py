@@ -116,3 +116,34 @@ def test_worker_side_profile_events(tmp_path, monkeypatch):
         assert ev["status"] in ("val", "shm", "err")
     finally:
         ray_tpu.shutdown()
+
+
+@pytest.mark.fast
+def test_timeline_merges_worker_exec_lanes(tmp_path, monkeypatch):
+    """`ray timeline` parity: worker execution windows appear as their own
+    track group alongside head-side task spans."""
+    monkeypatch.setenv("RAY_TPU_EXPORT_EVENTS_ENABLED", "1")
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        from ray_tpu.util import state
+
+        @ray_tpu.remote
+        def t():
+            return 1
+
+        assert ray_tpu.get(t.remote(), timeout=60) == 1
+        import time as _t
+
+        deadline = _t.time() + 30
+        exec_rows = []
+        while _t.time() < deadline:
+            exec_rows = [e for e in state.timeline() if e["cat"] == "worker_exec"]
+            if exec_rows:
+                break
+            _t.sleep(0.1)
+        assert exec_rows, "no worker exec lanes in timeline"
+        assert all(e["pid"] == 2 and e["dur"] >= 0 for e in exec_rows)
+        # head-side spans still present
+        assert any(e["cat"] == "task" for e in state.timeline())
+    finally:
+        ray_tpu.shutdown()

@@ -32,8 +32,11 @@ def test_1k_concurrent_inflight_bounded_threads(session):
 
     @deployment(name="Echo", num_replicas=2, max_ongoing_requests=600)
     class Echo:
-        def __call__(self, body):
-            time.sleep(0.05)  # hold many requests in flight simultaneously
+        async def __call__(self, body):
+            # async replica: in-flight calls ride the actor's event loop
+            # (callback completion) — no thread parks per request anywhere
+            # on the path (reference: asyncio replicas + asyncio router)
+            await asyncio.sleep(0.05)
             return {"v": body.get("v")}
 
     serve.run(Echo.bind(), route_prefix="/echo")
