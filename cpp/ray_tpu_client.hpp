@@ -2,11 +2,12 @@
 //
 // Parity: the reference's C++ worker API surface (cpp/include/ray/api.h —
 // ray::Init, ray::Task(...).Remote(), ray::Get, actor handles), re-scoped to
-// the cross-language client model: functions/actors are invoked by REGISTERED
-// name on the Python session (the descriptor model of cross_language.py), over
-// the session's JSON-framed xlang endpoint (ray_tpu/experimental/xlang.py).
-// Header-only; no third-party dependencies (a minimal JSON value type and
-// recursive-descent parser are included).
+// the cross-language client model: functions/actors are invoked by
+// REGISTERED name on the Python session (the descriptor model of
+// cross_language.py). The client speaks the session's NATIVE control plane
+// (ray_tpu/core/rpc/): length-prefixed msgpack frames, hello-time schema
+// version negotiation, numbered ops — the same wire Python workers use, not
+// a JSON side-channel. Header-only; a minimal msgpack codec is included.
 //
 // Usage:
 //   rtpu::Client c = rtpu::Init("127.0.0.1", port, token);
@@ -37,12 +38,38 @@
 
 namespace rtpu {
 
-// ----------------------------------------------------------------- JSON
+// Wire protocol constants — MUST match ray_tpu/core/rpc/schema.py +
+// codec.py (numbered, append-only schemas; renumbering is a wire break).
+constexpr const char* kWireMagic = "rtpu1";
+constexpr int kWireVersionMin = 2;  // xl_* ops exist since v2
+constexpr int kWireVersionMax = 2;
+
+enum FrameKind { kHello = 0, kRequest = 1, kNotify = 2, kReply = 3,
+                 kError = 4, kGoodbye = 5 };
+
+constexpr uint32_t kMaxFrame = 1u << 31;  // codec.py MAX_FRAME
+
+enum OpNum {
+  kOpHello = 1,
+  kOpXlCall = 41,
+  kOpXlSubmit = 42,
+  kOpXlGet = 43,
+  kOpXlPut = 44,
+  kOpXlFree = 45,
+  kOpXlActorCreate = 46,
+  kOpXlActorCall = 47,
+  kOpXlKillActor = 48,
+  kOpXlListFuncs = 49,
+};
+
+// -------------------------------------------------------------- value type
+// Language-neutral value (named Json for API compatibility; the wire is
+// msgpack, which adds a native binary type — no base64 envelopes).
 struct Json {
-  enum Type { Null, Bool, Num, Str, Arr, Obj } type = Null;
+  enum Type { Null, Bool, Num, Str, Arr, Obj, Bin } type = Null;
   bool b = false;
   double num = 0;
-  std::string str;
+  std::string str;  // Str text or Bin bytes
   std::vector<Json> arr;
   std::map<std::string, Json> obj;
 
@@ -57,15 +84,22 @@ struct Json {
     Json j; j.type = Arr; j.arr = std::move(items); return j;
   }
   static Json Object() { Json j; j.type = Obj; return j; }
+  static Json Bytes(std::string raw) {
+    Json j; j.type = Bin; j.str = std::move(raw); return j;
+  }
 
   bool is_null() const { return type == Null; }
   double AsNum() const {
-    if (type != Num) throw std::runtime_error("json: not a number");
+    if (type != Num) throw std::runtime_error("value: not a number");
     return num;
   }
   long AsInt() const { return static_cast<long>(AsNum()); }
   const std::string& AsStr() const {
-    if (type != Str) throw std::runtime_error("json: not a string");
+    if (type != Str) throw std::runtime_error("value: not a string");
+    return str;
+  }
+  const std::string& AsBytes() const {
+    if (type != Bin) throw std::runtime_error("value: not bytes");
     return str;
   }
   const Json& operator[](const std::string& k) const {
@@ -74,6 +108,7 @@ struct Json {
     return it == obj.end() ? null_ : it->second;
   }
 
+  // Debug rendering (JSON-ish; bytes shown as <n bytes>).
   void Dump(std::ostringstream& o) const {
     switch (type) {
       case Null: o << "null"; break;
@@ -88,7 +123,8 @@ struct Json {
         }
         break;
       }
-      case Str: DumpStr(o, str); break;
+      case Str: o << '"' << str << '"'; break;
+      case Bin: o << '<' << str.size() << " bytes>"; break;
       case Arr: {
         o << '[';
         for (size_t i = 0; i < arr.size(); i++) {
@@ -104,8 +140,7 @@ struct Json {
         for (auto& kv : obj) {
           if (!first) o << ',';
           first = false;
-          DumpStr(o, kv.first);
-          o << ':';
+          o << '"' << kv.first << "\":";
           kv.second.Dump(o);
         }
         o << '}';
@@ -118,179 +153,215 @@ struct Json {
     Dump(o);
     return o.str();
   }
+};
 
-  static void DumpStr(std::ostringstream& o, const std::string& s) {
-    o << '"';
-    for (unsigned char c : s) {
-      switch (c) {
-        case '"': o << "\\\""; break;
-        case '\\': o << "\\\\"; break;
-        case '\n': o << "\\n"; break;
-        case '\r': o << "\\r"; break;
-        case '\t': o << "\\t"; break;
-        default:
-          if (c < 0x20) {
-            char buf[8];
-            snprintf(buf, sizeof buf, "\\u%04x", c);
-            o << buf;
-          } else {
-            o << c;
-          }
-      }
+// ------------------------------------------------------------ msgpack pack
+class MsgpackWriter {
+ public:
+  std::string out;
+
+  void PackNil() { out += static_cast<char>(0xc0); }
+  void PackBool(bool v) { out += static_cast<char>(v ? 0xc3 : 0xc2); }
+
+  void PackInt(int64_t v) {
+    if (v >= 0 && v <= 127) {
+      out += static_cast<char>(v);
+    } else if (v < 0 && v >= -32) {
+      out += static_cast<char>(0xe0 | (v + 32));
+    } else {
+      out += static_cast<char>(0xd3);
+      PackBE64(static_cast<uint64_t>(v));
     }
-    o << '"';
+  }
+  void PackDouble(double v) {
+    out += static_cast<char>(0xcb);
+    uint64_t bits;
+    memcpy(&bits, &v, 8);
+    PackBE64(bits);
+  }
+  void PackStr(const std::string& s) {
+    size_t n = s.size();
+    if (n <= 31) {
+      out += static_cast<char>(0xa0 | n);
+    } else if (n <= 0xffff) {
+      out += static_cast<char>(0xda);
+      PackBE16(static_cast<uint16_t>(n));
+    } else {
+      out += static_cast<char>(0xdb);
+      PackBE32(static_cast<uint32_t>(n));
+    }
+    out += s;
+  }
+  void PackBin(const std::string& s) {
+    out += static_cast<char>(0xc6);
+    PackBE32(static_cast<uint32_t>(s.size()));
+    out += s;
+  }
+  void PackArrayHeader(size_t n) {
+    if (n <= 15) {
+      out += static_cast<char>(0x90 | n);
+    } else {
+      out += static_cast<char>(0xdd);
+      PackBE32(static_cast<uint32_t>(n));
+    }
+  }
+  void PackMapHeader(size_t n) {
+    if (n <= 15) {
+      out += static_cast<char>(0x80 | n);
+    } else {
+      out += static_cast<char>(0xdf);
+      PackBE32(static_cast<uint32_t>(n));
+    }
+  }
+  void PackValue(const Json& v) {
+    switch (v.type) {
+      case Json::Null: PackNil(); break;
+      case Json::Bool: PackBool(v.b); break;
+      case Json::Num: {
+        // integral doubles travel as ints (matches the Python side's
+        // int/float distinction for registered functions doing arithmetic)
+        if (std::isfinite(v.num) && v.num == static_cast<int64_t>(v.num) &&
+            std::fabs(v.num) < 9e15) {
+          PackInt(static_cast<int64_t>(v.num));
+        } else {
+          PackDouble(v.num);
+        }
+        break;
+      }
+      case Json::Str: PackStr(v.str); break;
+      case Json::Bin: PackBin(v.str); break;
+      case Json::Arr:
+        PackArrayHeader(v.arr.size());
+        for (const auto& x : v.arr) PackValue(x);
+        break;
+      case Json::Obj:
+        PackMapHeader(v.obj.size());
+        for (const auto& kv : v.obj) {
+          PackStr(kv.first);
+          PackValue(kv.second);
+        }
+        break;
+    }
+  }
+
+ private:
+  void PackBE16(uint16_t v) {
+    out += static_cast<char>(v >> 8);
+    out += static_cast<char>(v & 0xff);
+  }
+  void PackBE32(uint32_t v) {
+    for (int s = 24; s >= 0; s -= 8) out += static_cast<char>((v >> s) & 0xff);
+  }
+  void PackBE64(uint64_t v) {
+    for (int s = 56; s >= 0; s -= 8) out += static_cast<char>((v >> s) & 0xff);
   }
 };
 
-// Recursive-descent parser (subset sufficient for the xlang protocol:
-// standard JSON with \uXXXX escapes decoded to UTF-8).
-class JsonParser {
+// ---------------------------------------------------------- msgpack unpack
+class MsgpackReader {
  public:
-  explicit JsonParser(const std::string& s) : s_(s) {}
-  Json Parse() {
-    Json v = Value();
-    Ws();
-    if (i_ != s_.size()) throw std::runtime_error("json: trailing data");
-    return v;
+  explicit MsgpackReader(const std::string& s) : s_(s) {}
+
+  Json Read() {
+    uint8_t t = Byte();
+    if (t <= 0x7f) return Json(static_cast<double>(t));           // posfixint
+    if (t >= 0xe0) return Json(static_cast<double>(static_cast<int8_t>(t)));
+    if (t >= 0x80 && t <= 0x8f) return ReadMap(t & 0x0f);         // fixmap
+    if (t >= 0x90 && t <= 0x9f) return ReadArray(t & 0x0f);       // fixarray
+    if (t >= 0xa0 && t <= 0xbf) return ReadStr(t & 0x1f);         // fixstr
+    switch (t) {
+      case 0xc0: return Json();
+      case 0xc2: return Json(false);
+      case 0xc3: return Json(true);
+      case 0xc4: return ReadBin(BE8());
+      case 0xc5: return ReadBin(BE16());
+      case 0xc6: return ReadBin(BE32());
+      case 0xca: {
+        uint32_t bits = BE32();
+        float f;
+        memcpy(&f, &bits, 4);
+        return Json(static_cast<double>(f));
+      }
+      case 0xcb: {
+        uint64_t bits = BE64();
+        double d;
+        memcpy(&d, &bits, 8);
+        return Json(d);
+      }
+      case 0xcc: return Json(static_cast<double>(BE8()));
+      case 0xcd: return Json(static_cast<double>(BE16()));
+      case 0xce: return Json(static_cast<double>(BE32()));
+      case 0xcf: return Json(static_cast<double>(BE64()));
+      case 0xd0: return Json(static_cast<double>(static_cast<int8_t>(BE8())));
+      case 0xd1: return Json(static_cast<double>(static_cast<int16_t>(BE16())));
+      case 0xd2: return Json(static_cast<double>(static_cast<int32_t>(BE32())));
+      case 0xd3: return Json(static_cast<double>(static_cast<int64_t>(BE64())));
+      case 0xd9: return ReadStr(BE8());
+      case 0xda: return ReadStr(BE16());
+      case 0xdb: return ReadStr(BE32());
+      case 0xdc: return ReadArray(BE16());
+      case 0xdd: return ReadArray(BE32());
+      case 0xde: return ReadMap(BE16());
+      case 0xdf: return ReadMap(BE32());
+      default:
+        throw std::runtime_error("msgpack: unsupported type byte");
+    }
   }
 
  private:
   const std::string& s_;
   size_t i_ = 0;
 
-  void Ws() {
-    while (i_ < s_.size() && (s_[i_] == ' ' || s_[i_] == '\t' ||
-                              s_[i_] == '\n' || s_[i_] == '\r'))
-      i_++;
+  uint8_t Byte() {
+    if (i_ >= s_.size()) throw std::runtime_error("msgpack: truncated");
+    return static_cast<uint8_t>(s_[i_++]);
   }
-  char Peek() {
-    if (i_ >= s_.size()) throw std::runtime_error("json: eof");
-    return s_[i_];
+  uint64_t BE8() { return Byte(); }
+  uint64_t BE16() { uint64_t v = Byte(); return (v << 8) | Byte(); }
+  uint64_t BE32() {
+    uint64_t v = 0;
+    for (int k = 0; k < 4; k++) v = (v << 8) | Byte();
+    return v;
   }
-  void Expect(char c) {
-    if (Peek() != c) throw std::runtime_error(std::string("json: expected ") + c);
-    i_++;
+  uint64_t BE64() {
+    uint64_t v = 0;
+    for (int k = 0; k < 8; k++) v = (v << 8) | Byte();
+    return v;
   }
-  bool Lit(const char* lit) {
-    size_t n = strlen(lit);
-    if (s_.compare(i_, n, lit) == 0) {
-      i_ += n;
-      return true;
-    }
-    return false;
+  std::string Raw(size_t n) {
+    if (i_ + n > s_.size()) throw std::runtime_error("msgpack: truncated");
+    std::string out = s_.substr(i_, n);
+    i_ += n;
+    return out;
   }
-  Json Value() {
-    Ws();
-    char c = Peek();
-    if (c == '{') return ObjectV();
-    if (c == '[') return ArrayV();
-    if (c == '"') {
-      Json j;
-      j.type = Json::Str;
-      j.str = StringV();
-      return j;
-    }
-    if (Lit("true")) return Json(true);
-    if (Lit("false")) return Json(false);
-    if (Lit("null")) return Json();
-    return NumberV();
+  Json ReadStr(size_t n) {
+    Json j;
+    j.type = Json::Str;
+    j.str = Raw(n);
+    return j;
   }
-  Json ObjectV() {
-    Expect('{');
-    Json j = Json::Object();
-    Ws();
-    if (Peek() == '}') {
-      i_++;
-      return j;
-    }
-    while (true) {
-      Ws();
-      std::string k = StringV();
-      Ws();
-      Expect(':');
-      j.obj[k] = Value();
-      Ws();
-      if (Peek() == ',') {
-        i_++;
-        continue;
-      }
-      Expect('}');
-      return j;
-    }
-  }
-  Json ArrayV() {
-    Expect('[');
+  Json ReadBin(size_t n) { return Json::Bytes(Raw(n)); }
+  Json ReadArray(size_t n) {
     Json j;
     j.type = Json::Arr;
-    Ws();
-    if (Peek() == ']') {
-      i_++;
-      return j;
-    }
-    while (true) {
-      j.arr.push_back(Value());
-      Ws();
-      if (Peek() == ',') {
-        i_++;
-        continue;
-      }
-      Expect(']');
-      return j;
-    }
+    j.arr.reserve(n);
+    for (size_t k = 0; k < n; k++) j.arr.push_back(Read());
+    return j;
   }
-  std::string StringV() {
-    Expect('"');
-    std::string out;
-    while (true) {
-      char c = Peek();
-      i_++;
-      if (c == '"') return out;
-      if (c == '\\') {
-        char e = Peek();
-        i_++;
-        switch (e) {
-          case 'n': out += '\n'; break;
-          case 't': out += '\t'; break;
-          case 'r': out += '\r'; break;
-          case 'b': out += '\b'; break;
-          case 'f': out += '\f'; break;
-          case 'u': {
-            unsigned cp = std::stoul(s_.substr(i_, 4), nullptr, 16);
-            i_ += 4;
-            // BMP-only escape decoding (enough for the protocol's ASCII use)
-            if (cp < 0x80) {
-              out += static_cast<char>(cp);
-            } else if (cp < 0x800) {
-              out += static_cast<char>(0xC0 | (cp >> 6));
-              out += static_cast<char>(0x80 | (cp & 0x3F));
-            } else {
-              out += static_cast<char>(0xE0 | (cp >> 12));
-              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
-              out += static_cast<char>(0x80 | (cp & 0x3F));
-            }
-            break;
-          }
-          default: out += e;
-        }
-      } else {
-        out += c;
-      }
+  Json ReadMap(size_t n) {
+    Json j = Json::Object();
+    for (size_t k = 0; k < n; k++) {
+      Json key = Read();
+      j.obj[key.type == Json::Str ? key.str : key.Dump()] = Read();
     }
-  }
-  Json NumberV() {
-    size_t start = i_;
-    while (i_ < s_.size() &&
-           (isdigit(static_cast<unsigned char>(s_[i_])) || s_[i_] == '-' ||
-            s_[i_] == '+' || s_[i_] == '.' || s_[i_] == 'e' || s_[i_] == 'E'))
-      i_++;
-    return Json(std::stod(s_.substr(start, i_ - start)));
+    return j;
   }
 };
 
 // -------------------------------------------------------- typed conversions
 // The typed task API (reference: cpp/include/ray/api.h — ray::Task(fn)
 // .Remote(native args) with typed ObjectRef<T> returns): native C++ values
-// convert to/from the wire Json automatically, so call sites never touch
+// convert to/from the wire value automatically, so call sites never touch
 // Json when they don't want to.
 inline Json ToJson(const Json& v) { return v; }
 inline Json ToJson(bool v) { return Json(v); }
@@ -331,7 +402,7 @@ template <> struct FromJsonImpl<int> {
 };
 template <> struct FromJsonImpl<bool> {
   static bool Get(const Json& j) {
-    if (j.type != Json::Bool) throw std::runtime_error("json: not a bool");
+    if (j.type != Json::Bool) throw std::runtime_error("value: not a bool");
     return j.b;
   }
 };
@@ -340,7 +411,7 @@ template <> struct FromJsonImpl<std::string> {
 };
 template <typename T> struct FromJsonImpl<std::vector<T>> {
   static std::vector<T> Get(const Json& j) {
-    if (j.type != Json::Arr) throw std::runtime_error("json: not an array");
+    if (j.type != Json::Arr) throw std::runtime_error("value: not an array");
     std::vector<T> out;
     out.reserve(j.arr.size());
     for (const auto& x : j.arr) out.push_back(FromJsonImpl<T>::Get(x));
@@ -424,16 +495,20 @@ class Client {
       throw std::runtime_error("bad host: " + host);
     if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0)
       throw std::runtime_error("connect failed");
+    Handshake();
+    // authenticate on the control plane (op 1), like any worker
     Json hello = Json::Object();
-    hello.obj["op"] = Json("hello");
     hello.obj["token"] = Json(token);
-    Request(hello);
+    hello.obj["kind"] = Json("xlang");
+    Request(kOpHello, hello);
   }
   ~Client() {
     if (fd_ >= 0) close(fd_);
   }
   Client(const Client&) = delete;
   Client& operator=(const Client&) = delete;
+
+  int WireVersion() const { return agreed_version_; }
 
   TaskCaller Task(const std::string& func) { return TaskCaller(this, func); }
 
@@ -446,24 +521,21 @@ class Client {
 
   Actor ActorCreate(const std::string& cls, std::vector<Json> args = {}) {
     Json m = Json::Object();
-    m.obj["op"] = Json("actor_create");
     m.obj["cls"] = Json(cls);
     m.obj["args"] = Json::Array(std::move(args));
-    return Actor(this, Request(m)["actor"].AsStr());
+    return Actor(this, Request(kOpXlActorCreate, m)["actor"].AsStr());
   }
 
   ObjectRef Put(const Json& value) {
     Json m = Json::Object();
-    m.obj["op"] = Json("put");
     m.obj["value"] = value;
-    return ObjectRef{Request(m)["ref"].AsStr()};
+    return ObjectRef{Request(kOpXlPut, m)["ref"].AsStr()};
   }
 
   Json Get(const ObjectRef& ref) {
     Json m = Json::Object();
-    m.obj["op"] = Json("get");
     m.obj["ref"] = Json(ref.id);
-    return Request(m);
+    return Request(kOpXlGet, m);
   }
 
   template <typename T>
@@ -475,18 +547,15 @@ class Client {
   // this a long-lived client pins every object for the server's lifetime.
   void Free(const ObjectRef& ref) {
     Json m = Json::Object();
-    m.obj["op"] = Json("free");
     m.obj["ref"] = Json(ref.id);
-    Request(m);
+    Request(kOpXlFree, m);
   }
 
   template <typename T>
   void Free(const TypedRef<T>& ref) { Free(ObjectRef{ref.id}); }
 
   std::vector<std::string> ListFuncs() {
-    Json m = Json::Object();
-    m.obj["op"] = Json("list_funcs");
-    Json r = Request(m);
+    Json r = Request(kOpXlListFuncs, Json::Object());
     std::vector<std::string> out;
     for (auto& f : r["funcs"].arr) out.push_back(f.AsStr());
     return out;
@@ -494,26 +563,87 @@ class Client {
 
   // one in-flight request per client (callers wanting parallelism open
   // multiple clients — connections are cheap)
-  Json Request(Json msg) {
-    msg.obj["id"] = Json(static_cast<double>(++next_id_));
-    std::string body = msg.Dump();
+  Json Request(int op_num, const Json& payload) {
+    uint64_t mid = ++next_id_;
+    MsgpackWriter w;
+    w.PackArrayHeader(4);
+    w.PackInt(kRequest);
+    w.PackInt(static_cast<int64_t>(mid));
+    w.PackInt(op_num);
+    w.PackValue(payload);
+    SendFrame(w.out);
+    while (true) {
+      Json frame = RecvFrame();
+      long kind = frame.arr.at(0).AsInt();
+      if (kind == kNotify) continue;  // pushed notifications: not ours
+      if (kind == kGoodbye)
+        throw std::runtime_error("server closed: " + frame.arr.at(1).AsStr());
+      if (frame.arr.size() < 3 ||
+          static_cast<uint64_t>(frame.arr.at(1).AsInt()) != mid)
+        throw std::runtime_error("rpc: out-of-order reply");
+      if (kind == kError)
+        throw std::runtime_error("remote error: " + frame.arr.at(2).AsStr());
+      if (kind != kReply) throw std::runtime_error("rpc: unexpected frame");
+      return frame.arr.at(2);
+    }
+  }
+
+ private:
+  void Handshake() {
+    // both ends fire HELLO immediately; agree on min(max_a, max_b)
+    MsgpackWriter w;
+    w.PackArrayHeader(5);
+    w.PackInt(kHello);
+    w.PackStr(kWireMagic);
+    w.PackInt(kWireVersionMin);
+    w.PackInt(kWireVersionMax);
+    w.PackMapHeader(0);
+    SendFrame(w.out);
+    Json frame = RecvFrame();
+    long kind = frame.arr.at(0).AsInt();
+    if (kind == kGoodbye)
+      throw std::runtime_error("server refused: " + frame.arr.at(1).AsStr());
+    if (kind != kHello || frame.arr.size() < 4)
+      throw std::runtime_error("rpc: expected hello frame");
+    if (frame.arr.at(1).AsStr() != kWireMagic)
+      throw std::runtime_error("rpc: bad protocol magic");
+    long peer_min = frame.arr.at(2).AsInt();
+    long peer_max = frame.arr.at(3).AsInt();
+    long agreed = peer_max < kWireVersionMax ? peer_max : kWireVersionMax;
+    long floor_ = peer_min > kWireVersionMin ? peer_min : kWireVersionMin;
+    if (agreed < floor_)
+      throw std::runtime_error(
+          "wire schema version mismatch: client supports [" +
+          std::to_string(kWireVersionMin) + ", " +
+          std::to_string(kWireVersionMax) + "], server supports [" +
+          std::to_string(peer_min) + ", " + std::to_string(peer_max) + "]");
+    agreed_version_ = static_cast<int>(agreed);
+  }
+
+  void SendFrame(const std::string& body) {
     uint32_t n = htonl(static_cast<uint32_t>(body.size()));
     SendAll(reinterpret_cast<const char*>(&n), 4);
     SendAll(body.data(), body.size());
+  }
+  Json RecvFrame() {
     char hdr[4];
     RecvAll(hdr, 4);
     uint32_t len;
     memcpy(&len, hdr, 4);
     len = ntohl(len);
-    std::string reply(len, '\0');
-    RecvAll(&reply[0], len);
-    Json r = JsonParser(reply).Parse();
-    if (!r["error"].is_null())
-      throw std::runtime_error("remote error: " + r["error"].AsStr());
-    return r["result"];
+    if (len > kMaxFrame)
+      // e.g. an HTTP response's first bytes parsed as a length — reject
+      // before allocating gigabytes (matches codec.py unpack_header)
+      throw std::runtime_error(
+          "rpc: frame length " + std::to_string(len) +
+          " exceeds MAX_FRAME (not an rtpu endpoint?)");
+    std::string body(len, '\0');
+    RecvAll(&body[0], len);
+    Json frame = MsgpackReader(body).Read();
+    if (frame.type != Json::Arr || frame.arr.empty())
+      throw std::runtime_error("rpc: malformed frame");
+    return frame;
   }
-
- private:
   void SendAll(const char* p, size_t n) {
     while (n) {
       ssize_t k = send(fd_, p, n, 0);
@@ -531,44 +661,41 @@ class Client {
     }
   }
   int fd_ = -1;
+  int agreed_version_ = 0;
   uint64_t next_id_ = 0;
 };
 
 template <typename... A>
 Json TaskCaller::Remote(A&&... args) {
   Json m = Json::Object();
-  m.obj["op"] = Json("call");
   m.obj["func"] = Json(func_);
   m.obj["args"] = Json::Array({Json(std::forward<A>(args))...});
-  return c_->Request(m);
+  return c_->Request(kOpXlCall, m);
 }
 
 template <typename... A>
 ObjectRef TaskCaller::RemoteAsync(A&&... args) {
   Json m = Json::Object();
-  m.obj["op"] = Json("submit");
   m.obj["func"] = Json(func_);
   m.obj["args"] = Json::Array({Json(std::forward<A>(args))...});
-  return ObjectRef{c_->Request(m)["ref"].AsStr()};
+  return ObjectRef{c_->Request(kOpXlSubmit, m)["ref"].AsStr()};
 }
 
 template <typename... A>
 Json Actor::Call(const std::string& method, A&&... args) {
   if (c_ == nullptr) throw std::runtime_error("Actor not initialized");
   Json m = Json::Object();
-  m.obj["op"] = Json("actor_call");
   m.obj["actor"] = Json(id_);
   m.obj["method"] = Json(method);
   m.obj["args"] = Json::Array({Json(std::forward<A>(args))...});
-  return c_->Request(m);
+  return c_->Request(kOpXlActorCall, m);
 }
 
 inline void Actor::Kill() {
   if (c_ == nullptr) throw std::runtime_error("Actor not initialized");
   Json m = Json::Object();
-  m.obj["op"] = Json("kill_actor");
   m.obj["actor"] = Json(id_);
-  c_->Request(m);
+  c_->Request(kOpXlKillActor, m);
 }
 
 inline Client Init(const std::string& host, int port, const std::string& token) {

@@ -260,8 +260,12 @@ def restore_session(runtime) -> int:
         oid = ObjectID(oid_bin)
         size = 0
         for node_bin, sz in holders.items():
+            # seeded=True: unconfirmed until the holder agent re-registers;
+            # expires after the reconnect grace window (runtime
+            # _expire_seeded_planes) so pre-crash refs whose holder died
+            # with the old head don't hang get() forever
             runtime.plane_object_added(oid, NodeID(node_bin), size=sz,
-                                       _persist=False)
+                                       _persist=False, seeded=True)
             size = max(size, sz)
         if not runtime.memory_store.contains(oid):
             runtime.memory_store.put(oid, RayObject(size=size, in_shm=True))

@@ -155,63 +155,58 @@ class ClientRuntime:
         return self._rpc().call("debug_list", timeout=10)
 
     # ------------------------------------------------------------ transport
+    def _connect_once(self):
+        """One connect + authenticated hello; returns the live peer."""
+        from ray_tpu.core import rpc
+
+        peer = rpc.connect(
+            self._host, self._port,
+            handlers={"pubsub_msg": self._h_pubsub_msg},
+            name=f"worker-{os.getpid()}",
+        )
+        try:
+            peer.call("hello", token=self._token, kind="worker",
+                      pid=os.getpid(), node=self._node_bin,
+                      plane=self._plane_mode,
+                      held=self.reference_counter.held_oids(),
+                      timeout=10)
+        except BaseException:
+            peer.close()  # don't leak the socket + reader thread
+            raise
+        return peer
+
     def _rpc(self, retry_connect: bool = True):
-        """Connected peer, reconnecting lazily. With ``retry_connect`` a head
-        that is briefly unreachable — e.g. crashed and restarting on the same
-        address with its durable store — is retried for up to
-        RAY_TPU_HEAD_RECONNECT_S (reference: the GCS client's auto-reconnect,
-        gcs_rpc_client/rpc_client.h:622)."""
-        import time
+        """Connected peer, reconnecting lazily with exponential backoff +
+        jitter. With ``retry_connect`` a head that is briefly unreachable —
+        e.g. crashed and restarting on the same address with its durable
+        store — is retried for up to RAY_TPU_HEAD_RECONNECT_S (reference:
+        the GCS client's retryable channel, retryable_grpc_client.h:81)."""
+        from ray_tpu.core.rpc import RetryPolicy
 
-        from ray_tpu.core import wire
-
-        deadline = None
         with self._lock:
-            while self._peer is None or self._peer.closed:
-                try:
-                    peer = wire.connect(
-                        self._host, self._port,
-                        handlers={"pubsub_msg": self._h_pubsub_msg},
-                        name=f"worker-{os.getpid()}",
-                    )
-                    try:
-                        peer.call("hello", token=self._token, kind="worker",
-                                  pid=os.getpid(), node=self._node_bin,
-                                  plane=self._plane_mode,
-                                  held=self.reference_counter.held_oids(),
-                                  timeout=10)
-                    except BaseException:
-                        peer.close()  # don't leak the socket + reader thread
-                        raise
-                    self._peer = peer
-                    break
-                except (OSError, ConnectionError) as e:
-                    if not retry_connect or self.is_shutdown:
-                        raise
-                    if deadline is None:
-                        deadline = time.monotonic() + float(
-                            os.environ.get("RAY_TPU_HEAD_RECONNECT_S", "30"))
-                    if time.monotonic() >= deadline:
-                        raise
-                    time.sleep(0.3)
-            return self._peer
+            if self._peer is not None and not self._peer.closed:
+                return self._peer
+
+            def attempt():
+                self._peer = self._connect_once()
+                return self._peer
+
+            if not retry_connect:
+                return attempt()
+            return RetryPolicy.default().run(
+                attempt, retryable=(OSError, ConnectionError),
+                should_stop=lambda: self.is_shutdown)
 
     def _call_retrying(self, op: str, timeout=None, **payload):
-        """Call an IDEMPOTENT op, retrying through head restarts: a mid-call
-        disconnect re-issues the request against the reconnected head."""
-        import time
+        """Call an IDEMPOTENT op, retrying through head restarts with the
+        shared backoff policy: a mid-call disconnect re-issues the request
+        against the reconnected head."""
+        from ray_tpu.core.rpc import RetryPolicy
 
-        from ray_tpu.core.wire import PeerDisconnected
-
-        deadline = time.monotonic() + float(
-            os.environ.get("RAY_TPU_HEAD_RECONNECT_S", "30"))
-        while True:
-            try:
-                return self._rpc().call(op, timeout=timeout, **payload)
-            except (PeerDisconnected, ConnectionError, OSError):
-                if self.is_shutdown or time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.3)
+        return RetryPolicy.default().run(
+            lambda: self._rpc().call(op, timeout=timeout, **payload),
+            retryable=(ConnectionError, OSError),
+            should_stop=lambda: self.is_shutdown)
 
     # ------------------------------------------------------------ pub/sub
     def _h_pubsub_msg(self, peer, msg):
@@ -443,7 +438,9 @@ class ClientRuntime:
             "client_submit",
             func=cloudpickle.dumps(spec.func),
             args=cloudpickle.dumps((spec.args, spec.kwargs)),
-            opts=opts, timeout=120,
+            # opaque blob: options may carry user types (e.g.
+            # retry_exceptions=(MyError,)) that are not msgpack-native
+            opts=cloudpickle.dumps(opts), timeout=120,
         )
         return [ObjectRef(ObjectID(b), self) for b in ref_bins]
 
@@ -461,7 +458,7 @@ class ClientRuntime:
             "client_create_actor",
             cls=cloudpickle.dumps(cls),
             args=cloudpickle.dumps((args, kwargs)),
-            opts=opts, timeout=120,
+            opts=cloudpickle.dumps(opts), timeout=120,
         )
         return ActorID(actor_bin)
 
@@ -470,7 +467,8 @@ class ClientRuntime:
         ref_bins = self._rpc().call(
             "client_actor_call",
             actor=actor_id.binary(), method=method_name,
-            args=cloudpickle.dumps((args, kwargs)), opts=options, timeout=None,
+            args=cloudpickle.dumps((args, kwargs)),
+            opts=cloudpickle.dumps(options), timeout=None,
         )
         return [ObjectRef(ObjectID(b), self) for b in ref_bins]
 
@@ -496,7 +494,8 @@ class ClientRuntime:
                                index=index, timeout=None)
         if got is None:
             return None
-        if isinstance(got, tuple) and got[0] == "err":
+        if isinstance(got, (list, tuple)) and got[0] == "err":
+            # msgpack has no tuple type: the error pair arrives as a list
             raise cloudpickle.loads(got[1])
         return ObjectRef(ObjectID(got), self)
 

@@ -15,6 +15,11 @@ Nodes here are OS processes on one host sharing the shm object plane (the
 reference's test topology: multiple raylets on one machine,
 python/ray/cluster_utils.py:141). Cross-host agents use the same protocol; the
 object plane then needs the chunked transfer layer (ROADMAP).
+
+Transport: every handler here names an op in core/rpc/schema.py (numbered,
+versioned msgpack messages — the protobuf-service analog); the server is a
+bounded-reactor rpc.RpcServer, and cross-language clients (cpp/) speak the
+same plane via the ``xl_*`` ops instead of a JSON side-channel.
 """
 
 from __future__ import annotations
@@ -33,7 +38,7 @@ import cloudpickle
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
-from ray_tpu.core.wire import PeerDisconnected, RpcPeer, RpcServer
+from ray_tpu.core.rpc import PeerDisconnected, RpcPeer, RpcServer
 
 if TYPE_CHECKING:
     from ray_tpu.core.runtime import Runtime
@@ -41,6 +46,11 @@ if TYPE_CHECKING:
 import logging
 
 logger = logging.getLogger("ray_tpu")
+
+
+class _NeedSlowGet(Exception):
+    """Internal: a reactor-slot client_get must move to a thread (the entry
+    turned non-resident between the fast check and its use)."""
 
 
 class ControlPlane:
@@ -60,6 +70,15 @@ class ControlPlane:
         cfg = runtime.config
         self._hb: dict[NodeID, float] = {}
         self._hb_lock = threading.Lock()
+        # Server-held borrows for cross-language clients (xl_* ops). Keyed
+        # by ref/actor id but tracked per-peer too, so a crashed C++ client
+        # releases its borrows like any worker (see _peer_gone) instead of
+        # pinning objects/actors for the session's lifetime.
+        self._xl_refs: dict[str, Any] = {}
+        self._xl_actors: dict[str, Any] = {}
+        # serializes pending_gets mutations (deferred client_get lists) —
+        # registration, completion, and disconnect cleanup race otherwise
+        self._pg_lock = threading.Lock()
         self.server = RpcServer(
             handlers=self._handlers(),
             host=cfg.control_plane_host,
@@ -101,6 +120,23 @@ class ControlPlane:
 
     def _peer_gone(self, peer: RpcPeer) -> None:
         peer.meta.pop("held_refs", None)  # release the client's borrowed refs
+        # Deferred single-object gets this peer still has parked in the
+        # store's ready-callback table: cancel them, or a get for an object
+        # id the head never learns about leaks its callback + wire future
+        # forever (ADVICE round-5 finding, object_store.py on_ready).
+        # Snapshot under the lock: a put firing concurrently mutates the
+        # same lists (cancel_ready then just reports already-fired).
+        with self._pg_lock:
+            pending = {oid: list(cbs) for oid, cbs in
+                       peer.meta.pop("pending_gets", {}).items()}
+        for oid, cbs in pending.items():
+            for cb in cbs:
+                self.runtime.memory_store.cancel_ready(oid, cb)
+        # cross-language borrows die with their peer (like held_refs)
+        for rid in peer.meta.pop("xl_refs", ()):
+            self._xl_refs.pop(rid, None)
+        for aid in peer.meta.pop("xl_actors", ()):
+            self._xl_actors.pop(aid, None)
         for sid in peer.meta.pop("debug_sessions", ()):  # dead worker's pdbs
             self.runtime.debug_sessions.pop(sid, None)
         try:
@@ -193,6 +229,20 @@ class ControlPlane:
             "pubsub_publish": self._h_pubsub_publish,
             "pubsub_subscribe": self._h_pubsub_subscribe,
             "pubsub_unsubscribe": self._h_pubsub_unsubscribe,
+            "kv_get": self._h_kv,
+            # Cross-language plane: non-Python clients (cpp/) call REGISTERED
+            # functions/actors over the same schema'd wire — the JSON
+            # side-channel of experimental/xlang.py folded into the native
+            # protocol (reference: cross_language.py descriptor calls).
+            "xl_call": self._h_xl_call,
+            "xl_submit": self._h_xl_submit,
+            "xl_get": self._h_xl_get,
+            "xl_put": self._h_xl_put,
+            "xl_free": self._h_xl_free,
+            "xl_actor_create": self._h_xl_actor_create,
+            "xl_actor_call": self._h_xl_actor_call,
+            "xl_kill_actor": self._h_xl_kill_actor,
+            "xl_list_funcs": self._h_xl_list_funcs,
         }
         return {op: self._authed(op, fn) for op, fn in h.items()}
 
@@ -243,12 +293,17 @@ class ControlPlane:
             msg["resources"],
             labels=msg.get("labels"),
             slice_name=msg.get("slice_name"),
-            ici_coords=msg.get("ici_coords"),
+            # msgpack has no tuple type; coords arrive as a list
+            ici_coords=(tuple(msg["ici_coords"])
+                        if msg.get("ici_coords") else None),
             node_id=nid,
         )
         peer.meta["node_id"] = nid
         peer.meta["pid"] = msg.get("pid")
         rt._agents[nid] = peer
+        # seeded plane locations for this node are now confirmed by a live
+        # agent: cancel their expiry (head-FT liveness contract)
+        rt.confirm_plane_node(nid)
         if msg.get("plane_addr"):
             # isolated-object-plane node: its store is served at this endpoint
             with rt._lock:
@@ -314,7 +369,13 @@ class ControlPlane:
 
     # ---- worker/client object plane
     def _h_client_get(self, peer: RpcPeer, msg: dict):
+        """Runs on the bounded reactor (the op is NOT schema-blocking):
+        the deferred and all-resident paths answer without parking, and
+        only a get that may genuinely park (deadline wait, chunk pull,
+        recovery) moves to its own thread via a deferred Future."""
         rt = self.runtime
+        from concurrent.futures import Future
+
         # Single-object pending get without a blocking deadline: defer the
         # reply via a wire Future fired by the store's ready-callback — no
         # head thread parks per in-flight client get (the serve proxies'
@@ -323,8 +384,6 @@ class ControlPlane:
                 and not msg.get("task") and not msg.get("materialize")):
             oid = ObjectID(msg["oids"][0])
             if not rt.memory_store.contains(oid):
-                from concurrent.futures import Future
-
                 out: Future = Future()
 
                 def finish(oid=oid):
@@ -341,30 +400,98 @@ class ControlPlane:
                     # runs on the PUTTING thread (agent reader / pool reply):
                     # serialization of a large value must not stall it — hand
                     # off to the shared resolve pool
+                    with self._pg_lock:
+                        pgets = peer.meta.get("pending_gets", {})
+                        cbs = pgets.get(oid)
+                        if cbs is not None:
+                            try:
+                                cbs.remove(on_obj)
+                            except ValueError:
+                                pass
+                            if not cbs:  # don't accumulate empty lists
+                                pgets.pop(oid, None)
                     rt._async_resolve_pool().submit(finish)
 
+                # tracked per-peer — a LIST per oid, since one worker can
+                # have several concurrent gets for the same object — so a
+                # disconnect cancels every registration (see _peer_gone)
+                # instead of leaking them in _ready_cbs
+                with self._pg_lock:
+                    peer.meta.setdefault("pending_gets", {}).setdefault(
+                        oid, []).append(on_obj)
                 rt.memory_store.on_ready(oid, on_obj)
+                if peer.closed:
+                    # the disconnect cleanup may have run BEFORE this queued
+                    # request registered: withdraw ourselves or the callback
+                    # leaks exactly the way _peer_gone exists to prevent
+                    with self._pg_lock:
+                        pgets = peer.meta.get("pending_gets", {})
+                        cbs = pgets.get(oid)
+                        if cbs is not None and on_obj in cbs:
+                            cbs.remove(on_obj)
+                            if not cbs:
+                                pgets.pop(oid, None)
+                    rt.memory_store.cancel_ready(oid, on_obj)
                 return out
-        if msg.get("task") and any(
-            not rt.memory_store.contains(ObjectID(b)) for b in msg["oids"]
-        ):
-            # Only a get that will actually BLOCK releases the caller's
-            # resources (reference: NotifyDirectCallTaskBlocked fires on
-            # unready objects, not on every fetch).
-            rt.release_blocked_task_resources(msg["task"])
-        return self._client_get_entries(
-            peer, [ObjectID(b) for b in msg["oids"]],
-            msg.get("get_timeout"), bool(msg.get("materialize")))
+        oids = [ObjectID(b) for b in msg["oids"]]
+        if not msg.get("materialize"):
+            # optimistic non-parking attempt on the reactor slot: every
+            # entry that is resident (value) or plane-backed ("shm" marker)
+            # answers inline; the first entry that would need a blocking
+            # fetch/recovery bails to the threaded path below
+            try:
+                return self._client_get_entries(
+                    peer, oids, msg.get("get_timeout"), False,
+                    fast_only=True)
+            except _NeedSlowGet:
+                pass
+
+        # may park (deadline wait / chunk pull / lineage recovery): a
+        # deferred reply off a dedicated thread, so parked gets never
+        # starve the bounded reactor
+        out = Future()
+
+        def work():
+            try:
+                if msg.get("task") and any(
+                    not rt.memory_store.contains(oid) for oid in oids
+                ):
+                    # Only a get that will actually BLOCK releases the
+                    # caller's resources (reference:
+                    # NotifyDirectCallTaskBlocked fires on unready objects,
+                    # not on every fetch).
+                    rt.release_blocked_task_resources(msg["task"])
+                out.set_result(self._client_get_entries(
+                    peer, oids, msg.get("get_timeout"),
+                    bool(msg.get("materialize"))))
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        threading.Thread(target=work, daemon=True,
+                         name="rpc-client-get-wait").start()
+        return out
 
     def _client_get_entries(self, peer: RpcPeer, oids, get_timeout,
-                            materialize: bool) -> list:
+                            materialize: bool, fast_only: bool = False) -> list:
+        """``fast_only`` aborts with _NeedSlowGet instead of entering
+        rt.get() for a non-resident entry — the reactor-slot fast path must
+        never park on a blocking fetch/recovery (see _h_client_get)."""
         rt = self.runtime
         out = []
         for oid in oids:
             ref = ObjectRef(oid, rt)
             try:
                 if not materialize:
-                    obj = rt.memory_store.get([oid], timeout=get_timeout)[0]
+                    if fast_only:
+                        # strictly non-blocking probe (a contains-then-get
+                        # pair could still park if the entry vanishes
+                        # between the two calls)
+                        obj = rt.memory_store.get_if_exists(oid)
+                        if obj is None:
+                            raise _NeedSlowGet
+                    else:
+                        obj = rt.memory_store.get(
+                            [oid], timeout=get_timeout)[0]
                     if obj.error is None and obj.in_shm and (
                         (rt.shm_store is not None and rt.shm_store.contains(oid))
                         or rt.has_plane_copy(oid)
@@ -373,8 +500,14 @@ class ControlPlane:
                         # or chunk-pulls from a holder (locate_object)
                         out.append(("shm", None))
                         continue
+                    if fast_only and obj.error is None and obj.in_shm:
+                        # backing copy vanished since the fast check:
+                        # rt.get() would block on recovery — go slow
+                        raise _NeedSlowGet
                 val = rt.get([ref], timeout=get_timeout)[0]
                 out.append(("val", serialization.serialize_to_bytes(val)))
+            except _NeedSlowGet:
+                raise
             except BaseException as e:  # noqa: BLE001
                 out.append(("err", cloudpickle.dumps(e)))
         return out
@@ -461,7 +594,8 @@ class ControlPlane:
 
         func = cloudpickle.loads(msg["func"])
         args, kwargs = cloudpickle.loads(msg["args"])  # refs rebind to head runtime
-        opts = {k: v for k, v in (msg.get("opts") or {}).items() if v is not None}
+        opts = cloudpickle.loads(msg["opts"]) if msg.get("opts") else {}
+        opts = {k: v for k, v in opts.items() if v is not None}
         resources = opts.pop("resources", None) or {}
         if "CPU" in resources:
             opts["num_cpus"] = resources.pop("CPU")
@@ -480,13 +614,15 @@ class ControlPlane:
     def _h_client_create_actor(self, peer: RpcPeer, msg: dict):
         cls = cloudpickle.loads(msg["cls"])
         args, kwargs = cloudpickle.loads(msg["args"])
-        actor_id = self.runtime.create_actor(cls, args, kwargs, msg.get("opts") or {})
+        opts = cloudpickle.loads(msg["opts"]) if msg.get("opts") else {}
+        actor_id = self.runtime.create_actor(cls, args, kwargs, opts)
         return actor_id.binary()
 
     def _h_client_actor_call(self, peer: RpcPeer, msg: dict):
         args, kwargs = cloudpickle.loads(msg["args"])
+        opts = cloudpickle.loads(msg["opts"]) if msg.get("opts") else {}
         refs = self.runtime.submit_actor_task(
-            ActorID(msg["actor"]), msg["method"], args, kwargs, msg.get("opts") or {}
+            ActorID(msg["actor"]), msg["method"], args, kwargs, opts
         )
         self._hold_for(peer, refs)
         return [r.object_id().binary() for r in refs]
@@ -523,6 +659,94 @@ class ControlPlane:
         from ray_tpu.experimental import internal_kv
 
         return internal_kv._internal_kv_get(msg["key"], namespace=msg.get("namespace"))
+
+    # ---- cross-language ops (native plane for cpp/ clients; the registry
+    # and value codec live in experimental/xlang.py). Refs/actors created by
+    # xlang clients are held server-side until xl_free/xl_kill_actor — the
+    # borrow analog of _hold_for for peers without a refcounter.
+    def _xl_registry(self):
+        from ray_tpu.experimental import xlang
+
+        return xlang
+
+    def _h_xl_call(self, peer: RpcPeer, msg: dict):
+        import ray_tpu
+
+        xlang = self._xl_registry()
+        fn = xlang.lookup(msg["func"])
+        args = xlang._decode(msg.get("args") or [])
+        kwargs = xlang._decode(msg.get("kwargs") or {})
+        ref = ray_tpu.remote(fn).remote(*args, **kwargs)
+        return xlang._encode(ray_tpu.get(ref, timeout=msg.get("timeout")))
+
+    def _h_xl_submit(self, peer: RpcPeer, msg: dict):
+        import ray_tpu
+
+        xlang = self._xl_registry()
+        fn = xlang.lookup(msg["func"])
+        ref = ray_tpu.remote(fn).remote(*xlang._decode(msg.get("args") or []))
+        rid = ref.object_id().hex()
+        self._xl_refs[rid] = ref
+        peer.meta.setdefault("xl_refs", set()).add(rid)
+        return {"ref": rid}
+
+    def _h_xl_get(self, peer: RpcPeer, msg: dict):
+        import ray_tpu
+
+        xlang = self._xl_registry()
+        ref = self._xl_refs.get(msg["ref"])
+        if ref is None:
+            raise KeyError(f"unknown ref {msg['ref']}")
+        return xlang._encode(ray_tpu.get(ref, timeout=msg.get("timeout")))
+
+    def _h_xl_put(self, peer: RpcPeer, msg: dict):
+        import ray_tpu
+
+        xlang = self._xl_registry()
+        ref = ray_tpu.put(xlang._decode(msg.get("value")))
+        rid = ref.object_id().hex()
+        self._xl_refs[rid] = ref
+        peer.meta.setdefault("xl_refs", set()).add(rid)
+        return {"ref": rid}
+
+    def _h_xl_free(self, peer: RpcPeer, msg: dict):
+        self._xl_refs.pop(msg["ref"], None)
+        peer.meta.setdefault("xl_refs", set()).discard(msg["ref"])
+        return True
+
+    def _h_xl_actor_create(self, peer: RpcPeer, msg: dict):
+        import ray_tpu
+
+        xlang = self._xl_registry()
+        cls = xlang.lookup_actor(msg["cls"])
+        handle = ray_tpu.remote(cls).remote(*xlang._decode(msg.get("args") or []))
+        aid = handle._actor_id.hex()
+        self._xl_actors[aid] = handle
+        peer.meta.setdefault("xl_actors", set()).add(aid)
+        return {"actor": aid}
+
+    def _h_xl_actor_call(self, peer: RpcPeer, msg: dict):
+        import ray_tpu
+
+        xlang = self._xl_registry()
+        handle = self._xl_actors[msg["actor"]]
+        method = getattr(handle, msg["method"])
+        ref = method.remote(*xlang._decode(msg.get("args") or []))
+        return xlang._encode(ray_tpu.get(ref, timeout=msg.get("timeout")))
+
+    def _h_xl_kill_actor(self, peer: RpcPeer, msg: dict):
+        import ray_tpu
+
+        handle = self._xl_actors.pop(msg["actor"], None)
+        peer.meta.setdefault("xl_actors", set()).discard(msg["actor"])
+        if handle is not None:
+            ray_tpu.kill(handle)
+        return True
+
+    def _h_xl_list_funcs(self, peer: RpcPeer, msg: dict):
+        xlang = self._xl_registry()
+        return {"funcs": sorted(xlang._registry),
+                "actors": sorted(xlang._actor_registry)}
 
 
 # ------------------------------------------------------------------ agents

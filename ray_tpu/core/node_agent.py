@@ -40,7 +40,7 @@ def main() -> None:
     _pin_worker_jax()
 
     from ray_tpu._private.ids import NodeID, ObjectID
-    from ray_tpu.core import wire
+    from ray_tpu.core import rpc as wire
     from ray_tpu.core.process_pool import (
         ProcessWorkerPool,
         _RemoteTaskError,
@@ -302,15 +302,21 @@ def main() -> None:
             if peer.closed:
                 if reconnect_s <= 0:
                     break
-                deadline = time.monotonic() + reconnect_s
                 print(f"node agent: head connection lost; reconnecting for up "
                       f"to {reconnect_s:.0f}s", file=sys.stderr, flush=True)
-                while time.monotonic() < deadline:
-                    try:
-                        peer, reg = connect_and_register()
-                        break
-                    except Exception:
-                        time.sleep(0.5)
+                # exponential backoff + jitter bounded by the grace window
+                # (reference: gcs_rpc_client reconnection budget); a
+                # WireVersionError aborts immediately — a replacement head
+                # speaking an incompatible schema never becomes compatible
+                policy = wire.RetryPolicy(
+                    initial_backoff_s=0.2, max_backoff_s=5.0,
+                    deadline_s=reconnect_s)
+                try:
+                    peer, reg = policy.run(connect_and_register,
+                                           retryable=(Exception,))
+                except Exception as e:
+                    print(f"node agent: reconnect window exhausted ({e})",
+                          file=sys.stderr, flush=True)
                 if peer.closed:
                     break  # window exhausted
                 # A new head means a new shared shm segment / log dir: rebuild

@@ -23,7 +23,7 @@ from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Callable, Optional
 
 from ray_tpu._private.ids import ObjectID
-from ray_tpu.core import wire
+from ray_tpu.core import rpc as wire
 from ray_tpu.exceptions import ObjectLostError
 
 import os as _os
@@ -155,7 +155,10 @@ class PlaneClient:
     def _pull_gated(self, addrs, oid_bin, chunk_bytes, window, timeout,
                     on_stale) -> Optional[bytes]:
         for entry in addrs:
-            token, addr = entry if isinstance(entry, tuple) else (None, entry)
+            # directory entries fetched over the wire arrive as msgpack
+            # lists; locally-built ones are tuples
+            token, addr = (entry if isinstance(entry, (tuple, list))
+                           else (None, entry))
             try:
                 peer = self._peer(addr)
                 meta = peer.call("obj_meta", oid=oid_bin, timeout=timeout)

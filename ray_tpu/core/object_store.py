@@ -91,6 +91,20 @@ class MemoryStore:
                     return
         cb(obj)
 
+    def cancel_ready(self, object_id: ObjectID, cb: Callable) -> bool:
+        """Withdraw an on_ready registration (the waiter gave up — e.g. its
+        control-plane peer disconnected). Returns True if the callback was
+        still pending; False means it already fired or was never registered,
+        so the caller must not double-handle."""
+        with self._cv:
+            cbs = self._ready_cbs.get(object_id)
+            if not cbs or cb not in cbs:
+                return False
+            cbs.remove(cb)
+            if not cbs:
+                self._ready_cbs.pop(object_id, None)
+            return True
+
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             return object_id in self._objects

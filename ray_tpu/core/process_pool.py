@@ -1,5 +1,11 @@
 """Process worker pool: pipelined OS-process task execution with crash FT.
 
+Transport note: the parent<->worker pipes here are the intra-node DATA plane
+between processes of one build (parent spawns the child, so versions match
+by construction) — cloudpickle frames are the designed opaque-payload path.
+Workers' CONTROL-plane traffic (nested submit/get/put against the head)
+goes through client_runtime over the schema'd msgpack wire in core/rpc/.
+
 This is the multi-process half of the execution story (the reference's model:
 N `default_worker.py` processes per node, each embedding a CoreWorker —
 python/ray/_private/workers/default_worker.py:203 + raylet WorkerPool

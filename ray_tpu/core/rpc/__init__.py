@@ -1,0 +1,49 @@
+"""Schema'd control-plane RPC: versioned msgpack wire, bounded reactors,
+retry/backoff/deadlines.
+
+Replaces the length-prefixed-pickle transport that core/wire.py used to
+implement (wire.py now re-exports from here). See schema.py for the op
+registry and the version-negotiation contract; scripts/check_wire_schemas.py
+lints the registry invariants.
+"""
+
+from ray_tpu.core.rpc.codec import MAX_FRAME, ProtocolError
+from ray_tpu.core.rpc.peer import (
+    NEGOTIATION_TIMEOUT_S,
+    PeerDisconnected,
+    RpcPeer,
+    RpcServer,
+    connect,
+)
+from ray_tpu.core.rpc.reactor import Reactor
+from ray_tpu.core.rpc.retry import RetryPolicy
+from ray_tpu.core.rpc.schema import (
+    REGISTRY,
+    WIRE_VERSION,
+    WIRE_VERSION_MIN,
+    OpSpec,
+    SchemaError,
+    WireVersionError,
+    register_op,
+)
+from ray_tpu.core.rpc.userblob import RemoteError
+
+__all__ = [
+    "MAX_FRAME",
+    "NEGOTIATION_TIMEOUT_S",
+    "ProtocolError",
+    "PeerDisconnected",
+    "RpcPeer",
+    "RpcServer",
+    "connect",
+    "Reactor",
+    "RetryPolicy",
+    "REGISTRY",
+    "WIRE_VERSION",
+    "WIRE_VERSION_MIN",
+    "OpSpec",
+    "SchemaError",
+    "WireVersionError",
+    "register_op",
+    "RemoteError",
+]

@@ -1,0 +1,135 @@
+"""Wire envelope: length-prefixed msgpack frames.
+
+Frame layout (the whole control plane speaks this, Python and C++ alike):
+
+    u32 big-endian body length | msgpack body
+
+Body is a msgpack array whose first element is the frame kind:
+
+    HELLO   [0, magic, min_ver, max_ver, meta_map]   first frame each way
+    REQUEST [1, id, op_num, payload_map, ttl_ms?]    expects REPLY/ERROR
+    NOTIFY  [2, op_num, payload_map]                 one-way
+    REPLY   [3, reply_to, result]
+    ERROR   [4, reply_to, message_str, exc_blob|nil] exc_blob: opaque pickled
+                                                     exception (user payload)
+    GOODBYE [5, message_str]                         protocol-fatal, then close
+
+Every value is msgpack-native (nil/bool/int/float/str/bin/array/map); the
+envelope itself carries NO pickled control structures. ``ttl_ms`` (v2) lets
+the receiving reactor drop requests whose caller deadline already passed.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+import msgpack
+
+from ray_tpu.core.rpc.schema import SchemaError
+
+_LEN = struct.Struct(">I")
+HEADER_SIZE = _LEN.size
+MAX_FRAME = 1 << 31
+
+HELLO = 0
+REQUEST = 1
+NOTIFY = 2
+REPLY = 3
+ERROR = 4
+GOODBYE = 5
+
+
+class ProtocolError(ConnectionError):
+    """Malformed or oversized frame: the connection is unrecoverable."""
+
+
+def _default(obj: Any):
+    # The packer never pickles: anything non-native is a schema bug at the
+    # call site, surfaced with the offending type instead of a pickle frame.
+    if isinstance(obj, (bytearray, memoryview)):
+        return bytes(obj)
+    if isinstance(obj, tuple):
+        return list(obj)
+    raise SchemaError(
+        f"value of type {type(obj).__name__} is not msgpack-native; "
+        f"control-plane payloads must use declared schema fields "
+        f"(opaque user data belongs in BLOB bytes fields)")
+
+
+def pack(body: list) -> bytes:
+    """Envelope body -> framed bytes (header + msgpack)."""
+    blob = msgpack.packb(body, use_bin_type=True, default=_default)
+    if len(blob) > MAX_FRAME:
+        raise ValueError(f"frame too large: {len(blob)} bytes")
+    return _LEN.pack(len(blob)) + blob
+
+
+def unpack_header(header: bytes) -> int:
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ProtocolError(f"frame length {n} exceeds MAX_FRAME")
+    return n
+
+
+def unpack_body(blob: bytes) -> list:
+    try:
+        body = msgpack.unpackb(blob, raw=False, strict_map_key=False,
+                               use_list=True)
+    except Exception as e:
+        raise ProtocolError(f"undecodable frame ({type(e).__name__}: {e}); "
+                            "peer is not speaking the rtpu msgpack wire "
+                            "(version mismatch or corruption)") from e
+    if not isinstance(body, list) or not body:
+        raise ProtocolError("frame body is not a non-empty array")
+    kind = body[0]
+    if not isinstance(kind, int) or not (HELLO <= kind <= GOODBYE):
+        raise ProtocolError(f"unknown frame kind {kind!r}")
+    _ARITY_CHECKS[kind](body)
+    return body
+
+
+def _need(body: list, n: int, kind: str) -> None:
+    if len(body) < n:
+        raise ProtocolError(f"truncated {kind} frame: {len(body)} elements")
+
+
+_ARITY_CHECKS = {
+    HELLO: lambda b: _need(b, 5, "HELLO"),
+    REQUEST: lambda b: _need(b, 4, "REQUEST"),
+    NOTIFY: lambda b: _need(b, 3, "NOTIFY"),
+    REPLY: lambda b: _need(b, 3, "REPLY"),
+    ERROR: lambda b: _need(b, 4, "ERROR"),
+    GOODBYE: lambda b: _need(b, 2, "GOODBYE"),
+}
+
+
+def hello_frame(min_ver: int, max_ver: int, meta: Optional[dict] = None) -> bytes:
+    from ray_tpu.core.rpc.schema import WIRE_MAGIC
+
+    return pack([HELLO, WIRE_MAGIC, min_ver, max_ver, meta or {}])
+
+
+def request_frame(mid: int, op_num: int, payload: dict,
+                  ttl_ms: Optional[int] = None) -> bytes:
+    body = [REQUEST, mid, op_num, payload]
+    if ttl_ms is not None:
+        body.append(int(ttl_ms))
+    return pack(body)
+
+
+def notify_frame(op_num: int, payload: dict) -> bytes:
+    return pack([NOTIFY, op_num, payload])
+
+
+def reply_frame(reply_to: int, result: Any) -> bytes:
+    return pack([REPLY, reply_to, result])
+
+
+def error_frame(reply_to: int, message: str,
+                exc_blob: Optional[bytes]) -> bytes:
+    return pack([ERROR, reply_to, message, exc_blob])
+
+
+def goodbye_frame(message: str) -> bytes:
+    return pack([GOODBYE, message])

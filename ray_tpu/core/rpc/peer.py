@@ -1,0 +1,492 @@
+"""Framed bidirectional RPC over TCP: the schema'd control-plane transport.
+
+Parity: the reference's gRPC control plane (grpc_server.h:93,
+retryable_grpc_client.h:81) — request/response with correlation ids, one-way
+notifications, per-connection reader loop, disconnect propagation (a dead
+peer fails all in-flight calls, the UNAVAILABLE analog). Unlike the pickle
+wire it replaces, frames are versioned msgpack (core/rpc/codec.py) validated
+against numbered op schemas (core/rpc/schema.py): a head and agent at
+different schema versions negotiate a common version at hello or fail with a
+clear WireVersionError, and non-Python peers (cpp/ray_tpu_client.hpp) join
+the same plane.
+
+Inbound requests run on a bounded reactor (core/rpc/reactor.py), not a
+thread per request; handlers that return a Future defer their reply until it
+resolves, so any number of calls pipeline through a fixed thread count.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Optional
+
+from ray_tpu.core.rpc import codec
+from ray_tpu.core.rpc.codec import MAX_FRAME, ProtocolError
+from ray_tpu.core.rpc.reactor import Reactor
+from ray_tpu.core.rpc.schema import (
+    WIRE_MAGIC,
+    WIRE_VERSION,
+    WIRE_VERSION_MIN,
+    BY_NUM,
+    SchemaError,
+    WireVersionError,
+    check_op_version,
+    get_op,
+    negotiate,
+    validate_payload,
+)
+from ray_tpu.core.rpc.userblob import dumps_exception, loads_exception
+
+logger = logging.getLogger("ray_tpu")
+
+NEGOTIATION_TIMEOUT_S = 10.0
+
+
+class PeerDisconnected(ConnectionError):
+    """The remote end of an RpcPeer went away (fails all in-flight calls)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise PeerDisconnected("socket closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class RpcPeer:
+    """One end of a full-duplex message link.
+
+    ``handlers`` maps op name -> fn(peer, msg_dict) -> reply payload (any
+    msgpack-native value, or a Future for a deferred reply). Handler
+    exceptions travel back and re-raise at the caller. Every handler name
+    must have a schema entry (core/rpc/schema.py)."""
+
+    def __init__(
+        self,
+        sock: socket.socket,
+        handlers: dict[str, Callable[["RpcPeer", dict], Any]] | None = None,
+        on_disconnect: Callable[["RpcPeer"], None] | None = None,
+        name: str = "peer",
+        reactor: Reactor | None = None,
+        versions: tuple[int, int] | None = None,
+    ):
+        self._sock = sock
+        self._handlers = handlers or {}
+        for op in self._handlers:
+            get_op(op)  # typo'd / schema-less handlers fail at construction
+        self._on_disconnect = on_disconnect
+        self.name = name
+        self._wlock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._plock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._closed = False
+        self.meta: dict = {}  # server-side: registration info lives here
+        self._own_reactor = reactor is None
+        self._reactor = reactor if reactor is not None else Reactor(
+            name=f"rpc-reactor-{name}")
+        self._vmin, self._vmax = versions or (WIRE_VERSION_MIN, WIRE_VERSION)
+        self.negotiated_version: Optional[int] = None
+        self._negotiated = threading.Event()
+        self._negotiation_error: Optional[BaseException] = None
+        # Both ends fire their HELLO immediately (no extra round-trip); the
+        # reader resolves the agreed version from the peer's HELLO.
+        try:
+            self._send_raw(codec.hello_frame(self._vmin, self._vmax,
+                                             {"name": name}))
+        except BaseException:
+            self._sock.close()
+            raise
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name=f"rpc-read-{name}"
+        )
+        self._reader.start()
+
+    # --------------------------------------------------------- negotiation
+    def wait_negotiated(self, timeout: float = NEGOTIATION_TIMEOUT_S) -> int:
+        """Block until hello exchange completes; raises WireVersionError on
+        mismatch, PeerDisconnected if the peer died first."""
+        if not self._negotiated.wait(timeout):
+            raise WireVersionError(
+                f"{self.name}: peer sent no hello within {timeout}s "
+                "(not an rtpu rpc endpoint?)")
+        if self._negotiation_error is not None:
+            raise self._negotiation_error
+        assert self.negotiated_version is not None
+        return self.negotiated_version
+
+    def _handle_hello(self, body: list) -> None:
+        _, magic, peer_min, peer_max, peer_meta = body[:5]
+        if magic != WIRE_MAGIC:
+            raise ProtocolError(
+                f"bad protocol magic {magic!r} (expected {WIRE_MAGIC!r})")
+        try:
+            agreed = negotiate(self._vmin, self._vmax,
+                               int(peer_min), int(peer_max))
+        except WireVersionError as e:
+            try:
+                self._send_raw(codec.goodbye_frame(str(e)))
+            except Exception:
+                pass
+            raise
+        self.negotiated_version = agreed
+        self.meta.setdefault("peer_hello", peer_meta or {})
+        self._negotiated.set()
+
+    # --- outbound ---
+    def call(self, op: str, timeout: float | None = None, **payload) -> Any:
+        """Request/response; raises the handler's exception, PeerDisconnected,
+        or WireVersionError if the negotiated version predates ``op``."""
+        mid, fut = self.call_async(op, _ttl=timeout, **payload)
+        try:
+            return fut.result(timeout=timeout)
+        finally:
+            with self._plock:
+                self._pending.pop(mid, None)
+
+    def call_async(self, op: str, _ttl: float | None = None,
+                   **payload) -> tuple[int, Future]:
+        """Fire a request and return (id, Future) without blocking — lets a
+        caller keep a window of requests in flight (the object plane
+        pipelines chunk fetches this way, like the reference's windowed
+        chunked pulls, object_manager.cc:536). Caller must pop the pending
+        entry via finish_call() when done."""
+        spec = get_op(op)
+        self._check_version(spec)
+        payload = validate_payload(spec, payload, outbound=True)
+        mid = next(self._ids)
+        fut: Future = Future()
+        with self._plock:
+            if self._closed:
+                raise PeerDisconnected(f"{self.name} is closed")
+            self._pending[mid] = fut
+        ttl_ms = None
+        if (_ttl is not None and self.negotiated_version is not None
+                and self.negotiated_version >= 2):
+            ttl_ms = max(1, int(_ttl * 1000))
+        try:
+            self._send_raw(codec.request_frame(mid, spec.num, payload, ttl_ms))
+        except BaseException:
+            # e.g. frame-too-large ValueError: the request never left, so the
+            # pending future would otherwise leak for the connection's life
+            with self._plock:
+                self._pending.pop(mid, None)
+            raise
+        return mid, fut
+
+    def finish_call(self, mid: int) -> None:
+        with self._plock:
+            self._pending.pop(mid, None)
+
+    def notify(self, op: str, **payload) -> None:
+        """One-way message (no reply expected)."""
+        spec = get_op(op)
+        self._check_version(spec)
+        payload = validate_payload(spec, payload, outbound=True)
+        self._send_raw(codec.notify_frame(spec.num, payload))
+
+    def _check_version(self, spec) -> None:
+        if spec.since <= self._vmin:
+            return  # op predates everything we could negotiate down to
+        agreed = self.negotiated_version
+        if agreed is None:
+            agreed = self.wait_negotiated()
+        check_op_version(spec, agreed)
+
+    def _send_raw(self, frame: bytes) -> None:
+        try:
+            with self._wlock:
+                self._sock.sendall(frame)
+        except OSError as e:
+            self._fail(PeerDisconnected(f"send to {self.name} failed: {e}"))
+            raise PeerDisconnected(str(e)) from e
+
+    # --- inbound ---
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                n = codec.unpack_header(
+                    _recv_exact(self._sock, codec.HEADER_SIZE))
+                body = codec.unpack_body(_recv_exact(self._sock, n))
+                kind = body[0]
+                if self.negotiated_version is None and kind not in (
+                        codec.HELLO, codec.GOODBYE):
+                    raise ProtocolError(
+                        "peer sent frames before hello negotiation")
+                if kind == codec.HELLO:
+                    self._handle_hello(body)
+                elif kind == codec.REPLY:
+                    self._complete(body[1], body[2], None, None)
+                elif kind == codec.ERROR:
+                    self._complete(body[1], None, body[2], body[3])
+                elif kind == codec.NOTIFY:
+                    # NOTIFICATIONS run inline on the reader so their order
+                    # is preserved (pubsub/heartbeat contracts); handlers
+                    # must be cheap
+                    self._dispatch(body[1], None, body[2], None)
+                elif kind == codec.REQUEST:
+                    ttl_ms = body[4] if len(body) > 4 else None
+                    deadline = (time.monotonic() + ttl_ms / 1000.0
+                                if ttl_ms else None)
+                    self._enqueue_request(body[2], body[1], body[3], deadline)
+                elif kind == codec.GOODBYE:
+                    raise WireVersionError(
+                        f"{self.name}: peer refused connection: {body[1]}")
+        except (WireVersionError, ProtocolError, SchemaError) as e:
+            self._fail(e if isinstance(e, WireVersionError)
+                       else PeerDisconnected(f"{self.name}: {e}"))
+        except (PeerDisconnected, OSError, EOFError) as e:
+            self._fail(PeerDisconnected(f"{self.name} disconnected: {e}"))
+
+    def _complete(self, mid, result, err_msg, err_blob) -> None:
+        with self._plock:
+            fut = self._pending.pop(mid, None)
+        if fut is not None and not fut.done():
+            if err_msg is not None:
+                fut.set_exception(loads_exception(err_msg, err_blob))
+            else:
+                fut.set_result(result)
+
+    def _enqueue_request(self, op_num: int, mid: int, payload: dict,
+                         deadline: float | None) -> None:
+        spec = BY_NUM.get(op_num)
+        if spec is not None and spec.blocking:
+            # may park on external events: a dedicated thread, so parked
+            # waiters can't starve the bounded reactor (ttl shedding applies
+            # here too — the caller may have given up while we queued)
+            def run_blocking():
+                if deadline is not None and time.monotonic() > deadline:
+                    self._send_error_reply(mid, TimeoutError(
+                        f"request {spec.name} ttl expired before dispatch"))
+                    return
+                self._dispatch(op_num, mid, payload, deadline)
+
+            threading.Thread(target=run_blocking, daemon=True,
+                             name=f"rpc-blk-{spec.name}").start()
+            return
+        self._reactor.submit(
+            self._dispatch, op_num, mid, payload, deadline,
+            deadline=deadline,
+            on_expired=lambda: self._send_error_reply(
+                mid, TimeoutError(
+                    f"request {spec.name if spec else op_num} ttl expired "
+                    "before dispatch")),
+        )
+
+    def _dispatch(self, op_num: int, mid: int | None, payload: Any,
+                  deadline: float | None) -> None:
+        spec = BY_NUM.get(op_num)
+        try:
+            if spec is None:
+                raise SchemaError(
+                    f"unknown rpc op number {op_num} (peer is newer; "
+                    f"this end speaks schema v{self._vmax})")
+            handler = self._handlers.get(spec.name)
+            if handler is None:
+                raise SchemaError(
+                    f"no handler for rpc op {spec.name!r} on {self.name}")
+            if not isinstance(payload, dict):
+                raise ProtocolError(f"op {spec.name!r}: payload not a map")
+            # handlers see ONLY schema fields — injecting envelope metadata
+            # here would clobber ops with a field named "id" (debug_unregister)
+            msg = validate_payload(spec, payload, outbound=False)
+            result = handler(self, msg)
+            if mid is not None:
+                if isinstance(result, Future):
+                    # Deferred reply: the handler pipelined the work (e.g. a
+                    # node agent queuing onto its worker pool) — send the
+                    # frame when the future resolves, freeing this slot.
+                    result.add_done_callback(
+                        lambda f, mid=mid: self._send_deferred_reply(mid, f))
+                    return
+                self._send_raw(codec.reply_frame(mid, result))
+        except PeerDisconnected as e:
+            # Either THIS peer died (reply undeliverable — the error reply
+            # below is a no-op) or the HANDLER tripped over some OTHER dead
+            # peer. The two are indistinguishable here, and swallowing the
+            # second strands the caller forever on a reply that never
+            # comes — so always attempt the error reply.
+            if mid is not None:
+                self._send_error_reply(mid, e)
+        except BaseException as e:  # noqa: BLE001 — ship the error back
+            if mid is not None:
+                self._send_error_reply(mid, e)
+
+    def _send_deferred_reply(self, mid: int, fut: Future) -> None:
+        try:
+            result = fut.result()
+        except BaseException as e:  # noqa: BLE001 — incl. PeerDisconnected:
+            # the deferred work failing on SOME peer must still answer THIS
+            # one, or the caller hangs on a reply that never comes
+            self._send_error_reply(mid, e)
+            return
+        try:
+            self._send_raw(codec.reply_frame(mid, result))
+        except PeerDisconnected:
+            pass
+        except BaseException as e:  # noqa: BLE001 — e.g. frame-too-large:
+            # the caller must get SOMETHING or its future hangs forever
+            self._send_error_reply(mid, e)
+
+    def _send_error_reply(self, mid: int, e: BaseException) -> None:
+        message, blob = dumps_exception(e)
+        try:
+            self._send_raw(codec.error_frame(mid, message, blob))
+        except PeerDisconnected:
+            pass
+        except Exception:
+            logger.debug("rpc %s: error reply for %s undeliverable",
+                         self.name, mid)
+
+    def _fail(self, exc: Exception) -> None:
+        with self._plock:
+            if self._closed:
+                return
+            self._closed = True
+            pending, self._pending = self._pending, {}
+        if not self._negotiated.is_set():
+            self._negotiation_error = exc
+            self._negotiated.set()
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        try:
+            # close() alone does not wake a reader blocked in recv() (the fd
+            # release — and the FIN — defer until the syscall returns, so
+            # the remote end would never learn we left); shutdown() does.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._own_reactor:
+            self._reactor.close()
+        if self._on_disconnect is not None:
+            try:
+                self._on_disconnect(self)
+            except Exception:
+                pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def local_address(self) -> tuple:
+        """(host, port) of this end of the connection — the routable address
+        peers on the remote side could reach this host at."""
+        return self._sock.getsockname()
+
+    def close(self) -> None:
+        self._fail(PeerDisconnected(f"{self.name} closed locally"))
+
+
+class RpcServer:
+    """Listening endpoint; wraps each accepted connection in an RpcPeer.
+
+    The reference analog is GrpcServer (grpc_server.h:93): one listener, a
+    service handler table, a FIXED worker pool serving every connection —
+    the accepted peers share one bounded Reactor."""
+
+    def __init__(
+        self,
+        handlers: dict[str, Callable[[RpcPeer, dict], Any]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_connect: Callable[[RpcPeer], None] | None = None,
+        on_disconnect: Callable[[RpcPeer], None] | None = None,
+        reactor_threads: int = 0,
+        versions: tuple[int, int] | None = None,
+    ):
+        self._handlers = handlers
+        for op in handlers:
+            get_op(op)
+        self._on_connect = on_connect
+        self._on_disconnect = on_disconnect
+        self._versions = versions
+        self.reactor = Reactor(max_threads=reactor_threads, name="rpc-srv")
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.address = self._listener.getsockname()  # (host, port)
+        self.peers: list[RpcPeer] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rpc-accept"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                peer = RpcPeer(
+                    sock, self._handlers, on_disconnect=self._peer_gone,
+                    name=f"conn-{addr[1]}", reactor=self.reactor,
+                    versions=self._versions,
+                )
+            except OSError:
+                continue
+            with self._lock:
+                self.peers.append(peer)
+            if self._on_connect is not None:
+                self._on_connect(peer)
+
+    def _peer_gone(self, peer: RpcPeer) -> None:
+        with self._lock:
+            if peer in self.peers:
+                self.peers.remove(peer)
+        if self._on_disconnect is not None:
+            self._on_disconnect(peer)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            peers, self.peers = list(self.peers), []
+        for p in peers:
+            p.close()
+        self.reactor.close()
+
+
+def connect(
+    host: str,
+    port: int,
+    handlers: dict[str, Callable[[RpcPeer, dict], Any]] | None = None,
+    on_disconnect: Callable[[RpcPeer], None] | None = None,
+    timeout: float = 10.0,
+    name: str = "client",
+    versions: tuple[int, int] | None = None,
+    wait_negotiated: bool = True,
+) -> RpcPeer:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    peer = RpcPeer(sock, handlers, on_disconnect=on_disconnect, name=name,
+                   versions=versions)
+    if wait_negotiated:
+        try:
+            peer.wait_negotiated(timeout)
+        except BaseException:
+            peer.close()
+            raise
+    return peer

@@ -1,0 +1,88 @@
+"""Bounded-executor reactor: inbound request dispatch without a thread per
+request.
+
+Parity: the reference's gRPC server thread model (grpc_server.h — a fixed
+completion-queue thread pool serving every call) versus the old wire.py,
+which spawned one Python thread per inbound request and hit a thread-count
+knee near 50 agents. Here each server (and each client peer with handlers)
+owns a small fixed pool; requests queue FIFO and handlers that pipeline
+work (returning a Future) free their slot immediately — deferred replies
+are the backpressure release valve.
+
+Ops whose handlers may PARK on external events (client_get with a deadline,
+client_wait, xl_* gets) are declared ``blocking=True`` in the schema and get
+a dedicated thread, so a burst of parked waiters cannot starve the bounded
+pool — the same split the reference makes between polling threads and
+long-running call handlers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+DEFAULT_THREADS = int(os.environ.get("RAY_TPU_RPC_REACTOR_THREADS", "8"))
+
+
+class Reactor:
+    """Fixed-size executor with TTL-aware submission.
+
+    One Reactor is shared by every peer a server accepts (bounding the whole
+    server's inbound concurrency); client-side peers lazily create their own.
+    """
+
+    def __init__(self, max_threads: int = 0, name: str = "rpc-reactor"):
+        self.max_threads = max_threads or DEFAULT_THREADS
+        self.name = name
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _executor(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(f"reactor {self.name} is closed")
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.max_threads,
+                    thread_name_prefix=self.name)
+            return self._pool
+
+    def submit(self, fn: Callable, *args,
+               deadline: Optional[float] = None,
+               on_expired: Optional[Callable] = None) -> None:
+        """Queue fn(*args). If ``deadline`` (time.monotonic epoch) passes
+        before a worker picks it up, ``on_expired`` runs instead — the
+        caller already gave up, so burning a slot on the work is waste and
+        the queue must not amplify a stampede."""
+
+        def run():
+            if deadline is not None and time.monotonic() > deadline:
+                if on_expired is not None:
+                    try:
+                        on_expired()
+                    except Exception:
+                        pass
+                return
+            fn(*args)
+
+        try:
+            self._executor().submit(run)
+        except RuntimeError:
+            # shutting down: answer instead of silently dropping, or a
+            # caller blocked without a timeout waits forever
+            if on_expired is not None:
+                try:
+                    on_expired()
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)

@@ -1,0 +1,79 @@
+"""Client-side retry policy: exponential backoff + jitter + deadlines.
+
+Parity: the reference's RetryableGrpcClient (retryable_grpc_client.h:81 —
+server_unavailable_timeout, exponential backoff with jitter on UNAVAILABLE)
+replacing the ad-hoc fixed-sleep reconnect loops that client_runtime.py and
+node_agent.py grew independently.
+
+Only DISCONNECT-class failures retry (the gRPC UNAVAILABLE analog);
+application exceptions raised by handlers always propagate — retrying them
+is the caller's policy, not the transport's.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff schedule for idempotent control-plane calls.
+
+    ``deadline_s`` bounds the WHOLE retry loop (per-call timeouts bound each
+    attempt). Defaults follow RAY_TPU_HEAD_RECONNECT_S, the grace window a
+    restarted head has to come back (reference: gcs reconnect budget,
+    gcs_rpc_client/rpc_client.h:622).
+    """
+
+    initial_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.2          # +- fraction of each sleep
+    deadline_s: Optional[float] = None
+
+    @classmethod
+    def default(cls) -> "RetryPolicy":
+        # default 60s everywhere this env var is read (node_agent reconnect,
+        # runtime seeded-plane expiry) — one grace window, one meaning
+        return cls(deadline_s=_env_float("RAY_TPU_HEAD_RECONNECT_S", 60.0))
+
+    def backoffs(self) -> Iterator[float]:
+        b = self.initial_backoff_s
+        while True:
+            yield b * (1.0 + random.uniform(-self.jitter, self.jitter))
+            b = min(b * self.multiplier, self.max_backoff_s)
+
+    def run(self, attempt: Callable, retryable: tuple,
+            should_stop: Optional[Callable[[], bool]] = None):
+        """Call ``attempt()`` until it succeeds, a non-retryable error
+        surfaces, the deadline lapses, or ``should_stop()`` turns true.
+        The last retryable error re-raises when the budget is spent."""
+        deadline = (None if self.deadline_s is None
+                    else time.monotonic() + self.deadline_s)
+        from ray_tpu.core.rpc.schema import WireVersionError
+
+        for sleep_s in self.backoffs():
+            try:
+                return attempt()
+            except retryable as e:
+                if isinstance(e, WireVersionError):
+                    raise  # deterministic: the peer will never change its mind
+                if should_stop is not None and should_stop():
+                    raise
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    raise
+                if deadline is not None:
+                    sleep_s = min(sleep_s, max(0.0, deadline - now))
+                time.sleep(sleep_s)

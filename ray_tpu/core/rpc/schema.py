@@ -1,0 +1,311 @@
+"""Control-plane message schemas: numbered, versioned, append-only.
+
+Parity: the reference's protobuf service definitions (src/ray/protobuf/ —
+every control-plane RPC has a numbered message schema compiled into both
+ends) and its versioned client handshake. Here each op is an ``OpSpec``:
+a stable wire number, the schema version that introduced it, and a typed
+field list. Payloads are msgpack maps validated against the spec; opaque
+user payloads (pickled functions/args/results/exceptions) travel as
+``BLOB``/``BYTES`` fields and are never interpreted by this layer.
+
+Rules (enforced by ``scripts/check_wire_schemas.py``):
+- op numbers are unique and append-only: once shipped, a number is never
+  reused or renumbered; new ops take numbers past the frozen baseline.
+- every handler registered on a control-plane server names an op here.
+- no pickling of control structures: the envelope and every declared field
+  is msgpack-native; the only pickle in ``core/rpc/`` is the exception
+  codec in ``userblob.py`` (exceptions are user payloads).
+
+Version history:
+- v1: initial msgpack wire — session/control/object-plane ops.
+- v2: cross-language ops (``xl_*``), ``kv_get``, request TTL field.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# The schema version this build speaks, and the oldest it can fall back to.
+# Peers negotiate min(max_a, max_b) at hello; see negotiate().
+WIRE_VERSION = 2
+WIRE_VERSION_MIN = 1
+
+# Protocol magic sent in the hello frame: rejects foreign/legacy peers with
+# a clear error instead of a decode crash.
+WIRE_MAGIC = "rtpu1"
+
+
+# --------------------------------------------------------------- field types
+class T:
+    """Field type tags (wire representation is always msgpack-native)."""
+
+    BYTES = "bytes"    # control-plane binary (ids, digests)
+    BLOB = "blob"      # OPAQUE user payload (pickled by the app layer)
+    STR = "str"
+    INT = "int"
+    FLOAT = "float"
+    BOOL = "bool"
+    ANY = "any"        # any msgpack-native composite (maps/lists/scalars)
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    type: str
+    required: bool = False
+
+
+def _f(name: str, type: str, required: bool = False) -> Field:
+    return Field(name, type, required)
+
+
+@dataclass(frozen=True)
+class OpSpec:
+    num: int
+    name: str
+    fields: tuple
+    since: int = 1           # schema version that introduced this op
+    blocking: bool = False   # handler may park on external events: runs on a
+    #                          dedicated thread instead of the bounded reactor
+    doc: str = ""
+
+    def field_map(self) -> dict:
+        return {f.name: f for f in self.fields}
+
+
+REGISTRY: dict[str, OpSpec] = {}
+BY_NUM: dict[int, OpSpec] = {}
+
+
+class SchemaError(ValueError):
+    """A message violated its op schema (unknown op, bad field, bad type)."""
+
+
+def register_op(num: int, name: str, fields: "list[Field]", since: int = 1,
+                blocking: bool = False, doc: str = "") -> OpSpec:
+    if name in REGISTRY:
+        raise SchemaError(f"duplicate op name {name!r}")
+    if num in BY_NUM:
+        raise SchemaError(
+            f"duplicate op number {num} ({name!r} vs {BY_NUM[num].name!r})")
+    spec = OpSpec(num=num, name=name, fields=tuple(fields), since=since,
+                  blocking=blocking, doc=doc)
+    REGISTRY[name] = spec
+    BY_NUM[num] = spec
+    return spec
+
+
+def get_op(name: str) -> OpSpec:
+    spec = REGISTRY.get(name)
+    if spec is None:
+        raise SchemaError(f"unknown rpc op {name!r} (no schema entry)")
+    return spec
+
+
+_SCALAR_CHECKS = {
+    T.STR: lambda v: isinstance(v, str),
+    T.INT: lambda v: isinstance(v, int) and not isinstance(v, bool),
+    T.FLOAT: lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+    T.BOOL: lambda v: isinstance(v, bool),
+}
+
+
+def validate_payload(spec: OpSpec, payload: dict, *, outbound: bool) -> dict:
+    """Check a payload against its op schema.
+
+    Outbound: unknown fields are an error (the sender is this build — a typo
+    must not silently vanish on the wire). Inbound: unknown fields are
+    IGNORED (a newer peer may send optional fields this build predates —
+    the version-tolerance contract). Bytes-like values are normalized to
+    ``bytes`` so handlers never see memoryviews.
+    """
+    fields = spec.field_map()
+    out = {}
+    for key, val in payload.items():
+        f = fields.get(key)
+        if f is None:
+            if outbound:
+                raise SchemaError(
+                    f"op {spec.name!r}: field {key!r} not in schema")
+            continue  # inbound forward-compat: ignore unknown fields
+        if val is None:
+            if f.required and outbound:
+                raise SchemaError(f"op {spec.name!r}: field {key!r} is None "
+                                  "but required")
+            out[key] = None
+            continue
+        if f.type in (T.BYTES, T.BLOB):
+            if isinstance(val, (bytearray, memoryview)):
+                val = bytes(val)
+            elif not isinstance(val, bytes):
+                raise SchemaError(
+                    f"op {spec.name!r}: field {key!r} expects bytes, got "
+                    f"{type(val).__name__}")
+        else:
+            check = _SCALAR_CHECKS.get(f.type)
+            if check is not None and not check(val):
+                raise SchemaError(
+                    f"op {spec.name!r}: field {key!r} expects {f.type}, got "
+                    f"{type(val).__name__}")
+        out[key] = val
+    if outbound:
+        for f in spec.fields:
+            if f.required and out.get(f.name) is None:
+                raise SchemaError(
+                    f"op {spec.name!r}: required field {f.name!r} missing")
+    return out
+
+
+class WireVersionError(ConnectionError):
+    """Peers could not agree on a schema version (or an op post-dates the
+    negotiated version). The clear-failure replacement for a pickle crash."""
+
+
+def negotiate(local_min: int, local_max: int,
+              peer_min: int, peer_max: int) -> int:
+    """Pick the wire version both ends speak, or raise WireVersionError."""
+    agreed = min(local_max, peer_max)
+    if agreed < max(local_min, peer_min):
+        raise WireVersionError(
+            f"wire schema version mismatch: local supports "
+            f"[{local_min}, {local_max}], peer supports "
+            f"[{peer_min}, {peer_max}] — no common version. "
+            f"Upgrade the older end (head and agents must overlap).")
+    return agreed
+
+
+def check_op_version(spec: OpSpec, agreed: int) -> None:
+    if spec.since > agreed:
+        raise WireVersionError(
+            f"op {spec.name!r} requires wire version {spec.since} but the "
+            f"connection negotiated version {agreed} (peer is older)")
+
+
+# ------------------------------------------------------------------- schemas
+# Append-only numbering. NEVER renumber or reuse; new ops go at the end.
+
+# -- session / membership (reference: gcs_node_manager registration plane)
+register_op(1, "hello", [
+    _f("token", T.STR), _f("kind", T.STR), _f("pid", T.INT),
+    _f("node", T.BYTES), _f("plane", T.STR), _f("held", T.ANY),
+], doc="authenticate + identify; reply {ok}")
+register_op(2, "register_node", [
+    _f("resources", T.ANY, required=True), _f("labels", T.ANY),
+    _f("slice_name", T.STR), _f("ici_coords", T.ANY), _f("pid", T.INT),
+    _f("name", T.STR), _f("node_id", T.BYTES), _f("plane_addr", T.STR),
+    _f("plane_objects", T.ANY),
+], doc="agent joins; reply {node_id, shm_name, shm_size, log_dir}")
+register_op(3, "heartbeat", [_f("stats", T.ANY)],
+            doc="agent liveness + node physical stats (notify)")
+
+# -- distributed borrowing (reference: reference_counter.cc borrow protocol)
+register_op(4, "ref_add", [_f("oid", T.BYTES, required=True)])
+register_op(5, "ref_drop", [_f("oid", T.BYTES, required=True)])
+
+# -- remote pdb registry
+register_op(6, "debug_register", [_f("session", T.ANY, required=True)])
+register_op(7, "debug_unregister", [_f("id", T.STR, required=True)])
+register_op(8, "debug_list", [])
+
+# -- object directory / transfer plane control
+register_op(9, "locate_object", [_f("oid", T.BYTES, required=True)])
+register_op(10, "object_added", [
+    _f("oid", T.BYTES, required=True), _f("size", T.INT)])
+register_op(11, "object_removed", [
+    _f("oid", T.BYTES, required=True), _f("node", T.BYTES)])
+
+# -- pub/sub bridge (reference: src/ray/pubsub long-poll -> pushed notifies)
+register_op(12, "pubsub_publish", [
+    _f("channel", T.STR, required=True), _f("blob", T.BLOB, required=True)])
+register_op(13, "pubsub_subscribe", [
+    _f("channel", T.STR, required=True), _f("sub", T.STR, required=True)])
+register_op(14, "pubsub_unsubscribe", [_f("sub", T.STR)])
+register_op(15, "pubsub_msg", [
+    _f("channel", T.STR), _f("sub", T.STR, required=True),
+    _f("blob", T.BLOB, required=True)], doc="head->client delivery (notify)")
+
+# -- worker/client task + object plane (reference: CoreWorker<->GCS/raylet)
+register_op(16, "client_submit", [
+    _f("func", T.BLOB, required=True), _f("args", T.BLOB, required=True),
+    # opts is OPAQUE (cloudpickle): task options legitimately carry user
+    # types (retry_exceptions=(MyError,)) that are not msgpack-native
+    _f("opts", T.BLOB)])
+register_op(17, "client_get", [
+    _f("oids", T.ANY, required=True), _f("get_timeout", T.FLOAT),
+    _f("task", T.BYTES), _f("materialize", T.BOOL)],
+    doc="runs on the reactor; the handler itself defers to a thread only "
+        "for gets that may park (cluster.py _h_client_get)")
+register_op(18, "client_put", [
+    _f("blob", T.BLOB, required=True), _f("task", T.BYTES)])
+register_op(19, "client_put_alloc", [])
+register_op(20, "client_put_seal", [
+    _f("oid", T.BYTES, required=True), _f("size", T.INT, required=True),
+    _f("contained", T.ANY), _f("task", T.BYTES)])
+register_op(21, "client_wait", [
+    _f("oids", T.ANY, required=True), _f("num_returns", T.INT, required=True),
+    _f("wait_timeout", T.FLOAT), _f("fetch_local", T.BOOL),
+    _f("task", T.BYTES)], blocking=True)
+register_op(22, "client_free", [_f("oids", T.ANY, required=True)])
+register_op(23, "client_cancel", [
+    _f("oid", T.BYTES, required=True), _f("force", T.BOOL)])
+register_op(24, "client_create_actor", [
+    _f("cls", T.BLOB, required=True), _f("args", T.BLOB, required=True),
+    _f("opts", T.BLOB)], blocking=True)
+register_op(25, "client_actor_call", [
+    _f("actor", T.BYTES, required=True), _f("method", T.STR, required=True),
+    _f("args", T.BLOB, required=True), _f("opts", T.BLOB)])
+register_op(26, "client_get_actor", [
+    _f("name", T.STR, required=True), _f("namespace", T.STR)])
+register_op(27, "client_kill_actor", [
+    _f("actor", T.BYTES, required=True), _f("no_restart", T.BOOL)])
+register_op(28, "client_actor_cls", [_f("actor", T.BYTES, required=True)])
+register_op(29, "client_next_stream", [
+    _f("stream", T.BYTES, required=True), _f("index", T.INT, required=True)],
+    blocking=True)
+register_op(30, "client_stream_done", [
+    _f("stream", T.BYTES, required=True), _f("index", T.INT, required=True)])
+
+# -- head -> agent dispatch plane (reference: PushNormalTask lease reuse)
+register_op(31, "execute_task", [
+    _f("fn", T.BLOB, required=True), _f("args", T.BLOB, required=True),
+    _f("oid", T.BYTES), _f("task", T.BYTES), _f("renv", T.ANY)],
+    doc="deferred reply: resolves when the pool finishes")
+register_op(32, "task_blocked", [_f("task", T.BYTES, required=True)])
+register_op(33, "plane_free", [_f("oid", T.BYTES, required=True)])
+register_op(34, "kill_worker", [])
+register_op(35, "num_alive", [])
+register_op(36, "ping", [])
+register_op(37, "shutdown", [])
+
+# -- node-to-node object transfer (reference: object_manager.cc chunk pulls)
+register_op(38, "obj_meta", [_f("oid", T.BYTES, required=True)])
+register_op(39, "obj_chunk", [
+    _f("oid", T.BYTES, required=True), _f("off", T.INT, required=True),
+    _f("len", T.INT, required=True)])
+register_op(40, "obj_done", [_f("oid", T.BYTES, required=True)])
+
+# -- cross-language plane, folded into the native protocol (v2; reference:
+#    cross_language.py descriptor calls — clients name code, never ship it)
+register_op(41, "xl_call", [
+    _f("func", T.STR, required=True), _f("args", T.ANY),
+    _f("kwargs", T.ANY), _f("timeout", T.FLOAT)], since=2, blocking=True)
+register_op(42, "xl_submit", [
+    _f("func", T.STR, required=True), _f("args", T.ANY)], since=2)
+register_op(43, "xl_get", [
+    _f("ref", T.STR, required=True), _f("timeout", T.FLOAT)],
+    since=2, blocking=True)
+register_op(44, "xl_put", [_f("value", T.ANY)], since=2)
+register_op(45, "xl_free", [_f("ref", T.STR, required=True)], since=2)
+register_op(46, "xl_actor_create", [
+    _f("cls", T.STR, required=True), _f("args", T.ANY)], since=2,
+    blocking=True)
+register_op(47, "xl_actor_call", [
+    _f("actor", T.STR, required=True), _f("method", T.STR, required=True),
+    _f("args", T.ANY), _f("timeout", T.FLOAT)], since=2, blocking=True)
+register_op(48, "xl_kill_actor", [_f("actor", T.STR, required=True)], since=2)
+register_op(49, "xl_list_funcs", [], since=2)
+
+# -- internal KV read for workers (v2)
+register_op(50, "kv_get", [
+    _f("key", T.BYTES, required=True), _f("namespace", T.BYTES)], since=2)

@@ -1,0 +1,42 @@
+"""Opaque user-payload codec: the ONLY pickle in ``core/rpc/``.
+
+The wire envelope is schema'd msgpack; what remains opaque is user data —
+functions, args, results, and the exceptions handlers raise. Those travel
+as bytes fields, produced/consumed here. ``scripts/check_wire_schemas.py``
+asserts pickle never appears anywhere else under ``core/rpc/``.
+
+Security note: exception blobs are unpickled only between processes the
+session itself spawned, sharing one auth token (the trust domain the old
+wire.py documented). Non-Python peers ignore the blob and use the message
+string carried alongside it.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+
+class RemoteError(RuntimeError):
+    """A handler failed on the peer and its exception could not be
+    reconstructed locally (foreign type, or a non-Python peer)."""
+
+
+def dumps_exception(e: BaseException) -> "tuple[str, Optional[bytes]]":
+    """(message, blob) for an ERROR frame; blob may be None if unpicklable."""
+    message = f"{type(e).__name__}: {e}"
+    try:
+        return message, pickle.dumps(e)
+    except Exception:
+        return message, None
+
+
+def loads_exception(message: str, blob: Optional[bytes]) -> BaseException:
+    if blob is not None:
+        try:
+            e = pickle.loads(blob)
+            if isinstance(e, BaseException):
+                return e
+        except Exception:
+            pass
+    return RemoteError(message)

@@ -274,6 +274,14 @@ class Runtime:
         # store (isolated-plane agents); the head's own shm/spill holdings are
         # covered by shm_store.contains/spill.is_spilled.
         self._plane_locations: dict[ObjectID, set[NodeID]] = {}
+        # Locations SEEDED from the durable plane table by restore_session():
+        # node_id -> monotonic deadline. A seeded holder is unconfirmed — its
+        # agent may have died during the head outage — so unless the agent
+        # re-registers within the reconnect grace window, its entries expire
+        # and gets fall through to reconstruction/ObjectLostError instead of
+        # spinning on a holder that will never dial in (ADVICE round-5
+        # liveness finding, _resolve_obj wait-for-holder branch).
+        self._plane_seeded: dict[NodeID, float] = {}
         # worker puts pinned until their task's result is processed (closes
         # the ref_drop-vs-result borrow race; see hold_put_for_task)
         self._task_put_holds: dict[bytes, list] = {}
@@ -627,9 +635,17 @@ class Runtime:
 
     # ---------------------------------------------------- object plane
     def plane_object_added(self, oid: ObjectID, node_id: NodeID,
-                           size: int = 0, _persist: bool = True) -> None:
+                           size: int = 0, _persist: bool = True,
+                           seeded: bool = False) -> None:
         with self._lock:
             self._plane_locations.setdefault(oid, set()).add(node_id)
+            if seeded and node_id not in self._agents:
+                # restored from the durable table, unconfirmed by a live
+                # agent: expires unless the node re-registers in time
+                self._plane_seeded.setdefault(
+                    node_id,
+                    time.monotonic() + float(os.environ.get(
+                        "RAY_TPU_HEAD_RECONNECT_S", "60")))
         if _persist:
             from ray_tpu._private import persistence
 
@@ -650,7 +666,46 @@ class Runtime:
         if store is not None:
             store.plane_remove(oid.binary(), node_id.binary())
 
+    def confirm_plane_node(self, node_id: NodeID) -> None:
+        """An agent (re-)registered: its seeded plane locations are real."""
+        with self._lock:
+            self._plane_seeded.pop(node_id, None)
+
+    def _expire_seeded_planes(self) -> None:
+        """Drop restored plane locations whose node never re-registered
+        within the reconnect grace window — the holder died with the old
+        head, and a get() waiting on it must fall through to lineage
+        reconstruction or ObjectLostError rather than spin forever."""
+        if not self._plane_seeded:  # hot-path fast exit, no lock
+            return
+        now = time.monotonic()
+        with self._lock:
+            expired = [nid for nid, deadline in self._plane_seeded.items()
+                       if now > deadline]
+            for nid in expired:
+                self._plane_seeded.pop(nid, None)
+        if not expired:
+            return
+        from ray_tpu._private import persistence
+
+        store = persistence.get_store()
+        for nid in expired:
+            logger.warning(
+                "restored plane node %s never re-registered within the "
+                "grace window; expiring its object locations",
+                nid.hex()[:12])
+            with self._lock:
+                self._plane_addrs.pop(nid, None)
+                for oid, holders in list(self._plane_locations.items()):
+                    if nid in holders:
+                        holders.discard(nid)
+                        if store is not None:
+                            store.plane_remove(oid.binary(), nid.binary())
+                        if not holders:
+                            self._plane_locations.pop(oid, None)
+
     def has_plane_copy(self, oid: ObjectID) -> bool:
+        self._expire_seeded_planes()
         with self._lock:
             return bool(self._plane_locations.get(oid))
 
@@ -975,7 +1030,7 @@ class Runtime:
                     task=spec.task_id.binary(), renv=None,
                 )
             except Exception as e:  # peer closed racing dispatch
-                from ray_tpu.core.wire import PeerDisconnected
+                from ray_tpu.core.rpc import PeerDisconnected
 
                 if isinstance(e, PeerDisconnected):
                     # same wrap as the sync path: agent death is a retryable
@@ -999,7 +1054,7 @@ class Runtime:
                              rids: list, fut) -> None:
         """Agent-reader-thread callback: the tail of _execute_on_agent for
         pushed dispatches."""
-        from ray_tpu.core.wire import PeerDisconnected
+        from ray_tpu.core.rpc import PeerDisconnected
 
         spec = entry.spec
         try:
@@ -1112,7 +1167,10 @@ class Runtime:
                 (tid, e) for tid, e in self._tasks.items()
                 if e.state in ("FINISHED", "FAILED", "CANCELLED")
             ]
-            excess = len(self._tasks) - cap // 2
+            # trim only the overage past the cap (the unparenthesized
+            # `len - cap // 2` halved the table every GC, costing the state
+            # API 2x the documented history — ADVICE round-5 finding)
+            excess = len(self._tasks) - cap
             terminal.sort(key=lambda kv: kv[1].end_time or 0.0)
             for tid, _ in terminal[:excess]:
                 self._tasks.pop(tid, None)
@@ -1436,7 +1494,7 @@ class Runtime:
         """Dispatch to a node agent over the control plane (reference: lease
         granted on a remote raylet -> PushNormalTask to its worker,
         normal_task_submitter.cc:515)."""
-        from ray_tpu.core.wire import PeerDisconnected
+        from ray_tpu.core.rpc import PeerDisconnected
 
         spec = entry.spec
         if entry.cancelled:
@@ -2140,18 +2198,25 @@ class Runtime:
     def _finish_async_actor_call(self, state: _ActorState, spec, entry,
                                  mailbox, sem, fut) -> None:
         """Event-loop callback: the tail of _actor_loop for async methods
-        completed without a parked thread (store/fail/retry + bookkeeping)."""
-        retrying = False
+        completed without a parked thread.
+
+        Runs ON the actor's asyncio loop thread (run_coroutine_threadsafe
+        fires callbacks there), so it does the MINIMUM: the retry decision,
+        the admission-permit release, and re-enqueue. The store/bookkeeping
+        tail — result serialization, possible shm writes, event recording —
+        hands off to the shared resolve pool, so one large async result
+        cannot stall every other in-flight coroutine of the actor (ADVICE
+        round-5 finding)."""
         try:
+            result = fut.result()
+        except BaseException as e:  # noqa: BLE001
+            retrying = False
             try:
-                result = fut.result()
-            except BaseException as e:  # noqa: BLE001
                 attempts = entry.attempts if entry else 0
                 if (_retries_left(spec, attempts) and _should_retry(spec, e)
                         and state.state == "ALIVE"):
                     if entry:
                         entry.attempts += 1
-                    retrying = True
                     logger.warning(
                         "Actor task %s failed (%s); retry %d/%d",
                         spec.desc(), type(e).__name__, attempts + 1,
@@ -2159,34 +2224,55 @@ class Runtime:
                     )
                     self._record_event(spec, "RETRYING")
                     mailbox.put((spec, spec.return_ids()[0]))
-                    return
+                    retrying = True
+            finally:
+                # the permit and the task's terminal bookkeeping must
+                # happen even if the retry bookkeeping itself raised
+                sem.release()
+                if not retrying:
+                    self._submit_async_tail(state, spec, entry, None, e)
+            return
+        sem.release()
+        self._submit_async_tail(state, spec, entry, result, None)
+
+    def _submit_async_tail(self, state, spec, entry, result, exc) -> None:
+        """Queue the store/bookkeeping tail on the resolve pool; if the pool
+        is gone (session teardown), run inline — the task's result must
+        never be silently stranded with pending_count held."""
+        try:
+            self._async_resolve_pool().submit(
+                self._finish_async_actor_tail, state, spec, entry, result,
+                exc)
+        except BaseException:  # noqa: BLE001 — pool shut down
+            self._finish_async_actor_tail(state, spec, entry, result, exc)
+
+    def _finish_async_actor_tail(self, state: _ActorState, spec, entry,
+                                 result, exc) -> None:
+        """Resolve-pool side of _finish_async_actor_call: store the result or
+        error and close out the task's bookkeeping (off the loop thread)."""
+        try:
+            if exc is None:
+                try:
+                    self._store_returns(spec, result)
+                except BaseException as e:  # noqa: BLE001 — unserializable
+                    exc = e
+            if exc is not None:
                 if entry:
                     entry.state = "FAILED"
                     entry.end_time = time.time()
                 self._record_event(spec, "FAILED")
-                self._store_error(spec, TaskError(e, spec.desc()))
-                return
-            try:
-                self._store_returns(spec, result)
-            except BaseException as e:  # noqa: BLE001 — e.g. unserializable
+                self._store_error(spec, TaskError(exc, spec.desc()))
+            else:
                 if entry:
-                    entry.state = "FAILED"
+                    entry.state = "FINISHED"
                     entry.end_time = time.time()
-                self._record_event(spec, "FAILED")
-                self._store_error(spec, TaskError(e, spec.desc()))
-                return
-            if entry:
-                entry.state = "FINISHED"
-                entry.end_time = time.time()
-            self._record_event(spec, "FINISHED")
+                self._record_event(spec, "FINISHED")
         finally:
-            sem.release()
-            if not retrying:
-                self.reference_counter.remove_submitted_task_refs(
-                    [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
-                )
-                with state.lock:
-                    state.pending_count -= 1
+            self.reference_counter.remove_submitted_task_refs(
+                [r.object_id() for r in _ref_args(spec.args, spec.kwargs)]
+            )
+            with state.lock:
+                state.pending_count -= 1
 
     def _run_proc_actor_generator(self, spec: TaskSpec, proc_worker,
                                   args_blob: bytes) -> None:

@@ -1,310 +1,28 @@
-"""Framed bidirectional RPC over TCP: the control-plane transport.
+"""Compat shim: the control-plane transport moved to ``ray_tpu.core.rpc``.
 
-Parity: the reference's gRPC control plane (src/ray/rpc/grpc_server.h:93,
-retryable_grpc_client.h:81) — here a length-prefixed pickle protocol between
-same-user processes on one trust domain, with the same shape: request/response
-with correlation ids, one-way notifications, per-connection reader loop, and
-disconnect propagation (a dead peer fails all in-flight calls, the analog of
-gRPC UNAVAILABLE).
-
-Security note: frames are pickle — this transport is for processes the session
-itself spawned (head, node agents, workers), bound to 127.0.0.1, carrying a
-shared session token. The reference similarly trusts its gRPC mesh by default
-(token auth optional, rpc/authentication/).
+Historically this module implemented a length-prefixed **pickle** protocol
+with a thread per inbound request. Both are gone: frames are now versioned,
+schema'd msgpack (core/rpc/codec.py + core/rpc/schema.py — no pickled
+control structures on the wire), version-negotiated at hello, and served by
+a bounded reactor per peer (core/rpc/reactor.py). Existing importers keep
+working through these re-exports; new code should import ray_tpu.core.rpc
+directly.
 """
 
 from __future__ import annotations
 
-import itertools
-import pickle
-import socket
 import struct
-import threading
-from concurrent.futures import Future
-from typing import Any, Callable, Optional
 
+from ray_tpu.core.rpc.codec import MAX_FRAME
+from ray_tpu.core.rpc.peer import (
+    PeerDisconnected,
+    RpcPeer,
+    RpcServer,
+    connect,
+)
+
+# legacy frame-header struct, still the layout (u32 big-endian length prefix)
 _LEN = struct.Struct(">I")
-MAX_FRAME = 1 << 31
 
-
-class PeerDisconnected(ConnectionError):
-    """The remote end of an RpcPeer went away (fails all in-flight calls)."""
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise PeerDisconnected("socket closed")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-class RpcPeer:
-    """One end of a full-duplex message link.
-
-    ``handlers`` maps op name -> fn(peer, msg_dict) -> reply payload (any
-    picklable value). Handler exceptions travel back and re-raise at the
-    caller. Each inbound request runs on its own thread (control-plane
-    volume; execution-ordering guarantees live above this layer, e.g. actor
-    mailboxes)."""
-
-    def __init__(
-        self,
-        sock: socket.socket,
-        handlers: dict[str, Callable[["RpcPeer", dict], Any]] | None = None,
-        on_disconnect: Callable[["RpcPeer"], None] | None = None,
-        name: str = "peer",
-    ):
-        self._sock = sock
-        self._handlers = handlers or {}
-        self._on_disconnect = on_disconnect
-        self.name = name
-        self._wlock = threading.Lock()
-        self._pending: dict[int, Future] = {}
-        self._plock = threading.Lock()
-        self._ids = itertools.count(1)
-        self._closed = False
-        self.meta: dict = {}  # server-side: registration info lives here
-        self._reader = threading.Thread(
-            target=self._read_loop, daemon=True, name=f"rpc-read-{name}"
-        )
-        self._reader.start()
-
-    # --- outbound ---
-    def call(self, op: str, timeout: float | None = None, **payload) -> Any:
-        """Request/response; raises the handler's exception or PeerDisconnected."""
-        mid, fut = self.call_async(op, **payload)
-        try:
-            return fut.result(timeout=timeout)
-        finally:
-            with self._plock:
-                self._pending.pop(mid, None)
-
-    def call_async(self, op: str, **payload) -> tuple[int, Future]:
-        """Fire a request and return (id, Future) without blocking — lets a
-        caller keep a window of requests in flight (the object plane pipelines
-        chunk fetches this way, like the reference's windowed chunked pulls,
-        object_manager.cc:536). Caller must pop self._pending[id] via
-        finish_call() when done."""
-        mid = next(self._ids)
-        fut: Future = Future()
-        with self._plock:
-            if self._closed:
-                raise PeerDisconnected(f"{self.name} is closed")
-            self._pending[mid] = fut
-        try:
-            self._send({"op": op, "id": mid, **payload})
-        except BaseException:
-            # e.g. frame-too-large ValueError: the request never left, so the
-            # pending future would otherwise leak for the connection's life
-            with self._plock:
-                self._pending.pop(mid, None)
-            raise
-        return mid, fut
-
-    def finish_call(self, mid: int) -> None:
-        with self._plock:
-            self._pending.pop(mid, None)
-
-    def notify(self, op: str, **payload) -> None:
-        """One-way message (no reply expected)."""
-        self._send({"op": op, **payload})
-
-    def _send(self, msg: dict) -> None:
-        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        if len(blob) > MAX_FRAME:
-            raise ValueError(f"frame too large: {len(blob)} bytes")
-        try:
-            with self._wlock:
-                self._sock.sendall(_LEN.pack(len(blob)) + blob)
-        except OSError as e:
-            self._fail(PeerDisconnected(f"send to {self.name} failed: {e}"))
-            raise PeerDisconnected(str(e)) from e
-
-    # --- inbound ---
-    def _read_loop(self) -> None:
-        try:
-            while True:
-                (n,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
-                msg = pickle.loads(_recv_exact(self._sock, n))
-                if "reply_to" in msg:
-                    with self._plock:
-                        fut = self._pending.pop(msg["reply_to"], None)
-                    if fut is not None and not fut.done():
-                        if "error" in msg:
-                            fut.set_exception(pickle.loads(msg["error"]))
-                        else:
-                            fut.set_result(msg.get("result"))
-                elif msg.get("id") is None:
-                    # NOTIFICATIONS run inline on the reader so their order is
-                    # preserved (pubsub/heartbeat contracts); handlers must be
-                    # cheap — anything long-running belongs in a request
-                    self._handle(msg)
-                else:
-                    threading.Thread(
-                        target=self._handle, args=(msg,), daemon=True,
-                        name=f"rpc-h-{msg.get('op', '?')}",
-                    ).start()
-        except (PeerDisconnected, OSError, EOFError, pickle.UnpicklingError) as e:
-            self._fail(PeerDisconnected(f"{self.name} disconnected: {e}"))
-
-    def _handle(self, msg: dict) -> None:
-        op, mid = msg.get("op"), msg.get("id")
-        handler = self._handlers.get(op)
-        try:
-            if handler is None:
-                raise ValueError(f"unknown rpc op {op!r}")
-            result = handler(self, msg)
-            if mid is not None:
-                if isinstance(result, Future):
-                    # Deferred reply: the handler pipelined the work (e.g. a
-                    # node agent queuing onto its worker pool) — send the
-                    # frame when the future resolves, freeing this thread.
-                    result.add_done_callback(
-                        lambda f, mid=mid: self._send_deferred_reply(mid, f))
-                    return
-                self._send({"reply_to": mid, "result": result})
-        except PeerDisconnected:
-            pass
-        except BaseException as e:  # noqa: BLE001 — ship the error back
-            if mid is not None:
-                self._send_error_reply(mid, e)
-
-    def _send_deferred_reply(self, mid: int, fut: Future) -> None:
-        try:
-            result = fut.result()
-        except PeerDisconnected:
-            return
-        except BaseException as e:  # noqa: BLE001
-            self._send_error_reply(mid, e)
-            return
-        try:
-            self._send({"reply_to": mid, "result": result})
-        except PeerDisconnected:
-            pass
-        except BaseException as e:  # noqa: BLE001 — e.g. frame-too-large:
-            # the caller must get SOMETHING or its future hangs forever
-            self._send_error_reply(mid, e)
-
-    def _send_error_reply(self, mid: int, e: BaseException) -> None:
-        try:
-            blob = pickle.dumps(e)
-        except Exception:
-            blob = pickle.dumps(RuntimeError(f"{type(e).__name__}: {e}"))
-        try:
-            self._send({"reply_to": mid, "error": blob})
-        except PeerDisconnected:
-            pass
-
-    def _fail(self, exc: Exception) -> None:
-        with self._plock:
-            if self._closed:
-                return
-            self._closed = True
-            pending, self._pending = self._pending, {}
-        for fut in pending.values():
-            if not fut.done():
-                fut.set_exception(exc)
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        if self._on_disconnect is not None:
-            try:
-                self._on_disconnect(self)
-            except Exception:
-                pass
-
-    @property
-    def closed(self) -> bool:
-        return self._closed
-
-    @property
-    def local_address(self) -> tuple:
-        """(host, port) of this end of the connection — the routable address
-        peers on the remote side could reach this host at."""
-        return self._sock.getsockname()
-
-    def close(self) -> None:
-        self._fail(PeerDisconnected(f"{self.name} closed locally"))
-
-
-class RpcServer:
-    """Listening endpoint; wraps each accepted connection in an RpcPeer.
-
-    The reference analog is GrpcServer (grpc_server.h:93): one listener, a
-    service handler table, per-call dispatch."""
-
-    def __init__(
-        self,
-        handlers: dict[str, Callable[[RpcPeer, dict], Any]],
-        host: str = "127.0.0.1",
-        port: int = 0,
-        on_connect: Callable[[RpcPeer], None] | None = None,
-        on_disconnect: Callable[[RpcPeer], None] | None = None,
-    ):
-        self._handlers = handlers
-        self._on_connect = on_connect
-        self._on_disconnect = on_disconnect
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
-        self._listener.listen(128)
-        self.address = self._listener.getsockname()  # (host, port)
-        self.peers: list[RpcPeer] = []
-        self._lock = threading.Lock()
-        self._closed = False
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="rpc-accept"
-        )
-        self._accept_thread.start()
-
-    def _accept_loop(self) -> None:
-        while not self._closed:
-            try:
-                sock, addr = self._listener.accept()
-            except OSError:
-                return
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            peer = RpcPeer(
-                sock, self._handlers, on_disconnect=self._peer_gone,
-                name=f"conn-{addr[1]}",
-            )
-            with self._lock:
-                self.peers.append(peer)
-            if self._on_connect is not None:
-                self._on_connect(peer)
-
-    def _peer_gone(self, peer: RpcPeer) -> None:
-        with self._lock:
-            if peer in self.peers:
-                self.peers.remove(peer)
-        if self._on_disconnect is not None:
-            self._on_disconnect(peer)
-
-    def close(self) -> None:
-        self._closed = True
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        with self._lock:
-            peers, self.peers = list(self.peers), []
-        for p in peers:
-            p.close()
-
-
-def connect(
-    host: str,
-    port: int,
-    handlers: dict[str, Callable[[RpcPeer, dict], Any]] | None = None,
-    on_disconnect: Callable[[RpcPeer], None] | None = None,
-    timeout: float = 10.0,
-    name: str = "client",
-) -> RpcPeer:
-    sock = socket.create_connection((host, port), timeout=timeout)
-    sock.settimeout(None)
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    return RpcPeer(sock, handlers, on_disconnect=on_disconnect, name=name)
+__all__ = ["MAX_FRAME", "PeerDisconnected", "RpcPeer", "RpcServer",
+           "connect", "_LEN"]

@@ -36,6 +36,12 @@ class TaskError(RayTpuError):
     def as_cause(self) -> BaseException:
         return self.cause
 
+    def __reduce__(self):
+        # default exception pickling replays __init__(*args) with the
+        # MESSAGE string as `cause`, which breaks on unpickle; rebuild from
+        # the real constructor inputs so the error crosses the wire intact
+        return (type(self), (self.cause, self.task_desc, self.remote_tb))
+
 
 class ActorError(RayTpuError):
     """The actor died before or during this method call (reference: RayActorError)."""

@@ -1,32 +1,26 @@
-"""Cross-language client plane: JSON-framed TCP for non-Python clients.
+"""Cross-language client plane: non-Python clients on the NATIVE wire.
 
 Parity: the reference's cross-language surface — Java/C++ workers invoke
 Python functions through language-neutral descriptors
 (python/ray/cross_language.py, msgpack envelopes per
-src/ray/protobuf/serialization.proto) and the C++ worker API (cpp/include/
-ray/api.h). Here the neutral encoding is length-prefixed JSON (binary values
-as {"__bytes__": base64}); callables are invoked by REGISTERED name, the
-same "function descriptor, not pickled code" model the reference uses across
-languages. The C++ client library lives in cpp/ (ray_tpu_client.hpp).
+src/ray/protobuf/serialization.proto) and the C++ worker API
+(cpp/include/ray/api.h). Callables are invoked by REGISTERED name — the
+"function descriptor, not pickled code" model the reference uses across
+languages.
 
-Frames: 4-byte big-endian length + JSON object. Requests carry {"id", "op",
-...}; replies {"id", "result"} or {"id", "error"}.
-
-Ops: hello{token} | call{func,args,kwargs} (submit + wait, returns the value)
-| submit{func,args} -> {ref} | get{ref} | put{value} -> {ref} | free{ref}
-| actor_create{cls,args} -> {actor} | actor_call{actor,method,args}
-| kill_actor{actor} | list_funcs.
+Historically this module ran a separate JSON-framed TCP endpoint. That
+side-channel is gone: the ``xl_*`` ops are numbered, versioned msgpack
+schemas on the MAIN control plane (core/rpc/schema.py ops 41-49, served by
+core/cluster.py), so a C++ client (cpp/ray_tpu_client.hpp) authenticates
+with the session token and speaks the same framed protocol as Python
+workers — version negotiation, retry semantics, and all. Values are
+msgpack-native (bytes travel as bin, no base64 envelope); this module keeps
+the registry and the numpy-aware value codec.
 """
 
 from __future__ import annotations
 
-import base64
-import json
-import socket
-import threading
 from typing import Any, Callable, Optional
-
-from ray_tpu.core.wire import _LEN, MAX_FRAME
 
 _registry: dict[str, Callable] = {}
 _actor_registry: dict[str, type] = {}
@@ -42,9 +36,29 @@ def register_actor(name: str, cls: type) -> None:
     _actor_registry[name] = cls
 
 
+def lookup(name: str) -> Callable:
+    fn = _registry.get(name)
+    if fn is None:
+        raise KeyError(f"unknown xlang function {name!r} "
+                       f"(registered: {sorted(_registry)})")
+    return fn
+
+
+def lookup_actor(name: str) -> type:
+    cls = _actor_registry.get(name)
+    if cls is None:
+        raise KeyError(f"unknown xlang actor {name!r} "
+                       f"(registered: {sorted(_actor_registry)})")
+    return cls
+
+
 def _decode(v: Any) -> Any:
+    """Wire value -> Python. msgpack gives us native types; kept as a hook
+    (and for the legacy {"__bytes__": b64} envelope older clients send)."""
     if isinstance(v, dict):
         if "__bytes__" in v and len(v) == 1:
+            import base64
+
             return base64.b64decode(v["__bytes__"])
         return {k: _decode(x) for k, x in v.items()}
     if isinstance(v, list):
@@ -53,10 +67,12 @@ def _decode(v: Any) -> Any:
 
 
 def _encode(v: Any) -> Any:
+    """Python value -> msgpack-native wire value (numpy flattened; tuples
+    become lists; bytes pass through as bin)."""
     import numpy as np
 
     if isinstance(v, (bytes, bytearray, memoryview)):
-        return {"__bytes__": base64.b64encode(bytes(v)).decode()}
+        return bytes(v)
     if isinstance(v, np.generic):
         return v.item()
     if isinstance(v, np.ndarray):
@@ -68,181 +84,44 @@ def _encode(v: Any) -> Any:
     return v
 
 
-class XLangServer:
-    """One listener; each connection served by a reader thread. Ops execute
-    through the session runtime, so cross-language tasks get the same
-    scheduling/FT as Python tasks."""
+class XLangEndpoint:
+    """Handle for the cross-language surface of a live session: the address
+    + token a non-Python client needs. The ops are served by the session's
+    control plane itself; close() is retained for API compatibility and
+    drops nothing but this handle."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 token: str | None = None):
-        import ray_tpu
+    def __init__(self, control_plane):
+        self._cp = control_plane
+        self.address = control_plane.address
+        self.token = control_plane.token
 
-        if not ray_tpu.is_initialized():
-            raise RuntimeError("ray_tpu.init() before starting the xlang server")
-        from ray_tpu.core.runtime import get_runtime
-
-        self._rt = get_runtime()
-        self.token = token if token is not None else (
-            self._rt.control_plane.token if self._rt.control_plane else "")
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
-        self._listener.listen(16)
-        self.address = "%s:%d" % self._listener.getsockname()
-        self._closed = False
-        self._refs: dict[str, Any] = {}  # held for the client (borrow analog)
-        self._actors: dict[str, Any] = {}
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name="xlang-accept").start()
-
-    # ---------------------------------------------------------------- ops
-    def _op_call(self, msg):
-        import ray_tpu
-
-        fn = _registry[msg["func"]]
-        args = _decode(msg.get("args") or [])
-        kwargs = _decode(msg.get("kwargs") or {})
-        ref = ray_tpu.remote(fn).remote(*args, **kwargs)
-        return _encode(ray_tpu.get(ref, timeout=msg.get("timeout")))
-
-    def _op_submit(self, msg):
-        import ray_tpu
-
-        fn = _registry[msg["func"]]
-        ref = ray_tpu.remote(fn).remote(*_decode(msg.get("args") or []))
-        rid = ref.object_id().hex()
-        self._refs[rid] = ref
-        return {"ref": rid}
-
-    def _op_get(self, msg):
-        import ray_tpu
-
-        ref = self._refs.get(msg["ref"])
-        if ref is None:
-            raise KeyError(f"unknown ref {msg['ref']}")
-        return _encode(ray_tpu.get(ref, timeout=msg.get("timeout")))
-
-    def _op_put(self, msg):
-        import ray_tpu
-
-        ref = ray_tpu.put(_decode(msg["value"]))
-        rid = ref.object_id().hex()
-        self._refs[rid] = ref
-        return {"ref": rid}
-
-    def _op_free(self, msg):
-        self._refs.pop(msg["ref"], None)
-        return True
-
-    def _op_actor_create(self, msg):
-        import ray_tpu
-
-        cls = _actor_registry[msg["cls"]]
-        handle = ray_tpu.remote(cls).remote(*_decode(msg.get("args") or []))
-        aid = handle._actor_id.hex()
-        self._actors[aid] = handle
-        return {"actor": aid}
-
-    def _op_actor_call(self, msg):
-        import ray_tpu
-
-        handle = self._actors[msg["actor"]]
-        method = getattr(handle, msg["method"])
-        ref = method.remote(*_decode(msg.get("args") or []))
-        return _encode(ray_tpu.get(ref, timeout=msg.get("timeout")))
-
-    def _op_kill_actor(self, msg):
-        import ray_tpu
-
-        handle = self._actors.pop(msg["actor"], None)
-        if handle is not None:
-            ray_tpu.kill(handle)
-        return True
-
-    def _op_list_funcs(self, msg):
-        return {"funcs": sorted(_registry), "actors": sorted(_actor_registry)}
-
-    # ---------------------------------------------------------- plumbing
-    def _accept_loop(self):
-        while not self._closed:
-            try:
-                sock, _ = self._listener.accept()
-            except OSError:
-                return
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._serve_conn, args=(sock,),
-                             daemon=True, name="xlang-conn").start()
-
-    def _recv_exact(self, sock, n):
-        if n > MAX_FRAME:
-            # bound honored BEFORE auth: an unauthenticated peer must not be
-            # able to drive allocation with a forged length header (wire.py's
-            # MAX_FRAME discipline)
-            raise ConnectionError(f"frame too large: {n}")
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("closed")
-            buf.extend(chunk)
-        return bytes(buf)
-
-    def _serve_conn(self, sock):
-        ops = {
-            "call": self._op_call, "submit": self._op_submit,
-            "get": self._op_get, "put": self._op_put, "free": self._op_free,
-            "actor_create": self._op_actor_create,
-            "actor_call": self._op_actor_call,
-            "kill_actor": self._op_kill_actor,
-            "list_funcs": self._op_list_funcs,
-        }
-        authed = False
-        try:
-            while True:
-                (n,) = _LEN.unpack(self._recv_exact(sock, 4))
-                msg = json.loads(self._recv_exact(sock, n))
-                mid = msg.get("id")
-                try:
-                    op = msg.get("op")
-                    if op == "hello":
-                        if self.token and msg.get("token") != self.token:
-                            raise PermissionError("bad token")
-                        authed = True
-                        reply = {"id": mid, "result": {"ok": True}}
-                    elif not authed:
-                        raise PermissionError("hello first")
-                    else:
-                        reply = {"id": mid, "result": ops[op](msg)}
-                except BaseException as e:  # noqa: BLE001 — ship error to client
-                    reply = {"id": mid,
-                             "error": f"{type(e).__name__}: {e}"}
-                try:
-                    blob = json.dumps(reply).encode()
-                except (TypeError, ValueError) as e:
-                    # result not JSON-encodable: an error reply, not a dead
-                    # connection (sets, custom objects, NaN keys...)
-                    blob = json.dumps({
-                        "id": mid,
-                        "error": f"result not JSON-serializable: {e}",
-                    }).encode()
-                sock.sendall(_LEN.pack(len(blob)) + blob)
-        except (ConnectionError, OSError, json.JSONDecodeError):
-            pass
-        finally:
-            try:
-                sock.close()
-            except OSError:
-                pass
-
-    def close(self):
-        self._closed = True
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+    def close(self) -> None:
+        pass  # the control plane outlives the xlang handle
 
 
 def serve(host: str = "127.0.0.1", port: int = 0,
-          token: Optional[str] = None) -> XLangServer:
-    """Start the cross-language endpoint for this session."""
-    return XLangServer(host, port, token)
+          token: Optional[str] = None) -> XLangEndpoint:
+    """Return the session's cross-language endpoint (the control plane).
+
+    ``host``/``port``/``token`` parameters are legacy: the endpoint now IS
+    the control plane, whose bind address/token are fixed at init. Passing
+    non-defaults is loudly ignored — clients must use the returned handle's
+    ``address``/``token``, not values they configured here."""
+    import logging
+
+    if host != "127.0.0.1" or port != 0 or token is not None:
+        logging.getLogger("ray_tpu").warning(
+            "xlang.serve(host/port/token) is ignored: the cross-language "
+            "endpoint is the session control plane; point clients at the "
+            "returned handle's .address/.token (got host=%r port=%r "
+            "token=%s)", host, port, "<set>" if token else None)
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        raise RuntimeError("ray_tpu.init() before starting the xlang server")
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    if rt.control_plane is None:
+        raise RuntimeError("session has no control plane; xlang unavailable")
+    return XLangEndpoint(rt.control_plane)

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Wire-schema lint: the control plane's append-only contract, enforced.
+
+Runnable standalone (``python scripts/check_wire_schemas.py``) and as a
+test (tests/test_round5_fixes-style import; see test_rpc_wire.py). Asserts:
+
+1. every handler registered on a control-plane server (core/cluster.py,
+   core/node_agent.py, core/object_plane.py) has a schema entry;
+2. schema numbers are unique and APPEND-ONLY against the frozen baseline
+   below — renumbering or reusing a shipped number is a wire break;
+3. no ``pickle.dumps``/``pickle.loads`` of control structures remains in
+   ``core/rpc/`` (the single sanctioned pickle site is userblob.py, the
+   opaque user-payload codec) nor in ``core/wire.py``.
+
+When you ADD an op: give it the next free number, bump WIRE_VERSION if the
+op must be gated, run this lint, then extend the baseline in the same PR.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Frozen at ISSUE-2 (wire v2). Append new ops; NEVER edit existing pairs.
+SCHEMA_BASELINE = {
+    "hello": 1, "register_node": 2, "heartbeat": 3, "ref_add": 4,
+    "ref_drop": 5, "debug_register": 6, "debug_unregister": 7,
+    "debug_list": 8, "locate_object": 9, "object_added": 10,
+    "object_removed": 11, "pubsub_publish": 12, "pubsub_subscribe": 13,
+    "pubsub_unsubscribe": 14, "pubsub_msg": 15, "client_submit": 16,
+    "client_get": 17, "client_put": 18, "client_put_alloc": 19,
+    "client_put_seal": 20, "client_wait": 21, "client_free": 22,
+    "client_cancel": 23, "client_create_actor": 24, "client_actor_call": 25,
+    "client_get_actor": 26, "client_kill_actor": 27, "client_actor_cls": 28,
+    "client_next_stream": 29, "client_stream_done": 30, "execute_task": 31,
+    "task_blocked": 32, "plane_free": 33, "kill_worker": 34, "num_alive": 35,
+    "ping": 36, "shutdown": 37, "obj_meta": 38, "obj_chunk": 39,
+    "obj_done": 40, "xl_call": 41, "xl_submit": 42, "xl_get": 43,
+    "xl_put": 44, "xl_free": 45, "xl_actor_create": 46, "xl_actor_call": 47,
+    "xl_kill_actor": 48, "xl_list_funcs": 49, "kv_get": 50,
+}
+
+# Files whose handler tables must be fully schema'd.
+HANDLER_FILES = [
+    "ray_tpu/core/cluster.py",
+    "ray_tpu/core/node_agent.py",
+    "ray_tpu/core/object_plane.py",
+    "ray_tpu/core/client_runtime.py",
+]
+
+# The sanctioned opaque-payload pickle site inside core/rpc/.
+PICKLE_ALLOWED = {"userblob.py"}
+
+
+def _fail(errors: list) -> None:
+    for e in errors:
+        print(f"SCHEMA LINT: {e}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def check_registry() -> list:
+    from ray_tpu.core.rpc import schema
+
+    errors = []
+    nums: dict = {}
+    for name, spec in schema.REGISTRY.items():
+        if spec.num in nums:
+            errors.append(
+                f"op number {spec.num} used by both {name!r} and "
+                f"{nums[spec.num]!r}")
+        nums[spec.num] = name
+        if not (1 <= spec.since <= schema.WIRE_VERSION):
+            errors.append(f"op {name!r}: since={spec.since} outside "
+                          f"[1, WIRE_VERSION={schema.WIRE_VERSION}]")
+    # append-only vs the frozen baseline
+    for name, num in SCHEMA_BASELINE.items():
+        spec = schema.REGISTRY.get(name)
+        if spec is None:
+            errors.append(f"baseline op {name!r} (#{num}) was REMOVED — "
+                          "shipped ops must stay registered")
+        elif spec.num != num:
+            errors.append(f"op {name!r} renumbered {num} -> {spec.num} — "
+                          "numbers are append-only")
+    floor = max(SCHEMA_BASELINE.values())
+    for name, spec in schema.REGISTRY.items():
+        if name not in SCHEMA_BASELINE and spec.num <= floor:
+            errors.append(
+                f"new op {name!r} took number {spec.num} <= baseline max "
+                f"{floor} — new ops must append (and extend the baseline)")
+    return errors
+
+
+def _string_keys_of_dicts(tree: ast.AST) -> set:
+    """All string keys of dict literals + string first-args of handler-map
+    subscripts — a superset of op names used as handler-table keys."""
+    keys = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+    return keys
+
+
+_NON_OPS = {
+    # dict-literal keys in those files that are not handler-table entries
+    "CPU", "TPU", "ok", "node_id", "shm_name", "shm_size", "log_dir",
+    "size", "actors", "funcs", "ref", "actor", "__bytes__", "pid", "ts",
+    "load1", "mem_total_mb", "mem_available_mb", "agent_rss_mb",
+    "workers_alive", "store_used_mb", "store_cap_mb", "num_returns",
+    "max_retries", "retry_exceptions", "name", "resources", "runtime_env",
+    "isolate_process", "peer_hello",
+}
+
+
+def check_handlers_have_schemas() -> list:
+    """Every ``"op": handler`` table entry and every peer.call/notify op
+    literal in the control-plane modules must name a registered schema."""
+    from ray_tpu.core.rpc import schema
+
+    errors = []
+    for rel in HANDLER_FILES:
+        path = os.path.join(REPO, rel)
+        tree = ast.parse(open(path).read(), filename=rel)
+        # call sites: peer.call("op", ...) / notify / call_async
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("call", "call_async", "notify")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                op = node.args[0].value
+                if op not in schema.REGISTRY:
+                    errors.append(f"{rel}: call site uses op {op!r} with no "
+                                  "schema entry")
+        # handler tables: dict literals whose values are function refs and
+        # whose keys look like op names
+        for key in _string_keys_of_dicts(tree):
+            if key in _NON_OPS or not key.replace("_", "").isalpha():
+                continue
+            if key.islower() and "_" in key and key not in schema.REGISTRY:
+                # plausible op-shaped key with no schema — flag it
+                errors.append(f"{rel}: dict key {key!r} looks like an op "
+                              "but has no schema entry (add one, or list "
+                              "it in _NON_OPS)")
+    return errors
+
+
+def check_no_pickle_in_rpc() -> list:
+    errors = []
+    rpc_dir = os.path.join(REPO, "ray_tpu", "core", "rpc")
+    for fname in sorted(os.listdir(rpc_dir)):
+        if not fname.endswith(".py") or fname in PICKLE_ALLOWED:
+            continue
+        src = open(os.path.join(rpc_dir, fname)).read()
+        tree = ast.parse(src, filename=fname)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                names = [a.name for a in node.names]
+                mod = getattr(node, "module", "") or ""
+                if "pickle" in names or "cloudpickle" in names or \
+                        mod in ("pickle", "cloudpickle"):
+                    errors.append(
+                        f"core/rpc/{fname}:{node.lineno}: imports pickle — "
+                        "control-plane frames must stay msgpack-native "
+                        "(opaque payloads go through userblob.py)")
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in ("dumps", "loads")
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in ("pickle", "cloudpickle")):
+                errors.append(
+                    f"core/rpc/{fname}:{node.lineno}: "
+                    f"{node.value.id}.{node.attr} of a control structure")
+    # the legacy shim must carry no pickling either (AST check: prose in the
+    # docstring may mention the history)
+    wire_path = os.path.join(REPO, "ray_tpu", "core", "wire.py")
+    wire_tree = ast.parse(open(wire_path).read(), filename="wire.py")
+    for node in ast.walk(wire_tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names]
+            mod = getattr(node, "module", "") or ""
+            if "pickle" in names or "cloudpickle" in names or \
+                    mod in ("pickle", "cloudpickle"):
+                errors.append(f"core/wire.py:{node.lineno}: imports pickle — "
+                              "the shim must stay transport-free")
+    return errors
+
+
+def run_all() -> None:
+    errors = check_registry()
+    errors += check_handlers_have_schemas()
+    errors += check_no_pickle_in_rpc()
+    if errors:
+        _fail(errors)
+    from ray_tpu.core.rpc import schema
+
+    print(f"wire schemas OK: {len(schema.REGISTRY)} ops, "
+          f"version {schema.WIRE_VERSION_MIN}..{schema.WIRE_VERSION}, "
+          f"baseline {len(SCHEMA_BASELINE)} frozen")
+
+
+if __name__ == "__main__":
+    run_all()

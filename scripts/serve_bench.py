@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Serve north-star benchmark: req/s + TTFT over the OpenAI ingress.
+
+Parity: the reference's serve release workloads
+(release/serve_tests/workloads/) which gate serve regressions on sustained
+req/s and latency percentiles. Runs the full production path — HTTP proxy ->
+router -> deployment replica -> LLM engine (CPU byte-tokenizer fallback
+model, so the artifact is hermetic and hardware-independent) — and emits
+``SERVE_BENCH.json`` at the repo root:
+
+    {"req_per_s": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ..., ...}
+
+Usage: python scripts/serve_bench.py [--requests N] [--concurrency C]
+       [--stream-samples K] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+PORT = int(os.environ.get("RAY_TPU_SERVE_BENCH_PORT", "18470"))
+
+
+def _post(url: str, body: dict, timeout: float = 120.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _ttft_ms(url: str, body: dict, timeout: float = 120.0) -> float:
+    """Time-to-first-token over the SSE streaming path, in milliseconds."""
+    body = dict(body, stream=True)
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                return (time.perf_counter() - t0) * 1000.0
+    raise RuntimeError("stream produced no data frames")
+
+
+def _throughput(url: str, body: dict, n: int, concurrency: int) -> dict:
+    """Sustained closed-loop req/s with per-request latency percentiles."""
+    latencies: list[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    it = iter(range(n))
+
+    def worker():
+        while True:
+            with lock:
+                try:
+                    next(it)
+                except StopIteration:
+                    return
+            t0 = time.perf_counter()
+            try:
+                _post(url, body)
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            dt = (time.perf_counter() - t0) * 1000.0
+            with lock:
+                latencies.append(dt)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    done = len(latencies)
+    lat = sorted(latencies) or [0.0]
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))], 2)
+
+    return {
+        "requests": n, "completed": done, "errors": errors[0],
+        "concurrency": concurrency, "wall_s": round(wall, 3),
+        "req_per_s": round(done / wall, 2) if wall > 0 else 0.0,
+        "latency_p50_ms": pct(0.50), "latency_p99_ms": pct(0.99),
+    }
+
+
+def run(requests: int, concurrency: int, stream_samples: int,
+        max_tokens: int = 8) -> dict:
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    app = serve.build_openai_app()  # default config: CPU-model fallback
+    serve.run(app, route_prefix="/v1")
+    proxy = serve.start_http_proxy(port=PORT)
+    base = f"http://127.0.0.1:{PORT}/v1"
+    chat_body = {
+        "messages": [{"role": "user", "content": "benchmark prompt"}],
+        "max_tokens": max_tokens,
+    }
+
+    # warm: model build + route table + first compile
+    _post(f"{base}/chat/completions", chat_body)
+
+    # TTFT over the streaming path (sequential: measures the ingress->first-
+    # delta critical path, not queueing)
+    ttfts = [_ttft_ms(f"{base}/chat/completions", chat_body)
+             for _ in range(stream_samples)]
+    ttfts.sort()
+
+    def pct(vals, p):
+        return round(vals[min(len(vals) - 1, int(p * len(vals)))], 2)
+
+    # sustained closed-loop throughput on the non-streaming path
+    tput = _throughput(f"{base}/chat/completions", chat_body,
+                       requests, concurrency)
+
+    result = {
+        "bench": "serve_openai_ingress",
+        "model": "cpu-byte-fallback",
+        "max_tokens": max_tokens,
+        "ttft_samples": stream_samples,
+        "ttft_p50_ms": pct(ttfts, 0.50),
+        "ttft_p99_ms": pct(ttfts, 0.99),
+        "ttft_mean_ms": round(statistics.fmean(ttfts), 2),
+        **tput,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        proxy.stop()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+    return result
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=300)
+    parser.add_argument("--concurrency", type=int, default=8)
+    parser.add_argument("--stream-samples", type=int, default=50)
+    parser.add_argument("--max-tokens", type=int, default=8)
+    parser.add_argument("--quick", action="store_true",
+                        help="smoke sizes (CI)")
+    parser.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
+    args = parser.parse_args()
+    if args.quick:
+        args.requests, args.stream_samples = 30, 8
+    result = run(args.requests, args.concurrency, args.stream_samples,
+                 args.max_tokens)
+    print(json.dumps(result, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

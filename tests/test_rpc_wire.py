@@ -1,0 +1,366 @@
+"""Wire-level tests for the schema'd control-plane RPC (core/rpc/).
+
+Covers the ISSUE-2 acceptance surface:
+- mixed-version handshake: an old peer and a new peer negotiate a common
+  schema version or fail with a clear WireVersionError (never a decode
+  crash);
+- decoder robustness: malformed/truncated/oversized frames kill only the
+  offending connection, with the server intact;
+- reactor backpressure: N concurrent inbound calls complete on a bounded
+  thread count (no thread-per-request).
+"""
+
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import TimeoutError as FutTimeout
+
+import pytest
+
+from ray_tpu.core import rpc
+from ray_tpu.core.rpc import codec, schema
+from ray_tpu.core.rpc.retry import RetryPolicy
+
+_LEN = struct.Struct(">I")
+
+
+def _mkserver(handlers, **kw):
+    srv = rpc.RpcServer(handlers=handlers, **kw)
+    return srv
+
+
+# ------------------------------------------------------------- negotiation
+def test_same_version_negotiates_current():
+    srv = _mkserver({"ping": lambda p, m: "pong"})
+    try:
+        c = rpc.connect(*srv.address, name="t")
+        assert c.negotiated_version == schema.WIRE_VERSION
+        assert c.call("ping", timeout=10) == "pong"
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_old_agent_new_head_negotiates_down():
+    """v1-only agent <-> v2 head: they agree on v1; v1 ops work both ways;
+    a v2-only op fails locally with a clear version error."""
+    srv = _mkserver({"ping": lambda p, m: "pong",
+                     "kv_get": lambda p, m: b"v"})  # kv_get is since=2
+    try:
+        old = rpc.connect(*srv.address, name="old-agent", versions=(1, 1))
+        assert old.negotiated_version == 1
+        assert old.call("ping", timeout=10) == "pong"
+        with pytest.raises(rpc.WireVersionError, match="requires wire version 2"):
+            old.call("kv_get", key=b"k", timeout=10)
+        old.close()
+    finally:
+        srv.close()
+
+
+def test_incompatible_versions_reject_cleanly():
+    srv = _mkserver({"ping": lambda p, m: "pong"})
+    try:
+        with pytest.raises(rpc.WireVersionError, match="no common version"):
+            rpc.connect(*srv.address, name="future", versions=(7, 9))
+    finally:
+        srv.close()
+
+
+def test_mixed_version_against_live_control_plane():
+    """The real head control plane accepts a downgraded (v1) client for v1
+    ops and cleanly rejects a from-the-future client — the old wire's
+    behavior here was a pickle crash."""
+    import ray_tpu
+    from ray_tpu.core.runtime import get_runtime
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        rt = get_runtime()
+        host, port = rt.control_plane.server.address
+        token = rt.control_plane.token
+
+        old = rpc.connect(host, port, name="old-worker", versions=(1, 1))
+        assert old.negotiated_version == 1
+        assert old.call("hello", token=token, kind="worker", timeout=10)["ok"]
+        oid_bin = old.call("client_put_alloc", timeout=10)
+        assert isinstance(oid_bin, bytes)
+        old.close()
+
+        with pytest.raises(rpc.WireVersionError):
+            rpc.connect(host, port, name="future-worker", versions=(9, 9))
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ decoder fuzz
+def _raw_conn(addr):
+    sock = socket.create_connection(addr)
+    sock.settimeout(5)
+    return sock
+
+
+def _server_alive(srv):
+    c = rpc.connect(*srv.address, name="probe")
+    try:
+        return c.call("ping", timeout=10) == "pong"
+    finally:
+        c.close()
+
+
+def test_malformed_frames_do_not_kill_server():
+    srv = _mkserver({"ping": lambda p, m: "pong"})
+    try:
+        import msgpack
+
+        evil_bodies = [
+            b"\x00" * 8,                          # not msgpack an array
+            b"\xff\xfe\xfd",                      # invalid msgpack
+            msgpack.packb("just a string"),       # wrong top-level type
+            msgpack.packb([]),                    # empty array
+            msgpack.packb([99, 1, 2]),            # unknown frame kind
+            msgpack.packb([codec.REQUEST, 1]),    # truncated REQUEST
+            msgpack.packb([codec.HELLO, "wrong-magic", 1, 2, {}]),
+            # REQUEST with non-map payload (arrives before hello)
+            msgpack.packb([codec.REQUEST, 1, 36, "not-a-map"]),
+        ]
+        for body in evil_bodies:
+            s = _raw_conn(srv.address)
+            s.sendall(_LEN.pack(len(body)) + body)
+            time.sleep(0.05)
+            s.close()
+        # oversized length header: connection must die without allocation
+        s = _raw_conn(srv.address)
+        s.sendall(_LEN.pack(codec.MAX_FRAME + 1))
+        time.sleep(0.05)
+        s.close()
+        # truncated header mid-frame
+        s = _raw_conn(srv.address)
+        s.sendall(b"\x00\x00")
+        s.close()
+        assert _server_alive(srv)
+    finally:
+        srv.close()
+
+
+def test_truncated_request_payload_fuzz():
+    """Take a VALID request frame, truncate/corrupt it at every prefix
+    length: the server must survive every variant."""
+    srv = _mkserver({"ping": lambda p, m: "pong"})
+    try:
+        spec = schema.get_op("ping")
+        good = codec.request_frame(1, spec.num, {})
+        hello = codec.hello_frame(schema.WIRE_VERSION_MIN, schema.WIRE_VERSION)
+        for cut in range(1, len(good)):
+            s = _raw_conn(srv.address)
+            s.sendall(hello)            # pass negotiation, then corrupt
+            s.sendall(good[:cut])
+            s.close()
+        # bit-flipped bodies
+        for i in range(codec.HEADER_SIZE, len(good)):
+            mutated = bytearray(good)
+            mutated[i] ^= 0xFF
+            s = _raw_conn(srv.address)
+            s.sendall(hello)
+            s.sendall(bytes(mutated))
+            time.sleep(0.01)
+            s.close()
+        assert _server_alive(srv)
+    finally:
+        srv.close()
+
+
+def test_unknown_op_is_error_reply_not_disconnect():
+    srv = _mkserver({"ping": lambda p, m: "pong"})
+    try:
+        c = rpc.connect(*srv.address, name="t")
+        # an op number the server has no handler for -> error reply, and the
+        # connection keeps serving
+        with pytest.raises(rpc.SchemaError, match="no handler"):
+            c.call("kv_get", key=b"k", timeout=10)
+        assert c.call("ping", timeout=10) == "pong"
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_frame_too_large_rejected_at_sender():
+    srv = _mkserver({"client_put": lambda p, m: True})
+    try:
+        c = rpc.connect(*srv.address, name="t")
+        with pytest.raises(ValueError, match="frame too large"):
+            c.call("client_put", blob=b"x" * (codec.MAX_FRAME + 1))
+        # the failed send didn't leak a pending future or kill the link
+        assert not c._pending
+        c.close()
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------- reactor
+def test_reactor_backpressure_bounded_threads():
+    """64 concurrent inbound calls complete while the server spends at most
+    its fixed reactor pool — the thread-per-request model this replaces
+    spawned 64."""
+    n_threads_cap = 4
+    gate = threading.Event()
+
+    def slow_ping(peer, msg):
+        gate.wait(5)
+        return "pong"
+
+    srv = _mkserver({"ping": slow_ping}, reactor_threads=n_threads_cap)
+    try:
+        c = rpc.connect(*srv.address, name="t")
+        calls = [c.call_async("ping") for _ in range(64)]
+        time.sleep(0.3)  # let the reactor saturate
+        handler_threads = [t for t in threading.enumerate()
+                           if t.name.startswith("rpc-srv")]
+        assert 0 < len(handler_threads) <= n_threads_cap, handler_threads
+        gate.set()
+        for mid, fut in calls:
+            assert fut.result(timeout=30) == "pong"
+            c.finish_call(mid)
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_deferred_reply_frees_reactor_slot():
+    """A handler returning a Future must not hold its reactor slot: more
+    in-flight deferred calls than reactor threads all complete."""
+    from concurrent.futures import Future
+
+    futs = []
+
+    def deferred(peer, msg):
+        f = Future()
+        futs.append(f)
+        return f
+
+    srv = _mkserver({"ping": deferred}, reactor_threads=2)
+    try:
+        c = rpc.connect(*srv.address, name="t")
+        calls = [c.call_async("ping") for _ in range(16)]
+        deadline = time.monotonic() + 5
+        while len(futs) < 16 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(futs) == 16  # every handler ran despite 2 threads
+        for i, f in enumerate(futs):
+            f.set_result(i)
+        got = sorted(fut.result(10) for _, fut in calls)
+        assert got == list(range(16))
+        for mid, _ in calls:
+            c.finish_call(mid)
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_request_ttl_expired_before_dispatch():
+    """v2 requests carry the caller deadline; the reactor sheds queued work
+    whose caller already gave up instead of burning a slot on it."""
+    release = threading.Event()
+
+    def blocker(peer, msg):
+        release.wait(10)
+        return "pong"
+
+    srv = _mkserver({"ping": blocker}, reactor_threads=1)
+    try:
+        c = rpc.connect(*srv.address, name="t")
+        first = c.call_async("ping")  # occupies the single reactor slot
+        time.sleep(0.1)
+        with pytest.raises((TimeoutError, FutTimeout)):
+            c.call("ping", timeout=0.3)  # queued behind, ttl 300ms
+        release.set()
+        assert first[1].result(10) == "pong"
+        c.finish_call(first[0])
+        c.close()
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------ retry policy
+def test_retry_policy_backoff_and_version_error():
+    calls = []
+
+    def flaky():
+        calls.append(time.monotonic())
+        if len(calls) < 3:
+            raise ConnectionError("down")
+        return "up"
+
+    policy = RetryPolicy(initial_backoff_s=0.01, max_backoff_s=0.05,
+                         jitter=0.0, deadline_s=5.0)
+    assert policy.run(flaky, retryable=(ConnectionError,)) == "up"
+    assert len(calls) == 3
+    # backoff grew between attempts
+    assert (calls[2] - calls[1]) >= (calls[1] - calls[0]) * 0.9
+
+    # version mismatch is never retried, even when "retryable" matches
+    def mismatched():
+        calls.append(None)
+        raise rpc.WireVersionError("incompatible")
+
+    calls.clear()
+    with pytest.raises(rpc.WireVersionError):
+        policy.run(mismatched, retryable=(ConnectionError,))
+    assert len(calls) == 1
+
+
+def test_retry_policy_deadline_exhaustion():
+    policy = RetryPolicy(initial_backoff_s=0.02, max_backoff_s=0.02,
+                         jitter=0.0, deadline_s=0.15)
+
+    def always_down():
+        raise ConnectionError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        policy.run(always_down, retryable=(ConnectionError,))
+    assert time.monotonic() - t0 < 2.0  # bounded, not forever
+
+
+# ------------------------------------------------------------ schema rules
+def test_wire_schema_lint():
+    """The CI lint (scripts/check_wire_schemas.py) as a test: registry
+    append-only + every handler schema'd + no pickle in core/rpc/."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec_ = importlib.util.spec_from_file_location(
+        "check_wire_schemas",
+        os.path.join(repo, "scripts", "check_wire_schemas.py"))
+    mod = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(mod)
+    mod.run_all()  # raises SystemExit(1) on violation
+
+
+def test_schema_registry_invariants():
+    nums = [s.num for s in schema.REGISTRY.values()]
+    assert len(nums) == len(set(nums)), "op numbers must be unique"
+    names = set(schema.REGISTRY)
+    assert {"hello", "register_node", "heartbeat", "execute_task",
+            "client_get", "obj_chunk", "xl_call"} <= names
+    for spec in schema.REGISTRY.values():
+        assert 1 <= spec.since <= schema.WIRE_VERSION
+
+
+def test_outbound_schema_validation():
+    srv = _mkserver({"ping": lambda p, m: "pong"})
+    try:
+        c = rpc.connect(*srv.address, name="t")
+        with pytest.raises(rpc.SchemaError, match="not in schema"):
+            c.call("ping", bogus_field=1)
+        with pytest.raises(rpc.SchemaError, match="expects bytes"):
+            c.call_async("ref_add", oid="not-bytes")
+        with pytest.raises(rpc.SchemaError, match="required"):
+            c.call_async("ref_add")
+        with pytest.raises(rpc.SchemaError):
+            c.call("client_put", blob=object())  # not msgpack-native
+        assert c.call("ping", timeout=10) == "pong"
+        c.close()
+    finally:
+        srv.close()

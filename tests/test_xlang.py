@@ -1,24 +1,21 @@
-"""Cross-language plane tests: JSON protocol + the C++ client end-to-end.
+"""Cross-language plane tests: the native msgpack wire + C++ client e2e.
 
 Reference analogs: cross_language.py descriptor calls, the C++ worker API
-(cpp/include/ray/api.h), java/runtime msgpack envelopes — here one JSON wire
-(experimental/xlang.py) and a header-only C++ client (cpp/ray_tpu_client.hpp)
-compiled with g++ in-test.
+(cpp/include/ray/api.h), java/runtime msgpack envelopes. The xlang ops are
+schema'd ops (core/rpc/schema.py 41-49) on the MAIN control plane — a
+non-Python client authenticates with the session token and speaks the same
+framed protocol as Python workers (no JSON side-channel). The header-only
+C++ client (cpp/ray_tpu_client.hpp) is compiled with g++ in-test.
 """
 
-import json
 import shutil
-import socket
-import struct
 import subprocess
-import sys
 
 import pytest
 
 import ray_tpu
+from ray_tpu.core import rpc
 from ray_tpu.experimental import xlang
-
-_LEN = struct.Struct(">I")
 
 
 @pytest.fixture
@@ -50,64 +47,64 @@ def xserver(ray_start_regular):
 
 
 class _PyClient:
-    """Minimal python-side protocol client (validates the wire itself)."""
+    """Minimal native-plane client (validates the wire itself: negotiation,
+    token hello, xl_* schema'd ops)."""
 
     def __init__(self, addr, token):
         host, _, port = addr.rpartition(":")
-        self.sock = socket.create_connection((host, int(port)))
-        self._id = 0
-        assert self.req(op="hello", token=token)["ok"]
+        self.peer = rpc.connect(host, int(port), name="xlang-test")
+        assert self.peer.negotiated_version == rpc.WIRE_VERSION
+        assert self.peer.call("hello", token=token, kind="xlang",
+                              timeout=10)["ok"]
 
-    def req(self, **msg):
-        self._id += 1
-        msg["id"] = self._id
-        blob = json.dumps(msg).encode()
-        self.sock.sendall(_LEN.pack(len(blob)) + blob)
-        (n,) = _LEN.unpack(self._recv(4))
-        reply = json.loads(self._recv(n))
-        if "error" in reply:
-            raise RuntimeError(reply["error"])
-        return reply["result"]
+    def req(self, op, **payload):
+        return self.peer.call(op, timeout=30, **payload)
 
-    def _recv(self, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = self.sock.recv(n - len(buf))
-            assert chunk
-            buf += chunk
-        return buf
+    def close(self):
+        self.peer.close()
 
 
-def test_json_protocol_tasks_actors_objects(xserver):
+def test_native_protocol_tasks_actors_objects(xserver):
     c = _PyClient(xserver.address, xserver.token)
-    assert c.req(op="call", func="add", args=[2, 5]) == 7
-    ref = c.req(op="submit", func="square", args=[6])["ref"]
-    assert c.req(op="get", ref=ref) == 36
-    put = c.req(op="put", value={"k": [1, 2, 3]})["ref"]
-    assert c.req(op="get", ref=put) == {"k": [1, 2, 3]}
-    # binary envelope roundtrip
-    import base64
-
-    blob = base64.b64encode(b"\x00\x01raw").decode()
-    out = c.req(op="call", func="echo_bytes", args=[{"__bytes__": blob}])
-    assert out == {"__bytes__": blob}
-    a = c.req(op="actor_create", cls="Counter")["actor"]
-    c.req(op="actor_call", actor=a, method="inc")
-    assert c.req(op="actor_call", actor=a, method="value") == 1
-    listing = c.req(op="list_funcs")
+    assert c.req("xl_call", func="add", args=[2, 5]) == 7
+    ref = c.req("xl_submit", func="square", args=[6])["ref"]
+    assert c.req("xl_get", ref=ref) == 36
+    put = c.req("xl_put", value={"k": [1, 2, 3]})["ref"]
+    assert c.req("xl_get", ref=put) == {"k": [1, 2, 3]}
+    assert c.req("xl_free", ref=put) is True
+    # binary roundtrip: msgpack bin, no base64 envelope
+    out = c.req("xl_call", func="echo_bytes", args=[b"\x00\x01raw"])
+    assert out == b"\x00\x01raw"
+    a = c.req("xl_actor_create", cls="Counter")["actor"]
+    c.req("xl_actor_call", actor=a, method="inc")
+    assert c.req("xl_actor_call", actor=a, method="value") == 1
+    listing = c.req("xl_list_funcs")
     assert "add" in listing["funcs"] and "Counter" in listing["actors"]
-    with pytest.raises(RuntimeError, match="kapow"):
-        c.req(op="call", func="boom")
+    # the remote failure crosses the wire as the real TaskError (opaque
+    # exception blob), carrying the worker-side traceback
+    from ray_tpu.exceptions import TaskError
+
+    with pytest.raises(TaskError, match="kapow"):
+        c.req("xl_call", func="boom")
+    c.close()
+
+
+def test_unknown_func_clear_error(xserver):
+    c = _PyClient(xserver.address, xserver.token)
+    with pytest.raises(KeyError, match="unknown xlang function"):
+        c.req("xl_call", func="nope")
+    c.close()
 
 
 def test_bad_token_rejected(xserver):
     host, _, port = xserver.address.rpartition(":")
-    sock = socket.create_connection((host, int(port)))
-    blob = json.dumps({"id": 1, "op": "hello", "token": "wrong"}).encode()
-    sock.sendall(_LEN.pack(len(blob)) + blob)
-    (n,) = _LEN.unpack(sock.recv(4))
-    reply = json.loads(sock.recv(n))
-    assert "error" in reply and "token" in reply["error"]
+    peer = rpc.connect(host, int(port), name="intruder")
+    with pytest.raises(PermissionError):
+        peer.call("hello", token="wrong", timeout=10)
+    # unauthenticated xl ops are rejected too
+    with pytest.raises(PermissionError):
+        peer.call("xl_list_funcs", timeout=10)
+    peer.close()
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
