@@ -17,6 +17,7 @@ operator-provided ``token_provider``.
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import threading
@@ -149,11 +150,33 @@ class TpuVmApi:
         return op
 
 
+def slice_host_count(accelerator_type: str) -> int:
+    """UPPER BOUND on worker VMs in a TPU slice: the accelerator-type
+    suffix counts cores or chips (generation-dependent), and GCE never
+    packs fewer than 4 of either on a host VM — v4-32 is 4 hosts of 8,
+    v6e-16 is 4 hosts of 4. Every host runs the same startup script, so a
+    join token needs one redemption per host; dividing by the smallest
+    host size deliberately over-counts dense generations, because a spare
+    redemption on a TTL'd token is far cheaper than a stranded slice whose
+    later workers can never join."""
+    try:
+        n = int(accelerator_type.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 1
+    return max(1, n // 4)
+
+
 def join_startup_script(head_address: str, token: str,
                         num_cpus: int = 4) -> str:
     """The bootstrap a freshly-created TPU VM runs to join the cluster —
     the repo's `ray start --address` analog, shipped as VM startup metadata
-    (reference: gcp/config.py injecting the ray bootstrap into user-data)."""
+    (reference: gcp/config.py injecting the ray bootstrap into user-data).
+
+    ``token`` should be a short-lived single-use join token
+    (ControlPlane.mint_join_token), NOT the session token: VM metadata is
+    readable by any process on the VM for its whole life, so the durable
+    credential must never land there. The agent exchanges the join token
+    for the session token at first hello."""
     return (
         "#!/bin/bash\n"
         f"python3 -m ray_tpu.scripts.cli start --address {head_address} "
@@ -174,21 +197,58 @@ class GceTpuNodeProvider(NodeProvider):
     CLUSTER_LABEL = "ray-tpu-cluster"
 
     def __init__(self, project: str, zone: str, cluster_name: str,
-                 head_address: str, cluster_token: str,
+                 head_address: str, cluster_token: Optional[str] = None,
                  runtime_version: str = "tpu-ubuntu2204-base",
                  api: Optional[TpuVmApi] = None,
                  transport: Callable = _default_transport,
                  token_provider: Callable[[], str] = metadata_token_provider,
-                 spot: bool = False):
+                 spot: bool = False,
+                 join_token_provider: Optional[Callable[[], str]] = None):
+        """``join_token_provider`` (typically the head's
+        ``control_plane.mint_join_token``) mints a fresh short-lived,
+        single-use credential per launched node, keeping the long-lived
+        session token out of VM startup metadata. ``cluster_token`` is the
+        legacy fallback when no provider is wired (token lands in metadata
+        verbatim — avoid outside dev clusters)."""
+        if cluster_token is None and join_token_provider is None:
+            raise ValueError(
+                "need a join_token_provider (preferred) or cluster_token")
         self.api = api or TpuVmApi(project, zone, transport=transport,
                                    token_provider=token_provider)
         self.cluster_name = cluster_name
         self.head_address = head_address
         self.cluster_token = cluster_token
+        self.join_token_provider = join_token_provider
         self.runtime_version = runtime_version
         self.spot = spot
         self._instances: dict[str, Instance] = {}
         self._lock = threading.Lock()
+
+    def _node_token(self, node_type: "str | None" = None) -> str:
+        """A fresh per-node join token when a provider is wired (redeemable
+        once per worker VM of the slice — all hosts run the same startup
+        script); the long-lived cluster token only as legacy fallback."""
+        if self.join_token_provider is not None:
+            uses = slice_host_count(node_type) if node_type else 1
+            try:
+                params = inspect.signature(
+                    self.join_token_provider).parameters
+                accepts_uses = "max_uses" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
+            except (TypeError, ValueError):  # builtins/C callables
+                accepts_uses = False
+            if accepts_uses:
+                return self.join_token_provider(max_uses=uses)
+            if uses > 1:
+                # one single-use token in a script every host runs: worker 0
+                # joins, workers 1..N are locked out of a billing slice
+                logger.warning(
+                    "join_token_provider does not accept max_uses; the %d "
+                    "extra hosts of a %s slice will fail to join — mint "
+                    "with ControlPlane.mint_join_token", uses - 1, node_type)
+            return self.join_token_provider()
+        return self.cluster_token
 
     def launch(self, node_type: str, count: int) -> list[Instance]:
         out = []
@@ -197,8 +257,8 @@ class GceTpuNodeProvider(NodeProvider):
             op = self.api.create_node(
                 name, accelerator_type=node_type,
                 runtime_version=self.runtime_version,
-                startup_script=join_startup_script(self.head_address,
-                                                   self.cluster_token),
+                startup_script=join_startup_script(
+                    self.head_address, self._node_token(node_type)),
                 labels={self.CLUSTER_LABEL: self.cluster_name,
                         "ray-tpu-node-type": node_type.replace(".", "-")},
                 spot=self.spot,
@@ -298,8 +358,25 @@ class GceTpuNodeProvider(NodeProvider):
     def ssh_join_command(self, instance_id: str) -> list[str]:
         """Manual-bootstrap fallback (startup scripts need image support):
         the gcloud ssh line an operator runs to join a slice by hand."""
+        with self._lock:
+            inst = self._instances.get(instance_id)
+        node_type = inst.node_type if inst else None
+        if node_type is None and self.join_token_provider is not None:
+            # cache miss (fresh process, pre-reconcile): the command still
+            # runs on --worker=all, so the token MUST cover every host —
+            # ask the API rather than defaulting to a single-use token
+            # that would strand all hosts but one of a multi-host slice
+            try:
+                node_type = self.api.get_node(instance_id).get(
+                    "acceleratorType")
+            except Exception:
+                logger.warning(
+                    "could not resolve accelerator type of %s; join token "
+                    "minted single-use — multi-host slices need "
+                    "mint_join_token(max_uses=<hosts>)", instance_id)
         join = (f"python3 -m ray_tpu.scripts.cli start "
-                f"--address {self.head_address} --token {self.cluster_token}")
+                f"--address {self.head_address} "
+                f"--token {self._node_token(node_type)}")
         return ["gcloud", "compute", "tpus", "tpu-vm", "ssh", instance_id,
                 f"--zone={self.api.zone}", f"--project={self.api.project}",
                 "--worker=all", f"--command={join}"]

@@ -248,10 +248,16 @@ class ClientRuntime:
         return self._store
 
     # ------------------------------------------------------------ objects
-    def _pull_remote(self, oid: ObjectID) -> "bytes | None":
+    def _pull_remote(self, oid: ObjectID):
         """Local-store miss: ask the head directory for holders, chunk-pull
         from one, and seed the local store with a secondary (unpinned) copy
-        (reference: PullManager pull into local plasma, pull_manager.h:52)."""
+        (reference: PullManager pull into local plasma, pull_manager.h:52).
+
+        Zero-copy path first: chunks land straight in this node's mapped
+        store slot (pull_into + create_for_write — no whole-object transient
+        buffer, no put_bytes copy) and the returned view aliases the store
+        segment. The bytes-returning pull() remains the fallback when there
+        is no local store or it can't fit the object."""
         try:
             pairs = self._call_retrying("locate_object", oid=oid.binary(), timeout=30)
         except Exception:
@@ -269,11 +275,18 @@ class ClientRuntime:
             except Exception:
                 pass
 
-        blob = self._plane_client.pull(pairs, oid, on_stale=report_stale)
+        store = self._shm()
+        blob, how = self._plane_client.pull_into_or_pull(
+            pairs, oid, store, on_stale=report_stale)
         if blob is None:
             return None
-        store = self._shm()
-        if store is not None:
+        if how == "sealed":
+            try:
+                self._rpc().notify("object_added", oid=oid.binary(),
+                                   size=len(blob))
+            except Exception:
+                pass
+        elif how == "pulled" and store is not None:
             try:
                 store.put_bytes(oid, blob)
                 self._rpc().notify("object_added", oid=oid.binary(), size=len(blob))

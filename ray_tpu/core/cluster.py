@@ -67,6 +67,14 @@ class ControlPlane:
         self.token = persisted or secrets.token_hex(16)
         if store is not None and persisted is None:
             store.set_session_meta("token", self.token)
+        # Short-lived node-join credentials (token -> [expiry, uses_left]).
+        # Minted per provisioned node so cloud bootstrap metadata never
+        # carries the long-lived session token; redeemed a bounded number
+        # of times (once per worker VM of the slice — every host of a
+        # multi-host TPU slice runs the same startup script) and exchanged
+        # for the session token at first hello.
+        self._join_tokens: dict[str, list] = {}
+        self._jt_lock = threading.Lock()
         cfg = runtime.config
         self._hb: dict[NodeID, float] = {}
         self._hb_lock = threading.Lock()
@@ -254,9 +262,52 @@ class ControlPlane:
 
         return wrapper
 
+    def mint_join_token(self, ttl_s: float = 3600.0,
+                        max_uses: int = 1) -> str:
+        """Mint a short-lived, bounded-use node-join token (autoscaler
+        bootstrap). VM startup metadata is readable by anything on the VM
+        for its whole life, so provisioning ships one of these instead of
+        the session token; the joining agent redeems it at first hello and
+        receives the session token in the reply.
+
+        ``max_uses``: redemptions allowed — one per worker VM of the slice
+        (a multi-host TPU slice ships ONE startup script to every host, so
+        a strictly single-use token would let worker 0 join and strand
+        workers 1..N on a billing slice forever).
+
+        The default TTL is an hour, not minutes: it is minted at launch()
+        time and a queued/spot TPU slice can take well over 10 minutes to
+        create + boot — an expired token would strand a billing VM that can
+        never join. The use bound is the real guard; the TTL only bounds
+        how long a leaked never-redeemed token stays live."""
+        jt = "jt-" + secrets.token_hex(16)
+        with self._jt_lock:
+            now = time.monotonic()
+            self._join_tokens = {
+                t: ent for t, ent in self._join_tokens.items()
+                if ent[0] > now}
+            self._join_tokens[jt] = [now + ttl_s, max(1, int(max_uses))]
+        return jt
+
+    def _redeem_join_token(self, tok) -> bool:
+        if not isinstance(tok, str) or not tok.startswith("jt-"):
+            return False
+        with self._jt_lock:
+            ent = self._join_tokens.get(tok)
+            if ent is None or ent[0] <= time.monotonic():
+                self._join_tokens.pop(tok, None)
+                return False
+            ent[1] -= 1
+            if ent[1] <= 0:
+                del self._join_tokens[tok]
+            return True
+
     def _h_hello(self, peer: RpcPeer, msg: dict):
+        redeemed = False
         if msg.get("token") != self.token:
-            raise PermissionError("bad control-plane token")
+            redeemed = self._redeem_join_token(msg.get("token"))
+            if not redeemed:
+                raise PermissionError("bad control-plane token")
         peer.meta["auth"] = True
         peer.meta["kind"] = msg.get("kind", "client")
         # Workers report which node's object plane they live on ("worker_node",
@@ -270,6 +321,10 @@ class ControlPlane:
         # refs so restored objects don't zero-fire on first touch.
         for b in msg.get("held") or ():
             self._hold_for(peer, [ObjectRef(ObjectID(b), self.runtime)])
+        if redeemed:
+            # join-token exchange: the node uses the session token from now
+            # on (reconnects, worker spawns) — the join token is spent
+            return {"ok": True, "token": self.token}
         return {"ok": True}
 
     def _h_register_node(self, peer: RpcPeer, msg: dict):

@@ -188,8 +188,13 @@ def main() -> None:
         peer = wire.connect(host, int(port), handlers=handlers,
                             name=f"agent-{os.getpid()}")
         try:
-            peer.call("hello", token=args.token, kind="agent", pid=os.getpid(),
-                      timeout=10)
+            h = peer.call("hello", token=args.token, kind="agent",
+                          pid=os.getpid(), timeout=10)
+            if isinstance(h, dict) and h.get("token"):
+                # Bootstrapped with a single-use join token: the head just
+                # exchanged it for the session token — use that for worker
+                # spawns and every reconnect (the join token is spent).
+                args.token = h["token"]
             plane_addr = None
             if plane_server is not None:
                 _, plane_port = plane_server.server.address

@@ -10,6 +10,20 @@ across holders. Pulled copies are secondary (unpinned, evictable) — the
 creating node keeps the pinned primary, so eviction of a pulled copy just
 re-pulls.
 
+Zero-copy bulk path (wire v3): chunks are served as raw BLOB frames sliced
+straight out of the holder's mapped store segment (scatter-gather sendmsg, no
+msgpack encode of payload bytes) and received with recv_into directly into the
+puller's destination — ideally a CREATING slot of its own store
+(``PlaneClient.pull_into`` + ``SharedMemoryStore.create_for_write``), so a
+pulled byte is written exactly once on the receiving node. Against a holder
+that negotiated wire < v3, pulls fall back to the chunked-msgpack ``obj_chunk``
+path (one copy into the destination per chunk).
+
+Admission is a bytes-being-pulled budget (reference: pull_manager.h's
+admission bound), not a pull count: a burst of small gets no longer queues
+behind one huge object, and two 1GB pulls can't double-commit the NIC/store.
+Large objects stripe their chunks across multiple live holders.
+
 Design differences from the reference (deliberate, TPU-first single-controller
 runtime): transfers are pull-only (no proactive push scheduling) and the
 directory lives at the head rather than with each owner worker — one fewer
@@ -18,18 +32,35 @@ failure domain, at the cost of head RTTs that are amortized by chunking.
 
 from __future__ import annotations
 
+import collections
 import threading
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Callable, Optional
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.core import rpc as wire
-from ray_tpu.exceptions import ObjectLostError
+from ray_tpu.exceptions import ObjectLostError, ObjectStoreFullError
 
 import os as _os
 
-CHUNK_BYTES = int(_os.environ.get("RAY_TPU_PLANE_CHUNK_BYTES", str(1 << 20)))
+# 4 MiB: on the raw BLOB path a chunk costs no allocation on either side
+# (views in, recv_into out), so larger chunks just amortize the per-chunk
+# header roundtrip — measured 3x MB/s vs 1 MiB on loopback (MICROBENCH.md
+# round 7; the reference ships 5 MiB object-manager chunks for the same
+# reason, ray_config_def.h object_manager_default_chunk_size).
+CHUNK_BYTES = int(_os.environ.get("RAY_TPU_PLANE_CHUNK_BYTES", str(4 << 20)))
 WINDOW = int(_os.environ.get("RAY_TPU_PLANE_WINDOW", "8"))
+# Bytes-being-pulled admission budget (replaces the count-based
+# RAY_TPU_PLANE_MAX_PULLS gate of wire<=2 builds).
+PULL_BYTES = int(_os.environ.get("RAY_TPU_PLANE_PULL_BYTES", str(256 << 20)))
+# Objects at least this large stripe chunks across multiple live holders.
+STRIPE_MIN_BYTES = int(
+    _os.environ.get("RAY_TPU_PLANE_STRIPE_MIN_BYTES", str(8 << 20)))
+STRIPE_HOLDERS = int(_os.environ.get("RAY_TPU_PLANE_STRIPE_HOLDERS", "4"))
+
+_HOLDER_ERRORS = (wire.PeerDisconnected, wire.WireVersionError,
+                  wire.SchemaError, OSError, ObjectLostError,
+                  TimeoutError, FutureTimeoutError)
 
 
 class ObjectPlaneServer:
@@ -40,7 +71,7 @@ class ObjectPlaneServer:
     peer disconnect, so a crashed puller can't leak pins."""
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
-                 spill=None):
+                 spill=None, wire_versions: "tuple[int, int] | None" = None):
         self.store = store
         self.spill = spill  # optional SpillManager: serve spilled objects too
         self._open: dict[tuple[int, bytes], memoryview | bytes] = {}
@@ -49,10 +80,12 @@ class ObjectPlaneServer:
             handlers={
                 "obj_meta": self._h_meta,
                 "obj_chunk": self._h_chunk,
+                "obj_chunk_raw": self._h_chunk_raw,
                 "obj_done": self._h_done,
             },
             host=host, port=port,
             on_disconnect=self._peer_gone,
+            versions=wire_versions,
         )
 
     @property
@@ -68,7 +101,7 @@ class ObjectPlaneServer:
                 return view
         view = self.store.get_bytes(ObjectID(oid_bin)) if self.store else None
         if view is None and self.spill is not None:
-            view = self.spill.restore(ObjectID(oid_bin))  # bytes | None
+            view = self.spill.restore(ObjectID(oid_bin))  # buffer | None
         if view is not None:
             with self._lock:
                 self._open[key] = view
@@ -87,6 +120,19 @@ class ObjectPlaneServer:
         off = msg["off"]
         return bytes(view[off:off + msg["len"]])
 
+    def _h_chunk_raw(self, peer, msg):
+        """v3 bulk path: the chunk leaves as a raw BLOB frame sliced straight
+        out of the store mapping — no bytes() copy, no msgpack encode."""
+        view = self._view_for(peer, msg["oid"])
+        if view is None:
+            raise ObjectLostError(
+                f"object {msg['oid'].hex()[:12]} evicted mid-transfer"
+            )
+        if not isinstance(view, memoryview):
+            view = memoryview(view)  # spill-restored bytes: still zero-copy
+        off = msg["off"]
+        return wire.RawReply(view[off:off + msg["len"]])
+
     def _h_done(self, peer, msg):
         with self._lock:
             self._open.pop((id(peer), msg["oid"]), None)
@@ -104,21 +150,68 @@ class ObjectPlaneServer:
             self._open.clear()
 
 
+class _PullBudget:
+    """Bytes-being-pulled admission bound (reference: pull_manager.h:52 —
+    pulls are admitted while their total size fits the budget). Admission is
+    FIFO: a pull too big for the current headroom blocks every later arrival
+    behind it, so a steady stream of small gets can't starve a large one (the
+    reference admits in queue order for the same reason). An object larger
+    than the whole budget is still admitted when nothing else is in flight,
+    so a giant pull can't deadlock — it just runs alone."""
+
+    def __init__(self, budget: int):
+        self._budget = max(1, int(budget))
+        self._inflight = 0
+        self._cv = threading.Condition()
+        self._waiters: collections.deque = collections.deque()
+
+    def acquire(self, nbytes: int) -> None:
+        me = object()
+        with self._cv:
+            self._waiters.append(me)
+            try:
+                while self._waiters[0] is not me or (
+                        self._inflight > 0
+                        and self._inflight + nbytes > self._budget):
+                    self._cv.wait()
+                self._inflight += nbytes
+            finally:
+                # an interrupted wait (KeyboardInterrupt in a blocked get)
+                # must not leave the sentinel queued — every later acquire
+                # would spin behind a waiter that no longer exists
+                self._waiters.remove(me)
+                self._cv.notify_all()  # the next queued pull may fit too
+
+    def release(self, nbytes: int) -> None:
+        with self._cv:
+            self._inflight -= nbytes
+            self._cv.notify_all()
+
+    @property
+    def inflight_bytes(self) -> int:
+        with self._cv:
+            return self._inflight
+
+
+class _AlreadyStored(Exception):
+    """pull_into: the destination store already holds a sealed copy."""
+
+
 class PlaneClient:
     """Pull-side: cached connections + windowed chunk pipeline with holder
     failover (reference: PullManager's retrying pull loop), under a global
-    concurrent-pull bound so a burst of gets can't saturate the NIC/head
-    (reference: pull_manager.h's bytes-being-pulled admission bound —
-    expressed here as max simultaneous object pulls, env-tunable)."""
+    bytes-being-pulled admission budget so a burst of gets can't saturate
+    the NIC/head (reference: pull_manager.h's admission bound), with chunk
+    striping across live holders for large objects."""
 
-    def __init__(self, max_concurrent_pulls: int | None = None):
-        import os as _os
-
+    def __init__(self, max_pull_bytes: int | None = None,
+                 stripe_min_bytes: int | None = None,
+                 stripe_holders: int | None = None):
         self._peers: dict[str, wire.RpcPeer] = {}
         self._lock = threading.Lock()
-        n = max_concurrent_pulls or int(
-            _os.environ.get("RAY_TPU_PLANE_MAX_PULLS", "4"))
-        self._pull_gate = threading.BoundedSemaphore(max(1, n))
+        self._budget = _PullBudget(max_pull_bytes or PULL_BYTES)
+        self._stripe_min = stripe_min_bytes or STRIPE_MIN_BYTES
+        self._stripe_holders = max(1, stripe_holders or STRIPE_HOLDERS)
 
     def _peer(self, addr: str) -> wire.RpcPeer:
         with self._lock:
@@ -135,68 +228,301 @@ class PlaneClient:
             self._peers[addr] = p
         return p
 
+    def _drop_peer(self, addr: str, peer) -> None:
+        try:
+            peer.close()
+        except Exception:
+            pass
+        with self._lock:
+            if self._peers.get(addr) is peer:
+                del self._peers[addr]
+
+    # ------------------------------------------------------------- pull APIs
     def pull(self, addrs: list, oid: ObjectID,
              chunk_bytes: int = CHUNK_BYTES, window: int = WINDOW,
              timeout: float = 60.0,
-             on_stale: Optional[Callable] = None) -> Optional[bytes]:
-        """Fetch the object from the first holder that has it; None if no
-        holder does (caller falls back to lineage reconstruction).
+             on_stale: Optional[Callable] = None) -> "Optional[bytearray]":
+        """Fetch the object from holders into process memory; None if no
+        holder has it (caller falls back to lineage reconstruction). The
+        fallback of pull_into for pullers without a local store (or with a
+        full one) — it pays the one whole-object buffer pull_into avoids.
 
         ``addrs`` entries are either plain "host:port" strings or
         (token, "host:port") pairs; a holder that answers "don't have it"
         triggers ``on_stale(token)`` so the caller can invalidate its
         directory entry (reference: object directory location invalidation
         after a failed pull)."""
-        oid_bin = oid.binary()
-        with self._pull_gate:
-            return self._pull_gated(addrs, oid_bin, chunk_bytes, window,
-                                    timeout, on_stale)
+        box: dict = {}
 
-    def _pull_gated(self, addrs, oid_bin, chunk_bytes, window, timeout,
-                    on_stale) -> Optional[bytes]:
-        for entry in addrs:
-            # directory entries fetched over the wire arrive as msgpack
-            # lists; locally-built ones are tuples
-            token, addr = (entry if isinstance(entry, (tuple, list))
-                           else (None, entry))
-            try:
-                peer = self._peer(addr)
-                meta = peer.call("obj_meta", oid=oid_bin, timeout=timeout)
-                if meta is None:
-                    if on_stale is not None and token is not None:
-                        on_stale(token)
-                    continue
-                size = meta["size"]
-                buf = bytearray(size)
-                offs = list(range(0, size, chunk_bytes))
-                inflight: list[tuple[int, int, object]] = []  # (off, mid, fut)
-                try:
-                    i = 0
-                    while i < len(offs) or inflight:
-                        while i < len(offs) and len(inflight) < window:
-                            off = offs[i]
-                            mid, fut = peer.call_async(
-                                "obj_chunk", oid=oid_bin, off=off,
-                                len=min(chunk_bytes, size - off),
-                            )
-                            inflight.append((off, mid, fut))
-                            i += 1
-                        off, mid, fut = inflight.pop(0)
-                        data = fut.result(timeout=timeout)
-                        peer.finish_call(mid)
-                        buf[off:off + len(data)] = data
-                finally:
-                    for _, mid, _ in inflight:
-                        peer.finish_call(mid)
+        def get_dest(size: int) -> memoryview:
+            box["buf"] = bytearray(size)
+            return memoryview(box["buf"])
+
+        if not self._pull_common(addrs, oid.binary(), get_dest, chunk_bytes,
+                                 window, timeout, on_stale):
+            return None
+        # returned as-is (bytes() here would be a second whole-object copy);
+        # callers treat pulled payloads as read-only
+        return box["buf"]
+
+    def pull_into(self, addrs: list, oid: ObjectID, store,
+                  chunk_bytes: int = CHUNK_BYTES, window: int = WINDOW,
+                  timeout: float = 60.0,
+                  on_stale: Optional[Callable] = None) -> Optional[str]:
+        """Zero-copy pull: land chunks straight in ``store``'s mapped slot
+        for ``oid`` (create_for_write -> recv_into -> seal), so the received
+        bytes are written exactly once, with no whole-object transient
+        buffer. Returns "sealed" (pulled + sealed), "exists" (store already
+        had it), or None (no holder / store can't fit it — caller falls back
+        to the bytes-returning pull())."""
+        state: dict = {}
+
+        def get_dest(size: int) -> memoryview:
+            view = store.create_for_write(oid, size)
+            if view is None:
+                raise _AlreadyStored
+            state["created"] = True
+            return view
+
+        try:
+            ok = self._pull_common(addrs, oid.binary(), get_dest, chunk_bytes,
+                                   window, timeout, on_stale, hazard=state)
+        except _AlreadyStored:
+            return "exists"
+        except ObjectStoreFullError:
+            return None
+        except BaseException:
+            if state.get("created"):
+                self._abort_or_leak(store, oid, state)
+            raise
+        if ok:
+            store.seal(oid)
+            return "sealed"
+        if state.get("created"):
+            self._abort_or_leak(store, oid, state)
+        return None
+
+    @staticmethod
+    def _abort_or_leak(store, oid: ObjectID, state: dict) -> None:
+        """Retire a failed pull's CREATING slot — unless a dropped holder's
+        reader thread outlived its join, in which case it may still hold a
+        sink view into the slot: then the slot is deliberately LEAKED
+        (later puts of this oid stay blocked for the process's life), since
+        freeing memory a live writer can still recv_into trades a stuck oid
+        for silent shm corruption."""
+        if not state.get("reader_straggler"):
+            store.abort(oid)
+
+    def pull_into_or_pull(self, addrs: list, oid: ObjectID, store,
+                          timeout: float = 60.0,
+                          on_stale: Optional[Callable] = None,
+                          ) -> "tuple[object, str | None]":
+        """The full pull policy runtimes consume: zero-copy pull-into-store
+        first, bytes-returning pull() as the fallback when there is no local
+        store, it can't fit the object, or the sealed copy was evicted
+        before it could be read. Returns ``(payload, how)`` — payload is a
+        store view or pulled buffer (None: no holder has the object), how is
+        "sealed" (fresh copy landed in ``store``), "exists" (store already
+        had it), or "pulled" (bytes path; not in the store). Non-holder
+        failures (protocol bugs, dest write errors, seal failures) propagate
+        — the pull aborts loudly rather than silently re-transferring the
+        whole object over the bytes path."""
+        if store is not None:
+            status = self.pull_into(addrs, oid, store, timeout=timeout,
+                                    on_stale=on_stale)
+            if status is not None:
+                view = store.get_bytes(oid)
+                if view is not None:
+                    return view, status
+                # sealed copy already evicted under pressure: fall through
+        blob = self.pull(addrs, oid, timeout=timeout, on_stale=on_stale)
+        return blob, ("pulled" if blob is not None else None)
+
+    # --------------------------------------------------------------- engine
+    def _pull_common(self, addrs, oid_bin, get_dest, chunk_bytes, window,
+                     timeout, on_stale, hazard: "dict | None" = None) -> bool:
+        """Shared pull engine: discover live holders, admit by bytes, stripe
+        chunks across them, fail over to untried holders until the object is
+        complete or no holder remains."""
+        # directory entries fetched over the wire arrive as msgpack lists;
+        # locally-built ones are tuples
+        entries = [tuple(e) if isinstance(e, (tuple, list)) else (None, e)
+                   for e in addrs]
+        dest: Optional[memoryview] = None
+        size = 0
+        acquired = 0
+        # stale: holder answered "don't have it" / wrong size — permanent.
+        # fails: transient holder errors per addr; an addr is retried once
+        # with a FRESH connection before being given up on, because its
+        # PeerDisconnected may be collateral from ANOTHER pull dropping the
+        # shared cached peer (the holder itself is healthy).
+        stale: set = set()
+        fails: collections.Counter = collections.Counter()
+        pending: collections.deque = collections.deque()
+        total = 0
+        # metered: every peer whose obj_meta opened a server-side read pin —
+        # ALL of them get obj_done on exit, whatever path exits (an early
+        # _AlreadyStored/store-full bail or a stale-size holder must not
+        # leave the holder's copy pinned for the connection's life).
+        # dropped: peers failed mid-transfer, whose reader threads may still
+        # be landing raw payloads into dest slices.
+        state: dict = {"done": 0, "error": None, "dropped": []}
+        metered: dict = {}
+        try:
+            while True:
+                holders = []
+                for token, addr in entries:
+                    if addr in stale or fails[addr] >= 2 or \
+                            any(a == addr for _, a in holders):
+                        continue
+                    try:
+                        peer = self._peer(addr)
+                        meta = peer.call("obj_meta", oid=oid_bin,
+                                         timeout=timeout)
+                    except _HOLDER_ERRORS:
+                        fails[addr] += 1
+                        continue
+                    if meta is None:
+                        stale.add(addr)
+                        if on_stale is not None and token is not None:
+                            on_stale(token)
+                        continue
+                    metered[addr] = peer
+                    if dest is None:
+                        size = meta["size"]
+                        # admit BEFORE committing memory: the budget bounds
+                        # resident pull bytes, so the slot/buffer must not
+                        # exist while we wait (reference: pull_manager.h
+                        # admits before activating a pull)
+                        self._budget.acquire(size)
+                        acquired = size
+                        dest = get_dest(size)  # may raise _AlreadyStored
+                        pending.extend(range(0, size, chunk_bytes))
+                        total = len(pending)
+                    elif meta["size"] != size:
+                        stale.add(addr)  # immutable objects: a size mismatch
+                        continue  # means a stale/corrupt directory entry
+                    holders.append((peer, addr))
+                    if size < self._stripe_min or \
+                            len(holders) >= self._stripe_holders:
+                        break
+                if not holders or dest is None:
+                    return False
+                self._transfer(dest, size, oid_bin, holders, pending, state,
+                               chunk_bytes, window, timeout, fails)
+                if state["error"] is not None:
+                    # non-holder failure (protocol bug, dest write error):
+                    # abort the pull loudly instead of spinning on a round
+                    # that can never progress
+                    raise state["error"]
+                if state["done"] >= total:
+                    return True
+                # every holder of this round died/evicted mid-transfer; the
+                # loop re-gathers (surviving peers + untried addrs) and only
+                # the chunks still pending are re-pulled
+        finally:
+            for addr, peer in metered.items():  # release server-side pins
+                if not peer.closed:
                     try:
                         peer.notify("obj_done", oid=oid_bin)
-                    except wire.PeerDisconnected:
+                    except _HOLDER_ERRORS:
                         pass
-                return bytes(buf)
-            except (wire.PeerDisconnected, OSError, ObjectLostError,
-                    TimeoutError, FutureTimeoutError):
-                continue  # holder died or evicted mid-pull: try the next one
-        return None
+            # a dropped peer's reader may still be recv_into-ing a raw
+            # payload into a dest slice; join it so the caller can abort()
+            # the CREATING slot (freeing the arena region for reuse) with
+            # no straggler able to scribble on reallocated memory. A reader
+            # that outlives the join is reported via ``hazard`` so the
+            # caller leaks the slot instead of recycling referenced memory.
+            for peer in state["dropped"]:
+                if not peer.join_reader(timeout=5.0) and hazard is not None:
+                    hazard["reader_straggler"] = True
+            if acquired:
+                self._budget.release(acquired)
+
+    def _transfer(self, dest, size, oid_bin, holders, pending, state,
+                  chunk_bytes, window, timeout, fails) -> None:
+        """One striping round: ``pending`` is a shared chunk-offset pool;
+        each holder runs a windowed pipeline over it (one thread per extra
+        holder), so fast holders naturally take more chunks (reference:
+        PullManager spreading chunk requests over object locations). Chunks
+        of a failed holder go back to the pool for the survivors."""
+        lock = threading.Lock()
+
+        def run_holder(peer, addr):
+            raw = (peer.negotiated_version or 0) >= 3
+            inflight: collections.deque = collections.deque()
+            grabbed: collections.deque = collections.deque()
+            try:
+                while True:
+                    with lock:
+                        while len(inflight) + len(grabbed) < window and pending:
+                            grabbed.append(pending.popleft())
+                    while grabbed:
+                        off = grabbed[0]
+                        ln = min(chunk_bytes, size - off)
+                        if raw:
+                            # zero-copy: the reader lands the BLOB payload
+                            # directly in dest[off:off+ln]
+                            mid, fut = peer.call_async(
+                                "obj_chunk_raw", _sink=dest[off:off + ln],
+                                oid=oid_bin, off=off, len=ln)
+                        else:
+                            mid, fut = peer.call_async(
+                                "obj_chunk", oid=oid_bin, off=off, len=ln)
+                        inflight.append((off, ln, mid, fut))
+                        grabbed.popleft()
+                    if not inflight:
+                        return
+                    # keep the head entry in ``inflight`` until its result is
+                    # fully consumed, so a holder error requeues it too
+                    off, ln, mid, fut = inflight[0]
+                    data = fut.result(timeout=timeout)
+                    if isinstance(data, int):  # raw path: byte count
+                        if data != ln:
+                            raise ObjectLostError(
+                                f"short raw chunk at {off}: {data} != {ln}")
+                    else:  # msgpack fallback: one copy into the slot
+                        if len(data) != ln:  # truncated holder copy: fail
+                            raise ObjectLostError(  # over, don't abort pull
+                                f"short chunk at {off}: {len(data)} != {ln}")
+                        dest[off:off + ln] = data
+                    inflight.popleft()
+                    peer.finish_call(mid)
+                    with lock:
+                        state["done"] += 1
+            except BaseException as e:
+                # Requeue every chunk this holder still owed (grabbed-but-
+                # unsent AND in-flight) for the survivors. Close the peer —
+                # its reader may still be landing raw payloads into sinks
+                # (_pull_common joins it before any slot abort). A
+                # non-holder error (protocol bug, dest write failure) is
+                # recorded so the pull aborts instead of spinning on a
+                # silently dead thread.
+                with lock:
+                    pending.extend(grabbed)
+                    for o, _, _, _ in inflight:
+                        pending.append(o)
+                    fails[addr] += 1
+                    state["dropped"].append(peer)
+                    if not isinstance(e, _HOLDER_ERRORS):
+                        state["error"] = e
+                self._drop_peer(addr, peer)
+
+        if len(holders) == 1:
+            run_holder(*holders[0])
+        else:
+            threads = [
+                threading.Thread(target=run_holder, args=h, daemon=True,
+                                 name=f"plane-pull-{i}")
+                for i, h in enumerate(holders)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        # server-side read pins release in _pull_common's finally (obj_done
+        # to every metered peer), covering early-bail paths this round-local
+        # loop never saw
 
     def close(self) -> None:
         with self._lock:

@@ -11,6 +11,7 @@ from ray_tpu.core.rpc.codec import MAX_FRAME, ProtocolError
 from ray_tpu.core.rpc.peer import (
     NEGOTIATION_TIMEOUT_S,
     PeerDisconnected,
+    RawReply,
     RpcPeer,
     RpcServer,
     connect,
@@ -33,6 +34,7 @@ __all__ = [
     "NEGOTIATION_TIMEOUT_S",
     "ProtocolError",
     "PeerDisconnected",
+    "RawReply",
     "RpcPeer",
     "RpcServer",
     "connect",

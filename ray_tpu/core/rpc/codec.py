@@ -13,10 +13,22 @@ Body is a msgpack array whose first element is the frame kind:
     ERROR   [4, reply_to, message_str, exc_blob|nil] exc_blob: opaque pickled
                                                      exception (user payload)
     GOODBYE [5, message_str]                         protocol-fatal, then close
+    BLOB    [6, reply_to, payload_len]               v3 raw reply header; the
+                                                     payload_len payload bytes
+                                                     follow RAW on the stream
 
 Every value is msgpack-native (nil/bool/int/float/str/bin/array/map); the
 envelope itself carries NO pickled control structures. ``ttl_ms`` (v2) lets
 the receiving reactor drop requests whose caller deadline already passed.
+
+BLOB (v3) is the bulk-data exception to "body == msgpack": only its HEADER
+is msgpack — the payload bytes are written with scatter-gather (sendmsg)
+straight out of the sender's buffer and received with recv_into straight
+into the caller's destination buffer, so object-plane chunks cross the wire
+without a msgpack encode, an intermediate join, or a slice copy (reference:
+ObjectManager's chunked scatter-gather sends, object_manager.cc:536). A peer
+that negotiated < v3 never receives one: the only ops answered with BLOB are
+``since=3``-gated.
 """
 
 from __future__ import annotations
@@ -38,6 +50,7 @@ NOTIFY = 2
 REPLY = 3
 ERROR = 4
 GOODBYE = 5
+BLOB = 6
 
 
 class ProtocolError(ConnectionError):
@@ -83,7 +96,7 @@ def unpack_body(blob: bytes) -> list:
     if not isinstance(body, list) or not body:
         raise ProtocolError("frame body is not a non-empty array")
     kind = body[0]
-    if not isinstance(kind, int) or not (HELLO <= kind <= GOODBYE):
+    if not isinstance(kind, int) or not (HELLO <= kind <= BLOB):
         raise ProtocolError(f"unknown frame kind {kind!r}")
     _ARITY_CHECKS[kind](body)
     return body
@@ -94,6 +107,13 @@ def _need(body: list, n: int, kind: str) -> None:
         raise ProtocolError(f"truncated {kind} frame: {len(body)} elements")
 
 
+def _check_blob(body: list) -> None:
+    _need(body, 3, "BLOB")
+    n = body[2]
+    if not isinstance(n, int) or n < 0 or n > MAX_FRAME:
+        raise ProtocolError(f"BLOB payload length {n!r} out of range")
+
+
 _ARITY_CHECKS = {
     HELLO: lambda b: _need(b, 5, "HELLO"),
     REQUEST: lambda b: _need(b, 4, "REQUEST"),
@@ -101,6 +121,7 @@ _ARITY_CHECKS = {
     REPLY: lambda b: _need(b, 3, "REPLY"),
     ERROR: lambda b: _need(b, 4, "ERROR"),
     GOODBYE: lambda b: _need(b, 2, "GOODBYE"),
+    BLOB: _check_blob,
 }
 
 
@@ -133,3 +154,13 @@ def error_frame(reply_to: int, message: str,
 
 def goodbye_frame(message: str) -> bytes:
     return pack([GOODBYE, message])
+
+
+def blob_header(reply_to: int, payload_len: int) -> bytes:
+    """Framed HEADER of a BLOB reply. The payload is deliberately NOT an
+    argument: it never passes through this module's packer — the peer writes
+    it raw with sendmsg right after this header (the zero-copy contract the
+    wire lint pins, scripts/check_wire_schemas.py)."""
+    if payload_len > MAX_FRAME:
+        raise ValueError(f"blob too large: {payload_len} bytes")
+    return pack([BLOB, reply_to, payload_len])

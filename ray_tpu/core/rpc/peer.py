@@ -51,6 +51,22 @@ class PeerDisconnected(ConnectionError):
     """The remote end of an RpcPeer went away (fails all in-flight calls)."""
 
 
+class RawReply:
+    """Handler return wrapper: answer this request with a raw BLOB frame.
+
+    The wrapped buffer is sent scatter-gather (header + payload in one
+    sendmsg) without slicing, joining, or msgpack-encoding it — the
+    object plane returns ``RawReply(shm_view[off:off+n])`` so chunk bytes
+    go NIC-ward straight out of the mapped store segment. Only handlers of
+    ``since>=3`` ops may return one (older peers can't decode BLOB frames).
+    """
+
+    __slots__ = ("view",)
+
+    def __init__(self, buf):
+        self.view = buf if isinstance(buf, memoryview) else memoryview(buf)
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -59,6 +75,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise PeerDisconnected("socket closed")
         buf.extend(chunk)
     return bytes(buf)
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Land exactly len(view) bytes straight into the caller's buffer —
+    the zero-copy receive half of the BLOB frame (memoryview slicing keeps
+    every partial recv writing into the same underlying memory)."""
+    got, n = 0, len(view)
+    while got < n:
+        r = sock.recv_into(view[got:] if got else view)
+        if r == 0:
+            raise PeerDisconnected("socket closed mid-blob")
+        got += r
 
 
 class RpcPeer:
@@ -86,6 +114,9 @@ class RpcPeer:
         self.name = name
         self._wlock = threading.Lock()
         self._pending: dict[int, Future] = {}
+        # mid -> caller-supplied destination buffer for raw BLOB replies
+        # (pull-into-shm: the reader lands payload bytes there directly)
+        self._sinks: dict[int, memoryview] = {}
         self._plock = threading.Lock()
         self._ids = itertools.count(1)
         self._closed = False
@@ -151,14 +182,21 @@ class RpcPeer:
         finally:
             with self._plock:
                 self._pending.pop(mid, None)
+                self._sinks.pop(mid, None)
 
     def call_async(self, op: str, _ttl: float | None = None,
+                   _sink: "memoryview | None" = None,
                    **payload) -> tuple[int, Future]:
         """Fire a request and return (id, Future) without blocking — lets a
         caller keep a window of requests in flight (the object plane
         pipelines chunk fetches this way, like the reference's windowed
         chunked pulls, object_manager.cc:536). Caller must pop the pending
-        entry via finish_call() when done."""
+        entry via finish_call() when done.
+
+        ``_sink``: writable buffer for a raw BLOB reply — the reader
+        recv_into()s the payload there and the future resolves with the
+        byte count instead of a bytes object (zero-copy pull-into path).
+        A msgpack REPLY to a sink'd call still resolves normally."""
         spec = get_op(op)
         self._check_version(spec)
         payload = validate_payload(spec, payload, outbound=True)
@@ -168,6 +206,8 @@ class RpcPeer:
             if self._closed:
                 raise PeerDisconnected(f"{self.name} is closed")
             self._pending[mid] = fut
+            if _sink is not None:
+                self._sinks[mid] = _sink
         ttl_ms = None
         if (_ttl is not None and self.negotiated_version is not None
                 and self.negotiated_version >= 2):
@@ -179,12 +219,14 @@ class RpcPeer:
             # pending future would otherwise leak for the connection's life
             with self._plock:
                 self._pending.pop(mid, None)
+                self._sinks.pop(mid, None)
             raise
         return mid, fut
 
     def finish_call(self, mid: int) -> None:
         with self._plock:
             self._pending.pop(mid, None)
+            self._sinks.pop(mid, None)
 
     def notify(self, op: str, **payload) -> None:
         """One-way message (no reply expected)."""
@@ -209,6 +251,29 @@ class RpcPeer:
             self._fail(PeerDisconnected(f"send to {self.name} failed: {e}"))
             raise PeerDisconnected(str(e)) from e
 
+    def _send_blob(self, reply_to: int, view: memoryview) -> None:
+        """Answer a request with a raw BLOB frame: msgpack header + payload
+        in one scatter-gather syscall, the payload straight from the
+        caller's buffer (typically a view into the shm store segment) —
+        no slice copy, no join, no msgpack encode of the bytes."""
+        if view.ndim != 1 or view.itemsize != 1:
+            view = view.cast("B")
+        header = codec.blob_header(reply_to, len(view))
+        hlen, total = len(header), len(header) + len(view)
+        try:
+            with self._wlock:
+                sent = self._sock.sendmsg([header, view])
+                while sent < total:  # short write: resend the remainder,
+                    #                  still by reference (sliced views)
+                    if sent < hlen:
+                        bufs = [memoryview(header)[sent:], view]
+                    else:
+                        bufs = [view[sent - hlen:]]
+                    sent += self._sock.sendmsg(bufs)
+        except OSError as e:
+            self._fail(PeerDisconnected(f"send to {self.name} failed: {e}"))
+            raise PeerDisconnected(str(e)) from e
+
     # --- inbound ---
     def _read_loop(self) -> None:
         try:
@@ -225,6 +290,8 @@ class RpcPeer:
                     self._handle_hello(body)
                 elif kind == codec.REPLY:
                     self._complete(body[1], body[2], None, None)
+                elif kind == codec.BLOB:
+                    self._read_blob(body[1], body[2])
                 elif kind == codec.ERROR:
                     self._complete(body[1], None, body[2], body[3])
                 elif kind == codec.NOTIFY:
@@ -246,9 +313,27 @@ class RpcPeer:
         except (PeerDisconnected, OSError, EOFError) as e:
             self._fail(PeerDisconnected(f"{self.name} disconnected: {e}"))
 
+    def _read_blob(self, mid: int, n: int) -> None:
+        """BLOB reply: land the n raw payload bytes that follow the header.
+        With a registered sink the bytes go straight into the caller's
+        buffer (recv_into, zero-copy) and the future resolves with the
+        count; without one (caller gave no sink, or already timed out and
+        finished the call) the payload must still be drained to keep the
+        stream framed — into a throwaway buffer, resolving with bytes."""
+        with self._plock:
+            sink = self._sinks.pop(mid, None)
+        if sink is not None and len(sink) == n:
+            _recv_exact_into(self._sock, sink)
+            self._complete(mid, n, None, None)
+        else:
+            buf = bytearray(n)
+            _recv_exact_into(self._sock, memoryview(buf))
+            self._complete(mid, bytes(buf), None, None)
+
     def _complete(self, mid, result, err_msg, err_blob) -> None:
         with self._plock:
             fut = self._pending.pop(mid, None)
+            self._sinks.pop(mid, None)
         if fut is not None and not fut.done():
             if err_msg is not None:
                 fut.set_exception(loads_exception(err_msg, err_blob))
@@ -289,6 +374,15 @@ class RpcPeer:
                 raise SchemaError(
                     f"unknown rpc op number {op_num} (peer is newer; "
                     f"this end speaks schema v{self._vmax})")
+            if spec.since > (self.negotiated_version or 1):
+                # inbound gate, not just outbound: a non-conforming peer
+                # that calls a since-gated op on an old-wire connection must
+                # get a clean per-request error — answering (op 51 replies
+                # with a BLOB frame) would feed its conforming decoder a
+                # frame kind it can't parse and tear down the connection
+                raise SchemaError(
+                    f"rpc op {spec.name!r} needs wire v{spec.since}; "
+                    f"connection negotiated v{self.negotiated_version}")
             handler = self._handlers.get(spec.name)
             if handler is None:
                 raise SchemaError(
@@ -300,6 +394,9 @@ class RpcPeer:
             msg = validate_payload(spec, payload, outbound=False)
             result = handler(self, msg)
             if mid is not None:
+                if isinstance(result, RawReply):
+                    self._send_blob(mid, result.view)
+                    return
                 if isinstance(result, Future):
                     # Deferred reply: the handler pipelined the work (e.g. a
                     # node agent queuing onto its worker pool) — send the
@@ -329,6 +426,9 @@ class RpcPeer:
             self._send_error_reply(mid, e)
             return
         try:
+            if isinstance(result, RawReply):
+                self._send_blob(mid, result.view)
+                return
             self._send_raw(codec.reply_frame(mid, result))
         except PeerDisconnected:
             pass
@@ -352,6 +452,7 @@ class RpcPeer:
                 return
             self._closed = True
             pending, self._pending = self._pending, {}
+            self._sinks.clear()
         if not self._negotiated.is_set():
             self._negotiation_error = exc
             self._negotiated.set()
@@ -389,6 +490,21 @@ class RpcPeer:
 
     def close(self) -> None:
         self._fail(PeerDisconnected(f"{self.name} closed locally"))
+
+    def join_reader(self, timeout: float | None = None) -> bool:
+        """Wait for the inbound reader thread to exit (close() first, or
+        this blocks until the remote hangs up). A raw BLOB ``_sink``
+        aliases caller-owned memory; after a close mid-transfer the reader
+        can still be recv_into-ing buffered payload, so a caller about to
+        recycle that memory joins the reader to guarantee no straggling
+        write lands after this returns. Returns False if the reader is
+        STILL alive after ``timeout`` — the caller must then treat the
+        sink memory as referenced and not recycle it."""
+        t = getattr(self, "_reader", None)
+        if t is None or t is threading.current_thread():
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
 
 class RpcServer:

@@ -19,6 +19,10 @@ Rules (enforced by ``scripts/check_wire_schemas.py``):
 Version history:
 - v1: initial msgpack wire — session/control/object-plane ops.
 - v2: cross-language ops (``xl_*``), ``kv_get``, request TTL field.
+- v3: raw BLOB frame kind + ``obj_chunk_raw`` — bulk object-plane chunks
+  travel as raw bytes after a msgpack header (codec.py BLOB) instead of
+  msgpack ``bin`` values; pullers on a <v3 connection fall back to the
+  chunked-msgpack ``obj_chunk`` path.
 """
 
 from __future__ import annotations
@@ -28,7 +32,7 @@ from typing import Optional
 
 # The schema version this build speaks, and the oldest it can fall back to.
 # Peers negotiate min(max_a, max_b) at hello; see negotiate().
-WIRE_VERSION = 2
+WIRE_VERSION = 3
 WIRE_VERSION_MIN = 1
 
 # Protocol magic sent in the hello frame: rejects foreign/legacy peers with
@@ -309,3 +313,12 @@ register_op(49, "xl_list_funcs", [], since=2)
 # -- internal KV read for workers (v2)
 register_op(50, "kv_get", [
     _f("key", T.BYTES, required=True), _f("namespace", T.BYTES)], since=2)
+
+# -- zero-copy bulk data plane (v3): same request shape as obj_chunk, but the
+#    reply is a raw BLOB frame (scatter-gather sent, recv_into received) —
+#    the payload bytes never pass through msgpack. Version-gated so a v2 peer
+#    is never sent a frame kind it cannot decode.
+register_op(51, "obj_chunk_raw", [
+    _f("oid", T.BYTES, required=True), _f("off", T.INT, required=True),
+    _f("len", T.INT, required=True)], since=3,
+    doc="reply is a raw BLOB frame, not a msgpack REPLY")

@@ -631,7 +631,12 @@ class Runtime:
         if not task_bin:
             return
         with self._lock:
-            self._task_put_holds.pop(task_bin, None)  # ref GC drops the holds
+            holds = self._task_put_holds.pop(task_bin, None)
+        # the refs must die OUTSIDE the lock: a zero-fire here runs
+        # _on_ref_zero -> _free_plane_copies, which takes self._lock again
+        # (non-reentrant) — holding it through the __del__ deadlocks the
+        # store-result thread and, behind it, every runtime entry point
+        del holds  # ref GC drops the holds
 
     # ---------------------------------------------------- object plane
     def plane_object_added(self, oid: ObjectID, node_id: NodeID,
@@ -725,25 +730,27 @@ class Runtime:
             pairs.append((None, self.plane_server.address))
         return pairs
 
-    def _pull_from_plane(self, oid: ObjectID) -> "bytes | None":
+    def _pull_from_plane(self, oid: ObjectID):
         """Chunk-pull a node-held object into the head's store (secondary,
-        unpinned copy — evictable; the holder keeps the pinned primary)."""
+        unpinned copy — evictable; the holder keeps the pinned primary).
+
+        Zero-copy path first: chunks land directly in the store's mapped
+        slot (pull_into + create_for_write, no whole-object transient
+        buffer) and the returned view aliases the store segment. The
+        bytes-returning pull() remains the fallback when the store is
+        absent or can't fit the object (the pulled buffer then serves this
+        get only)."""
         if self.plane_client is None:
             return None
         pairs = self.plane_holder_addrs(oid, include_head=False)
         if not pairs:
             return None
-        blob = self.plane_client.pull(
-            pairs, oid,
-            on_stale=lambda nb: self.plane_object_removed(oid, NodeID(nb)),
-        )
-        if blob is None:
-            return None
-        if self.shm_store is not None:
-            try:
-                self.shm_store.put_bytes(oid, blob)
-            except Exception:
-                pass  # store full: serve this get from the pulled bytes
+
+        def on_stale(nb):
+            self.plane_object_removed(oid, NodeID(nb))
+
+        blob, _how = self.plane_client.pull_into_or_pull(
+            pairs, oid, self.shm_store, on_stale=on_stale)
         return blob
 
     def _free_plane_copies(self, oid: ObjectID) -> None:
@@ -1160,6 +1167,7 @@ class Runtime:
         submitted; reference: GcsTaskManager's bounded storage). Live
         entries (PENDING/RUNNING) are never dropped."""
         cap = self.config.task_table_max_size
+        dropped = []
         with self._lock:
             if len(self._tasks) <= cap:
                 return
@@ -1173,7 +1181,10 @@ class Runtime:
             excess = len(self._tasks) - cap
             terminal.sort(key=lambda kv: kv[1].end_time or 0.0)
             for tid, _ in terminal[:excess]:
-                self._tasks.pop(tid, None)
+                dropped.append(self._tasks.pop(tid, None))
+        # entries can hold the last ref to task args; their __del__ re-enters
+        # self._lock via _on_ref_zero -> _free_plane_copies, so GC them here
+        del dropped
 
     def _maybe_inject_chaos(self, spec: TaskSpec) -> None:
         """Config-driven fault injection (reference: src/ray/rpc/rpc_chaos.cc,

@@ -170,6 +170,31 @@ class SharedMemoryStore:
             raise
         self._lib.shm_store_seal(self._handle, oid.binary())
 
+    def create_for_write(self, oid: ObjectID, size: int) -> Optional[memoryview]:
+        """Incremental-write API over the native create/seal lifecycle: a
+        writable view of a CREATING slot the caller fills (e.g. recv_into
+        straight off a socket — the pull-into-shm path) and then seal()s.
+        Returns None if the object is already sealed (idempotent create).
+
+        Contract: exactly one of seal(oid) or abort(oid) MUST follow — an
+        abandoned CREATING entry blocks every later put of this oid until
+        the writer pid dies (the native store's live-writer guard)."""
+        off = self._create_slot(oid, size)
+        if off is None:
+            return None
+        buf = (ctypes.c_char * size).from_address(self._base + off)
+        return memoryview(buf).cast("B")
+
+    def seal(self, oid: ObjectID) -> None:
+        """Publish a create_for_write slot: the object becomes immutable and
+        readable (native seal wakes blocked getters)."""
+        self._lib.shm_store_seal(self._handle, oid.binary())
+
+    def abort(self, oid: ObjectID) -> None:
+        """Retire a create_for_write slot whose fill failed, freeing its
+        arena space (plasma's Abort analog). No-op for sealed objects."""
+        self._lib.shm_store_abort(self._handle, oid.binary())
+
     def _create_slot(self, oid: ObjectID, size: int) -> Optional[int]:
         """Allocate a CREATING entry; returns payload offset, or None if the
         object is already sealed.

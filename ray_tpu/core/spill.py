@@ -123,12 +123,15 @@ class SpillManager:
         logger.debug("spilled %s (%d bytes) to %s", oid.hex()[:12], size, path)
 
     # ------------------------------------------------------------ restore
-    def restore(self, oid: ObjectID) -> Optional[bytes]:
-        """Bring a spilled object back; returns its serialized bytes, or None
-        if this object was never spilled. Re-seats it in shm (re-pinned) when
-        it fits so subsequent reads are zero-copy again.
+    def restore(self, oid: ObjectID):
+        """Bring a spilled object back; returns its serialized payload
+        (memoryview into shm when re-seated, bytes otherwise), or None if
+        this object was never spilled. Re-seats it in shm (re-pinned) when
+        it fits so subsequent reads are zero-copy again — the file bytes
+        land straight in a create_for_write slot (readinto, one write)
+        instead of a read()+put_bytes double copy.
 
-        Disk I/O and the shm memcpy run OUTSIDE the manager lock — a large
+        Disk I/O and the shm fill run OUTSIDE the manager lock — a large
         restore must not stall every concurrent put/get's bookkeeping."""
         with self._lock:
             entry = self._spilled.get(oid)
@@ -141,25 +144,52 @@ class SpillManager:
                 self._restoring.add(oid)
         path, size = entry
         try:
-            try:
-                with open(path, "rb") as f:
-                    blob = f.read()
-            except OSError:
-                with self._lock:
-                    self._spilled.pop(oid, None)
-                return None
+            blob = None
             reseated = False
             if i_reseat:
+                view = None
                 try:
-                    self._store.put_bytes(oid, blob)
-                    self._store.pin(oid)
-                    reseated = True
+                    view = self._store.create_for_write(oid, size)
                 except Exception:
-                    pass  # store still under pressure: serve from the file copy
+                    view = None  # store under pressure: serve the file copy
+                if view is not None:
+                    ok = False
+                    try:
+                        with open(path, "rb") as f:
+                            ok = f.readinto(view) == size
+                    except OSError:
+                        ok = False
+                    finally:
+                        del view  # ctypes view must die before any unmap
+                    if ok:
+                        self._store.seal(oid)
+                        self._store.pin(oid)
+                        blob = self._store.get_bytes(oid)
+                        # only a copy we can actually serve counts as
+                        # re-seated: an eviction racing the seal->pin gap
+                        # must NOT delete the spill record/file below (that
+                        # would lose the object permanently)
+                        reseated = blob is not None
+                    else:
+                        self._store.abort(oid)
+                elif self._store.contains(oid):
+                    # another writer sealed this oid meanwhile (e.g. a plane
+                    # pull landed the same object): adopt that copy
+                    self._store.pin(oid)
+                    blob = self._store.get_bytes(oid)
+                    reseated = blob is not None
+            if blob is None:
+                try:
+                    with open(path, "rb") as f:
+                        blob = f.read()
+                except OSError:
+                    with self._lock:
+                        self._spilled.pop(oid, None)
+                    return None
             with self._lock:
                 self.restored_bytes_total += len(blob)
                 if reseated:
-                    self._resident[oid] = len(blob)
+                    self._resident[oid] = size
                     self._spilled.pop(oid, None)
             if reseated:
                 try:

@@ -1,0 +1,26 @@
+"""jax API compatibility for the parallel package.
+
+`shard_map` moved from `jax.experimental.shard_map` (kwarg `check_rep`) to
+top-level `jax.shard_map` (kwarg `check_vma`) across jax releases; this repo
+must run on both (the pinned CI jax is 0.4.x). One import site — callers use
+the new-style signature (`check_vma=`) and the shim translates for old jax.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _impl = jax.shard_map
+else:  # jax < 0.6: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _impl
+
+_CHECK_KW = ("check_vma" if "check_vma" in inspect.signature(_impl).parameters
+             else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    return _impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                 **{_CHECK_KW: check_vma})

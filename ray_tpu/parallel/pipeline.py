@@ -40,6 +40,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models import llama
+from ray_tpu.parallel._compat import shard_map
 
 
 def layer_specs() -> dict:
@@ -216,7 +217,7 @@ def make_pp_loss_and_grad(
                     grads[k], ("data", "fsdp", "pipe", "tensor"))
         return loss, reduced
 
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh,
         in_specs=(specs, BATCH_SPEC, BATCH_SPEC),
         out_specs=(P(), specs),

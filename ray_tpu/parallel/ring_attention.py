@@ -24,7 +24,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ray_tpu.parallel._compat import shard_map
 
 NEG_INF = -1e30
 

@@ -131,6 +131,48 @@ def bench_get_gigabytes(total_mb: int) -> dict:
     return {"metric": "get_throughput_zero_copy", "value": round(gb / dt, 3), "unit": "GB/s"}
 
 
+def bench_plane_pull(size_mb: int, holders: int = 1) -> dict:
+    """Object-plane pull throughput over loopback: chunk frames from the
+    holder store(s) landing in the puller's store via ``pull_into`` (the
+    zero-copy v3 BLOB path when negotiated). Runs against live plane
+    servers, so it measures the real wire path — not the in-process store."""
+    import os
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+    from ray_tpu.core.shm_store import SharedMemoryStore
+
+    nbytes = size_mb << 20
+    slack = 16 << 20
+    tag = f"{os.getpid()}_{size_mb}_{holders}"
+    srcs = [SharedMemoryStore(f"/rtpu_mb_src{i}_{tag}", size=nbytes + slack,
+                              owner=True) for i in range(holders)]
+    dst = SharedMemoryStore(f"/rtpu_mb_dst_{tag}", size=nbytes + slack,
+                            owner=True)
+    servers = [ObjectPlaneServer(s) for s in srcs]
+    client = PlaneClient(stripe_min_bytes=1 if holders > 1 else None)
+    try:
+        payload = np.random.default_rng(0).bytes(nbytes)
+        oid = ObjectID(os.urandom(ObjectID.SIZE))
+        for s in srcs:
+            s.put_bytes(oid, payload)
+        addrs = [srv.address for srv in servers]
+        t0 = time.perf_counter()
+        status = client.pull_into(addrs, oid, dst)
+        dt = time.perf_counter() - t0
+        assert status == "sealed", f"pull failed: {status}"
+        dst.delete(oid)  # so repeats re-pull instead of hitting "exists"
+        return {"metric": f"plane_pull_{size_mb}mb_{holders}h",
+                "value": round(nbytes / dt / 1e6, 1), "unit": "MB/s"}
+    finally:
+        client.close()
+        for srv in servers:
+            srv.close()
+        for s in srcs:
+            s.close()
+        dst.close()
+
+
 def _median_of(samples: list[dict]) -> dict:
     """Collapse repeated runs of one bench into median + dispersion.
 
@@ -167,6 +209,13 @@ def run(quick: bool = False, repeats: int = 5) -> list[dict]:
         lambda: bench_actor_calls_async(100 * k),
         lambda: bench_put_gigabytes(16 * k),
         lambda: bench_get_gigabytes(16 * k),
+        # object-plane pulls over live loopback plane servers (wire v3)
+        lambda: bench_plane_pull(1, 1),
+        lambda: bench_plane_pull(1, 2),
+        lambda: bench_plane_pull(16, 1),
+        lambda: bench_plane_pull(16, 2),
+        lambda: bench_plane_pull(16 * (4 if not quick else 1), 1),
+        lambda: bench_plane_pull(16 * (4 if not quick else 1), 2),
     ]
     results = []
     for bench in benches:

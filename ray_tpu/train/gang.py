@@ -99,6 +99,10 @@ def _child_main(in_path: str, out_path: str) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        try:  # multi-process CPU collectives need the Gloo backend
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # newer jax: gloo is the default; flag may be gone
     else:
         import jax
     jax.distributed.initialize(

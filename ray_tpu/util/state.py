@@ -11,7 +11,7 @@ import json
 from collections import Counter as _Counter
 from typing import Optional
 
-from ray_tpu.core.runtime import get_runtime
+from ray_tpu.core.runtime import get_runtime, get_runtime_or_none
 
 
 def list_tasks(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
@@ -126,11 +126,22 @@ def _worker_profile_events() -> list[dict]:
 
     from ray_tpu._private import export_events
 
-    if not export_events.enabled() or export_events._DIR is None:
+    # Resolve the export dir from THIS session's runtime, not the module
+    # global: export_events._DIR/_ENABLED are process-wide and re-written by
+    # every init/shutdown in the process (suite runs cycle many sessions), so
+    # the global can lag the session whose timeline is being asked for.
+    profile_dir = None
+    rt = get_runtime_or_none()
+    session_dir = getattr(rt, "session_dir", None)
+    if session_dir is not None:
+        profile_dir = os.path.join(session_dir, "export_events")
+    elif export_events.enabled() and export_events._DIR is not None:
+        profile_dir = export_events._DIR
+    if profile_dir is None:
         return []
     out: list[dict] = []
     try:
-        for p in glob.glob(os.path.join(export_events._DIR,
+        for p in glob.glob(os.path.join(profile_dir,
                                         "export_task_profile*.jsonl")):
             with open(p) as f:
                 for line in f:

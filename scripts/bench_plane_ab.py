@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Interleaved seed-vs-PR object-plane pull A/B (MICROBENCH.md methodology).
+
+Measures MB/s for a pull landing in the local store, over live loopback
+plane servers. Runs against whichever tree is on PYTHONPATH and adapts:
+
+- new tree: ``PlaneClient.pull_into`` (zero-copy v3 BLOB path);
+- seed tree: ``PlaneClient.pull`` -> ``put_bytes`` (the old five-copy path,
+  exactly as runtime._pull_from_plane consumed it).
+
+Interleave by alternating invocations of this script between two checkouts
+on the same box; single-run numbers on a shared core are noise.
+
+    PYTHONPATH=/path/to/tree python scripts/bench_plane_ab.py --size-mb 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def bench(size_mb: int, holders: int, repeats: int) -> None:
+    import numpy as np
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+    from ray_tpu.core.shm_store import SharedMemoryStore
+
+    nbytes = size_mb << 20
+    slack = 16 << 20
+    tag = f"{os.getpid()}_{size_mb}_{holders}"
+    srcs = [SharedMemoryStore(f"/rtpu_ab_src{i}_{tag}", size=nbytes + slack,
+                              owner=True) for i in range(holders)]
+    dst = SharedMemoryStore(f"/rtpu_ab_dst_{tag}",
+                            size=repeats * nbytes + slack, owner=True)
+    servers = [ObjectPlaneServer(s) for s in srcs]
+    zero_copy = hasattr(PlaneClient, "pull_into")
+    client = PlaneClient()
+    if zero_copy and holders > 1:
+        client = PlaneClient(stripe_min_bytes=1)
+    try:
+        payload = np.random.default_rng(0).bytes(nbytes)
+        addrs = [srv.address for srv in servers]
+        rates = []
+        for _ in range(repeats):
+            oid = ObjectID(os.urandom(ObjectID.SIZE))
+            for s in srcs:
+                s.put_bytes(oid, payload)
+            t0 = time.perf_counter()
+            if zero_copy:
+                status = client.pull_into(addrs, oid, dst)
+                assert status == "sealed", status
+            else:
+                blob = client.pull(addrs, oid)
+                assert blob is not None
+                dst.put_bytes(oid, blob)
+            dt = time.perf_counter() - t0
+            assert bytes(dst.get_bytes(oid)) == payload
+            rates.append(round(nbytes / dt / 1e6, 1))
+            for s in srcs:
+                s.delete(oid)
+        print(json.dumps({
+            "tree": "pull_into_v3" if zero_copy else "seed_pull_putbytes",
+            "metric": f"plane_pull_{size_mb}mb_{holders}h",
+            "mb_per_s": rates, "median": sorted(rates)[len(rates) // 2],
+            "unit": "MB/s",
+        }), flush=True)
+    finally:
+        client.close()
+        for srv in servers:
+            srv.close()
+        for s in srcs:
+            s.close()
+        dst.close()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=int, default=64)
+    ap.add_argument("--holders", type=int, default=1)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+    # AFTER PYTHONPATH, never ahead of it: the whole point is that the
+    # operator's PYTHONPATH selects which tree (seed vs PR) is measured
+    sys.path.append(os.getcwd())
+    bench(args.size_mb, args.holders, args.repeats)

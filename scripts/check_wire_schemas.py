@@ -10,7 +10,13 @@ test (tests/test_round5_fixes-style import; see test_rpc_wire.py). Asserts:
    below — renumbering or reusing a shipped number is a wire break;
 3. no ``pickle.dumps``/``pickle.loads`` of control structures remains in
    ``core/rpc/`` (the single sanctioned pickle site is userblob.py, the
-   opaque user-payload codec) nor in ``core/wire.py``.
+   opaque user-payload codec) nor in ``core/wire.py``;
+4. the raw BLOB frame keeps its zero-copy contract: the ``obj_chunk_raw``
+   header schema is registered and version-gated (since>=3, so v2 peers
+   never see a frame kind they can't decode), and no payload bytes pass
+   through the msgpack packer — or a ``bytes()`` copy — on the plane
+   chunk path (codec.blob_header packs lengths only; peer send is
+   sendmsg-by-reference, receive is recv_into).
 
 When you ADD an op: give it the next free number, bump WIRE_VERSION if the
 op must be gated, run this lint, then extend the baseline in the same PR.
@@ -42,6 +48,8 @@ SCHEMA_BASELINE = {
     "obj_done": 40, "xl_call": 41, "xl_submit": 42, "xl_get": 43,
     "xl_put": 44, "xl_free": 45, "xl_actor_create": 46, "xl_actor_call": 47,
     "xl_kill_actor": 48, "xl_list_funcs": 49, "kv_get": 50,
+    # ISSUE-5 (wire v3): bulk data plane
+    "obj_chunk_raw": 51,
 }
 
 # Files whose handler tables must be fully schema'd.
@@ -191,10 +199,99 @@ def check_no_pickle_in_rpc() -> list:
     return errors
 
 
+def _calls_in(fn: ast.FunctionDef, names: set) -> list:
+    """(lineno, name) for every call inside ``fn`` whose callee name/attr is
+    in ``names`` (matches both ``packb(...)`` and ``msgpack.packb(...)``)."""
+    hits = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        name = (callee.attr if isinstance(callee, ast.Attribute)
+                else callee.id if isinstance(callee, ast.Name) else None)
+        if name in names:
+            hits.append((node.lineno, name))
+    return hits
+
+
+def _find_funcs(tree: ast.AST, wanted: set) -> dict:
+    return {node.name: node for node in ast.walk(tree)
+            if isinstance(node, ast.FunctionDef) and node.name in wanted}
+
+
+def check_blob_zero_copy() -> list:
+    """The v3 BLOB contract: raw kind version-gated, header schema frozen,
+    payload bytes never packed, joined, or copied on the chunk path."""
+    from ray_tpu.core.rpc import codec, schema
+
+    errors = []
+    # version gate: obj_chunk_raw (the only BLOB-replied op) must be >= v3
+    spec = schema.REGISTRY.get("obj_chunk_raw")
+    if spec is None:
+        errors.append("obj_chunk_raw (the BLOB header schema) is not "
+                      "registered")
+    elif spec.since < 3:
+        errors.append(f"obj_chunk_raw gated since={spec.since} < 3 — a v2 "
+                      "peer would receive a frame kind it cannot decode")
+    if getattr(codec, "BLOB", None) is None or codec.BLOB <= codec.GOODBYE:
+        errors.append("codec.BLOB must be a NEW frame kind appended after "
+                      "GOODBYE (old decoders reject unknown kinds cleanly)")
+    # the packer sees header fields only: blob_header takes lengths, never
+    # the payload
+    import inspect
+
+    params = list(inspect.signature(codec.blob_header).parameters)
+    if params != ["reply_to", "payload_len"]:
+        errors.append(f"codec.blob_header{tuple(params)} — must take "
+                      "(reply_to, payload_len): payload bytes never enter "
+                      "the msgpack packer")
+    # peer: sendmsg-by-reference out, recv_into in — no packer, no copies
+    peer_path = os.path.join(REPO, "ray_tpu", "core", "rpc", "peer.py")
+    peer_fns = _find_funcs(ast.parse(open(peer_path).read(), "peer.py"),
+                           {"_send_blob", "_read_blob"})
+    packers = {"pack", "packb", "dumps", "reply_frame"}
+    for name in ("_send_blob", "_read_blob"):
+        fn = peer_fns.get(name)
+        if fn is None:
+            errors.append(f"peer.py: {name} missing — BLOB path gone?")
+            continue
+        for lineno, callee in _calls_in(fn, packers):
+            errors.append(f"peer.py:{lineno}: {name} calls {callee}() — "
+                          "BLOB payloads must bypass the msgpack packer")
+    if "_send_blob" in peer_fns and not _calls_in(peer_fns["_send_blob"],
+                                                  {"sendmsg"}):
+        errors.append("peer.py: _send_blob no longer scatter-gathers via "
+                      "sendmsg (header+payload in one syscall, by reference)")
+    if "_read_blob" in peer_fns:
+        if _calls_in(peer_fns["_read_blob"], {"_recv_exact"}):
+            errors.append("peer.py: _read_blob uses copying _recv_exact — "
+                          "payload must land via recv_into")
+        if not _calls_in(peer_fns["_read_blob"], {"_recv_exact_into"}):
+            errors.append("peer.py: _read_blob must receive via "
+                          "_recv_exact_into (recv_into, zero-copy)")
+    # plane: the raw-chunk handler serves a store view, never a bytes() copy
+    plane_path = os.path.join(REPO, "ray_tpu", "core", "object_plane.py")
+    plane_fns = _find_funcs(ast.parse(open(plane_path).read(),
+                                      "object_plane.py"), {"_h_chunk_raw"})
+    fn = plane_fns.get("_h_chunk_raw")
+    if fn is None:
+        errors.append("object_plane.py: _h_chunk_raw handler missing")
+    else:
+        for lineno, callee in _calls_in(fn, packers | {"bytes", "bytearray"}):
+            errors.append(f"object_plane.py:{lineno}: _h_chunk_raw calls "
+                          f"{callee}() — raw chunks must leave as views "
+                          "into the store mapping (RawReply)")
+        if not _calls_in(fn, {"RawReply"}):
+            errors.append("object_plane.py: _h_chunk_raw must answer with "
+                          "a RawReply (raw BLOB frame)")
+    return errors
+
+
 def run_all() -> None:
     errors = check_registry()
     errors += check_handlers_have_schemas()
     errors += check_no_pickle_in_rpc()
+    errors += check_blob_zero_copy()
     if errors:
         _fail(errors)
     from ray_tpu.core.rpc import schema

@@ -225,3 +225,124 @@ def test_api_error_surfaces_cleanly():
     prov.api._transport = broken
     live = prov.non_terminated_instances()
     assert [i.instance_id for i in live] == [inst.instance_id]
+
+
+# --------------------------------------------------- per-node join tokens
+@pytest.mark.fast
+def test_launch_mints_per_node_join_tokens_not_session_token():
+    """ADVICE r5: VM startup metadata is world-readable on the VM for its
+    whole life — launches must carry fresh single-use join tokens, never
+    the long-lived session token."""
+    svc = FakeTpuService()
+    api = TpuVmApi("proj", "us-central2-b", transport=svc.transport,
+                   token_provider=lambda: "fake-token", poll_interval_s=0.01)
+    minted = []
+
+    def mint():
+        jt = f"jt-{len(minted):032x}"
+        minted.append(jt)
+        return jt
+
+    prov = GceTpuNodeProvider(
+        "proj", "us-central2-b", cluster_name="c1",
+        head_address="10.0.0.2:6379", cluster_token="session-secret",
+        api=api, join_token_provider=mint)
+    prov.launch("v5p-8", 2)
+    scripts = [b["metadata"]["startup-script"]
+               for (m, u, b) in svc.requests if m == "POST"]
+    assert len(scripts) == 2 and len(minted) == 2
+    for script, jt in zip(scripts, minted):
+        assert f"--token {jt}" in script
+        assert "session-secret" not in script
+    # the ssh fallback mints too (it also lands on an operator's console)
+    (inst,) = prov.launch("v5p-8", 1)
+    cmd = prov.ssh_join_command(inst.instance_id)
+    assert not any("session-secret" in c for c in cmd)
+    assert any(minted[-1] in c for c in cmd)
+
+
+def test_join_tokens_cover_every_host_of_a_multi_host_slice():
+    """Every worker VM of a slice runs the SAME startup script, so the one
+    token it ships must redeem once per host — a strictly single-use token
+    would join worker 0 and strand workers 1..N on a billing slice."""
+    from ray_tpu.autoscaler.gce import slice_host_count
+    from ray_tpu.core.cluster import ControlPlane
+
+    # upper bounds (divide by the smallest chips-per-host GCE ships): a
+    # spare redemption is cheap, a locked-out host VM bills forever
+    assert slice_host_count("v4-8") == 2  # 1 real host + spare
+    assert slice_host_count("v4-32") == 8  # 4 real hosts
+    assert slice_host_count("v6e-16") == 4  # 4 real hosts of 4 chips: exact
+    assert slice_host_count("weird") == 1  # unknown format: safe floor
+
+    svc = FakeTpuService()
+    api = TpuVmApi("proj", "us-central2-b", transport=svc.transport,
+                   token_provider=lambda: "fake-token", poll_interval_s=0.01)
+    uses_asked = []
+
+    def mint(max_uses=1):
+        uses_asked.append(max_uses)
+        return f"jt-{len(uses_asked):032x}"
+
+    prov = GceTpuNodeProvider(
+        "proj", "us-central2-b", cluster_name="c1",
+        head_address="10.0.0.2:6379", cluster_token="session-secret",
+        api=api, join_token_provider=mint)
+    (inst,) = prov.launch("v4-32", 1)
+    assert uses_asked == [8]  # >= the slice's 4 host VMs
+
+    # ssh_join_command on a cache miss (fresh process, pre-reconcile)
+    # resolves the type via the API — it must NOT mint single-use for a
+    # command that joins every host via --worker=all
+    with prov._lock:
+        prov._instances.clear()
+    prov.ssh_join_command(inst.instance_id)
+    assert uses_asked[-1] == 8
+
+    # redemption budget actually enforced head-side
+    cp = ControlPlane.__new__(ControlPlane)
+    cp._join_tokens, cp._jt_lock = {}, threading.Lock()
+    jt = ControlPlane.mint_join_token(cp, ttl_s=60, max_uses=3)
+    assert [ControlPlane._redeem_join_token(cp, jt) for _ in range(4)] == \
+        [True, True, True, False]
+
+
+def test_join_token_exchange_against_live_head():
+    """A join token admits exactly one hello, which hands back the session
+    token; replay and garbage both stay locked out."""
+    import ray_tpu
+    from ray_tpu.core import rpc
+    from ray_tpu.core.runtime import get_runtime
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        cp = get_runtime().control_plane
+        host, port = cp.server.address
+        jt = cp.mint_join_token(ttl_s=60)
+        assert jt != cp.token
+
+        p1 = rpc.connect(host, port, name="joining-agent")
+        reply = p1.call("hello", token=jt, kind="agent", timeout=10)
+        assert reply["ok"] and reply["token"] == cp.token  # exchanged
+        p1.close()
+
+        # single-use: replay of the spent token is rejected
+        p2 = rpc.connect(host, port, name="replaying-agent")
+        with pytest.raises(PermissionError):
+            p2.call("hello", token=jt, kind="agent", timeout=10)
+        p2.close()
+
+        # expired tokens are rejected (and pruned on the next mint)
+        stale = cp.mint_join_token(ttl_s=-1)
+        p3 = rpc.connect(host, port, name="late-agent")
+        with pytest.raises(PermissionError):
+            p3.call("hello", token=stale, kind="agent", timeout=10)
+        p3.close()
+
+        # the session token itself still works and returns no exchange
+        p4 = rpc.connect(host, port, name="worker")
+        assert "token" not in p4.call("hello", token=cp.token, kind="worker",
+                                      timeout=10)
+        p4.close()
+    finally:
+        ray_tpu.shutdown()

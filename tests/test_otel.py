@@ -123,9 +123,21 @@ def test_timeline_merges_worker_exec_lanes(tmp_path, monkeypatch):
     """`ray timeline` parity: worker execution windows appear as their own
     track group alongside head-side task spans."""
     monkeypatch.setenv("RAY_TPU_EXPORT_EVENTS_ENABLED", "1")
+    # Hermetic session dir: nothing shared with (or leaked from) the other
+    # sessions a full-suite run cycles through this process.
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR_PREFIX", str(tmp_path / "sess"))
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     try:
+        import glob
+        import os
+
+        from ray_tpu.core.runtime import get_runtime
         from ray_tpu.util import state
+
+        session_dir = get_runtime().session_dir
+        assert session_dir.startswith(str(tmp_path)), (
+            f"init() attached to a leaked session at {session_dir} — an "
+            "earlier test failed to shut its runtime down")
 
         @ray_tpu.remote
         def t():
@@ -141,7 +153,11 @@ def test_timeline_merges_worker_exec_lanes(tmp_path, monkeypatch):
             if exec_rows:
                 break
             _t.sleep(0.1)
-        assert exec_rows, "no worker exec lanes in timeline"
+        profile_files = glob.glob(
+            os.path.join(session_dir, "export_events", "export_task_profile*"))
+        assert exec_rows, (
+            "no worker exec lanes in timeline; profile files on disk: "
+            f"{profile_files or 'NONE (worker never emitted)'}")
         assert all(e["pid"] == 2 and e["dur"] >= 0 for e in exec_rows)
         # head-side spans still present
         assert any(e["cat"] == "task" for e in state.timeline())

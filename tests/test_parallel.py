@@ -57,7 +57,7 @@ def test_shard_params_places_on_mesh():
 
 
 def test_device_collectives_in_shard_map():
-    from jax import shard_map
+    from ray_tpu.parallel._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = cpu_mesh(data=8)
