@@ -501,6 +501,26 @@ class ClientRuntime:
             cls = self._actor_cls_cache[key] = cloudpickle.loads(blob)
         return _ActorStateShim(cls)
 
+    # ------------------------------------------------------ compiled graphs
+    def dag_install(self, spec_blob: bytes) -> dict:
+        """Install a compiled actor graph on the head (dag/compiled.py).
+        Raises WireVersionError on a pre-v4 head — the caller falls back to
+        per-call RPC dispatch. The returned handle is wire-bridged: this
+        driver's input/output edges ride persistent dag_ch_* channel ops."""
+        return self._rpc().call("dag_install", spec=spec_blob, timeout=120)
+
+    def dag_teardown(self, graph_id: bytes) -> None:
+        try:
+            self._rpc().call("dag_teardown", graph=graph_id, timeout=30)
+        except Exception:
+            pass  # peer already gone: the head reaps the graph on disconnect
+
+    def dag_wire_in(self, graph_id: bytes, chan_id: int) -> "_WireInChannel":
+        return _WireInChannel(self, graph_id, chan_id)
+
+    def dag_wire_out(self, graph_id: bytes, chan_id: int) -> "_WireOutChannel":
+        return _WireOutChannel(self, graph_id, chan_id)
+
     # ------------------------------------------------------------ streams
     def next_stream_item(self, stream_id: ObjectID, index: int):
         got = self._rpc().call("client_next_stream", stream=stream_id.binary(),
@@ -522,6 +542,62 @@ class ClientRuntime:
             self._plane_client.close()
         if self._peer is not None:
             self._peer.close()
+
+
+class _WireInChannel:
+    """Remote-driver input edge of a compiled graph: one ``dag_ch_write``
+    per frame, replied after the head-side shm channel admitted it — so the
+    ring channel's bounded-queue backpressure propagates over the wire."""
+
+    def __init__(self, client: ClientRuntime, graph_id: bytes, chan_id: int):
+        self._client = client
+        self._graph = graph_id
+        self._chan = chan_id
+
+    def write(self, frame: bytes, timeout: float | None = None) -> None:
+        self._client._rpc().call(
+            "dag_ch_write", graph=self._graph, chan=self._chan,
+            frame=bytes(frame),
+            timeout=None if timeout is None else timeout + 30)
+
+    def close(self) -> None:
+        pass  # server side owns the shm; dag_teardown closes it
+
+
+class _WireOutChannel:
+    """Remote-driver output edge: long-poll ``dag_ch_read``; the reply is a
+    raw BLOB frame ``[u64 version | payload]`` sent scatter-gather out of the
+    head (the PR-5 zero-copy path). Raises TimeoutError on an idle poll
+    window (caller loops) and ChannelClosed once the graph is gone.
+
+    The poll window is fixed (server long-polls 30s; 45s wire budget) — a
+    caller-chosen timeout is deliberately NOT accepted: abandoning an
+    in-flight read whose server side already consumed a frame would LOSE
+    that frame. Teardown unblocks a parked read via the head reaping the
+    graph (the call errors out)."""
+
+    def __init__(self, client: ClientRuntime, graph_id: bytes, chan_id: int):
+        self._client = client
+        self._graph = graph_id
+        self._chan = chan_id
+
+    def read(self, last: int):
+        import concurrent.futures as _cf
+
+        try:
+            raw = self._client._rpc().call(
+                "dag_ch_read", graph=self._graph, chan=self._chan, last=last,
+                timeout=45)
+        except _cf.TimeoutError as e:
+            # LOCAL wire-budget expiry: on Python 3.10 cf.TimeoutError is
+            # NOT builtin TimeoutError — normalize so the drain's retry
+            # path catches it (the server-side `last` makes retries
+            # idempotent) instead of declaring the graph dead
+            raise TimeoutError("dag_ch_read wire budget expired") from e
+        return int.from_bytes(raw[:8], "big"), raw[8:]
+
+    def close(self) -> None:
+        pass
 
 
 def install_client_runtime(host: str, port: int, token: str | None,

@@ -87,6 +87,11 @@ class ControlPlane:
         # serializes pending_gets mutations (deferred client_get lists) —
         # registration, completion, and disconnect cleanup race otherwise
         self._pg_lock = threading.Lock()
+        # compiled-graph wire bridges for REMOTE drivers: graph_id -> the
+        # driver-edge shm channels this head relays dag_ch_write/read into
+        # (dag/compiled.py; the graph itself lives in runtime._dags)
+        self._dag_bridges: dict[bytes, dict] = {}
+        self._dag_lock = threading.Lock()
         self.server = RpcServer(
             handlers=self._handlers(),
             host=cfg.control_plane_host,
@@ -147,6 +152,11 @@ class ControlPlane:
             self._xl_actors.pop(aid, None)
         for sid in peer.meta.pop("debug_sessions", ()):  # dead worker's pdbs
             self.runtime.debug_sessions.pop(sid, None)
+        for gid in peer.meta.pop("dags", ()):  # dead driver's compiled graphs
+            try:
+                self._dag_bridge_teardown(gid)
+            except Exception:
+                pass
         try:
             self.runtime.publisher.unsubscribe_remote(peer)
         except Exception:
@@ -251,6 +261,12 @@ class ControlPlane:
             "xl_actor_call": self._h_xl_actor_call,
             "xl_kill_actor": self._h_xl_kill_actor,
             "xl_list_funcs": self._h_xl_list_funcs,
+            # compiled actor graphs (v4): remote-driver install + persistent
+            # channel bridge ops (dag/compiled.py)
+            "dag_install": self._h_dag_install,
+            "dag_teardown": self._h_dag_teardown,
+            "dag_ch_write": self._h_dag_ch_write,
+            "dag_ch_read": self._h_dag_ch_read,
         }
         return {op: self._authed(op, fn) for op, fn in h.items()}
 
@@ -709,6 +725,80 @@ class ControlPlane:
 
     def _h_client_stream_done(self, peer: RpcPeer, msg: dict):
         return self.runtime.stream_completed(ObjectID(msg["stream"]), msg["index"])
+
+    # ---- compiled actor graphs (v4): a REMOTE driver installs the graph on
+    # this head; the actor-to-actor edges are head-host shm channels, and the
+    # driver's own input/output edges are bridged over these persistent ops
+    # (reads answered with raw BLOB frames — the PR-5 sendmsg path).
+    def _h_dag_install(self, peer: RpcPeer, msg: dict):
+        from ray_tpu.core.shm_channel import default_timeout
+
+        res = self.runtime.dag_install(msg["spec"])
+        gid = res["graph"]
+        live = self.runtime.dag_channels(gid)
+        driver_cids = list(res["input_chans"]) + [res["output_chan"]]
+        bridge = {
+            "chans": {cid: live[cid] for cid in driver_cids},
+            # one lock per channel: a client retry after a local wire-budget
+            # expiry must never run concurrently with the still-parked
+            # previous handler on the same strictly single-reader channel
+            "locks": {cid: threading.Lock() for cid in driver_cids},
+            "timeout": default_timeout(),
+            "peer": peer,
+        }
+        with self._dag_lock:
+            self._dag_bridges[gid] = bridge
+        peer.meta.setdefault("dags", set()).add(gid)
+        return {"graph": gid, "wire": True,
+                "input_chans": res["input_chans"],
+                "output_chan": res["output_chan"]}
+
+    def _dag_bridge_chan(self, msg: dict):
+        with self._dag_lock:
+            bridge = self._dag_bridges.get(msg["graph"])
+        if bridge is None:
+            from ray_tpu.core.shm_channel import ChannelClosed
+
+            raise ChannelClosed("compiled graph is gone (torn down?)")
+        ch = bridge["chans"].get(msg["chan"])
+        if ch is None:
+            raise ValueError(f"graph has no driver channel {msg['chan']}")
+        return bridge, ch
+
+    def _h_dag_ch_write(self, peer: RpcPeer, msg: dict):
+        bridge, ch = self._dag_bridge_chan(msg)
+        with bridge["locks"][msg["chan"]]:
+            ch.write(msg["frame"], timeout=bridge["timeout"])
+        return True
+
+    def _h_dag_ch_read(self, peer: RpcPeer, msg: dict):
+        from ray_tpu.core.rpc import RawReply
+
+        bridge, ch = self._dag_bridge_chan(msg)
+        # bounded long-poll: the remote drain loops on TimeoutError, so an
+        # idle graph never parks a request past the poll window
+        with bridge["locks"][msg["chan"]]:
+            version, view = ch.read_view(msg["last"], timeout=30.0)
+            # freeze the payload UNDER the lock (the channel's scratch is
+            # reused by the next read); the 8-byte version prefix rides the
+            # sendmsg iovec — no whole-frame copy to prepend it
+            return RawReply(bytes(view),
+                            prefix=version.to_bytes(8, "big"))
+
+    def _h_dag_teardown(self, peer: RpcPeer, msg: dict):
+        self._dag_bridge_teardown(msg["graph"])
+        peer.meta.setdefault("dags", set()).discard(msg["graph"])
+        return True
+
+    def _dag_bridge_teardown(self, gid: bytes) -> None:
+        # the bridge borrows the runtime's channel objects; teardown there
+        # closes + unlinks them
+        with self._dag_lock:
+            self._dag_bridges.pop(gid, None)
+        try:
+            self.runtime.dag_teardown(gid)
+        except Exception:
+            pass
 
     def _h_kv(self, peer: RpcPeer, msg: dict):
         from ray_tpu.experimental import internal_kv

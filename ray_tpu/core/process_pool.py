@@ -40,6 +40,11 @@ Wire protocol (parent -> worker):
   ("ack", seq, consumed)                go-ahead: consumer progress for a stream
   ("cancel", seq)                       yank if unstarted; abort a stream
   ("actor_init", cls, args, renv)       dedicated actors (unnumbered reply)
+  ("dag_install", seq, plan_blob, chan_names)  compiled-graph resident loop:
+                                          attach the named shm channels and
+                                          drive the actor through the static
+                                          plan until they close
+                                          (dag/exec_loop.py)
   ("exit",)
 Worker -> parent:
   ("ready",)                            boot handshake
@@ -48,6 +53,7 @@ Worker -> parent:
    ("done", seq, status, payload, extra[, contained]) status: "val" | "shm" | "err" | "gen_end"
   ("skipped", seq)                      cancel won; parent resubmits elsewhere
   ("badreq", None)                      undecodable frame: parent kills + respawns
+  ("dag", seq, "ok"/"err", payload[, exc])  dag_install ack
   3-tuple (status, payload, extra)      actor_init reply (unnumbered)
 """
 
@@ -400,6 +406,10 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
     actor_env_stack = None  # noqa: F841 - held so the env outlives __init__
     actor_loop = None
     actor_pool = None  # sync-method thread pool when max_concurrency > 1
+    # serializes compiled-graph loop steps with direct sync dispatch
+    # (max_concurrency=1 actors keep sequential semantics while a graph
+    # loop runs in this process; see dag/exec_loop.py step_lock)
+    actor_step_mutex = threading.Lock()
     actor_group_pools: dict = {}  # named concurrency group -> its own pool
     # (reference: concurrency_group_manager.cc runs sync calls on a pool of
     # max_concurrency threads inside the worker; user code owns its locking)
@@ -486,6 +496,35 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             except BaseException as e:  # noqa: BLE001
                 _reply(_error_payload(e))
             continue
+        if kind == "dag_install":
+            # ("dag_install", seq, plan_blob, chan_names): attach the
+            # compiled graph's shm channels and run the static schedule on a
+            # resident thread — zero pipe/RPC traffic per step from here on.
+            dag_seq = req[1]
+            try:
+                if actor_instance is None:
+                    raise RuntimeError("dag_install before actor_init")
+                from ray_tpu.core.shm_channel import ShmChannel
+                from ray_tpu.dag import exec_loop
+
+                plan = cloudpickle.loads(req[2])
+                chans = {cid: ShmChannel(name=name, create=False)
+                         for cid, name in req[3].items()}
+                threading.Thread(
+                    target=exec_loop.run_plan,
+                    args=(actor_instance, plan, chans),
+                    # the step mutex is skipped for mc>1 actors — they
+                    # opted into concurrent execution (pool path)
+                    kwargs={"detach_on_exit": True,
+                            "step_lock": (actor_step_mutex
+                                          if actor_pool is None else None)},
+                    daemon=True, name="actor-dag-loop",
+                ).start()
+                _reply(("dag", dag_seq, "ok", None))
+            except BaseException as e:  # noqa: BLE001
+                status, payload, extra = _error_payload(e)
+                _reply(("dag", dag_seq, "err", payload, extra))
+            continue
         if kind == "actor_call2":
             # ("actor_call2", seq, method, args_blob, oid_bin[, group])
             _, seq, method_name, args_blob, oid_bin = req[:5]
@@ -535,7 +574,9 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                     else:
                         pool_for.submit(_run_pooled)
                 else:
-                    _finish_call(seq, method(*args, **kwargs), oid_bin)
+                    with actor_step_mutex:
+                        result = method(*args, **kwargs)
+                    _finish_call(seq, result, oid_bin)
             except BaseException as e:  # noqa: BLE001
                 _finish_err(seq, e)
             continue
@@ -583,7 +624,14 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                         gen_status = "gen_end"
                         try:
                             try:
-                                _stream_out(s, tb, m(*a, **kw), b)
+                                if actor_pool is None:
+                                    # max_concurrency=1: generator iteration
+                                    # mutates actor state — serialize with
+                                    # any installed compiled-graph loop
+                                    with actor_step_mutex:
+                                        _stream_out(s, tb, m(*a, **kw), b)
+                                else:
+                                    _stream_out(s, tb, m(*a, **kw), b)
                             finally:
                                 with pend_cv:
                                     gen_consumed.pop(s, None)
@@ -817,6 +865,7 @@ class DedicatedActorWorker:
         self._mu = threading.Lock()
         self._calls: dict[int, _ActorCall] = {}
         self._init_fut: Future | None = None
+        self._dag_futs: dict[int, Future] = {}  # seq-tagged install acks
         self._seq = 0
         self._dead = False
         threading.Thread(target=self._reader, daemon=True,
@@ -839,11 +888,13 @@ class DedicatedActorWorker:
             self._dead = True
             calls, self._calls = list(self._calls.values()), {}
             init_fut, self._init_fut = self._init_fut, None
+            dag_futs, self._dag_futs = list(self._dag_futs.values()), {}
         for c in calls:
             if not c.future.done():
                 c.future.set_exception(exc)
-        if init_fut is not None and not init_fut.done():
-            init_fut.set_exception(exc)
+        for fut in [init_fut] + dag_futs:
+            if fut is not None and not fut.done():
+                fut.set_exception(exc)
 
     def _reader(self) -> None:
         while True:
@@ -903,6 +954,20 @@ class DedicatedActorWorker:
                 else:
                     call.future.set_result((status, payload, extra, contained))
                 continue
+            if tag == "dag":
+                # compiled-graph install ack: ("dag", seq, "ok"/"err",
+                # payload[, exc]) — seq-tagged so concurrent installs on
+                # one actor pair each ack with ITS request
+                with self._mu:
+                    fut = self._dag_futs.pop(resp[1], None)
+                if fut is not None and not fut.done():
+                    if resp[2] == "err":
+                        fut.set_exception(
+                            _RemoteTaskError(resp[3], exc_blob=resp[4]
+                                             if len(resp) > 4 else None))
+                    else:
+                        fut.set_result(None)
+                continue
             # unnumbered 3-tuple: actor_init reply
             if self._init_fut is not None:
                 status, payload, extra = resp
@@ -925,6 +990,29 @@ class DedicatedActorWorker:
         except (BrokenPipeError, OSError) as e:
             raise WorkerCrashedError("actor worker process died") from e
         fut.result()
+
+    def dag_install(self, plan_blob: bytes, chan_names: dict) -> None:
+        """Install a compiled-graph resident loop in the worker process: it
+        attaches the named shm channels and drives the actor instance through
+        the static plan until the channels close (dag/exec_loop.py). Blocks
+        until the worker acks the attach (or reports the error)."""
+        with self._mu:
+            if self._dead:
+                raise WorkerCrashedError("actor worker process died")
+            seq = self._seq
+            self._seq += 1
+            fut = self._dag_futs[seq] = Future()
+        try:
+            self._send(("dag_install", seq, plan_blob, dict(chan_names)))
+        except (BrokenPipeError, OSError) as e:
+            with self._mu:
+                self._dag_futs.pop(seq, None)
+            raise WorkerCrashedError("actor worker process died") from e
+        try:
+            fut.result(timeout=30)
+        finally:
+            with self._mu:
+                self._dag_futs.pop(seq, None)
 
     def submit_call(self, method_name: str, args_blob: bytes,
                     oid_bin: bytes | None, on_item=None, task_bin: bytes | None = None,

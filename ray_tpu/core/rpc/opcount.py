@@ -1,0 +1,37 @@
+"""Control-plane dispatch counters.
+
+Every outbound RPC request/notify (``rpc:<op>``) and every local task/actor
+submission (``local:submit_task`` / ``local:submit_actor_task``) bumps a
+process-wide counter. The compiled-graph contract — zero control-plane
+round trips per DAG step at steady state — is asserted against these
+counters in tests (tests/test_dag.py) and the microbench suite; they are
+cheap dict increments, always on.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+COUNTS: "Counter[str]" = Counter()
+
+
+def bump(name: str) -> None:
+    COUNTS[name] += 1
+
+
+def snapshot() -> dict:
+    """Copy of all counters (stable across concurrent bumps under the GIL)."""
+    return dict(COUNTS)
+
+
+def total(snap: dict | None = None) -> int:
+    """Sum of all dispatch counters (optionally of a snapshot)."""
+    src = COUNTS if snap is None else snap
+    return sum(src.values())
+
+
+def delta(before: dict, after: dict | None = None) -> dict:
+    """Non-zero per-op growth between two snapshots."""
+    after = snapshot() if after is None else after
+    return {k: v - before.get(k, 0) for k, v in after.items()
+            if v != before.get(k, 0)}

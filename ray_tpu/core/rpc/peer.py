@@ -25,7 +25,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Optional
 
-from ray_tpu.core.rpc import codec
+from ray_tpu.core.rpc import codec, opcount
 from ray_tpu.core.rpc.codec import MAX_FRAME, ProtocolError
 from ray_tpu.core.rpc.reactor import Reactor
 from ray_tpu.core.rpc.schema import (
@@ -59,12 +59,18 @@ class RawReply:
     object plane returns ``RawReply(shm_view[off:off+n])`` so chunk bytes
     go NIC-ward straight out of the mapped store segment. Only handlers of
     ``since>=3`` ops may return one (older peers can't decode BLOB frames).
+
+    ``prefix``: optional small app-level header (e.g. the dag channel's
+    8-byte version counter) that rides the same sendmsg iovec ahead of the
+    payload — it counts toward the frame's payload_len without forcing a
+    whole-frame copy to prepend it.
     """
 
-    __slots__ = ("view",)
+    __slots__ = ("view", "prefix")
 
-    def __init__(self, buf):
+    def __init__(self, buf, prefix: bytes = b""):
         self.view = buf if isinstance(buf, memoryview) else memoryview(buf)
+        self.prefix = prefix
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -200,6 +206,7 @@ class RpcPeer:
         spec = get_op(op)
         self._check_version(spec)
         payload = validate_payload(spec, payload, outbound=True)
+        opcount.bump(f"rpc:{op}")
         mid = next(self._ids)
         fut: Future = Future()
         with self._plock:
@@ -233,6 +240,7 @@ class RpcPeer:
         spec = get_op(op)
         self._check_version(spec)
         payload = validate_payload(spec, payload, outbound=True)
+        opcount.bump(f"rpc:{op}")
         self._send_raw(codec.notify_frame(spec.num, payload))
 
     def _check_version(self, spec) -> None:
@@ -251,25 +259,33 @@ class RpcPeer:
             self._fail(PeerDisconnected(f"send to {self.name} failed: {e}"))
             raise PeerDisconnected(str(e)) from e
 
-    def _send_blob(self, reply_to: int, view: memoryview) -> None:
-        """Answer a request with a raw BLOB frame: msgpack header + payload
-        in one scatter-gather syscall, the payload straight from the
-        caller's buffer (typically a view into the shm store segment) —
-        no slice copy, no join, no msgpack encode of the bytes."""
+    def _send_blob(self, reply_to: int, view: memoryview,
+                   prefix: bytes = b"") -> None:
+        """Answer a request with a raw BLOB frame: msgpack header (+ any
+        app-level prefix) + payload in one scatter-gather syscall, the
+        payload straight from the caller's buffer (typically a view into
+        the shm store segment) — no slice copy, no join, no msgpack encode
+        of the bytes."""
         if view.ndim != 1 or view.itemsize != 1:
             view = view.cast("B")
-        header = codec.blob_header(reply_to, len(view))
-        hlen, total = len(header), len(header) + len(view)
+        header = codec.blob_header(reply_to, len(prefix) + len(view))
+        bufs0 = [memoryview(header), memoryview(prefix), view] if prefix \
+            else [memoryview(header), view]
+        total = sum(len(b) for b in bufs0)
         try:
             with self._wlock:
-                sent = self._sock.sendmsg([header, view])
+                sent = self._sock.sendmsg(bufs0)
                 while sent < total:  # short write: resend the remainder,
                     #                  still by reference (sliced views)
-                    if sent < hlen:
-                        bufs = [memoryview(header)[sent:], view]
-                    else:
-                        bufs = [view[sent - hlen:]]
-                    sent += self._sock.sendmsg(bufs)
+                    rem, skipped = [], 0
+                    for b in bufs0:
+                        if sent >= skipped + len(b):
+                            skipped += len(b)
+                            continue
+                        off = sent - skipped  # <= 0 for buffers fully unsent
+                        rem.append(b[off:] if off > 0 else b)
+                        skipped += len(b)
+                    sent += self._sock.sendmsg(rem)
         except OSError as e:
             self._fail(PeerDisconnected(f"send to {self.name} failed: {e}"))
             raise PeerDisconnected(str(e)) from e
@@ -395,7 +411,7 @@ class RpcPeer:
             result = handler(self, msg)
             if mid is not None:
                 if isinstance(result, RawReply):
-                    self._send_blob(mid, result.view)
+                    self._send_blob(mid, result.view, result.prefix)
                     return
                 if isinstance(result, Future):
                     # Deferred reply: the handler pipelined the work (e.g. a
@@ -427,7 +443,7 @@ class RpcPeer:
             return
         try:
             if isinstance(result, RawReply):
-                self._send_blob(mid, result.view)
+                self._send_blob(mid, result.view, result.prefix)
                 return
             self._send_raw(codec.reply_frame(mid, result))
         except PeerDisconnected:

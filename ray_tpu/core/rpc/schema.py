@@ -23,6 +23,11 @@ Version history:
   travel as raw bytes after a msgpack header (codec.py BLOB) instead of
   msgpack ``bin`` values; pullers on a <v3 connection fall back to the
   chunked-msgpack ``obj_chunk`` path.
+- v4: compiled actor graphs (``dag_*``) — a remote driver installs a static
+  per-actor schedule over pre-negotiated channels (dag/compiled.py) and
+  moves step data over persistent ``dag_ch_write``/``dag_ch_read`` channel
+  ops (reads answered with raw BLOB frames). A <v4 peer cannot install
+  graphs; ``experimental_compile`` falls back to RPC dispatch.
 """
 
 from __future__ import annotations
@@ -32,7 +37,7 @@ from typing import Optional
 
 # The schema version this build speaks, and the oldest it can fall back to.
 # Peers negotiate min(max_a, max_b) at hello; see negotiate().
-WIRE_VERSION = 3
+WIRE_VERSION = 4
 WIRE_VERSION_MIN = 1
 
 # Protocol magic sent in the hello frame: rejects foreign/legacy peers with
@@ -322,3 +327,27 @@ register_op(51, "obj_chunk_raw", [
     _f("oid", T.BYTES, required=True), _f("off", T.INT, required=True),
     _f("len", T.INT, required=True)], since=3,
     doc="reply is a raw BLOB frame, not a msgpack REPLY")
+
+# -- compiled actor graphs (v4; reference: python/ray/dag compiled graphs +
+#    experimental/channel): install/teardown are the ONLY control-plane
+#    round trips of a compiled graph's life — steps ride channels.
+register_op(52, "dag_install", [
+    _f("spec", T.BLOB, required=True)], since=4, blocking=True,
+    doc="install a compiled actor graph: create channels, start resident "
+        "loops; reply {graph, channels, input_chans, output_chan}")
+register_op(53, "dag_teardown", [
+    _f("graph", T.BYTES, required=True)], since=4, blocking=True,
+    doc="close + destroy a graph's channels; loops exit, actors return to "
+        "normal RPC dispatch. blocking: joins loop threads (seconds), must "
+        "not park a shared reactor slot")
+register_op(54, "dag_ch_write", [
+    _f("graph", T.BYTES, required=True), _f("chan", T.INT, required=True),
+    _f("frame", T.BLOB, required=True)], since=4, blocking=True,
+    doc="remote driver input edge: publish one frame into the graph's shm "
+        "channel (reply after admission = channel backpressure)")
+register_op(55, "dag_ch_read", [
+    _f("graph", T.BYTES, required=True), _f("chan", T.INT, required=True),
+    _f("last", T.INT, required=True)], since=4, blocking=True,
+    doc="remote driver output edge: long-poll the next frame newer than "
+        "`last`; reply is a raw BLOB frame [u64 version | payload] riding "
+        "the v3 zero-copy sendmsg path")

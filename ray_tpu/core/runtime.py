@@ -42,6 +42,7 @@ from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, Worke
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.object_store import MemoryStore, RayObject
 from ray_tpu.core.reference_counter import ReferenceCounter
+from ray_tpu.core.rpc import opcount
 from ray_tpu.core.scheduler import (
     ClusterScheduler,
     PlacementGroupState,
@@ -202,6 +203,11 @@ class _ActorState:
         self.lock = threading.Lock()
         self.pending_count = 0
         self.proc_worker = None  # DedicatedActorWorker for process actors
+        # serializes compiled-graph loop steps with normal sync dispatch on
+        # max_concurrency=1 actors (dag/exec_loop.py step_lock): an actor
+        # written for sequential semantics keeps them while a graph is
+        # installed (or two graphs share it)
+        self.dag_step_lock = threading.Lock()
 
     def mailbox_for(self, spec: "TaskSpec") -> "queue.Queue":
         if spec.concurrency_group:
@@ -221,6 +227,32 @@ class _ActorState:
             mb = self.mailboxes.get(gname, self.mailbox)
             for _ in range(n):
                 mb.put(None)
+
+
+class _DagRecord:
+    """One installed compiled actor graph: its channels, the resident loop
+    threads serving it, and the actors it spans (dag/compiled.py)."""
+
+    def __init__(self, graph_id: bytes):
+        self.graph_id = graph_id
+        self.channels: dict[int, Any] = {}      # chan_id -> ShmChannel
+        self.threads: list[threading.Thread] = []
+        self.actor_bins: set[bytes] = set()
+        self.stop_monitor = threading.Event()
+        self.dead_reason: str | None = None
+
+    def abort(self, reason: str) -> None:
+        """Close every channel: each resident loop (and the driver drain)
+        wakes with ChannelClosed, so every in-flight execute() raises
+        instead of hanging. Idempotent; destroy() still owns the unlink."""
+        if self.dead_reason is None:
+            self.dead_reason = reason
+        self.stop_monitor.set()
+        for ch in self.channels.values():
+            try:
+                ch.close_channel()
+            except Exception:
+                pass
 
 
 class Runtime:
@@ -315,6 +347,10 @@ class Runtime:
         self._streams: dict[ObjectID, _StreamState] = {}
         self._actors: dict[ActorID, _ActorState] = {}
         self._named_actors: dict[tuple[str, str], ActorID] = {}
+        # installed compiled actor graphs (dag/compiled.py): graph_id ->
+        # _DagRecord (channels + resident loop threads + liveness monitor)
+        self._dags: dict[bytes, _DagRecord] = {}
+        self._dags_lock = threading.Lock()
         self._lock = threading.Lock()
         self._put_index = 0
         self._recovering: set[ObjectID] = set()
@@ -801,6 +837,7 @@ class Runtime:
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
         if self.is_shutdown:
             raise RuntimeError("ray_tpu runtime is shut down")
+        opcount.bump("local:submit_task")
         dep_refs = _ref_args(spec.args, spec.kwargs)
         self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
         return_ids = spec.return_ids()
@@ -2144,14 +2181,28 @@ class Runtime:
                             self._finish_async_actor_call(
                                 state, spec, entry, mailbox, sem, f))
                         continue
-                    fut = asyncio.run_coroutine_threadsafe(
-                        method(*args, **kwargs), state.loop)
-                    result = fut.result()
-                elif is_gen:
-                    self._execute_actor_generator(spec, method, args, kwargs)
-                    result = _NO_STORE
+
+                def _invoke(method=method, args=args, kwargs=kwargs,
+                            is_coro=is_coro, is_gen=is_gen, spec=spec):
+                    if is_coro:
+                        fut = asyncio.run_coroutine_threadsafe(
+                            method(*args, **kwargs), state.loop)
+                        return fut.result()
+                    if is_gen:
+                        self._execute_actor_generator(spec, method, args,
+                                                      kwargs)
+                        return _NO_STORE
+                    return method(*args, **kwargs)
+
+                if state.max_concurrency == 1 and not state.concurrency_groups:
+                    # mutual exclusion with any installed compiled-graph loop
+                    # for EVERY inline dispatch shape — sync, async, and
+                    # generator methods all mutate actor state (uncontended
+                    # when no graph is installed)
+                    with state.dag_step_lock:
+                        result = _invoke()
                 else:
-                    result = method(*args, **kwargs)
+                    result = _invoke()
                 if result is not _NO_STORE:
                     self._store_returns(spec, result)
                 if entry:
@@ -2446,6 +2497,7 @@ class Runtime:
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs, options: dict) -> list[ObjectRef]:
         """Reference: CoreWorker::SubmitActorTask (core_worker.cc:2386) via
         ActorTaskSubmitter sequential queues."""
+        opcount.bump("local:submit_actor_task")
         state = self._actors.get(actor_id)
         if state is None:
             raise ActorDiedError("Actor handle refers to unknown actor.")
@@ -2511,6 +2563,7 @@ class Runtime:
         was_alive = state.state == "ALIVE"
         state.state = "DEAD"
         state.death_cause = "ray_tpu.kill() called"
+        self._abort_dags_for(actor_id, "actor killed mid-loop")
         self._publish_actor_event(state)
         if state.name:
             with self._lock:
@@ -2585,6 +2638,141 @@ class Runtime:
 
     def actor_state(self, actor_id: ActorID) -> _ActorState | None:
         return self._actors.get(actor_id)
+
+    # ----------------------------------------------------- compiled graphs
+    def dag_install(self, spec_blob: bytes) -> dict:
+        """Install a compiled actor graph (dag/compiled.py GraphSpec blob):
+        create one seqlock shm channel per DAG edge and start a resident
+        execution loop in every participating actor — in-process thread for
+        thread-hosted actors, a loop inside the dedicated worker for process
+        actors. Returns ``{"graph", "channels": {chan_id: shm_name},
+        "input_chans", "output_chan"}``; after this, graph steps run with
+        zero control-plane requests (dag/exec_loop.py)."""
+        import cloudpickle
+
+        from ray_tpu.core.shm_channel import ShmChannel
+        from ray_tpu.dag import exec_loop
+
+        spec = cloudpickle.loads(spec_blob)
+        rec = _DagRecord(spec.graph_id)
+        proc_workers = []
+        try:
+            for cid in spec.all_chans:
+                rec.channels[cid] = ShmChannel(capacity=spec.capacity)
+            for plan in spec.plans:
+                state = self._dag_wait_actor(ActorID(plan.actor_bin))
+                rec.actor_bins.add(plan.actor_bin)
+                plan_chans = set(plan.read_chans) | set(plan.write_chans())
+                if state.proc_worker is not None:
+                    state.proc_worker.dag_install(
+                        cloudpickle.dumps(plan),
+                        {cid: rec.channels[cid].name for cid in plan_chans})
+                    proc_workers.append(state.proc_worker)
+                else:
+                    # in-process loop sharing the runtime's channel objects
+                    # (single reader/writer per end still holds: one loop per
+                    # channel end). The loop closes-but-never-detaches them;
+                    # dag_teardown owns the unlink. step_lock keeps mc=1
+                    # sequential semantics against normal dispatch;
+                    # mc>1/grouped actors opted into concurrency already.
+                    step_lock = (state.dag_step_lock
+                                 if state.max_concurrency == 1
+                                 and not state.concurrency_groups else None)
+                    t = threading.Thread(
+                        target=exec_loop.run_plan,
+                        args=(state.instance, plan,
+                              {cid: rec.channels[cid] for cid in plan_chans}),
+                        kwargs={"step_lock": step_lock},
+                        daemon=True,
+                        name=f"ray_tpu-dag-{state.cls.__name__}-"
+                             f"{spec.graph_id.hex()[:8]}",
+                    )
+                    rec.threads.append(t)
+                    t.start()
+        except BaseException:
+            rec.abort("install failed")
+            for ch in rec.channels.values():
+                ch.destroy()
+            raise
+        if proc_workers:
+            # a SIGKILLed/crashed dedicated worker can't close its channels
+            # itself — watch liveness and cascade the abort so no end hangs
+            mon = threading.Thread(
+                target=self._dag_monitor, args=(rec, proc_workers),
+                daemon=True,
+                name=f"ray_tpu-dag-monitor-{spec.graph_id.hex()[:8]}")
+            rec.threads.append(mon)
+            mon.start()
+        with self._dags_lock:
+            self._dags[spec.graph_id] = rec
+        # channel OBJECTS are exposed via dag_channels(); workers already got
+        # their segment names through proc_worker.dag_install above
+        return {
+            "graph": spec.graph_id,
+            "input_chans": list(spec.input_chans),
+            "output_chan": spec.output_chan,
+        }
+
+    def dag_channels(self, graph_id: bytes) -> dict:
+        """Live channel objects of an installed graph — same-process callers
+        (the local driver, the head's wire bridges) use these directly
+        instead of re-attaching segments by name (a second attach in the
+        same process would double-register with the resource tracker)."""
+        with self._dags_lock:
+            rec = self._dags.get(graph_id)
+            return dict(rec.channels) if rec is not None else {}
+
+    def _dag_wait_actor(self, actor_id: ActorID, timeout: float = 30.0):
+        """Creation is asynchronous — wait until the actor is ALIVE (its
+        instance or dedicated worker exists) before installing the loop."""
+        deadline = time.monotonic() + timeout
+        while True:
+            state = self._actors.get(actor_id)
+            if state is None:
+                raise ActorDiedError(
+                    "compiled DAG references an unknown actor")
+            if state.state == "DEAD":
+                raise ActorDiedError(state.death_cause or "actor is dead")
+            if state.state == "ALIVE" and (
+                    state.instance is not None
+                    or state.proc_worker is not None):
+                return state
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"actor {actor_id.hex()[:12]} not ALIVE within {timeout}s "
+                    "for compiled-DAG install")
+            time.sleep(0.005)
+
+    def _dag_monitor(self, rec: _DagRecord, workers: list) -> None:
+        while not rec.stop_monitor.wait(0.2):
+            for w in workers:
+                if not w.is_alive():
+                    rec.abort("process actor died mid-loop")
+                    return
+
+    def dag_teardown(self, graph_id: bytes) -> None:
+        """Close + destroy a graph's channels and join its loops; the actors
+        return to normal RPC dispatch (their mailboxes never stopped)."""
+        with self._dags_lock:
+            rec = self._dags.pop(graph_id, None)
+        if rec is None:
+            return
+        rec.abort("graph torn down")
+        for t in rec.threads:
+            t.join(timeout=5)
+        for ch in rec.channels.values():
+            ch.destroy()
+
+    def _abort_dags_for(self, actor_id: ActorID, reason: str) -> None:
+        """An actor died: close the channels of every graph it participates
+        in so resident loops and drivers raise instead of hanging. The
+        records stay registered — the driver's teardown() (or runtime
+        shutdown) destroys the segments."""
+        abin = actor_id.binary()
+        with self._dags_lock:
+            recs = [r for r in self._dags.values() if abin in r.actor_bins]
+        for rec in recs:
+            rec.abort(reason)
 
     # ------------------------------------------------------------------ events / state API
     def _record_event(self, spec: TaskSpec, state: str) -> None:
@@ -2685,6 +2873,13 @@ class Runtime:
 
         for var in getattr(self, "_session_env_vars", ()):
             _os.environ.pop(var, None)
+        with self._dags_lock:
+            dag_ids = list(self._dags)
+        for gid in dag_ids:
+            try:
+                self.dag_teardown(gid)
+            except Exception:
+                pass
         for state in list(self._actors.values()):
             if state.proc_worker is not None:
                 try:

@@ -6,113 +6,310 @@ channels built on them (experimental/channel/shared_memory_channel.py) —
 a fixed buffer written in place per DAG execution, with writer/reader
 synchronization instead of per-call RPC + allocation.
 
-Mechanism here: one POSIX shm segment per channel carrying a seqlock header
-  [u64 version][u64 acked][u64 len][u32 closed]
-and a fixed payload area. The writer bumps version to ODD while copying,
-EVEN when sealed; a reader spins/sleeps until an unseen EVEN version, copies
-out, re-checks the version (seqlock), then stores it into `acked`. The writer
-waits for acked == version before the next write — capacity-1 backpressure,
-exactly the mutable-object semantics (writer blocks until readers consumed).
+Mechanism here: one POSIX shm segment per channel carrying a small ring of
+fixed-size slots behind a counter header
 
-Single-writer / single-reader per channel (a compiled DAG edge); ping-pong
-pairs give bidirectional driver<->worker loops (dag/__init__.py shm mode).
+    [u64 written][u64 read][u32 closed][u32 nslots][u64 slot_size]
+
+Single-writer / single-reader per channel (a compiled DAG edge). The writer
+publishes frame ``i`` into slot ``i % nslots`` and bumps ``written``; the
+reader consumes slot ``read % nslots`` and bumps ``read``. The writer blocks
+while the ring is full (``written - read == nslots``), the reader while it
+is empty — bounded-queue backpressure. A slot is never rewritten before the
+reader advanced past it, so copies need no seqlock retries.
+
+The ring (vs the previous single mutable slot) exists for throughput on
+busy pipelines: with one slot every frame costs a full writer<->reader
+context-switch handoff (~100 µs on a 1-core host); with a small ring each
+party moves bursts of frames per wakeup, amortizing the handoff.
+
+Payloads larger than one slot are CHUNKED across consecutive slots: every
+chunk except the last carries more=1 and the reader reassembles. Capacity
+is therefore a throughput knob (bigger slots = fewer chunks per frame),
+never a correctness cliff — a compiled loop that suddenly produces one
+oversized activation keeps running instead of dying on ValueError.
+
+Waiters back off hot-spin -> ``os.sched_yield()`` -> escalating micro-sleeps
+(``_backoff``); an idle channel costs ~zero CPU, a saturated one hands the
+core straight to its peer.
 """
 
 from __future__ import annotations
 
+import os
 import struct
 import time
 from multiprocessing import shared_memory
 
-_HDR = struct.Struct("<QQQI")  # version, acked, len, closed
+_HDR = struct.Struct("<QQIIQ")  # written, read, closed, nslots, slot_size
+_CTR = struct.Struct("<QQI")    # written, read, closed (hot-path view)
+_SLOT = struct.Struct("<QI4x")  # len, more (16-byte slot header)
 HEADER_SIZE = _HDR.size
+SLOT_HEADER = _SLOT.size
+
+DEFAULT_SLOTS_ENV = "RAY_TPU_DAG_CHANNEL_SLOTS"
+
+# One knob shared by every compiled-graph channel user (ShmCompiledDAG,
+# CompiledActorDAG, the head-side wire bridges): how long a single channel
+# write/read may park before the caller gets a TimeoutError.
+DEFAULT_TIMEOUT_ENV = "RAY_TPU_DAG_CHANNEL_TIMEOUT_S"
+
+
+def default_timeout() -> float:
+    """The compiled-graph channel timeout (seconds), env-overridable."""
+    try:
+        return float(os.environ.get(DEFAULT_TIMEOUT_ENV, "60"))
+    except ValueError:
+        return 60.0
+
+
+def _default_slots() -> int:
+    try:
+        return max(1, int(os.environ.get(DEFAULT_SLOTS_ENV, "8")))
+    except ValueError:
+        return 8
 
 
 class ChannelClosed(Exception):
     pass
 
 
+def _backoff(spins: int) -> None:
+    """Wait strategy: brief hot spin, then ``os.sched_yield()`` (a REAL
+    yield syscall — ``time.sleep(0)`` is not one), then escalate to bounded
+    micro-sleeps so an idle channel costs ~zero CPU.
+
+    On a saturated pipeline the peer is RUNNABLE one timeslice away, so the
+    yield phase carries the steady state: measured on a 1-core host, a
+    cross-process ping-pong runs ~54K round trips/s under this policy vs
+    ~1K with a fixed 0.5 ms poll-sleep (which capped compiled actor chains
+    at ~400 steps/s)."""
+    if spins < 16:
+        return
+    if spins < 2048:
+        os.sched_yield()
+        return
+    time.sleep(min(0.0005, 0.000005 * (spins - 2047)))
+
+
 class ShmChannel:
     def __init__(self, name: str | None = None, capacity: int = 1 << 20,
-                 create: bool = True):
+                 create: bool = True, nslots: int | None = None):
         if create:
+            nslots = nslots or _default_slots()
+            slot_size = max(4096, capacity // nslots)
+            size = HEADER_SIZE + nslots * (SLOT_HEADER + slot_size)
             self._shm = shared_memory.SharedMemory(
-                name=name, create=True, size=HEADER_SIZE + capacity)
-            _HDR.pack_into(self._shm.buf, 0, 0, 0, 0, 0)
+                name=name, create=True, size=size)
+            _HDR.pack_into(self._shm.buf, 0, 0, 0, 0, nslots, slot_size)
+            self._nslots, self._slot_size = nslots, slot_size
         else:
             self._shm = shared_memory.SharedMemory(name=name)
+            # bpo-38119: on CPython < 3.13 ATTACHING also registers with the
+            # resource tracker, which unlinks the segment when this process
+            # exits — yanking a channel other processes still use (a killed
+            # proc actor would tear down its graph's segments). The creator
+            # owns the unlink; un-register the attach.
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(self._shm._name, "shared_memory")
+            except Exception:
+                pass
+            _, _, _, self._nslots, self._slot_size = _HDR.unpack_from(
+                self._shm.buf, 0)
         self.name = self._shm.name
-        self.capacity = self._shm.size - HEADER_SIZE
+        self.capacity = self._nslots * self._slot_size
         self._created = create
+        self._scratch = bytearray()  # read_view reassembly buffer (reused)
+        self._consumed_version = 0   # last frame THIS reader object returned
+        self._consumed_len = 0       # (scratch cache for idempotent retries)
 
     # ------------------------------------------------------------- header
-    def _hdr(self):
-        return _HDR.unpack_from(self._shm.buf, 0)
+    def _counters(self):
+        return _CTR.unpack_from(self._shm.buf, 0)
 
-    def _set_version(self, v: int) -> None:
+    def _set_written(self, v: int) -> None:
         struct.pack_into("<Q", self._shm.buf, 0, v)
 
-    def _set_acked(self, v: int) -> None:
+    def _set_read(self, v: int) -> None:
         struct.pack_into("<Q", self._shm.buf, 8, v)
 
-    def _set_len(self, n: int) -> None:
-        struct.pack_into("<Q", self._shm.buf, 16, n)
+    def _slot_off(self, index: int) -> int:
+        return HEADER_SIZE + (index % self._nslots) * (SLOT_HEADER
+                                                       + self._slot_size)
 
     # -------------------------------------------------------------- write
-    def write(self, payload: bytes, timeout: float | None = 30.0) -> None:
-        """Blocks until the previous value was consumed (capacity-1
-        backpressure), then publishes `payload` under the seqlock."""
-        if len(payload) > self.capacity:
-            raise ValueError(
-                f"payload {len(payload)} > channel capacity {self.capacity}")
+    def write(self, payload, timeout: float | None = 30.0) -> None:
+        """Publish one frame; blocks while the ring is full (bounded-queue
+        backpressure). Payloads beyond one slot are split across consecutive
+        slots (the reader reassembles transparently)."""
+        view = memoryview(payload)
+        if view.ndim != 1 or view.itemsize != 1:
+            view = view.cast("B")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        total = len(view)
+        off = 0
+        while True:
+            n = min(self._slot_size, total - off)
+            try:
+                self._write_chunk(view[off:off + n], more=(off + n < total),
+                                  deadline=deadline)
+            except TimeoutError:
+                if off == 0:
+                    raise  # nothing published: a clean, retryable timeout
+                # TIMEOUT-ATOMICITY: chunks of this frame are already in the
+                # ring. Abandoning now would fuse the remainder with the
+                # next frame at the reader — silent corruption. Poison the
+                # channel instead: both ends fail loudly with ChannelClosed.
+                self.close_channel()
+                raise ChannelClosed(
+                    f"channel {self.name} poisoned: writer stalled mid-frame "
+                    f"(chunk at byte {off}/{total})") from None
+            off += n
+            if off >= total:
+                return
+            # continuation chunks get a fresh, generous frame deadline: the
+            # caller's (possibly sub-second poll) timeout must only gate the
+            # frame START, never abort it halfway. A timeout=None caller
+            # (resident exec loops) keeps blocking forever — a merely slow
+            # peer must never poison the channel.
+            if deadline is not None:
+                deadline = time.monotonic() + default_timeout()
+
+    def _write_chunk(self, chunk, more: bool, deadline) -> None:
+        spins = 0
+        while True:
+            written, read, closed = self._counters()
+            if closed:
+                raise ChannelClosed(self.name)
+            if written - read < self._nslots:
+                break
+            spins += 1
+            _backoff(spins)
+            if (deadline is not None and not spins & 63
+                    and time.monotonic() > deadline):
+                raise TimeoutError(f"channel {self.name} writer stalled "
+                                   "(reader not consuming)")
+        off = self._slot_off(written)
+        _SLOT.pack_into(self._shm.buf, off, len(chunk), 1 if more else 0)
+        dst = off + SLOT_HEADER
+        self._shm.buf[dst:dst + len(chunk)] = chunk
+        self._set_written(written + 1)  # publish (slot untouchable until
+        #                                 the reader advances past it)
+
+    def slots_for(self, nbytes: int) -> int:
+        """Ring slots a payload of ``nbytes`` will occupy (>= 1)."""
+        return max(1, -(-nbytes // self._slot_size))
+
+    def wait_writable(self, timeout: float | None = 30.0,
+                      slots: int = 1) -> None:
+        """Block until the ring has ``slots`` free slots (capped at the ring
+        size — larger frames inherently need concurrent reader progress), or
+        raise TimeoutError/ChannelClosed. For a channel's SOLE writer this
+        makes a subsequent write of up to that many slots non-blocking —
+        multi-channel fan-out callers use it to avoid partially-published
+        frames (dag/compiled.py execute: all input rings admitted before any
+        frame is written)."""
+        need = min(max(1, slots), self._nslots)
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         while True:
-            version, acked, _, closed = self._hdr()
+            written, read, closed = self._counters()
             if closed:
                 raise ChannelClosed(self.name)
-            if acked == version:
-                break
+            if self._nslots - (written - read) >= need:
+                return
             spins += 1
-            if spins > 1000:
-                time.sleep(0.0005)
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"channel {self.name} writer stalled "
+            _backoff(spins)
+            if (deadline is not None and not spins & 63
+                    and time.monotonic() > deadline):
+                raise TimeoutError(f"channel {self.name} ring full "
                                    "(reader not consuming)")
-        self._set_version(version + 1)  # odd: write in progress
-        self._shm.buf[HEADER_SIZE:HEADER_SIZE + len(payload)] = payload
-        self._set_len(len(payload))
-        self._set_version(version + 2)  # even: sealed
 
     # --------------------------------------------------------------- read
     def read(self, last_version: int = 0,
              timeout: float | None = 30.0) -> tuple[int, bytes]:
-        """Blocks for a version newer than `last_version`; returns
-        (version, payload) and acks it (unblocking the writer)."""
+        """Blocks for the next frame; returns (version, payload) where
+        version is the monotonically increasing consumed-frame count. A
+        chunked frame is reassembled across slots before returning."""
+        version, view = self.read_view(last_version, timeout)
+        return version, bytes(view)
+
+    def read_view(self, last_version: int = 0,
+                  timeout: float | None = 30.0) -> "tuple[int, memoryview]":
+        """Like read(), but the payload lands in this channel object's
+        internal scratch buffer and a memoryview of it is returned — no
+        per-frame bytes() allocation on the hot loop (compiled-graph exec
+        loops deserialize straight from the view). The view is valid only
+        until the NEXT read/read_view call on this object.
+
+        ``last_version`` makes retries idempotent for THIS reader object: if
+        it predates the most recent frame this object consumed, that frame
+        is re-delivered from the scratch cache instead of skipping ahead —
+        a caller whose wait timed out while the read had already consumed
+        the frame (the wire bridge's client-side deadline racing the reply)
+        retries without losing a result."""
+        if last_version < self._consumed_version:
+            return (self._consumed_version,
+                    memoryview(self._scratch)[:self._consumed_len])
         deadline = None if timeout is None else time.monotonic() + timeout
+        total = 0
+        while True:
+            try:
+                version, n, more = self._read_chunk(deadline, total)
+            except TimeoutError:
+                if total == 0:
+                    raise  # idle poll: nothing consumed, safe to retry
+                # TIMEOUT-ATOMICITY: chunks already consumed (and their ring
+                # slots re-usable by the writer) cannot be un-read; bailing
+                # would hand the frame's remainder to the next read_view as
+                # a bogus fresh frame. Poison the channel instead.
+                self.close_channel()
+                raise ChannelClosed(
+                    f"channel {self.name} poisoned: reader stalled mid-frame "
+                    f"({total} bytes consumed)") from None
+            total += n
+            if not more:
+                self._consumed_version, self._consumed_len = version, total
+                return version, memoryview(self._scratch)[:total]
+            # continuation chunks: fresh generous frame deadline (see write)
+            if deadline is not None:
+                deadline = time.monotonic() + default_timeout()
+
+    def _read_chunk(self, deadline, dst_off: int) -> tuple[int, int, int]:
         spins = 0
         while True:
-            version, _, n, closed = self._hdr()
-            if version > last_version and version % 2 == 0:
-                payload = bytes(self._shm.buf[HEADER_SIZE:HEADER_SIZE + n])
-                v2 = self._hdr()[0]
-                if v2 == version:  # seqlock: unchanged during our copy
-                    self._set_acked(version)
-                    return version, payload
-                continue  # torn read: retry
+            written, read, closed = self._counters()
+            if written > read:
+                break
             if closed:
                 raise ChannelClosed(self.name)
             spins += 1
-            if spins > 1000:
-                time.sleep(0.0005)
-            if deadline is not None and time.monotonic() > deadline:
+            _backoff(spins)
+            if (deadline is not None and not spins & 63
+                    and time.monotonic() > deadline):
                 raise TimeoutError(f"channel {self.name} reader timed out")
+        off = self._slot_off(read)
+        n, more = _SLOT.unpack_from(self._shm.buf, off)
+        need = dst_off + n
+        if len(self._scratch) < need:
+            # REPLACE the scratch rather than resize it: a view returned by
+            # the previous read_view may still be alive in the caller
+            # (exported buffers cannot be re-sized)
+            grown = bytearray(max(need, 2 * len(self._scratch)))
+            grown[:dst_off] = self._scratch[:dst_off]
+            self._scratch = grown
+        src = off + SLOT_HEADER
+        self._scratch[dst_off:dst_off + n] = self._shm.buf[src:src + n]
+        self._set_read(read + 1)  # frees the slot for the writer
+        return read + 1, n, more
 
     # ---------------------------------------------------------- lifecycle
     def close_channel(self) -> None:
         """Mark closed (wakes both ends with ChannelClosed)."""
         try:
-            struct.pack_into("<I", self._shm.buf, 24, 1)
+            struct.pack_into("<I", self._shm.buf, 16, 1)
         except (ValueError, TypeError):
             pass
 

@@ -19,6 +19,7 @@ from typing import Any, Callable, Optional
 
 import ray_tpu
 from ray_tpu.core.api import ActorHandle
+from ray_tpu.dag.compiled import ResultBufferDriver as _ResultBufferDriver
 
 
 class DAGNode:
@@ -53,12 +54,21 @@ class DAGNode:
         raise NotImplementedError
 
     def experimental_compile(self, channel: str | None = None):
-        """Reference: dag_node.py:283. ``channel="shm"`` runs a function-node
-        pipeline in a dedicated worker process fed by mutable shm channels
-        (no per-execute RPC; core/shm_channel.py) — the compiled-graph
-        data-plane the reference builds on mutable plasma objects."""
+        """Reference: dag_node.py:283. An ACTOR-METHOD DAG compiles into a
+        true compiled graph (dag/compiled.py): a static per-actor schedule
+        over pre-negotiated shm/wire channels with resident exec loops —
+        zero control-plane round trips per execute at steady state. Other
+        shapes (function nodes, collectives) keep the legacy driver-thread
+        CompiledDAG; ``channel="shm"`` runs a function-node pipeline in a
+        dedicated worker process fed by mutable shm channels
+        (core/shm_channel.py)."""
         if channel == "shm":
             return ShmCompiledDAG(self)
+        from ray_tpu.dag.compiled import try_compile_actor_dag
+
+        compiled = try_compile_actor_dag(self)
+        if compiled is not None:
+            return compiled
         return CompiledDAG(self)
 
 
@@ -146,9 +156,16 @@ class CompiledDAG:
             try:
                 # same topological evaluation DAGNode.execute uses, with a fresh
                 # per-execution cache (the static schedule is the memoized walk)
-                self._results[seq].put(("ok", self._output._exec({}, input_args)))
+                self._publish(seq, ("ok", self._output._exec({}, input_args)))
             except BaseException as e:  # noqa: BLE001
-                self._results[seq].put(("err", e))
+                self._publish(seq, ("err", e))
+
+    def _publish(self, seq: int, result: tuple) -> None:
+        # teardown may have cleared/failed this slot concurrently — the
+        # publish must tolerate that instead of KeyError-ing the daemon
+        q = self._results.get(seq)
+        if q is not None:
+            q.put(result)
 
     def get(self, seq: int, timeout: float | None = None):
         q = self._results[seq]
@@ -164,17 +181,22 @@ class CompiledDAG:
 
     def teardown(self) -> None:
         self._running = False
+        # join the driver: after this no daemon thread can race the drain
+        # below (it exits within its 0.2s queue-poll window)
+        self._driver.join(timeout=5)
         # fail anything still queued or un-fetched so get() never hangs
         err = RuntimeError("CompiledDAG torn down before this execution completed")
         try:
             while True:
                 seq, _ = self._in_q.get_nowait()
-                self._results[seq].put(("err", err))
+                q = self._results.get(seq)
+                if q is not None:
+                    q.put(("err", err))
         except queue.Empty:
             pass
 
 
-class ShmCompiledDAG:
+class ShmCompiledDAG(_ResultBufferDriver):
     """Function pipeline on a persistent worker process, driven through two
     mutable shm channels (reference: compiled graphs over shared-memory
     channels, experimental/channel/shared_memory_channel.py). Per-execute
@@ -182,8 +204,10 @@ class ShmCompiledDAG:
 
     A drain thread continuously acks the output channel into a result buffer,
     so the worker never blocks on un-fetched results and any number of
-    executes may be in flight (execute() itself only waits for the worker to
-    pick up the previous input — the natural depth-2 pipeline backpressure)."""
+    executes may be in flight (execute() blocks only while the input ring is
+    full — in-flight work is bounded by the channel's slot count)."""
+
+    _desc = "shm DAG"
 
     def __init__(self, output_node: DAGNode, channel_capacity: int = 1 << 20):
         import subprocess
@@ -192,10 +216,13 @@ class ShmCompiledDAG:
         import cloudpickle
 
         from ray_tpu.core.process_pool import worker_env
-        from ray_tpu.core.shm_channel import ShmChannel
+        from ray_tpu.core.shm_channel import ShmChannel, default_timeout
 
         self._in_ch = ShmChannel(capacity=channel_capacity)
         self._out_ch = ShmChannel(capacity=channel_capacity)
+        # one knob for every compiled-graph channel wait
+        # (env RAY_TPU_DAG_CHANNEL_TIMEOUT_S, default 60s)
+        self._timeout = default_timeout()
         self._proc = None
         try:
             self._proc = subprocess.Popen(
@@ -203,7 +230,8 @@ class ShmCompiledDAG:
                  self._in_ch.name, self._out_ch.name],
                 env=worker_env(),
             )
-            self._in_ch.write(cloudpickle.dumps(output_node), timeout=60.0)
+            self._in_ch.write(cloudpickle.dumps(output_node),
+                              timeout=self._timeout)
         except BaseException:
             # nothing reaches the caller: clean up or the segments +
             # subprocess leak with no handle to teardown()
@@ -212,15 +240,11 @@ class ShmCompiledDAG:
             self._in_ch.destroy()
             self._out_ch.destroy()
             raise
-        self._seq = 0
-        self._buffer: dict[int, tuple] = {}
-        self._cond = threading.Condition()  # guards _buffer/_dead ONLY
-        # separate lock for seq allocation + input write: holding _cond
-        # across a (possibly blocking) channel write would starve the drain
-        # thread and deadlock the pipeline (worker can't publish results)
-        self._exec_lock = threading.Lock()
-        self._running = True
-        self._dead: str | None = None
+        # _exec_lock (from the shared driver) serializes seq allocation +
+        # input write: holding _cond across a (possibly blocking) channel
+        # write would starve the drain thread and deadlock the pipeline
+        # (worker can't publish results)
+        self._init_result_buffer()
         self._drain = threading.Thread(target=self._drain_loop, daemon=True)
         self._drain.start()
 
@@ -235,23 +259,22 @@ class ShmCompiledDAG:
         while self._running:
             try:
                 last, frame = self._out_ch.read(last, timeout=0.5)
+                # loads stays INSIDE the try: an undeserializable frame must
+                # flag the DAG dead, not silently kill this thread
+                got_seq, status, payload = cloudpickle.loads(frame)
             except TimeoutError:
                 if self._proc.poll() is not None:
-                    with self._cond:
-                        self._dead = (f"shm DAG worker died "
-                                      f"(rc={self._proc.returncode})")
-                        self._cond.notify_all()
+                    self._mark_dead(f"shm DAG worker died "
+                                    f"(rc={self._proc.returncode})")
                     return
                 continue
             except ChannelClosed:
-                with self._cond:
-                    self._dead = "shm DAG channel closed"
-                    self._cond.notify_all()
+                self._mark_dead("shm DAG channel closed")
                 return
-            got_seq, status, payload = cloudpickle.loads(frame)
-            with self._cond:
-                self._buffer[got_seq] = (status, payload)
-                self._cond.notify_all()
+            except BaseException as e:  # noqa: BLE001 — never die silently
+                self._mark_dead(f"shm DAG drain failed: {e!r}")
+                return
+            self._publish_result(got_seq, status, payload)
 
     def execute(self, *input_args) -> "CompiledDAGRef":
         import cloudpickle
@@ -263,28 +286,13 @@ class ShmCompiledDAG:
                 raise RuntimeError(self._dead)
         with self._exec_lock:
             seq = self._seq
-            # blocks only until the worker picks up the PREVIOUS input
-            self._in_ch.write(cloudpickle.dumps((seq, input_args)), timeout=60.0)
+            # blocks only while the input ring is full (bounded in-flight)
+            self._in_ch.write(cloudpickle.dumps((seq, input_args)),
+                              timeout=self._timeout)
             self._seq += 1  # incremented only after the frame is really sent
         return CompiledDAGRef(self, seq)
 
-    def get(self, seq: int, timeout: float | None = None):
-        deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cond:
-            while seq not in self._buffer:
-                if self._dead:
-                    raise RuntimeError(self._dead)
-                remaining = (None if deadline is None
-                             else max(0.0, deadline - time.monotonic()))
-                if remaining == 0.0 or not self._cond.wait(timeout=remaining):
-                    if seq in self._buffer or self._dead:
-                        continue
-                    raise TimeoutError(
-                        f"shm DAG execution {seq} did not finish in {timeout}s")
-            status, payload = self._buffer.pop(seq)
-        if status == "err":
-            raise payload
-        return payload
+    # get() inherited from _ResultBufferDriver (dag/compiled.py)
 
     def teardown(self) -> None:
         self._running = False
@@ -294,8 +302,14 @@ class ShmCompiledDAG:
             self._proc.wait(timeout=5)
         except Exception:
             self._proc.kill()
+        # join the drain BEFORE unmapping the segments it may be mid-read on
+        # (the closed flag above wakes it within its 0.5s poll window)
+        self._drain.join(timeout=5)
         self._in_ch.destroy()
         self._out_ch.destroy()
+        # the drain may have exited on the _running flag without marking
+        # death — fail un-fetched refs explicitly so get() never hangs
+        self._mark_dead("ShmCompiledDAG torn down")
 
 
 class CollectiveOutputNode(DAGNode):

@@ -1,0 +1,472 @@
+"""Compiled actor graphs: static schedules over pre-negotiated channels.
+
+Parity: the reference's Compiled Graphs (python/ray/dag/compiled_dag_node.py
++ experimental/channel/) — ``experimental_compile()`` on an actor-method DAG
+topo-sorts it ONCE into a fixed per-actor operation schedule, negotiates one
+channel per DAG edge up front, and installs a resident execution loop in
+every participating actor (``dag/exec_loop.py``). After compilation,
+``execute()`` is one input-channel write and ``ref.get()`` one output-channel
+read: **zero control-plane round trips at steady state** — the Podracer
+shape (arXiv 2104.06272) of long-lived actor fleets driven by data instead
+of per-call RPC dispatch (the original Ray task model, arXiv 1712.05889).
+
+Channel kinds per edge:
+- same-node (everything reachable over the head host's shm): a
+  ``core/shm_channel.py`` seqlock channel — one mapped segment per edge.
+- driver edges of a REMOTE driver (``ray_tpu.init(address=...)``): a
+  persistent wire channel over the client's control-plane connection, whose
+  read side answers with raw BLOB frames (the PR-5 zero-copy sendmsg path).
+  Peers that negotiated a pre-v4 wire cannot install graphs; compilation
+  falls back to legacy RPC dispatch with a warning instead of crashing.
+
+Lifecycle: bind -> experimental_compile (analyze + dag_install: channel
+creation + loop install) -> execute/get over channels -> teardown (channels
+closed + destroyed, loops exit, actors keep serving normal RPC calls).
+An actor dying mid-loop closes its channels; the close cascades edge-by-edge
+through every loop and the driver, so in-flight ``execute()``s raise instead
+of hanging.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from ray_tpu.core.shm_channel import ChannelClosed, default_timeout
+from ray_tpu.dag.exec_loop import CHAN, CONST, SLOT, ActorPlan, OpStep
+
+logger = logging.getLogger("ray_tpu")
+
+CHANNEL_BYTES_ENV = "RAY_TPU_DAG_CHANNEL_BYTES"
+
+
+def _channel_capacity() -> int:
+    try:
+        return int(os.environ.get(CHANNEL_BYTES_ENV, str(1 << 20)))
+    except ValueError:
+        return 1 << 20
+
+
+class GraphSpec:
+    """The install payload shipped to the runtime (picklable)."""
+
+    def __init__(self, graph_id: bytes, plans: list, all_chans: list,
+                 input_chans: list, output_chan: int, capacity: int):
+        self.graph_id = graph_id
+        self.plans = plans
+        self.all_chans = all_chans
+        self.input_chans = input_chans
+        self.output_chan = output_chan
+        self.capacity = capacity
+
+
+class UnsupportedGraph(Exception):
+    """The DAG shape cannot compile to a static actor graph (function nodes,
+    collectives, no InputNode ancestry, ...) — callers fall back to the
+    legacy driver-thread CompiledDAG."""
+
+
+def analyze(output_node) -> GraphSpec:
+    """Topo-sort an actor-method DAG into per-actor schedules + edge list.
+
+    Raises UnsupportedGraph unless every non-input node is a
+    ``ClassMethodNode`` and every method node transitively consumes the
+    InputNode (a source with no input ancestry would run unthrottled)."""
+    from ray_tpu.dag import ClassMethodNode, DAGNode, InputNode
+
+    order: list = []
+    seen: set = set()
+
+    def visit(n):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for d in n._deps():
+            visit(d)
+        order.append(n)
+
+    visit(output_node)
+
+    methods = [n for n in order if isinstance(n, ClassMethodNode)]
+    if not methods or not isinstance(output_node, ClassMethodNode):
+        raise UnsupportedGraph("not an actor-method DAG")
+    for n in order:
+        if not isinstance(n, (ClassMethodNode, InputNode)):
+            raise UnsupportedGraph(
+                f"unsupported node type {type(n).__name__} in actor graph")
+
+    # input ancestry: every method node must be throttled by the driver input
+    reaches_input: set = set()
+    for n in order:  # order is topological: deps appear first
+        if isinstance(n, InputNode):
+            reaches_input.add(id(n))
+        elif any(id(d) in reaches_input for d in n._deps()):
+            reaches_input.add(id(n))
+    for n in methods:
+        if id(n) not in reaches_input:
+            raise UnsupportedGraph(
+                f"method node {n._method_name!r} does not depend on "
+                "InputNode (unthrottled source)")
+
+    # resident loops invoke methods synchronously — async/generator methods
+    # would yield un-awaited coroutines/generators into the channels; those
+    # DAGs keep the legacy RPC-dispatch driver, which handles them
+    import inspect
+
+    for n in methods:
+        fn = getattr(n._handle._cls, n._method_name, None)
+        if fn is not None and (
+                inspect.iscoroutinefunction(fn)
+                or inspect.isgeneratorfunction(fn)
+                or inspect.isasyncgenfunction(fn)):
+            raise UnsupportedGraph(
+                f"method {n._method_name!r} is async/generator — compiled "
+                "loops call methods synchronously")
+
+    node_idx = {id(n): i for i, n in enumerate(methods)}
+    actor_of = {id(n): n._handle._actor_id.binary() for n in methods}
+
+    next_chan = [0]
+
+    def new_chan() -> int:
+        next_chan[0] += 1
+        return next_chan[0] - 1
+
+    # per-(producer, consumer) channels; per-consumer input channels
+    edge_chan: dict = {}
+    input_chan: dict = {}
+    out_chans: dict = {i: [] for i in range(len(methods))}
+    keep_slot: set = set()
+    input_ids: list = []
+
+    def template(consumer, arg):
+        if not isinstance(arg, DAGNode):
+            return (CONST, arg)
+        if isinstance(arg, InputNode):
+            key = id(consumer)
+            if key not in input_chan:
+                input_chan[key] = new_chan()
+                input_ids.append(input_chan[key])
+            return (CHAN, input_chan[key])
+        pidx = node_idx[id(arg)]
+        if actor_of[id(arg)] == actor_of[id(consumer)]:
+            keep_slot.add(pidx)
+            return (SLOT, pidx)
+        key = (id(arg), id(consumer))
+        if key not in edge_chan:
+            edge_chan[key] = new_chan()
+            out_chans[pidx].append(edge_chan[key])
+        return (CHAN, edge_chan[key])
+
+    steps: dict = {}
+    for n in methods:
+        i = node_idx[id(n)]
+        args = tuple(template(n, a) for a in n._bound_args)
+        kwargs = {k: template(n, v) for k, v in n._bound_kwargs.items()}
+        steps[i] = (n, args, kwargs)
+
+    output_chan = new_chan()
+    out_chans[node_idx[id(output_node)]].append(output_chan)
+
+    # group steps per actor, preserving global topological order
+    plans: dict = {}
+    for n in methods:
+        i = node_idx[id(n)]
+        abin = actor_of[id(n)]
+        _, args, kwargs = steps[i]
+        read = [t[1] for t in args if t[0] == CHAN]
+        read += [t[1] for t in kwargs.values() if t[0] == CHAN]
+        op = OpStep(node_idx=i, method=n._method_name, args=args,
+                    kwargs=kwargs, out_chans=tuple(out_chans[i]),
+                    keep_slot=(i in keep_slot))
+        plan = plans.setdefault(abin, {"steps": [], "read": []})
+        plan["steps"].append(op)
+        plan["read"].extend(read)
+
+    actor_plans = [
+        ActorPlan(actor_bin=abin, steps=tuple(p["steps"]),
+                  read_chans=tuple(dict.fromkeys(p["read"])))
+        for abin, p in plans.items()
+    ]
+    return GraphSpec(
+        graph_id=os.urandom(8),
+        plans=actor_plans,
+        all_chans=list(range(next_chan[0])),
+        input_chans=input_ids,
+        output_chan=output_chan,
+        capacity=_channel_capacity(),
+    )
+
+
+class _WireShim:
+    """Adapter giving wire driver-channels the read_view/write surface the
+    driver uses for shm channels."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def write(self, blob, timeout=None):
+        self._inner.write(bytes(blob), timeout)
+
+    def read_view(self, last, timeout=None):
+        # the caller's poll timeout is NOT forwarded: a wire read abandoned
+        # mid-flight would lose the frame the server already consumed — the
+        # wire channel owns its own (bounded) long-poll window
+        ver, payload = self._inner.read(last)
+        return ver, memoryview(payload)
+
+    def close_channel(self):
+        pass
+
+    def detach(self):
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
+
+
+class ResultBufferDriver:
+    """Shared driver half of a compiled graph handle: a background drain
+    buffers (seq, status, payload) results as they land so the producing
+    end never stalls on un-fetched outputs, and ``get()`` waits on the
+    buffer. ``CompiledActorDAG`` and ``ShmCompiledDAG`` (dag/__init__.py)
+    both ride this — one implementation of the seq/buffer/death protocol."""
+
+    _desc = "compiled DAG"
+
+    def _init_result_buffer(self) -> None:
+        self._seq = 0
+        self._buffer: dict = {}
+        self._cond = threading.Condition()  # guards _buffer/_dead
+        self._exec_lock = threading.Lock()
+        self._running = True
+        self._dead: str | None = None
+
+    def _publish_result(self, seq: int, status: str, payload) -> None:
+        with self._cond:
+            self._buffer[seq] = (status, payload)
+            self._cond.notify_all()
+
+    def _mark_dead(self, message: str, *, only_if_running: bool = False) -> None:
+        with self._cond:
+            if self._dead is None and not (only_if_running
+                                           and not self._running):
+                self._dead = message
+            self._cond.notify_all()
+
+    def get(self, seq: int, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while seq not in self._buffer:
+                if self._dead:
+                    raise RuntimeError(self._dead)
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                if remaining == 0.0 or not self._cond.wait(timeout=remaining):
+                    if seq in self._buffer or self._dead:
+                        continue
+                    raise TimeoutError(
+                        f"{self._desc} execution {seq} did not finish in "
+                        f"{timeout}s")
+            status, payload = self._buffer.pop(seq)
+        if status == "err":
+            raise payload
+        return payload
+
+
+class CompiledActorDAG(ResultBufferDriver):
+    """Driver handle for an installed compiled actor graph."""
+
+    _desc = "compiled actor DAG"
+
+    def __init__(self, spec: GraphSpec):
+        import cloudpickle
+
+        rt = _get_runtime()
+        self._spec = spec
+        self._timeout = default_timeout()
+        res = rt.dag_install(cloudpickle.dumps(self._spec))
+        self.graph_id = res["graph"]
+        self._rt = rt
+        try:
+            if res.get("wire"):
+                self._in_chs = [
+                    _WireShim(rt.dag_wire_in(self.graph_id, cid))
+                    for cid in self._spec.input_chans
+                ]
+                self._out_ch = _WireShim(
+                    rt.dag_wire_out(self.graph_id, self._spec.output_chan))
+            else:
+                # local driver shares the runtime's channel objects (one
+                # writer/reader per end still holds: the driver is the only
+                # writer of input edges and the only reader of the output)
+                live = rt.dag_channels(self.graph_id)
+                self._in_chs = [live[cid] for cid in self._spec.input_chans]
+                self._out_ch = live[self._spec.output_chan]
+        except BaseException:
+            rt.dag_teardown(self.graph_id)
+            raise
+        self._init_result_buffer()
+        self._drain = threading.Thread(
+            target=self._drain_loop, daemon=True,
+            name=f"ray_tpu-dag-drain-{self.graph_id.hex()[:8]}")
+        self._drain.start()
+
+    # -------------------------------------------------------------- driver
+    def _drain_loop(self) -> None:
+        """Ack every result frame as it lands so the terminal actor never
+        stalls on un-fetched outputs; flag graph death promptly."""
+        import cloudpickle
+
+        last = 0
+        while self._running:
+            try:
+                last, view = self._out_ch.read_view(last, timeout=0.5)
+                # loads stays INSIDE the try: an undeserializable frame
+                # (e.g. a worker-only exception class) must flag the graph
+                # dead, not silently kill this thread and hang every get()
+                seq, status, payload = cloudpickle.loads(view)
+            except TimeoutError:
+                continue
+            except (ChannelClosed, ConnectionError) as e:
+                self._mark_dead(
+                    "compiled DAG channels closed (actor died or graph "
+                    f"torn down): {e}", only_if_running=True)
+                return
+            except BaseException as e:  # noqa: BLE001 — never die silently
+                self._mark_dead(f"compiled DAG drain failed: {e!r}")
+                return
+            self._publish_result(seq, status, payload)
+
+    def execute(self, *input_args) -> "CompiledDAGRef":
+        import cloudpickle
+
+        from ray_tpu.dag import CompiledDAGRef
+
+        if not self._running:
+            raise RuntimeError(
+                "CompiledActorDAG was torn down; re-compile to execute again")
+        with self._cond:
+            if self._dead:
+                raise RuntimeError(self._dead)
+        value = input_args[0] if len(input_args) == 1 else input_args
+        with self._exec_lock:
+            seq = self._seq
+            blob = cloudpickle.dumps((seq, "ok", value))
+            if len(self._in_chs) > 1:
+                # fan-out pre-admission: wait until EVERY input ring can
+                # take the whole frame before publishing anything — a
+                # healthy-but-slow branch then surfaces as a clean
+                # retryable TimeoutError instead of a partially-published
+                # frame (which would have to poison the graph). The driver
+                # is each ring's sole writer, so admission can't be raced
+                # away. (Frames bigger than a whole ring still need reader
+                # progress mid-write; the channel's mid-frame poison stays
+                # the backstop for that case.)
+                for ch in self._in_chs:
+                    wait = getattr(ch, "wait_writable", None)
+                    if wait is not None:
+                        try:
+                            wait(timeout=self._timeout,
+                                 slots=ch.slots_for(len(blob)))
+                        except ChannelClosed as e:
+                            raise RuntimeError(
+                                "compiled DAG input channel closed (actor "
+                                f"died or graph torn down): {e}") from e
+            wrote = 0
+            try:
+                for ch in self._in_chs:
+                    # blocks only while that edge's ring is full (bounded
+                    # in-flight = channel slots, the pipeline backpressure)
+                    ch.write(blob, timeout=self._timeout)
+                    wrote += 1
+            except BaseException as e:
+                if wrote:
+                    # PARTIAL FAN-OUT: earlier input channels hold a frame
+                    # for a seq that will never be accounted for — from now
+                    # on the fan-in would pair payloads from DIFFERENT
+                    # executions. The graph is unrecoverable: poison it so
+                    # every end fails loudly instead of computing garbage.
+                    self._poison(
+                        f"input fan-out failed after {wrote}/"
+                        f"{len(self._in_chs)} channels (seq {seq}): {e!r}")
+                if isinstance(e, (ChannelClosed, ConnectionError)):
+                    raise RuntimeError(
+                        "compiled DAG input channel closed (actor died or "
+                        f"graph torn down): {e}") from e
+                raise
+            self._seq += 1  # only after every input frame really landed
+        return CompiledDAGRef(self, seq)
+
+    def _poison(self, message: str) -> None:
+        """Mark the graph dead and cascade channel closure (best effort)."""
+        self._mark_dead(message)
+        for ch in list(self._in_chs) + [self._out_ch]:
+            try:
+                ch.close_channel()
+            except Exception:
+                pass
+        try:
+            # closes the head-side channels too (wire drivers can't reach
+            # them directly); idempotent with a later user teardown()
+            self._rt.dag_teardown(self.graph_id)
+        except Exception:
+            pass
+
+    def teardown(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        # flag the channels closed before destroying them so the drain (and
+        # any racing execute) exits on ChannelClosed, not on a torn mapping
+        for ch in self._in_chs:
+            ch.close_channel()
+        self._out_ch.close_channel()
+        wire = isinstance(self._out_ch, _WireShim)
+        if not wire:
+            # shm drain wakes on the closed flag — park it BEFORE the
+            # runtime unmaps the segments underneath it
+            self._drain.join(timeout=5)
+        try:
+            self._rt.dag_teardown(self.graph_id)
+        except Exception:
+            pass
+        if wire:
+            # the wire drain unblocks when the head reaps the graph
+            self._drain.join(timeout=5)
+        with self._cond:
+            if self._dead is None:
+                self._dead = "CompiledActorDAG torn down"
+            self._cond.notify_all()
+        # shm objects are the runtime's (dag_teardown destroyed them); only
+        # wire shims have driver-side state to release
+        for ch in list(self._in_chs) + [self._out_ch]:
+            if isinstance(ch, _WireShim):
+                ch.detach()
+
+
+def _get_runtime():
+    from ray_tpu.core.runtime import get_runtime
+
+    return get_runtime()
+
+
+def try_compile_actor_dag(output_node):
+    """Compile ``output_node`` into a CompiledActorDAG, or return None when
+    the graph/peer cannot support one (caller falls back to the legacy
+    driver-thread CompiledDAG — plain RPC dispatch)."""
+    from ray_tpu.core.rpc.schema import WireVersionError
+
+    try:
+        spec = analyze(output_node)
+    except UnsupportedGraph as e:
+        logger.debug("experimental_compile: %s; using RPC-dispatch driver", e)
+        return None
+    try:
+        return CompiledActorDAG(spec)
+    except (WireVersionError, NotImplementedError) as e:
+        logger.warning(
+            "experimental_compile: compiled-graph install unavailable (%s); "
+            "falling back to per-call RPC dispatch", e)
+        return None

@@ -1,0 +1,183 @@
+"""Compiled-graph actor-side execution: a resident loop over a static plan.
+
+Parity: the reference's ``do_exec_tasks`` loop (compiled_dag_node.py:186) —
+once a DAG is compiled, every participating actor runs a FIXED schedule of
+operations per execution, fed and drained by preallocated channels. At steady
+state this module makes **zero control-plane calls**: no ``.remote()``, no
+RPC ``call``/``notify``, no task submission — the only cross-actor traffic is
+shm ring-channel reads/writes (``core/shm_channel.py``). That property is
+pinned by ``scripts/check_wire_schemas.py::check_dag_loop_steady_state``.
+
+The plan dataclasses live here (not in ``ray_tpu.dag``) so dedicated actor
+worker processes can import them without pulling the full public API in.
+
+Frame protocol on every channel: ``cloudpickle.dumps((seq, status, payload))``
+with status ``"ok"`` or ``"err"``. An error input short-circuits the step and
+is FORWARDED downstream, so one failing execution surfaces at the driver
+without desynchronizing the pipeline; the loop itself stays alive for the
+next execution. A closed channel (teardown, actor death) ends the loop and
+closes every channel the plan touches, cascading the shutdown through the
+graph so no end ever hangs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ray_tpu.core.shm_channel import ChannelClosed
+
+# Argument templates (picklable, interpreted per step):
+CONST = "const"   # ("const", value)           literal bound at .bind() time
+CHAN = "chan"     # ("chan", chan_id)          read from a channel this step
+SLOT = "slot"     # ("slot", node_idx)         same-actor upstream result
+
+
+@dataclass
+class OpStep:
+    """One scheduled method execution on this plan's actor."""
+
+    node_idx: int
+    method: str
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+    out_chans: tuple = ()   # channel ids the result frame is published to
+    keep_slot: bool = False  # a later same-actor step consumes the result
+
+
+@dataclass
+class ActorPlan:
+    """The static per-actor schedule: steps in topological order plus the
+    channel ids this actor reads. One plan per participating actor."""
+
+    actor_bin: bytes
+    steps: tuple = ()
+    read_chans: tuple = ()
+
+    def write_chans(self) -> list:
+        out = []
+        for s in self.steps:
+            out.extend(s.out_chans)
+        return out
+
+
+class _ErrorFrame(Exception):
+    """Internal: an input frame carried an upstream error."""
+
+    def __init__(self, payload):
+        self.payload = payload
+
+
+def run_plan(instance, plan: ActorPlan, channels: dict, *,
+             detach_on_exit: bool = False, step_lock=None) -> None:
+    """Drive ``instance`` through ``plan`` until the channels close.
+
+    ``channels`` maps chan_id -> ShmChannel (reader AND writer ends this
+    actor touches). Blocks the calling thread for the graph's lifetime —
+    callers run it on a dedicated thread per installed graph.
+
+    ``step_lock``: mutex shared with the actor's normal dispatch path.
+    Held ONLY around the method invocation (never across channel waits),
+    it preserves the max_concurrency=1 sequential-execution guarantee when
+    ``.remote()`` calls — or a second installed graph — run concurrently
+    with this loop.
+    """
+    import cloudpickle
+    import contextlib
+
+    guard = step_lock if step_lock is not None else contextlib.nullcontext()
+
+    last = {cid: 0 for cid in plan.read_chans}
+    slots: dict = {}
+    try:
+        while True:
+            frames: dict = {}   # chan_id -> (seq, status, payload)
+            seq = None
+
+            def _chan_value(cid):
+                nonlocal seq
+                fr = frames.get(cid)
+                if fr is None:
+                    last[cid], view = channels[cid].read_view(
+                        last[cid], timeout=None)
+                    fr = frames[cid] = cloudpickle.loads(view)
+                if seq is None:
+                    seq = fr[0]
+                if fr[1] != "ok":
+                    raise _ErrorFrame(fr[2])
+                return fr[2]
+
+            def _resolve(t):
+                kind = t[0]
+                if kind == CONST:
+                    return t[1]
+                if kind == CHAN:
+                    return _chan_value(t[1])
+                val = slots[t[1]]  # SLOT
+                if isinstance(val, _ErrorFrame):
+                    raise val
+                return val
+
+            slots.clear()
+            for step in plan.steps:
+                status, payload = "ok", None
+                try:
+                    args = [_resolve(t) for t in step.args]
+                    kwargs = {k: _resolve(t) for k, t in step.kwargs.items()}
+                    with guard:
+                        payload = getattr(instance, step.method)(*args,
+                                                                 **kwargs)
+                except ChannelClosed:
+                    raise
+                except _ErrorFrame as ef:
+                    status, payload = "err", ef.payload
+                except BaseException as e:  # noqa: BLE001 — crosses the channel
+                    status, payload = "err", e
+                if step.keep_slot:
+                    # a later same-actor step consumes this; an error input
+                    # re-raises there so it forwards through the schedule
+                    slots[step.node_idx] = (payload if status == "ok"
+                                            else _ErrorFrame(payload))
+                if step.out_chans:
+                    try:
+                        blob = cloudpickle.dumps((seq, status, payload))
+                    except BaseException as e:  # noqa: BLE001 — unserializable
+                        blob = cloudpickle.dumps(
+                            (seq, "err",
+                             RuntimeError(f"result of {step.method} not "
+                                          f"serializable: {e!r}")))
+                    for cid in step.out_chans:
+                        channels[cid].write(blob, timeout=None)
+            # error short-circuits can leave input channels unread; consume
+            # them now so every channel advances exactly one generation per
+            # execution (the lockstep invariant the seq protocol rests on)
+            _drain_unread(plan, frames, channels, last)
+    except ChannelClosed:
+        pass
+    except BaseException:  # noqa: BLE001 — loop must never die silently:
+        # closing the channels below converts this into ChannelClosed at
+        # every other end instead of a hang — but the ROOT CAUSE must land
+        # in a log, or a production graph death leaves zero evidence
+        import logging
+
+        logging.getLogger("ray_tpu").exception(
+            "compiled-graph exec loop died; closing its channels")
+    finally:
+        for ch in channels.values():
+            try:
+                ch.close_channel()
+            except Exception:
+                pass
+            if detach_on_exit:
+                ch.detach()
+
+
+def _drain_unread(plan: ActorPlan, frames: dict, channels: dict,
+                  last: dict) -> None:
+    """Consume any input channel not yet read this execution (short-circuited
+    by an upstream error) so the graph stays in lockstep."""
+    import cloudpickle
+
+    for cid in plan.read_chans:
+        if cid not in frames:
+            last[cid], view = channels[cid].read_view(last[cid], timeout=None)
+            frames[cid] = cloudpickle.loads(view)

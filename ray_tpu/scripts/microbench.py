@@ -173,6 +173,71 @@ def bench_plane_pull(size_mb: int, holders: int = 1) -> dict:
         dst.close()
 
 
+def _dag_chain_actors(stages: int):
+    import ray_tpu
+
+    @ray_tpu.remote(isolate_process=True)  # own process per stage: the loops
+    class Stage:  # spin on shm channels without sharing the driver's GIL
+        def proc(self, x):
+            return x + 1
+
+    actors = [Stage.remote() for _ in range(stages)]
+    ray_tpu.get([a.proc.remote(0) for a in actors])  # wait ALIVE
+    return actors
+
+
+def bench_dag_steps_compiled(n: int, stages: int = 3) -> dict:
+    """Compiled actor graph: a `stages`-deep chain executed n times — per
+    step, one input-channel write + one output-channel read, ZERO
+    control-plane requests (dag/compiled.py; asserted via the rpc op counter
+    in tests/test_dag.py)."""
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    actors = _dag_chain_actors(stages)
+    with InputNode() as inp:
+        node = inp
+        for a in actors:
+            node = a.proc.bind(node)
+    compiled = node.experimental_compile()
+    try:
+        compiled.execute(0).get(timeout=60)  # warm the loops + channels
+        t0 = time.perf_counter()
+        refs = [compiled.execute(i) for i in range(n)]
+        out = [r.get(timeout=60) for r in refs]
+        dt = time.perf_counter() - t0
+        assert out[-1] == (n - 1) + stages
+    finally:
+        compiled.teardown()
+        for a in actors:
+            ray_tpu.kill(a)
+    return {"metric": "dag_steps_compiled", "value": _rate(n, dt),
+            "unit": "steps/s"}
+
+
+def bench_dag_steps_rpc_baseline(n: int, stages: int = 3) -> dict:
+    """The same chain driven the pre-compiled way: per step, one `.remote()`
+    per stage (refs chained) + one get — every hop pays control-plane
+    dispatch. The compiled/rpc ratio is the headline of ISSUE 7."""
+    import ray_tpu
+
+    actors = _dag_chain_actors(stages)
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            ref = actors[0].proc.remote(i)
+            for a in actors[1:]:
+                ref = a.proc.remote(ref)
+            out = ray_tpu.get(ref)
+        dt = time.perf_counter() - t0
+        assert out == (n - 1) + stages
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+    return {"metric": "dag_steps_rpc_baseline", "value": _rate(n, dt),
+            "unit": "steps/s"}
+
+
 def _median_of(samples: list[dict]) -> dict:
     """Collapse repeated runs of one bench into median + dispersion.
 
@@ -209,6 +274,9 @@ def run(quick: bool = False, repeats: int = 5) -> list[dict]:
         lambda: bench_actor_calls_async(100 * k),
         lambda: bench_put_gigabytes(16 * k),
         lambda: bench_get_gigabytes(16 * k),
+        # compiled actor graphs vs per-call dispatch on the same 3-actor chain
+        lambda: bench_dag_steps_compiled(200 * k),
+        lambda: bench_dag_steps_rpc_baseline(50 * k),
         # object-plane pulls over live loopback plane servers (wire v3)
         lambda: bench_plane_pull(1, 1),
         lambda: bench_plane_pull(1, 2),

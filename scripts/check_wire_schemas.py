@@ -16,7 +16,13 @@ test (tests/test_round5_fixes-style import; see test_rpc_wire.py). Asserts:
    never see a frame kind they can't decode), and no payload bytes pass
    through the msgpack packer — or a ``bytes()`` copy — on the plane
    chunk path (codec.blob_header packs lengths only; peer send is
-   sendmsg-by-reference, receive is recv_into).
+   sendmsg-by-reference, receive is recv_into);
+5. the compiled-graph steady-state contract: the actor-side exec loop
+   (``ray_tpu/dag/exec_loop.py``) makes NO control-plane calls — no
+   ``.remote()``, no rpc ``call``/``notify``, no task submission, no rpc
+   imports — channels only; and the ``dag_*`` ops are version-gated
+   (since>=4) so an old-wire peer negotiates down to RPC dispatch instead
+   of receiving frames it cannot decode.
 
 When you ADD an op: give it the next free number, bump WIRE_VERSION if the
 op must be gated, run this lint, then extend the baseline in the same PR.
@@ -50,6 +56,9 @@ SCHEMA_BASELINE = {
     "xl_kill_actor": 48, "xl_list_funcs": 49, "kv_get": 50,
     # ISSUE-5 (wire v3): bulk data plane
     "obj_chunk_raw": 51,
+    # ISSUE-7 (wire v4): compiled actor graphs
+    "dag_install": 52, "dag_teardown": 53, "dag_ch_write": 54,
+    "dag_ch_read": 55,
 }
 
 # Files whose handler tables must be fully schema'd.
@@ -121,7 +130,7 @@ _NON_OPS = {
     "load1", "mem_total_mb", "mem_available_mb", "agent_rss_mb",
     "workers_alive", "store_used_mb", "store_cap_mb", "num_returns",
     "max_retries", "retry_exceptions", "name", "resources", "runtime_env",
-    "isolate_process", "peer_hello",
+    "isolate_process", "peer_hello", "input_chans", "output_chan",
 }
 
 
@@ -287,11 +296,73 @@ def check_blob_zero_copy() -> list:
     return errors
 
 
+# Control-plane call names that must never appear in the compiled-graph
+# exec loop: steady state is channels only (ISSUE-7 acceptance).
+_DAG_LOOP_FORBIDDEN_CALLS = {
+    "remote", "call", "call_async", "notify", "submit_task",
+    "submit_actor_task", "create_actor",
+}
+_DAG_LOOP_FORBIDDEN_IMPORTS = (
+    "ray_tpu.core.rpc", "ray_tpu.core.runtime", "ray_tpu.core.cluster",
+    "ray_tpu.core.client_runtime", "ray_tpu.core.api",
+)
+
+
+def check_dag_loop_steady_state() -> list:
+    """The resident exec loop a compiled graph installs in each actor makes
+    zero control-plane calls at steady state — its module may touch shm
+    channels and the serializer, nothing else."""
+    errors = []
+    path = os.path.join(REPO, "ray_tpu", "dag", "exec_loop.py")
+    if not os.path.exists(path):
+        return ["ray_tpu/dag/exec_loop.py missing — compiled-graph loop gone?"]
+    tree = ast.parse(open(path).read(), filename="exec_loop.py")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            callee = node.func
+            name = (callee.attr if isinstance(callee, ast.Attribute)
+                    else callee.id if isinstance(callee, ast.Name) else None)
+            if name in _DAG_LOOP_FORBIDDEN_CALLS:
+                errors.append(
+                    f"dag/exec_loop.py:{node.lineno}: calls {name}() — the "
+                    "compiled-graph loop must be channels-only at steady "
+                    "state (no RPC, no task submission)")
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names]
+            mods.append(getattr(node, "module", "") or "")
+            for m in mods:
+                if any(m == f or m.startswith(f + ".")
+                       for f in _DAG_LOOP_FORBIDDEN_IMPORTS):
+                    errors.append(
+                        f"dag/exec_loop.py:{node.lineno}: imports {m} — the "
+                        "loop module must not link the control plane")
+    # run_plan must exist and speak the channel surface
+    fns = _find_funcs(tree, {"run_plan"})
+    if "run_plan" not in fns:
+        errors.append("dag/exec_loop.py: run_plan missing")
+    elif not _calls_in(fns["run_plan"], {"read_view", "read", "write"}):
+        errors.append("dag/exec_loop.py: run_plan no longer moves data over "
+                      "channel read/write")
+    # version gating: dag ops must be >= v4 so old peers negotiate down
+    from ray_tpu.core.rpc import schema
+
+    for op in ("dag_install", "dag_teardown", "dag_ch_write", "dag_ch_read"):
+        spec = schema.REGISTRY.get(op)
+        if spec is None:
+            errors.append(f"{op} schema missing")
+        elif spec.since < 4:
+            errors.append(f"{op} gated since={spec.since} < 4 — an old-wire "
+                          "peer must fall back to RPC dispatch, not receive "
+                          "undecodable frames")
+    return errors
+
+
 def run_all() -> None:
     errors = check_registry()
     errors += check_handlers_have_schemas()
     errors += check_no_pickle_in_rpc()
     errors += check_blob_zero_copy()
+    errors += check_dag_loop_steady_state()
     if errors:
         _fail(errors)
     from ray_tpu.core.rpc import schema

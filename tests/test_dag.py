@@ -228,3 +228,439 @@ def test_collective_validation_at_construction(ray_start_regular):
         dag.allreduce_bind([])
     with pytest.raises(ValueError, match="actor-method"):
         dag.allreduce_bind([dag.InputNode()])
+
+
+# -------------------------------------- round-3: compiled actor graphs
+# (ISSUE 7: static per-actor schedules over pre-negotiated channels —
+# reference: python/ray/dag compiled graphs + experimental/channel)
+
+@ray_tpu.remote
+class _Stage:
+    def __init__(self, k):
+        self.k = k
+
+    def proc(self, x):
+        return x + self.k
+
+    def where(self, x):
+        import os
+
+        return (os.getpid(), x + self.k)
+
+
+def _compile_chain(actors):
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        node = inp
+        for a in actors:
+            node = a.proc.bind(node)
+    return node.experimental_compile()
+
+
+def test_compiled_actor_chain_zero_control_plane():
+    """The acceptance bar: a 3-actor chain executes steps with ZERO
+    control-plane requests at steady state (asserted via the rpc/local
+    dispatch counters every .remote()/RPC call bumps)."""
+    from ray_tpu.core.rpc import opcount
+    from ray_tpu.dag.compiled import CompiledActorDAG
+
+    actors = [_Stage.remote(k) for k in (1, 10, 100)]
+    compiled = _compile_chain(actors)
+    try:
+        assert isinstance(compiled, CompiledActorDAG)
+        assert compiled.execute(0).get(timeout=60) == 111  # warm the loops
+        assert opcount.total() > 0  # the counter itself is live
+        before = opcount.snapshot()
+        refs = [compiled.execute(i) for i in range(30)]
+        assert [r.get(timeout=60) for r in refs] == [111 + i for i in range(30)]
+        assert opcount.delta(before) == {}  # steady state: channels only
+    finally:
+        compiled.teardown()
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_compiled_actor_fan_out_fan_in():
+    @ray_tpu.remote
+    class Join:
+        def join(self, x, y):
+            return (x, y)
+
+    from ray_tpu.dag import InputNode
+
+    src, l, r, j = (_Stage.remote(1), _Stage.remote(100), _Stage.remote(200),
+                    Join.remote())
+    with InputNode() as inp:
+        s = src.proc.bind(inp)
+        dag = j.join.bind(l.proc.bind(s), r.proc.bind(s))
+    compiled = dag.experimental_compile()
+    try:
+        from ray_tpu.dag.compiled import CompiledActorDAG
+
+        assert isinstance(compiled, CompiledActorDAG)
+        assert compiled.execute(0).get(timeout=60) == (101, 201)
+        assert compiled.execute(1).get(timeout=60) == (102, 202)
+    finally:
+        compiled.teardown()
+        for a in (src, l, r, j):
+            ray_tpu.kill(a)
+
+
+def test_compiled_actor_cross_process_shm_edge():
+    """A process-isolated actor on the chain: the edge crosses process
+    boundaries over the shm channel, and the resident loop runs INSIDE the
+    dedicated worker (no pipe/RPC per step)."""
+    import os
+
+    from ray_tpu.dag import InputNode
+
+    a = _Stage.remote(1)
+    b = _Stage.options(isolate_process=True).remote(10)
+    with InputNode() as inp:
+        dag = b.where.bind(a.proc.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        outs = [compiled.execute(i).get(timeout=60) for i in range(3)]
+        assert [o[1] for o in outs] == [11, 12, 13]
+        assert all(o[0] != os.getpid() for o in outs)  # ran in the worker
+    finally:
+        compiled.teardown()
+        for x in (a, b):
+            ray_tpu.kill(x)
+
+
+def test_compiled_actor_error_propagates_pipeline_survives():
+    """A method raising fails THAT execution at the driver (forwarded
+    through the channels as an error frame) without desynchronizing or
+    killing the resident loops."""
+    @ray_tpu.remote
+    class Flaky:
+        def f(self, x):
+            if x == 2:
+                raise ValueError("dag kaboom")
+            return x * 2
+
+    from ray_tpu.dag import InputNode
+
+    fl, tail = Flaky.remote(), _Stage.remote(0)
+    with InputNode() as inp:
+        dag = tail.proc.bind(fl.f.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(1).get(timeout=60) == 2
+        with pytest.raises(ValueError, match="kaboom"):
+            compiled.execute(2).get(timeout=60)
+        assert compiled.execute(3).get(timeout=60) == 6  # still in lockstep
+    finally:
+        compiled.teardown()
+        for a in (fl, tail):
+            ray_tpu.kill(a)
+
+
+def test_compiled_actor_death_mid_loop_raises_not_hangs():
+    actors = [_Stage.remote(1), _Stage.remote(2)]
+    compiled = _compile_chain(actors)
+    try:
+        assert compiled.execute(0).get(timeout=60) == 3
+        ray_tpu.kill(actors[1])
+        with pytest.raises(RuntimeError, match="closed|died|torn"):
+            # the kill cascades channel closure; every in-flight execute
+            # raises instead of hanging
+            for i in range(20):
+                compiled.execute(i).get(timeout=10)
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(actors[0])
+
+
+def test_compiled_teardown_restores_rpc_dispatch_and_recompiles():
+    actors = [_Stage.remote(1), _Stage.remote(10)]
+    compiled = _compile_chain(actors)
+    assert compiled.execute(5).get(timeout=60) == 16
+    compiled.teardown()
+    with pytest.raises(RuntimeError, match="re-compile"):
+        compiled.execute(1)
+    # actors returned to normal RPC dispatch...
+    assert ray_tpu.get(actors[0].proc.remote(1)) == 2
+    # ...and the same DAG recompiles onto fresh channels
+    recompiled = _compile_chain(actors)
+    try:
+        assert recompiled.execute(7).get(timeout=60) == 18
+    finally:
+        recompiled.teardown()
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_compiled_remote_driver_wire_channels(monkeypatch):
+    """A driver attached over the control plane (ray_tpu.init(address=...))
+    compiles the same graph: actor-to-actor edges stay head-host shm, the
+    driver's input/output edges ride persistent dag_ch_* wire channels."""
+    from ray_tpu.core.client_runtime import ClientRuntime
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dag import compiled as compiled_mod
+
+    rt = get_runtime()
+    actors = [_Stage.remote(1), _Stage.remote(10)]
+    ray_tpu.get([a.proc.remote(0) for a in actors])
+    host, port = rt.control_plane.server.address
+    client = ClientRuntime(host, port, rt.control_plane.token, None, 0)
+    monkeypatch.setattr(compiled_mod, "_get_runtime", lambda: client)
+    compiled = _compile_chain(actors)
+    try:
+        assert isinstance(compiled, compiled_mod.CompiledActorDAG)
+        assert all(isinstance(ch, compiled_mod._WireShim)
+                   for ch in compiled._in_chs)  # wire-bridged driver edges
+        refs = [compiled.execute(i) for i in range(5)]
+        assert [r.get(timeout=60) for r in refs] == [11 + i for i in range(5)]
+    finally:
+        compiled.teardown()
+        client.shutdown()
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_compiled_old_wire_peer_negotiates_down(monkeypatch, caplog):
+    """A peer that negotiated a pre-v4 wire cannot carry dag ops: the op
+    gate raises WireVersionError, and experimental_compile falls back to
+    the RPC-dispatch driver with a warning — never a crash."""
+    import logging
+
+    from ray_tpu.core import rpc as wire
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dag import CompiledDAG
+
+    rt = get_runtime()
+    # 1) the op gate itself, against the LIVE head: a v3-max client must
+    # get a clean WireVersionError for dag_install
+    host, port = rt.control_plane.server.address
+    peer = wire.connect(host, port, versions=(1, 3), name="old-driver")
+    try:
+        peer.call("hello", token=rt.control_plane.token, kind="worker",
+                  pid=0, timeout=10)
+        assert peer.negotiated_version == 3
+        with pytest.raises(wire.WireVersionError, match="dag_install"):
+            peer.call("dag_install", spec=b"x", timeout=10)
+    finally:
+        peer.close()
+    # 2) compile-level fallback: install unavailable -> legacy CompiledDAG
+    monkeypatch.setattr(
+        type(rt), "dag_install",
+        lambda self, blob: (_ for _ in ()).throw(
+            wire.WireVersionError("op 'dag_install' requires wire version 4")),
+    )
+    actors = [_Stage.remote(1), _Stage.remote(10)]
+    with caplog.at_level(logging.WARNING, logger="ray_tpu"):
+        compiled = _compile_chain(actors)
+    try:
+        assert isinstance(compiled, CompiledDAG)  # RPC-dispatch driver
+        assert any("falling back" in r.message for r in caplog.records)
+        assert compiled.execute(5).get(timeout=60) == 16  # still works
+    finally:
+        compiled.teardown()
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_shm_channel_oversized_payload_chunks_both_ends():
+    """Payloads beyond the segment capacity chunk across ring slots in BOTH
+    directions — capacity is a throughput knob, not a correctness cliff."""
+    import subprocess
+    import sys
+    import textwrap
+
+    from ray_tpu.core.shm_channel import ShmChannel
+
+    ch = ShmChannel(capacity=1 << 14, nslots=4)  # 4 KiB slots
+    echo = ShmChannel(capacity=1 << 14, nslots=4)
+    big = bytes(range(256)) * 300  # ~75 KiB >> one slot, > whole ring
+    child = subprocess.Popen([sys.executable, "-c", textwrap.dedent(f"""
+        from ray_tpu.core.shm_channel import ShmChannel
+        cin = ShmChannel(name={ch.name!r}, create=False)
+        cout = ShmChannel(name={echo.name!r}, create=False)
+        last = 0
+        for _ in range(3):
+            last, data = cin.read(last, timeout=30)
+            cout.write(data[::-1], timeout=30)
+        cin.detach(); cout.detach()
+    """)])
+    try:
+        last = 0
+        for _ in range(3):
+            ch.write(big, timeout=30)
+            last, out = echo.read(last, timeout=30)
+            assert out == big[::-1]
+        assert child.wait(timeout=30) == 0
+    finally:
+        child.kill()
+        ch.destroy()
+        echo.destroy()
+
+
+def test_shm_channel_mid_frame_timeout_poisons_not_corrupts(monkeypatch):
+    """Timeout atomicity: a caller timeout only gates the START of a frame.
+    A stall after chunks were already consumed can't be retried (the ring
+    slots are gone) — the channel poisons itself so both ends fail loudly
+    instead of fusing the remainder with the next frame."""
+    from ray_tpu.core.shm_channel import ChannelClosed, ShmChannel
+
+    monkeypatch.setenv("RAY_TPU_DAG_CHANNEL_TIMEOUT_S", "0.4")
+    ch = ShmChannel(capacity=1 << 14, nslots=4)
+    try:
+        # an idle-poll timeout consumes nothing and stays retryable
+        with pytest.raises(TimeoutError):
+            ch.read_view(0, timeout=0.1)
+        ch.write(b"ok", timeout=1)
+        v, payload = ch.read(0, timeout=1)
+        assert payload == b"ok"
+        # now strand half a frame (first chunk published, rest never comes)
+        ch._write_chunk(b"x" * 100, more=True, deadline=None)
+        with pytest.raises(ChannelClosed, match="poisoned"):
+            ch.read_view(v, timeout=0.2)
+        with pytest.raises(ChannelClosed):  # writer end is dead too
+            ch.write(b"y", timeout=0.2)
+    finally:
+        ch.destroy()
+
+
+def test_shm_channel_stale_last_redelivers_frame():
+    """A retry with a stale `last` re-delivers the most recent frame this
+    reader consumed instead of skipping ahead — what makes the wire
+    bridge's long-poll retry (client deadline racing the reply) lossless."""
+    from ray_tpu.core.shm_channel import ShmChannel
+
+    ch = ShmChannel(capacity=1 << 14)
+    try:
+        ch.write(b"a", timeout=5)
+        v1, p1 = ch.read(0, timeout=5)
+        assert p1 == b"a"
+        v2, p2 = ch.read(0, timeout=5)  # stale last: redeliver, not skip
+        assert (v2, p2) == (v1, b"a")
+        ch.write(b"b", timeout=5)
+        assert ch.read(v2, timeout=5)[1] == b"b"  # fresh last: next frame
+    finally:
+        ch.destroy()
+
+
+def test_compiled_async_method_falls_back_to_rpc_driver():
+    """Async actor methods can't run on the synchronous resident loop —
+    the DAG keeps the legacy driver (which awaits them correctly)."""
+    from ray_tpu.dag import CompiledDAG, InputNode
+
+    @ray_tpu.remote
+    class A:
+        async def proc(self, x):
+            return x + 1
+
+    a = A.remote()
+    with InputNode() as inp:
+        dag = a.proc.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert isinstance(compiled, CompiledDAG)
+        assert compiled.execute(1).get(timeout=60) == 2
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+
+
+def test_shm_compiled_teardown_never_hangs_get():
+    from ray_tpu import dag as dag_mod
+
+    @ray_tpu.remote
+    def ident(x):
+        return x
+
+    compiled = dag_mod.bind_function(
+        ident, dag_mod.InputNode()).experimental_compile(channel="shm")
+    ref = compiled.execute(1)
+    compiled.teardown()
+    try:
+        assert ref.get(timeout=10) == 1  # drained before teardown — fine
+    except RuntimeError:
+        pass  # torn down first — must RAISE, not park until the timeout
+
+
+def test_compiled_dag_teardown_joins_driver_and_tolerates_races():
+    """Satellite: legacy CompiledDAG.teardown() joins its driver thread and
+    the publish path tolerates a concurrently cleared results map."""
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.05)
+        return x
+
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = slow.bind(inp)
+    compiled = dag.experimental_compile()
+    refs = [compiled.execute(i) for i in range(4)]  # leave work in flight
+    compiled.teardown()
+    assert not compiled._driver.is_alive()  # joined, not abandoned
+    # the item in flight at teardown (and the one racing the flag) may have
+    # completed; everything still queued must FAIL, not hang or KeyError
+    with pytest.raises(RuntimeError, match="torn down"):
+        refs[-1].get(timeout=5)
+
+
+def test_dag_channel_timeout_env(monkeypatch):
+    from ray_tpu.core.shm_channel import default_timeout
+
+    monkeypatch.setenv("RAY_TPU_DAG_CHANNEL_TIMEOUT_S", "7.5")
+    assert default_timeout() == 7.5
+    actors = [_Stage.remote(1)]
+    compiled = _compile_chain(actors)
+    try:
+        assert compiled._timeout == 7.5  # plumbed into the live driver
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(actors[0])
+
+
+def test_compiled_loop_serializes_with_normal_dispatch():
+    """Resident loop steps and concurrent .remote() calls on a
+    max_concurrency=1 actor stay mutually exclusive (the actor keeps its
+    sequential-execution guarantee while a graph is installed)."""
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self, x):
+            v = self.n
+            time.sleep(0.0005)  # widen the lost-update window
+            self.n = v + 1
+            return x
+
+        def total(self):
+            return self.n
+
+    from ray_tpu.dag import InputNode
+
+    actor = Counter.remote()
+    with InputNode() as inp:
+        dag = actor.bump.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(i) for i in range(30)]
+        rpc_refs = [actor.bump.remote(0) for _ in range(30)]
+        [r.get(timeout=60) for r in refs]
+        ray_tpu.get(rpc_refs)
+        assert ray_tpu.get(actor.total.remote()) == 60  # no lost updates
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(actor)
+
+
+def test_compiled_stage_pipeline_consumer():
+    """parallel/pipeline.py's actor-stage pipeline rides compiled graphs."""
+    from ray_tpu.parallel.pipeline import CompiledStagePipeline
+
+    pipe = CompiledStagePipeline([lambda x: x + 1, lambda x: x * 2],
+                                 isolate_process=False)
+    try:
+        assert pipe.run(range(6), timeout=60) == [(i + 1) * 2
+                                                  for i in range(6)]
+    finally:
+        pipe.teardown()
