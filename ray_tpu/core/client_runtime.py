@@ -124,6 +124,41 @@ class ClientRuntime:
         from ray_tpu._private.ids import JobID
 
         self.job_id = JobID.from_random()  # worker-local; head re-keys task ids
+        # Telemetry push (wire v5): workers are where a node's plane pulls
+        # and compiled-graph channels actually run, so each worker ships its
+        # own registry + flight events to the head (reference: every process
+        # reports to the node metrics agent; here the head aggregates
+        # directly — single-controller design).
+        self._metrics_thread = threading.Thread(
+            target=self._metrics_push_loop, daemon=True,
+            name="client-metrics-push")
+        self._metrics_thread.start()
+
+    def _metrics_push_loop(self) -> None:
+        import time as _time
+
+        from ray_tpu.util import metrics as _metrics
+
+        period = float(os.environ.get("RAY_TPU_METRICS_PUSH_PERIOD_S", "2"))
+        if period <= 0:
+            return
+        cursor = 0
+        while not self.is_shutdown:
+            _time.sleep(period)
+            try:
+                peer = self._peer  # only piggyback a LIVE connection — the
+                if peer is None or peer.closed:  # pusher never dials itself
+                    continue
+                if (peer.negotiated_version or 0) < 5:
+                    # old head: since-gated op — skip this round, but keep
+                    # checking: a reconnect after a head upgrade negotiates
+                    # v5 and pushes resume (the node agent does the same)
+                    continue
+                # cursor advances only on a successful push (push_once), so
+                # a dropped notify re-ships its flight events next round
+                cursor = _metrics.push_once(peer, cursor)
+            except Exception:
+                pass  # telemetry must never take a worker down
 
     def _notify_ref(self, op: str, oid: ObjectID) -> None:
         if self.is_shutdown:
@@ -438,6 +473,8 @@ class ClientRuntime:
                 "placement groups are not supported for tasks submitted from "
                 "inside workers yet; submit PG tasks from the driver"
             )
+        from ray_tpu.util import tracing
+
         opts = {
             "num_returns": spec.num_returns,
             "max_retries": spec.max_retries,
@@ -446,6 +483,9 @@ class ClientRuntime:
             "resources": dict(spec.resources),
             "runtime_env": spec.runtime_env,
             "isolate_process": spec.isolate_process,
+            # live span context rides along so the head-side resubmission
+            # (and its worker execute span) joins THIS process's trace
+            "_trace_ctx": tracing.current_context(),
         }
         ref_bins, is_stream = self._rpc().call(
             "client_submit",
@@ -477,6 +517,11 @@ class ClientRuntime:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
                           options: dict) -> list[ObjectRef]:
+        from ray_tpu.util import tracing
+
+        tctx = tracing.current_context()
+        if tctx is not None:
+            options = {**options, "_trace_ctx": tctx}
         ref_bins = self._rpc().call(
             "client_actor_call",
             actor=actor_id.binary(), method=method_name,

@@ -133,6 +133,12 @@ class ControlPlane:
 
     def _peer_gone(self, peer: RpcPeer) -> None:
         peer.meta.pop("held_refs", None)  # release the client's borrowed refs
+        src = peer.meta.pop("metrics_source", None)
+        if src is not None:
+            # stop serving a dead process's series as if they were live
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.drop_remote_snapshot(src[0], src[1])
         # Deferred single-object gets this peer still has parked in the
         # store's ready-callback table: cancel them, or a get for an object
         # id the head never learns about leaks its callback + wire future
@@ -221,6 +227,7 @@ class ControlPlane:
             "hello": self._h_hello,
             "register_node": self._h_register_node,
             "heartbeat": self._h_heartbeat,
+            "metrics_push": self._h_metrics_push,
             "client_submit": self._h_client_submit,
             "client_get": self._h_client_get,
             "client_put": self._h_client_put,
@@ -326,6 +333,7 @@ class ControlPlane:
                 raise PermissionError("bad control-plane token")
         peer.meta["auth"] = True
         peer.meta["kind"] = msg.get("kind", "client")
+        peer.meta["pid"] = msg.get("pid")
         # Workers report which node's object plane they live on ("worker_node",
         # distinct from the agent's "node_id" meta — a worker disconnect must
         # not be mistaken for node death in _peer_gone).
@@ -437,6 +445,41 @@ class ControlPlane:
                 # (reference: reporter agent -> GcsNodeResourceInfo)
                 self.runtime.node_stats[nid] = {**stats, "ts": time.time()}
         return True
+
+    def _h_metrics_push(self, peer: RpcPeer, msg: dict):
+        """Telemetry plane (v5): a node agent or worker ships its metrics
+        registry + new flight-recorder events; the head merges both under
+        the sender's node id so /metrics is a true cluster scrape and
+        util/state.node_io_view() has a per-node signal (reference: the
+        per-node metrics agent -> cluster Prometheus view, SURVEY §5.5)."""
+        from ray_tpu.util import flight_recorder
+        from ray_tpu.util import metrics as _metrics
+
+        nid = peer.meta.get("node_id") or peer.meta.get("worker_node")
+        if nid is not None:
+            node_hex = nid.hex()
+        elif peer.is_same_host():
+            # head-host worker (shared plane, no node id): its I/O is this
+            # machine's I/O
+            node_hex = "head"
+        else:
+            # node-less remote peer (a driver via init(address=...)): its
+            # traffic flows on ITS machine — attributing it to "head" would
+            # inflate the head row of node_io_view with foreign bandwidth
+            node_hex = f"client:{peer.remote_host or 'unknown'}"
+        source = f"{peer.meta.get('kind', 'client')}-" \
+                 f"{peer.meta.get('pid') or id(peer)}"
+        peer.meta["metrics_source"] = (node_hex, source)
+        _metrics.ingest_wire_snapshot(node_hex, msg["snap"], source=source)
+        if msg.get("events"):
+            flight_recorder.ingest_remote(node_hex, msg["events"])
+        if peer.closed:
+            # register-after-disconnect: _peer_gone may have already run
+            # while this push sat on the reactor — withdraw, or a dead
+            # process's series get served as live forever (the same race
+            # PR-2 closed for pending_gets)
+            peer.meta.pop("metrics_source", None)
+            _metrics.drop_remote_snapshot(node_hex, source)
 
     # ---- worker/client object plane
     def _h_client_get(self, peer: RpcPeer, msg: dict):
@@ -667,6 +710,7 @@ class ControlPlane:
         args, kwargs = cloudpickle.loads(msg["args"])  # refs rebind to head runtime
         opts = cloudpickle.loads(msg["opts"]) if msg.get("opts") else {}
         opts = {k: v for k, v in opts.items() if v is not None}
+        tctx = opts.pop("_trace_ctx", None)
         resources = opts.pop("resources", None) or {}
         if "CPU" in resources:
             opts["num_cpus"] = resources.pop("CPU")
@@ -675,7 +719,18 @@ class ControlPlane:
         if resources:
             opts["resources"] = resources
         rf = api.remote(**opts)(func) if opts else api.remote(func)
-        result = rf.remote(*args, **kwargs)
+        if tctx:
+            # propagated span context: the head-side resubmission records
+            # under the remote caller's trace, so driver->worker->head->
+            # worker chains read as ONE trace (tracing satellite, ISSUE 8)
+            from ray_tpu.util import tracing
+
+            with tracing.span(
+                    f"client_submit::{getattr(func, '__name__', 'fn')}",
+                    parent_ctx=tuple(tctx)):
+                result = rf.remote(*args, **kwargs)
+        else:
+            result = rf.remote(*args, **kwargs)
         if isinstance(result, ObjectRefGenerator):
             return [result._stream_id.binary()], True
         refs = result if isinstance(result, list) else [result]

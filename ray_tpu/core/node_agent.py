@@ -130,7 +130,8 @@ def main() -> None:
                 out.set_exception(e)
 
         pool.submit_blob(fn_blob, msg["args"], msg.get("oid"),
-                         task_bin=msg.get("task")).add_done_callback(_done)
+                         task_bin=msg.get("task"),
+                         trace=msg.get("trace")).add_done_callback(_done)
         return out
 
     def h_plane_free(peer, msg):
@@ -291,6 +292,31 @@ def main() -> None:
                 pass
         return st
 
+    # Telemetry push (wire v5): ship this process's metrics registry + new
+    # flight-recorder events to the head every push period, piggybacked on
+    # the heartbeat cadence (reference: the per-node metrics agent feeding
+    # the cluster Prometheus view). A <v5 head simply never gets pushes.
+    from ray_tpu.util import metrics as _metrics
+
+    push_period = float(os.environ.get("RAY_TPU_METRICS_PUSH_PERIOD_S", "2"))
+    push_box = {"next": 0.0, "cursor": 0}
+
+    def _maybe_push_metrics(p) -> None:
+        if push_period <= 0 or time.monotonic() < push_box["next"]:
+            return
+        if (p.negotiated_version or 0) < 5:
+            return  # old head: since-gated op, skip quietly
+        push_box["next"] = time.monotonic() + push_period
+        try:
+            # push_once advances the cursor only on success: a failed push
+            # re-ships its flight events next round instead of losing them
+            push_box["cursor"] = _metrics.push_once(p, push_box["cursor"])
+        except wire.PeerDisconnected:
+            raise  # heartbeat loop owns reconnect
+        except Exception as e:  # telemetry must never kill the agent
+            print(f"node agent: metrics push failed: {e!r}",
+                  file=sys.stderr, flush=True)
+
     # Heartbeat; on head loss, try to reconnect to the SAME address for a
     # grace window — a restarted head (durable GCS store, same token)
     # re-registers this node and its pinned plane objects. Exceeding the
@@ -302,6 +328,7 @@ def main() -> None:
         while True:
             try:
                 peer.notify("heartbeat", stats=_node_stats())
+                _maybe_push_metrics(peer)
             except wire.PeerDisconnected:
                 pass
             if peer.closed:

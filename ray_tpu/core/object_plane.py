@@ -34,14 +34,66 @@ from __future__ import annotations
 
 import collections
 import threading
+import time as _time
+import weakref
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Callable, Optional
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.core import rpc as wire
 from ray_tpu.exceptions import ObjectLostError, ObjectStoreFullError
+from ray_tpu.util import flight_recorder
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 import os as _os
+
+# Instruments bound once at import (util/metrics.py bind contract — the
+# chunk loop and BLOB frame paths never touch the registry). Pull-level
+# observations happen once per pull; the per-chunk cost is two plain dict
+# updates in _note_pending.
+_M_PULL_BYTES = Counter(
+    "ray_tpu_plane_pull_bytes_total",
+    "payload bytes pulled from remote holders into this node").bind()
+_M_PULL_SECONDS = Histogram(
+    "ray_tpu_plane_pull_seconds", "wall-clock duration of whole-object pulls",
+    boundaries=[0.005, 0.02, 0.1, 0.5, 2, 10, 60]).bind()
+_M_PULLS = Counter("ray_tpu_plane_pulls_total",
+                   "completed pull attempts by outcome", tag_keys=("outcome",))
+_M_PULL_OK = _M_PULLS.bind({"outcome": "ok"})
+_M_PULL_MISS = _M_PULLS.bind({"outcome": "miss"})
+_M_FAILOVER = Counter(
+    "ray_tpu_plane_holder_failover_total",
+    "mid-pull holder failures that requeued chunks onto survivors").bind()
+_M_STALE = Counter(
+    "ray_tpu_plane_stale_holder_total",
+    "directory entries invalidated because the holder lacked the object").bind()
+
+# Live PlaneClients, sampled at scrape/push time for bytes-in-flight and
+# per-holder pending-bytes gauges (the striper/scheduler topology signal).
+_CLIENTS: "weakref.WeakSet[PlaneClient]" = weakref.WeakSet()
+
+
+def _inflight_bytes_producer():
+    total = 0
+    for c in list(_CLIENTS):
+        total += c._budget.inflight_bytes
+    return [({}, total)]
+
+
+def _holder_pending_producer():
+    agg: dict[str, int] = {}
+    for c in list(_CLIENTS):
+        for addr, n in c.holder_pending_bytes().items():
+            agg[addr] = agg.get(addr, 0) + n
+    return [({"holder": a}, n) for a, n in agg.items()]
+
+
+Gauge("ray_tpu_plane_pull_bytes_in_flight",
+      "bytes admitted by the pull budget and not yet landed"
+      ).attach_producer(_inflight_bytes_producer)
+Gauge("ray_tpu_plane_holder_pending_bytes",
+      "chunk bytes currently owed by each holder address",
+      tag_keys=("holder",)).attach_producer(_holder_pending_producer)
 
 # 4 MiB: on the raw BLOB path a chunk costs no allocation on either side
 # (views in, recv_into out), so larger chunks just amortize the per-chunk
@@ -212,6 +264,23 @@ class PlaneClient:
         self._budget = _PullBudget(max_pull_bytes or PULL_BYTES)
         self._stripe_min = stripe_min_bytes or STRIPE_MIN_BYTES
         self._stripe_holders = max(1, stripe_holders or STRIPE_HOLDERS)
+        # addr -> chunk bytes currently owed by that holder (grabbed or in
+        # flight); the per-node bandwidth/queue view the striper consumes
+        self._holder_pending: dict[str, int] = {}
+        self._hp_lock = threading.Lock()
+        _CLIENTS.add(self)
+
+    def holder_pending_bytes(self) -> dict[str, int]:
+        with self._hp_lock:
+            return {a: n for a, n in self._holder_pending.items() if n > 0}
+
+    def _note_pending(self, addr: str, delta: int) -> None:
+        with self._hp_lock:
+            n = self._holder_pending.get(addr, 0) + delta
+            if n <= 0:
+                self._holder_pending.pop(addr, None)
+            else:
+                self._holder_pending[addr] = n
 
     def _peer(self, addr: str) -> wire.RpcPeer:
         with self._lock:
@@ -348,6 +417,7 @@ class PlaneClient:
         # locally-built ones are tuples
         entries = [tuple(e) if isinstance(e, (tuple, list)) else (None, e)
                    for e in addrs]
+        t_start = _time.perf_counter()
         dest: Optional[memoryview] = None
         size = 0
         acquired = 0
@@ -384,6 +454,10 @@ class PlaneClient:
                         continue
                     if meta is None:
                         stale.add(addr)
+                        _M_STALE.inc()
+                        flight_recorder.record(
+                            "plane", "stale_holder", holder=addr,
+                            oid=oid_bin.hex()[:16])
                         if on_stale is not None and token is not None:
                             on_stale(token)
                         continue
@@ -407,6 +481,14 @@ class PlaneClient:
                             len(holders) >= self._stripe_holders:
                         break
                 if not holders or dest is None:
+                    if dest is not None:
+                        # transfer started, then every holder died/went
+                        # stale: the all-holders-dead abort path
+                        flight_recorder.record(
+                            "plane", "pull_abandoned", oid=oid_bin.hex()[:16],
+                            bytes_done=state["done"] * chunk_bytes,
+                            size=size)
+                    _M_PULL_MISS.inc()
                     return False
                 self._transfer(dest, size, oid_bin, holders, pending, state,
                                chunk_bytes, window, timeout, fails)
@@ -416,6 +498,9 @@ class PlaneClient:
                     # that can never progress
                     raise state["error"]
                 if state["done"] >= total:
+                    _M_PULL_OK.inc()
+                    _M_PULL_BYTES.inc(size)
+                    _M_PULL_SECONDS.observe(_time.perf_counter() - t_start)
                     return True
                 # every holder of this round died/evicted mid-transfer; the
                 # loop re-gathers (surviving peers + untried addrs) and only
@@ -471,6 +556,7 @@ class PlaneClient:
                                 "obj_chunk", oid=oid_bin, off=off, len=ln)
                         inflight.append((off, ln, mid, fut))
                         grabbed.popleft()
+                        self._note_pending(addr, ln)
                     if not inflight:
                         return
                     # keep the head entry in ``inflight`` until its result is
@@ -488,6 +574,7 @@ class PlaneClient:
                         dest[off:off + ln] = data
                     inflight.popleft()
                     peer.finish_call(mid)
+                    self._note_pending(addr, -ln)
                     with lock:
                         state["done"] += 1
             except BaseException as e:
@@ -498,6 +585,7 @@ class PlaneClient:
                 # non-holder error (protocol bug, dest write failure) is
                 # recorded so the pull aborts instead of spinning on a
                 # silently dead thread.
+                requeued = len(grabbed) + len(inflight)
                 with lock:
                     pending.extend(grabbed)
                     for o, _, _, _ in inflight:
@@ -506,6 +594,13 @@ class PlaneClient:
                     state["dropped"].append(peer)
                     if not isinstance(e, _HOLDER_ERRORS):
                         state["error"] = e
+                self._note_pending(addr, -sum(l for _, l, _, _ in inflight))
+                if isinstance(e, _HOLDER_ERRORS):
+                    _M_FAILOVER.inc()
+                    flight_recorder.record(
+                        "plane", "holder_failover", holder=addr,
+                        oid=oid_bin.hex()[:16], requeued_chunks=requeued,
+                        error=f"{type(e).__name__}: {e}"[:200])
                 self._drop_peer(addr, peer)
 
         if len(holders) == 1:

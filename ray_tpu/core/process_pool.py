@@ -685,8 +685,9 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                     gen_consumed.pop(seq, None)
                 _retire(seq)
             continue
-        # ("run", seq, oid_bin, fn_blob, args_blob, task_bin)
+        # ("run", seq, oid_bin, fn_blob, args_blob, task_bin[, trace])
         _, seq, oid_bin, fn_blob, args_blob, task_bin = req[:6]
+        trace_ctx = req[6] if len(req) > 6 else None
         if _check_skip(seq):
             _reply(("skipped", seq))
             continue
@@ -697,8 +698,22 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         try:
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = _decode_call(args_blob)
+            if trace_ctx:
+                # worker-side execute span joins the driver's submit trace
+                # (the propagated context IS the opt-in — recorded to this
+                # process's buffer and OTLP sink when configured)
+                from ray_tpu.util import tracing as _tracing
+
+                with _tracing.span(
+                        "worker_exec::" + (task_bin.hex()[:12]
+                                           if task_bin else "task"),
+                        {"worker_pid": os.getpid()},
+                        parent_ctx=tuple(trace_ctx)):
+                    result = fn(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
             status, payload, extra, contained = _result_payload(
-                fn(*args, **kwargs), oid_bin)
+                result, oid_bin)
         except BaseException as e:  # noqa: BLE001
             _maybe_post_mortem(e)
             status, payload, extra = _error_payload(e)
@@ -719,10 +734,10 @@ class _Inflight:
     __slots__ = ("future", "oid_bin", "fn_blob", "args_blob", "task_bin",
                  "started", "cancel_sent", "cancel_reason", "worker",
                  "submit_ts", "user_cancelled", "kind", "on_item",
-                 "backpressure", "seq")
+                 "backpressure", "seq", "trace")
 
     def __init__(self, fn_blob, args_blob, oid_bin, task_bin, kind="run",
-                 on_item=None, backpressure=0):
+                 on_item=None, backpressure=0, trace=None):
         self.future: Future = Future()
         self.fn_blob = fn_blob
         self.args_blob = args_blob
@@ -738,6 +753,7 @@ class _Inflight:
         self.on_item = on_item      # gen: callback(index, status, payload, extra)
         self.backpressure = backpressure
         self.seq: int | None = None
+        self.trace = trace  # [trace_id, parent_span_id] from the submitter
 
     def ack(self, consumed: int) -> None:
         """Tell the producing worker the consumer has read `consumed` items
@@ -1393,7 +1409,7 @@ class ProcessWorkerPool:
                          inf.backpressure)
             else:
                 frame = ("run", seq, inf.oid_bin, inf.fn_blob, inf.args_blob,
-                         inf.task_bin)
+                         inf.task_bin, inf.trace)
             # Ordered handoff: acquire the worker's send lock WHILE the
             # registration lock is held, but do the (blocking) pipe write
             # after releasing it. Every cancel sender discovers the inflight
@@ -1414,10 +1430,12 @@ class ProcessWorkerPool:
 
     def submit_blob(self, fn_blob: bytes, args_blob: bytes,
                     result_oid_bin: bytes | None = None,
-                    task_bin: bytes | None = None) -> Future:
+                    task_bin: bytes | None = None,
+                    trace=None) -> Future:
         """Pipelined submission; the future resolves to (status, payload, extra)
         or raises _RemoteTaskError / WorkerCrashedError."""
-        inf = _Inflight(fn_blob, args_blob, result_oid_bin, task_bin)
+        inf = _Inflight(fn_blob, args_blob, result_oid_bin, task_bin,
+                        trace=trace)
         self._submit_inflight(inf)
         return inf.future
 
@@ -1454,11 +1472,13 @@ class ProcessWorkerPool:
     def execute_blob(self, fn_blob: bytes, args_blob: bytes,
                      result_oid_bin: bytes | None = None,
                      timeout: float | None = None,
-                     task_bin: bytes | None = None):
+                     task_bin: bytes | None = None,
+                     trace=None):
         """Blocking form (head dispatcher and node agents): submit + wait."""
         import concurrent.futures as _cf
 
-        inf = _Inflight(fn_blob, args_blob, result_oid_bin, task_bin)
+        inf = _Inflight(fn_blob, args_blob, result_oid_bin, task_bin,
+                        trace=trace)
         self._submit_inflight(inf)
         try:
             return inf.future.result(timeout)

@@ -1,4 +1,4 @@
-"""Control-plane dispatch counters.
+"""Control-plane dispatch counters + hot-path RPC instruments.
 
 Every outbound RPC request/notify (``rpc:<op>``) and every local task/actor
 submission (``local:submit_task`` / ``local:submit_actor_task``) bumps a
@@ -6,13 +6,22 @@ process-wide counter. The compiled-graph contract — zero control-plane
 round trips per DAG step at steady state — is asserted against these
 counters in tests (tests/test_dag.py) and the microbench suite; they are
 cheap dict increments, always on.
+
+This module is also the control plane's metrics binding point (reference:
+the per-node metrics agent exporting gRPC client/server stats, SURVEY
+§5.5): per-op latency histograms, TTL-shed and retry counters live here as
+instruments bound ONCE at import time (util/metrics.py bind contract), so
+``peer.call`` pays one dict lookup + one locked bucket increment per
+completed round trip — never a registry lookup.
 """
 
 from __future__ import annotations
 
-from collections import Counter
+from collections import Counter as _PyCounter
 
-COUNTS: "Counter[str]" = Counter()
+from ray_tpu.util.metrics import Counter, Histogram
+
+COUNTS: "_PyCounter[str]" = _PyCounter()
 
 
 def bump(name: str) -> None:
@@ -35,3 +44,42 @@ def delta(before: dict, after: dict | None = None) -> dict:
     after = snapshot() if after is None else after
     return {k: v - before.get(k, 0) for k, v in after.items()
             if v != before.get(k, 0)}
+
+
+# ----------------------------------------------------------- rpc instruments
+# Bound once at import; per-op bound-series caches grow to the op-name set
+# (bounded by the schema registry), so steady state is pure dict hits.
+OP_LATENCY_MS = Histogram(
+    "ray_tpu_rpc_op_latency_ms",
+    "round-trip latency of control-plane calls, per op",
+    boundaries=[0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000],
+    tag_keys=("op",))
+TTL_SHED_TOTAL = Counter(
+    "ray_tpu_rpc_ttl_shed_total",
+    "requests dropped server-side because the caller's ttl expired "
+    "before dispatch", tag_keys=("op",))
+RETRIES_TOTAL = Counter(
+    "ray_tpu_rpc_retries_total",
+    "control-plane call attempts retried by RetryPolicy")
+_RETRIES = RETRIES_TOTAL.bind()
+
+_lat_bound: dict = {}
+_shed_bound: dict = {}
+
+
+def observe_op_latency(op: str, ms: float) -> None:
+    b = _lat_bound.get(op)
+    if b is None:
+        b = _lat_bound[op] = OP_LATENCY_MS.bind({"op": op})
+    b.observe(ms)
+
+
+def count_ttl_shed(op: str) -> None:
+    b = _shed_bound.get(op)
+    if b is None:
+        b = _shed_bound[op] = TTL_SHED_TOTAL.bind({"op": op})
+    b.inc()
+
+
+def count_retry() -> None:
+    _RETRIES.inc()

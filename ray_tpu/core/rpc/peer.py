@@ -182,9 +182,14 @@ class RpcPeer:
     def call(self, op: str, timeout: float | None = None, **payload) -> Any:
         """Request/response; raises the handler's exception, PeerDisconnected,
         or WireVersionError if the negotiated version predates ``op``."""
+        t0 = time.perf_counter()
         mid, fut = self.call_async(op, _ttl=timeout, **payload)
         try:
-            return fut.result(timeout=timeout)
+            result = fut.result(timeout=timeout)
+            # per-op round-trip latency (import-time-bound instrument: one
+            # dict hit + one bucket increment — see opcount.py)
+            opcount.observe_op_latency(op, (time.perf_counter() - t0) * 1e3)
+            return result
         finally:
             with self._plock:
                 self._pending.pop(mid, None)
@@ -365,6 +370,7 @@ class RpcPeer:
             # here too — the caller may have given up while we queued)
             def run_blocking():
                 if deadline is not None and time.monotonic() > deadline:
+                    opcount.count_ttl_shed(spec.name)
                     self._send_error_reply(mid, TimeoutError(
                         f"request {spec.name} ttl expired before dispatch"))
                     return
@@ -373,13 +379,16 @@ class RpcPeer:
             threading.Thread(target=run_blocking, daemon=True,
                              name=f"rpc-blk-{spec.name}").start()
             return
+
+        def on_expired():
+            opcount.count_ttl_shed(spec.name if spec else str(op_num))
+            self._send_error_reply(mid, TimeoutError(
+                f"request {spec.name if spec else op_num} ttl expired "
+                "before dispatch"))
+
         self._reactor.submit(
             self._dispatch, op_num, mid, payload, deadline,
-            deadline=deadline,
-            on_expired=lambda: self._send_error_reply(
-                mid, TimeoutError(
-                    f"request {spec.name if spec else op_num} ttl expired "
-                    "before dispatch")),
+            deadline=deadline, on_expired=on_expired,
         )
 
     def _dispatch(self, op_num: int, mid: int | None, payload: Any,
@@ -503,6 +512,29 @@ class RpcPeer:
         """(host, port) of this end of the connection — the routable address
         peers on the remote side could reach this host at."""
         return self._sock.getsockname()
+
+    @property
+    def remote_host(self) -> "str | None":
+        """IP the peer connected from (None once the socket is closed) —
+        lets the head attribute pushes from node-less peers to a machine."""
+        try:
+            return self._sock.getpeername()[0]
+        except OSError:
+            return None
+
+    def is_same_host(self) -> bool:
+        """Best-effort: does the peer live on this machine? True for
+        loopback or when the peer's source IP equals this socket's local
+        IP (same box reached over a LAN address)."""
+        rip = self.remote_host
+        if rip is None:
+            return False
+        if rip in ("127.0.0.1", "::1"):
+            return True
+        try:
+            return rip == self._sock.getsockname()[0]
+        except OSError:
+            return False
 
     def close(self) -> None:
         self._fail(PeerDisconnected(f"{self.name} closed locally"))

@@ -21,10 +21,31 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
+from ray_tpu.util.metrics import Gauge
+
 DEFAULT_THREADS = int(os.environ.get("RAY_TPU_RPC_REACTOR_THREADS", "8"))
+
+# All live reactors, sampled at metric-scrape time (zero hot-path cost:
+# depth is a plain int the submit/run pair already maintains).
+_REACTORS: "weakref.WeakSet[Reactor]" = weakref.WeakSet()
+
+
+def _depth_by_name():
+    depths: dict[str, int] = {}
+    for r in list(_REACTORS):
+        depths[r.name] = depths.get(r.name, 0) + r.depth
+    return [({"reactor": name}, d) for name, d in depths.items()]
+
+
+QUEUE_DEPTH = Gauge(
+    "ray_tpu_rpc_reactor_queue_depth",
+    "inbound requests queued or running on each bounded reactor",
+    tag_keys=("reactor",))
+QUEUE_DEPTH.attach_producer(_depth_by_name)
 
 
 class Reactor:
@@ -40,6 +61,10 @@ class Reactor:
         self._pool: Optional[ThreadPoolExecutor] = None
         self._lock = threading.Lock()
         self._closed = False
+        # queued + running request count; plain int updates under the GIL —
+        # telemetry precision, not a synchronization primitive
+        self.depth = 0
+        _REACTORS.add(self)
 
     def _executor(self) -> ThreadPoolExecutor:
         with self._lock:
@@ -60,18 +85,23 @@ class Reactor:
         the queue must not amplify a stampede."""
 
         def run():
-            if deadline is not None and time.monotonic() > deadline:
-                if on_expired is not None:
-                    try:
-                        on_expired()
-                    except Exception:
-                        pass
-                return
-            fn(*args)
+            try:
+                if deadline is not None and time.monotonic() > deadline:
+                    if on_expired is not None:
+                        try:
+                            on_expired()
+                        except Exception:
+                            pass
+                    return
+                fn(*args)
+            finally:
+                self.depth -= 1
 
+        self.depth += 1
         try:
             self._executor().submit(run)
         except RuntimeError:
+            self.depth -= 1
             # shutting down: answer instead of silently dropping, or a
             # caller blocked without a timeout waits forever
             if on_expired is not None:

@@ -61,19 +61,31 @@ class RetryPolicy:
         The last retryable error re-raises when the budget is spent."""
         deadline = (None if self.deadline_s is None
                     else time.monotonic() + self.deadline_s)
+        from ray_tpu.core.rpc import opcount
         from ray_tpu.core.rpc.schema import WireVersionError
+        from ray_tpu.util import flight_recorder
 
+        attempts = 0
         for sleep_s in self.backoffs():
             try:
                 return attempt()
             except retryable as e:
+                attempts += 1
                 if isinstance(e, WireVersionError):
-                    raise  # deterministic: the peer will never change its mind
+                    # deterministic: the peer will never change its mind —
+                    # a version-negotiation failure, not a transient drop
+                    flight_recorder.record(
+                        "rpc", "version_negotiation_failed", error=str(e)[:200])
+                    raise
                 if should_stop is not None and should_stop():
                     raise
                 now = time.monotonic()
                 if deadline is not None and now >= deadline:
+                    flight_recorder.record(
+                        "rpc", "retry_exhausted", attempts=attempts,
+                        error=f"{type(e).__name__}: {e}"[:200])
                     raise
+                opcount.count_retry()
                 if deadline is not None:
                     sleep_s = min(sleep_s, max(0.0, deadline - now))
                 time.sleep(sleep_s)

@@ -28,6 +28,10 @@ Version history:
   moves step data over persistent ``dag_ch_write``/``dag_ch_read`` channel
   ops (reads answered with raw BLOB frames). A <v4 peer cannot install
   graphs; ``experimental_compile`` falls back to RPC dispatch.
+- v5: cluster telemetry — ``metrics_push`` (node agents ship compact
+  metrics-registry snapshots + flight-recorder events to the head; the
+  head's /metrics becomes a true cluster scrape). A <v5 agent simply never
+  pushes; the head still has its heartbeat-borne physical stats.
 """
 
 from __future__ import annotations
@@ -37,7 +41,7 @@ from typing import Optional
 
 # The schema version this build speaks, and the oldest it can fall back to.
 # Peers negotiate min(max_a, max_b) at hello; see negotiate().
-WIRE_VERSION = 4
+WIRE_VERSION = 5
 WIRE_VERSION_MIN = 1
 
 # Protocol magic sent in the hello frame: rejects foreign/legacy peers with
@@ -278,7 +282,11 @@ register_op(30, "client_stream_done", [
 # -- head -> agent dispatch plane (reference: PushNormalTask lease reuse)
 register_op(31, "execute_task", [
     _f("fn", T.BLOB, required=True), _f("args", T.BLOB, required=True),
-    _f("oid", T.BYTES), _f("task", T.BYTES), _f("renv", T.ANY)],
+    _f("oid", T.BYTES), _f("task", T.BYTES), _f("renv", T.ANY),
+    # optional [trace_id, parent_span_id] — the submitter's span context;
+    # the executing worker parents its execute span on it (appended field:
+    # inbound-tolerant old peers simply drop it)
+    _f("trace", T.ANY)],
     doc="deferred reply: resolves when the pool finishes")
 register_op(32, "task_blocked", [_f("task", T.BYTES, required=True)])
 register_op(33, "plane_free", [_f("oid", T.BYTES, required=True)])
@@ -351,3 +359,12 @@ register_op(55, "dag_ch_read", [
     doc="remote driver output edge: long-poll the next frame newer than "
         "`last`; reply is a raw BLOB frame [u64 version | payload] riding "
         "the v3 zero-copy sendmsg path")
+
+# -- cluster telemetry plane (v5; reference: the per-node metrics agent
+#    feeding the cluster-wide Prometheus view, _private/metrics_agent.py).
+#    Version-gated so a v5 agent joined to a <v5 head just skips pushing.
+register_op(56, "metrics_push", [
+    _f("snap", T.ANY, required=True), _f("events", T.ANY)], since=5,
+    doc="agent -> head (notify): compact metrics-registry snapshot "
+        "(util/metrics.wire_snapshot) + new flight-recorder events; the "
+        "head merges both under the sender's node_id")

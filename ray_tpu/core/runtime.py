@@ -98,6 +98,10 @@ class TaskSpec:
     concurrency_group: str | None = None
     # None = follow config.task_execution; True/False force process/thread
     isolate_process: bool | None = None
+    # propagated tracing context (trace_id, parent_span_id) captured at
+    # submit time: execute-side spans — head dispatch, worker execution —
+    # join the submitter's trace instead of rooting disjoint ones
+    trace_ctx: "tuple[str, str] | None" = None
 
     def return_ids(self) -> list[ObjectID]:
         n = 1 if isinstance(self.num_returns, str) else self.num_returns
@@ -838,6 +842,7 @@ class Runtime:
         if self.is_shutdown:
             raise RuntimeError("ray_tpu runtime is shut down")
         opcount.bump("local:submit_task")
+        self._stamp_trace_ctx(spec)
         dep_refs = _ref_args(spec.args, spec.kwargs)
         self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
         return_ids = spec.return_ids()
@@ -859,6 +864,25 @@ class Runtime:
         if spec.num_returns == STREAMING or spec.num_returns == DYNAMIC:
             return refs  # caller wraps in ObjectRefGenerator
         return refs
+
+    def _stamp_trace_ctx(self, spec: TaskSpec) -> None:
+        """Driver-side submit span (reference: tracing_helper wrapping
+        ``.remote()``): record the submission and stamp its context on the
+        spec, so every execute-side span — head dispatch, worker execution,
+        nested resubmission — links under it: ONE connected trace per
+        remote call instead of disjoint roots."""
+        if spec.trace_ctx is not None:
+            return
+        from ray_tpu.util import tracing
+
+        if tracing.is_enabled():
+            with tracing.span(f"submit::{spec.desc()}",
+                              {"task_id": spec.task_id.hex()[:16]}):
+                spec.trace_ctx = tracing.current_context()
+        else:
+            # not recording locally, but an inbound propagated context (a
+            # client_submit wrapper span) still flows through
+            spec.trace_ctx = tracing.current_context()
 
     def _enqueue(self, spec: TaskSpec) -> None:
         with self._lock:
@@ -987,7 +1011,8 @@ class Runtime:
                 # submit-side task spans (util/tracing/tracing_helper.py).
                 if tracing.is_enabled():
                     with tracing.span(f"task::{spec.desc()}",
-                                      {"task_id": spec.task_id.hex()[:16]}):
+                                      {"task_id": spec.task_id.hex()[:16]},
+                                      parent_ctx=spec.trace_ctx):
                         if agent is not None:
                             self._execute_on_agent(entry, agent)
                         else:
@@ -1300,6 +1325,13 @@ class Runtime:
             "name": state.name,
             "num_restarts": state.num_restarts,
         }
+        if state.state == "DEAD":
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "actors", "actor_died", actor_id=payload["actor_id"][:16],
+                class_name=payload["class_name"],
+                cause=str(getattr(state, "death_cause", "") or "")[:200])
         export_events.emit("actor", payload)
         try:
             self.publisher.publish("actors", payload)
@@ -1343,6 +1375,21 @@ class Runtime:
                 self.scheduler.release(entry.node_id, entry.sched_req)
                 self.scheduler.retry_pending_pgs()
 
+    def scheduler_queue_depths(self) -> dict:
+        """Task-queue view per node: PENDING tasks not yet schedulable
+        (global — they have no node until leased) plus RUNNING tasks per
+        leased node. The queue-depth half of the node_io_view() signal."""
+        pending = 0
+        per_node: dict[str, int] = {}
+        with self._lock:
+            for e in self._tasks.values():
+                if e.state == "PENDING":
+                    pending += 1
+                elif e.state == "RUNNING" and e.node_id is not None:
+                    k = e.node_id.hex()
+                    per_node[k] = per_node.get(k, 0) + 1
+        return {"pending": pending, "per_node": per_node}
+
     def on_node_death(self, node_id: NodeID) -> None:
         """Agent vanished (socket EOF or missed heartbeats): remove the node;
         its in-flight dispatches fail with PeerDisconnected and retry onto
@@ -1350,7 +1397,11 @@ class Runtime:
         self._agents.pop(node_id, None)
         self.node_stats.pop(node_id, None)  # no live-looking stats on a dead row
         from ray_tpu._private import export_events
+        from ray_tpu.util import flight_recorder
+        from ray_tpu.util import metrics as util_metrics
 
+        util_metrics.drop_remote_snapshot(node_id.hex())  # all its sources
+        flight_recorder.record("cluster", "node_dead", node_id=node_id.hex())
         export_events.emit("node", {"node_id": node_id.hex(), "state": "DEAD"})
         # Objects whose only copies lived on the dead node are now lost; the
         # next access misses the directory and falls to lineage reconstruction.
@@ -1473,9 +1524,12 @@ class Runtime:
             self._store_returns(spec, result)
             return
         try:
+            from ray_tpu.util import tracing
+
             status, payload, size, contained = self._process_pool().execute_blob(
                 fn_blob, args_blob, result_oid_bin=oid_bin,
                 task_bin=spec.task_id.binary(),
+                trace=tracing.current_context() or spec.trace_ctx,
             )
         except _RemoteTaskError as e:
             # Re-raise the ORIGINAL exception type so retry_exceptions matching
@@ -1571,9 +1625,13 @@ class Runtime:
             self._store_returns(spec, result)
             return
         try:
+            from ray_tpu.util import tracing
+
+            tctx = tracing.current_context() or spec.trace_ctx
             res = agent.call(
                 "execute_task", fn=fn_blob, args=args_blob, oid=oid_bin,
-                task=spec.task_id.binary(), renv=None, timeout=None,
+                task=spec.task_id.binary(), renv=None,
+                trace=list(tctx) if tctx else None, timeout=None,
             )
         except PeerDisconnected as e:
             raise ActorError(f"node agent died during task: {e}") from e
@@ -1590,7 +1648,8 @@ class Runtime:
 
         if tracing.is_enabled():
             with tracing.span(f"task::{entry.spec.desc()}",
-                              {"task_id": entry.spec.task_id.hex()[:16]}):
+                              {"task_id": entry.spec.task_id.hex()[:16]},
+                              parent_ctx=entry.spec.trace_ctx):
                 return self._run_user_fn_inner(entry, fn, args, kwargs)
         return self._run_user_fn_inner(entry, fn, args, kwargs)
 
@@ -1641,6 +1700,15 @@ class Runtime:
             return
         entry.state = "FAILED"
         entry.error = repr(exc)
+        if entry.attempts > 0:
+            # a task that retried and STILL failed is the signal the flight
+            # recorder exists for; plain first-try app errors are not
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "tasks", "retry_exhausted", task=spec.desc()[:64],
+                attempts=entry.attempts, max_retries=spec.max_retries,
+                error=f"{type(exc).__name__}: {exc}"[:200])
         self._record_event(spec, "FAILED")
         self._store_error(spec, TaskError(exc, spec.desc()))
 
@@ -2146,6 +2214,7 @@ class Runtime:
                         with tracing.span(
                             f"actor::{state.cls.__name__}.{spec.method_name}",
                             {"actor_id": state.actor_id.hex()[:16]},
+                            parent_ctx=spec.trace_ctx,
                         ):
                             return _m(*a, **kw)
 
@@ -2155,6 +2224,7 @@ class Runtime:
                             with tracing.span(
                                 f"actor::{state.cls.__name__}.{spec.method_name}",
                                 {"actor_id": state.actor_id.hex()[:16]},
+                                parent_ctx=spec.trace_ctx,
                             ):
                                 return await _m(*a, **kw)
 
@@ -2506,6 +2576,7 @@ class Runtime:
             self._store_error(spec, ActorDiedError(state.death_cause or "actor is dead"))
             return [ObjectRef(r, self) for r in spec.return_ids()]
         spec = self._make_actor_task_spec(actor_id, method_name, args, kwargs, options)
+        self._stamp_trace_ctx(spec)
         mailbox = state.mailbox_for(spec)  # raises on unknown group pre-enqueue
         dep_refs = _ref_args(spec.args, spec.kwargs)
         self.reference_counter.add_submitted_task_refs([r.object_id() for r in dep_refs])
@@ -2550,6 +2621,10 @@ class Runtime:
             max_retries=options.get("max_task_retries", default_retries),
             retry_exceptions=options.get("retry_exceptions", False),
             concurrency_group=options.get("concurrency_group"),
+            # propagated from a remote submitter (client_runtime ships its
+            # live span context in the opts blob)
+            trace_ctx=(tuple(options["_trace_ctx"])
+                       if options.get("_trace_ctx") else None),
         )
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
@@ -3070,3 +3145,35 @@ def set_runtime(rt: Runtime | None) -> None:
     global _runtime
     with _runtime_lock:
         _runtime = rt
+
+
+# Scheduler queue-depth gauges: registered ONCE per process, resolving the
+# live runtime at scrape/push time (init/shutdown cycles must not stack
+# duplicate producers; a dead runtime just produces nothing).
+def _sched_pending_producer():
+    rt = get_runtime_or_none()
+    if rt is None or rt.is_shutdown or not hasattr(rt, "scheduler_queue_depths"):
+        return []
+    return [({}, rt.scheduler_queue_depths()["pending"])]
+
+
+def _sched_running_producer():
+    rt = get_runtime_or_none()
+    if rt is None or rt.is_shutdown or not hasattr(rt, "scheduler_queue_depths"):
+        return []
+    return [({"node_id": k}, v)
+            for k, v in rt.scheduler_queue_depths()["per_node"].items()]
+
+
+def _register_sched_gauges() -> None:
+    from ray_tpu.util.metrics import Gauge
+
+    Gauge("ray_tpu_sched_pending_tasks",
+          "submitted tasks not yet schedulable (deps unready or no "
+          "feasible node)").attach_producer(_sched_pending_producer)
+    Gauge("ray_tpu_sched_running_tasks",
+          "tasks leased and running, per node",
+          tag_keys=("node_id",)).attach_producer(_sched_running_producer)
+
+
+_register_sched_gauges()

@@ -129,6 +129,17 @@ class ShmChannel:
     def _counters(self):
         return _CTR.unpack_from(self._shm.buf, 0)
 
+    def occupancy(self) -> int:
+        """Frames published but not yet consumed (0..nslots) — the ring-depth
+        telemetry signal, readable by either end at any time (two u64 loads,
+        no locking)."""
+        written, read, _ = self._counters()
+        return written - read
+
+    @property
+    def nslots(self) -> int:
+        return self._nslots
+
     def _set_written(self, v: int) -> None:
         struct.pack_into("<Q", self._shm.buf, 0, v)
 
@@ -163,6 +174,7 @@ class ShmChannel:
                 # next frame at the reader — silent corruption. Poison the
                 # channel instead: both ends fail loudly with ChannelClosed.
                 self.close_channel()
+                self._record_poison("writer_stalled_mid_frame", off, total)
                 raise ChannelClosed(
                     f"channel {self.name} poisoned: writer stalled mid-frame "
                     f"(chunk at byte {off}/{total})") from None
@@ -266,6 +278,7 @@ class ShmChannel:
                 # would hand the frame's remainder to the next read_view as
                 # a bogus fresh frame. Poison the channel instead.
                 self.close_channel()
+                self._record_poison("reader_stalled_mid_frame", total, None)
                 raise ChannelClosed(
                     f"channel {self.name} poisoned: reader stalled mid-frame "
                     f"({total} bytes consumed)") from None
@@ -304,6 +317,18 @@ class ShmChannel:
         self._scratch[dst_off:dst_off + n] = self._shm.buf[src:src + n]
         self._set_read(read + 1)  # frees the slot for the writer
         return read + 1, n, more
+
+    def _record_poison(self, why: str, done: int, total) -> None:
+        """Flight-record a channel poisoning — failure-path only (the hot
+        read/write loops never reach here)."""
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record(
+                "shm_channel", "poisoned", channel=self.name, reason=why,
+                bytes_done=done, frame_bytes=total if total is not None else -1)
+        except Exception:
+            pass
 
     # ---------------------------------------------------------- lifecycle
     def close_channel(self) -> None:

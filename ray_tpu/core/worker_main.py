@@ -55,6 +55,15 @@ def main() -> None:
         except Exception:
             pass
 
+    try:
+        # adopt the driver's tracing opt-in (enable_tracing() stamps the env
+        # the spawner copies) so propagated span contexts are recorded here
+        from ray_tpu.util import tracing
+
+        tracing.enable_from_env()
+    except Exception:
+        pass
+
     from multiprocessing.connection import Connection
 
     conn = Connection(args.fd)

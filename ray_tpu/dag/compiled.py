@@ -466,6 +466,11 @@ def try_compile_actor_dag(output_node):
     try:
         return CompiledActorDAG(spec)
     except (WireVersionError, NotImplementedError) as e:
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record(
+            "dag", "compile_fallback",
+            reason=f"{type(e).__name__}: {e}"[:200])
         logger.warning(
             "experimental_compile: compiled-graph install unavailable (%s); "
             "falling back to per-call RPC dispatch", e)

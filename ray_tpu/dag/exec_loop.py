@@ -22,9 +22,30 @@ graph so no end ever hangs.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 from ray_tpu.core.shm_channel import ChannelClosed
+from ray_tpu.util.metrics import Counter, Histogram
+
+# Telemetry: instruments bound ONCE at import (util/metrics.py bind
+# contract, pinned by check_wire_schemas::check_hot_path_instruments) and
+# recorded SAMPLED — the steady-state loop stays RPC-free and
+# allocation-free; every _SAMPLE_EVERY-th execution pays two perf_counter
+# reads and a handful of locked dict increments at the flush.
+_SAMPLE_EVERY = 32
+_M_STEPS = Counter("ray_tpu_dag_steps_total",
+                   "compiled-graph executions completed by resident "
+                   "exec loops").bind()
+_M_STEP_MS = Histogram(
+    "ray_tpu_dag_step_latency_ms",
+    "sampled wall-clock of one execution (first input frame -> outputs "
+    "published)",
+    boundaries=[0.05, 0.2, 1, 5, 20, 100, 1000]).bind()
+_M_RING_OCC = Histogram(
+    "ray_tpu_dag_ring_occupancy",
+    "sampled input-ring depth (frames published, unconsumed) at flush",
+    boundaries=[0, 1, 2, 4, 8, 16]).bind()
 
 # Argument templates (picklable, interpreted per step):
 CONST = "const"   # ("const", value)           literal bound at .bind() time
@@ -88,13 +109,19 @@ def run_plan(instance, plan: ActorPlan, channels: dict, *,
 
     last = {cid: 0 for cid in plan.read_chans}
     slots: dict = {}
+    execs = 0       # executions since the last metrics flush
+    sampled_ms = -1.0
+    t_exec = 0.0    # start of the SAMPLED execution (first frame in hand)
     try:
         while True:
             frames: dict = {}   # chan_id -> (seq, status, payload)
             seq = None
+            sampling = execs == 0  # first execution of each flush window
+            if sampling:
+                t_exec = 0.0  # a frameless execution must not reuse a stale clock
 
             def _chan_value(cid):
-                nonlocal seq
+                nonlocal seq, t_exec
                 fr = frames.get(cid)
                 if fr is None:
                     last[cid], view = channels[cid].read_view(
@@ -102,6 +129,10 @@ def run_plan(instance, plan: ActorPlan, channels: dict, *,
                     fr = frames[cid] = cloudpickle.loads(view)
                 if seq is None:
                     seq = fr[0]
+                    if sampling:
+                        # clock starts when the first input frame is in hand
+                        # — idle channel wait is arrival time, not step cost
+                        t_exec = time.perf_counter()
                 if fr[1] != "ok":
                     raise _ErrorFrame(fr[2])
                 return fr[2]
@@ -151,6 +182,21 @@ def run_plan(instance, plan: ActorPlan, channels: dict, *,
             # them now so every channel advances exactly one generation per
             # execution (the lockstep invariant the seq protocol rests on)
             _drain_unread(plan, frames, channels, last)
+            if sampling and t_exec:
+                sampled_ms = (time.perf_counter() - t_exec) * 1e3
+            execs += 1
+            if execs >= _SAMPLE_EVERY:
+                _M_STEPS.inc(execs)
+                if sampled_ms >= 0.0:
+                    _M_STEP_MS.observe(sampled_ms)
+                occ = 0
+                for cid in plan.read_chans:
+                    o = channels[cid].occupancy()
+                    if o > occ:
+                        occ = o
+                _M_RING_OCC.observe(occ)
+                execs = 0
+                sampled_ms = -1.0
     except ChannelClosed:
         pass
     except BaseException:  # noqa: BLE001 — loop must never die silently:
@@ -159,9 +205,16 @@ def run_plan(instance, plan: ActorPlan, channels: dict, *,
         # in a log, or a production graph death leaves zero evidence
         import logging
 
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("dag", "exec_loop_died",
+                               steps=len(plan.steps),
+                               read_chans=list(plan.read_chans))
         logging.getLogger("ray_tpu").exception(
             "compiled-graph exec loop died; closing its channels")
     finally:
+        if execs:  # partial flush window: don't lose the tail count
+            _M_STEPS.inc(execs)
         for ch in channels.values():
             try:
                 ch.close_channel()

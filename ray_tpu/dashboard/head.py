@@ -266,6 +266,27 @@ class Dashboard:
             return web.Response(text=system_prometheus_text() + prometheus_text(),
                                 content_type="text/plain")
 
+        async def flight_records(request):
+            """Recent structured events (pull failovers, channel poisonings,
+            actor deaths, retry exhaustions, negotiation fallbacks) — local
+            rings + everything agents/workers shipped with metrics pushes.
+            ?subsystem= filters one ring; ?limit= caps the merge."""
+            from ray_tpu.util import state as st
+
+            try:
+                limit = min(int(request.query.get("limit", 1000)), 10000)
+            except ValueError:
+                limit = 1000
+            return web.json_response(jsonable(st.flight_records(
+                subsystem=request.query.get("subsystem"), limit=limit)))
+
+        async def node_io(request):
+            """Per-node bandwidth/queue-depth view (util/state.node_io_view)
+            — the topology signal for the striper/scheduler/KV router."""
+            from ray_tpu.util import state as st
+
+            return web.json_response(jsonable(st.node_io_view()))
+
         async def serve_status(request):
             try:
                 from ray_tpu import serve
@@ -333,6 +354,8 @@ class Dashboard:
             app.router.add_get("/api/cluster_status", cluster_status)
             app.router.add_get("/api/v0/{resource}/summarize", state_summarize)
             app.router.add_get("/api/v0/tasks/{task_id:[0-9a-f]{16,}}", task_detail)
+            app.router.add_get("/api/v0/flight_records", flight_records)
+            app.router.add_get("/api/v0/node_io", node_io)
             app.router.add_get("/api/v0/{resource}", state_list)
             app.router.add_get("/api/jobs", jobs)
             app.router.add_post("/api/jobs", job_submit)

@@ -1,0 +1,120 @@
+"""Flight recorder: bounded per-subsystem rings of recent structured events.
+
+The "what happened in the last 30 seconds" answer log-grepping can't give:
+subsystems record rare-but-load-bearing events (pull failovers, channel
+poisonings, actor deaths, retry exhaustions, version-negotiation fallbacks)
+into small in-memory rings — one deque per subsystem, O(1) append, bounded
+memory — and ``ray_tpu.util.state.flight_records()`` / the dashboard's
+``/api/v0/flight_records`` dump them on demand or on fatal errors.
+
+Reference analog: Ray's event framework (src/ray/util/event.h RayEvent ring
+sinks + the dashboard event page) — here process-local, shipped to the head
+piggybacked on the node agents' ``metrics_push``, so a multi-node session's
+recent history is inspectable from one place.
+
+Events are plain dicts (msgpack-native values only — they cross the wire):
+``{"ts": wall_clock, "subsystem": ..., "event": ..., **fields}``.
+Recording is always on: one deque.append under a small lock per RARE event
+costs nothing measurable, and a recorder that must be switched on is never
+on when the failure happens.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+MAX_EVENTS_PER_SUBSYSTEM = 256
+
+_lock = threading.Lock()
+_rings: dict[str, deque] = {}
+_seq = itertools.count(1)  # monotone id: the agents' ship-cursor
+
+
+def record(subsystem: str, event: str, **fields) -> None:
+    """Append one structured event. Values must be msgpack-native (str/int/
+    float/bool/bytes/lists/dicts) — they ride the metrics_push wire op."""
+    entry = {"seq": next(_seq), "ts": time.time(),
+             "subsystem": subsystem, "event": event}
+    entry.update(fields)
+    with _lock:
+        ring = _rings.get(subsystem)
+        if ring is None:
+            ring = _rings[subsystem] = deque(maxlen=MAX_EVENTS_PER_SUBSYSTEM)
+        ring.append(entry)
+
+
+def records(subsystem: Optional[str] = None, limit: int = 1000) -> list[dict]:
+    """Recent events, oldest first — one subsystem's ring or all rings
+    merged by sequence. ``limit`` caps the merge; <= 0 returns nothing
+    (out[-limit:] with a non-positive limit would UNcap instead)."""
+    if limit <= 0:
+        return []
+    with _lock:
+        if subsystem is not None:
+            out = list(_rings.get(subsystem, ()))
+        else:
+            out = [e for ring in _rings.values() for e in ring]
+    out.sort(key=lambda e: e["seq"])
+    return out[-limit:]
+
+
+def subsystems() -> list[str]:
+    with _lock:
+        return sorted(_rings)
+
+
+def drain_since(cursor: int) -> tuple[list[dict], int]:
+    """Events newer than ``cursor`` plus the new cursor — the node agent's
+    incremental ship loop (each event crosses the wire once)."""
+    out = []
+    with _lock:
+        for ring in _rings.values():
+            for e in ring:
+                if e["seq"] > cursor:
+                    out.append(e)
+    out.sort(key=lambda e: e["seq"])
+    new_cursor = out[-1]["seq"] if out else cursor
+    return out, new_cursor
+
+
+def ingest_remote(node_hex: str, events: list) -> None:
+    """Head side: fold a node's shipped events into local rings, tagged with
+    the origin node (remote seq is replaced — the head's cursor space is its
+    own)."""
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        fields = {k: v for k, v in e.items()
+                  if k not in ("seq", "subsystem", "event", "ts")}
+        fields["node_id"] = node_hex
+        fields["node_ts"] = e.get("ts")
+        record(str(e.get("subsystem", "remote")),
+               str(e.get("event", "unknown")), **fields)
+
+
+def dump(file=None) -> None:
+    """Human-readable dump of every ring — called on fatal errors so the
+    crash report carries the recent-history context."""
+    import sys
+
+    out = file or sys.stderr
+    evs = records()
+    if not evs:
+        return
+    print(f"=== ray_tpu flight recorder ({len(evs)} recent events) ===",
+          file=out)
+    for e in evs:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("seq", "ts", "subsystem", "event")}
+        stamp = time.strftime("%H:%M:%S", time.localtime(e["ts"]))
+        print(f"  {stamp} [{e['subsystem']}] {e['event']} {extra}", file=out)
+    print("=== end flight recorder ===", file=out, flush=True)
+
+
+def clear() -> None:
+    with _lock:
+        _rings.clear()

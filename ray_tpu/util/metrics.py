@@ -1,47 +1,161 @@
-"""User-defined metrics: Counter / Gauge / Histogram.
+"""User-defined + runtime metrics: Counter / Gauge / Histogram.
 
 Parity: python/ray/util/metrics.py — tagged metrics recorded by application
-code; a registry snapshot serves the dashboard/Prometheus scrape (reference:
-per-node metrics agent + opencensus pipeline, SURVEY §5.5).
+code — plus the per-node metrics-agent pipeline (reference: SURVEY §5.5,
+_private/metrics_agent.py): every process records into its own registry,
+node agents ship compact snapshots to the head over the ``metrics_push``
+wire op, and the head's ``/metrics`` scrape merges them into one
+cluster-wide Prometheus view with a ``node_id`` label per remote series.
+
+Hot-path contract: subsystems that record per-event (RPC dispatch, plane
+pulls, compiled-graph steps) bind instruments ONCE — at import or install
+time — via ``bind()``, which precomputes the series key so recording is a
+single locked dict update with no tag merging, no registry lookup
+(enforced for the hottest modules by ``scripts/check_wire_schemas.py::
+check_hot_path_instruments``). Gauges for values that already live
+somewhere (queue depths, bytes in flight) attach a producer callback and
+cost nothing until scrape/push time.
 """
 
 from __future__ import annotations
 
 import threading
+import time
+from bisect import bisect_left
 from collections import defaultdict
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 _registry_lock = threading.Lock()
 _registry: dict[str, "Metric"] = {}
 
+DEFAULT_HIST_BOUNDARIES = [0.01, 0.1, 1, 10, 100]
+
 
 class Metric:
-    def __init__(self, name: str, description: str = "", tag_keys: Optional[Iterable[str]] = None):
+    """Base instrument. Re-registering a name RETURNS the existing
+    instrument object (extended with any newly declared tag keys) instead
+    of silently shadowing it — the reference's ``ray.util.metrics``
+    behavior, where a metric name identifies one series family per
+    process. A name re-registered as a *different* instrument kind is a
+    programming error and raises.
+
+    Construction happens ENTIRELY inside ``__new__`` under the registry
+    lock (``__init__`` is a no-op): the create-vs-reuse decision and the
+    instance's storage setup are atomic, so two threads racing the first
+    registration can never observe a half-initialized instrument."""
+
+    def __new__(cls, name: str, *args, **kwargs):
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}, not {cls.__name__}")
+                existing._merge(*args, **kwargs)
+                return existing
+            inst = super().__new__(cls)
+            inst._setup(name, *args, **kwargs)
+            _registry[name] = inst
+            return inst
+
+    def __init__(self, *args, **kwargs):
+        pass  # see __new__: construction is atomic with registration
+
+    def _setup(self, name: str, description: str = "",
+               tag_keys: Optional[Iterable[str]] = None) -> None:
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys or ())
         self._default_tags: dict[str, str] = {}
         self._lock = threading.Lock()
-        with _registry_lock:
-            _registry[name] = self
+
+    def _merge(self, description: str = "",
+               tag_keys: Optional[Iterable[str]] = None) -> None:
+        """Duplicate registration: keep the live series, union tag keys."""
+        if tag_keys:
+            merged = dict.fromkeys(self.tag_keys)
+            merged.update(dict.fromkeys(tag_keys))
+            self.tag_keys = tuple(merged)
+        if description and not self.description:
+            self.description = description
 
     def set_default_tags(self, tags: dict[str, str]) -> "Metric":
+        self._check_tags(tags)
         self._default_tags = dict(tags)
         return self
 
+    def _check_tags(self, tags: dict | None) -> None:
+        if not tags:
+            return
+        undeclared = [k for k in tags if k not in self.tag_keys]
+        if undeclared:
+            raise ValueError(
+                f"metric {self.name!r}: tag(s) {undeclared} not declared in "
+                f"tag_keys={list(self.tag_keys)} — undeclared tags would "
+                "fork silent series (declare them at construction)")
+
     def _key(self, tags: dict | None) -> tuple:
+        self._check_tags(tags)
         merged = {**self._default_tags, **(tags or {})}
         return tuple(sorted(merged.items()))
 
+    def bind(self, tags: dict | None = None):
+        """Precompute one series' key: the returned handle records with a
+        single locked dict update — the hot-path form (bind at import or
+        install time, record per event). The handle is kind-typed: a
+        Counter bind exposes only inc(), a Gauge only set(), a Histogram
+        only observe() — a kind mismatch fails at bind time, not on the
+        hot path."""
+        return self._bound_cls(self, self._key(tags))
+
+
+class _BoundBase:
+    """A (metric, series-key) pair with allocation-free record methods."""
+
+    __slots__ = ("_m", "_k")
+
+    def __init__(self, metric: Metric, key: tuple):
+        self._m = metric
+        self._k = key
+
+
+class _BoundCounter(_BoundBase):
+    __slots__ = ()
+
+    def inc(self, value: float = 1.0) -> None:
+        m = self._m
+        with m._lock:
+            m._values[self._k] += value
+
+
+class _BoundGauge(_BoundBase):
+    __slots__ = ()
+
+    def set(self, value: float) -> None:
+        m = self._m
+        with m._lock:
+            m._values[self._k] = value
+
+
+class _BoundHistogram(_BoundBase):
+    __slots__ = ()
+
+    def observe(self, value: float) -> None:
+        self._m._observe_key(self._k, value)
+
 
 class Counter(Metric):
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
+    _bound_cls = _BoundCounter
+
+    def _setup(self, *args, **kwargs) -> None:
+        super()._setup(*args, **kwargs)
         self._values: dict[tuple, float] = defaultdict(float)
 
     def inc(self, value: float = 1.0, tags: dict | None = None) -> None:
+        key = self._key(tags)
         with self._lock:
-            self._values[self._key(tags)] += value
+            self._values[key] += value
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -49,42 +163,78 @@ class Counter(Metric):
 
 
 class Gauge(Metric):
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
+    _bound_cls = _BoundGauge
+
+    def _setup(self, *args, **kwargs) -> None:
+        super()._setup(*args, **kwargs)
         self._values: dict[tuple, float] = {}
+        self._producers: list[Callable[[], Iterable[tuple[dict, float]]]] = []
 
     def set(self, value: float, tags: dict | None = None) -> None:
+        key = self._key(tags)
         with self._lock:
-            self._values[self._key(tags)] = value
+            self._values[key] = value
+
+    def attach_producer(
+            self, fn: "Callable[[], Iterable[tuple[dict, float]]]") -> None:
+        """Register a callback yielding ``(tags, value)`` pairs, sampled at
+        snapshot time — zero hot-path cost for values that already live in
+        some subsystem (queue depths, bytes in flight). Producer errors are
+        swallowed: a scrape must never take the runtime down."""
+        with self._lock:
+            self._producers.append(fn)
 
     def snapshot(self) -> dict:
         with self._lock:
-            return dict(self._values)
+            out = dict(self._values)
+            producers = list(self._producers)
+        for fn in producers:
+            try:
+                for tags, value in fn():
+                    out[self._key(tags)] = value
+            except Exception:
+                pass
+        return out
 
 
 class Histogram(Metric):
-    def __init__(self, name: str, description: str = "", boundaries: Iterable[float] = (),
-                 tag_keys=None):
-        super().__init__(name, description, tag_keys)
-        self.boundaries = sorted(boundaries) or [0.01, 0.1, 1, 10, 100]
+    _bound_cls = _BoundHistogram
+
+    def _setup(self, name: str, description: str = "",
+               boundaries: Iterable[float] = (), tag_keys=None) -> None:
+        super()._setup(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or list(DEFAULT_HIST_BOUNDARIES)
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = defaultdict(float)
 
+    def _merge(self, description: str = "", boundaries: Iterable[float] = (),
+               tag_keys=None) -> None:
+        # boundaries are fixed at first registration (live bucket lists
+        # can't be re-shaped); later declarations keep the original
+        super()._merge(description, tag_keys)
+
     def observe(self, value: float, tags: dict | None = None) -> None:
-        key = self._key(tags)
+        self._observe_key(self._key(tags), value)
+
+    def _observe_key(self, key: tuple, value: float) -> None:
+        i = bisect_left(self.boundaries, value)
         with self._lock:
-            buckets = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
-            for i, b in enumerate(self.boundaries):
-                if value <= b:
-                    buckets[i] += 1
-                    break
-            else:
-                buckets[-1] += 1
+            buckets = self._counts.get(key)
+            if buckets is None:
+                buckets = self._counts[key] = [0] * (len(self.boundaries) + 1)
+            buckets[i] += 1
             self._sums[key] += value
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {k: {"buckets": list(v), "sum": self._sums[k]} for k, v in self._counts.items()}
+            return {k: {"buckets": list(v), "sum": self._sums[k],
+                        "boundaries": self.boundaries}
+                    for k, v in self._counts.items()}
+
+
+def get_metric(name: str) -> "Metric | None":
+    with _registry_lock:
+        return _registry.get(name)
 
 
 def registry_snapshot() -> dict:
@@ -92,6 +242,304 @@ def registry_snapshot() -> dict:
     with _registry_lock:
         metrics = dict(_registry)
     return {name: m.snapshot() for name, m in metrics.items() if hasattr(m, "snapshot")}
+
+
+# ------------------------------------------------------- cluster aggregation
+# Remote snapshots pushed over ``metrics_push`` — by node agents AND by
+# worker processes (a node's plane pulls and compiled-graph channels live
+# in its workers, not its agent). Keyed by (node_hex, source) where source
+# distinguishes processes on one node; each entry keeps the previous
+# counters too so byte/sec rates (the striper/scheduler bandwidth signal)
+# come for free.
+_remote_lock = threading.Lock()
+_remote: dict[tuple[str, str], dict] = {}
+
+
+def wire_snapshot() -> list:
+    """This process's registry as a msgpack-native list (tag tuples become
+    ``[[k, v], ...]`` lists — msgpack map keys can't be tuples):
+    ``[name, kind, [[tags, value], ...]], ...``."""
+    out = []
+    with _registry_lock:
+        metrics = list(_registry.items())
+    for name, m in metrics:
+        if not hasattr(m, "snapshot"):
+            continue
+        kind = type(m).__name__.lower()
+        series = []
+        for key, val in m.snapshot().items():
+            tags = [[k, v] for k, v in key]
+            series.append([tags, val])
+        if series:
+            out.append([name, kind, series])
+    return out
+
+
+def _sane_value(val) -> bool:
+    if isinstance(val, bool):
+        return False
+    if isinstance(val, (int, float)):
+        return True
+    if not (isinstance(val, dict) and isinstance(val.get("buckets"), list)
+            and isinstance(val.get("sum"), (int, float))
+            and all(isinstance(b, (int, float)) for b in val["buckets"])):
+        return False
+    # boundaries ride the wire too and feed zip() in _render_series — a
+    # non-list (or non-numeric entries) would poison every later scrape
+    bounds = val.get("boundaries", [])
+    return (isinstance(bounds, (list, tuple))
+            and all(isinstance(b, (int, float)) for b in bounds))
+
+
+def _sanitize_snapshot(snap) -> list:
+    """Drop malformed entries from a pushed snapshot BEFORE storing it: a
+    single version-skewed or buggy pusher must degrade to missing series,
+    never to a /metrics / node_io_view 500 for the whole cluster (the
+    stored entry would poison every later render until the peer drops)."""
+    out = []
+    if not isinstance(snap, (list, tuple)):
+        return out
+    for ent in snap:
+        if not (isinstance(ent, (list, tuple)) and len(ent) == 3
+                and isinstance(ent[0], str) and isinstance(ent[1], str)
+                and isinstance(ent[2], (list, tuple))):
+            continue
+        series = []
+        for s in ent[2]:
+            if not (isinstance(s, (list, tuple)) and len(s) == 2):
+                continue
+            tags, val = s
+            if not isinstance(tags, (list, tuple)) or not _sane_value(val):
+                continue
+            if all(isinstance(t, (list, tuple)) and len(t) == 2 for t in tags):
+                series.append([tags, val])
+        if series:
+            out.append([ent[0], ent[1], series])
+    return out
+
+
+def ingest_wire_snapshot(node_hex: str, snap: list,
+                         source: str = "agent") -> None:
+    """Head side: merge one process's pushed snapshot (shape-sanitized).
+    Counter-rate estimation keeps the previous push, so ``node_rates()``
+    can answer bytes/sec without the head ever subscribing to raw
+    events."""
+    snap = _sanitize_snapshot(snap)
+    now = time.monotonic()
+    key = (node_hex, source)
+    with _remote_lock:
+        prev = _remote.get(key)
+        _remote[key] = {
+            "snap": snap, "ts": now, "wall_ts": time.time(),
+            "prev_snap": prev["snap"] if prev else None,
+            "prev_ts": prev["ts"] if prev else None,
+        }
+
+
+def drop_remote_snapshot(node_hex: str, source: "str | None" = None) -> None:
+    """Forget a process's series (peer disconnected) — ``source=None``
+    drops every source of the node (node death)."""
+    with _remote_lock:
+        for key in [k for k in _remote
+                    if k[0] == node_hex and (source is None or k[1] == source)]:
+            _remote.pop(key, None)
+
+
+def remote_snapshots() -> dict[tuple[str, str], dict]:
+    with _remote_lock:
+        return dict(_remote)
+
+
+def _counter_total(snap: list, metric_name: str) -> "float | None":
+    for name, kind, series in snap:
+        if name == metric_name and kind == "counter":
+            return sum(val for _tags, val in series)
+    return None
+
+
+def _gauge_series(snap: list, metric_name: str) -> "list | None":
+    for name, kind, series in snap:
+        if name == metric_name:
+            return series
+    return None
+
+
+def node_rates(metric_name: str) -> dict[str, float]:
+    """Per-node rate (units/sec) of a pushed counter, from the last two
+    pushes of every source on the node — e.g.
+    ``node_rates("ray_tpu_plane_pull_bytes_total")`` is the per-node
+    pull-bandwidth estimate node_io_view() serves."""
+    out: dict[str, float] = {}
+    for (node_hex, _src), ent in remote_snapshots().items():
+        cur = _counter_total(ent["snap"], metric_name)
+        if cur is None:
+            continue
+        prev = (_counter_total(ent["prev_snap"], metric_name)
+                if ent.get("prev_snap") else None)
+        dt = (ent["ts"] - ent["prev_ts"]) if ent.get("prev_ts") else None
+        rate = (max(0.0, (cur - prev) / dt)
+                if prev is not None and dt and dt > 0 else 0.0)
+        out[node_hex] = out.get(node_hex, 0.0) + rate
+    return out
+
+
+def node_counter(metric_name: str) -> dict[str, float]:
+    """Latest pushed total of a counter per node (sources summed)."""
+    out: dict[str, float] = {}
+    for (node_hex, _src), ent in remote_snapshots().items():
+        cur = _counter_total(ent["snap"], metric_name)
+        if cur is not None:
+            out[node_hex] = out.get(node_hex, 0.0) + cur
+    return out
+
+
+def node_gauge(metric_name: str) -> dict[str, float]:
+    """Latest pushed value of a gauge per node (series + sources summed)."""
+    out: dict[str, float] = {}
+    for (node_hex, _src), ent in remote_snapshots().items():
+        series = _gauge_series(ent["snap"], metric_name)
+        if series is not None:
+            out[node_hex] = out.get(node_hex, 0.0) + sum(
+                v for _t, v in series if isinstance(v, (int, float)))
+    return out
+
+
+def node_tagged_gauge(metric_name: str,
+                      tag_key: str) -> dict[str, dict[str, float]]:
+    """Pushed gauge broken out per node AND per one tag's value — e.g.
+    ``node_tagged_gauge("ray_tpu_plane_holder_pending_bytes", "holder")``
+    gives each node's per-holder pending-bytes map (sources summed)."""
+    out: dict[str, dict[str, float]] = {}
+    for (node_hex, _src), ent in remote_snapshots().items():
+        series = _gauge_series(ent["snap"], metric_name)
+        if series is None:
+            continue
+        per = out.setdefault(node_hex, {})
+        for tags, val in series:
+            if not isinstance(val, (int, float)):
+                continue
+            tval = dict(tuple(t) for t in tags).get(tag_key)
+            if tval is not None:
+                per[str(tval)] = per.get(str(tval), 0.0) + val
+    return out
+
+
+def node_io_rollup() -> dict:
+    """Everything ``state.node_io_view()`` needs from the remote-snapshot
+    table in ONE pass (the per-metric extractors above each rescan the
+    whole table — fine for ad-hoc queries, wasteful for a view the
+    scheduler/striper/KV router poll)."""
+    pull_rate: dict[str, float] = {}
+    pull_total: dict[str, float] = {}
+    inflight: dict[str, float] = {}
+    reactor: dict[str, float] = {}
+    holder: dict[str, dict[str, float]] = {}
+
+    def _sum(series):
+        return sum(v for _t, v in series if isinstance(v, (int, float)))
+
+    for (node_hex, _src), ent in remote_snapshots().items():
+        cur_total = None
+        for name, kind, series in ent["snap"]:
+            if name == "ray_tpu_plane_pull_bytes_total" and kind == "counter":
+                cur_total = _sum(series)
+                pull_total[node_hex] = pull_total.get(node_hex, 0.0) + cur_total
+            elif name == "ray_tpu_plane_pull_bytes_in_flight":
+                inflight[node_hex] = inflight.get(node_hex, 0.0) + _sum(series)
+            elif name == "ray_tpu_rpc_reactor_queue_depth":
+                reactor[node_hex] = reactor.get(node_hex, 0.0) + _sum(series)
+            elif name == "ray_tpu_plane_holder_pending_bytes":
+                per = holder.setdefault(node_hex, {})
+                for tags, val in series:
+                    if not isinstance(val, (int, float)):
+                        continue
+                    tval = dict(tuple(t) for t in tags).get("holder")
+                    if tval is not None:
+                        per[str(tval)] = per.get(str(tval), 0.0) + val
+        if cur_total is not None:
+            rate = 0.0
+            prev_snap, prev_ts = ent.get("prev_snap"), ent.get("prev_ts")
+            if prev_snap and prev_ts:
+                prev = _counter_total(prev_snap,
+                                      "ray_tpu_plane_pull_bytes_total")
+                dt = ent["ts"] - prev_ts
+                if prev is not None and dt > 0:
+                    rate = max(0.0, (cur_total - prev) / dt)
+            pull_rate[node_hex] = pull_rate.get(node_hex, 0.0) + rate
+    return {"pull_rate": pull_rate, "pull_total": pull_total,
+            "inflight": inflight, "reactor_depth": reactor,
+            "holder_pending": holder}
+
+
+def push_once(peer, cursor: int) -> int:
+    """One metrics_push over ``peer``: ship this process's registry plus
+    flight-recorder events newer than ``cursor``; returns the advanced
+    cursor. The cursor only moves AFTER the notify succeeds, so a failed
+    push re-ships its events next time instead of dropping them — shared
+    by the node agent's heartbeat loop and the worker pusher. Raises on
+    transport failure (the caller owns reconnect/skip policy)."""
+    from ray_tpu.util import flight_recorder
+
+    events, new_cursor = flight_recorder.drain_since(cursor)
+    peer.notify("metrics_push", snap=wire_snapshot(), events=events or None)
+    return new_cursor
+
+
+# ---------------------------------------------------------------- exposition
+def _esc_label(v) -> str:
+    """Prometheus exposition escaping for label values: backslash, double
+    quote, newline — one hostile tag value must not invalidate the whole
+    cluster scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(pairs: Iterable[tuple]) -> str:
+    tags = ",".join(f'{k}="{_esc_label(v)}"' for k, v in pairs)
+    return f"{{{tags}}}" if tags else ""
+
+
+def _render_series(lines: list, name: str, key, val,
+                   extra_tags: "tuple | None" = None) -> None:
+    safe = name.replace(".", "_").replace("-", "_")
+    pairs = list(key) + list(extra_tags or ())
+    if isinstance(val, dict):  # histogram
+        buckets = val["buckets"]
+        boundaries = val.get("boundaries") or DEFAULT_HIST_BOUNDARIES
+        # cumulative _bucket lines incl. +Inf — without them histogram
+        # quantiles aren't plottable (histogram_quantile needs le buckets)
+        cum = 0
+        for b, n in zip(boundaries, buckets):
+            cum += n
+            lines.append(
+                f"{safe}_bucket{_fmt_labels(pairs + [('le', b)])} {cum}")
+        total = cum + (buckets[len(boundaries)]
+                       if len(buckets) > len(boundaries) else 0)
+        lines.append(
+            f"{safe}_bucket{_fmt_labels(pairs + [('le', '+Inf')])} {total}")
+        lines.append(f"{safe}_sum{_fmt_labels(pairs)} {val['sum']}")
+        lines.append(f"{safe}_count{_fmt_labels(pairs)} {total}")
+    else:
+        lines.append(f"{safe}{_fmt_labels(pairs)} {val}")
+
+
+def prometheus_text() -> str:
+    """Render the registry — local series plus every node-pushed remote
+    snapshot (tagged ``node_id``) — in Prometheus exposition format: the
+    cluster-wide scrape the dashboard's /metrics serves."""
+    lines: list[str] = []
+    for name, values in registry_snapshot().items():
+        for key, val in values.items():
+            _render_series(lines, name, key, val)
+    for (node_hex, source), ent in remote_snapshots().items():
+        # src disambiguates processes on one node (agent vs workers) so two
+        # pushers can't emit conflicting samples under identical labels
+        tag = (("node_id", node_hex), ("src", source))
+        for name, kind, series in ent["snap"]:
+            for tags, val in series:
+                _render_series(lines, name, [tuple(t) for t in tags], val,
+                               extra_tags=tag)
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def system_prometheus_text() -> str:
@@ -137,19 +585,3 @@ def system_prometheus_text() -> str:
     if pool is not None:
         gauge("worker_processes_alive", pool.num_alive)
     return "\n".join(lines) + ("\n" if lines else "")
-
-
-def prometheus_text() -> str:
-    """Render the registry in Prometheus exposition format."""
-    lines = []
-    for name, values in registry_snapshot().items():
-        safe = name.replace(".", "_").replace("-", "_")
-        for key, val in values.items():
-            tags = ",".join(f'{k}="{v}"' for k, v in key)
-            label = f"{{{tags}}}" if tags else ""
-            if isinstance(val, dict):  # histogram
-                lines.append(f"{safe}_sum{label} {val['sum']}")
-                lines.append(f"{safe}_count{label} {sum(val['buckets'])}")
-            else:
-                lines.append(f"{safe}{label} {val}")
-    return "\n".join(lines) + "\n"

@@ -73,6 +73,116 @@ def list_placement_groups(limit: int = 1000) -> list[dict]:
     return placement_group_table()[:limit]
 
 
+def flight_records(subsystem: Optional[str] = None,
+                   limit: int = 1000) -> list[dict]:
+    """Recent structured events from the flight recorder — local rings plus
+    everything node agents/workers shipped with their metrics pushes (each
+    remote event carries its origin ``node_id``). The "what happened in the
+    last 30 seconds" dump (ISSUE 8)."""
+    from ray_tpu.util import flight_recorder
+
+    return flight_recorder.records(subsystem, limit)
+
+
+# per-metric previous sample for the HEAD's own rate estimation (remote
+# nodes get rates from consecutive metrics_push deltas; the head has no
+# pusher, so consecutive node_io_view() calls carry the baseline)
+_local_rate_prev: dict[str, tuple] = {}
+
+
+def _local_metric_total(name: str) -> float:
+    from ray_tpu.util import metrics
+
+    m = metrics.get_metric(name)
+    if m is None or not hasattr(m, "snapshot"):
+        return 0.0
+    return sum(v for v in m.snapshot().values() if isinstance(v, (int, float)))
+
+
+def _local_rate(name: str) -> float:
+    import time as _t
+
+    now = _t.monotonic()
+    cur = _local_metric_total(name)
+    prev = _local_rate_prev.get(name)
+    _local_rate_prev[name] = (now, cur)
+    if prev is None or now <= prev[0]:
+        return 0.0
+    return max(0.0, (cur - prev[1]) / (now - prev[0]))
+
+
+def node_io_view() -> dict:
+    """Per-node bandwidth / queue-depth view: the topology signal the
+    striper, the scheduler, and the KV router consume (ROADMAP: "a refactor
+    that surfaces a per-node bandwidth/queue-depth view in util/state.py
+    unlocks the scheduler, the striper, and the KV router in one move").
+
+    Sources: agent/worker ``metrics_push`` snapshots (pull-bandwidth =
+    counter deltas between the last two pushes), heartbeat physical stats,
+    and the head scheduler's queue depths. Returns::
+
+        {"nodes": {node_hex | "head": {pull_bandwidth_bps, pull_bytes_total,
+                                       pending_pull_bytes, holder_pending_bytes,
+                                       reactor_queue_depth, sched_running_tasks,
+                                       stats}},
+         "sched_pending_tasks": int}
+    """
+    from ray_tpu.util import metrics
+
+    rt = get_runtime()
+    if not hasattr(rt, "scheduler"):
+        # ClientRuntime (worker / remote driver): the aggregate lives at
+        # the head — fail clearly instead of half-crashing mid-function
+        raise RuntimeError(
+            "node_io_view() is head-only: this process holds a client "
+            "runtime; query the head's dashboard at /api/v0/node_io")
+    sched = rt.scheduler_queue_depths()
+    roll = metrics.node_io_rollup()  # one pass over the pushed snapshots
+    pull_rates = roll["pull_rate"]
+    pull_totals = roll["pull_total"]
+    inflight = roll["inflight"]
+    reactor = roll["reactor_depth"]
+    holder_pending = roll["holder_pending"]
+
+    def row(k: str) -> dict:
+        return {
+            "pull_bandwidth_bps": pull_rates.get(k, 0.0),
+            "pull_bytes_total": pull_totals.get(k, 0.0),
+            "pending_pull_bytes": inflight.get(k, 0.0),
+            "holder_pending_bytes": dict(holder_pending.get(k, {})),
+            "reactor_queue_depth": reactor.get(k, 0.0),
+            "sched_running_tasks": sched["per_node"].get(k, 0),
+            "stats": None,
+        }
+
+    nodes: dict[str, dict] = {}
+    for n in rt.scheduler.nodes():
+        if not n.alive:
+            continue
+        k = n.node_id.hex()
+        nodes[k] = row(k)
+        nodes[k]["stats"] = rt.node_stats.get(n.node_id)
+
+    # the head process itself (plus any head-host workers, which push under
+    # "head"): its own registry is local, not pushed — sample directly
+    head = row("head")
+    head["pull_bandwidth_bps"] += _local_rate("ray_tpu_plane_pull_bytes_total")
+    head["pull_bytes_total"] += _local_metric_total(
+        "ray_tpu_plane_pull_bytes_total")
+    head["pending_pull_bytes"] += _local_metric_total(
+        "ray_tpu_plane_pull_bytes_in_flight")
+    head["reactor_queue_depth"] += _local_metric_total(
+        "ray_tpu_rpc_reactor_queue_depth")
+    hp = metrics.get_metric("ray_tpu_plane_holder_pending_bytes")
+    if hp is not None:  # merge local over any head-host worker pushes
+        for k, v in hp.snapshot().items():
+            holder = dict(k).get("holder", "?")
+            head["holder_pending_bytes"][holder] = (
+                head["holder_pending_bytes"].get(holder, 0.0) + v)
+    nodes["head"] = head
+    return {"nodes": nodes, "sched_pending_tasks": sched["pending"]}
+
+
 def summarize_tasks() -> dict:
     by_state = _Counter(t["state"] for t in get_runtime().list_tasks())
     by_name = _Counter(t["name"] for t in get_runtime().list_tasks())

@@ -80,17 +80,48 @@ def _otel_processor(s: "Span") -> None:
                        span_id=s.span_id, parent_span_id=s.parent_id)
 
 
+TRACING_ENV = "RAY_TPU_TRACING"
+
+
 def enable_tracing() -> None:
     """Reference: `ray start --tracing-startup-hook` opt-in. OTLP export
     rides the processor hook when a sink is configured
-    (RAY_TPU_OTLP_FILE / RAY_TPU_OTLP_ENDPOINT)."""
+    (RAY_TPU_OTLP_FILE / RAY_TPU_OTLP_ENDPOINT). Sets RAY_TPU_TRACING so
+    worker processes spawned from here come up tracing too (worker_env()
+    copies os.environ) — their execute spans join the driver's trace via
+    the propagated context."""
+    import os
+
     if _otel_processor not in _tracer._processors:
         _tracer.add_span_processor(_otel_processor)
     _tracer.enabled = True
+    os.environ[TRACING_ENV] = "1"
 
 
 def disable_tracing() -> None:
+    import os
+
     _tracer.enabled = False
+    os.environ.pop(TRACING_ENV, None)
+
+
+def enable_from_env() -> None:
+    """Worker-boot hook: adopt the driver's tracing opt-in."""
+    import os
+
+    if os.environ.get(TRACING_ENV) == "1" and not _tracer.enabled:
+        enable_tracing()
+
+
+def current_context() -> "tuple[str, str] | None":
+    """(trace_id, span_id) of the live span, for cross-process propagation
+    (the W3C traceparent analog): ship it in task-submit opts and pass it
+    to span(parent_ctx=...) on the executing side so the worker's execute
+    span joins the submitter's trace instead of rooting a new one."""
+    s = _current_span.get()
+    if s is None:
+        return None
+    return (s.trace_id, s.span_id)
 
 
 def is_enabled() -> bool:
@@ -102,18 +133,31 @@ def add_span_processor(fn: Callable[[Span], None]) -> None:
 
 
 @contextlib.contextmanager
-def span(name: str, attributes: dict | None = None):
-    """Record a span (no-op unless tracing is enabled). Nested spans link via
-    thread-local parent context (tracing_helper's context propagation)."""
-    if not _tracer.enabled:
+def span(name: str, attributes: dict | None = None,
+         parent_ctx: "tuple[str, str] | None" = None):
+    """Record a span (no-op unless tracing is enabled — except that an
+    explicit ``parent_ctx`` ALSO records: a propagated context means the
+    submitting process opted in, and the execute span must join its trace
+    even where local enablement lagged). Nested spans link via thread-local
+    parent context (tracing_helper's context propagation); ``parent_ctx``
+    — a (trace_id, span_id) pair from ``current_context()``, possibly from
+    another process — takes precedence, linking this span under a remote
+    parent."""
+    if not _tracer.enabled and parent_ctx is None:
         yield None
         return
     parent: Optional[Span] = _current_span.get()
+    if parent_ctx is not None:
+        trace_id, parent_id = parent_ctx
+    elif parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    else:
+        trace_id, parent_id = uuid.uuid4().hex[:32], None
     s = Span(
         name=name,
         span_id=uuid.uuid4().hex[:16],
-        trace_id=parent.trace_id if parent else uuid.uuid4().hex[:32],
-        parent_id=parent.span_id if parent else None,
+        trace_id=trace_id,
+        parent_id=parent_id,
         start_ns=time.time_ns(),
         attributes=dict(attributes or {}),
     )

@@ -59,6 +59,8 @@ SCHEMA_BASELINE = {
     # ISSUE-7 (wire v4): compiled actor graphs
     "dag_install": 52, "dag_teardown": 53, "dag_ch_write": 54,
     "dag_ch_read": 55,
+    # ISSUE-8 (wire v5): cluster telemetry plane
+    "metrics_push": 56,
 }
 
 # Files whose handler tables must be fully schema'd.
@@ -131,6 +133,7 @@ _NON_OPS = {
     "workers_alive", "store_used_mb", "store_cap_mb", "num_returns",
     "max_retries", "retry_exceptions", "name", "resources", "runtime_env",
     "isolate_process", "peer_hello", "input_chans", "output_chan",
+    "_trace_ctx",
 }
 
 
@@ -357,12 +360,78 @@ def check_dag_loop_steady_state() -> list:
     return errors
 
 
+# Metric construction / registry-touching call names that must never run
+# per-event on a hot path — instruments bind at import/install time
+# (util/metrics.py bind contract, ISSUE-8 telemetry plane).
+_METRIC_CONSTRUCT_CALLS = {
+    "Counter", "Gauge", "Histogram", "bind", "get_metric",
+    "registry_snapshot", "wire_snapshot", "prometheus_text",
+    "attach_producer",
+}
+# Any metric recording at all is banned inside the raw BLOB frame paths —
+# a lock per frame there is a measured regression (pull metrics live at
+# whole-pull granularity in object_plane instead).
+_METRIC_RECORD_CALLS = {"inc", "observe", "record"}
+
+
+def check_hot_path_instruments() -> list:
+    """Hot-path telemetry contract: ``dag/exec_loop.py`` binds its
+    instruments at module import (and never constructs/looks one up inside
+    a function), and the BLOB send/recv frame paths (``peer._send_blob``/
+    ``_read_blob``, ``object_plane._h_chunk_raw``) carry NO metric calls at
+    all — no per-event registry lookups, no lock-per-frame regressions."""
+    errors = []
+    # 1) exec_loop: module-level bind exists...
+    loop_path = os.path.join(REPO, "ray_tpu", "dag", "exec_loop.py")
+    tree = ast.parse(open(loop_path).read(), filename="exec_loop.py")
+    top_binds = 0
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = node.value.func
+            name = (callee.attr if isinstance(callee, ast.Attribute)
+                    else callee.id if isinstance(callee, ast.Name) else None)
+            if name == "bind":
+                top_binds += 1
+    if top_binds == 0:
+        errors.append(
+            "dag/exec_loop.py: no module-level instrument bind() — hot-loop "
+            "metrics must be bound at import time, not per event")
+    # ...and no function body constructs instruments / touches the registry
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        for lineno, callee in _calls_in(fn, _METRIC_CONSTRUCT_CALLS):
+            errors.append(
+                f"dag/exec_loop.py:{lineno}: {fn.name} calls {callee}() — "
+                "instruments bind at import time, never per event")
+    # 2) BLOB frame paths: zero metric traffic
+    for rel, fnames in (("ray_tpu/core/rpc/peer.py",
+                         {"_send_blob", "_read_blob"}),
+                        ("ray_tpu/core/object_plane.py", {"_h_chunk_raw"})):
+        path = os.path.join(REPO, rel)
+        fns = _find_funcs(ast.parse(open(path).read(), rel), fnames)
+        for fname in sorted(fnames):
+            fn = fns.get(fname)
+            if fn is None:
+                errors.append(f"{rel}: {fname} missing — BLOB path gone?")
+                continue
+            banned = _METRIC_CONSTRUCT_CALLS | _METRIC_RECORD_CALLS
+            for lineno, callee in _calls_in(fn, banned):
+                errors.append(
+                    f"{rel}:{lineno}: {fname} calls {callee}() — the raw "
+                    "BLOB frame path must stay metric-free (a lock per "
+                    "frame is a measured regression; account at pull "
+                    "granularity instead)")
+    return errors
+
+
 def run_all() -> None:
     errors = check_registry()
     errors += check_handlers_have_schemas()
     errors += check_no_pickle_in_rpc()
     errors += check_blob_zero_copy()
     errors += check_dag_loop_steady_state()
+    errors += check_hot_path_instruments()
     if errors:
         _fail(errors)
     from ray_tpu.core.rpc import schema

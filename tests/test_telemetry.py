@@ -1,0 +1,387 @@
+"""Cluster telemetry plane tests (ISSUE 8): histogram exposition, tag
+validation, flight recorder, metrics_push wire op + version gating, live
+2-node aggregation, node_io_view, and trace-context propagation.
+
+Reference analogs: ray.util.metrics semantics (tag validation, duplicate
+registration), the per-node metrics agent -> cluster Prometheus pipeline
+(SURVEY §5.5), and tracing_helper's cross-process span linkage.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import flight_recorder
+from ray_tpu.util import metrics as rt_metrics
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ instruments
+def test_histogram_bucket_exposition():
+    """Satellite: prometheus_text emits cumulative _bucket{le=} lines incl.
+    +Inf (histogram quantiles are plottable), not just _sum/_count."""
+    h = rt_metrics.Histogram("tel_hist_exp", boundaries=[0.1, 1.0, 10.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(2.0)
+    h.observe(100.0)
+    text = rt_metrics.prometheus_text()
+    assert 'tel_hist_exp_bucket{le="0.1"} 1' in text
+    assert 'tel_hist_exp_bucket{le="1.0"} 2' in text
+    assert 'tel_hist_exp_bucket{le="10.0"} 3' in text
+    assert 'tel_hist_exp_bucket{le="+Inf"} 4' in text
+    assert "tel_hist_exp_count 4" in text
+    assert "tel_hist_exp_sum" in text
+
+
+def test_tag_validation_and_duplicate_registration():
+    """Satellite: undeclared record-time tags raise instead of silently
+    forking series; re-registering a name returns the SAME instrument
+    (reference: ray.util.metrics one-instrument-per-name semantics)."""
+    c = rt_metrics.Counter("tel_tagged", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    with pytest.raises(ValueError):
+        c.inc(tags={"undeclared": "x"})
+    with pytest.raises(ValueError):
+        c.set_default_tags({"undeclared": "x"})
+    # duplicate registration: same object, counts accumulate — not a shadow
+    c2 = rt_metrics.Counter("tel_tagged", tag_keys=("route",))
+    assert c2 is c
+    c2.inc(tags={"route": "/a"})
+    assert c.snapshot()[(("route", "/a"),)] == 2
+    # a name re-registered as a different KIND is a programming error
+    with pytest.raises(ValueError):
+        rt_metrics.Gauge("tel_tagged")
+
+
+def test_bound_series_and_gauge_producer():
+    c = rt_metrics.Counter("tel_bound", tag_keys=("k",))
+    b = c.bind({"k": "v"})
+    b.inc()
+    b.inc(4)
+    assert c.snapshot()[(("k", "v"),)] == 5
+    g = rt_metrics.Gauge("tel_cb_gauge", tag_keys=("src",))
+    g.attach_producer(lambda: [({"src": "x"}, 42.0)])
+    assert g.snapshot()[(("src", "x"),)] == 42.0
+
+
+def test_wire_snapshot_roundtrip_and_node_tagging():
+    import msgpack
+
+    c = rt_metrics.Counter("tel_wire_counter")
+    c.inc(7)
+    snap = rt_metrics.wire_snapshot()
+    msgpack.unpackb(msgpack.packb(snap))  # msgpack-native end to end
+    rt_metrics.ingest_wire_snapshot("feedface", snap, source="agent-1")
+    try:
+        text = rt_metrics.prometheus_text()
+        assert 'tel_wire_counter{node_id="feedface",src="agent-1"} 7' in text
+        # a second push computes rates from the counter delta
+        c.inc(100)
+        time.sleep(0.05)
+        rt_metrics.ingest_wire_snapshot("feedface", rt_metrics.wire_snapshot(),
+                                        source="agent-1")
+        rates = rt_metrics.node_rates("tel_wire_counter")
+        assert rates.get("feedface", 0) > 0
+        assert rt_metrics.node_counter("tel_wire_counter")["feedface"] >= 107
+    finally:
+        rt_metrics.drop_remote_snapshot("feedface")
+    assert 'node_id="feedface"' not in rt_metrics.prometheus_text()
+
+
+def test_malformed_push_cannot_poison_the_scrape():
+    """A buggy/skewed pusher degrades to missing series — /metrics and
+    node_io_view keep rendering (shape-sanitized at ingest)."""
+    c = rt_metrics.Counter("tel_sane")
+    c.inc(1)
+    good = rt_metrics.wire_snapshot()
+    rt_metrics.ingest_wire_snapshot("badbeef", {"not": "a list"}, source="x")
+    rt_metrics.ingest_wire_snapshot("badbeef", [["oops", "counter"]],
+                                    source="y")
+    rt_metrics.ingest_wire_snapshot(
+        "badbeef",
+        [["m", "counter", [[[["k", "v"]], True], "junk",
+                           [[["k", "v"]], 3.0]]]] + good, source="z")
+    try:
+        text = rt_metrics.prometheus_text()  # must not raise
+        assert 'm{k="v",node_id="badbeef",src="z"} 3.0' in text
+        assert rt_metrics.node_rates("tel_sane") is not None
+    finally:
+        rt_metrics.drop_remote_snapshot("badbeef")
+
+
+# --------------------------------------------------------- flight recorder
+def test_flight_recorder_roundtrip():
+    flight_recorder.record("tel_sub", "thing_happened", detail="x", n=3)
+    evs = flight_recorder.records("tel_sub")
+    assert evs and evs[-1]["event"] == "thing_happened"
+    assert evs[-1]["n"] == 3 and evs[-1]["ts"] > 0
+    # incremental drain ships each event once
+    evs, cursor = flight_recorder.drain_since(0)
+    again, cursor2 = flight_recorder.drain_since(cursor)
+    assert again == [] and cursor2 == cursor
+    # remote ingest tags origin
+    flight_recorder.ingest_remote("cafe01", [
+        {"seq": 1, "ts": time.time(), "subsystem": "plane",
+         "event": "holder_failover", "holder": "h:1"}])
+    remote = [e for e in flight_recorder.records("plane")
+              if e.get("node_id") == "cafe01"]
+    assert remote and remote[-1]["event"] == "holder_failover"
+    # bounded ring
+    for i in range(400):
+        flight_recorder.record("tel_ring", "e", i=i)
+    ring = flight_recorder.records("tel_ring", limit=10_000)
+    assert len(ring) == flight_recorder.MAX_EVENTS_PER_SUBSYSTEM
+    # dump is exercisable (fatal-error path)
+    import io
+
+    buf = io.StringIO()
+    flight_recorder.dump(buf)
+    assert "flight recorder" in buf.getvalue()
+
+
+def test_holder_failover_recorded(session):
+    """Acceptance: a holder failing mid-pull lands a flight-recorder event
+    (and the pull completes off the surviving holder). The failing holder
+    is deterministic: its chunk handler always answers ObjectLostError."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.core import rpc as wire
+    from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+    from ray_tpu.core.shm_store import SharedMemoryStore
+    from ray_tpu.exceptions import ObjectLostError
+
+    nbytes = 4 << 20
+    store = SharedMemoryStore(f"/rtpu_tel_src_{os.getpid()}",
+                              size=nbytes + (8 << 20), owner=True)
+    payload = np.random.default_rng(0).bytes(nbytes)
+    oid = ObjectID(os.urandom(ObjectID.SIZE))
+    store.put_bytes(oid, payload)
+    good = ObjectPlaneServer(store)
+
+    def h_meta(peer, msg):
+        return {"size": nbytes}
+
+    def h_chunk_raw(peer, msg):
+        raise ObjectLostError("holder killed mid-pull (test)")
+
+    def h_chunk(peer, msg):
+        raise ObjectLostError("holder killed mid-pull (test)")
+
+    bad = wire.RpcServer(handlers={
+        "obj_meta": h_meta, "obj_chunk_raw": h_chunk_raw,
+        "obj_chunk": h_chunk, "obj_done": lambda p, m: True})
+    client = PlaneClient(stripe_min_bytes=1, stripe_holders=2)
+    try:
+        bad_addr = "%s:%d" % bad.address
+        before = len([e for e in flight_recorder.records("plane")
+                      if e["event"] == "holder_failover"])
+        blob = client.pull([bad_addr, good.address], oid, timeout=30)
+        assert blob is not None and bytes(blob) == payload
+        failovers = [e for e in flight_recorder.records("plane")
+                     if e["event"] == "holder_failover"]
+        assert len(failovers) > before
+        assert failovers[-1]["holder"] == bad_addr
+    finally:
+        client.close()
+        bad.close()
+        good.close()
+        store.close()
+
+
+# ------------------------------------------------------------ wire op + push
+def test_metrics_push_version_gated():
+    """Mixed-version: metrics_push is since=5 — an old-wire connection may
+    not carry it (outbound raises WireVersionError; agents check and skip)."""
+    from ray_tpu.core import rpc
+    from ray_tpu.core.rpc import schema
+
+    spec = schema.get_op("metrics_push")
+    assert spec.since == 5
+    srv = rpc.RpcServer(handlers={"ping": lambda p, m: "pong"})
+    try:
+        old = rpc.connect(*srv.address, name="old-agent", versions=(1, 4))
+        assert old.negotiated_version == 4
+        with pytest.raises(schema.WireVersionError):
+            old.notify("metrics_push", snap=[])
+        old.close()
+    finally:
+        srv.close()
+
+
+def test_live_cluster_metrics_push_and_node_io_view():
+    """Acceptance: over a live 2-node session the head's /metrics serves
+    series recorded on the agent node tagged node_id, and node_io_view()
+    returns a non-empty per-node bandwidth/queue-depth view."""
+    os.environ["RAY_TPU_METRICS_PUSH_PERIOD_S"] = "0.5"
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        nid = cluster.add_node(num_cpus=2, real_process=True,
+                               isolated_plane=True)
+
+        @ray_tpu.remote(scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id=nid.hex()))
+        def make():
+            return np.arange(1_000_000)  # ~8 MB, sealed on the agent node
+
+        arr = ray_tpu.get(make.remote(), timeout=180)  # head pulls it over
+        assert arr.shape == (1_000_000,)
+
+        deadline = time.monotonic() + 30
+        text = ""
+        while time.monotonic() < deadline:
+            text = rt_metrics.prometheus_text()
+            if (f'node_id="{nid.hex()}"' in text
+                    and "ray_tpu_rpc_op_latency_ms" in text):
+                break
+            time.sleep(0.5)
+        agent_lines = [ln for ln in text.splitlines()
+                       if f'node_id="{nid.hex()}"' in ln]
+        assert agent_lines, "no agent-pushed series reached the head"
+        assert any("ray_tpu_rpc_op_latency_ms" in ln for ln in agent_lines)
+
+        view = state.node_io_view()
+        assert view["nodes"], "node_io_view empty"
+        assert nid.hex() in view["nodes"]
+        head_row = view["nodes"]["head"]
+        # the head pulled the task result from the agent's plane
+        assert head_row["pull_bytes_total"] >= 8_000_000
+        for key in ("pull_bandwidth_bps", "pending_pull_bytes",
+                    "reactor_queue_depth", "sched_running_tasks"):
+            assert key in head_row
+        assert "sched_pending_tasks" in view
+    finally:
+        cluster.shutdown()
+        os.environ.pop("RAY_TPU_METRICS_PUSH_PERIOD_S", None)
+
+
+def test_dashboard_flight_records_and_node_io(session):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard.head import Dashboard
+
+    flight_recorder.record("tel_dash", "visible_event", marker="dash-test")
+    dash = Dashboard(port=8271)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:8271{path}", timeout=10) as r:
+                return json.loads(r.read())
+
+        evs = get("/api/v0/flight_records?subsystem=tel_dash")
+        assert any(e.get("marker") == "dash-test" for e in evs)
+        view = get("/api/v0/node_io")
+        assert "nodes" in view and "head" in view["nodes"]
+        # cluster scrape is served from /metrics (text)
+        with urllib.request.urlopen(
+                "http://127.0.0.1:8271/metrics", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        dash.stop()
+
+
+# ----------------------------------------------------------------- tracing
+def test_trace_context_links_submit_and_execute(session):
+    """Satellite: the execute-side span joins the driver's submit span —
+    one connected trace per remote call instead of disjoint roots."""
+    from ray_tpu.util import tracing
+
+    tracing.clear()
+    tracing.enable_tracing()
+    try:
+        @ray_tpu.remote
+        def traced(x):
+            return x * 2
+
+        assert ray_tpu.get(traced.remote(21), timeout=120) == 42
+        spans = tracing.spans()
+        subs = [s for s in spans if s.name == "submit::traced"]
+        execs = [s for s in spans if s.name == "task::traced"]
+        assert subs and execs
+        assert execs[-1].trace_id == subs[-1].trace_id
+        assert execs[-1].parent_id == subs[-1].span_id
+        # actor methods link too
+        @ray_tpu.remote
+        class T:
+            def m(self):
+                return 1
+
+        t = T.remote()
+        assert ray_tpu.get(t.m.remote(), timeout=120) == 1
+        spans = tracing.spans()
+        sub_m = [s for s in spans if s.name == "submit::m"]
+        exec_m = [s for s in spans if s.name.endswith("T.m")]
+        assert sub_m and exec_m
+        assert exec_m[-1].trace_id == sub_m[-1].trace_id
+        ray_tpu.kill(t)
+    finally:
+        tracing.disable_tracing()
+        tracing.clear()
+
+
+def test_span_parent_ctx_cross_process_shape():
+    """span(parent_ctx=...) records under a remote parent even where local
+    enablement lagged (the propagated context IS the opt-in)."""
+    from ray_tpu.util import tracing
+
+    tracing.disable_tracing()
+    tracing.clear()
+    with tracing.span("child", parent_ctx=("a" * 32, "b" * 16)) as s:
+        assert s is not None
+    rec = tracing.spans()[-1]
+    assert rec.trace_id == "a" * 32 and rec.parent_id == "b" * 16
+    tracing.clear()
+
+
+# ------------------------------------------------------- hot-path contracts
+def test_dag_steps_counter_advances(session):
+    """Compiled-graph loops flush sampled step counts into the registry
+    (and the loop module stays registry-free per the lint)."""
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote(isolate_process=False)
+    class S:
+        def f(self, x):
+            return x + 1
+
+    a = S.remote()
+    ray_tpu.get(a.f.remote(0))
+    with InputNode() as inp:
+        node = a.f.bind(inp)
+    compiled = node.experimental_compile()
+    try:
+        m = rt_metrics.get_metric("ray_tpu_dag_steps_total")
+        before = sum(m.snapshot().values()) if m else 0
+        for i in range(40):
+            assert compiled.execute(i).get(timeout=60) == i + 1
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+    m = rt_metrics.get_metric("ray_tpu_dag_steps_total")
+    assert m is not None
+    assert sum(m.snapshot().values()) >= before + 32  # at least one flush
+
+
+def test_rpc_latency_histogram_recorded(session):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote(), timeout=120)
+    m = rt_metrics.get_metric("ray_tpu_rpc_op_latency_ms")
+    # any live session makes control-plane calls (hello/register at least
+    # when agents exist; worker client calls otherwise). The instrument must
+    # exist and be a histogram keyed by op.
+    assert m is not None and "op" in m.tag_keys
