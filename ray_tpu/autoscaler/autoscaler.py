@@ -17,6 +17,35 @@ from typing import Optional
 from ray_tpu.autoscaler.node_provider import InstanceStatus, NodeProvider
 
 
+# Standing demand: resource shapes a consumer needs SOON but has not yet
+# queued as tasks — an elastic gang REFORMING after a preemption submits no
+# member tasks until capacity exists, so without this the reconciler would
+# see zero demand and never launch the replacement node (the chicken-and-egg
+# the reference solves with cluster resource constraints /
+# request_resources()). Keyed so each consumer owns its entry.
+_STANDING_DEMAND: dict[str, list] = {}
+_SD_LOCK = threading.Lock()
+
+
+def register_standing_demand(key: str, shapes: "list[dict]") -> None:
+    """Declare resource shapes the autoscaler should provision for even
+    though no task/PG currently carries them (ray.autoscaler.sdk
+    request_resources analog). Replaces any prior entry under ``key``."""
+    with _SD_LOCK:
+        _STANDING_DEMAND[key] = [dict(s) for s in shapes]
+
+
+def clear_standing_demand(key: str) -> None:
+    with _SD_LOCK:
+        _STANDING_DEMAND.pop(key, None)
+
+
+def standing_demand() -> "list[dict]":
+    with _SD_LOCK:
+        return [dict(s) for shapes in _STANDING_DEMAND.values()
+                for s in shapes]
+
+
 @dataclass
 class NodeTypeConfig:
     name: str
@@ -67,6 +96,7 @@ class Autoscaler:
             if pg.state == "PENDING":
                 for b in pg.bundles:
                     demand.append(dict(b.resources))
+        demand.extend(standing_demand())
         return demand
 
     def _feasible_now(self, shape: dict[str, float]) -> bool:

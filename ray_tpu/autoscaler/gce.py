@@ -34,6 +34,25 @@ logger = logging.getLogger("ray_tpu")
 TPU_API = "https://tpu.googleapis.com/v2"
 METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
                       "instance/service-accounts/default/token")
+# The VM-local preemption signal: flips to "TRUE" when GCE schedules this
+# VM for reclaim (spot/preemptible TPU-VMs get ~30s of notice). Polled by
+# the node agent's watcher thread (node_agent.py) and the driver-side
+# watcher (train/elastic.py) — reference: the ray spot-drain handler
+# reading the same endpoint.
+PREEMPTED_METADATA_URL = ("http://metadata.google.internal/computeMetadata/"
+                          "v1/instance/preempted")
+
+
+def poll_preempted(url: str = PREEMPTED_METADATA_URL,
+                   timeout: float = 5.0) -> bool:
+    """One metadata-server probe: True iff the VM has a preemption notice.
+    Unreachable metadata (not on GCE, CI) reads as 'not preempted'."""
+    req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode("utf-8", "replace").strip().upper() == "TRUE"
+    except Exception:
+        return False
 
 # TPU node state -> instance FSM (reference: gcp/node.py GCPTPUNode.is_running
 # / autoscaler v2 reconciler states, reconciler.py:59)

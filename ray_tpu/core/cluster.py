@@ -228,6 +228,7 @@ class ControlPlane:
             "register_node": self._h_register_node,
             "heartbeat": self._h_heartbeat,
             "metrics_push": self._h_metrics_push,
+            "preempt_notice": self._h_preempt_notice,
             "client_submit": self._h_client_submit,
             "client_get": self._h_client_get,
             "client_put": self._h_client_put,
@@ -401,6 +402,13 @@ class ControlPlane:
         rt.scheduler.retry_pending_pgs()
         logger.info("node agent registered: %s pid=%s resources=%s",
                     nid.hex()[:12], msg.get("pid"), msg["resources"])
+        try:
+            # capacity-arrival event: elastic gangs REFORMING at reduced
+            # world size wake on this instead of polling the scheduler
+            rt.publisher.publish("nodes", {"node_id": nid.hex(),
+                                           "event": "registered"})
+        except Exception:
+            pass
         return {
             "node_id": nid.binary(),
             "shm_name": rt.shm_store.name if rt.shm_store else None,
@@ -480,6 +488,14 @@ class ControlPlane:
             # PR-2 closed for pending_gets)
             peer.meta.pop("metrics_source", None)
             _metrics.drop_remote_snapshot(node_hex, source)
+
+    def _h_preempt_notice(self, peer: RpcPeer, msg: dict):
+        """v6: the sending agent's VM got a provider preemption notice —
+        cordon the node and fan the event out (see Runtime.on_preempt_notice)."""
+        nid = peer.meta.get("node_id")
+        if nid is not None:
+            self.runtime.on_preempt_notice(nid, msg.get("deadline_s"))
+        return True
 
     # ---- worker/client object plane
     def _h_client_get(self, peer: RpcPeer, msg: dict):

@@ -150,6 +150,52 @@ def main() -> None:
                 pass
         return True
 
+    plane_client_box: dict = {}  # lazy PlaneClient shared by replications
+
+    def h_plane_replicate(peer, msg):
+        """v6 replication hint: pull a copy of the object from the given
+        holder endpoints into THIS node's store, pin it, and announce the
+        new location (elastic-gang checkpoint shards: a preempted holder
+        must not take the only copy with it). Deferred-Future reply — the
+        pull can take seconds and must not park a reactor slot."""
+        from concurrent.futures import Future as _Future
+
+        if local_store is None:
+            raise RuntimeError(
+                "plane_replicate needs an isolated-plane node store")
+        out: _Future = _Future()
+
+        def work():
+            try:
+                from ray_tpu.core.object_plane import PlaneClient
+
+                client = plane_client_box.get("client")
+                if client is None:
+                    client = plane_client_box["client"] = PlaneClient()
+                oid = ObjectID(msg["oid"])
+                view, how = client.pull_into_or_pull(
+                    list(msg["addrs"]), oid, local_store)
+                if view is None:
+                    raise RuntimeError("no holder still had the object")
+                size = len(view)
+                if how == "pulled":
+                    # store couldn't take it zero-copy (full): land the
+                    # pulled buffer the plain way so the replica is real
+                    local_store.put_bytes(oid, view)
+                local_store.pin(oid)
+                with pinned_lock:
+                    pinned_objects[msg["oid"]] = size
+                # the head records the new location when this reply lands
+                # (single directory writer); re-announce after a head
+                # restart rides the register_node plane_objects list
+                out.set_result(size)
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        __import__("threading").Thread(
+            target=work, daemon=True, name="plane-replicate").start()
+        return out
+
     def h_task_blocked(peer, msg):
         """Head relays a worker's blocked-in-get announcement: yank the
         blocked worker's queued tasks so they run on other workers."""
@@ -178,6 +224,7 @@ def main() -> None:
         "execute_task": h_execute_task,
         "task_blocked": h_task_blocked,
         "plane_free": h_plane_free,
+        "plane_replicate": h_plane_replicate,
         "kill_worker": h_kill_worker,
         "num_alive": h_num_alive,
         "ping": h_ping,
@@ -317,6 +364,46 @@ def main() -> None:
             print(f"node agent: metrics push failed: {e!r}",
                   file=sys.stderr, flush=True)
 
+    # GCE preemption-notice watcher: poll the VM-local metadata endpoint
+    # and flag once it reads preempted. The NOTIFY to the head rides the
+    # heartbeat loop (robust across reconnects — the watcher thread never
+    # touches the possibly-rebound peer). Enabled by RAY_TPU_PREEMPT_WATCH=1
+    # (TPU-VM provisioning sets it) or an explicit override URL (tests).
+    preempt_box = {"pending": False, "sent": False}
+    preempt_url = os.environ.get("RAY_TPU_PREEMPT_METADATA_URL")
+    if preempt_url or os.environ.get("RAY_TPU_PREEMPT_WATCH") == "1":
+        from ray_tpu.autoscaler import gce as _gce
+
+        watch_url = preempt_url or _gce.PREEMPTED_METADATA_URL
+        watch_period = float(os.environ.get(
+            "RAY_TPU_PREEMPT_POLL_PERIOD_S", "1.0"))
+
+        def _preempt_watch():
+            while not preempt_box["pending"]:
+                if _gce.poll_preempted(watch_url, timeout=watch_period + 4):
+                    from ray_tpu.util import flight_recorder
+
+                    flight_recorder.record("cluster", "preempt_notice_local",
+                                           pid=os.getpid())
+                    preempt_box["pending"] = True
+                    return
+                time.sleep(watch_period)
+
+        __import__("threading").Thread(
+            target=_preempt_watch, daemon=True,
+            name="preempt-watch").start()
+
+    def _maybe_send_preempt(p) -> None:
+        if not preempt_box["pending"] or preempt_box["sent"]:
+            return
+        if (p.negotiated_version or 0) < 6:
+            return  # old head: since-gated op, skip quietly
+        try:
+            p.notify("preempt_notice", deadline_s=30.0)
+            preempt_box["sent"] = True
+        except wire.PeerDisconnected:
+            pass  # retried next heartbeat after reconnect
+
     # Heartbeat; on head loss, try to reconnect to the SAME address for a
     # grace window — a restarted head (durable GCS store, same token)
     # re-registers this node and its pinned plane objects. Exceeding the
@@ -329,6 +416,7 @@ def main() -> None:
             try:
                 peer.notify("heartbeat", stats=_node_stats())
                 _maybe_push_metrics(peer)
+                _maybe_send_preempt(peer)
             except wire.PeerDisconnected:
                 pass
             if peer.closed:

@@ -32,6 +32,13 @@ Version history:
   metrics-registry snapshots + flight-recorder events to the head; the
   head's /metrics becomes a true cluster scrape). A <v5 agent simply never
   pushes; the head still has its heartbeat-borne physical stats.
+- v6: elastic gangs — ``preempt_notice`` (an agent's metadata watcher tells
+  the head its VM got a provider preemption notice; the head cordons the
+  node and publishes the event for gang managers to drain proactively) and
+  ``plane_replicate`` (head asks an agent to pull a copy of a plane object
+  into its local store — checkpoint-shard replication, so a preempted
+  holder doesn't take the only copy with it). A <v6 agent neither sends
+  notices nor serves replication; replication falls back to a head pull.
 """
 
 from __future__ import annotations
@@ -41,7 +48,7 @@ from typing import Optional
 
 # The schema version this build speaks, and the oldest it can fall back to.
 # Peers negotiate min(max_a, max_b) at hello; see negotiate().
-WIRE_VERSION = 5
+WIRE_VERSION = 6
 WIRE_VERSION_MIN = 1
 
 # Protocol magic sent in the hello frame: rejects foreign/legacy peers with
@@ -368,3 +375,20 @@ register_op(56, "metrics_push", [
     doc="agent -> head (notify): compact metrics-registry snapshot "
         "(util/metrics.wire_snapshot) + new flight-recorder events; the "
         "head merges both under the sender's node_id")
+
+# -- elastic gangs (v6; reference: GCS node-death pub/sub + the Podracer
+#    pattern of restartable actor fleets). Version-gated so a <v6 agent is
+#    never asked to replicate and a <v6 head never sees a notice op.
+register_op(57, "preempt_notice", [
+    _f("deadline_s", T.FLOAT)], since=6,
+    doc="agent -> head (notify): this node's VM received a provider "
+        "preemption notice (GCE metadata 'preempted'); the head cordons "
+        "the node and publishes a nodes-channel event so elastic gangs "
+        "checkpoint + drain before the capacity vanishes")
+register_op(58, "plane_replicate", [
+    _f("oid", T.BYTES, required=True), _f("addrs", T.ANY, required=True),
+    _f("size", T.INT)], since=6, blocking=True,
+    doc="head -> agent: pull a replica of a plane object from the given "
+        "holder endpoints into this node's local store and pin it "
+        "(checkpoint-shard replication); replies True once the copy is "
+        "sealed and announced via object_added")

@@ -770,6 +770,89 @@ class Runtime:
             pairs.append((None, self.plane_server.address))
         return pairs
 
+    def ensure_plane_replicas(self, oid: ObjectID, copies: int = 2,
+                              timeout: float = 30.0) -> int:
+        """Replication hint for the object plane: make sure at least
+        ``copies`` holders (node stores + the head's spill-backed store)
+        have ``oid``, so a preempted/killed holder doesn't take the only
+        copy with it (elastic-gang checkpoint shards; reference: the
+        object manager's multi-location durability story).
+
+        Prefers replicating onto OTHER agents' local stores (the v6
+        ``plane_replicate`` op — the agent pulls straight from current
+        holders, zero-copy), and falls back to pulling a copy into the
+        head's own store (which the spill manager backs with disk).
+        Returns the holder count actually reached (best-effort: a session
+        with one node can never reach 2)."""
+        with self._lock:
+            holders = set(self._plane_locations.get(oid, ()))
+        head_has = (
+            (self.shm_store is not None and self.shm_store.contains(oid))
+            or (self.spill is not None and self.spill.is_spilled(oid))
+        )
+        have = len(holders) + (1 if head_has else 0)
+        if have >= copies:
+            return have
+        addrs = self.plane_holder_addrs(oid)
+        if not addrs:
+            return have  # nothing plane-resident to replicate from
+        size = 0
+        obj = self.memory_store.get_if_exists(oid)
+        if obj is not None:
+            size = obj.size or 0
+        wire_addrs = [a for _, a in addrs]
+        # candidate agents: plane-capable, alive, not already holding it
+        with self._lock:
+            candidates = [nid for nid in self._plane_addrs
+                          if nid not in holders and nid in self._agents]
+        for nid in candidates:
+            if have >= copies:
+                break
+            agent = self._agents.get(nid)
+            if agent is None or agent.closed:
+                continue
+            if (agent.negotiated_version or 0) < 6:
+                continue  # old-wire agent: cannot serve plane_replicate
+            try:
+                got = agent.call("plane_replicate", oid=oid.binary(),
+                                 addrs=wire_addrs, size=size, timeout=timeout)
+                if got:
+                    # replica sealed + pinned on the agent: record the new
+                    # location (the directory has a single writer — here)
+                    self.plane_object_added(oid, nid, size=int(got))
+                    have += 1
+            except Exception as e:
+                logger.debug("plane replicate to %s failed: %s",
+                             nid.hex()[:12], e)
+        if have < copies and not head_has:
+            # head copy: durable via the spill manager even under store
+            # pressure (the ObjectPlaneServer serves spilled objects too)
+            if self._pull_from_plane(oid) is not None:
+                have += 1
+        return have
+
+    def on_preempt_notice(self, node_id: NodeID,
+                          deadline_s: "float | None" = None) -> None:
+        """A node's VM received a provider preemption notice (GCE metadata
+        'preempted'): cordon it so new work avoids it, and publish the
+        event so elastic gangs checkpoint + drain BEFORE the capacity
+        vanishes (reference: spot-instance drain-before-reclaim)."""
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("cluster", "preempt_notice",
+                               node_id=node_id.hex(),
+                               deadline_s=float(deadline_s or 0.0))
+        try:
+            self.scheduler.drain_node(node_id)
+        except Exception:
+            pass
+        try:
+            self.publisher.publish("nodes", {
+                "node_id": node_id.hex(), "event": "preempt_notice",
+                "deadline_s": deadline_s})
+        except Exception:
+            pass
+
     def _pull_from_plane(self, oid: ObjectID):
         """Chunk-pull a node-held object into the head's store (secondary,
         unpinned copy — evictable; the holder keeps the pinned primary).

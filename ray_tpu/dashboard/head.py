@@ -287,6 +287,13 @@ class Dashboard:
 
             return web.json_response(jsonable(st.node_io_view()))
 
+        async def gang(request):
+            """Live elastic gangs: phase, membership epoch, world size,
+            last checkpoint step (util/state.gang_view)."""
+            from ray_tpu.util import state as st
+
+            return web.json_response(jsonable(st.gang_view()))
+
         async def serve_status(request):
             try:
                 from ray_tpu import serve
@@ -356,6 +363,7 @@ class Dashboard:
             app.router.add_get("/api/v0/tasks/{task_id:[0-9a-f]{16,}}", task_detail)
             app.router.add_get("/api/v0/flight_records", flight_records)
             app.router.add_get("/api/v0/node_io", node_io)
+            app.router.add_get("/api/v0/gang", gang)
             app.router.add_get("/api/v0/{resource}", state_list)
             app.router.add_get("/api/jobs", jobs)
             app.router.add_post("/api/jobs", job_submit)

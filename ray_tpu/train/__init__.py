@@ -4,7 +4,7 @@ Parity surface: ray.train (report/get_context/Checkpoint/ScalingConfig/RunConfig
 FailureConfig/Result) + JaxTrainer.
 """
 
-from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, PlaneCheckpoint
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -15,6 +15,17 @@ from ray_tpu.train.config import (
 )
 from ray_tpu.train.context import TrainContext, get_context, report
 from ray_tpu.train.controller import TrainController
+from ray_tpu.train.elastic import (
+    ElasticConfig,
+    GangContext,
+    GangManager,
+    GangPhase,
+    GcePreemptionWatcher,
+    get_preemption_handler,
+    reshard_arrays,
+    run_elastic,
+    shard_bounds,
+)
 from ray_tpu.train.gang import run_jax_gang
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import WorkerGroup
@@ -23,6 +34,16 @@ __all__ = [
     "run_jax_gang",
     "Checkpoint",
     "CheckpointManager",
+    "PlaneCheckpoint",
+    "ElasticConfig",
+    "GangContext",
+    "GangManager",
+    "GangPhase",
+    "GcePreemptionWatcher",
+    "get_preemption_handler",
+    "reshard_arrays",
+    "run_elastic",
+    "shard_bounds",
     "CheckpointConfig",
     "FailureConfig",
     "JaxConfig",

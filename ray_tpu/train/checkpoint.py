@@ -1,9 +1,19 @@
-"""Checkpoints: directory-based, orbax for jax pytrees, top-k retention.
+"""Checkpoints: directory-based, orbax for jax pytrees, top-k retention —
+plus the PLANE-BACKED sharded path for elastic gangs.
 
 Parity: python/ray/train — Checkpoint (train/_checkpoint.py), CheckpointManager
 (train/v2/_internal/execution/checkpoint/checkpoint_manager.py), storage via
 pyarrow.fs (storage.py:14). TPU-native: pytree state is saved with orbax
 (async-capable, shard-aware) instead of torch.save.
+
+``PlaneCheckpoint`` keeps sharded train state in the OBJECT PLANE instead of
+a filesystem: each rank ``put``s its shard (sealed into its node's store,
+spill-backed on the head), the driver replicates shards across >= 2 holders
+(``Runtime.ensure_plane_replicas``) so a preempted holder doesn't take the
+only copy with it, and restore rides the PR-5 ``pull_into`` zero-copy path
+(recv_into straight into the destination store's mapped slot — no transient
+whole-shard buffer). This is the checkpoint transport of the elastic gang
+runtime (train/elastic.py).
 """
 
 from __future__ import annotations
@@ -14,7 +24,7 @@ import shutil
 import tempfile
 import time
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Optional
 
 
 class Checkpoint:
@@ -56,6 +66,133 @@ class Checkpoint:
         return f"Checkpoint({self.path})"
 
 
+# ----------------------------------------------------------- plane-backed
+def _dumps_shard(shard: Any) -> bytes:
+    """One rank's shard -> bytes for the object plane. jax arrays are
+    host-ified first (device buffers don't pickle portably)."""
+    import cloudpickle
+
+    try:
+        import jax
+
+        shard = jax.tree_util.tree_map(
+            lambda x: __import__("numpy").asarray(x)
+            if type(x).__module__.startswith(("jax", "jaxlib")) else x,
+            shard)
+    except Exception:
+        pass  # no jax in this process: shards are already host objects
+    return cloudpickle.dumps(shard)
+
+
+def _loads_shard(blob) -> Any:
+    import cloudpickle
+
+    return cloudpickle.loads(bytes(blob) if not isinstance(blob, bytes)
+                             else blob)
+
+
+class PlaneCheckpoint:
+    """A sharded checkpoint living in the object plane: one ObjectRef per
+    rank, rank-ordered. The refs are held by whoever constructs this (the
+    gang manager on the driver), which keeps the shards alive across the
+    putting workers' deaths — a preempted rank's shard survives it.
+
+    ``from_state`` / ``to_state`` mirror the directory ``Checkpoint``'s
+    surface but move bytes through the plane instead of a filesystem."""
+
+    def __init__(self, shard_refs: list, step: int = 0, epoch: int = 0,
+                 world_size: int | None = None):
+        self.shard_refs = list(shard_refs)
+        self.step = step
+        self.epoch = epoch  # gang membership epoch that WROTE it
+        self.world_size = world_size or len(self.shard_refs)
+
+    # -- save -------------------------------------------------------------
+    @staticmethod
+    def from_state(state: Any, *, step: int = 0, epoch: int = 0,
+                   replicas: int = 0) -> "PlaneCheckpoint":
+        """Put sharded train state into the plane. ``state`` is a list of
+        per-rank shards (one ``put`` each) or a single object (one shard).
+        ``replicas`` >= 2 asks the runtime to spread each shard across
+        that many holders (head copy is spill-backed)."""
+        import ray_tpu
+
+        shards = state if isinstance(state, list) else [state]
+        refs = [ray_tpu.put(_dumps_shard(s)) for s in shards]
+        ckpt = PlaneCheckpoint(refs, step=step, epoch=epoch)
+        if replicas > 1:
+            ckpt.replicate(replicas)
+        return ckpt
+
+    @staticmethod
+    def save_shard(shard: Any) -> "tuple[Any, int]":
+        """Worker-side: put ONE rank's shard; returns (ref, nbytes). The
+        caller ships the ref's id to the gang manager (pubsub), which
+        re-holds it driver-side before this worker can die with it."""
+        import ray_tpu
+
+        blob = _dumps_shard(shard)
+        return ray_tpu.put(blob), len(blob)
+
+    def replicate(self, copies: int = 2) -> None:
+        """Driver-side: ensure every shard has >= ``copies`` holders (other
+        agents' stores via the v6 plane_replicate op, head store + spill as
+        the fallback). Best-effort: a one-node session caps at 1."""
+        from ray_tpu.core.runtime import get_runtime_or_none
+
+        rt = get_runtime_or_none()
+        if rt is None or not hasattr(rt, "ensure_plane_replicas"):
+            return  # client-runtime driver: replication is head business
+        for ref in self.shard_refs:
+            rt.ensure_plane_replicas(ref.object_id(), copies=copies)
+
+    # -- restore ----------------------------------------------------------
+    def to_state(self, timeout: float | None = 120.0) -> list:
+        """All shards back, rank-ordered. In a worker on an isolated-plane
+        node the transfer lands via pull_into (zero-copy) before the final
+        deserialize."""
+        import ray_tpu
+
+        blobs = ray_tpu.get(list(self.shard_refs), timeout=timeout)
+        return [_loads_shard(b) for b in blobs]
+
+    @staticmethod
+    def restore_shard_into(store, addrs: list, oid, client=None,
+                           timeout: float = 60.0):
+        """Zero-copy node-level restore of one shard: chunks land straight
+        in ``store``'s mapped slot (create_for_write -> recv_into -> seal;
+        the PR-5 BLOB path) — no transient whole-shard allocation. Returns
+        the sealed memoryview aliasing the store segment.
+
+        This is the restore primitive the elastic runtime rides implicitly
+        through ``ray_tpu.get`` (client _pull_remote prefers pull_into);
+        exposed directly so the zero-copy contract is testable and so
+        node-local tooling can restore without a session."""
+        from ray_tpu.core.object_plane import PlaneClient
+        from ray_tpu.exceptions import ObjectLostError
+
+        own = client is None
+        if own:
+            client = PlaneClient()
+        try:
+            status = client.pull_into(addrs, oid, store, timeout=timeout)
+            if status is None:
+                raise ObjectLostError(
+                    f"checkpoint shard {oid.hex()[:12]} has no live holder")
+            view = store.get_bytes(oid)
+            if view is None:
+                raise ObjectLostError(
+                    f"checkpoint shard {oid.hex()[:12]} evicted after pull")
+            return view
+        finally:
+            if own:
+                client.close()
+
+    def __repr__(self):
+        return (f"PlaneCheckpoint(step={self.step}, epoch={self.epoch}, "
+                f"shards={len(self.shard_refs)})")
+
+
 @dataclass
 class _Tracked:
     checkpoint: Checkpoint
@@ -63,8 +200,34 @@ class _Tracked:
     index: int
 
 
+def _crash_point(tag: str) -> None:
+    """Test hook: die hard (as a SIGKILLed worker would) at a named point
+    inside register() — the crash-safety test's fault injector."""
+    if os.environ.get("RAY_TPU_TEST_CKPT_CRASH") == tag:
+        os._exit(137)
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """Write-then-rename so a reader (or a crash) never sees a torn file."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 class CheckpointManager:
-    """Top-k checkpoint retention (reference: checkpoint_manager.py)."""
+    """Top-k checkpoint retention (reference: checkpoint_manager.py).
+
+    Registration is CRASH-SAFE: the checkpoint is staged into a ``.tmp``
+    directory (metrics written + fsynced inside it) and published with one
+    atomic ``os.replace``, and the latest/best pointer file is written
+    temp-then-rename — a worker SIGKILLed mid-register can leave a stale
+    ``.tmp`` (swept on the next manager start) but never a half-copied
+    checkpoint dir or a corrupt/dangling pointer."""
+
+    POINTERS = "_pointers.json"
 
     def __init__(self, storage_path: str, num_to_keep: int | None = None,
                  score_attribute: str | None = None, score_order: str = "max"):
@@ -73,8 +236,23 @@ class CheckpointManager:
         self.score_attribute = score_attribute
         self.score_order = score_order
         self._tracked: list[_Tracked] = []
-        self._index = 0
         os.makedirs(storage_path, exist_ok=True)
+        # Resume past a previous manager (or a crash): indices continue
+        # after existing checkpoints so a restart can't collide with — and
+        # silently clobber — a published dir; half-staged .tmp dirs from a
+        # mid-register kill are swept here.
+        self._index = 0
+        for name in os.listdir(storage_path):
+            full = os.path.join(storage_path, name)
+            if name.endswith(".tmp") and os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+                continue
+            if name.startswith("checkpoint_"):
+                try:
+                    self._index = max(self._index,
+                                      int(name.split("_")[1]) + 1)
+                except (IndexError, ValueError):
+                    pass
 
     def register(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
         """Persist the checkpoint into storage_path and enforce retention."""
@@ -87,16 +265,79 @@ class CheckpointManager:
             )
         dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
         if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+            tmp = dest + ".tmp"
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            shutil.copytree(checkpoint.path, tmp)
+            with open(os.path.join(tmp, "_metrics.json"), "w") as f:
+                json.dump(_jsonable(metrics), f)
+                f.flush()
+                os.fsync(f.fileno())
+            _crash_point("mid_register")  # staged but unpublished
             if os.path.exists(dest):
                 shutil.rmtree(dest)
-            shutil.copytree(checkpoint.path, dest)
-        with open(os.path.join(dest, "_metrics.json"), "w") as f:
-            json.dump(_jsonable(metrics), f)
+            os.replace(tmp, dest)  # atomic publish
+        else:
+            _atomic_write_json(os.path.join(dest, "_metrics.json"),
+                               _jsonable(metrics))
+        _crash_point("after_publish")  # published, pointer not yet updated
         tracked = _Tracked(Checkpoint(dest), metrics, self._index)
         self._tracked.append(tracked)
         self._index += 1
         self._enforce_retention()
+        self._write_pointers()
         return tracked.checkpoint
+
+    def _write_pointers(self) -> None:
+        latest = self.latest_checkpoint()
+        best = self.best_checkpoint()
+        _atomic_write_json(
+            os.path.join(self.storage_path, self.POINTERS),
+            {"latest": os.path.basename(latest.path) if latest else None,
+             "best": os.path.basename(best.path) if best else None})
+
+    @staticmethod
+    def scan(storage_path: str) -> dict:
+        """Recovery view of a storage dir: every VALID checkpoint (complete
+        dir with parseable ``_metrics.json``; ``.tmp`` stages ignored) plus
+        the pointer targets, validated — a pointer naming a missing or
+        invalid dir falls back to the newest valid checkpoint rather than
+        dangling."""
+        valid: dict[str, dict] = {}
+        if os.path.isdir(storage_path):
+            for name in sorted(os.listdir(storage_path)):
+                full = os.path.join(storage_path, name)
+                if (not name.startswith("checkpoint_")
+                        or name.endswith(".tmp") or not os.path.isdir(full)):
+                    continue
+                try:
+                    with open(os.path.join(full, "_metrics.json")) as f:
+                        valid[name] = json.load(f)
+                except (OSError, ValueError):
+                    continue  # torn/incomplete: not a real checkpoint
+        pointers = {}
+        try:
+            with open(os.path.join(storage_path,
+                                   CheckpointManager.POINTERS)) as f:
+                pointers = json.load(f)
+        except (OSError, ValueError):
+            pass
+        newest = max(valid) if valid else None
+
+        def _resolve(key):
+            name = pointers.get(key)
+            return name if name in valid else newest
+
+        out_latest = _resolve("latest")
+        return {
+            "checkpoints": {n: Checkpoint(os.path.join(storage_path, n))
+                            for n in valid},
+            "metrics": valid,
+            "latest": (Checkpoint(os.path.join(storage_path, out_latest))
+                       if out_latest else None),
+            "best": (Checkpoint(os.path.join(storage_path, _resolve("best")))
+                     if _resolve("best") else None),
+        }
 
     def _enforce_retention(self) -> None:
         if self.num_to_keep is None or len(self._tracked) <= self.num_to_keep:

@@ -79,6 +79,13 @@ class TrainController:
     def _transition(self, state: ControllerState, detail: str = "") -> None:
         self.state = state
         self.state_history.append((state.value, detail))
+        # restarts/errors are exactly the rare-but-load-bearing events the
+        # flight recorder exists for (PR-8); normal finishes ride along
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("train", "controller_transition",
+                               run=self.run_config.name or "train",
+                               state=state.value, detail=detail[:200])
 
     def run(self) -> Result:
         from ray_tpu.air.callbacks import invoke as _cb

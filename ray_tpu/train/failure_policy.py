@@ -27,19 +27,37 @@ class FailureDecision(enum.Enum):
     RAISE = "raise"    # terminal: surface the error
 
 
+def _exception_chain(err):
+    """err plus every exception reachable through TaskError.cause /
+    __cause__ / __context__ — a worker death often arrives WRAPPED
+    (TaskError(ActorError(PeerDisconnected)) at get()), and classifying
+    the wrapper alone mistakes a system fault for a user error."""
+    seen: set = set()
+    stack = [err]
+    while stack:
+        e = stack.pop()
+        if e is None or id(e) in seen or not isinstance(e, BaseException):
+            continue
+        seen.add(id(e))
+        yield e
+        stack.extend((getattr(e, "cause", None), e.__cause__, e.__context__))
+
+
 def classify_failure(err) -> FailureKind:
     """Map an attempt error to its kind. Worker-side user tracebacks arrive
-    as strings from poll(); actor/system faults arrive as raised exceptions."""
+    as strings from poll(); actor/system faults arrive as raised exceptions
+    (possibly wrapped — the whole cause chain is inspected)."""
     from ray_tpu.exceptions import ActorDiedError, ActorError
 
     from ray_tpu.train.elastic import get_preemption_handler
 
     if get_preemption_handler().should_checkpoint_and_exit():
         return FailureKind.PREEMPTED
-    if isinstance(err, (ActorDiedError, ActorError)):
-        return FailureKind.WORKER_DIED
-    if isinstance(err, (ConnectionError, OSError)):
-        return FailureKind.WORKER_DIED
+    for e in _exception_chain(err):
+        if isinstance(e, (ActorDiedError, ActorError)):
+            return FailureKind.WORKER_DIED
+        if isinstance(e, (ConnectionError, OSError)):
+            return FailureKind.WORKER_DIED
     return FailureKind.USER_ERROR
 
 
@@ -61,10 +79,25 @@ class FailurePolicy:
         if kind == FailureKind.PREEMPTED:
             limit = getattr(self.config, "max_preemption_failures", -1)
             if limit is not None and limit >= 0 and self.counts[kind] > limit:
-                return FailureDecision.RAISE
+                return self._raise(kind)
             return FailureDecision.RETRY
         budget_used = (self.counts[FailureKind.WORKER_DIED]
                        + self.counts[FailureKind.USER_ERROR])
         if budget_used > self.config.max_failures:
-            return FailureDecision.RAISE
+            return self._raise(kind)
         return FailureDecision.RETRY
+
+    def _raise(self, kind: FailureKind) -> FailureDecision:
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record(
+            "train", "retry_exhausted", kind=kind.value,
+            counts={k.value: v for k, v in self.counts.items()},
+            max_failures=self.config.max_failures)
+        return FailureDecision.RAISE
+
+    def remaining(self) -> int:
+        """Worker-died/user-error retries left (preemptions budget apart)."""
+        used = (self.counts[FailureKind.WORKER_DIED]
+                + self.counts[FailureKind.USER_ERROR])
+        return max(0, self.config.max_failures - used)

@@ -28,12 +28,41 @@ from typing import Callable, Optional
 import cloudpickle
 
 
-def _free_port() -> int:
+def _reserve_port() -> "tuple[socket.socket, int]":
+    """Bind-and-HOLD an ephemeral coordinator port: the returned socket
+    stays bound until the caller closes it at the moment of use, so two
+    gang launches on one host can't both be handed the same port (the old
+    bind/close/re-bind-later pattern had a TOCTOU window). SO_REUSEADDR
+    lets the coordinator re-bind the port immediately after the handoff
+    close (no TIME_WAIT stall)."""
     s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("", 0))
-    port = s.getsockname()[1]
+    return s, s.getsockname()[1]
+
+
+def _free_port() -> int:
+    """Kept for callers that can't hold a socket; prefer _reserve_port —
+    this variant re-opens the race it closes."""
+    s, port = _reserve_port()
     s.close()
     return port
+
+
+# Coordinator-bind failure signatures across jax/grpc versions: the rank-0
+# child's stderr when another process won the port race.
+_BIND_CONFLICT_MARKERS = (
+    "address already in use",
+    "failed to bind",
+    "errno 98",
+    "could not bind",
+    "bind address",
+)
+
+
+def _is_bind_conflict(err: BaseException) -> bool:
+    s = str(err).lower()
+    return any(m in s for m in _BIND_CONFLICT_MARKERS)
 
 
 def _local_ip() -> str:
@@ -152,21 +181,53 @@ def _launch_gang(fn_blobs: list, env_for_rank, devices_per_worker: int,
                  coordinator_port: Optional[int] = None,
                  member_name: str = "jax_gang_member") -> list:
     """Shared launch scaffolding for single- and multi-slice gangs: one
-    coordinator, one runtime task per rank, rank-ordered results."""
+    coordinator, one runtime task per rank, rank-ordered results.
+
+    The coordinator port is RESERVED (socket held, released just before the
+    members launch) and a rank-0 bind conflict — some other process grabbed
+    the port in the remaining handoff window — retries the whole launch on
+    a fresh port instead of failing the gang. An explicitly requested
+    ``coordinator_port`` is never silently replaced."""
     import ray_tpu
 
     num_workers = len(fn_blobs)
-    port = coordinator_port or _free_port()
-    coordinator = f"{_local_ip()}:{port}"
-    member = ray_tpu.remote(num_cpus=0.1, name=member_name)(_gang_member)
-    refs = [
-        member.remote(rank, num_workers, coordinator, devices_per_worker,
-                      fn_blobs[rank], env_for_rank(rank, coordinator),
-                      use_tpu, timeout)
-        for rank in range(num_workers)
-    ]
-    blobs = ray_tpu.get(refs, timeout=timeout)
-    return [cloudpickle.loads(b) for b in blobs]
+    attempts = 1 if coordinator_port else 3
+    last_err: BaseException | None = None
+    for _attempt in range(attempts):
+        if coordinator_port:
+            reserved, port = None, coordinator_port
+        else:
+            reserved, port = _reserve_port()
+        coordinator = f"{_local_ip()}:{port}"
+        member = ray_tpu.remote(num_cpus=0.1, name=member_name)(_gang_member)
+        if reserved is not None:
+            reserved.close()  # handoff: rank 0's coordinator binds it next
+        refs = [
+            member.remote(rank, num_workers, coordinator, devices_per_worker,
+                          fn_blobs[rank], env_for_rank(rank, coordinator),
+                          use_tpu, timeout)
+            for rank in range(num_workers)
+        ]
+        try:
+            blobs = ray_tpu.get(refs, timeout=timeout)
+            return [cloudpickle.loads(b) for b in blobs]
+        except Exception as e:
+            if coordinator_port is None and _is_bind_conflict(e):
+                # cancel the failed attempt's survivors BEFORE retrying:
+                # ranks 1..N-1 are still blocked in jax.distributed
+                # initialize toward a coordinator that will never exist,
+                # holding their devices/resources for the whole timeout
+                for ref in refs:
+                    try:
+                        ray_tpu.cancel(ref, force=True)
+                    except Exception:
+                        pass
+                last_err = e  # port raced away in the handoff window
+                continue
+            raise
+    raise RuntimeError(
+        f"gang coordinator port collided {attempts} times"
+    ) from last_err
 
 
 def run_multislice_gang(

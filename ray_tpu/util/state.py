@@ -84,6 +84,15 @@ def flight_records(subsystem: Optional[str] = None,
     return flight_recorder.records(subsystem, limit)
 
 
+def gang_view() -> list[dict]:
+    """Live elastic gangs (train/elastic.py GangManager): phase, membership
+    epoch, world size, last checkpoint step, member placement — the
+    state-API face of the gang lifecycle (served at /api/v0/gang)."""
+    from ray_tpu.train import elastic
+
+    return elastic.gang_view()
+
+
 # per-metric previous sample for the HEAD's own rate estimation (remote
 # nodes get rates from consecutive metrics_push deltas; the head has no
 # pusher, so consecutive node_io_view() calls carry the baseline)

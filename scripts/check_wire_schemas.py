@@ -61,6 +61,9 @@ SCHEMA_BASELINE = {
     "dag_ch_read": 55,
     # ISSUE-8 (wire v5): cluster telemetry plane
     "metrics_push": 56,
+    # ISSUE-10 (wire v6): elastic gangs — preemption notices + checkpoint
+    # shard replication
+    "preempt_notice": 57, "plane_replicate": 58,
 }
 
 # Files whose handler tables must be fully schema'd.
@@ -425,6 +428,29 @@ def check_hot_path_instruments() -> list:
     return errors
 
 
+def check_elastic_ops() -> list:
+    """The v6 elastic-gang ops are version-gated: a <v6 agent must never be
+    asked to serve ``plane_replicate`` (it has no handler), and a <v6 head
+    must never receive ``preempt_notice`` (undecodable op number) — the
+    sender checks ``negotiated_version`` before using either."""
+    from ray_tpu.core.rpc import schema
+
+    errors = []
+    for op in ("preempt_notice", "plane_replicate"):
+        spec = schema.REGISTRY.get(op)
+        if spec is None:
+            errors.append(f"{op} schema missing — elastic gang wire gone?")
+        elif spec.since < 6:
+            errors.append(f"{op} gated since={spec.since} < 6 — an old-wire "
+                          "peer would receive an op it cannot serve/decode")
+    spec = schema.REGISTRY.get("plane_replicate")
+    if spec is not None and not spec.blocking:
+        errors.append("plane_replicate must be blocking=True — the agent "
+                      "handler parks on a whole-object pull and must not "
+                      "occupy a bounded reactor slot")
+    return errors
+
+
 def run_all() -> None:
     errors = check_registry()
     errors += check_handlers_have_schemas()
@@ -432,6 +458,7 @@ def run_all() -> None:
     errors += check_blob_zero_copy()
     errors += check_dag_loop_steady_state()
     errors += check_hot_path_instruments()
+    errors += check_elastic_ops()
     if errors:
         _fail(errors)
     from ray_tpu.core.rpc import schema

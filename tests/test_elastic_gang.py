@@ -1,0 +1,788 @@
+"""Elastic gang runtime tests (ISSUE 10): chaos-tested re-formation with
+object-plane checkpoints.
+
+The chaos scenarios run REAL node agents (Cluster real_process=True) and
+SIGKILL them mid-epoch: loss detection is event-driven through the head's
+agent-expiry path (socket EOF / missed heartbeats -> on_node_death -> the
+"nodes" pub/sub channel) — every assert below waits on condition variables
+(wait_for_phase / wait_for_checkpoint), no fixed sleep polling anywhere in
+the assert path. Seeded RNG; CPU process gangs; budget well under 60s.
+"""
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, PlaneCheckpoint
+from ray_tpu.train.elastic import (
+    ElasticConfig,
+    GangManager,
+    GangPhase,
+    GcePreemptionWatcher,
+    PreemptionHandler,
+    reshard_arrays,
+    shard_bounds,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- trainers
+def _make_trainer(dim: int, steps: int, ckpt_every: int, lr: float = 0.1,
+                  step_sleep: float = 0.04, resumed_sleep: float = 0.004):
+    """A deterministic sharded trainer: each rank owns a contiguous slice
+    of a parameter vector and descends toward a fixed target — the global
+    loss after s steps is a closed form independent of the sharding, so
+    step/loss continuity across re-formation is exactly assertable.
+
+    Membership epoch 1 runs slow (the chaos kill can never race the
+    epoch's completion); re-formed epochs run fast (test stays in budget).
+    """
+
+    def trainer(ctx):
+        import time as _t
+
+        import numpy as _np
+
+        sleep_s = step_sleep if ctx.membership_epoch == 1 else resumed_sleep
+        target = _np.linspace(0.5, 1.5, dim)
+        shards = ctx.restore_shards()
+        if shards is None:
+            w_full = _np.zeros(dim)
+        else:
+            # state re-sharded from the SURVIVING checkpoint shards (old
+            # world size) onto this epoch's world size
+            w_full = _np.concatenate([_np.asarray(s) for s in shards])
+        lo, hi = shard_bounds(dim, ctx.rank, ctx.world_size)
+        w = w_full[lo:hi].copy()
+        t = target[lo:hi]
+        loss = float(((w - t) ** 2).sum())
+        step = ctx.start_step
+        for step in range(ctx.start_step, steps):
+            w -= lr * 2.0 * (w - t)
+            loss = float(((w - t) ** 2).sum())
+            if sleep_s:
+                _t.sleep(sleep_s)
+            stop = ctx.should_stop()
+            if step % ckpt_every == 0 or step == steps - 1 or stop:
+                ctx.save(w, step, metrics={"loss": loss})
+            if stop:
+                return {"status": "stopped", "stopped_at": step,
+                        "rank": ctx.rank}
+        return {"final_loss": loss, "final_step": step, "rank": ctx.rank,
+                "world": ctx.world_size, "epoch": ctx.membership_epoch}
+
+    return trainer
+
+
+def _expected_loss(dim: int, steps: int, lr: float = 0.1) -> float:
+    target = np.linspace(0.5, 1.5, dim)
+    return float((target ** 2).sum()) * (1.0 - 2.0 * lr) ** (2 * steps)
+
+
+# ------------------------------------------------------------- chaos tests
+def test_chaos_kill_random_worker_mid_epoch_reforms_at_three():
+    """Acceptance scenario 1: a 4-worker CPU process gang; a random
+    worker's node agent is SIGKILLed mid-epoch; the gang detects the loss
+    through the agent-expiry event path, re-forms at world size 3, restores
+    from the plane-backed checkpoint, and finishes with step count and loss
+    continuity asserted."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import flight_recorder, metrics, state
+
+    random.seed(0xE1A5)
+    # lr chosen so the expected loss stays far above the float64 rounding
+    # floor (w-t decays to ~ulp(t) around 1e-16) — the closed form must
+    # hold exactly for the continuity assert
+    dim, steps, ckpt_every, lr = 120_000, 400, 25, 0.01
+    os.environ["RAY_TPU_PLANE_STORE_BYTES"] = str(64 << 20)
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"agent_heartbeat_timeout_s": 2.0})
+    cluster = Cluster(initialize_head=False)
+    mgr = None
+    try:
+        nodes = [cluster.add_node(num_cpus=1, resources={"gang": 1},
+                                  real_process=True, isolated_plane=True)
+                 for _ in range(4)]
+        mgr = GangManager(
+            _make_trainer(dim, steps, ckpt_every, lr=lr),
+            ElasticConfig(min_workers=3, max_workers=4,
+                          resources_per_worker={"CPU": 1.0, "gang": 1.0},
+                          checkpoint_replicas=2, drain_grace_s=8.0),
+            name="chaos1").start()
+        assert mgr.wait_for_phase(GangPhase.RUNNING, timeout=90)
+        assert mgr.world_size == 4
+        # gang_view serves the live gang while it runs
+        view = {g["name"]: g for g in state.gang_view()}
+        assert view["chaos1"]["world_size"] == 4
+        # wait for a complete AND replicated checkpoint, then strike
+        assert mgr.wait_for_checkpoint(min_step=ckpt_every, safe=True,
+                                       timeout=90)
+        victim_rank = random.choice(sorted(mgr.members()))
+        victim_node = mgr.members()[victim_rank]["node"]
+        os.kill(cluster.agent_pid(victim_node), signal.SIGKILL)
+        # event-driven lifecycle asserts: condition-variable waits only
+        assert mgr.wait_for_phase(GangPhase.DRAINING, timeout=30)
+        assert mgr.wait_for_phase(GangPhase.REFORMING, timeout=30)
+        assert mgr.wait_for_phase(GangPhase.RESUMED, timeout=60)
+        res = mgr.result(timeout=180)
+        assert res.world_size == 3
+        assert res.membership_epochs == 2
+        phases = [h[0] for h in res.history]
+        assert phases == ["FORMING", "RUNNING", "DRAINING", "REFORMING",
+                          "RESUMED", "RUNNING", "FINISHED"]
+        # step continuity: every rank ran to the last step of the SAME run
+        assert all(r["final_step"] == steps - 1 for r in res.results)
+        assert all(r["epoch"] == 2 for r in res.results)
+        # loss continuity: the resumed trajectory lands exactly where an
+        # uninterrupted run would (closed form, sharding-independent)
+        got = sum(r["final_loss"] for r in res.results)
+        expect = _expected_loss(dim, steps, lr=lr)
+        assert abs(got - expect) / expect < 1e-6, (got, expect)
+        # every lifecycle transition is in the flight recorder...
+        gang_events = [e["event"] for e in state.flight_records("gang")]
+        for ev in ("worker_lost", "drain", "reform", "resume",
+                   "checkpoint", "transition"):
+            assert ev in gang_events, (ev, gang_events)
+        cluster_events = [e["event"] for e in state.flight_records("cluster")]
+        assert "node_dead" in cluster_events  # the agent-expiry signal
+        # ...and as gang_* series on the /metrics scrape
+        scrape = metrics.prometheus_text()
+        for series in ("ray_tpu_gang_transitions_total",
+                       "ray_tpu_gang_workers_lost_total",
+                       "ray_tpu_gang_reforms_total",
+                       "ray_tpu_gang_checkpoints_total",
+                       "ray_tpu_gang_reform_seconds_bucket"):
+            assert series in scrape, series
+        for phase in ("DRAINING", "REFORMING", "RESUMED"):
+            assert f'ray_tpu_gang_transitions_total{{phase="{phase}"}}' \
+                in scrape
+    finally:
+        if mgr is not None:
+            mgr.shutdown()
+        cluster.shutdown()
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_PLANE_STORE_BYTES", None)
+
+
+def test_chaos_checkpoint_holder_death_restores_off_replica():
+    """Acceptance scenario 2: the node HOLDING a checkpoint shard's primary
+    copy dies; restore succeeds off the replica/spill copy (the v6
+    plane_replicate fan-out / head pull that ensure_plane_replicas did)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.runtime import get_runtime
+
+    random.seed(0xE1A6)
+    dim, steps, ckpt_every, lr = 80_000, 250, 20, 0.01
+    os.environ["RAY_TPU_PLANE_STORE_BYTES"] = str(64 << 20)
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"agent_heartbeat_timeout_s": 2.0})
+    cluster = Cluster(initialize_head=False)
+    mgr = None
+    try:
+        # 3 gang-capable nodes; the gang uses 2 — the third is the spare
+        # capacity the re-formation folds in
+        for _ in range(3):
+            cluster.add_node(num_cpus=1, resources={"gang": 1},
+                             real_process=True, isolated_plane=True)
+        mgr = GangManager(
+            _make_trainer(dim, steps, ckpt_every, lr=lr),
+            ElasticConfig(min_workers=2, max_workers=2,
+                          resources_per_worker={"CPU": 1.0, "gang": 1.0},
+                          checkpoint_replicas=2, drain_grace_s=8.0),
+            name="chaos2").start()
+        assert mgr.wait_for_phase(GangPhase.RUNNING, timeout=90)
+        assert mgr.wait_for_checkpoint(min_step=ckpt_every, safe=True,
+                                       timeout=90)
+        rt = get_runtime()
+        ckpt = mgr.last_checkpoint(safe=True)
+        # pick the victim BY the checkpoint: a member node that holds the
+        # primary copy of its own rank's shard
+        victim_rank = random.choice(sorted(mgr.members()))
+        victim_node = mgr.members()[victim_rank]["node"]
+        victim_oid = ckpt.shard_refs[victim_rank].object_id()
+        with rt._lock:
+            holders = set(rt._plane_locations.get(victim_oid, ()))
+        assert victim_node in holders, "victim must hold its shard's primary"
+        os.kill(cluster.agent_pid(victim_node), signal.SIGKILL)
+        assert mgr.wait_for_phase(GangPhase.RESUMED, timeout=90)
+        # the shard the dead node held is still restorable off the replica
+        assert rt.has_plane_copy(victim_oid) or (
+            rt.shm_store is not None and rt.shm_store.contains(victim_oid)
+        ) or (rt.spill is not None and rt.spill.is_spilled(victim_oid))
+        res = mgr.result(timeout=180)
+        assert res.world_size == 2  # spare node folded in
+        assert res.membership_epochs == 2
+        got = sum(r["final_loss"] for r in res.results)
+        expect = _expected_loss(dim, steps, lr=lr)
+        assert abs(got - expect) / expect < 1e-6, (got, expect)
+    finally:
+        if mgr is not None:
+            mgr.shutdown()
+        cluster.shutdown()
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_PLANE_STORE_BYTES", None)
+
+
+def test_preempt_notice_drains_proactively():
+    """A GCE preemption NOTICE (not yet a death) on a member's node: the
+    agent's metadata watcher tells the head (wire v6 preempt_notice), the
+    head cordons the node + publishes, and the gang checkpoints, drains,
+    and re-forms AWAY from the noticed node before capacity vanishes."""
+    import http.server
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state
+
+    dim, steps, ckpt_every = 60_000, 300, 20
+    flag = {"preempted": False}
+
+    class Meta(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"TRUE" if flag["preempted"] else b"FALSE"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Meta)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    meta_url = f"http://127.0.0.1:{httpd.server_address[1]}/preempted"
+
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"agent_heartbeat_timeout_s": 3.0})
+    cluster = Cluster(initialize_head=False)
+    mgr = None
+    try:
+        # ONLY the first agent watches the fake metadata server (env is
+        # snapshotted into the agent's process at spawn)
+        os.environ["RAY_TPU_PREEMPT_METADATA_URL"] = meta_url
+        os.environ["RAY_TPU_PREEMPT_POLL_PERIOD_S"] = "0.2"
+        doomed = cluster.add_node(num_cpus=1, resources={"gang": 1},
+                                  real_process=True)
+        os.environ.pop("RAY_TPU_PREEMPT_METADATA_URL")
+        os.environ.pop("RAY_TPU_PREEMPT_POLL_PERIOD_S")
+        safe_node = cluster.add_node(num_cpus=1, resources={"gang": 1},
+                                     real_process=True)
+        mgr = GangManager(
+            _make_trainer(dim, steps, ckpt_every),
+            ElasticConfig(min_workers=1, max_workers=2,
+                          resources_per_worker={"CPU": 1.0, "gang": 1.0},
+                          checkpoint_replicas=2, drain_grace_s=8.0),
+            name="notice").start()
+        assert mgr.wait_for_phase(GangPhase.RUNNING, timeout=90)
+        assert mgr.world_size == 2
+        assert mgr.wait_for_checkpoint(min_step=0, timeout=90)
+        flag["preempted"] = True  # the metadata server flips
+        assert mgr.wait_for_phase(GangPhase.DRAINING, timeout=30)
+        assert mgr.wait_for_phase(GangPhase.RESUMED, timeout=60)
+        res = mgr.result(timeout=180)
+        # re-formed without the noticed node
+        assert res.world_size == 1
+        assert all(m["node"] == safe_node
+                   for m in mgr.members().values())
+        events = state.flight_records("gang")
+        assert any(e["event"] == "preempt_notice" for e in events)
+        cl = state.flight_records("cluster")
+        assert any(e["event"] == "preempt_notice" for e in cl)
+    finally:
+        if mgr is not None:
+            mgr.shutdown()
+        cluster.shutdown()
+        ray_tpu.shutdown()
+        httpd.shutdown()
+
+
+# ------------------------------------------------- zero-copy restore path
+@pytest.fixture
+def plane_stores():
+    from ray_tpu.core.shm_store import SharedMemoryStore
+
+    src = SharedMemoryStore(f"/rtpu_eg_src_{os.getpid()}", size=48 << 20,
+                            owner=True)
+    dst = SharedMemoryStore(f"/rtpu_eg_dst_{os.getpid()}", size=48 << 20,
+                            owner=True)
+    try:
+        yield src, dst
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_plane_checkpoint_restore_rides_pull_into(plane_stores):
+    """Acceptance: plane-backed restore lands via pull_into — recv_into
+    straight into the destination store's slot, NO transient whole-shard
+    allocation (tracemalloc-asserted) — and the pull-bytes counter moves
+    (counter-asserted like test_bulk_plane)."""
+    from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+    from ray_tpu.util import metrics
+
+    src, dst = plane_stores
+    nbytes = 12 << 20
+    payload = np.random.default_rng(7).bytes(nbytes)
+    oid = ObjectID(os.urandom(ObjectID.SIZE))
+    src.put_bytes(oid, payload)
+    server = ObjectPlaneServer(src)
+    client = PlaneClient()
+    try:
+        counter = metrics.get_metric("ray_tpu_plane_pull_bytes_total")
+        before = sum(counter.snapshot().values())
+        tracemalloc.start()
+        view = PlaneCheckpoint.restore_shard_into(
+            dst, [server.address], oid, client=client)
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert bytes(view) == payload
+        # no transient whole-shard buffer: the only whole-shard bytes live
+        # in the (untracked) shm mapping
+        assert peak < nbytes // 2, f"transient alloc {peak} vs {nbytes}"
+        # the transfer was a real zero-copy-wire pull, counted at pull
+        # granularity
+        assert sum(counter.snapshot().values()) - before >= nbytes
+        peer = client._peers[server.address]
+        assert (peer.negotiated_version or 0) >= 3
+    finally:
+        client.close()
+        server.close()
+
+
+def test_plane_checkpoint_restore_fails_over_to_replica(plane_stores):
+    """The primary holder dies: restore_shard_into succeeds off the replica
+    holder (the unit-level face of chaos scenario 2)."""
+    from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+    from ray_tpu.core.shm_store import SharedMemoryStore
+
+    src, dst = plane_stores
+    replica_store = SharedMemoryStore(f"/rtpu_eg_rep_{os.getpid()}",
+                                      size=48 << 20, owner=True)
+    servers = []
+    client = PlaneClient()
+    try:
+        nbytes = 4 << 20
+        payload = np.random.default_rng(11).bytes(nbytes)
+        oid = ObjectID(os.urandom(ObjectID.SIZE))
+        src.put_bytes(oid, payload)
+        primary = ObjectPlaneServer(src)
+        servers.append(primary)
+        # replicate: the replica holder pulls from the primary (exactly
+        # what the agent's plane_replicate handler does)
+        assert client.pull_into([primary.address], oid,
+                                replica_store) == "sealed"
+        replica = ObjectPlaneServer(replica_store)
+        servers.append(replica)
+        primary_addr = primary.address
+        primary.close()  # the holder dies with the primary copy
+        view = PlaneCheckpoint.restore_shard_into(
+            dst, [primary_addr, replica.address], oid, client=client)
+        assert bytes(view) == payload
+    finally:
+        client.close()
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+        replica_store.close()
+
+
+def test_plane_checkpoint_restore_from_spill(plane_stores):
+    """The shard was spilled to disk under store pressure: the plane still
+    serves it (ObjectPlaneServer spill fallback) and restore succeeds —
+    the 'spill copy' half of the durability story."""
+    import tempfile
+
+    from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+    from ray_tpu.core.spill import SpillManager
+
+    src, dst = plane_stores
+    spill = SpillManager(src, tempfile.mkdtemp(prefix="rtpu_eg_spill_"))
+    nbytes = 2 << 20
+    payload = np.random.default_rng(13).bytes(nbytes)
+    oid = ObjectID(os.urandom(ObjectID.SIZE))
+    src.put_bytes(oid, payload)
+    src.pin(oid)
+    spill.on_put(oid, nbytes)
+    spill.spill_for(src.stats()["arena_size"])  # force it out
+    assert spill.is_spilled(oid) and not src.contains(oid)
+    server = ObjectPlaneServer(src, spill=spill)
+    client = PlaneClient()
+    try:
+        view = PlaneCheckpoint.restore_shard_into(
+            dst, [server.address], oid, client=client)
+        assert bytes(view) == payload
+    finally:
+        client.close()
+        server.close()
+
+
+def test_plane_checkpoint_from_state_to_state_roundtrip():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        shards = [np.arange(30_000, dtype=np.float64) + r for r in range(3)]
+        ckpt = PlaneCheckpoint.from_state(shards, step=7)
+        assert ckpt.step == 7 and ckpt.world_size == 3
+        back = ckpt.to_state()
+        assert all(np.array_equal(a, b) for a, b in zip(shards, back))
+        # reshard 3 -> 2 preserves content
+        merged = np.concatenate(back)
+        resharded = reshard_arrays(back, 2)
+        assert np.array_equal(np.concatenate(resharded), merged)
+    finally:
+        ray_tpu.shutdown()
+
+
+# -------------------------------------------------- satellite: coordinator
+def test_reserve_port_holds_the_bind():
+    from ray_tpu.train.gang import _free_port, _is_bind_conflict, _reserve_port
+
+    held, port = _reserve_port()
+    try:
+        probe = socket.socket()
+        with pytest.raises(OSError):
+            probe.bind(("", port))  # the reservation really is held
+        probe.close()
+    finally:
+        held.close()
+    # after the handoff close, the coordinator can bind it immediately
+    s2 = socket.socket()
+    s2.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s2.bind(("", port))
+    s2.close()
+    assert isinstance(_free_port(), int)
+    # conflict classifier: jax/grpc bind-failure signatures retry, user
+    # errors don't
+    assert _is_bind_conflict(RuntimeError(
+        "gang rank 0 failed: ... Address already in use ..."))
+    assert _is_bind_conflict(RuntimeError("Failed to bind to address"))
+    assert not _is_bind_conflict(RuntimeError("ValueError: bad shapes"))
+
+
+def test_gang_launch_retries_on_port_conflict(monkeypatch):
+    """A bind conflict in the handoff window retries the launch on a fresh
+    port; a non-conflict error propagates immediately."""
+    from ray_tpu.train import gang as gang_mod
+
+    calls = {"n": 0}
+
+    def fake_launch_once_get(refs, timeout=None):
+        raise AssertionError("unused")
+
+    # drive _launch_gang with a stubbed member that fails with a bind
+    # conflict on the first port and succeeds on the second
+    ports = iter([50001, 50002])
+
+    def fake_reserve():
+        s = socket.socket()
+        return s, next(ports)
+
+    monkeypatch.setattr(gang_mod, "_reserve_port", fake_reserve)
+
+    class FakeRemoteFn:
+        def __init__(self, coordinators):
+            self.coordinators = coordinators
+
+        def remote(self, rank, num_workers, coordinator, *a):
+            self.coordinators.append(coordinator)
+            return ("ref", coordinator)
+
+    coordinators = []
+    cancelled = []
+
+    class FakeRayTpu:
+        @staticmethod
+        def remote(**kw):
+            return lambda fn: FakeRemoteFn(coordinators)
+
+        @staticmethod
+        def get(refs, timeout=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError(
+                    "gang rank 0 failed (rc=1): ... bind: Address already "
+                    "in use")
+            import cloudpickle
+
+            return [cloudpickle.dumps("ok") for _ in refs]
+
+        @staticmethod
+        def cancel(ref, force=False):
+            cancelled.append(ref)
+
+    monkeypatch.setitem(sys.modules, "ray_tpu", FakeRayTpu)
+    try:
+        out = gang_mod._launch_gang(
+            [b"blob"], lambda r, c: {}, 1, False, 30.0)
+        assert out == ["ok"]
+        assert calls["n"] == 2
+        # two distinct coordinator ports were tried
+        assert len({c for c in coordinators}) == 2
+        # the failed attempt's survivors were cancelled before the retry
+        # (zombie ranks must not hold devices against the fresh gang)
+        assert len(cancelled) == 1
+    finally:
+        monkeypatch.delitem(sys.modules, "ray_tpu", raising=False)
+
+
+# ------------------------------------------- satellite: crash-safe register
+_CRASH_CHILD = """
+import os, sys
+sys.path.insert(0, sys.argv[4])
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+storage, src, crash_at = sys.argv[1], sys.argv[2], sys.argv[3]
+mgr = CheckpointManager(storage)
+mgr.register(Checkpoint.from_directory(src), {"step": 0})
+os.environ["RAY_TPU_TEST_CKPT_CRASH"] = crash_at
+mgr.register(Checkpoint.from_directory(src), {"step": 1})
+print("NOT-REACHED")
+"""
+
+
+def _run_crash_child(storage, src, crash_at):
+    proc = subprocess.run(
+        [sys.executable, "-c", _CRASH_CHILD, storage, src, crash_at, REPO],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 137, (proc.returncode, proc.stderr[-500:])
+    assert "NOT-REACHED" not in proc.stdout
+
+
+def test_checkpoint_register_kill_mid_copy_leaves_no_corruption(tmp_path):
+    """SIGKILL-equivalent death BETWEEN staging and publish: the storage
+    dir has no half-copied checkpoint, the pointer still names the last
+    good one, and a fresh manager resumes cleanly."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "weights.bin").write_bytes(b"w" * 4096)
+    storage = str(tmp_path / "store")
+    _run_crash_child(storage, str(src), "mid_register")
+    view = CheckpointManager.scan(storage)
+    assert list(view["checkpoints"]) == ["checkpoint_000000"]
+    assert view["latest"] is not None
+    assert os.path.basename(view["latest"].path) == "checkpoint_000000"
+    assert view["metrics"]["checkpoint_000000"] == {"step": 0}
+    # a fresh manager sweeps the stale .tmp stage and continues the index
+    mgr = CheckpointManager(storage)
+    assert not any(n.endswith(".tmp") for n in os.listdir(storage))
+    ck = mgr.register(Checkpoint.from_directory(str(src)), {"step": 9})
+    assert os.path.basename(ck.path) == "checkpoint_000001"
+    assert os.path.basename(
+        CheckpointManager.scan(storage)["latest"].path) == "checkpoint_000001"
+
+
+def test_checkpoint_register_kill_after_publish_pointer_stays_valid(tmp_path):
+    """Death AFTER the atomic publish but before the pointer update: the
+    new dir is complete, and the pointer — the commit point — still names
+    a fully valid checkpoint (never corrupt, never dangling)."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "weights.bin").write_bytes(b"w" * 4096)
+    storage = str(tmp_path / "store")
+    _run_crash_child(storage, str(src), "after_publish")
+    view = CheckpointManager.scan(storage)
+    assert sorted(view["checkpoints"]) == ["checkpoint_000000",
+                                           "checkpoint_000001"]
+    latest = os.path.basename(view["latest"].path)
+    assert latest in view["checkpoints"]
+    with open(os.path.join(view["latest"].path, "_metrics.json")) as f:
+        json.load(f)  # parseable — pointer target is complete
+
+
+# ------------------------------------------ satellite: failure policy table
+def test_failure_policy_decision_table():
+    from ray_tpu.train.config import FailureConfig
+    from ray_tpu.train.failure_policy import (
+        FailureDecision,
+        FailureKind,
+        FailurePolicy,
+    )
+    from ray_tpu.util import flight_recorder
+
+    R, X = FailureDecision.RETRY, FailureDecision.RAISE
+    # retry budget exhaustion: worker deaths and user errors share the
+    # max_failures budget; the (budget+1)th draw raises
+    pol = FailurePolicy(FailureConfig(max_failures=2))
+    assert pol.decide(FailureKind.WORKER_DIED) == R
+    assert pol.remaining() == 1
+    assert pol.decide(FailureKind.USER_ERROR) == R
+    assert pol.remaining() == 0
+    assert pol.decide(FailureKind.WORKER_DIED) == X
+    # non-retryable passthrough: zero budget raises on the FIRST user error
+    pol0 = FailurePolicy(FailureConfig(max_failures=0))
+    assert pol0.decide(FailureKind.USER_ERROR) == X
+    # preemptions budget separately (default unlimited)...
+    polp = FailurePolicy(FailureConfig(max_failures=0))
+    assert all(polp.decide(FailureKind.PREEMPTED) == R for _ in range(6))
+    # ...and a bounded preemption budget exhausts independently
+    polb = FailurePolicy(FailureConfig(max_failures=5,
+                                       max_preemption_failures=1))
+    assert polb.decide(FailureKind.PREEMPTED) == R
+    assert polb.decide(FailureKind.PREEMPTED) == X
+    assert polb.remaining() == 5  # worker/user budget untouched
+    # exhaustion leaves a flight-recorder trace
+    assert any(e["event"] == "retry_exhausted"
+               for e in flight_recorder.records("train"))
+
+
+def test_classify_failure_passthrough():
+    from ray_tpu.train.failure_policy import FailureKind, classify_failure
+
+    class WeirdUserError(Exception):
+        pass
+
+    assert classify_failure(WeirdUserError("x")) == FailureKind.USER_ERROR
+    assert classify_failure(ConnectionResetError("x")) == \
+        FailureKind.WORKER_DIED
+
+
+# --------------------------------------- satellite: preemption handler/cfg
+def test_elastic_config_validation_messages():
+    with pytest.raises(ValueError, match="min_workers.*>= 1"):
+        ElasticConfig(min_workers=0)
+    with pytest.raises(ValueError, match="max_workers"):
+        ElasticConfig(max_workers=-2)
+    with pytest.raises(ValueError, match="exceeds max_workers"):
+        ElasticConfig(min_workers=5, max_workers=2)
+    with pytest.raises(ValueError, match="checkpoint_replicas"):
+        ElasticConfig(checkpoint_replicas=0)
+    with pytest.raises(ValueError, match="min_workers must be an int"):
+        ElasticConfig(min_workers=1.5)  # type: ignore[arg-type]
+
+
+def test_preemption_handler_thread_safety_and_listeners():
+    h = PreemptionHandler()
+    fired = []
+    h.add_listener(lambda: fired.append(1))
+    threads = [threading.Thread(target=h.notify_preemption)
+               for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.should_checkpoint_and_exit()
+    assert fired == [1]  # idempotent: listeners fire exactly once
+    s = h.seconds_since_notice()
+    assert s is not None and 0 <= s < 10  # monotonic-based
+    h.clear()
+    assert not h.should_checkpoint_and_exit()
+    assert h.seconds_since_notice() is None
+    # cleared handler re-arms
+    h.notify_preemption()
+    assert fired == [1, 1]
+
+
+def test_gce_preemption_watcher_fires_handler():
+    import http.server
+
+    flag = {"preempted": False}
+
+    class Meta(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"TRUE" if flag["preempted"] else b"FALSE"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Meta)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    handler = PreemptionHandler()
+    fired = threading.Event()
+    handler.add_listener(fired.set)
+    watcher = GcePreemptionWatcher(
+        url=f"http://127.0.0.1:{httpd.server_address[1]}/preempted",
+        period_s=0.05, handler=handler).start()
+    try:
+        assert not fired.wait(0.3)  # FALSE: nothing fires
+        flag["preempted"] = True
+        assert fired.wait(5.0)
+        assert handler.should_checkpoint_and_exit()
+    finally:
+        watcher.stop()
+        httpd.shutdown()
+
+
+# -------------------------------------- satellite: autoscaler standing demand
+def test_standing_demand_drives_autoscaler(ray_start_regular):
+    from ray_tpu.autoscaler import (
+        Autoscaler,
+        AutoscalingConfig,
+        FakeNodeProvider,
+        NodeTypeConfig,
+    )
+    from ray_tpu.autoscaler.autoscaler import (
+        clear_standing_demand,
+        register_standing_demand,
+        standing_demand,
+    )
+
+    provider = FakeNodeProvider(
+        {"gang-node": {"resources": {"CPU": 4.0, "gang": 1.0}}})
+    scaler = Autoscaler(
+        AutoscalingConfig(node_types=[
+            NodeTypeConfig("gang-node", {"CPU": 4.0, "gang": 1.0},
+                           max_workers=4)]),
+        provider)
+    try:
+        # a REFORMING gang has no queued tasks, but its floor is demand
+        register_standing_demand("gang-t", [{"CPU": 1.0, "gang": 1.0}] * 2)
+        assert len(standing_demand()) == 2
+        scaler.reconcile()
+        assert scaler.launch_count >= 1
+        clear_standing_demand("gang-t")
+        assert standing_demand() == []
+        before = scaler.launch_count
+        scaler.reconcile()
+        assert scaler.launch_count == before  # demand gone, no more launches
+    finally:
+        clear_standing_demand("gang-t")
+
+
+def test_gang_shutdown_reaches_terminal_phase():
+    """shutdown() at ANY point must land the gang on a terminal phase —
+    a concurrent result() must raise, never hang."""
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        # impossible capacity: the manager parks in FORMING's wait loop
+        mgr = GangManager(
+            lambda ctx: None,
+            ElasticConfig(min_workers=64, max_workers=64,
+                          reform_timeout_s=300.0),
+            name="shut").start()
+        assert mgr.wait_for_phase(GangPhase.FORMING, timeout=10)
+        mgr.shutdown()
+        with pytest.raises(RuntimeError, match="shut down"):
+            mgr.result(timeout=30)
+        assert mgr.phase in (GangPhase.FAILED, GangPhase.FINISHED)
+    finally:
+        ray_tpu.shutdown()
+
+
+# ----------------------------------------------------------- misc helpers
+def test_shard_bounds_cover_and_reshard():
+    for total in (10, 97, 1000):
+        for world in (1, 2, 3, 7):
+            spans = [shard_bounds(total, r, world) for r in range(world)]
+            assert spans[0][0] == 0 and spans[-1][1] == total
+            for (a, b), (c, d) in zip(spans, spans[1:]):
+                assert b == c  # contiguous, no gap/overlap
+    shards = reshard_arrays([np.arange(5), np.arange(5, 12)], 3)
+    assert [len(s) for s in shards] == [4, 4, 4]
+    assert np.array_equal(np.concatenate(shards), np.arange(12))
