@@ -123,18 +123,24 @@ class ObjectPlaneServer:
     peer disconnect, so a crashed puller can't leak pins."""
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
-                 spill=None, wire_versions: "tuple[int, int] | None" = None):
+                 spill=None, wire_versions: "tuple[int, int] | None" = None,
+                 extra_handlers: "dict | None" = None):
         self.store = store
         self.spill = spill  # optional SpillManager: serve spilled objects too
         self._open: dict[tuple[int, bytes], memoryview | bytes] = {}
         self._lock = threading.Lock()
+        # extra_handlers: schema'd side-ops served on the same endpoint (the
+        # KV-transport ack rides the plane connection it pulled over rather
+        # than a bespoke channel — serve/kv_transport.py)
+        handlers = {
+            "obj_meta": self._h_meta,
+            "obj_chunk": self._h_chunk,
+            "obj_chunk_raw": self._h_chunk_raw,
+            "obj_done": self._h_done,
+        }
+        handlers.update(extra_handlers or {})
         self.server = wire.RpcServer(
-            handlers={
-                "obj_meta": self._h_meta,
-                "obj_chunk": self._h_chunk,
-                "obj_chunk_raw": self._h_chunk_raw,
-                "obj_done": self._h_done,
-            },
+            handlers=handlers,
             host=host, port=port,
             on_disconnect=self._peer_gone,
             versions=wire_versions,

@@ -39,6 +39,11 @@ Version history:
   into its local store — checkpoint-shard replication, so a preempted
   holder doesn't take the only copy with it). A <v6 agent neither sends
   notices nor serves replication; replication falls back to a head pull.
+- v7: disaggregated prefill/decode serving — ``kv_ack`` (a decode engine
+  tells the prefill-side KV plane endpoint that a published KV handoff
+  landed, so the pages free immediately instead of waiting for the TTL
+  sweep). The KV pages themselves move over the EXISTING v3 BLOB pull
+  path; against a <v7 holder the puller skips the ack and TTL reclaims.
 """
 
 from __future__ import annotations
@@ -48,7 +53,7 @@ from typing import Optional
 
 # The schema version this build speaks, and the oldest it can fall back to.
 # Peers negotiate min(max_a, max_b) at hello; see negotiate().
-WIRE_VERSION = 6
+WIRE_VERSION = 7
 WIRE_VERSION_MIN = 1
 
 # Protocol magic sent in the hello frame: rejects foreign/legacy peers with
@@ -392,3 +397,17 @@ register_op(58, "plane_replicate", [
         "holder endpoints into this node's local store and pin it "
         "(checkpoint-shard replication); replies True once the copy is "
         "sealed and announced via object_added")
+
+# -- disaggregated prefill/decode KV transfer (v7; reference: the NIXL/RDT
+#    tensor-transport layer moving KV pages between prefill and decode
+#    engines). KV pages themselves ride the EXISTING v3 BLOB pull path
+#    (obj_meta/obj_chunk_raw against the prefill-side KV plane endpoint);
+#    the only new control traffic is the decode-side ack that lets the
+#    prefill worker free the published pages early instead of waiting for
+#    the TTL sweep. Version-gated so a <v7 holder is never sent an op it
+#    cannot decode — the puller then simply skips the ack (TTL covers it).
+register_op(59, "kv_ack", [
+    _f("hid", T.BYTES, required=True)], since=7,
+    doc="decode -> prefill KV endpoint (notify): the handoff's pages landed "
+        "in the decode engine's pool; the publisher frees the plane entry "
+        "(serve/kv_transport.py lifecycle: ack | TTL | claimant death)")

@@ -2260,7 +2260,14 @@ class Runtime:
             try:
                 self._maybe_inject_chaos(spec)
                 args, kwargs = self._resolve_args(spec)
-                method = getattr(state.instance, spec.method_name)
+                instance = state.instance  # snapshot: kill() nulls it for GC
+                if instance is None:
+                    # killed while this frame was dequeued/resolving args:
+                    # surface the death (the serve router fails over on
+                    # ActorDiedError), not a NoneType AttributeError
+                    raise ActorDiedError(
+                        state.death_cause or "actor was killed")
+                method = getattr(instance, spec.method_name)
                 renv_ctx = self._runtime_env_ctx(state)
                 is_coro = inspect.iscoroutinefunction(method)
                 is_gen = isinstance(spec.num_returns, str)
@@ -2737,6 +2744,11 @@ class Runtime:
             state.proc_worker.kill()
             state.proc_worker = None
         state.poison_all()
+        # drop the thread-actor instance so a killed actor's object graph
+        # (engines, shm arenas, sockets) is GC-able — in-flight method
+        # frames keep their own reference, and the restart path rebuilds
+        # the instance from creation_spec
+        state.instance = None
         if state.node_id is not None and state.sched_req is not None:
             self.scheduler.release(state.node_id, state.sched_req)
             state.node_id = None

@@ -77,7 +77,7 @@ class SharedMemoryStore:
     """Node-local shm store handle (plasma-client equivalent)."""
 
     def __init__(self, name: str, size: int = 512 * 1024 * 1024, table_cap: int = 65536,
-                 owner: bool = False):
+                 owner: bool = False, prefault: bool = True):
         self._lib = _Lib.get()
         self.name = name
         self.size = size
@@ -87,7 +87,11 @@ class SharedMemoryStore:
             raise RuntimeError(f"failed to create/open shm store {name}")
         self._base = self._lib.shm_store_base(self._handle)
         atexit.register(self.close)
-        if owner:
+        # prefault=False: small short-lived stores (e.g. serve KV-transport
+        # handoff stores, one per replica) skip the background page-table
+        # warm — populating the whole arena would pin its full size in RSS
+        # for a store whose live set is a few in-flight handoffs
+        if owner and prefault:
             self._start_prefault()
 
     def _start_prefault(self) -> None:

@@ -27,6 +27,16 @@ def __getattr__(name):
         from ray_tpu.serve import llm_paged
 
         return getattr(llm_paged, name)
+    if name in ("KVTransport", "KVHandoffLost"):
+        from ray_tpu.serve import kv_transport
+
+        return getattr(kv_transport, name)
+    if name in ("build_pd_deployment", "build_prefill_deployment",
+                "build_decode_deployment", "build_pd_controller",
+                "deploy_pd_app"):
+        from ray_tpu.serve import pd
+
+        return getattr(pd, name)
     raise AttributeError(name)
 from ray_tpu.serve.controller import DeploymentHandle, ServeController
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
@@ -37,6 +47,9 @@ __all__ = [
     "start_proxies", "stop_proxies",
     "get_deployment_handle", "build_openai_app",
     "PagedLLMConfig", "PagedLLMEngine",
+    "KVTransport", "KVHandoffLost",
+    "build_pd_deployment", "build_prefill_deployment",
+    "build_decode_deployment", "build_pd_controller", "deploy_pd_app",
     "batch", "DeploymentHandle", "ServeController",
     "multiplexed", "get_multiplexed_model_id",
 ]

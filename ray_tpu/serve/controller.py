@@ -88,6 +88,14 @@ class ReplicaActor:
         with self._lock:
             return self._ongoing
 
+    def node_hex(self) -> str:
+        """Which node hosts this replica ("head" for head-host replicas) —
+        the placement signal for drain + KV decode routing. Worker processes
+        carry RAY_TPU_NODE_ID (node_agent.py stamps it)."""
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID", "head")
+
     def reconfigure(self, user_config) -> None:
         if not self._is_function and hasattr(self._callable, "reconfigure"):
             self._callable.reconfigure(user_config)
@@ -126,10 +134,25 @@ class ServeController:
         self._routes: dict[str, str] = {}  # route_prefix -> deployment name
         self._health_failures: dict[str, int] = {}  # replica -> consecutive fails
         self._health_probes: dict[str, tuple] = {}  # replica -> (ref, sent_ts)
+        self._replica_nodes: dict[str, str] = {}  # replica key -> node hex
+        self._node_probes: dict[str, object] = {}  # replica key -> node_hex ref
+        self._draining_nodes: set[str] = set()
         self._lock = threading.Lock()
         self._reconcile_lock = threading.Lock()  # serializes reconcile passes
         self._running = True
         self._restore_from_checkpoint()
+        # Proactive drain (reference: the serve controller reacting to GCS
+        # node-death; here also PR-10's preempt_notice/cordon events): stop
+        # routing to a doomed node's replicas BEFORE the capacity vanishes.
+        self._nodes_sub = None
+        try:
+            from ray_tpu.experimental import pubsub
+
+            self._nodes_sub = pubsub.subscribe("nodes")
+            threading.Thread(target=self._nodes_loop, daemon=True,
+                             name="serve-node-drain").start()
+        except Exception:
+            pass  # no control plane (unit tests): drain stays inert
         self._loop = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._loop.start()
 
@@ -266,6 +289,123 @@ class ServeController:
         st = self._deployments.get(name)
         return list(st.replicas) if st else []
 
+    def _harvest_node_probes(self, wait_s: float = 0.0) -> None:
+        """Resolve finished node_hex probes. Zero-timeout waits by default
+        (reconcile/get_replica_nodes must not block); ``wait_s`` bounds a
+        TOTAL grace wait across all pending probes — drain_node uses it so
+        a just-spawned replica's placement is known before matching.
+        Dict access is lock-guarded (three threads mutate these maps);
+        the wait/get runs outside the lock so a slow probe can't stall
+        reconcile."""
+        deadline = time.monotonic() + wait_s
+        with self._lock:
+            pending = list(self._node_probes.items())
+        for key, ref in pending:
+            timeout = max(0.0, deadline - time.monotonic()) if wait_s else 0.0
+            try:
+                ready, _ = ray_tpu.wait([ref], timeout=timeout)
+            except Exception:
+                ready = []
+            if not ready:
+                continue
+            try:
+                node = str(ray_tpu.get(ref, timeout=1))
+            except Exception:
+                node = "head"
+            with self._lock:
+                self._node_probes.pop(key, None)
+                # never overwrite a recorded mapping: a replica doesn't
+                # move nodes after spawn, so an earlier entry (or one
+                # injected by a test) is at least as authoritative as the
+                # probe that raced it
+                self._replica_nodes.setdefault(key, node)
+
+    def get_replica_nodes(self, name: str) -> dict:
+        """replica key -> node hex ("head" until a replica's probe lands)."""
+        self._harvest_node_probes()
+        st = self._deployments.get(name)
+        with self._lock:
+            return {r._actor_id.hex():
+                    self._replica_nodes.get(r._actor_id.hex(), "head")
+                    for r in (list(st.replicas) if st else [])}
+
+    # ---- proactive drain (satellite of the PD subsystem: serve fleets get
+    # the same notice->drain path elastic gangs have) ----
+    def _nodes_loop(self) -> None:
+        while self._running:
+            try:
+                msg = self._nodes_sub.poll(timeout=0.5)
+            except Exception:
+                return  # subscription torn down
+            if not isinstance(msg, dict):
+                continue
+            event = msg.get("event")
+            node_hex = msg.get("node_id", "")
+            if event in ("preempt_notice", "dead", "cordon") and node_hex:
+                try:
+                    self.drain_node(node_hex, reason=event)
+                except Exception:
+                    pass
+            elif event == "registered" and node_hex:
+                self._draining_nodes.discard(node_hex)  # node came back
+
+    def drain_node(self, node_hex: str, reason: str = "manual") -> int:
+        """Stop routing to every replica on ``node_hex`` and replace them:
+        the replicas are removed from the routing set (routers drop them on
+        their next refresh and the KV router prunes their prefix affinity,
+        re-homing in-flight prefixes), killed, and respawned by reconcile —
+        which places off the node because the scheduler cordoned it.
+        Returns the number of replicas drained."""
+        from ray_tpu.util import flight_recorder
+
+        self._draining_nodes.add(node_hex)
+        # cordon the scheduler too (best-effort): reconcile respawns the
+        # victims immediately, and without the cordon the replacements
+        # could land right back on the node being drained. The
+        # preempt_notice path already cordoned (Runtime.on_preempt_notice);
+        # this covers manual drains and "dead"/"cordon" events.
+        try:
+            from ray_tpu._private.ids import NodeID
+            from ray_tpu.core.runtime import get_runtime_or_none
+
+            rt = get_runtime_or_none()
+            if rt is not None:
+                rt.scheduler.drain_node(NodeID.from_hex(node_hex))
+        except Exception:
+            pass
+        # resolve outstanding placement probes first (bounded grace wait:
+        # drains are rare and the notice gives a window): a drain arriving
+        # before any router ever asked for the node map must still find
+        # the doomed node's replicas
+        self._harvest_node_probes(wait_s=2.0)
+        victims: list = []
+        with self._lock:
+            for st in self._deployments.values():
+                for r in list(st.replicas):
+                    # match only KNOWN placements — "head" is a real value,
+                    # so an unresolved probe must not default into it (a
+                    # drain of "head" would kill replicas that actually
+                    # live elsewhere); a still-unknown replica is left for
+                    # health checks / node death to reap
+                    if self._replica_nodes.get(
+                            r._actor_id.hex()) == node_hex:
+                        st.replicas.remove(r)
+                        victims.append(r)
+            for r in victims:
+                self._replica_nodes.pop(r._actor_id.hex(), None)
+                self._node_probes.pop(r._actor_id.hex(), None)
+        flight_recorder.record("serve", "node_drain", node_id=node_hex,
+                               reason=reason, replicas=len(victims))
+        for r in victims:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        return len(victims)
+
+    def get_draining_nodes(self) -> list[str]:
+        return sorted(self._draining_nodes)
+
     def get_deployment_names(self) -> list[str]:
         return list(self._deployments)
 
@@ -304,6 +444,11 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._running = False
+        if self._nodes_sub is not None:
+            try:
+                self._nodes_sub.close()
+            except Exception:
+                pass
         for name in list(self._deployments):
             self.delete_deployment(name)
 
@@ -415,6 +560,17 @@ class ServeController:
     def _reconcile_once(self) -> None:
         with self._reconcile_lock:
             self._reconcile_locked()
+            self._harvest_node_probes()
+            # drop node bookkeeping for replicas that no longer exist
+            # (killed by health checks, drains, redeploys); under the lock —
+            # get_replica_nodes/drain_node write these maps concurrently
+            with self._lock:
+                live = {r._actor_id.hex()
+                        for st in self._deployments.values()
+                        for r in st.replicas}
+                for d in (self._replica_nodes, self._node_probes):
+                    for key in [k for k in d if k not in live]:
+                        d.pop(key, None)
 
     def _reconcile_locked(self) -> None:
         with self._lock:
@@ -451,6 +607,14 @@ class ServeController:
                 replica = actor_cls.remote(
                     d.func_or_class, d.init_args, d.init_kwargs, cfg.user_config
                 )
+                try:
+                    # fire-and-forget placement probe, harvested lazily by
+                    # get_replica_nodes (drain + KV decode routing signal)
+                    probe = replica.node_hex.remote()
+                    with self._lock:
+                        self._node_probes[replica._actor_id.hex()] = probe
+                except Exception:
+                    pass
                 with self._lock:
                     # attach only if the deployment wasn't redeployed/deleted meanwhile
                     cur = self._deployments.get(cfg.name)

@@ -13,35 +13,76 @@ the replica holding the LONGEST matching prefix wins, unless its in-flight
 depth exceeds the least-loaded replica by more than ``imbalance_tolerance``
 (cache affinity must not defeat load balancing). No affinity → pow-2
 fallback. State is router-local (no replica RPC on the hot path), sized by
-an LRU bound.
+an LRU bound; owners of removed replicas are pruned on refresh so dead
+entries neither burn longest-prefix lookups nor pin LRU slots.
+
+Decode-side placement (PD disaggregation, serve/pd.py): a request whose
+body carries a KV-handoff descriptor is scored instead of prefix-matched —
+``score = in-flight + node I/O pressure − locality bonus`` — so a handoff
+is pulled to the least-loaded decode replica closest to the page holder.
+I/O pressure folds in ``state.node_io_view()`` (pending pull bytes per
+node, the PR-8 telemetry signal), sampled at most once a second.
 """
 
 from __future__ import annotations
 
+import random
+import time
 from collections import OrderedDict
 
+import ray_tpu
 from ray_tpu.serve.controller import Router
+
+
+def _default_io_view() -> dict:
+    from ray_tpu.util import state
+
+    return state.node_io_view()
 
 
 class KVAwareRouter(Router):
     KIND = "kv_aware"
 
+    # pending pull bytes per unit of queue-depth-equivalent pressure: a node
+    # with 32 MB of KV/object bytes in flight scores like one extra
+    # in-flight request (capped so a saturated NIC can't dominate forever)
+    IO_PRESSURE_SCALE = 32 << 20
+    IO_PRESSURE_CAP = 4.0
+    # replica->node map fetch cadence: node placement changes only when
+    # replicas are (re)spawned, so this rides a slower clock than the base
+    # 0.5s replica refresh — otherwise every handle doubles the
+    # controller's routing RPC load with a second round-trip per cycle
+    NODE_MAP_PERIOD_S = 2.0
+
     def __init__(self, controller, deployment_name: str, *, block_size: int = 16,
-                 max_tracked_prefixes: int = 8192, imbalance_tolerance: int = 4):
+                 max_tracked_prefixes: int = 8192, imbalance_tolerance: int = 4,
+                 locality_bonus: float = 1.0):
         self.block_size = block_size
         self.max_tracked_prefixes = max_tracked_prefixes
         self.imbalance_tolerance = imbalance_tolerance
+        self.locality_bonus = locality_bonus
         # prefix hash -> replica key, LRU-ordered (most recent last)
         self._prefix_owner: "OrderedDict[int, str]" = OrderedDict()
+        # replica key -> node hex ("head" for head-host replicas); refreshed
+        # with the replica list — the decode placement signal
+        self._replica_nodes: dict[str, str] = {}
+        self._live_snapshot: frozenset = frozenset()
+        self._nodes_fetched = 0.0  # last node-map fetch (NODE_MAP_PERIOD_S)
+        self._io_cache: tuple = (0.0, {})
+        self._io_view_fn = _default_io_view  # test seam
         super().__init__(controller, deployment_name)
 
-    # ---- hint extraction: token-id requests carry their prompt ----
+    # ---- hint extraction ----
     def _routing_hint(self, method_name: str, args, kwargs):
         body = args[0] if args else kwargs.get("body")
         if isinstance(body, dict):
+            handoff = body.get("handoff")
+            if isinstance(handoff, dict) and isinstance(
+                    handoff.get("kv_ref"), dict):
+                return ("decode", handoff["kv_ref"])
             ids = body.get("prompt_ids")
             if isinstance(ids, (list, tuple)) and ids:
-                return list(ids)
+                return ("prefix", list(ids))
         return None
 
     def _block_hashes(self, prompt_ids: list) -> list[int]:
@@ -55,12 +96,61 @@ class KVAwareRouter(Router):
             out.append(h)
         return out
 
+    # ---- refresh: prune owners/nodes of removed replicas ----
+    def _refresh(self) -> None:
+        before = self._last_refresh
+        super()._refresh()
+        if self._last_refresh == before:
+            return  # base refresh didn't run this cycle
+        nodes = None
+        now = time.monotonic()
+        if now - self._nodes_fetched >= self.NODE_MAP_PERIOD_S:
+            self._nodes_fetched = now
+            try:
+                nodes = ray_tpu.get(self._controller.get_replica_nodes.remote(
+                    self._name), timeout=2)
+            except Exception:
+                pass  # older controller / transient failure: keep last map
+        # warm the io-pressure cache OUTSIDE the lock: node_io_view() is a
+        # full metrics rollup, and _select_decode (which reads it) runs
+        # under the router lock on the request path
+        self._io_pressure()
+        with self._lock:
+            live = frozenset(self._rkey(r) for r in self._replicas)
+            if isinstance(nodes, dict):
+                self._replica_nodes = {k: n for k, n in nodes.items()
+                                       if k in live}
+            self._prune_stale_owners(live)
+
+    def _prune_stale_owners(self, live: frozenset) -> None:
+        """Drop prefix entries owned by removed replicas (under the lock).
+        A removed replica's cache is gone with it: keeping its entries
+        burns every longest-prefix lookup on ``owner not in live`` misses
+        and pins LRU slots until the bound finally evicts them."""
+        if live == self._live_snapshot:
+            return  # replica set unchanged: nothing to prune
+        self._live_snapshot = live
+        for h in [h for h, o in self._prefix_owner.items()
+                  if o not in live]:
+            del self._prefix_owner[h]
+
+    # ---- selection ----
     def _select(self, hint):
-        # called under self._lock with >=2 replicas
-        if hint:
+        # called under self._lock with >=2 replicas. ``hint`` is
+        # ("prefix", prompt_ids) | ("decode", kv_ref) | a bare prompt-id
+        # list (legacy callers) | None.
+        if isinstance(hint, tuple) and len(hint) == 2:
+            kind, payload = hint
+        elif hint:
+            kind, payload = "prefix", hint
+        else:
+            kind, payload = None, None
+        if kind == "decode":
+            return self._select_decode(payload)
+        if kind == "prefix":
             live = {self._rkey(r): r for r in self._replicas}
             min_load = min(self._inflight.get(k, 0) for k in live)
-            hashes = self._block_hashes(hint)
+            hashes = self._block_hashes(payload)
             for h in reversed(hashes):  # longest prefix first
                 owner = self._prefix_owner.get(h)
                 if owner is None or owner not in live:
@@ -75,6 +165,51 @@ class KVAwareRouter(Router):
             self._claim(hashes, self._rkey(chosen))
             return chosen
         return super()._select(None)
+
+    def _select_decode(self, kv_ref):
+        """Decode-side placement: least loaded replica, discounted toward
+        the handoff holder's node, penalized by per-node I/O pressure.
+        Runs under the router lock: ``_io_pressure`` is a cache hit here in
+        steady state because ``_refresh`` warms it outside the lock."""
+        live = {self._rkey(r): r for r in self._replicas}
+        holder = kv_ref.get("node") if isinstance(kv_ref, dict) else None
+        io = self._io_pressure()
+        best_key = None
+        best_score = None
+        keys = list(live)
+        random.shuffle(keys)  # break score ties fairly
+        for key in keys:
+            node = self._replica_nodes.get(key)
+            score = float(self._inflight.get(key, 0))
+            if node is not None:
+                score += io.get(node, 0.0)
+                if holder is not None and node == holder:
+                    score -= self.locality_bonus
+            if best_score is None or score < best_score:
+                best_key, best_score = key, score
+        return live[best_key]
+
+    def _io_pressure(self) -> dict:
+        """node hex -> queue-depth-equivalent I/O pressure, from
+        ``state.node_io_view()`` (head-local aggregation; sampled at most
+        once a second; {} where the view is unavailable, e.g. in workers)."""
+        now = time.monotonic()
+        ts, cached = self._io_cache
+        if now - ts < 1.0:
+            return cached
+        pressure: dict = {}
+        try:
+            view = self._io_view_fn()
+            for node, row in (view.get("nodes") or {}).items():
+                pending = float(row.get("pending_pull_bytes", 0) or 0)
+                pending += sum(
+                    (row.get("holder_pending_bytes") or {}).values())
+                pressure[node] = min(self.IO_PRESSURE_CAP,
+                                     pending / float(self.IO_PRESSURE_SCALE))
+        except Exception:
+            pressure = {}
+        self._io_cache = (now, pressure)
+        return pressure
 
     def _claim(self, hashes: list[int], replica_key: str) -> None:
         for h in hashes:

@@ -1,0 +1,439 @@
+"""Zero-copy KV-cache handoff between prefill and decode engines, over the
+object plane.
+
+Parity: the reference's L4 tensor-transport layer (NIXL/RDT,
+rdt/nixl_tensor_transport.py) — prefill engines publish a sequence's KV
+pages, decode engines on other hosts land them directly into their own page
+pool, so the two fleets scale independently and KV bytes move node-to-node
+at NIC speed. Here the transport is NOT a bespoke side channel: pages ride
+the SAME wire-v3 BLOB pull path every plane object uses (arxiv 1712.05889's
+argument for a shared object plane):
+
+- **Publish** (prefill side): the gathered KV pages of one handoff are
+  written ONCE into a ``create_for_write`` slot of the transport's
+  shared-memory store and sealed — one plane entry per handoff (pages
+  batched, not one object per page: a handoff is the transfer unit). The
+  returned descriptor is control-plane-sized (ref id, endpoint, shapes);
+  the pages never touch the control plane.
+- **Pull** (decode side): ``PlaneClient.pull_into`` lands the entry as raw
+  BLOB frames ``recv_into`` the decode-side store slot — received bytes are
+  written exactly once — and the engine adopts the pages as zero-copy numpy
+  views of that slot (the scatter into the device pool is the engine-side
+  placement, the analog of NIXL's descriptor-list write).
+- **Free** (lifecycle): a published handoff is freed on the FIRST of:
+  decode ack (new wire-v7 ``kv_ack`` notify, sent back over the very
+  connection the pages were pulled on), TTL expiry (sweeper), or claimant
+  death (the puller's connection drops before acking — e.g. a decode
+  replica died mid-attach). TTL/death frees are flight-recorded ("kv"
+  ring); an abandoned handoff can never pin store memory forever.
+
+Instruments are bound at import (util/metrics.py bind contract); the
+publish/pull hot path never constructs or looks up a metric
+(``check_wire_schemas.py check_kv_transport`` lints this).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Callable, Optional
+
+import numpy as np
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+from ray_tpu.core.shm_store import SharedMemoryStore
+from ray_tpu.util import flight_recorder
+from ray_tpu.util.metrics import Counter, Gauge
+
+# Defaults (env-tunable): the store holds in-flight handoffs only — pages
+# are freed on ack, so steady-state occupancy is (handoff bytes) x (pulls
+# in flight), not the engine's whole KV pool.
+KV_STORE_BYTES = int(os.environ.get("RAY_TPU_KV_STORE_BYTES", str(128 << 20)))
+KV_TTL_S = float(os.environ.get("RAY_TPU_KV_TTL_S", "30"))
+
+_M_HANDOFFS = Counter(
+    "ray_tpu_serve_kv_handoffs_total",
+    "published KV handoffs retired, by outcome", tag_keys=("outcome",))
+_M_ACKED = _M_HANDOFFS.bind({"outcome": "acked"})
+_M_TTL = _M_HANDOFFS.bind({"outcome": "ttl_expired"})
+_M_DIED = _M_HANDOFFS.bind({"outcome": "claimant_died"})
+_M_CLOSED = _M_HANDOFFS.bind({"outcome": "closed"})
+_M_PUB_BYTES = Counter(
+    "ray_tpu_serve_kv_published_bytes_total",
+    "KV page bytes published for handoff").bind()
+_M_PULL_BYTES = Counter(
+    "ray_tpu_serve_kv_pulled_bytes_total",
+    "KV page bytes pulled into this decode engine").bind()
+
+# Live transports, sampled at scrape time for the in-flight handoff gauges.
+_TRANSPORTS: "weakref.WeakSet[KVTransport]" = weakref.WeakSet()
+
+
+def _live_handoff_bytes_producer():
+    total = 0
+    for t in list(_TRANSPORTS):
+        total += t.live_bytes()
+    return [({}, total)]
+
+
+Gauge("ray_tpu_serve_kv_live_handoff_bytes",
+      "published-but-unretired KV handoff bytes held in plane stores"
+      ).attach_producer(_live_handoff_bytes_producer)
+
+
+def _sweep_loop(transport_ref: "weakref.ref", wake: threading.Event) -> None:
+    """TTL sweeper body (module-level so the thread never pins the
+    transport). Exits when the transport is closed OR garbage-collected."""
+    interval = None
+    while True:
+        t = transport_ref()
+        if t is None:
+            return
+        if interval is None:
+            interval = max(0.05, min(1.0, t.ttl_s / 4.0))
+        del t
+        if wake.wait(interval):
+            return
+        t = transport_ref()
+        if t is None:
+            return
+        t._sweep_tick()
+        del t
+
+
+class KVHandoffLost(RuntimeError):
+    """The published pages are gone (TTL/death free beat the pull, or the
+    prefill endpoint died). Callers re-prefill instead of retrying the pull."""
+
+
+class _Handoff:
+    __slots__ = ("hid", "oid", "nbytes", "deadline", "claimant", "acked")
+
+    def __init__(self, hid: bytes, oid: ObjectID, nbytes: int, ttl_s: float):
+        self.hid = hid
+        self.oid = oid
+        self.nbytes = nbytes
+        self.deadline = time.monotonic() + ttl_s
+        self.claimant: Optional[int] = None  # id(peer) of the puller
+        self.acked = False
+
+
+class _KVPlaneServer(ObjectPlaneServer):
+    """The prefill-side KV endpoint: a stock plane server over the
+    transport's store, plus (a) the v7 ``kv_ack`` side-op and (b) claimant
+    tracking — the peer whose ``obj_meta`` opened a handoff's transfer is
+    recorded so its death before ack frees the pages immediately."""
+
+    def __init__(self, transport: "KVTransport", store, **kw):
+        self._transport = weakref.proxy(transport)
+        super().__init__(store, extra_handlers={"kv_ack": self._h_kv_ack},
+                         **kw)
+
+    def _h_meta(self, peer, msg):
+        try:
+            self._transport._note_claim(msg["oid"], id(peer))
+        except ReferenceError:
+            pass
+        return super()._h_meta(peer, msg)
+
+    def _h_kv_ack(self, peer, msg):
+        try:
+            self._transport._on_ack(msg["hid"])
+        except ReferenceError:
+            pass
+        return True
+
+    def _peer_gone(self, peer) -> None:
+        super()._peer_gone(peer)
+        try:
+            self._transport._on_claimant_gone(id(peer))
+        except ReferenceError:
+            pass
+
+
+class KVTransport:
+    """One per engine: publish side (prefill) and pull side (decode) of the
+    KV handoff plane. Both halves are always available — a PD replica that
+    does both (co-located fallback) needs only one transport."""
+
+    def __init__(self, name: str | None = None, *,
+                 store: SharedMemoryStore | None = None,
+                 store_bytes: int | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 ttl_s: float | None = None,
+                 node_hint: str | None = None):
+        self.ttl_s = ttl_s if ttl_s is not None else KV_TTL_S
+        self.node_hint = node_hint or os.environ.get("RAY_TPU_NODE_ID",
+                                                     "head")
+        self._owns_store = store is None
+        if store is None:
+            name = name or f"rtpu_kv_{os.getpid()}_{id(self):x}"
+            # prefault=False: the arena backs a few in-flight handoffs, not
+            # a node store — warming all of it would pin store_bytes of RSS
+            # per replica; a cold-page publish costs ~0.5 ms/MB once
+            store = SharedMemoryStore(f"/{name.lstrip('/')}",
+                                      size=store_bytes or KV_STORE_BYTES,
+                                      owner=True, prefault=False)
+        self._store = store
+        self._server = _KVPlaneServer(self, store, host=host, port=port)
+        self._client = PlaneClient()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._live: dict[bytes, _Handoff] = {}
+        self._by_oid: dict[bytes, bytes] = {}  # oid binary -> hid
+        # decode side: local secondary copies landed by pull(), swept on
+        # the same TTL if the ack path never ran (a failed attach must not
+        # strand handoff bytes in this store for the replica's life)
+        self._local_pulls: dict[bytes, float] = {}  # oid binary -> deadline
+        self._closed = False
+        self._sweep_wake = threading.Event()
+        # the sweeper holds a WEAK reference: a thread target bound to self
+        # would pin the transport forever, making __del__ (and the close()
+        # it runs — store/server/socket teardown) unreachable once the
+        # owning replica is dropped
+        self._sweeper = threading.Thread(
+            target=_sweep_loop, args=(weakref.ref(self), self._sweep_wake),
+            daemon=True, name=f"kv-ttl-{self.address}")
+        self._sweeper.start()
+        _TRANSPORTS.add(self)
+
+    # ------------------------------------------------------------ publish side
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def publish(self, k: np.ndarray, v: np.ndarray, *,
+                meta: dict | None = None) -> dict:
+        """Register one handoff's KV pages as a sealed plane entry and
+        return the compact descriptor the decode side pulls from.
+
+        ``k``/``v`` are host arrays (the engine's gathered pages); each is
+        written ONCE into the store's mapped slot — the only prefill-side
+        copy on the handoff path."""
+        if self._closed:
+            raise RuntimeError("KVTransport is closed")
+        k = np.asarray(k)
+        v = np.asarray(v)
+        if k.dtype != v.dtype:
+            # the descriptor ships ONE dtype; a mixed-dtype handoff would
+            # reconstruct v as silent garbage on the decode side
+            raise ValueError(
+                f"KV handoff dtype mismatch: k={k.dtype} v={v.dtype}")
+        nbytes = k.nbytes + v.nbytes
+        oid = ObjectID(os.urandom(ObjectID.SIZE))
+        hid = os.urandom(12)
+        view = self._store.create_for_write(oid, nbytes)
+        if view is None:  # random oid collided with a sealed entry: impossible
+            raise RuntimeError("KV handoff oid collision")
+        try:
+            np.copyto(np.frombuffer(view, dtype=k.dtype,
+                                    count=k.size).reshape(k.shape), k)
+            np.copyto(np.frombuffer(view, dtype=v.dtype, count=v.size,
+                                    offset=k.nbytes).reshape(v.shape), v)
+        except BaseException:
+            self._store.abort(oid)
+            raise
+        del view
+        self._store.seal(oid)
+        h = _Handoff(hid, oid, nbytes, self.ttl_s)
+        with self._lock:
+            self._live[hid] = h
+            self._by_oid[oid.binary()] = hid
+        _M_PUB_BYTES.inc(nbytes)
+        desc = {
+            "hid": hid,
+            "oid": oid.binary(),
+            "addr": self.address,
+            "nbytes": nbytes,
+            "k_shape": list(k.shape),
+            "v_shape": list(v.shape),
+            "dtype": str(k.dtype),
+            "node": self.node_hint,
+        }
+        if meta:
+            desc["meta"] = dict(meta)
+        return desc
+
+    def _note_claim(self, oid_bin: bytes, peer_id: int) -> None:
+        with self._lock:
+            hid = self._by_oid.get(oid_bin)
+            h = self._live.get(hid) if hid is not None else None
+            if h is not None:
+                h.claimant = peer_id
+
+    def _on_ack(self, hid: bytes) -> None:
+        self._retire(hid, "acked")
+
+    def _on_claimant_gone(self, peer_id: int) -> None:
+        with self._lock:
+            doomed = [h.hid for h in self._live.values()
+                      if h.claimant == peer_id and not h.acked]
+        for hid in doomed:
+            flight_recorder.record(
+                "kv", "handoff_claimant_died", hid=hid.hex(),
+                addr=self.address)
+            self._retire(hid, "claimant_died")
+
+    def _retire(self, hid: bytes, outcome: str) -> bool:
+        with self._lock:
+            h = self._live.pop(hid, None)
+            if h is None:
+                return False
+            h.acked = outcome == "acked"
+            self._by_oid.pop(h.oid.binary(), None)
+            # delete BEFORE waking wait_drained so "drained" implies the
+            # store entry is retired too. The plane server may still hold a
+            # read pin (an in-flight pull): delete marks the entry DELETING
+            # and the memory frees when the last pin drops — a racing pull
+            # either completes or sees ObjectLost.
+            self._store.delete(h.oid)
+            self._cv.notify_all()
+        if outcome == "acked":
+            _M_ACKED.inc()
+        elif outcome == "ttl_expired":
+            _M_TTL.inc()
+        elif outcome == "claimant_died":
+            _M_DIED.inc()
+        else:
+            _M_CLOSED.inc()
+        return True
+
+    def _sweep_tick(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            expired = [h.hid for h in self._live.values()
+                       if now > h.deadline]
+            stale_local = [ob for ob, dl in self._local_pulls.items()
+                           if now > dl]
+        for hid in expired:
+            flight_recorder.record(
+                "kv", "handoff_ttl_expired", hid=hid.hex(),
+                addr=self.address, ttl_s=self.ttl_s)
+            self._retire(hid, "ttl_expired")
+        for ob in stale_local:  # pulled-but-never-acked local copies
+            self._drop_local(ObjectID(ob))
+
+    # --------------------------------------------------------------- pull side
+    def pull(self, desc: dict, timeout: float = 60.0
+             ) -> "tuple[dict, Callable[[], None]]":
+        """Land a published handoff's pages locally and return
+        ``({"k": ..., "v": ...}, ack)`` — zero-copy numpy views of the
+        local store slot, plus the ack callable the engine invokes AFTER
+        scattering the pages into its pool (frees both ends). A local
+        copy whose ack never runs (failed attach) is TTL-swept."""
+        oid = ObjectID(bytes(desc["oid"]))
+        addr = desc["addr"]
+        nbytes = int(desc["nbytes"])
+        # the canonical pull policy: zero-copy pull-into-store first,
+        # bytes-returning fallback when there is no room (object_plane.py)
+        payload, how = self._client.pull_into_or_pull(
+            [addr], oid, self._store, timeout=timeout)
+        if payload is None:
+            raise KVHandoffLost(
+                f"KV handoff {bytes(desc['hid']).hex()[:12]} not served "
+                f"by {addr} (freed by ack/TTL, or the endpoint died)")
+        local = how in ("sealed", "exists")
+        if local:
+            with self._lock:
+                self._local_pulls[oid.binary()] = (
+                    time.monotonic() + self.ttl_s)
+        view = payload if isinstance(payload, memoryview) \
+            else memoryview(payload)
+        try:
+            if len(view) != nbytes:
+                raise KVHandoffLost(
+                    f"KV handoff size mismatch: pulled {len(view)} != "
+                    f"{nbytes}")
+            dtype = np.dtype(desc["dtype"])
+            k_shape = tuple(desc["k_shape"])
+            v_shape = tuple(desc["v_shape"])
+            k = np.frombuffer(view, dtype=dtype,
+                              count=int(np.prod(k_shape))).reshape(k_shape)
+            v = np.frombuffer(view, dtype=dtype,
+                              count=int(np.prod(v_shape)),
+                              offset=k.nbytes).reshape(v_shape)
+        except BaseException:
+            if local:
+                # delete tolerates our still-live read pin: the entry goes
+                # DELETING and frees when the views are garbage-collected
+                self._drop_local(oid)
+            raise
+        _M_PULL_BYTES.inc(nbytes)
+
+        def ack(_local=local, _oid=oid, _desc=desc):
+            self.ack(_desc)
+            if _local:
+                # retire the local secondary copy; the store frees it when
+                # the engine's views (k/v above) are garbage-collected
+                self._drop_local(_oid)
+
+        return {"k": k, "v": v}, ack
+
+    def _drop_local(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._local_pulls.pop(oid.binary(), None)
+        self._store.delete(oid)
+
+    def ack(self, desc: dict) -> bool:
+        """Tell the publisher the pages landed (frees the published entry).
+        Rides the pull connection; a <v7 publisher never sees the op — its
+        TTL sweep reclaims instead."""
+        try:
+            peer = self._client._peer(desc["addr"])
+            if (peer.negotiated_version or 0) >= 7:
+                peer.notify("kv_ack", hid=bytes(desc["hid"]))
+                return True
+        except Exception:
+            pass  # publisher gone / old wire: TTL covers it
+        return False
+
+    # --------------------------------------------------------------- lifecycle
+    def live_handoffs(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def live_bytes(self) -> int:
+        with self._lock:
+            return sum(h.nbytes for h in self._live.values())
+
+    def wait_drained(self, timeout: float = 30.0) -> bool:
+        """Block until every published handoff has been retired (ack, TTL,
+        or claimant death). Condition-variable wait — no sleep polling."""
+        with self._cv:
+            return self._cv.wait_for(lambda: not self._live, timeout=timeout)
+
+    def stats(self) -> dict:
+        with self._lock:
+            live, live_bytes = len(self._live), sum(
+                h.nbytes for h in self._live.values())
+            local = len(self._local_pulls)
+        return {"live_handoffs": live, "live_bytes": live_bytes,
+                "local_pulls": local, "store": self._store.stats()}
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._sweep_wake.set()
+        with self._lock:
+            doomed = list(self._live)
+            stale_local = list(self._local_pulls)
+        for hid in doomed:
+            self._retire(hid, "closed")
+        for ob in stale_local:
+            self._drop_local(ObjectID(ob))
+        try:
+            self._client.close()
+        finally:
+            self._server.close()
+            if self._owns_store:
+                self._store.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

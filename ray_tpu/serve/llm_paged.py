@@ -44,7 +44,11 @@ class PagedLLMConfig(LLMConfig):
     # "device" keeps KV device-resident and ships only a transfer TICKET —
     # the decode engine pulls the pages device->device over the jax transfer
     # server (experimental/rdt.py offer_device/pull_device; reference:
-    # rdt/nixl_tensor_transport.py)
+    # rdt/nixl_tensor_transport.py); "plane" publishes the pages as a sealed
+    # object-plane entry (serve/kv_transport.py) and ships only the compact
+    # descriptor — a decode engine on ANY node pulls them with zero-copy
+    # BLOB frames straight into its own store (reference: NIXL/RDT KV
+    # transfer riding the shared object plane)
     kv_transfer: str = "host"
 
 
@@ -55,6 +59,12 @@ class PagedLLMEngine(LLMEngine):
                  external_step: bool = False):
         # PD ops (prefill_extract / attach) processed on the engine thread
         self._ops: "queue.Queue" = queue.Queue()
+        # kv_transfer="plane" wiring (set by the PD deployment that owns the
+        # engine): kv_publish(k, v, meta=...) -> descriptor publishes the
+        # gathered pages (KVTransport.publish); kv_pull(descriptor) ->
+        # ({"k","v"}, ack) lands a remote handoff (KVTransport.pull)
+        self.kv_publish = None
+        self.kv_pull = None
         super().__init__(config or PagedLLMConfig(), params=params, seed=seed,
                          external_step=external_step)
 
@@ -317,6 +327,7 @@ class PagedLLMEngine(LLMEngine):
             )
             first_tok = self._sample(np.asarray(logits)[len(prompt_ids) - 1])
             idx = np.asarray(block_ids, dtype=np.int32)
+            kv = kv_ticket = kv_ref = None
             if self.config.kv_transfer == "device":
                 # the gather creates independent device arrays (pool blocks
                 # free below); only a tiny ticket crosses the control plane —
@@ -326,9 +337,20 @@ class PagedLLMEngine(LLMEngine):
                 kv_ticket = rdt.offer_device(
                     {"k": self.pool["k"][:, :, idx],
                      "v": self.pool["v"][:, :, idx]})
-                kv = None
+            elif self.config.kv_transfer == "plane":
+                # publish the gathered pages as one sealed plane entry
+                # (written once into the transport store's mapped slot); the
+                # handoff that crosses the control plane is just the
+                # descriptor — a remote decode engine lands the pages with
+                # zero-copy BLOB pulls (serve/kv_transport.py)
+                if self.kv_publish is None:
+                    raise RuntimeError(
+                        "kv_transfer='plane' requires engine.kv_publish to "
+                        "be bound to a KVTransport.publish")
+                kv_ref = self.kv_publish(
+                    np.asarray(self.pool["k"][:, :, idx]),
+                    np.asarray(self.pool["v"][:, :, idx]))
             else:
-                kv_ticket = None
                 kv = {
                     "k": np.asarray(self.pool["k"][:, :, idx]),  # [L, H, n, bs, D]
                     "v": np.asarray(self.pool["v"][:, :, idx]),
@@ -338,6 +360,7 @@ class PagedLLMEngine(LLMEngine):
         return {
             "kv": kv,
             "kv_ticket": kv_ticket,
+            "kv_ref": kv_ref,
             "n_prefill_blocks": len(block_ids),
             "first_token": first_tok,
             "prompt_len": len(prompt_ids),
@@ -368,6 +391,33 @@ class PagedLLMEngine(LLMEngine):
             self._ops.put(("attach", payload, fut))
             return None
         kv = handoff.get("kv")
+        ack = None
+        pulled = handoff.get("_pulled")
+        if kv is None and pulled is not None:
+            # plane path, pre-pulled by the serving replica's request
+            # thread (pd.DecodeServer.decode): the engine thread never
+            # blocks on the network. Ack timing is unchanged — fired
+            # below, only after the pool scatter lands.
+            kv, ack = pulled
+        if kv is None and handoff.get("kv_ref") is not None:
+            # plane path, direct-engine fallback: land the published pages
+            # in THIS node's store with zero-copy BLOB pulls; ``kv``
+            # aliases the local slot (no transient whole-KV buffer). NOTE
+            # this pull runs ON the engine thread — serving deployments
+            # pre-pull instead (above) so a hung holder can't stall every
+            # in-flight decode stream. The ack is sent only AFTER the
+            # pool scatter lands, so a failure here leaves the publisher's
+            # copy alive for a retry (TTL reclaims eventually).
+            if self.kv_pull is None:
+                raise RuntimeError(
+                    "handoff carries a kv_ref but engine.kv_pull is not "
+                    "bound to a KVTransport.pull")
+            kv, ack = self.kv_pull(handoff["kv_ref"])
+            expect = handoff.get("n_prefill_blocks")
+            if expect is not None and kv["k"].shape[2] != expect:
+                raise ValueError(
+                    f"KV handoff shape mismatch: pulled {kv['k'].shape[2]} "
+                    f"blocks, handoff says {expect}")
         if kv is None and handoff.get("kv_ticket") is not None:
             # device path: pull the pages straight into THIS process's
             # device memory over the transfer connection (no host pickle).
@@ -385,6 +435,15 @@ class PagedLLMEngine(LLMEngine):
                     f"KV ticket shape mismatch: pulled {kv['k'].shape[2]} "
                     f"blocks, handoff says {expect}")
         n_prefill_blocks = kv["k"].shape[2]
+        table = handoff.get("block_table")
+        if table is not None and len(table) != n_prefill_blocks:
+            # descriptor-vs-payload consistency: the block table is the
+            # page-order contract for the transferred entry, so its length
+            # must match what actually arrived (not what the descriptor's
+            # own n_prefill_blocks claims — that would be tautological)
+            raise ValueError(
+                f"KV handoff block_table lists {len(table)} pages but the "
+                f"transferred entry carries {n_prefill_blocks}")
         total_blocks = -(-(prompt_len + max_new_tokens) // bs)
         block_ids = self.allocator.alloc(total_blocks)
         try:
@@ -408,6 +467,11 @@ class PagedLLMEngine(LLMEngine):
         except BaseException:
             self.allocator.free(block_ids)
             raise
+        if ack is not None:
+            try:
+                ack()  # pages landed in the pool: free both plane copies
+            except Exception:
+                pass  # publisher gone/old-wire: its TTL sweep reclaims
         # a 1-token (or 0-token) request is already complete with first_token
         self._maybe_finish(slot, handoff["first_token"])
         return slot

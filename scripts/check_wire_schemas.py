@@ -64,6 +64,8 @@ SCHEMA_BASELINE = {
     # ISSUE-10 (wire v6): elastic gangs — preemption notices + checkpoint
     # shard replication
     "preempt_notice": 57, "plane_replicate": 58,
+    # ISSUE-11 (wire v7): disaggregated PD serving — KV handoff ack
+    "kv_ack": 59,
 }
 
 # Files whose handler tables must be fully schema'd.
@@ -72,6 +74,7 @@ HANDLER_FILES = [
     "ray_tpu/core/node_agent.py",
     "ray_tpu/core/object_plane.py",
     "ray_tpu/core/client_runtime.py",
+    "ray_tpu/serve/kv_transport.py",
 ]
 
 # The sanctioned opaque-payload pickle site inside core/rpc/.
@@ -137,6 +140,8 @@ _NON_OPS = {
     "max_retries", "retry_exceptions", "name", "resources", "runtime_env",
     "isolate_process", "peer_hello", "input_chans", "output_chan",
     "_trace_ctx",
+    # kv_transport.py descriptor/stats fields (not handler-table keys)
+    "live_handoffs", "live_bytes", "k_shape", "v_shape", "local_pulls",
 }
 
 
@@ -451,6 +456,52 @@ def check_elastic_ops() -> list:
     return errors
 
 
+def check_kv_transport() -> list:
+    """The v7 KV-transfer contract (ISSUE-11 PD disaggregation):
+
+    - ``kv_ack`` is version-gated (since>=7) — a <v7 holder must never
+      receive an op number it cannot decode; the puller skips the ack and
+      the publisher's TTL sweep reclaims instead.
+    - the handoff hot path (``KVTransport.publish``/``pull``) never
+      constructs or looks up a metric — instruments bind at module import
+      (the PR-8 hot-path contract; recording through bound handles is
+      fine, registry traffic per handoff is not).
+    - the pull path stays zero-copy: ``pull`` rides ``pull_into`` (BLOB
+      frames recv_into the local store slot), with the bytes-returning
+      ``pull`` only as the store-less fallback.
+    """
+    from ray_tpu.core.rpc import schema
+
+    errors = []
+    spec = schema.REGISTRY.get("kv_ack")
+    if spec is None:
+        errors.append("kv_ack schema missing — KV handoff ack gone?")
+    elif spec.since < 7:
+        errors.append(f"kv_ack gated since={spec.since} < 7 — an old-wire "
+                      "holder would receive an op it cannot decode")
+    path = os.path.join(REPO, "ray_tpu", "serve", "kv_transport.py")
+    if not os.path.exists(path):
+        return errors + ["ray_tpu/serve/kv_transport.py missing"]
+    tree = ast.parse(open(path).read(), filename="kv_transport.py")
+    fns = _find_funcs(tree, {"publish", "pull"})
+    for name in ("publish", "pull"):
+        fn = fns.get(name)
+        if fn is None:
+            errors.append(f"kv_transport.py: {name} missing — handoff "
+                          "path gone?")
+            continue
+        for lineno, callee in _calls_in(fn, _METRIC_CONSTRUCT_CALLS):
+            errors.append(
+                f"kv_transport.py:{lineno}: {name} calls {callee}() — the "
+                "handoff hot path must stay metric-construction-free "
+                "(bind instruments at import, record through the handles)")
+    if "pull" in fns and not _calls_in(fns["pull"],
+                                       {"pull_into", "pull_into_or_pull"}):
+        errors.append("kv_transport.py: pull no longer rides pull_into — "
+                      "KV pages must land zero-copy in the local store")
+    return errors
+
+
 def run_all() -> None:
     errors = check_registry()
     errors += check_handlers_have_schemas()
@@ -459,6 +510,7 @@ def run_all() -> None:
     errors += check_dag_loop_steady_state()
     errors += check_hot_path_instruments()
     errors += check_elastic_ops()
+    errors += check_kv_transport()
     if errors:
         _fail(errors)
     from ray_tpu.core.rpc import schema

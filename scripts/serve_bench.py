@@ -1,17 +1,29 @@
 #!/usr/bin/env python
-"""Serve north-star benchmark: req/s + TTFT over the OpenAI ingress.
+"""Serve north-star benchmark: OPEN-LOOP arrival-rate sweep + PD A/B.
 
 Parity: the reference's serve release workloads
-(release/serve_tests/workloads/) which gate serve regressions on sustained
-req/s and latency percentiles. Runs the full production path — HTTP proxy ->
-router -> deployment replica -> LLM engine (CPU byte-tokenizer fallback
-model, so the artifact is hermetic and hardware-independent) — and emits
-``SERVE_BENCH.json`` at the repo root:
+(release/serve_tests/workloads/) which gate serving on sustained tokens/s
+and latency under a load generator that does NOT slow down when the server
+does (open loop — Poisson arrivals at a fixed offered rate; a closed loop
+self-throttles and hides the overload knee).
 
-    {"req_per_s": ..., "ttft_p50_ms": ..., "ttft_p99_ms": ..., ...}
+Two benches, one artifact (``SERVE_BENCH.json``):
 
-Usage: python scripts/serve_bench.py [--requests N] [--concurrency C]
-       [--stream-samples K] [--quick]
+1. **Ingress sweep** — the full production path (HTTP proxy -> router ->
+   replica -> engine; CPU byte-tokenizer fallback model, hermetic) swept
+   across offered arrival rates. Per rate: tokens/s, goodput under the
+   TTFT SLO (completed req/s whose TTFT met the budget), client-side
+   p50/p99 TTFT over the SSE streaming path, and end-to-end latency
+   percentiles. Replaces the old single closed-loop ~53 req/s TTFT point.
+2. **PD A/B** — disaggregated prefill/decode (serve/pd.py deployments +
+   kv_transport.py plane handoff) vs the co-located baseline, interleaved
+   rounds on the same box at the same offered rate (tiny llama model).
+   Disaggregation pays one cross-engine KV hop per request; the A/B pins
+   what that hop costs where it matters (TTFT) — the win it buys
+   (independent fleet scaling) is a topology property, not a same-box one.
+
+Usage: python scripts/serve_bench.py [--rates 2,8,16,32] [--duration 8]
+       [--slo-ttft-ms 250] [--max-tokens 8] [--quick]
 """
 
 from __future__ import annotations
@@ -19,11 +31,13 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import statistics
 import sys
 import threading
 import time
 import urllib.request
+from concurrent.futures import ThreadPoolExecutor
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -38,133 +52,274 @@ def _post(url: str, body: dict, timeout: float = 120.0) -> dict:
         return json.loads(resp.read())
 
 
-def _ttft_ms(url: str, body: dict, timeout: float = 120.0) -> float:
-    """Time-to-first-token over the SSE streaming path, in milliseconds."""
+# ------------------------------------------------------------ open-loop core
+def _open_loop(fire, rate_rps: float, duration_s: float, *, seed: int = 0,
+               max_workers: int = 1024) -> tuple[list, float]:
+    """Fire ``fire(sched_t)`` at Poisson arrivals of ``rate_rps`` for
+    ``duration_s`` seconds, never waiting for completions (open loop).
+    ``fire`` receives its request's SCHEDULED arrival time (perf_counter
+    base) and must clock latency from it — so any client-side queueing
+    (worker-pool backlog under server overload) counts against TTFT
+    instead of silently self-throttling the offered load back into a
+    closed loop and hiding the knee. The pool is sized to the arrival
+    count (capped) so every scheduled request can be outstanding at once.
+    Returns (per-request records, wall seconds incl. the drain tail)."""
+    rng = random.Random(seed)
+    arrivals, t = [], 0.0
+    while True:
+        t += rng.expovariate(rate_rps)
+        if t >= duration_s:
+            break
+        arrivals.append(t)
+    pool = ThreadPoolExecutor(
+        max_workers=min(max_workers, max(1, len(arrivals))))
+    futs = []
+    t0 = time.perf_counter()
+    for at in arrivals:
+        delay = t0 + at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        futs.append(pool.submit(fire, t0 + at))
+    records = [f.result() for f in futs]
+    wall = time.perf_counter() - t0
+    pool.shutdown(wait=False)
+    return records, wall
+
+
+def _pct(sorted_vals: list, p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return round(sorted_vals[min(len(sorted_vals) - 1,
+                                 int(p * len(sorted_vals)))], 2)
+
+
+def _point(records: list, wall: float, rate: float, slo_ttft_ms: float,
+           tokens_per_req: int) -> dict:
+    ok = [r for r in records if r.get("ok")]
+    ttfts = sorted(r["ttft_ms"] for r in ok)
+    lats = sorted(r["latency_ms"] for r in ok)
+    good = sum(1 for r in ok if r["ttft_ms"] <= slo_ttft_ms)
+    return {
+        "rate_rps": rate,
+        "offered": len(records),
+        "completed": len(ok),
+        "errors": len(records) - len(ok),
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(len(ok) * tokens_per_req / wall, 2)
+        if wall > 0 else 0.0,
+        "goodput_rps": round(good / wall, 2) if wall > 0 else 0.0,
+        "ttft_p50_ms": _pct(ttfts, 0.50),
+        "ttft_p99_ms": _pct(ttfts, 0.99),
+        "latency_p50_ms": _pct(lats, 0.50),
+        "latency_p99_ms": _pct(lats, 0.99),
+    }
+
+
+# ------------------------------------------------------------- ingress sweep
+def _fire_stream(url: str, body: dict, timeout: float = 120.0,
+                 sched_t: float | None = None) -> dict:
+    """One SSE streaming request: client-side TTFT (first data frame) +
+    total latency; the stream is drained so the request really completes.
+    Clocks start at ``sched_t`` (the open-loop scheduled arrival) when
+    given, so pre-send queueing is part of the measurement."""
     body = dict(body, stream=True)
     req = urllib.request.Request(
         url, data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"})
-    t0 = time.perf_counter()
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        for raw in resp:
-            line = raw.decode().strip()
-            if line.startswith("data: ") and line != "data: [DONE]":
-                return (time.perf_counter() - t0) * 1000.0
-    raise RuntimeError("stream produced no data frames")
+    t0 = time.perf_counter() if sched_t is None else sched_t
+    ttft = None
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for raw in resp:
+                line = raw.decode().strip()
+                if line.startswith("data: "):
+                    if ttft is None and line != "data: [DONE]":
+                        ttft = (time.perf_counter() - t0) * 1000.0
+        if ttft is None:
+            return {"ok": False}
+        return {"ok": True, "ttft_ms": ttft,
+                "latency_ms": (time.perf_counter() - t0) * 1000.0}
+    except Exception:
+        return {"ok": False}
 
 
-def _throughput(url: str, body: dict, n: int, concurrency: int) -> dict:
-    """Sustained closed-loop req/s with per-request latency percentiles."""
-    latencies: list[float] = []
-    errors = [0]
-    lock = threading.Lock()
-    it = iter(range(n))
+def run_ingress_sweep(base: str, rates: list, duration_s: float,
+                      slo_ttft_ms: float, max_tokens: int) -> list:
+    from ray_tpu import serve
 
-    def worker():
-        while True:
-            with lock:
-                try:
-                    next(it)
-                except StopIteration:
-                    return
-            t0 = time.perf_counter()
-            try:
-                _post(url, body)
-            except Exception:
-                with lock:
-                    errors[0] += 1
-                continue
-            dt = (time.perf_counter() - t0) * 1000.0
-            with lock:
-                latencies.append(dt)
+    app = serve.build_openai_app()  # default config: CPU-model fallback
+    serve.run(app, route_prefix="/v1")
+    # AFTER the first serve.run: controller creation resets serve._state,
+    # which stops any proxy started before it
+    serve.start_http_proxy(port=PORT)
+    url = f"{base}/v1/chat/completions"
+    body = {"messages": [{"role": "user", "content": "benchmark prompt"}],
+            "max_tokens": max_tokens}
+    _post(url, body)  # warm: model build + route table + first compile
+    _fire_stream(url, body)
 
-    threads = [threading.Thread(target=worker, daemon=True)
-               for _ in range(concurrency)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    done = len(latencies)
-    lat = sorted(latencies) or [0.0]
+    points = []
+    for i, rate in enumerate(rates):
+        records, wall = _open_loop(
+            lambda sched: _fire_stream(url, body, sched_t=sched),
+            rate, duration_s, seed=17 + i)
+        pt = _point(records, wall, rate, slo_ttft_ms, max_tokens)
+        print(f"  ingress rate={rate:g}/s -> {pt['tokens_per_s']} tok/s, "
+              f"goodput {pt['goodput_rps']}/s, "
+              f"ttft p50/p99 {pt['ttft_p50_ms']}/{pt['ttft_p99_ms']} ms")
+        points.append(pt)
+    return points
 
-    def pct(p):
-        return round(lat[min(len(lat) - 1, int(p * len(lat)))], 2)
+
+# ------------------------------------------------------------------ PD A/B
+def _fire_pd(url: str, body: dict, timeout: float = 120.0,
+             sched_t: float | None = None) -> dict:
+    """One PD request over the JSON surface; TTFT is the server-reported
+    prefill time (identical definition on both arms of the A/B), while
+    latency clocks from the scheduled arrival when given (queue wait
+    under overload stays visible)."""
+    t0 = time.perf_counter() if sched_t is None else sched_t
+    try:
+        out = _post(url, body, timeout=timeout)
+        res = out.get("result", out)
+        return {"ok": True,
+                "ttft_ms": res["timings"]["ttft_s"] * 1000.0,
+                "latency_ms": (time.perf_counter() - t0) * 1000.0}
+    except Exception:
+        return {"ok": False}
+
+
+def run_pd_ab(base: str, rate_rps: float, duration_s: float, rounds: int,
+              slo_ttft_ms: float, max_tokens: int) -> dict:
+    """Interleaved A/B: co-located PDServer vs disaggregated
+    prefill/decode on the same box, same offered load, alternating rounds
+    (co, dis, co, dis ...) so box drift hits both arms equally."""
+    from ray_tpu import serve
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm_paged import PagedLLMConfig
+    from ray_tpu.serve.pd import build_pd_deployment, deploy_pd_app
+
+    cfg = PagedLLMConfig(model_config=llama.LlamaConfig.tiny(),
+                         max_batch_size=8, max_seq_len=128, block_size=16)
+    serve.run(build_pd_deployment(cfg), route_prefix="/pd_co")
+    deploy_pd_app(cfg, route_prefix="/pd_dis")
+    # shared 32-token system prefix + unique tail: exercises the prefix
+    # cache/affinity machinery the same way on both arms
+    prefix = list(range(7, 39))
+
+    def body(i):
+        return {"prompt_ids": prefix + [40 + (i % 100)],
+                "max_tokens": max_tokens}
+
+    for route in ("/pd_co", "/pd_dis"):  # warm both arms
+        _post(f"{base}{route}", body(0))
+
+    arms = {"colocated": "/pd_co", "disaggregated": "/pd_dis"}
+    per_round: dict = {a: [] for a in arms}
+    for rnd in range(rounds):
+        for arm, route in arms.items():
+            n = {"i": 0}
+            n_lock = threading.Lock()
+
+            def fire(sched, route=route, n=n, n_lock=n_lock):
+                with n_lock:
+                    n["i"] += 1
+                    i = n["i"]
+                return _fire_pd(f"{base}{route}", body(i), sched_t=sched)
+
+            records, wall = _open_loop(fire, rate_rps, duration_s,
+                                       seed=29 + rnd)
+            pt = _point(records, wall, rate_rps, slo_ttft_ms, max_tokens)
+            per_round[arm].append(pt)
+            print(f"  pd round {rnd} {arm}: {pt['tokens_per_s']} tok/s, "
+                  f"ttft p50 {pt['ttft_p50_ms']} ms, "
+                  f"goodput {pt['goodput_rps']}/s")
+
+    def median_point(pts: list) -> dict:
+        keys = ("tokens_per_s", "goodput_rps", "ttft_p50_ms", "ttft_p99_ms",
+                "latency_p50_ms", "latency_p99_ms")
+        out = dict(pts[0])
+        for k in keys:
+            out[k] = round(statistics.median(p[k] for p in pts), 2)
+        out["completed"] = sum(p["completed"] for p in pts)
+        out["errors"] = sum(p["errors"] for p in pts)
+        out["offered"] = sum(p["offered"] for p in pts)
+        # counts are summed across rounds, so wall must be too — anyone
+        # recomputing completed/wall_s from the artifact should land near
+        # the rate columns, not 2x off
+        out["wall_s"] = round(sum(p["wall_s"] for p in pts), 3)
+        return out
 
     return {
-        "requests": n, "completed": done, "errors": errors[0],
-        "concurrency": concurrency, "wall_s": round(wall, 3),
-        "req_per_s": round(done / wall, 2) if wall > 0 else 0.0,
-        "latency_p50_ms": pct(0.50), "latency_p99_ms": pct(0.99),
+        "rate_rps": rate_rps, "duration_s": duration_s, "rounds": rounds,
+        "max_tokens": max_tokens, "model": "llama-tiny-cpu",
+        "colocated": median_point(per_round["colocated"]),
+        "disaggregated": median_point(per_round["disaggregated"]),
+        "per_round": per_round,
     }
 
 
-def run(requests: int, concurrency: int, stream_samples: int,
-        max_tokens: int = 8) -> dict:
+# ----------------------------------------------------------------------- main
+def run(rates: list, duration_s: float, slo_ttft_ms: float, max_tokens: int,
+        pd_rate: float, pd_rounds: int, pd_max_tokens: int) -> dict:
     import ray_tpu
+
     from ray_tpu import serve
 
     ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
-    app = serve.build_openai_app()  # default config: CPU-model fallback
-    serve.run(app, route_prefix="/v1")
-    proxy = serve.start_http_proxy(port=PORT)
-    base = f"http://127.0.0.1:{PORT}/v1"
-    chat_body = {
-        "messages": [{"role": "user", "content": "benchmark prompt"}],
-        "max_tokens": max_tokens,
-    }
-
-    # warm: model build + route table + first compile
-    _post(f"{base}/chat/completions", chat_body)
-
-    # TTFT over the streaming path (sequential: measures the ingress->first-
-    # delta critical path, not queueing)
-    ttfts = [_ttft_ms(f"{base}/chat/completions", chat_body)
-             for _ in range(stream_samples)]
-    ttfts.sort()
-
-    def pct(vals, p):
-        return round(vals[min(len(vals) - 1, int(p * len(vals)))], 2)
-
-    # sustained closed-loop throughput on the non-streaming path
-    tput = _throughput(f"{base}/chat/completions", chat_body,
-                       requests, concurrency)
-
+    base = f"http://127.0.0.1:{PORT}"
+    print(f"ingress sweep: rates {rates} req/s x {duration_s}s, "
+          f"SLO ttft<={slo_ttft_ms}ms")
+    sweep = run_ingress_sweep(base, rates, duration_s, slo_ttft_ms,
+                              max_tokens)
+    print(f"PD A/B: {pd_rate} req/s x {duration_s}s x {pd_rounds} rounds")
+    pd_ab = run_pd_ab(base, rate_rps=pd_rate, duration_s=duration_s,
+                      rounds=pd_rounds, slo_ttft_ms=slo_ttft_ms,
+                      max_tokens=pd_max_tokens)
     result = {
-        "bench": "serve_openai_ingress",
+        "bench": "serve_openai_ingress_sweep",
         "model": "cpu-byte-fallback",
         "max_tokens": max_tokens,
-        "ttft_samples": stream_samples,
-        "ttft_p50_ms": pct(ttfts, 0.50),
-        "ttft_p99_ms": pct(ttfts, 0.99),
-        "ttft_mean_ms": round(statistics.fmean(ttfts), 2),
-        **tput,
+        "slo_ttft_ms": slo_ttft_ms,
+        "duration_s": duration_s,
+        "ttft_definition": "client-side first SSE data frame (sweep); "
+                           "server-reported prefill time (pd_ab)",
+        "sweep": sweep,
+        "pd_ab": pd_ab,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
-    try:
-        proxy.stop()
-    except Exception:
-        pass
+    serve.shutdown()
     ray_tpu.shutdown()
     return result
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--requests", type=int, default=300)
-    parser.add_argument("--concurrency", type=int, default=8)
-    parser.add_argument("--stream-samples", type=int, default=50)
+    parser.add_argument("--rates", default="2,8,16,32",
+                        help="offered arrival rates (req/s), comma-separated")
+    parser.add_argument("--duration", type=float, default=8.0,
+                        help="seconds of offered load per rate point")
+    parser.add_argument("--slo-ttft-ms", type=float, default=250.0)
     parser.add_argument("--max-tokens", type=int, default=8)
+    parser.add_argument("--pd-rate", type=float, default=4.0,
+                        help="offered rate for the PD A/B rounds")
+    parser.add_argument("--pd-rounds", type=int, default=2,
+                        help="interleaved rounds per PD arm")
+    parser.add_argument("--pd-max-tokens", type=int, default=16,
+                        help="decode length for the PD A/B (recorded in "
+                             "pd_ab.max_tokens; the top-level max_tokens "
+                             "is the ingress sweep's)")
     parser.add_argument("--quick", action="store_true",
                         help="smoke sizes (CI)")
     parser.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
     args = parser.parse_args()
+    rates = [float(r) for r in args.rates.split(",") if r]
     if args.quick:
-        args.requests, args.stream_samples = 30, 8
-    result = run(args.requests, args.concurrency, args.stream_samples,
-                 args.max_tokens)
-    print(json.dumps(result, indent=2))
+        rates, args.duration, args.pd_rounds = [2.0, 8.0], 4.0, 1
+    result = run(rates, args.duration, args.slo_ttft_ms, args.max_tokens,
+                 args.pd_rate, args.pd_rounds, args.pd_max_tokens)
+    print(json.dumps({k: v for k, v in result.items() if k != "pd_ab"},
+                     indent=2))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")

@@ -207,3 +207,24 @@ def test_kill_before_creation_does_not_resurrect(ray_start_regular):
     time.sleep(0.3)
     with pytest.raises((ActorError, TaskError)):
         ray_tpu.get(a.ping.remote(), timeout=5)
+
+
+def test_kill_releases_instance_for_gc(ray_start_regular):
+    """kill() must drop the thread-actor instance from the actor table so
+    its object graph (engines, shm arenas, sockets) is garbage-collectable
+    — otherwise every killed/redeployed in-process replica leaks for the
+    process's life (the serve controller churns replicas on drain,
+    health-check failure, and redeploy)."""
+    import gc
+    import weakref
+
+    from ray_tpu.core.runtime import get_runtime
+
+    c = Counter.remote(1)
+    assert ray_tpu.get(c.read.remote()) == 1
+    state = get_runtime()._actors[c._actor_id]
+    ref = weakref.ref(state.instance)
+    assert ref() is not None
+    ray_tpu.kill(c)
+    gc.collect()
+    assert ref() is None, "killed actor's instance still referenced"

@@ -92,6 +92,110 @@ def test_affinity_yields_under_imbalance():
     assert r._rkey(r._select(prompt)) == other
 
 
+def _mk_router(replica_nodes: dict, inflight: dict, *, io_view=None,
+               bonus: float = 1.0, tolerance: int = 2):
+    """Bare KVAwareRouter with injected replica/node/io state (no serve)."""
+    import threading
+    from collections import OrderedDict
+
+    from ray_tpu.serve.kv_router import KVAwareRouter
+
+    class FakeReplica:
+        def __init__(self, key):
+            self._actor_id = type("I", (), {
+                "hex": lambda self2, k=key: k})()
+
+    r = KVAwareRouter.__new__(KVAwareRouter)
+    r.block_size = 16
+    r.max_tracked_prefixes = 100
+    r.imbalance_tolerance = tolerance
+    r.locality_bonus = bonus
+    r._prefix_owner = OrderedDict()
+    r._lock = threading.Lock()
+    r._replicas = [FakeReplica(k) for k in replica_nodes]
+    r._replica_nodes = dict(replica_nodes)
+    r._inflight = dict(inflight)
+    r._live_snapshot = frozenset()
+    r._io_cache = (0.0, {})
+    r._io_view_fn = io_view or (lambda: {"nodes": {}})
+    return r
+
+
+def test_decode_placement_prefers_holder_node():
+    """A handoff descriptor routes to the replica on the page holder's node
+    when loads are level (pull locality beats a coin flip)."""
+    r = _mk_router({"a": "n1", "b": "n2"}, {"a": 0, "b": 0})
+    for _ in range(8):
+        pick = r._select(("decode", {"node": "n2", "nbytes": 1}))
+        assert r._rkey(pick) == "b"
+
+
+def test_decode_placement_yields_under_load():
+    """Locality is worth exactly ``locality_bonus`` in queue depth — an
+    overloaded holder-node replica loses to an idle remote one."""
+    r = _mk_router({"a": "n1", "b": "n2"}, {"a": 0, "b": 2}, bonus=1.0)
+    pick = r._select(("decode", {"node": "n2"}))
+    assert r._rkey(pick) == "a"
+    # within the bonus, the holder still wins
+    r = _mk_router({"a": "n1", "b": "n2"}, {"a": 0, "b": 0}, bonus=1.0)
+    assert r._rkey(r._select(("decode", {"node": "n2"}))) == "b"
+
+
+def test_decode_placement_folds_io_pressure():
+    """node_io_view pressure (pending pull bytes) counts against a node:
+    a decode replica behind a saturated NIC loses the handoff even when it
+    holds locality."""
+    view = {"nodes": {"n2": {"pending_pull_bytes": 64 << 20,
+                             "holder_pending_bytes": {}},
+                      "n1": {"pending_pull_bytes": 0,
+                             "holder_pending_bytes": {}}}}
+    r = _mk_router({"a": "n1", "b": "n2"}, {"a": 0, "b": 0},
+                   io_view=lambda: view, bonus=1.0)
+    # n2 pressure = 64MB/32MB = 2.0 > bonus 1.0: the idle off-holder wins
+    assert r._rkey(r._select(("decode", {"node": "n2"}))) == "a"
+
+
+def test_decode_hint_extracted_from_handoff_body():
+    r = _mk_router({"a": "n1"}, {"a": 0})
+    hint = r._routing_hint("decode", ({"handoff": {"kv_ref": {"node": "n9"}},
+                                       "max_tokens": 4},), {})
+    assert hint == ("decode", {"node": "n9"})
+    hint = r._routing_hint("__call__", ({"prompt_ids": [1, 2, 3]},), {})
+    assert hint == ("prefix", [1, 2, 3])
+
+
+def test_prefix_owners_pruned_when_replica_removed():
+    """Satellite: dead-replica owners are dropped on refresh instead of
+    lingering to the LRU bound and burning longest-prefix lookups."""
+    r = _mk_router({"a": "n1", "b": "n2"}, {"a": 0, "b": 0})
+    prompt = list(range(32))
+    hashes = r._block_hashes(prompt)
+    r._claim(hashes, "a")
+    r._claim(r._block_hashes(list(range(100, 132))), "dead")
+    assert len(r._prefix_owner) == 4
+    r._prune_stale_owners(frozenset({"a", "b"}))
+    assert len(r._prefix_owner) == 2
+    assert set(r._prefix_owner.values()) == {"a"}
+    # unchanged replica set: prune is a no-op fast path
+    r._claim(r._block_hashes(list(range(200, 232))), "ghost")
+    r._prune_stale_owners(frozenset({"a", "b"}))
+    assert "ghost" in set(r._prefix_owner.values())
+
+
+def test_affinity_boundary_exactly_at_tolerance():
+    """The owner keeps the request AT the imbalance tolerance and yields
+    one past it (boundary pinned so a drift regression is loud)."""
+    r = _mk_router({"a": "n1", "b": "n2"}, {"a": 0, "b": 0}, tolerance=2)
+    prompt = list(range(32))
+    first = r._select(("prefix", prompt))
+    key = r._rkey(first)
+    other = "b" if key == "a" else "a"
+    r._inflight[key] = 2  # == min_load + tolerance: affinity holds
+    assert r._rkey(r._select(("prefix", prompt))) == key
+    r._inflight[key] = 3  # one past: balance wins
+    assert r._rkey(r._select(("prefix", prompt))) == other
+
+
 def test_unknown_router_rejected():
     from ray_tpu.serve.kv_router import make_router
 

@@ -119,3 +119,101 @@ def test_pd_disaggregation_handoff(shared_params):
         prefiller.shutdown()
         decoder.shutdown()
         ref_engine.shutdown()
+
+
+# ------------------------------------------------- BlockPool under pressure
+def test_alloc_rollback_under_pressure_releases_evicted_cache_blocks():
+    """An alloc that evicts cached-prefix blocks and STILL comes up short
+    must roll the whole grab back — evicted-from-cache blocks return to the
+    free list (not leaked as phantom refs) and full capacity stays
+    allocatable."""
+    from ray_tpu.serve.paged_kv import BlockPool, NoFreeBlocks
+
+    pool = BlockPool(num_blocks=6, block_size=4)  # blocks 1..5 usable
+    prompt = list(range(8))  # 2 full blocks
+    ids = pool.alloc(2)
+    pool.register_prefix(prompt, ids)
+    pool.free(ids)  # cached at refcount 0: reusable until evicted
+    assert pool.stats()["cached_blocks"] == 2
+    assert pool.stats()["free_blocks"] == 5
+
+    with pytest.raises(NoFreeBlocks):
+        pool.alloc(6)  # 3 plain free + 2 evictable cached < 6
+    st = pool.stats()
+    assert st["free_blocks"] == 5, "rollback leaked blocks"
+    assert st["allocated_blocks"] == 0
+    # the failed attempt consumed the cache entries of the blocks it evicted
+    # (they were reclaimed mid-grab; rollback returns them as PLAIN free)
+    got = pool.alloc(5)  # full capacity still allocatable
+    assert len(set(got)) == 5
+    pool.free(got)
+
+
+def test_alloc_eviction_prefers_lru_zero_ref_cached_block():
+    from ray_tpu.serve.paged_kv import BlockPool
+
+    pool = BlockPool(num_blocks=4, block_size=4)  # 3 usable
+    pa, pb, pc = [list(range(i, i + 4)) for i in (0, 10, 20)]
+    a = pool.alloc(1); pool.register_prefix(pa, a); pool.free(a)
+    b = pool.alloc(1); pool.register_prefix(pb, b); pool.free(b)
+    c = pool.alloc(1); pool.register_prefix(pc, c); pool.free(c)
+    # touch A so B becomes the LRU zero-ref entry
+    hit, n = pool.lookup_prefix(pa)
+    assert hit == a and n == 4
+    got = pool.alloc(1)  # free list empty: must evict LRU (B)
+    assert got == b
+    # B's cache entry is gone; A (referenced) and C survive
+    assert pool.lookup_prefix(pb) == ([], 0)
+    assert pool.lookup_prefix(pc)[1] == 4
+    pool.free(hit); pool.free(got); pool.free(pool.lookup_prefix(pa)[0])
+    pool.free(pool.lookup_prefix(pc)[0])
+
+
+def test_register_prefix_with_partially_cached_prompt():
+    """skip_blocks: re-registering a prompt whose prefix was already cached
+    must neither duplicate entries nor rebind the cached block."""
+    from ray_tpu.serve.paged_kv import BlockPool
+
+    pool = BlockPool(num_blocks=8, block_size=4)
+    prompt = list(range(12))  # 3 full blocks
+    first = pool.alloc(1)
+    pool.register_prefix(prompt[:4], first)
+    pool.free(first)
+
+    hit, cached_len = pool.lookup_prefix(prompt)
+    assert hit == first and cached_len == 4  # partial: 1 of 3 blocks cached
+    fresh = pool.alloc(2)
+    block_ids = hit + fresh
+    pool.register_prefix(prompt, block_ids, skip_blocks=cached_len // 4)
+    st = pool.stats()
+    assert st["cached_blocks"] == 3, "suffix blocks not content-addressed"
+
+    # the whole prompt now resolves, through the ORIGINAL first block
+    pool.free(block_ids)
+    hit2, cached2 = pool.lookup_prefix(prompt)
+    assert cached2 == 12 and hit2[0] == first[0]
+    assert hit2[1:] == fresh
+    pool.free(hit2)
+
+
+def test_engine_admission_rolls_back_cached_hit_refs_when_pool_full():
+    """_admit_one under pool pressure: a request that took prefix-hit refs
+    but can't get its fresh blocks must drop those refs (the cached blocks
+    stay evictable — not pinned by a request that never ran)."""
+    from ray_tpu.serve.paged_kv import BlockPool, NoFreeBlocks
+
+    pool = BlockPool(num_blocks=6, block_size=4)
+    prompt = list(range(8))
+    ids = pool.alloc(2)
+    pool.register_prefix(prompt, ids)
+    pool.free(ids)
+    # simulate _admit_one's sequence: take the hit refs, fail the alloc
+    hit, _ = pool.lookup_prefix(prompt)
+    assert len(hit) == 2
+    with pytest.raises(NoFreeBlocks):
+        pool.alloc(6)
+    for b in hit:  # the engine's rollback path
+        pool.free([b])
+    # every cached block is back at refcount 0 -> still evictable/reusable
+    st = pool.stats()
+    assert st["free_blocks"] == 5 and st["allocated_blocks"] == 0

@@ -210,3 +210,75 @@ def test_process_backed_replicas(session):
     pids = {ray_tpu.get(h.remote({}), timeout=60)["pid"] for _ in range(8)}
     assert all(p != os.getpid() for p in pids)
     serve.delete("pidapp")
+
+
+def test_proactive_drain_on_preempt_notice(session):
+    """Serve fleets get the elastic-gang drain path: a preempt_notice (or
+    node death) on the "nodes" channel stops routing to that node's
+    replicas — they leave the routing set immediately, are replaced by
+    reconcile, and the drain is flight-recorded."""
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.serve.controller import ServeController
+    from ray_tpu.util import flight_recorder
+
+    ctrl = ServeController()
+
+    @serve.deployment(name="DrainMe", num_replicas=2)
+    class DrainMe:
+        def __call__(self, body):
+            return 1
+
+    try:
+        ctrl.deploy(DrainMe.bind().deployment, None)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(ctrl.get_replicas("DrainMe")) < 2:
+            time.sleep(0.05)
+        reps = ctrl.get_replicas("DrainMe")
+        assert len(reps) == 2
+
+        # direct drain: pin one replica to a fake node, cordon it
+        key0 = reps[0]._actor_id.hex()
+        ctrl._replica_nodes[key0] = "doomnode"
+        drained = ctrl.drain_node("doomnode", reason="test")
+        assert drained == 1
+        assert key0 not in [r._actor_id.hex()
+                            for r in ctrl.get_replicas("DrainMe")]
+        assert "doomnode" in ctrl.get_draining_nodes()
+        recs = [r for r in flight_recorder.records("serve")
+                if r["event"] == "node_drain"
+                and r.get("node_id") == "doomnode"]
+        assert recs and recs[-1]["replicas"] == 1
+
+        # reconcile replaces the drained replica
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(ctrl.get_replicas("DrainMe")) < 2:
+            time.sleep(0.05)
+        assert len(ctrl.get_replicas("DrainMe")) == 2
+
+        # pubsub path: a preempt_notice event drains without any direct call
+        reps = ctrl.get_replicas("DrainMe")
+        key1 = reps[0]._actor_id.hex()
+        ctrl._replica_nodes[key1] = "doomnode2"
+        get_runtime().publisher.publish(
+            "nodes", {"node_id": "doomnode2", "event": "preempt_notice",
+                      "deadline_s": 30.0})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            keys = [r._actor_id.hex() for r in ctrl.get_replicas("DrainMe")]
+            if key1 not in keys:
+                break
+            time.sleep(0.05)
+        assert key1 not in [r._actor_id.hex()
+                            for r in ctrl.get_replicas("DrainMe")]
+        assert "doomnode2" in ctrl.get_draining_nodes()
+
+        # a node re-registering clears its cordon
+        get_runtime().publisher.publish(
+            "nodes", {"node_id": "doomnode2", "event": "registered"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                "doomnode2" in ctrl.get_draining_nodes():
+            time.sleep(0.05)
+        assert "doomnode2" not in ctrl.get_draining_nodes()
+    finally:
+        ctrl.shutdown()

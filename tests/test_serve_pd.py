@@ -28,7 +28,7 @@ def test_pd_deployment_matches_single_engine(session):
     prompt = list(range(3, 40))
     out = ray_tpu.get(handle.remote({"prompt_ids": prompt, "max_tokens": 8}),
                       timeout=120)
-    assert out["disaggregated"] is True
+    assert out["disaggregated"] is False  # co-located baseline shape
     assert out["usage"]["completion_tokens"] == 8
 
     # same params/seed single engine must produce identical greedy tokens
@@ -44,6 +44,41 @@ def test_pd_deployment_matches_single_engine(session):
 
     stats = ray_tpu.get(handle.stats.remote(), timeout=30)
     assert "prefill" in stats and "decode" in stats
+
+
+def test_pd_disaggregated_app_matches_single_engine(session):
+    """The real PD shape: separate prefill and decode deployments joined by
+    the PDController, KV pages riding the object plane (kv_transport.py).
+    Greedy tokens must match the single-engine baseline exactly, and every
+    published handoff must be ack-freed."""
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm_paged import PagedLLMConfig, PagedLLMEngine
+    from ray_tpu.serve.pd import deploy_pd_app
+
+    cfg = PagedLLMConfig(model_config=llama.LlamaConfig.tiny(),
+                         max_batch_size=4, max_seq_len=128, block_size=16)
+    handle = deploy_pd_app(cfg, route_prefix="/pd_dis")
+    prompt = list(range(3, 40))
+    out = ray_tpu.get(handle.remote({"prompt_ids": prompt, "max_tokens": 8}),
+                      timeout=120)
+    assert out["disaggregated"] is True
+    assert out["usage"]["completion_tokens"] == 8
+    assert out["pd"]["prefill_replica"] != out["pd"]["decode_replica"]
+
+    import jax
+
+    params = llama.init(cfg.model_config, jax.random.PRNGKey(0))
+    ref_engine = PagedLLMEngine(cfg, params=params)
+    try:
+        expect = ref_engine.generate_sync(prompt, 8).token_ids
+    finally:
+        ref_engine.shutdown()
+    assert out["token_ids"] == expect
+
+    stats = ray_tpu.get(handle.stats.remote(), timeout=30)
+    assert stats["prefill"]["kv"]["live_handoffs"] == 0, (
+        "handoff not freed on decode ack")
+    assert stats["decode"]["kv"]["live_handoffs"] == 0
 
 
 def test_dp_attention_gang_lockstep(ray_start_regular):
@@ -100,6 +135,11 @@ def test_device_kv_transfer_cross_process(session):
     jax transfer server — across OS processes only a tiny ticket rides the
     control plane (bytes-on-wire asserted), and the tokens match the host
     path exactly. Reference: rdt/nixl_tensor_transport.py."""
+    pytest.importorskip(
+        "jax.experimental.transfer",
+        reason="this jax build ships no transfer server (the device KV "
+               "path needs jax.experimental.transfer; the plane path — "
+               "test_pd_disaggregated_app — covers cross-process handoff)")
     import cloudpickle
 
     from ray_tpu.models import llama
