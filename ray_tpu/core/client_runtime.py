@@ -124,6 +124,14 @@ class ClientRuntime:
         from ray_tpu._private.ids import JobID
 
         self.job_id = JobID.from_random()  # worker-local; head re-keys task ids
+        # Client-side put-id mint: a random per-process TaskID namespace +
+        # local counter — structurally a put id (put bit set, task_id()
+        # resolves to a never-scheduled task, so cancel/lineage lookups
+        # no-op exactly like head-allocated put ids).
+        from ray_tpu._private.ids import TaskID as _TaskID
+
+        self._put_ns = _TaskID(os.urandom(_TaskID.SIZE))
+        self._put_mint_index = 0
         # Telemetry push (wire v5): workers are where a node's plane pulls
         # and compiled-graph channels actually run, so each worker ships its
         # own registry + flight events to the head (reference: every process
@@ -329,6 +337,12 @@ class ClientRuntime:
                 pass  # local store full: serve this get from the pulled bytes
         return blob
 
+    def _mint_put_id(self) -> bytes:
+        with self._lock:
+            self._put_mint_index += 1
+            idx = self._put_mint_index
+        return ObjectID.for_put(self._put_ns, idx).binary()
+
     def put(self, value: Any) -> ObjectRef:
         from ray_tpu._private.config import get_config
         from ray_tpu.core.object_ref import collect_serialized_refs
@@ -338,7 +352,13 @@ class ClientRuntime:
         store = self._shm()
         if store is not None and len(blob) > get_config().max_inline_object_size:
             try:
-                oid_bin = self._rpc().call("client_put_alloc", timeout=30)
+                # Client-minted put id (ISSUE-12 data-plane hot path): the
+                # head's seal handler registers whatever id the client sealed
+                # under — its own random put namespace can't collide with the
+                # head's — so the alloc round-trip is gone and a worker put
+                # costs ONE control-plane RPC. client_put_alloc stays served
+                # for older clients (append-only wire).
+                oid_bin = self._mint_put_id()
                 store.put_bytes(ObjectID(oid_bin), blob)
                 if self._plane_mode == "isolated":
                     # this node holds the primary: pin it locally (the head

@@ -74,10 +74,7 @@ _CLIENTS: "weakref.WeakSet[PlaneClient]" = weakref.WeakSet()
 
 
 def _inflight_bytes_producer():
-    total = 0
-    for c in list(_CLIENTS):
-        total += c._budget.inflight_bytes
-    return [({}, total)]
+    return [({}, local_inflight_pull_bytes())]
 
 
 def _holder_pending_producer():
@@ -91,6 +88,25 @@ def _holder_pending_producer():
 Gauge("ray_tpu_plane_pull_bytes_in_flight",
       "bytes admitted by the pull budget and not yet landed"
       ).attach_producer(_inflight_bytes_producer)
+
+
+# Budget hooks (ISSUE-12): the process-local pressure signal higher planes
+# consume without reaching into client internals — the streaming data
+# executor stops admitting upstream blocks while pulls are saturating the
+# admission budget (data/streaming.py io_pressure_hot).
+def local_inflight_pull_bytes() -> int:
+    """Bytes currently admitted by THIS process's pull budget(s) and not
+    yet landed, summed over live PlaneClients."""
+    total = 0
+    for c in list(_CLIENTS):
+        total += c._budget.inflight_bytes
+    return total
+
+
+def pull_budget_bytes() -> int:
+    """The plane's bytes-being-pulled admission budget (the denominator
+    pressure fractions are computed against)."""
+    return PULL_BYTES
 Gauge("ray_tpu_plane_holder_pending_bytes",
       "chunk bytes currently owed by each holder address",
       tag_keys=("holder",)).attach_producer(_holder_pending_producer)

@@ -8,6 +8,8 @@ task pipelines; `iter_batches(batch_format="jax")` lands batches in HBM.
 from ray_tpu.data.block import Block
 from ray_tpu.data.dataset import DataIterator, Dataset
 from ray_tpu.data.executor import ActorPoolStrategy
+from ray_tpu.data.exchange import PartitionLostError
+from ray_tpu.data.streaming import BlockRef, IngestStats
 from ray_tpu.data.read_api import (
     from_arrow,
     from_huggingface,
@@ -30,7 +32,8 @@ from ray_tpu.data import llm  # noqa: F401  (ray.data.llm parity surface)
 
 __all__ = [
     "llm",
-    "Block", "Dataset", "DataIterator",
+    "Block", "Dataset", "DataIterator", "BlockRef", "IngestStats",
+    "PartitionLostError",
     "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "from_huggingface", "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images", "read_tfrecords", "read_webdataset",

@@ -235,33 +235,64 @@ class Dataset:
             return PhysicalOp("Select", lambda b, c=cols: [b.select(c)])
         raise ValueError(f"Unknown logical op {op.kind}")
 
-    def iter_blocks(self) -> Iterator[Block]:
-        """Compile the op chain in order: task-parallel segments stream through
-        execute_streaming; stream-side stateful ops (limit/repartition) apply at
-        their position in the chain."""
-        stream: Iterator[Block] = self._source_fn()
+    def _iter_items(self) -> Iterator[Any]:
+        """Compile the op chain in order, yielding a MIXED stream of Blocks
+        (driver-local segments) and plane descriptors (outputs of remote
+        segments — ``data/streaming.py`` BlockRefs). Task-parallel segments
+        stream through the executor; stream-side stateful ops
+        (limit/repartition) apply at their position in the chain. Consumers
+        pick their edge: ``iter_blocks`` materializes payloads here,
+        ``iter_block_refs`` keeps everything plane-resident."""
+        from ray_tpu.data import streaming
+
+        plane = streaming.plane_streaming_enabled()
+        stream: Iterator[Any] = self._source_fn()
         segment: list[PhysicalOp] = []
         # per-execution sink, atomically rebound: concurrent iterations of the
         # same Dataset each own their list; stats() shows the latest execution
         sink: list = []
         self._last_stats = sink
 
-        def flush(s: Iterator[Block], seg: list[PhysicalOp]) -> Iterator[Block]:
-            return execute_streaming(s, seg, stats_sink=sink) if seg else s
+        def flush(s: Iterator[Any], seg: list[PhysicalOp]) -> Iterator[Any]:
+            if not seg:
+                return s
+            if plane:
+                return streaming.execute_streaming_refs(s, seg, stats_sink=sink)
+            return execute_streaming(s, seg, stats_sink=sink)
 
         for op in self._ops:
             if op.kind == "limit":
                 stream = _limit_stream(flush(stream, segment), op.kwargs["n"])
                 segment = []
             elif op.kind == "repartition":
-                stream = _repartition_stream(flush(stream, segment), op.kwargs["num_blocks"])
+                stream = _repartition_stream(
+                    streaming.materialize(flush(stream, segment)),
+                    op.kwargs["num_blocks"])
                 segment = []
             elif op.kind == "shuffle":
-                stream = _shuffle_stream(flush(stream, segment), op.kwargs.get("seed"))
+                stream = _shuffle_stream(flush(stream, segment),
+                                         op.kwargs.get("seed"), plane)
                 segment = []
             else:
                 segment.append(self._compile_op(op))
         yield from flush(stream, segment)
+
+    def iter_blocks(self) -> Iterator[Block]:
+        """Blocks materialized in THIS process (the consumer edge): plane
+        descriptors land once via the zero-copy pull path."""
+        from ray_tpu.data import streaming
+
+        yield from streaming.materialize(self._iter_items())
+
+    def iter_block_refs(self) -> Iterator[Any]:
+        """Plane-native consumption: yields ``BlockRef`` descriptors — block
+        payloads stay in the object plane (driver-produced source blocks are
+        sealed into the local store). The surface streaming_split, the
+        exchange, and data/llm.py batch inference feed from."""
+        from ray_tpu.data import streaming
+
+        for item in self._iter_items():
+            yield streaming.ensure_ref(item)
 
     # ------------------------------------------------------------- consumption
     def take(self, n: int = 20) -> list[Row]:
@@ -277,7 +308,11 @@ class Dataset:
         return [r for b in self.iter_blocks() for r in b.rows()]
 
     def count(self) -> int:
-        return sum(b.num_rows() for b in self.iter_blocks())
+        # metadata-only: descriptors carry num_rows, so counting never
+        # pulls a block payload into the driver
+        from ray_tpu.data import streaming
+
+        return sum(streaming.item_rows(i) for i in self._iter_items())
 
     def to_pandas(self):
         """Reference: Dataset.to_pandas — materialize every block into one
@@ -296,17 +331,14 @@ class Dataset:
 
     def stats(self) -> str:
         """Per-operator counters for the LAST execution of this dataset
-        (reference: Dataset.stats / _internal stats.py)."""
+        (reference: Dataset.stats / _internal stats.py): rows/blocks plus
+        the plane-native accounting — bytes in/out, plane puts, and
+        backpressure-stall seconds per operator (sourced from the ISSUE-12
+        streaming instruments)."""
         rows = getattr(self, "_last_stats", [])
         if not rows:
             return "No execution stats recorded yet (run an action first)."
-        lines = []
-        for st in rows:
-            lines.append(
-                f"{st.name}: blocks_in={st.blocks_in} blocks_out={st.blocks_out} "
-                f"rows_out={st.rows_out}"
-            )
-        return "\n".join(lines)
+        return "\n".join(st.render() for st in rows)
 
     def materialize(self) -> "Dataset":
         blocks = list(self.iter_blocks())
@@ -354,15 +386,31 @@ class Dataset:
         if carried and not drop_last:
             yield _format_batch(emit(carried), batch_format, device_put)
 
-    def streaming_split(self, n: int, *, equal: bool = False) -> list["DataIterator"]:
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        prefetch_blocks: int = 4) -> list["DataIterator"]:
         """Reference: dataset.py:2117 — one iterator shard per train worker.
 
         The shards MUST be consumed concurrently (one consumer per shard, the
         train-worker pattern): output flows through bounded per-shard queues
         for backpressure, so draining one shard alone blocks once the others'
-        queues fill — the same contract as the reference's streaming_split."""
-        splitter = OutputSplitter(self.iter_blocks(), n, equal)
-        return [DataIterator(functools.partial(splitter.iterator, i)) for i in range(n)]
+        queues fill — the same contract as the reference's streaming_split.
+
+        Plane-native (default): the per-shard queues carry DESCRIPTORS and
+        each consumer prefetches up to ``prefetch_blocks`` block pulls
+        holder→itself (equal splits slice inside a task, sealed into the
+        executing node's store) — the gang-training input pipeline where no
+        block payload transits the driver and a step finds its next block
+        already local (see train/ingest.py)."""
+        from ray_tpu.data import streaming
+
+        if streaming.plane_streaming_enabled():
+            splitter = streaming.RefOutputSplitter(
+                self._iter_items(), n, equal, queue_depth=prefetch_blocks)
+        else:
+            splitter = OutputSplitter(self.iter_blocks(), n, equal)
+        return [DataIterator(functools.partial(splitter.iterator, i),
+                             prefetch_blocks=prefetch_blocks)
+                for i in range(n)]
 
     def split(self, n: int) -> list["Dataset"]:
         blocks = list(self.iter_blocks())
@@ -425,17 +473,33 @@ class Dataset:
 
 
 class DataIterator:
-    """Per-worker shard iterator (reference: data/iterator.py DataIterator)."""
+    """Per-worker shard iterator (reference: data/iterator.py DataIterator).
 
-    def __init__(self, blocks_fn: Callable[[], Iterator[Block]]):
+    The wrapped stream may yield Blocks (legacy) or plane descriptors
+    (plane-native streaming_split): ``iter_blocks`` runs a prefetching
+    pull loop — up to ``prefetch_blocks`` async block fetches in flight,
+    landing in the CONSUMING process's store — and exposes starvation
+    accounting on ``last_ingest_stats`` (the gang never-starve signal,
+    train/ingest.py)."""
+
+    def __init__(self, blocks_fn: Callable[[], Iterator[Any]],
+                 prefetch_blocks: int = 4):
         self._blocks_fn = blocks_fn
+        self._prefetch = max(1, prefetch_blocks)
+        # IngestStats of the most recent iteration (live-updated while
+        # consuming): blocks/bytes/wait_s/starved_steps
+        self.last_ingest_stats = None
 
     def iter_blocks(self) -> Iterator[Block]:
-        return self._blocks_fn()
+        from ray_tpu.data.streaming import PrefetchingBlockIterator
+
+        it = PrefetchingBlockIterator(self._blocks_fn(), depth=self._prefetch)
+        self.last_ingest_stats = it.stats
+        return it
 
     def iter_batches(self, *, batch_size: int = 256, batch_format: str = "numpy",
                      drop_last: bool = False, device_put=None) -> Iterator[Any]:
-        ds = Dataset(self._blocks_fn, (), "shard")
+        ds = Dataset(self.iter_blocks, (), "shard")
         return ds.iter_batches(batch_size=batch_size, batch_format=batch_format,
                                drop_last=drop_last, device_put=device_put)
 
@@ -503,16 +567,22 @@ def _make_row_op(fn: Callable, kind: str) -> Callable[[Block], list[Block]]:
     return transform
 
 
-def _limit_stream(stream: Iterator[Block], n: int) -> Iterator[Block]:
+def _limit_stream(stream: Iterator[Any], n: int) -> Iterator[Any]:
+    """Limit over a mixed Block/BlockRef stream: whole items pass through as
+    descriptors (rows counted from metadata, payload untouched); only the
+    BOUNDARY block is materialized to slice it."""
+    from ray_tpu.data import streaming
+
     remaining = n
-    for b in stream:
+    for item in stream:
         if remaining <= 0:
             return
-        if b.num_rows() <= remaining:
-            remaining -= b.num_rows()
-            yield b
+        rows = streaming.item_rows(item)
+        if rows <= remaining:
+            remaining -= rows
+            yield item
         else:
-            yield b.slice(0, remaining)
+            yield streaming.fetch_block(item).slice(0, remaining)
             return
 
 
@@ -526,8 +596,15 @@ def _repartition_stream(stream: Iterator[Block], num_blocks: int) -> Iterator[Bl
         yield all_blocks.slice(i, min(i + per, n))
 
 
-def _shuffle_stream(stream: Iterator[Block], seed: int | None) -> Iterator[Block]:
-    """Full random shuffle as an all-to-all exchange over tasks."""
-    from ray_tpu.data.exchange import shuffle_exchange
+def _shuffle_stream(stream: Iterator[Any], seed: int | None,
+                    plane: bool = True) -> Iterator[Any]:
+    """Full random shuffle as an all-to-all exchange over tasks. On the
+    plane path input descriptors go in and reduced-partition descriptors
+    come out — shuffle bytes never touch the driver."""
+    from ray_tpu.data import streaming
+    from ray_tpu.data.exchange import shuffle_exchange, shuffle_refs
 
-    yield from shuffle_exchange(stream, seed)
+    if plane:
+        yield from shuffle_refs(stream, seed)
+    else:
+        yield from shuffle_exchange(streaming.materialize(stream), seed)

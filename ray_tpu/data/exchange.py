@@ -6,20 +6,82 @@ from the reference: a MAP stage partitions every input block (one task per
 block, one return per partition) and a REDUCE stage combines each partition's
 slices (one task per partition); the object plane carries the slices, so the
 exchange parallelizes across worker processes and spills under pressure.
+
+Plane-native since ISSUE-12: slices AND reduced partitions live as sealed
+object-plane entries — mappers ``put`` slices into their node's store,
+reducers PULL THEIR OWN slices (``ray_tpu.get`` inside the reduce task rides
+the PR-5 ``pull_into`` failover path holder→reducer) and seal their output
+locally, and the driver carries only descriptors end to end. A holder that
+dies mid-exchange surfaces as a ``PartitionLostError`` naming the partition
+and the input blocks whose slices were lost; when the exchange still holds
+the inputs (``replayable``), the lost blocks are re-mapped (partition
+functions are deterministic in ``block_idx``, so the re-mapped slices are
+byte-identical) and the reduce retried off the survivors.
 """
 
 from __future__ import annotations
 
-import math
-from typing import Any, Callable, Iterator, Optional
+import os
+from typing import Callable, Iterator, Optional
 
 import numpy as np
 
 import ray_tpu
 from ray_tpu.data.block import Block
-from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.data.streaming import BlockRef, ensure_ref, fetch_block
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+)
+from ray_tpu.util import flight_recorder
 
 DEFAULT_PARTITIONS = 8
+# Base pull deadline inside a reduce task: the backstop behind the
+# event-driven loss path (a dead holder's slices normally surface as
+# ObjectLostError as soon as the head processes the node death). The
+# actual deadline SCALES with the bytes being pulled (base + bytes at the
+# assumed-minimum bandwidth below) so a slow-but-healthy bulk pull is
+# never misclassified as lost data.
+SLICE_TIMEOUT_S = float(os.environ.get("RAY_TPU_DATA_SLICE_TIMEOUT_S", "60"))
+# The slowest link the deadline scaling assumes: a pull slower than this
+# is treated as lost (conservatively low — the deadline is a backstop,
+# not a throughput SLO).
+MIN_PULL_MBPS = float(os.environ.get("RAY_TPU_DATA_MIN_PULL_MBPS", "16"))
+
+
+def _pull_deadline_s(nbytes: int) -> float:
+    return SLICE_TIMEOUT_S + nbytes / (MIN_PULL_MBPS * (1 << 20))
+# How many times a partition's reduce is retried after re-mapping its lost
+# input blocks before the PartitionLostError propagates.
+EXCHANGE_RETRIES = int(os.environ.get("RAY_TPU_DATA_EXCHANGE_RETRIES", "2"))
+
+
+class PartitionLostError(RayTpuError):
+    """A reduce task could not pull some of its partition's slices: every
+    holder of those slices is gone and the exchange could not (or may not)
+    re-map the input blocks that produced them. Names the partition and
+    the lost input block indices — the debuggable face of "a node died
+    mid-shuffle". ``partition == MAP_STAGE`` (-1) means the loss happened
+    before any partition existed: a map task's INPUT block became
+    unpullable (its holder died before the mapper fetched it)."""
+
+    MAP_STAGE = -1
+
+    def __init__(self, partition: int, lost_blocks: list,
+                 detail: str = ""):
+        self.partition = int(partition)
+        self.lost_blocks = sorted(set(int(b) for b in lost_blocks))
+        self.detail = detail
+        where = ("map stage" if self.partition == self.MAP_STAGE
+                 else f"partition {self.partition}")
+        super().__init__(
+            f"exchange {where} lost input block(s) {self.lost_blocks}"
+            + (f": {detail}" if detail else ""))
+
+    def __reduce__(self):
+        return (type(self), (self.partition, self.lost_blocks, self.detail))
 
 
 # ------------------------------------------------------------------ map/reduce
@@ -28,45 +90,55 @@ def _split_by_index(block: Block, idx: np.ndarray, P: int):
     for i in range(P):
         mask = idx == i
         outs.append(Block({k: v[mask] for k, v in block.columns.items()}))
-    return tuple(outs) if P > 1 else outs[0]
+    return outs
 
 
 def _map_partition(block: Block, part_fn, P: int, block_idx: int):
-    """One map task per input block -> P partition-slice REFS.
+    """One map task per input block -> P plane-sealed slice DESCRIPTORS.
 
     The slices are ray_tpu.put() from INSIDE the mapper: on an isolated-plane
     node that seals them into the node-LOCAL store (the head records only
     locations), and reducers pull their slices holder->consumer through the
     object plane — the head never carries block bytes, so the exchange
     scales past the head's memory budget (reference: hash_shuffle.py
-    emitting block refs; object_manager.cc:369 pull protocol)."""
+    emitting block refs; object_manager.cc:369 pull protocol). Each row is
+    ``[ref, rows, bytes]`` so the driver can account without touching
+    payloads."""
     idx = part_fn(block, block_idx)
     outs = _split_by_index(block, np.asarray(idx, dtype=np.int64), P)
-    if P == 1:
-        outs = [outs]
-    return [ray_tpu.put(o) for o in outs]
+    return [[ray_tpu.put(o), o.num_rows(), o.size_bytes()] for o in outs]
 
 
-def _scatter(blocks: Iterator[Block], part_fn, P: int, map_task):
-    """MAP stage shared by exchange() and join_exchange(): one task per block
-    returning P slice refs (tiny — the slices themselves stay in the
-    mappers' node stores). Returns (per-partition ref lists, n_blocks,
-    schema of the first non-empty block)."""
+def _scatter(items, part_fn, P: int, map_task):
+    """MAP stage shared by exchange_refs() and join_exchange(): one task per
+    input item (Block or BlockRef) returning P slice-descriptor rows (tiny —
+    the slices themselves stay in the mappers' node stores). Returns
+    ``(partitions, inputs, schema)`` where ``partitions[p]`` is a list of
+    ``[slice_ref, block_idx, rows, bytes]`` ordered by block index and
+    ``inputs`` holds every input's DESCRIPTOR (the ref kept alive for
+    lost-slice re-mapping)."""
     partitions: list[list] = [[] for _ in range(P)]
     ref_lists = []
-    n_blocks = 0
+    inputs: list = []
     schema: dict | None = None
-    for b in blocks:
-        if schema is None and b.num_rows() > 0:
-            schema = {k: v.dtype for k, v in b.columns.items()}
-        ref_lists.append(map_task.remote(b, part_fn, P, n_blocks))
-        n_blocks += 1
+    for item in items:
+        blk = item if isinstance(item, Block) else None
+        if schema is None and blk is not None and blk.num_rows() > 0:
+            schema = {k: v.dtype for k, v in blk.columns.items()}
+        # A driver-local Block is sealed into THIS process's store and held
+        # only as its descriptor: replay needs the input PULLABLE, not
+        # heap-resident — holding payloads would grow the driver by the
+        # whole dataset on a shuffle over a driver-local stream (the store
+        # absorbs the residency and spills under pressure).
+        desc = ensure_ref(item)
+        ref_lists.append(map_task.remote(desc.ref, part_fn, P, len(inputs)))
+        inputs.append(desc)
     # harvest in COMPLETION order (a slow mapper doesn't head-of-line block
     # collecting the fast ones' metadata) but PLACE by block index —
     # within-partition slice order must be deterministic or seeded shuffles
     # and stable-sort tie order change run to run
     block_idx = {r: i for i, r in enumerate(ref_lists)}
-    slots: list[list | None] = [None] * n_blocks
+    slots: list = [None] * len(inputs)
     pending = list(ref_lists)
     while pending:
         ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=600)
@@ -75,16 +147,172 @@ def _scatter(blocks: Iterator[Block], part_fn, P: int, map_task):
                 f"exchange map stage stalled: {len(pending)} mapper(s) not "
                 "done after 600s")
         for r in ready:
-            slots[block_idx[r]] = ray_tpu.get(r, timeout=600)  # P small refs
-    for slice_refs in slots:
-        for i, pref in enumerate(slice_refs):
-            partitions[i].append(pref)
-    return partitions, n_blocks, schema
+            try:
+                slots[block_idx[r]] = ray_tpu.get(r)  # P small descriptor rows
+            except TaskError as e:
+                cause = e.as_cause()
+                if not isinstance(cause, (ObjectLostError, GetTimeoutError)):
+                    raise  # user error in part_fn — propagate as-is
+                # The mapper could not pull its INPUT block (holder died
+                # before the map ran). The input is the thing that's gone,
+                # so there is nothing to re-map from — surface the named
+                # error the exchange contract promises, never a raw
+                # transport error.
+                raise PartitionLostError(
+                    PartitionLostError.MAP_STAGE, [block_idx[r]],
+                    detail=f"input block unpullable "
+                           f"({type(cause).__name__}: {cause})") from None
+            except (ObjectLostError, GetTimeoutError) as e:
+                # Same loss, other surface: when every retry of the mapper
+                # died on the unpullable input, the driver's lineage
+                # recovery gives up on the PUT input (puts have no task
+                # spec to re-execute) and get() raises the transport error
+                # RAW rather than as a TaskError.
+                raise PartitionLostError(
+                    PartitionLostError.MAP_STAGE, [block_idx[r]],
+                    detail=f"input block unpullable "
+                           f"({type(e).__name__}: {e})") from None
+    for bidx, rows in enumerate(slots):
+        for p, (ref, nrows, nbytes) in enumerate(rows):
+            partitions[p].append([ref, bidx, nrows, nbytes])
+    return partitions, inputs, schema
 
 
-def _reduce_partition(reduce_fn, *slices: Block) -> Block:
-    blocks = [s for s in slices if s.num_rows() > 0]
-    return reduce_fn(blocks) if blocks else Block({})
+def _reduce_partition(reduce_fn, part_idx: int, slice_descs: list) -> list:
+    """One reduce task per partition: PULL every slice yourself (resolved
+    through the local store or the object plane's failover pull across live
+    holders), combine, and seal the result into THIS node's store. Returns
+    one ``[ref, rows, bytes]`` descriptor row — reduced partitions never
+    transit the driver either.
+
+    The happy path is ONE batched get (one control-plane RPC for the whole
+    partition, store/plane resolution per slice); only when that fails does
+    the per-slice loop run, to NAME the lost input blocks. Slices whose
+    every holder died surface as ObjectLostError (or the deadline backstop)
+    and are re-raised as a PartitionLostError the driver can re-map from."""
+    blocks, lost = _pull_slices(part_idx, slice_descs, "slice")
+    if lost:
+        raise PartitionLostError(
+            part_idx, [b for b, _ in lost],
+            detail="; ".join(f"block {b}: {w}" for b, w in lost[:4]))
+    blocks = [b for b in blocks if b.num_rows() > 0]
+    out = reduce_fn(blocks) if blocks else Block({})
+    return [ray_tpu.put(out), out.num_rows(), out.size_bytes()]
+
+
+def exchange_refs(
+    items: "Iterator[Block | BlockRef]",
+    part_fn: Callable,
+    num_partitions: int,
+    reduce_fn: Callable[[list[Block]], Block],
+    ordered: bool = True,
+    replayable: bool = True,
+    _after_scatter: "Callable | None" = None,
+) -> "Iterator[BlockRef]":
+    """Plane-native exchange: partition every input with ``part_fn`` (one
+    map task per block), then reduce each partition (one task per
+    partition, pulling its own slices). Yields DESCRIPTORS of the reduced
+    partitions — callers materialize at their edge.
+
+    An exchange is a barrier by nature (every reducer needs a slice of
+    every mapper); memory pressure is absorbed by the node stores
+    (spilling). Holder death mid-exchange re-maps the lost input blocks
+    off ``items`` (held as plane descriptors until completion) up to
+    EXCHANGE_RETRIES times, then propagates the named
+    PartitionLostError."""
+    P = num_partitions
+    map_task = ray_tpu.remote(name="data::exchange_map")(_map_partition)
+    reduce_task = ray_tpu.remote(name="data::exchange_reduce")(_reduce_partition)
+    partitions, inputs, _ = _scatter(items, part_fn, P, map_task)
+    if not inputs:
+        return
+    if _after_scatter is not None:
+        # chaos-injection seam: tests strike a holder at the exact barrier
+        # between the map and reduce stages (deterministic, no timing games)
+        _after_scatter(partitions, inputs)
+
+    def submit_reduce(p: int):
+        descs = [[ref, bidx, nbytes] for ref, bidx, _r, nbytes
+                 in partitions[p]]
+        return reduce_task.remote(reduce_fn, p, descs)
+
+    out_refs = {submit_reduce(p): p for p in range(P)}
+    attempts = [0] * P
+    # One holder death loses the same input blocks from EVERY in-flight
+    # reduce; re-mapping once refreshes every partition's entries (the
+    # splice in _remap_blocks covers all P). Generation counters let the
+    # 2nd..Pth failure resubmit off the already-fresh refs instead of
+    # re-running the same map tasks P times.
+    remap_gen = 0
+    remapped_at: dict[int, int] = {}
+    submit_gen = [0] * P
+    emitted: dict[int, BlockRef] = {}
+    next_ordered = 0
+    pending = list(out_refs)
+    while pending:
+        ready, pending = ray_tpu.wait(pending, num_returns=1)
+        r = ready[0]
+        p = out_refs.pop(r)
+        try:
+            row = ray_tpu.get(r)
+        except (TaskError, PartitionLostError) as e:
+            cause = e.as_cause() if isinstance(e, TaskError) else e
+            if not isinstance(cause, PartitionLostError):
+                raise
+            attempts[p] += 1
+            if not replayable or attempts[p] > EXCHANGE_RETRIES:
+                raise cause from None
+            need = [b for b in cause.lost_blocks
+                    if remapped_at.get(b, -1) <= submit_gen[p]]
+            if need:
+                flight_recorder.record(
+                    "data", "partition_slices_remap", partition=p,
+                    lost_blocks=list(need), attempt=attempts[p])
+                _remap_blocks(need, inputs, partitions, part_fn, P,
+                              map_task)
+                remap_gen += 1
+                for b in need:
+                    remapped_at[b] = remap_gen
+            submit_gen[p] = remap_gen
+            nr = submit_reduce(p)
+            out_refs[nr] = p
+            pending.append(nr)
+            continue
+        ref, nrows, nbytes = row
+        desc = BlockRef(ref, nrows, nbytes)
+        if not ordered:
+            if nrows > 0:
+                yield desc
+            continue
+        emitted[p] = desc
+        while next_ordered in emitted:
+            d = emitted.pop(next_ordered)
+            next_ordered += 1
+            if d.num_rows > 0:
+                yield d
+
+
+def _remap_blocks(lost_blocks, inputs, partitions, part_fn, P, map_task):
+    """Re-run the map task for the named input blocks and splice the fresh
+    slice refs into every partition's entry list (the partition function is
+    deterministic in block_idx, so re-mapped slices are identical). If an
+    input itself is unpullable, the retried reduce reports it lost again
+    and the retry budget converts that into the user-facing error."""
+    remapped = {bidx: map_task.remote(inputs[bidx].ref, part_fn, P, bidx)
+                for bidx in lost_blocks}
+    for bidx, r in remapped.items():
+        try:
+            rows = ray_tpu.get(r, timeout=600)
+        except (ObjectLostError, GetTimeoutError, TaskError):
+            # the INPUT is unpullable too (its holder died as well): leave
+            # this block's entries stale — the retried reduce names it lost
+            # again and the retry budget converts that into the user-facing
+            # PartitionLostError (never a raw transport error)
+            continue
+        for p, (ref, nrows, nbytes) in enumerate(rows):
+            for ent in partitions[p]:
+                if ent[1] == bidx:
+                    ent[0], ent[2], ent[3] = ref, nrows, nbytes
 
 
 def exchange(
@@ -94,29 +322,11 @@ def exchange(
     reduce_fn: Callable[[list[Block]], Block],
     ordered: bool = True,
 ) -> Iterator[Block]:
-    """Partition every block with `part_fn`, then reduce each partition.
-
-    An exchange is a barrier by nature (every reducer needs a slice of every
-    mapper); memory pressure is absorbed by the object store (spilling)."""
-    P = num_partitions
-    map_task = ray_tpu.remote(name="data::exchange_map")(_map_partition)
-    reduce_task = ray_tpu.remote(name="data::exchange_reduce")(_reduce_partition)
-    partitions, n_blocks, _ = _scatter(blocks, part_fn, P, map_task)
-    if n_blocks == 0:
-        return
-    out_refs = [reduce_task.remote(reduce_fn, *parts) for parts in partitions]
-    if ordered:
-        for r in out_refs:
-            blk = ray_tpu.get(r)
-            if blk.num_rows() > 0:
-                yield blk
-    else:
-        pending = list(out_refs)
-        while pending:
-            ready, pending = ray_tpu.wait(pending, num_returns=1)
-            blk = ray_tpu.get(ready[0])
-            if blk.num_rows() > 0:
-                yield blk
+    """Block-level exchange surface (legacy callers): the plane-native
+    exchange with the driver as the consumer edge."""
+    for desc in exchange_refs(blocks, part_fn, num_partitions, reduce_fn,
+                              ordered=ordered):
+        yield fetch_block(desc)
 
 
 def _concat_reduce(blocks: list[Block]) -> Block:
@@ -124,10 +334,12 @@ def _concat_reduce(blocks: list[Block]) -> Block:
 
 
 # ------------------------------------------------------------------ shuffle
-def shuffle_exchange(blocks: Iterator[Block], seed: Optional[int],
-                     num_partitions: int = DEFAULT_PARTITIONS) -> Iterator[Block]:
-    """True global random shuffle: rows scatter uniformly over partitions,
-    each partition permutes (reference: random_shuffle as full exchange)."""
+def shuffle_refs(items: "Iterator[Block | BlockRef]", seed: Optional[int],
+                 num_partitions: int = DEFAULT_PARTITIONS,
+                 ) -> "Iterator[BlockRef]":
+    """True global random shuffle over descriptors: rows scatter uniformly
+    over partitions, each partition permutes (reference: random_shuffle as
+    full exchange)."""
     root = np.random.SeedSequence(seed)
     mix, reduce_seed = [int(s.generate_state(1)[0]) for s in root.spawn(2)]
 
@@ -143,7 +355,14 @@ def shuffle_exchange(blocks: Iterator[Block], seed: Optional[int],
         perm = rng.permutation(merged.num_rows())
         return Block({k: v[perm] for k, v in merged.columns.items()})
 
-    yield from exchange(blocks, part, num_partitions, reduce, ordered=False)
+    yield from exchange_refs(items, part, num_partitions, reduce,
+                             ordered=False)
+
+
+def shuffle_exchange(blocks: Iterator[Block], seed: Optional[int],
+                     num_partitions: int = DEFAULT_PARTITIONS) -> Iterator[Block]:
+    for desc in shuffle_refs(blocks, seed, num_partitions):
+        yield fetch_block(desc)
 
 
 # ------------------------------------------------------------------ sort
@@ -223,7 +442,8 @@ def join_exchange(left: Iterator[Block], right: Iterator[Block], on: str,
                   how: str = "inner",
                   num_partitions: int = DEFAULT_PARTITIONS) -> Iterator[Block]:
     """Hash join (reference: execution/operators/join.py): both sides hash-
-    partition on the key; each partition joins independently."""
+    partition on the key; each partition joins independently in a task that
+    pulls its own slices off the plane."""
     if how not in ("inner", "left", "outer", "right"):
         raise ValueError(f"unsupported join how={how!r}")
     P = num_partitions
@@ -231,31 +451,72 @@ def join_exchange(left: Iterator[Block], right: Iterator[Block], on: str,
     join_task = ray_tpu.remote(name="data::join_reduce")(_join_partition)
     part = hash_partitioner(on, P)
 
-    lparts, _, lschema = _scatter(left, part, P, map_task)
-    rparts, _, rschema = _scatter(right, part, P, map_task)
+    lparts, _linputs, lschema = _scatter(left, part, P, map_task)
+    rparts, _rinputs, rschema = _scatter(right, part, P, map_task)
     out_refs = []
     for i in range(P):
         if not lparts[i] and not rparts[i]:
             continue
+        ldescs = [[ref, bidx, nb] for ref, bidx, _r, nb in lparts[i]]
+        rdescs = [[ref, bidx, nb] for ref, bidx, _r, nb in rparts[i]]
         out_refs.append(
-            join_task.remote(on, how, len(lparts[i]),
+            join_task.remote(on, how, i, ldescs, rdescs,
                              {k: str(v) for k, v in (lschema or {}).items()},
-                             {k: str(v) for k, v in (rschema or {}).items()},
-                             *(lparts[i] + rparts[i]))
+                             {k: str(v) for k, v in (rschema or {}).items()})
         )
     pending = list(out_refs)
     while pending:
         ready, pending = ray_tpu.wait(pending, num_returns=1)
-        blk = ray_tpu.get(ready[0])
-        if blk.num_rows() > 0:
-            yield blk
+        try:
+            row = ray_tpu.get(ready[0])
+        except TaskError as e:
+            cause = e.as_cause()
+            if isinstance(cause, PartitionLostError):
+                raise cause from None
+            raise
+        ref, nrows, nbytes = row
+        if nrows > 0:
+            yield fetch_block(BlockRef(ref, nrows, nbytes))
 
 
-def _join_partition(on: str, how: str, n_left: int, lschema: dict, rschema: dict,
-                    *slices: Block) -> Block:
+def _pull_slices(part_idx: int, slice_descs: list,
+                 side: str) -> "tuple[list[Block], list]":
+    """Resolve a partition's slice refs in THIS process: one batched get
+    (single RPC) when everything is pullable, per-slice resolution naming
+    the lost blocks when it is not. Deadlines scale with the bytes being
+    pulled (``_pull_deadline_s``) so a large partition on a slow link
+    isn't misreported as a lost partition."""
+    if not slice_descs:
+        return [], []
+    total = sum(nb for _r, _b, nb in slice_descs)
+    try:
+        return ray_tpu.get([ref for ref, _b, _nb in slice_descs],
+                           timeout=_pull_deadline_s(total)), []
+    except (ObjectLostError, GetTimeoutError):
+        pass
+    blocks, lost = [], []
+    for ref, bidx, nb in slice_descs:
+        try:
+            blocks.append(ray_tpu.get(ref, timeout=_pull_deadline_s(nb)))
+        except (ObjectLostError, GetTimeoutError) as e:
+            lost.append((bidx, f"{side} {type(e).__name__}"))
+    return blocks, lost
+
+
+def _join_partition(on: str, how: str, part_idx: int, ldescs: list,
+                    rdescs: list, lschema: dict, rschema: dict) -> list:
     import pandas as pd
 
+    lblocks, llost = _pull_slices(part_idx, ldescs, "left")
+    rblocks, rlost = _pull_slices(part_idx, rdescs, "right")
+    if llost or rlost:
+        lost = llost + rlost
+        raise PartitionLostError(
+            part_idx, [b for b, _ in lost],
+            detail="; ".join(f"block {b}: {w}" for b, w in lost[:4]))
+
     def side_df(bs: list[Block], schema: dict):
+        bs = [b for b in bs if b.num_rows() > 0]
         if bs:
             return Block.concat(bs).to_pandas()
         # An empty side still joins with the full OUTPUT SCHEMA (its columns
@@ -265,9 +526,11 @@ def _join_partition(on: str, how: str, n_left: int, lschema: dict, rschema: dict
         schema = schema or {on: "object"}
         return pd.DataFrame({c: pd.Series(dtype=dt) for c, dt in schema.items()})
 
-    ldf = side_df([s for s in slices[:n_left] if s.num_rows() > 0], lschema)
-    rdf = side_df([s for s in slices[n_left:] if s.num_rows() > 0], rschema)
+    ldf = side_df(lblocks, lschema)
+    rdf = side_df(rblocks, rschema)
     if ldf.empty and rdf.empty:
-        return Block({})
-    merged = ldf.merge(rdf, on=on, how=how, suffixes=("", "_r"))
-    return Block.from_pandas(merged)
+        out = Block({})
+    else:
+        merged = ldf.merge(rdf, on=on, how=how, suffixes=("", "_r"))
+        out = Block.from_pandas(merged)
+    return [ray_tpu.put(out), out.num_rows(), out.size_bytes()]

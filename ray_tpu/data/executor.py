@@ -6,29 +6,35 @@ operators process blocks as tasks with a bounded number in flight (backpressure)
 blocks stream to the consumer as soon as their chain completes — no barrier
 between stages (outputs of op k feed op k+1 immediately).
 
-Simplification vs reference: the scheduling loop is a generator-driven pull
-pipeline rather than a resource-budget event loop; `max_in_flight` is the
-backpressure knob (reference: ConcurrencyCapBackpressurePolicy).
+Since ISSUE-12 the default engine is the PLANE-NATIVE executor in
+``data/streaming.py``: intermediate blocks live as sealed object-plane
+entries, tasks exchange descriptors, admission is byte-budgeted off
+``node_io_view`` pressure, and the driver materializes blocks only at the
+consumer edge. The legacy driver-get pipeline below (every operator
+boundary ``ray_tpu.get``s block payloads back to the driver) is kept as
+the measured A/B baseline — select it with
+``RAY_TPU_DATA_PLANE_STREAMING=0``.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Iterator
 
 import ray_tpu
 from ray_tpu.data.block import Block
+from ray_tpu.data.streaming import (
+    StreamOpStats,
+    _StreamError,
+    plane_streaming_enabled,
+)
 
-
-@dataclass
-class OpStats:
-    name: str
-    blocks_in: int = 0
-    blocks_out: int = 0
-    rows_out: int = 0
-    task_time_s: float = 0.0
+# Unified per-operator stats row (legacy name kept for callers; the legacy
+# engine fills the byte counters too — only plane_pulls and
+# backpressure_s stay zero there).
+OpStats = StreamOpStats
 
 
 @dataclass
@@ -60,7 +66,9 @@ class PhysicalOp:
     # Memory-aware backpressure: stop pulling upstream while the estimated
     # bytes of in-flight input blocks exceed this budget (reference:
     # streaming_executor_state.py:841 under_resource_limits +
-    # backpressure_policy/). None = window-only backpressure.
+    # backpressure_policy/). None = the executor default
+    # (RAY_TPU_DATA_OP_BUDGET_BYTES on the plane-native path; window-only
+    # backpressure on the legacy path).
     memory_budget_bytes: int | None = None
 
 
@@ -72,11 +80,31 @@ def execute_streaming(
 ) -> Iterator[Block]:
     """Run blocks from `source` through `ops`, yielding result blocks.
 
-    Each op keeps ≤ max_in_flight tasks outstanding (and ≤ its memory
+    Each op keeps ≤ max_in_flight tasks outstanding (and ≤ its byte
     budget); completed blocks flow to the next op without waiting for stage
     completion (streaming, not bulk). Per-op counters land in `stats_sink`
-    (reference: data stats.py).
-    """
+    (reference: data stats.py). On the default plane-native path the
+    yielded blocks are materialized HERE (the consumer edge) — mid-pipeline
+    they were descriptors."""
+    from ray_tpu.data import streaming
+
+    if plane_streaming_enabled():
+        return streaming.materialize(
+            streaming.execute_streaming_refs(
+                source, ops, preserve_order=preserve_order,
+                stats_sink=stats_sink))
+    return _execute_streaming_driver_get(
+        source, ops, preserve_order, stats_sink)
+
+
+def _execute_streaming_driver_get(
+    source: Iterator[Block],
+    ops: list[PhysicalOp],
+    preserve_order: bool = True,
+    stats_sink: list | None = None,
+) -> Iterator[Block]:
+    """LEGACY engine (the ISSUE-12 A/B baseline): every operator boundary
+    materializes block payloads on the driver."""
     # NOTE: not a generator — stats register eagerly (in pipeline order) even
     # though block flow is lazy; the inner generator does the streaming.
     stats = [OpStats(op.name) for op in ops]
@@ -143,6 +171,7 @@ def _apply_op(
                     break
                 stats.blocks_in += 1
                 est = blk.size_bytes()
+                stats.bytes_in += est
                 ref, idx = submit(blk)
                 in_flight.append((ref, idx, est))
                 in_flight_bytes += est
@@ -165,6 +194,7 @@ def _apply_op(
             for b in out_blocks:
                 stats.blocks_out += 1
                 stats.rows_out += b.num_rows()
+                stats.bytes_out += b.size_bytes()
                 yield b
     finally:
         for a in pool or ():
@@ -178,14 +208,12 @@ def _run_transform(transform: Callable[[Block], list[Block]], block: Block) -> l
     return transform(block)
 
 
-@dataclass
-class _StreamError:
-    exc: BaseException
-
-
 class OutputSplitter:
     """Fan one block stream out to n consumers (reference:
-    execution/operators/output_splitter.py backing Dataset.streaming_split).
+    execution/operators/output_splitter.py backing Dataset.streaming_split)
+    — the LEGACY driver-side splitter (block payloads pass through the
+    driver's queues); the plane-native splitter is
+    ``data/streaming.py::RefOutputSplitter``.
 
     equal=True slices every block into n equal parts so shard row counts differ
     by at most 1 per block — required when each SPMD rank must step the same

@@ -26,6 +26,20 @@ def _rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
+def _rss_now_mb() -> float:
+    """CURRENT resident set (not ru_maxrss, which is a lifetime peak — a
+    before/after delta off the peak reads ~0 for every arm after the first
+    regardless of what it actually allocated)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return _rss_mb()
+
+
 def bench_nodes(n: int, real: int) -> list[dict]:
     """n logical (in-process) nodes + `real` OS-process node agents: register
     them all, then prove SPREAD scheduling lands tasks on every node."""
@@ -219,6 +233,101 @@ def bench_broadcast(n_agents: int, mb: int = 64) -> list[dict]:
     return out
 
 
+def _data_gen_block(i, rows):
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.data.block import Block
+
+    b = Block({"k": np.arange(rows, dtype=np.int64) % 8,
+               "v": np.full(rows, i, dtype=np.int64)})
+    return [rt.put(b), b.num_rows(), b.size_bytes()]
+
+
+def _data_consume_block(b):
+    return int(b.columns["v"].sum())
+
+
+def bench_data_ingest(block_mb: int = 16, blocks: int = 16,
+                      agents: int = 2,
+                      parallelisms: tuple = (2, 4, 8)) -> list[dict]:
+    """Streaming data plane sweep (ISSUE-12): end-to-end MB/s of the
+    ingestion shape — a FLEET-RESIDENT dataset (generated by agent tasks,
+    sealed into agent-local stores) shuffled and consumed by agent tasks —
+    interleaved A/B per parallelism between the plane-native exchange
+    (blocks move holder→consumer as sealed plane entries; the driver
+    carries descriptors) and the driver-get path (every upstream block
+    materialized at the driver and re-shipped to the mappers — the seed's
+    executor boundary). Reports MB/s, the driver-transit byte counter (the
+    plane arm must stay at 0), and driver RSS delta."""
+    import numpy as np  # noqa: F401 (worker fns import their own)
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.data.block import Block
+    from ray_tpu.data.exchange import exchange, exchange_refs, hash_partitioner
+    from ray_tpu.data.streaming import BlockRef, materialize
+    from ray_tpu.util.metrics import get_metric
+
+    cluster = Cluster()
+    for _ in range(agents):
+        cluster.add_node(num_cpus=4, real_process=True, isolated_plane=True,
+                         resources={"datafleet": 4}, timeout=120)
+    gen = ray_tpu.remote(num_cpus=1, resources={"datafleet": 1},
+                         name="data::gen")(_data_gen_block)
+    consume = ray_tpu.remote(num_cpus=1, resources={"datafleet": 1},
+                             name="data::consume")(_data_consume_block)
+    # each block carries TWO int64 columns (k, v) — divide by 16 so a
+    # "block_mb" block really is block_mb; total derives from the sealed
+    # descriptors' true byte counts, not the label
+    rows = block_mb * (1 << 20) // 16
+
+    def make_source():
+        metas = ray_tpu.get([gen.remote(i, rows) for i in range(blocks)],
+                            timeout=600)
+        return [BlockRef(r, nr, nb) for r, nr, nb in metas]
+
+    def driver_bytes() -> float:
+        ctr = get_metric("ray_tpu_data_driver_block_bytes_total")
+        return sum(ctr.snapshot().values()) if ctr else 0.0
+
+    out = []
+    for par in parallelisms:
+        # arm selection is explicit (exchange_refs vs materialize+exchange);
+        # the RAY_TPU_DATA_PLANE_STREAMING engine switch only affects
+        # Dataset executions and is deliberately left alone here
+        for arm in ("driver_get", "plane"):
+            descs = make_source()
+            total_mb = sum(d.size_bytes for d in descs) / (1 << 20)
+            rss0, dbytes0 = _rss_now_mb(), driver_bytes()
+            t0 = time.perf_counter()
+            refs = []
+            if arm == "plane":
+                for d in exchange_refs(iter(descs),
+                                       hash_partitioner("k", par), par,
+                                       lambda bs: Block.concat(bs),
+                                       ordered=False):
+                    refs.append(consume.remote(d.ref))
+            else:
+                for b in exchange(materialize(iter(descs)),
+                                  hash_partitioner("k", par), par,
+                                  lambda bs: Block.concat(bs),
+                                  ordered=False):
+                    refs.append(consume.remote(b))
+            total = sum(ray_tpu.get(refs, timeout=600))
+            dt = time.perf_counter() - t0
+            assert total == sum(i * rows for i in range(blocks))
+            out.append({
+                "metric": "data_ingest_shuffle", "arm": arm,
+                "parallelism": par, "total_mb": round(total_mb, 1),
+                "mb_per_s": round(total_mb / max(dt, 1e-9), 1),
+                "secs": round(dt, 2),
+                "driver_transit_mb": round(
+                    (driver_bytes() - dbytes0) / (1 << 20), 1),
+                "driver_rss_delta_mb": round(_rss_now_mb() - rss0, 1),
+            })
+    return out
+
+
 def bench_placement_groups(n: int) -> list[dict]:
     """n simultaneous 1-bundle PGs on a cluster with room for all of them."""
     rt = get_runtime()
@@ -242,7 +351,7 @@ def bench_placement_groups(n: int) -> list[dict]:
 
 def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int,
         dispatch_agents: int = 0, broadcast_agents: int = 0,
-        broadcast_mb: int = 64) -> list[dict]:
+        broadcast_mb: int = 64, data_mb: int = 0) -> list[dict]:
     results = []
     ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
     for section, fn in (
@@ -250,6 +359,8 @@ def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int,
         ("dispatch", lambda: bench_dispatch(dispatch_agents) if dispatch_agents else []),
         ("broadcast", lambda: bench_broadcast(broadcast_agents, broadcast_mb)
                       if broadcast_agents else []),
+        ("data_ingest", lambda: bench_data_ingest(block_mb=data_mb)
+                        if data_mb else []),
         ("actors", lambda: bench_actors(actors)),
         ("queued_tasks", lambda: bench_queued_tasks(tasks)),
         ("placement_groups", lambda: bench_placement_groups(pgs)),
@@ -302,11 +413,14 @@ if __name__ == "__main__":
     ap.add_argument("--dispatch-agents", type=int, default=0)
     ap.add_argument("--broadcast-agents", type=int, default=0)
     ap.add_argument("--broadcast-mb", type=int, default=64)
+    ap.add_argument("--data-mb", type=int, default=0,
+                    help="per-block MB for the data-ingestion sweep "
+                         "(0 = skip)")
     ap.add_argument("--md", default="SCALE_r05.md")
     a = ap.parse_args()
     res = run(a.nodes, a.real_agents, a.actors, a.tasks, a.pgs,
               dispatch_agents=a.dispatch_agents,
               broadcast_agents=a.broadcast_agents,
-              broadcast_mb=a.broadcast_mb)
+              broadcast_mb=a.broadcast_mb, data_mb=a.data_mb)
     if a.md:
         write_md(res, a.md, a)

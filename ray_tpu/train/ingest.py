@@ -1,0 +1,119 @@
+"""Gang-training ingest: plane-backed per-rank input pipelines (ISSUE-12).
+
+The marquee consumer of the streaming data plane: ``Dataset.streaming_split``
+shards become per-rank prefetch queues whose payloads move through the
+object plane — the splitter pump carries DESCRIPTORS only, each rank's
+``DataIterator`` keeps several block pulls in flight (landing in the rank's
+own process/store), and the training step finds its next batch already
+local. Reference: ray.train's dataset_shards wiring
+(train/v2/_internal/data_integration.py) over Ray Data streaming_split.
+
+Starvation is MEASURED, not hoped for: every shard iterator counts fetch
+waits that found no prefetched block ready (``IngestStats.starved_steps``),
+so a gang can assert "no training step waited on input" after a run —
+the input-pipeline SLO that keeps a TPU step function busy (PAPERS.md,
+arxiv 2605.25645: input pipelines that never starve a step are a
+first-order throughput lever).
+
+Wiring: ``DataParallelTrainer(datasets={...})`` routes through
+``create_gang_shards`` — the split happens ONCE on the driver, shard
+handles are passed to the (in-process) worker gang through the shard
+registry, and each rank reads its ``ray_tpu.train.get_context()
+.get_dataset_shard(name)``. Process-isolated gangs (`isolate_workers`)
+would need the shard queues to cross process boundaries — unsupported;
+feed those from per-rank datasets instead.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from ray_tpu.data.dataset import DataIterator, Dataset
+
+# Driver-side shard registry: streaming_split shard handles hold live
+# queues fed by a pump thread, so they cross into the worker gang by
+# REFERENCE (thread-actor gangs share the process), keyed by a token that
+# travels in the (picklable) train config.
+_registry_lock = threading.Lock()
+_registry: dict[str, list[dict]] = {}
+_keys = itertools.count(1)
+
+
+class StarvedError(AssertionError):
+    """A gang rank's training step waited on input (see IngestStats)."""
+
+
+def create_gang_shards(datasets: "dict[str, Dataset]", world_size: int,
+                       *, equal: bool = True,
+                       prefetch_blocks: int = 4) -> str:
+    """Split every dataset into ``world_size`` plane-backed shards (once,
+    driver-side) and park the per-rank shard dicts in the registry.
+    Returns the registry key the train config carries."""
+    per_rank: list[dict] = [{} for _ in range(world_size)]
+    for name, ds in datasets.items():
+        shards = ds.streaming_split(world_size, equal=equal,
+                                    prefetch_blocks=prefetch_blocks)
+        for rank, shard in enumerate(shards):
+            per_rank[rank][name] = shard
+    key = f"gang-shards-{next(_keys)}"
+    with _registry_lock:
+        _registry[key] = per_rank
+    return key
+
+
+def take_rank_shards(key: str, rank: int) -> "dict[str, DataIterator]":
+    """Worker side: claim this rank's shard dict. Raises a clear error when
+    the registry entry is not reachable (a process-isolated gang cannot
+    share the in-process shard queues)."""
+    with _registry_lock:
+        per_rank = _registry.get(key)
+    if per_rank is None:
+        raise RuntimeError(
+            f"dataset shard registry key {key!r} not found in this process: "
+            "plane-backed gang ingest requires the worker gang to share the "
+            "driver process (thread actors, the DataParallelTrainer "
+            "default); for isolate_workers gangs pass per-rank datasets "
+            "through train_loop_config instead")
+    return per_rank[rank]
+
+
+def release_gang_shards(key: str) -> None:
+    with _registry_lock:
+        _registry.pop(key, None)
+
+
+def ingest_report(shards: "dict[str, DataIterator]") -> dict:
+    """Per-shard ingest counters for a rank's report: blocks, bytes, wait
+    seconds, starved steps (None stats = shard never consumed)."""
+    out = {}
+    for name, it in shards.items():
+        st = getattr(it, "last_ingest_stats", None)
+        out[name] = None if st is None else {
+            "blocks": st.blocks, "bytes": st.bytes,
+            "wait_s": round(st.wait_s, 6),
+            "starved_steps": st.starved_steps,
+        }
+    return out
+
+
+def assert_never_starved(shards: "dict[str, DataIterator] | list",
+                         where: str = "") -> None:
+    """The gang input-pipeline SLO: raise StarvedError if any consumed
+    shard recorded a training step that waited on input with nothing
+    prefetched. The first ``prefetch_blocks`` fetches per shard — the
+    window filling for the first time — are pipeline warmup and are never
+    counted (a cold pipeline cannot have prefetched anything yet)."""
+    items = shards.items() if isinstance(shards, dict) else enumerate(shards)
+    starved = []
+    for name, it in items:
+        st = getattr(it, "last_ingest_stats", None)
+        if st is not None and st.starved_steps:
+            starved.append((name, st.starved_steps, round(st.wait_s, 4)))
+    if starved:
+        raise StarvedError(
+            f"training step(s) waited on input{' in ' + where if where else ''}: "
+            + ", ".join(f"shard {n}: {s} starved steps ({w}s waited)"
+                        for n, s, w in starved))

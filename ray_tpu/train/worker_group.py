@@ -40,10 +40,17 @@ class RayTrainWorker:
         self._result: Any = None
 
     def run(self, train_fn: Callable, config: dict) -> None:
+        shards: dict = {}
+        shard_key = config.get("_dataset_shard_key")
+        if shard_key:
+            from ray_tpu.train import ingest
+
+            shards = ingest.take_rank_shards(shard_key, self.rank)
         ctx = TrainContext(
             rank=self.rank,
             world_size=self.world_size,
             report_fn=lambda m, c: self._reports.put((m, c)),
+            dataset_shards=shards,
         )
 
         def target():
@@ -126,6 +133,37 @@ class WorkerGroup:
         ]
 
     def run(self, train_fn: Callable, config: dict) -> None:
+        # Datasets split ONCE here (plane-backed streaming_split shards,
+        # train/ingest.py); workers claim their rank's shard dict through
+        # the in-process registry — the config carries only the key.
+        from ray_tpu.data.dataset import Dataset as _Dataset
+
+        datasets = {k: v for k, v in (config.get("_datasets") or {}).items()
+                    if isinstance(v, _Dataset)}
+        if datasets:
+            from ray_tpu.train import ingest
+
+            # A retried run() re-splits fresh — release the previous
+            # attempt's registry entry first or its shard iterators (and
+            # their pump threads / upstream datasets) leak for process
+            # lifetime; shutdown() only releases the LAST key.
+            prev = getattr(self, "_shard_key", None)
+            if prev:
+                ingest.release_gang_shards(prev)
+                self._shard_key = None
+            # caller's config unmutated: a retried attempt re-splits fresh.
+            # Non-Dataset values (pre-split shard lists, paths) stay in
+            # _datasets for the train loop to read directly.
+            rest = {k: v for k, v in config["_datasets"].items()
+                    if k not in datasets}
+            config = dict(config)
+            if rest:
+                config["_datasets"] = rest
+            else:
+                config.pop("_datasets", None)
+            config["_dataset_shard_key"] = ingest.create_gang_shards(
+                datasets, len(self.workers))
+            self._shard_key = config["_dataset_shard_key"]
         ray_tpu.get([w.run.remote(train_fn, config) for w in self.workers])
 
     def poll(self) -> list[dict]:
@@ -160,6 +198,11 @@ class WorkerGroup:
         return out
 
     def shutdown(self) -> None:
+        key = getattr(self, "_shard_key", None)
+        if key:
+            from ray_tpu.train import ingest
+
+            ingest.release_gang_shards(key)
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

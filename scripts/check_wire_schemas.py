@@ -502,6 +502,91 @@ def check_kv_transport() -> list:
     return errors
 
 
+# Streaming-data-plane hot functions (ISSUE-12): the operator pump and the
+# consumer-side fetch/prefetch loops. They may submit tasks and get objects
+# through the public ray_tpu API (which owns retry/failover), but must not
+# speak the wire directly nor construct/look up metrics per block —
+# instruments bind at operator-install time (the exec-loop/kv-transport
+# contract, applied to the data plane).
+_DATA_HOT_FUNCS = {
+    "ray_tpu/data/streaming.py": {
+        "_drive_op", "fetch_block", "_prefetch_pump", "__next__",
+        "_transform_to_plane", "_slice_to_plane",
+    },
+    "ray_tpu/data/exchange.py": {
+        "_reduce_partition", "_map_partition", "_pull_slices",
+    },
+}
+_DATA_HOT_FORBIDDEN_RPC = {"call", "call_async", "notify"}
+
+
+def check_data_streaming_hot_path() -> list:
+    """The ISSUE-12 streaming hot path: pump/pull loops are
+    metric-bind()-only (no instrument construction or registry lookups per
+    block) and RPC-free (no direct wire calls — data moves via tasks +
+    plane pulls), and the data modules never import the wire layer."""
+    errors = []
+    for rel, fnames in sorted(_DATA_HOT_FUNCS.items()):
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel} missing — streaming data plane gone?")
+            continue
+        tree = ast.parse(open(path).read(), filename=rel)
+        # module must not link the control-plane wire directly
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names]
+                mods.append(getattr(node, "module", "") or "")
+                for m in mods:
+                    if m == "ray_tpu.core.rpc" or \
+                            m.startswith("ray_tpu.core.rpc."):
+                        errors.append(
+                            f"{rel}:{node.lineno}: imports {m} — the data "
+                            "plane rides tasks + plane pulls, never the "
+                            "wire directly")
+        fns = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name in fnames:
+                fns.setdefault(node.name, node)
+        for fname in sorted(fnames):
+            fn = fns.get(fname)
+            if fn is None:
+                errors.append(f"{rel}: hot function {fname} missing — "
+                              "streaming pump/pull loop renamed? (update "
+                              "_DATA_HOT_FUNCS)")
+                continue
+            for lineno, callee in _calls_in(fn, _METRIC_CONSTRUCT_CALLS):
+                errors.append(
+                    f"{rel}:{lineno}: {fname} calls {callee}() — streaming "
+                    "hot path must record through handles bound at "
+                    "operator-install time, never construct/look up "
+                    "instruments per block")
+            for lineno, callee in _calls_in(fn, _DATA_HOT_FORBIDDEN_RPC):
+                errors.append(
+                    f"{rel}:{lineno}: {fname} calls {callee}() — streaming "
+                    "hot path is RPC-free (tasks and gets go through the "
+                    "public API)")
+    # the exchange's map stage must seal slices plane-side (put inside the
+    # task), and the reduce stage must PULL its own slices (get inside the
+    # task) — the ISSUE-12 plane-native contract
+    ex_path = os.path.join(REPO, "ray_tpu", "data", "exchange.py")
+    if os.path.exists(ex_path):
+        ex_fns = _find_funcs(ast.parse(open(ex_path).read(), "exchange.py"),
+                             {"_map_partition", "_reduce_partition"})
+        if "_map_partition" in ex_fns and \
+                not _calls_in(ex_fns["_map_partition"], {"put"}):
+            errors.append("exchange.py: _map_partition no longer seals "
+                          "slices via ray_tpu.put — slices must stay in "
+                          "the mapper's node store")
+        if "_reduce_partition" in ex_fns and \
+                not _calls_in(ex_fns["_reduce_partition"],
+                              {"get", "_pull_slices"}):
+            errors.append("exchange.py: _reduce_partition no longer pulls "
+                          "its own slices — reducers must resolve slices "
+                          "through the plane failover path themselves")
+    return errors
+
+
 def run_all() -> None:
     errors = check_registry()
     errors += check_handlers_have_schemas()
@@ -511,6 +596,7 @@ def run_all() -> None:
     errors += check_hot_path_instruments()
     errors += check_elastic_ops()
     errors += check_kv_transport()
+    errors += check_data_streaming_hot_path()
     if errors:
         _fail(errors)
     from ray_tpu.core.rpc import schema

@@ -170,13 +170,15 @@ def test_distributed_exchange_through_object_plane():
         reduce_task = ray_tpu.remote(name="data::exchange_reduce")(_reduce_partition)
         from ray_tpu.data.exchange import _scatter
 
-        partitions, n, _schema = _scatter(iter(blocks),
-                                          hash_partitioner("k", 4), 4, map_task)
-        assert n == n_blocks
+        partitions, inputs, _schema = _scatter(iter(blocks),
+                                               hash_partitioner("k", 4), 4,
+                                               map_task)
+        assert len(inputs) == n_blocks
         # the ~1MB slices were sealed into the AGENTS' node-local stores:
         # the head's plane directory must list them (pull-by-location), and
         # they must live on BOTH agent nodes
-        slice_oids = {r.object_id() for parts in partitions for r in parts}
+        slice_oids = {ref.object_id()
+                      for parts in partitions for ref, _b, _r, _n in parts}
         located = {oid for oid in slice_oids if rt._plane_locations.get(oid)}
         assert len(located) >= len(slice_oids) // 2, (
             f"only {len(located)}/{len(slice_oids)} slices plane-resident")
@@ -184,12 +186,15 @@ def test_distributed_exchange_through_object_plane():
                         for nid in rt._plane_locations[oid]}
         assert len(holder_nodes) >= 2, "slices did not spread over both agents"
 
-        out = []
-        for parts in partitions:
-            out.append(ray_tpu.get(
-                reduce_task.remote(lambda bs: Block.concat(bs), *parts),
-                timeout=300))
-        total = sum(b.num_rows() for b in out)
+        # reducers PULL THEIR OWN slices (holder->reducer through the plane)
+        # and seal their output locally: the driver sees descriptors only
+        total = 0
+        for p, parts in enumerate(partitions):
+            descs = [[ref, bidx, nb] for ref, bidx, _r, nb in parts]
+            ref, nrows, nbytes = ray_tpu.get(
+                reduce_task.remote(lambda bs: Block.concat(bs), p, descs),
+                timeout=300)
+            total += nrows
         assert total == n_blocks * rows_per
     finally:
         cluster.shutdown()
