@@ -452,6 +452,13 @@ class ControlPlane:
                 # per-node physical stats for the dashboard/state API
                 # (reference: reporter agent -> GcsNodeResourceInfo)
                 self.runtime.node_stats[nid] = {**stats, "ts": time.time()}
+                if isinstance(stats.get("wall_ts"), (int, float)):
+                    # heartbeat-borne clock sample: feeds the per-node
+                    # offset the timeline exporter aligns cross-node
+                    # events with (util/timeline.clock_offset)
+                    from ray_tpu.util import timeline
+
+                    timeline.note_clock_sample(nid.hex(), stats["wall_ts"])
         return True
 
     def _h_metrics_push(self, peer: RpcPeer, msg: dict):
@@ -481,6 +488,12 @@ class ControlPlane:
         _metrics.ingest_wire_snapshot(node_hex, msg["snap"], source=source)
         if msg.get("events"):
             flight_recorder.ingest_remote(node_hex, msg["events"])
+        if msg.get("phases"):
+            # v8 timeline piggyback: worker task-phase + span entries,
+            # keyed (node, worker) for the cluster timeline exporter
+            from ray_tpu.util import timeline
+
+            timeline.ingest_remote(node_hex, source, msg["phases"])
         if peer.closed:
             # register-after-disconnect: _peer_gone may have already run
             # while this push sat on the reactor — withdraw, or a dead

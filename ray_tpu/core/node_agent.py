@@ -204,6 +204,74 @@ def main() -> None:
             pool.on_task_blocked(msg["task"])
         return True
 
+    def h_profile_capture(peer, msg):
+        """v8 out-of-band profiler: signal a worker of THIS node to stack-
+        sample itself (util/stack_sampler — reaches a worker wedged in a
+        lock, which a remote-task capture by construction cannot), then
+        seal the collapsed-stack artifact into the node's plane store so
+        the head lands it zero-copy via pull_into. Deferred-Future reply:
+        the capture parks for the sample window and must not hold an agent
+        thread per request beyond its worker."""
+        from concurrent.futures import Future as _Future
+
+        out: _Future = _Future()
+
+        def work():
+            try:
+                from ray_tpu.util import stack_sampler
+
+                mode = msg.get("mode") or "stack"
+                if mode != "stack":
+                    raise ValueError(
+                        f"node agent serves mode='stack' captures only "
+                        f"(got {mode!r}); XPlane captures ride the "
+                        "dashboard's remote-task path for healthy workers")
+                pid = int(msg.get("pid") or 0)
+                pool = pool_box.get("pool")
+                if not pid:
+                    # auto-target: the worker running the OLDEST in-flight
+                    # task — exactly the one an operator asks "why is that
+                    # worker stuck" about
+                    running = (pool.running_tasks()
+                               if pool is not None else {})
+                    if not running:
+                        raise RuntimeError(
+                            "no in-flight worker task to profile "
+                            "(pass an explicit pid)")
+                    pid = min(running.items(), key=lambda kv: kv[1][1])[0]
+                elif pool is None or pid not in pool.worker_pids():
+                    # only signal OUR pool's workers: they installed the
+                    # handler at boot — SIGUSR2 to any other pid (the
+                    # agent itself, a plane server, an unrelated process)
+                    # would TERMINATE it (default disposition)
+                    raise ValueError(
+                        f"pid {pid} is not a live worker of this node — "
+                        "refusing to signal it")
+                blob = stack_sampler.capture_out_of_band(
+                    pid, duration_s=float(msg.get("duration_s") or 1.0),
+                    samples=int(msg.get("samples") or 20))
+                result = {"pid": pid, "size": len(blob)}
+                oid_bin = msg.get("oid")
+                if local_store is not None and oid_bin:
+                    oid = ObjectID(oid_bin)
+                    local_store.put_bytes(oid, blob)
+                    local_store.pin(oid)
+                    with pinned_lock:
+                        pinned_objects[oid_bin] = len(blob)
+                    result["oid"] = oid_bin
+                    result["plane"] = True
+                else:
+                    # shared-plane node (or no artifact id): inline reply
+                    result["blob"] = blob
+                    result["plane"] = False
+                out.set_result(result)
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        __import__("threading").Thread(
+            target=work, daemon=True, name="profile-capture").start()
+        return out
+
     def h_kill_worker(peer, msg):
         return pool_box["pool"].kill_random_worker()
 
@@ -225,6 +293,7 @@ def main() -> None:
         "task_blocked": h_task_blocked,
         "plane_free": h_plane_free,
         "plane_replicate": h_plane_replicate,
+        "profile_capture": h_profile_capture,
         "kill_worker": h_kill_worker,
         "num_alive": h_num_alive,
         "ping": h_ping,
@@ -300,7 +369,10 @@ def main() -> None:
         """Per-node physical stats shipped with every heartbeat (reference:
         dashboard/modules/reporter agent — psutil loop; here plain /proc
         reads so agents stay dependency-free)."""
-        st: dict = {"pid": os.getpid()}
+        # wall_ts: heartbeat-borne clock sample — the head's per-node clock-
+        # offset estimator (util/timeline) re-bases this node's timeline
+        # events onto the head clock with it
+        st: dict = {"pid": os.getpid(), "wall_ts": time.time()}
         try:
             with open("/proc/loadavg") as f:
                 st["load1"] = float(f.read().split()[0])

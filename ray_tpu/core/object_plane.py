@@ -43,6 +43,7 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.core import rpc as wire
 from ray_tpu.exceptions import ObjectLostError, ObjectStoreFullError
 from ray_tpu.util import flight_recorder
+from ray_tpu.util import timeline as _tl
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 import os as _os
@@ -522,7 +523,14 @@ class PlaneClient:
                 if state["done"] >= total:
                     _M_PULL_OK.inc()
                     _M_PULL_BYTES.inc(size)
-                    _M_PULL_SECONDS.observe(_time.perf_counter() - t_start)
+                    dur = _time.perf_counter() - t_start
+                    _M_PULL_SECONDS.observe(dur)
+                    # whole-pull timeline window (once per pull, same
+                    # granularity as the histogram above — the chunk loop
+                    # and BLOB frame paths stay timeline-free too)
+                    _tl.record_span("plane_pull", f"pull:{oid_bin.hex()[:12]}",
+                                    _time.time() - dur, dur,
+                                    {"bytes": size})
                     return True
                 # every holder of this round died/evicted mid-transfer; the
                 # loop re-gathers (surviving peers + untried addrs) and only

@@ -50,7 +50,11 @@ Worker -> parent:
   ("ready",)                            boot handshake
   ("start", seq)                        executor began the task (running-set upkeep)
   ("item", seq, index, status, payload, extra)  one generator yield
-   ("done", seq, status, payload, extra[, contained]) status: "val" | "shm" | "err" | "gen_end"
+   ("done", seq, status, payload, extra[, contained[, phase_clocks]])
+                                        status: "val" | "shm" | "err" | "gen_end";
+                                        phase_clocks: wall [recv, args, exec_end,
+                                        stored] for the cluster timeline
+                                        (util/timeline.phase_reply)
   ("skipped", seq)                      cancel won; parent resubmits elsewhere
   ("badreq", None)                      undecodable frame: parent kills + respawns
   ("dag", seq, "ok"/"err", payload[, exc])  dag_install ack
@@ -74,6 +78,7 @@ from typing import Any, Callable, Optional
 import cloudpickle
 
 from ray_tpu.exceptions import ActorError, TaskCancelledError
+from ray_tpu.util import timeline as _timeline
 
 
 class WorkerCrashedError(ActorError):
@@ -695,9 +700,17 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         _set_current_task(task_bin)
         contained = None
         exec_t0 = time.time()
+        # Task phase clocks (ISSUE 13 timeline): received (dequeued) ->
+        # args-deserialized -> exec -> outputs-stored. Monotonic reads here;
+        # the wall-converted clocks ride the done reply (phase_reply, pinned
+        # RPC- and instrument-free by check_phase_stamp_hot_path) and the
+        # pool PARENT — head driver or node agent, both already metric
+        # pushers — stamps them into its timeline ring.
+        t_recv = t_args = t_exec1 = time.monotonic()
         try:
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = _decode_call(args_blob)
+            t_args = t_exec1 = time.monotonic()
             if trace_ctx:
                 # worker-side execute span joins the driver's submit trace
                 # (the propagated context IS the opt-in — recorded to this
@@ -712,6 +725,7 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                     result = fn(*args, **kwargs)
             else:
                 result = fn(*args, **kwargs)
+            t_exec1 = time.monotonic()
             status, payload, extra, contained = _result_payload(
                 result, oid_bin)
         except BaseException as e:  # noqa: BLE001
@@ -720,7 +734,9 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
         finally:
             _set_current_task(None)
             _emit_profile_event(task_bin, exec_t0, status)
-        _reply(("done", seq, status, payload, extra, contained))
+        _reply(("done", seq, status, payload, extra, contained,
+                _timeline.phase_reply(t_recv, t_args, t_exec1,
+                                      time.monotonic())))
         _retire(seq)
 
 
@@ -1303,6 +1319,7 @@ class ProcessWorkerPool:
             elif tag == "done":
                 seq, status, payload, extra = resp[1], resp[2], resp[3], resp[4]
                 contained = resp[5] if len(resp) > 5 else None
+                phase_clocks = resp[6] if len(resp) > 6 else None
                 with self._cv:
                     inf = w.inflight.pop(seq, None)
                     cur = self._running_tasks.get(w.proc.pid)
@@ -1315,6 +1332,11 @@ class ProcessWorkerPool:
                     self._cv.notify_all()
                 if inf is None:
                     continue
+                if phase_clocks:
+                    # worker phase clocks rode the reply pipe: stamp them
+                    # into THIS (pushing) process's timeline ring
+                    _timeline.stamp_task_phases(inf.task_bin, w.proc.pid,
+                                                phase_clocks, status)
                 if status == "err":
                     inf.future.set_exception(_RemoteTaskError(payload, exc_blob=extra))
                 else:
@@ -1582,6 +1604,12 @@ class ProcessWorkerPool:
         return True
 
     # ------------------------------------------------------------- inspection
+    def worker_pids(self) -> list[int]:
+        """Live worker pids (profile-capture target validation: a SIGUSR to
+        a pid with no handler installed would TERMINATE it)."""
+        with self._lock:
+            return [w.proc.pid for w in self._workers if w.is_alive()]
+
     def running_tasks(self) -> dict:
         """pid -> (task_bin, start_ts) for in-flight tasks (OOM policy input)."""
         with self._lock:

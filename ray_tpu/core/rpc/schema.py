@@ -44,6 +44,14 @@ Version history:
   landed, so the pages free immediately instead of waiting for the TTL
   sweep). The KV pages themselves move over the EXISTING v3 BLOB pull
   path; against a <v7 holder the puller skips the ack and TTL reclaims.
+- v8: cluster timeline + out-of-band profiler — ``profile_capture`` (head
+  asks a NODE AGENT to stack-sample one of its workers via the in-process
+  SIGUSR sampler and seal the artifact into the object plane; unlike a
+  remote-task capture this reaches a worker wedged in a lock). Worker task
+  PHASE events ride the EXISTING v5 ``metrics_push`` as the appended
+  optional ``phases`` field — inbound-tolerant <v8 heads simply drop it,
+  so no gating is needed for the timeline half. A <v8 agent cannot serve
+  captures; the head falls back to the remote-task jax-profiler path.
 """
 
 from __future__ import annotations
@@ -53,7 +61,7 @@ from typing import Optional
 
 # The schema version this build speaks, and the oldest it can fall back to.
 # Peers negotiate min(max_a, max_b) at hello; see negotiate().
-WIRE_VERSION = 7
+WIRE_VERSION = 8
 WIRE_VERSION_MIN = 1
 
 # Protocol magic sent in the hello frame: rejects foreign/legacy peers with
@@ -376,10 +384,14 @@ register_op(55, "dag_ch_read", [
 #    feeding the cluster-wide Prometheus view, _private/metrics_agent.py).
 #    Version-gated so a v5 agent joined to a <v5 head just skips pushing.
 register_op(56, "metrics_push", [
-    _f("snap", T.ANY, required=True), _f("events", T.ANY)], since=5,
+    _f("snap", T.ANY, required=True), _f("events", T.ANY),
+    # v8 timeline piggyback: worker task-phase + subsystem span entries
+    # (util/timeline.drain_since). Appended optional field — inbound-
+    # tolerant <v8 heads drop it, so the push itself stays since=5.
+    _f("phases", T.ANY)], since=5,
     doc="agent -> head (notify): compact metrics-registry snapshot "
-        "(util/metrics.wire_snapshot) + new flight-recorder events; the "
-        "head merges both under the sender's node_id")
+        "(util/metrics.wire_snapshot) + new flight-recorder events + new "
+        "timeline entries; the head merges all under the sender's node_id")
 
 # -- elastic gangs (v6; reference: GCS node-death pub/sub + the Podracer
 #    pattern of restartable actor fleets). Version-gated so a <v6 agent is
@@ -411,3 +423,20 @@ register_op(59, "kv_ack", [
     doc="decode -> prefill KV endpoint (notify): the handoff's pages landed "
         "in the decode engine's pool; the publisher frees the plane entry "
         "(serve/kv_transport.py lifecycle: ack | TTL | claimant death)")
+
+# -- out-of-band worker profiler (v8; reference: dashboard profile_manager's
+#    py-spy/memray captures of ANY worker — here the node agent drives the
+#    in-process SIGUSR stack sampler, util/stack_sampler.py, so a worker
+#    wedged in a lock is still diagnosable). Version-gated: a <v8 agent has
+#    no handler; the head falls back to the remote-task jax-profiler path.
+register_op(60, "profile_capture", [
+    _f("pid", T.INT, required=True), _f("duration_s", T.FLOAT),
+    _f("samples", T.INT), _f("mode", T.STR), _f("oid", T.BYTES)],
+    since=8, blocking=True,
+    doc="head -> agent: signal worker `pid` (0 = the worker running the "
+        "oldest in-flight task) to stack-sample itself for duration_s; the "
+        "agent seals the collapsed-stack artifact into its plane store "
+        "under `oid` (pin + announce) and replies {pid, size, oid, plane} "
+        "— or {pid, size, blob, plane: false} inline on a shared-plane "
+        "node. blocking: parks for the sample window, must not occupy a "
+        "bounded reactor slot")

@@ -378,6 +378,15 @@ class Runtime:
         from ray_tpu._private import export_events as _export
 
         _export.configure(self.session_dir)
+        try:
+            # crash-dump hooks (ISSUE 13 satellite): atexit + SIGTERM dump
+            # every flight-recorder ring to session_dir/flight_dump.json so
+            # post-mortems survive head death; disarmed in shutdown()
+            from ray_tpu.util import flight_recorder as _fr
+
+            _fr.install_crash_dump(self.session_dir)
+        except Exception:
+            pass
         # workers join the export pipeline (worker-side batched profile
         # events; reference: TaskEventBuffer's worker profile events) —
         # worker_env() copies os.environ into spawned processes. The enabled
@@ -875,6 +884,78 @@ class Runtime:
         blob, _how = self.plane_client.pull_into_or_pull(
             pairs, oid, self.shm_store, on_stale=on_stale)
         return blob
+
+    def profile_worker(self, node_id: "NodeID", pid: int = 0,
+                       duration_s: float = 1.0, samples: int = 20,
+                       mode: str = "stack") -> dict:
+        """Out-of-band stack capture of a worker on ``node_id`` (ISSUE 13):
+        the node AGENT signals the target worker's in-process sampler
+        (util/stack_sampler) — so a worker wedged in a lock or a stuck
+        collective is still diagnosable, which a remote-task capture by
+        construction is not — seals the collapsed-stack artifact into its
+        plane store, and this head pulls it zero-copy (``pull_into``).
+
+        ``pid=0`` lets the agent pick the worker running the oldest
+        in-flight task. Returns ``{pid, size, blob, transport, node}`` with
+        ``transport`` "plane" (sealed + pulled) or "inline" (shared-plane
+        node — the artifact rode the reply)."""
+        agent = self._agents.get(node_id)
+        if agent is None or agent.closed:
+            raise ValueError(
+                f"no live node agent for {node_id.hex()[:12]} — out-of-band "
+                "captures need a real-process node")
+        if (agent.negotiated_version or 0) < 8:
+            from ray_tpu.core.rpc import WireVersionError
+
+            raise WireVersionError(
+                "node agent negotiated wire < v8: it cannot serve "
+                "profile_capture (fall back to the dashboard's remote-task "
+                "XPlane capture — healthy workers only)")
+        # head-minted artifact id: structurally a put id, so directory /
+        # free bookkeeping treats it like any other plane object
+        with self._lock:
+            self._put_index += 1
+            art_oid = ObjectID.for_put(self.driver_task_id, self._put_index)
+        try:
+            got = agent.call(
+                "profile_capture", pid=int(pid or 0),
+                duration_s=float(duration_s), samples=int(samples), mode=mode,
+                oid=art_oid.binary(), timeout=float(duration_s) + 60.0)
+            if not isinstance(got, dict):
+                raise RuntimeError(
+                    f"malformed profile_capture reply: {got!r}")
+        except BaseException:
+            # the agent may have sealed+pinned the artifact before the
+            # failure (reply lost / wire timeout): best-effort unpin, or
+            # repeated failed captures leak agent store capacity
+            try:
+                agent.notify("plane_free", oid=art_oid.binary())
+            except Exception:
+                pass
+            raise
+        if got.get("oid"):
+            oid = ObjectID(got["oid"])
+            self.plane_object_added(oid, node_id, size=got.get("size") or 0)
+            try:
+                view = self._pull_from_plane(oid)  # v3 zero-copy pull_into
+                if view is None:
+                    raise RuntimeError(
+                        "profile artifact vanished from the plane before "
+                        "the head could pull it")
+                blob = bytes(view)
+            finally:
+                self._free_plane_copies(oid)  # drop the agent-pinned primary
+            transport = "plane"
+        else:
+            blob = bytes(got.get("blob") or b"")
+            transport = "inline"
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("profile", "stack_capture",
+                               node_id=node_id.hex(), pid=got.get("pid"),
+                               size=len(blob), transport=transport)
+        return {"pid": got.get("pid"), "size": len(blob), "blob": blob,
+                "transport": transport, "node": node_id.hex()}
 
     def _free_plane_copies(self, oid: ObjectID) -> None:
         with self._lock:
@@ -3037,6 +3118,14 @@ class Runtime:
         from ray_tpu._private import export_events
 
         export_events.shutdown()  # close writers; late daemon emits no-op
+        try:
+            # final flight dump + handler restore (suite-cycled sessions
+            # must not stack SIGTERM hooks)
+            from ray_tpu.util import flight_recorder as _fr
+
+            _fr.uninstall_crash_dump()
+        except Exception:
+            pass
         # don't leak OUR session env into later sessions / user subprocesses
         # (user-set values are left alone)
         import os as _os

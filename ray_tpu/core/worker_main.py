@@ -64,6 +64,16 @@ def main() -> None:
     except Exception:
         pass
 
+    try:
+        # out-of-band profiler target (ISSUE 13): the node agent triggers an
+        # in-process stack sample with a signal — reaches this worker even
+        # when its executor is wedged in a lock (a remote task cannot)
+        from ray_tpu.util import stack_sampler
+
+        stack_sampler.install()
+    except Exception:
+        pass
+
     from multiprocessing.connection import Connection
 
     conn = Connection(args.fd)

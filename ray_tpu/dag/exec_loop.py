@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 
 from ray_tpu.core.shm_channel import ChannelClosed
+from ray_tpu.util import timeline as _timeline
 from ray_tpu.util.metrics import Counter, Histogram
 
 # Telemetry: instruments bound ONCE at import (util/metrics.py bind
@@ -112,6 +113,7 @@ def run_plan(instance, plan: ActorPlan, channels: dict, *,
     execs = 0       # executions since the last metrics flush
     sampled_ms = -1.0
     t_exec = 0.0    # start of the SAMPLED execution (first frame in hand)
+    t_wall = 0.0    # wall twin of t_exec: the timeline window's anchor
     try:
         while True:
             frames: dict = {}   # chan_id -> (seq, status, payload)
@@ -121,7 +123,7 @@ def run_plan(instance, plan: ActorPlan, channels: dict, *,
                 t_exec = 0.0  # a frameless execution must not reuse a stale clock
 
             def _chan_value(cid):
-                nonlocal seq, t_exec
+                nonlocal seq, t_exec, t_wall
                 fr = frames.get(cid)
                 if fr is None:
                     last[cid], view = channels[cid].read_view(
@@ -133,6 +135,7 @@ def run_plan(instance, plan: ActorPlan, channels: dict, *,
                         # clock starts when the first input frame is in hand
                         # — idle channel wait is arrival time, not step cost
                         t_exec = time.perf_counter()
+                        t_wall = time.time()
                 if fr[1] != "ok":
                     raise _ErrorFrame(fr[2])
                 return fr[2]
@@ -184,6 +187,11 @@ def run_plan(instance, plan: ActorPlan, channels: dict, *,
             _drain_unread(plan, frames, channels, last)
             if sampling and t_exec:
                 sampled_ms = (time.perf_counter() - t_exec) * 1e3
+                # sampled timeline window: one ring append per flush window
+                # (same cadence as the metrics sample — the loop stays
+                # RPC-free and per-step-allocation-free)
+                _timeline.record_span("dag_step", "exec", t_wall,
+                                      sampled_ms / 1e3)
             execs += 1
             if execs >= _SAMPLE_EVERY:
                 _M_STEPS.inc(execs)

@@ -100,11 +100,39 @@ class Dashboard:
         finally:
             shutil.rmtree(out_dir, ignore_errors=True)
 
-    def _register_artifact(self, art_id: str, path: str) -> int:
-        import zipfile
+    def _capture_stack(self, duration: float, node_hex: "str | None",
+                       pid: int) -> tuple:
+        """Out-of-band stack capture (ISSUE 13): the node AGENT drives the
+        target worker's SIGUSR sampler and seals the artifact into the
+        plane; the head pulls it zero-copy. Reaches workers a remote-task
+        capture cannot (wedged in a lock, stuck in a collective)."""
+        import tempfile
+        import uuid as _uuid
 
-        with zipfile.ZipFile(path) as z:
-            n_files = len(z.namelist())
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu.core.runtime import get_runtime
+
+        if not node_hex:
+            raise ValueError("mode=stack needs ?node=<hex> (the capture is "
+                             "served by that node's agent)")
+        rt = get_runtime()
+        got = rt.profile_worker(NodeID(bytes.fromhex(node_hex)), pid=pid,
+                                duration_s=duration)
+        art_id = f"stacks-{node_hex[:8]}-{_uuid.uuid4().hex[:6]}"
+        path = os.path.join(tempfile.gettempdir(), f"{art_id}.json")
+        with open(path, "wb") as f:
+            f.write(got["blob"])
+        n_files = self._register_artifact(art_id, path)
+        return art_id, path, n_files, got
+
+    def _register_artifact(self, art_id: str, path: str) -> int:
+        if path.endswith(".zip"):
+            import zipfile
+
+            with zipfile.ZipFile(path) as z:
+                n_files = len(z.namelist())
+        else:
+            n_files = 1  # single-file artifact (stack-capture json)
         self._profile_artifacts[art_id] = path
         # capped retention, like the capture dirs before it
         while len(self._profile_artifacts) > 8:
@@ -294,6 +322,22 @@ class Dashboard:
 
             return web.json_response(jsonable(st.gang_view()))
 
+        async def timeline(request):
+            """The whole session as ONE Chrome/Perfetto trace (util/state
+            .timeline): task phases + head transitions + spans + dag steps
+            + plane pulls + flight instants, offset-aligned across nodes.
+            Save the body and load it in ui.perfetto.dev."""
+            import asyncio as _aio
+
+            from ray_tpu.util import state as st
+
+            loop = _aio.get_running_loop()
+            try:
+                trace = await loop.run_in_executor(None, st.timeline)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)[:300]}, status=500)
+            return web.json_response(jsonable(trace))
+
         async def serve_status(request):
             try:
                 from ray_tpu import serve
@@ -306,19 +350,40 @@ class Dashboard:
             return web.json_response({"status": "ok"})
 
         async def profile(request):
-            """On-demand accelerator/host profiling (reference: dashboard
-            reporter profile_manager.py:82 py-spy/memray; TPU-native
-            equivalent is a jax profiler XPlane/perfetto capture). With
-            ?node=<hex> the capture runs in a WORKER on that node (the task is
-            node-affinity pinned); artifacts are stored head-side and served
-            from /api/profile/artifacts/<id>."""
+            """On-demand profiling (reference: dashboard reporter
+            profile_manager.py:82 py-spy/memray captures of any worker).
+            ``mode=native`` (default): jax profiler XPlane capture —
+            head-local, or inside a WORKER pinned to ?node=<hex>; healthy
+            workers only (it runs as a remote task). ``mode=stack``: the
+            OUT-OF-BAND path — the node agent signals the target worker's
+            in-process stack sampler (wire v8 profile_capture), so a hung
+            worker is still diagnosable; ?pid= targets one worker (default:
+            the worker running the oldest in-flight task)."""
             import asyncio as _aio
 
             duration = min(float(request.query.get("duration_s", "1.0")), 30.0)
             node_hex = request.query.get("node")
+            mode = request.query.get("mode", "native")
 
             loop = _aio.get_running_loop()
             try:
+                if mode == "stack":
+                    try:
+                        pid = int(request.query.get("pid", "0"))
+                    except ValueError:
+                        pid = 0
+                    art_id, _path, n_files, got = await loop.run_in_executor(
+                        None, self._capture_stack, duration, node_hex, pid)
+                    return web.json_response({
+                        "artifact_id": art_id,
+                        "artifact_url": f"/api/profile/artifacts/{art_id}",
+                        "num_files": n_files,
+                        "node": node_hex, "pid": got.get("pid"),
+                        "transport": got.get("transport"),
+                        "duration_s": duration,
+                        "hint": "collapsed stacks (flamegraph-ready): feed "
+                                "`collapsed` to speedscope / flamegraph.pl",
+                    })
                 art_id, zip_path, n_files = await loop.run_in_executor(
                     None, self._capture_profile, duration, node_hex)
             except Exception as e:  # noqa: BLE001
@@ -346,9 +411,10 @@ class Dashboard:
             path = self._profile_artifacts.get(aid)
             if path is None or not os.path.exists(path):
                 return web.json_response({"error": "unknown artifact"}, status=404)
+            ext = os.path.splitext(path)[1] or ".bin"
             return web.FileResponse(
                 path, headers={"Content-Disposition":
-                               f'attachment; filename="{aid}.zip"'})
+                               f'attachment; filename="{aid}{ext}"'})
 
         async def index(request):
             from ray_tpu.dashboard.ui import INDEX_HTML
@@ -364,6 +430,7 @@ class Dashboard:
             app.router.add_get("/api/v0/flight_records", flight_records)
             app.router.add_get("/api/v0/node_io", node_io)
             app.router.add_get("/api/v0/gang", gang)
+            app.router.add_get("/api/v0/timeline", timeline)
             app.router.add_get("/api/v0/{resource}", state_list)
             app.router.add_get("/api/jobs", jobs)
             app.router.add_post("/api/jobs", job_submit)

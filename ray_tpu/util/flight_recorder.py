@@ -22,6 +22,7 @@ on when the failure happens.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -94,6 +95,101 @@ def ingest_remote(node_hex: str, events: list) -> None:
         fields["node_ts"] = e.get("ts")
         record(str(e.get("subsystem", "remote")),
                str(e.get("event", "unknown")), **fields)
+
+
+# ------------------------------------------------------------ crash dumps
+# atexit + fatal-signal hook (ISSUE 13 satellite): the head dumps every
+# ring to ``session_dir/flight_dump.json`` on the way down, so the "what
+# happened in the last 30 seconds" answer survives head death and is
+# available to post-mortems that never got to call the state API.
+_dump_path: "str | None" = None
+_prev_handlers: dict = {}
+
+
+def dump_json(path: "str | None" = None) -> "str | None":
+    """Write every ring as JSON to ``path`` (default: the installed crash-
+    dump path). Atomic tmp+rename; returns the path or None (no path / IO
+    error — dumping must never raise on a dying process)."""
+    p = path or _dump_path
+    if p is None:
+        return None
+    try:
+        payload = {"ts": time.time(), "pid": os.getpid(),
+                   "events": records(limit=100000)}
+        import json
+
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, p)
+        return p
+    except Exception:
+        return None
+
+
+def _on_fatal_signal(signum, frame) -> None:
+    import signal as _signal
+
+    dump_json()
+    prev = _prev_handlers.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+        return
+    if prev is _signal.SIG_IGN:
+        return
+    # default disposition: restore and re-deliver so exit semantics
+    # (exit code, core, parent's waitpid status) stay untouched
+    _signal.signal(signum, _signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def install_crash_dump(session_dir: str) -> "str | None":
+    """Arm the crash dump: atexit hook always; SIGTERM chain-hook when this
+    is the main thread (the orchestrator/systemd/GCE-reclaim kill signal).
+    Returns the dump path. Idempotent per path; ``uninstall_crash_dump``
+    restores the previous handlers."""
+    global _dump_path
+    import atexit
+    import signal as _signal
+
+    _dump_path = os.path.join(session_dir, "flight_dump.json")
+    atexit.register(dump_json)
+    if threading.current_thread() is threading.main_thread():
+        for sig in (_signal.SIGTERM,):
+            try:
+                prev = _signal.getsignal(sig)
+                if prev is _on_fatal_signal:
+                    continue
+                _prev_handlers[sig] = prev
+                _signal.signal(sig, _on_fatal_signal)
+            except (ValueError, OSError):
+                pass
+    return _dump_path
+
+
+def uninstall_crash_dump(final_dump: bool = True) -> None:
+    """Disarm (runtime shutdown): writes one final dump by default — an
+    orderly shutdown leaves the same post-mortem artifact a crash would —
+    then restores chained handlers so suite-cycled sessions don't stack."""
+    global _dump_path
+    import atexit
+    import signal as _signal
+
+    if final_dump:
+        dump_json()
+    for sig, prev in list(_prev_handlers.items()):
+        try:
+            if _signal.getsignal(sig) is _on_fatal_signal:
+                _signal.signal(sig, prev)
+        except (ValueError, OSError, TypeError):
+            pass
+    _prev_handlers.clear()
+    try:
+        atexit.unregister(dump_json)
+    except Exception:
+        pass
+    _dump_path = None
 
 
 def dump(file=None) -> None:

@@ -19,6 +19,7 @@ cost nothing until scrape/push time.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from bisect import bisect_left
@@ -345,8 +346,29 @@ def drop_remote_snapshot(node_hex: str, source: "str | None" = None) -> None:
             _remote.pop(key, None)
 
 
+def _push_expiry_s() -> "float | None":
+    """Staleness bound for pushed series: 3x the push period (None = push
+    disabled, nothing expires)."""
+    try:
+        period = float(os.environ.get("RAY_TPU_METRICS_PUSH_PERIOD_S", "2"))
+    except ValueError:
+        period = 2.0
+    return 3.0 * period if period > 0 else None
+
+
 def remote_snapshots() -> dict[tuple[str, str], dict]:
+    """Live pushed snapshots. A ``(node, src)`` that has not pushed within
+    3x the push period is EXPIRED here (and pruned) — previously a dead
+    worker's gauges lingered at their last value in /metrics forever; the
+    disconnect-drop path only covers peers whose socket death the head
+    observed."""
+    exp = _push_expiry_s()
+    now = time.monotonic()
     with _remote_lock:
+        if exp is not None:
+            for key in [k for k, ent in _remote.items()
+                        if now - ent["ts"] > exp]:
+                del _remote[key]
         return dict(_remote)
 
 
@@ -471,18 +493,25 @@ def node_io_rollup() -> dict:
             "holder_pending": holder}
 
 
-def push_once(peer, cursor: int) -> int:
+def push_once(peer, cursor) -> dict:
     """One metrics_push over ``peer``: ship this process's registry plus
-    flight-recorder events newer than ``cursor``; returns the advanced
-    cursor. The cursor only moves AFTER the notify succeeds, so a failed
-    push re-ships its events next time instead of dropping them — shared
-    by the node agent's heartbeat loop and the worker pusher. Raises on
-    transport failure (the caller owns reconnect/skip policy)."""
-    from ray_tpu.util import flight_recorder
+    flight-recorder events and timeline entries (worker task phases +
+    subsystem spans, util/timeline) newer than their cursors; returns the
+    advanced cursor dict ``{"flight": int, "timeline": int}`` (a bare int
+    is accepted as a flight-only cursor from older callers). Cursors only
+    move AFTER the notify succeeds, so a failed push re-ships its events
+    next time instead of dropping them — shared by the node agent's
+    heartbeat loop and the worker pusher. Raises on transport failure (the
+    caller owns reconnect/skip policy)."""
+    from ray_tpu.util import flight_recorder, timeline
 
-    events, new_cursor = flight_recorder.drain_since(cursor)
-    peer.notify("metrics_push", snap=wire_snapshot(), events=events or None)
-    return new_cursor
+    if not isinstance(cursor, dict):
+        cursor = {"flight": int(cursor), "timeline": 0}
+    events, fl_cursor = flight_recorder.drain_since(cursor.get("flight", 0))
+    phases, tl_cursor = timeline.drain_since(cursor.get("timeline", 0))
+    peer.notify("metrics_push", snap=wire_snapshot(), events=events or None,
+                phases=phases or None)
+    return {"flight": fl_cursor, "timeline": tl_cursor}
 
 
 # ---------------------------------------------------------------- exposition
@@ -554,9 +583,11 @@ def system_prometheus_text() -> str:
     lines = []
 
     def gauge(name, value, **tags):
-        label = ",".join(f'{k}="{v}"' for k, v in tags.items())
-        lines.append(f"ray_tpu_{name}{{{label}}} {value}" if label
-                     else f"ray_tpu_{name} {value}")
+        # _fmt_labels escapes backslash/quote/newline per the exposition
+        # spec — task states and store stat keys flow in from user-visible
+        # strings and must not be able to break the scrape
+        lines.append(
+            f"ray_tpu_{name}{_fmt_labels(sorted(tags.items()))} {value}")
 
     states: dict[str, int] = {}
     with rt._lock:

@@ -1,0 +1,180 @@
+"""Out-of-band worker stack sampler: SIGUSR-triggered, in-process.
+
+The profiler half of ISSUE 13. The dashboard's jax-profiler capture runs as
+a REMOTE TASK — which by construction cannot profile a worker that is
+wedged (its executor never picks the capture up). This module closes that
+hole the way py-spy/the reference's ``profile_manager.py`` does, but
+without an external dependency: every worker installs a signal handler at
+boot (``install()``), and the NODE AGENT — a separate process that is
+alive exactly when the worker is stuck — triggers a capture by writing a
+request file and sending the signal (``capture_out_of_band()``).
+
+Why a signal reaches a stuck worker: CPython delivers signal handlers on
+the main thread, and the main-thread blocking primitives that wedge
+workers in practice (``lock.acquire``, ``Event.wait``, ``Condition.wait``,
+nested ``get``) are signal-interruptible — the handler runs, spawns a
+DAEMON sampler thread, and returns so the interrupted wait resumes
+untouched. The sampler thread then walks ``sys._current_frames()`` N times
+over the window — every thread's live stack, very much including the
+blocked main thread — and writes a collapsed-stack (flamegraph-ready)
+artifact to a rendezvous file the agent seals into the object plane.
+
+A capture never mutates the target's state beyond one short-lived thread:
+no tracing hooks, no settrace, no stopping the world.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import traceback
+
+# SIGUSR2: SIGUSR1 is popular with app code (and jax debug dumps); both
+# overridable for embedders whose runtime claims USR2.
+CAPTURE_SIGNAL = getattr(signal,
+                         os.environ.get("RAY_TPU_STACK_SIGNAL", "SIGUSR2"))
+DEFAULT_SAMPLES = 20
+DEFAULT_DURATION_S = 1.0
+
+
+def stack_dir() -> str:
+    """Per-machine rendezvous dir shared by agent and workers (tempdir is
+    host-stable; pids key the files — no session plumbing needed)."""
+    d = os.path.join(tempfile.gettempdir(), "ray_tpu_stacks")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _req_path(pid: int) -> str:
+    return os.path.join(stack_dir(), f"{pid}.req.json")
+
+
+def _out_path(pid: int) -> str:
+    return os.path.join(stack_dir(), f"{pid}.stacks.json")
+
+
+# --------------------------------------------------------------- target side
+_installed = False
+
+
+def install() -> bool:
+    """Register the capture signal handler (worker boot hook; main thread
+    only — returns False where that isn't possible, e.g. embedded
+    non-main-thread runtimes)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        signal.signal(CAPTURE_SIGNAL, _on_capture_signal)
+    except ValueError:  # not the main thread
+        return False
+    _installed = True
+    return True
+
+
+def _on_capture_signal(signum, frame) -> None:
+    # Return immediately: the handler interrupted SOMETHING (possibly the
+    # blocked wait we were asked to diagnose) — all work happens on a
+    # daemon thread so the interrupted call resumes at once.
+    threading.Thread(target=_sample_to_file, daemon=True,
+                     name="stack-sampler").start()
+
+
+def sample_stacks(samples: int, period_s: float,
+                  skip_idents: "set | None" = None) -> "tuple[dict, int]":
+    """N passes over ``sys._current_frames()``: per-thread collapsed stacks
+    ``{thread_name: {"frame;frame;...": count}}`` (outermost first, each
+    frame ``file:function:line`` — flamegraph-ready) + the pass count."""
+    skip = set(skip_idents or ())
+    skip.add(threading.get_ident())  # never sample the sampler
+    collapsed: dict[str, dict[str, int]] = {}
+    taken = 0
+    for i in range(max(1, samples)):
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for ident, frm in sys._current_frames().items():
+            if ident in skip:
+                continue
+            name = names.get(ident, f"thread-{ident}")
+            stack = ";".join(
+                f"{os.path.basename(fr.filename)}:{fr.name}:{fr.lineno}"
+                for fr in traceback.extract_stack(frm))
+            per = collapsed.setdefault(name, {})
+            per[stack] = per.get(stack, 0) + 1
+        taken += 1
+        if i + 1 < samples:
+            time.sleep(period_s)
+    return collapsed, taken
+
+
+def _sample_to_file() -> None:
+    pid = os.getpid()
+    try:
+        try:
+            with open(_req_path(pid)) as f:
+                req = json.load(f)
+        except (OSError, ValueError):
+            req = {}
+        samples = int(req.get("samples") or DEFAULT_SAMPLES)
+        duration = float(req.get("duration_s") or DEFAULT_DURATION_S)
+        t0 = time.time()
+        collapsed, taken = sample_stacks(samples,
+                                         duration / max(1, samples))
+        artifact = {
+            "pid": pid, "argv": sys.argv[:3], "ts": t0,
+            "duration_s": time.time() - t0, "samples": taken,
+            "collapsed": collapsed,
+        }
+        tmp = _out_path(pid) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f)
+        os.replace(tmp, _out_path(pid))  # atomic: existence == complete
+        try:
+            os.unlink(_req_path(pid))
+        except OSError:
+            pass
+    except Exception:
+        # a failed capture must never take the worker down with it
+        pass
+
+
+# ---------------------------------------------------------------- agent side
+def capture_out_of_band(pid: int, duration_s: float = DEFAULT_DURATION_S,
+                        samples: int = DEFAULT_SAMPLES,
+                        timeout: "float | None" = None) -> bytes:
+    """Drive a capture of ANOTHER process on this machine (the node-agent
+    half of the v8 ``profile_capture`` op): write the request file, signal
+    the target, wait for the atomically-renamed artifact. Returns the raw
+    JSON artifact bytes; raises ProcessLookupError (target gone) or
+    TimeoutError (no handler installed / handler starved)."""
+    out = _out_path(pid)
+    try:
+        os.unlink(out)  # stale artifact from an earlier capture
+    except OSError:
+        pass
+    tmp = _req_path(pid) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"samples": int(samples), "duration_s": float(duration_s)},
+                  f)
+    os.replace(tmp, _req_path(pid))
+    os.kill(pid, CAPTURE_SIGNAL)
+    deadline = time.monotonic() + (timeout if timeout is not None
+                                   else duration_s + 10.0)
+    while time.monotonic() < deadline:
+        if os.path.exists(out):
+            with open(out, "rb") as f:
+                blob = f.read()
+            try:
+                os.unlink(out)
+            except OSError:
+                pass
+            return blob
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"pid {pid} produced no stack artifact within the window — no "
+        f"handler installed (worker predates v8?) or the process is wedged "
+        f"in non-interruptible native code")

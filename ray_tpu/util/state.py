@@ -7,11 +7,10 @@ export of task events).
 
 from __future__ import annotations
 
-import json
 from collections import Counter as _Counter
 from typing import Optional
 
-from ray_tpu.core.runtime import get_runtime, get_runtime_or_none
+from ray_tpu.core.runtime import get_runtime
 
 
 def list_tasks(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
@@ -205,82 +204,17 @@ def summarize_actors() -> dict:
 
 
 def timeline(path: str | None = None) -> list[dict]:
-    """Chrome-trace events from the task event buffer (reference: ray timeline)."""
-    events = get_runtime().task_events()
-    # pair RUNNING->terminal per task into complete events
-    starts: dict[str, float] = {}
-    trace: list[dict] = []
-    for ev in events:
-        tid = ev["task_id"]
-        if ev["state"] == "RUNNING":
-            starts[tid] = ev["ts"]
-        elif ev["state"] in ("FINISHED", "FAILED", "CANCELLED") and tid in starts:
-            t0 = starts.pop(tid)
-            trace.append(
-                {
-                    "name": ev["name"],
-                    "cat": "task",
-                    "ph": "X",
-                    "ts": int(t0 * 1e6),
-                    "dur": int((ev["ts"] - t0) * 1e6),
-                    "pid": 1,
-                    "tid": abs(hash(ev.get("actor_id") or tid)) % 1000,
-                    "args": {"state": ev["state"]},
-                }
-            )
-    trace.extend(_worker_profile_events())
-    if path:
-        with open(path, "w") as f:
-            json.dump(trace, f)
-    return trace
+    """ONE Chrome/Perfetto trace for the whole session (reference: ray
+    timeline, grown cluster-wide): head-side task transitions (stable
+    per-actor lanes; unpaired RUNNING surfaces as an open span instead of
+    being dropped), worker task-phase windows shipped over metrics_push,
+    tracing spans, sampled dag exec-loop steps, plane-pull windows,
+    flight-recorder instants and gang transitions — cross-node clocks
+    offset-aligned, submit->exec flow arrows per task. The merge lives in
+    ``ray_tpu.util.timeline.export``."""
+    from ray_tpu.util import timeline as tl
 
-
-def _worker_profile_events() -> list[dict]:
-    """Worker-side execution windows from the export pipeline (when export
-    events are on): one 'exec' lane per worker pid, so the timeline shows
-    the dispatch-side span AND the worker's own wall-clock window
-    (reference: ray timeline merging worker profile events)."""
-    import glob
-    import os
-
-    from ray_tpu._private import export_events
-
-    # Resolve the export dir from THIS session's runtime, not the module
-    # global: export_events._DIR/_ENABLED are process-wide and re-written by
-    # every init/shutdown in the process (suite runs cycle many sessions), so
-    # the global can lag the session whose timeline is being asked for.
-    profile_dir = None
-    rt = get_runtime_or_none()
-    session_dir = getattr(rt, "session_dir", None)
-    if session_dir is not None:
-        profile_dir = os.path.join(session_dir, "export_events")
-    elif export_events.enabled() and export_events._DIR is not None:
-        profile_dir = export_events._DIR
-    if profile_dir is None:
-        return []
-    out: list[dict] = []
-    try:
-        for p in glob.glob(os.path.join(profile_dir,
-                                        "export_task_profile*.jsonl")):
-            with open(p) as f:
-                for line in f:
-                    try:
-                        ev = json.loads(line)["event_data"]
-                    except (ValueError, KeyError):
-                        continue
-                    out.append({
-                        "name": f"exec:{(ev.get('task_id') or '?')[:12]}",
-                        "cat": "worker_exec",
-                        "ph": "X",
-                        "ts": int(ev["exec_start"] * 1e6),
-                        "dur": int((ev["exec_end"] - ev["exec_start"]) * 1e6),
-                        "pid": 2,  # separate track group from head-side spans
-                        "tid": ev.get("worker_pid") or 0,
-                        "args": {"status": ev.get("status")},
-                    })
-    except OSError:
-        pass
-    return out
+    return tl.export(path)
 
 
 def _apply_filters(rows: list[dict], filters) -> list[dict]:

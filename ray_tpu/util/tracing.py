@@ -182,6 +182,12 @@ def clear() -> None:
 
 
 def to_chrome_trace() -> list[dict]:
+    # stable lane per trace: sorted trace ids -> 1..N (a per-process
+    # hash-salted lane collides and reshuffles between exports — the same
+    # fix ISSUE-13 applied to state.timeline's actor lanes)
+    spans_ = _tracer.spans()
+    lane_of = {t: i + 1
+               for i, t in enumerate(sorted({s.trace_id for s in spans_}))}
     return [
         {
             "name": s.name,
@@ -190,8 +196,8 @@ def to_chrome_trace() -> list[dict]:
             "ts": s.start_ns // 1000,
             "dur": max(0, (s.end_ns - s.start_ns) // 1000),
             "pid": 1,
-            "tid": abs(hash(s.trace_id)) % 1000,
+            "tid": lane_of[s.trace_id],
             "args": {**s.attributes, "status": s.status},
         }
-        for s in _tracer.spans()
+        for s in spans_
     ]

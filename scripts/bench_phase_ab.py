@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Interleaved phase-stamping overhead A/B (MICROBENCH.md round 12).
+
+Measures process-worker task throughput with the ISSUE-13 timeline phase
+stamping ON (default) vs OFF (``RAY_TPU_TASK_PHASES=0`` — switches off the
+monotonic reads, the clocks element on the done reply, and the parent-side
+ring append). Each arm runs in a FRESH process (the gate is read at module
+import); interleave arms by alternating invocations:
+
+    python scripts/bench_phase_ab.py --arm on  --tasks 600
+    python scripts/bench_phase_ab.py --arm off --tasks 600
+
+The metric is end-to-end tasks/s of trivial process tasks — the dispatch
+path the 4 extra monotonic reads + 4 floats on the reply pipe ride on, so
+any regression shows up undiluted by task work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def bench(tasks: int, repeats: int) -> list[float]:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    def nop(x):
+        return x
+
+    # warm the pool (spawn + import cost must not land in the measured arm)
+    ray_tpu.get([nop.remote(i) for i in range(32)], timeout=120)
+    rates = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote(i) for i in range(tasks)], timeout=300)
+        rates.append(tasks / (time.perf_counter() - t0))
+    ray_tpu.shutdown()
+    return rates
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arm", choices=("on", "off"), required=True)
+    ap.add_argument("--tasks", type=int, default=600)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    os.environ["RAY_TPU_TASK_PHASES"] = "1" if args.arm == "on" else "0"
+    rates = bench(args.tasks, args.repeats)
+    out = {"arm": args.arm, "tasks": args.tasks,
+           "rates": [round(r, 1) for r in rates],
+           "median_tasks_per_s": round(statistics.median(rates), 1)}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,9 @@ SCHEMA_BASELINE = {
     "preempt_notice": 57, "plane_replicate": 58,
     # ISSUE-11 (wire v7): disaggregated PD serving — KV handoff ack
     "kv_ack": 59,
+    # ISSUE-13 (wire v8): out-of-band worker profiler (agent-driven SIGUSR
+    # stack sampler, artifact sealed to the object plane)
+    "profile_capture": 60,
 }
 
 # Files whose handler tables must be fully schema'd.
@@ -136,7 +139,8 @@ _NON_OPS = {
     "CPU", "TPU", "ok", "node_id", "shm_name", "shm_size", "log_dir",
     "size", "actors", "funcs", "ref", "actor", "__bytes__", "pid", "ts",
     "load1", "mem_total_mb", "mem_available_mb", "agent_rss_mb",
-    "workers_alive", "store_used_mb", "store_cap_mb", "num_returns",
+    "workers_alive", "store_used_mb", "store_cap_mb", "wall_ts",
+    "num_returns",
     "max_retries", "retry_exceptions", "name", "resources", "runtime_env",
     "isolate_process", "peer_hello", "input_chans", "output_chan",
     "_trace_ctx",
@@ -587,6 +591,100 @@ def check_data_streaming_hot_path() -> list:
     return errors
 
 
+def check_profiler_op() -> list:
+    """The v8 out-of-band profiler contract: ``profile_capture`` is
+    version-gated (since>=8 — a <v8 agent has no handler and must never be
+    sent the op; the head checks ``negotiated_version`` first) and
+    blocking (the agent-side handler parks for the whole sample window and
+    must not occupy a bounded reactor slot)."""
+    from ray_tpu.core.rpc import schema
+
+    errors = []
+    spec = schema.REGISTRY.get("profile_capture")
+    if spec is None:
+        return ["profile_capture schema missing — out-of-band profiler "
+                "wire gone?"]
+    if spec.since < 8:
+        errors.append(f"profile_capture gated since={spec.since} < 8 — an "
+                      "old-wire agent would receive an op it cannot serve")
+    if not spec.blocking:
+        errors.append("profile_capture must be blocking=True — the agent "
+                      "handler parks for the sample window")
+    # the metrics_push piggyback field must exist (the timeline half rides
+    # the v5 push; removing the field silently severs worker phase lanes)
+    push = schema.REGISTRY.get("metrics_push")
+    if push is not None and "phases" not in push.field_map():
+        errors.append("metrics_push lost its `phases` field — worker "
+                      "timeline entries have no transport")
+    return errors
+
+
+# The worker-side phase-stamping path (ISSUE-13 timeline): the stamp is a
+# ring append — it must never construct/look up instruments nor speak the
+# wire, exactly like the dag exec loop's sampled metrics.
+_PHASE_STAMP_FORBIDDEN = _METRIC_CONSTRUCT_CALLS | {
+    "call", "call_async", "notify", "remote", "submit_task",
+}
+
+
+def check_phase_stamp_hot_path() -> list:
+    """``util/timeline.py``'s recording half is bind-only: the stamp/record
+    functions make no instrument construction/lookup and no RPC, the
+    module never links the control plane, and the worker exec path
+    (``process_pool._worker_main``) actually stamps phases."""
+    errors = []
+    tl_path = os.path.join(REPO, "ray_tpu", "util", "timeline.py")
+    if not os.path.exists(tl_path):
+        return ["ray_tpu/util/timeline.py missing — cluster timeline gone?"]
+    tree = ast.parse(open(tl_path).read(), filename="timeline.py")
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names]
+            mods.append(getattr(node, "module", "") or "")
+            for m in mods:
+                for f in _DAG_LOOP_FORBIDDEN_IMPORTS:
+                    if (m == f or m.startswith(f + ".")) \
+                            and f != "ray_tpu.core.runtime":
+                        errors.append(
+                            f"util/timeline.py:{node.lineno}: imports {m} — "
+                            "the recording module must not link the wire")
+    fns = _find_funcs(tree, {"phase_reply", "stamp_task_phases",
+                             "record_span", "drain_since"})
+    for name in ("phase_reply", "stamp_task_phases", "record_span",
+                 "drain_since"):
+        fn = fns.get(name)
+        if fn is None:
+            errors.append(f"util/timeline.py: {name} missing — phase "
+                          "recording path renamed? (update the lint)")
+            continue
+        for lineno, callee in _calls_in(fn, _PHASE_STAMP_FORBIDDEN):
+            errors.append(
+                f"util/timeline.py:{lineno}: {name} calls {callee}() — the "
+                "phase-stamping path is bind-only (ring append under one "
+                "lock; no instruments, no RPC)")
+    # export() may import the runtime (head-side merge), but the recording
+    # functions above may not — and both halves of the stamping path must
+    # stay wired: the worker exec path ships clocks on the done reply, the
+    # pool parent (head driver / node agent — the pushing processes) stamps
+    pp_path = os.path.join(REPO, "ray_tpu", "core", "process_pool.py")
+    pp_fns = _find_funcs(ast.parse(open(pp_path).read(), "process_pool.py"),
+                         {"_worker_main", "_reply_reader"})
+    wm = pp_fns.get("_worker_main")
+    if wm is None:
+        errors.append("process_pool.py: _worker_main missing")
+    elif not _calls_in(wm, {"phase_reply"}):
+        errors.append("process_pool.py: _worker_main no longer ships phase "
+                      "clocks on the done reply — worker timeline lanes go "
+                      "dark")
+    rr = pp_fns.get("_reply_reader")
+    if rr is None:
+        errors.append("process_pool.py: _reply_reader missing")
+    elif not _calls_in(rr, {"stamp_task_phases"}):
+        errors.append("process_pool.py: _reply_reader no longer stamps "
+                      "worker phase clocks into the parent's timeline ring")
+    return errors
+
+
 def run_all() -> None:
     errors = check_registry()
     errors += check_handlers_have_schemas()
@@ -597,6 +695,8 @@ def run_all() -> None:
     errors += check_elastic_ops()
     errors += check_kv_transport()
     errors += check_data_streaming_hot_path()
+    errors += check_profiler_op()
+    errors += check_phase_stamp_hot_path()
     if errors:
         _fail(errors)
     from ray_tpu.core.rpc import schema

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Export a session's cluster timeline as one Perfetto/Chrome trace file.
+
+Two sources:
+
+- ``--url http://HEAD:8265`` — fetch ``GET /api/v0/timeline`` from a live
+  dashboard (the normal operator path: works from any machine that can
+  reach the head).
+- no ``--url`` — run INSIDE a driver process' session: imports ray_tpu and
+  exports the current runtime's timeline directly (same as
+  ``ray_tpu.util.state.timeline(path)``).
+
+Load the output in https://ui.perfetto.dev or chrome://tracing. Lanes:
+process = node (head is pid 1), thread = worker pid / stable actor lane;
+flow arrows join each task's head-side dispatch to its worker exec window;
+cross-node timestamps are re-based onto the head clock (heartbeat-derived
+offsets — see README "Observability > Cluster timeline" for the caveats).
+
+    python scripts/timeline.py --url http://127.0.0.1:8265 -o trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="dashboard base url (e.g. http://127.0.0.1:8265); "
+                         "omit to export from an in-process session")
+    ap.add_argument("-o", "--out", default="timeline.json",
+                    help="output trace file (default: timeline.json)")
+    ap.add_argument("--timeout", type=float, default=60.0)
+    args = ap.parse_args()
+
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/api/v0/timeline"
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            trace = json.load(r)
+        if isinstance(trace, dict) and trace.get("error"):
+            print(f"timeline export failed: {trace['error']}",
+                  file=sys.stderr)
+            return 1
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+    else:
+        import ray_tpu  # noqa: F401 — must already be init'd in-session
+        from ray_tpu.util import state
+
+        trace = state.timeline(args.out)
+
+    cats = sorted({e.get("cat") for e in trace if e.get("cat")})
+    print(f"wrote {args.out}: {len(trace)} events, categories: "
+          f"{', '.join(cats)}")
+    print("open in https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

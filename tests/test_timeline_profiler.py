@@ -1,0 +1,454 @@
+"""Cluster timeline + out-of-band profiler tests (ISSUE 13): phase
+stamping and the one-trace export, per-node clock alignment, stable
+timeline lanes, the v8 profile_capture wire op, the SIGUSR stack sampler
+against a genuinely blocked process, and the observability satellites
+(pushed-series expiry, exposition escaping, flight crash dump, node_io
+rate clamping).
+
+Reference analogs: `ray timeline` over the GCS task manager's aggregated
+task + worker profile events (SURVEY §5.1) and the dashboard
+profile_manager's py-spy captures of any worker.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import get_runtime
+from ray_tpu.util import flight_recorder
+from ray_tpu.util import metrics as rt_metrics
+from ray_tpu.util import state as rt_state
+from ray_tpu.util import timeline as tl
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_timeline_rings():
+    yield
+    tl.clear()
+
+
+# ------------------------------------------------------------- unit: rings
+def test_phase_reply_and_stamp_roundtrip():
+    """Worker half returns wall clocks; parent half appends one 'phase'
+    entry; drain_since advances like the flight recorder's cursor."""
+    t = time.monotonic()
+    clocks = tl.phase_reply(t, t + 0.001, t + 0.5, t + 0.51)
+    assert clocks is not None and len(clocks) == 4
+    # wall-converted: within a second of time.time()
+    assert abs(clocks[0] - time.time()) < 2.0
+    tl.stamp_task_phases(b"\x07" * 24, 4242, clocks, "val")
+    evs, cur = tl.drain_since(0)
+    phase = [e for e in evs if e[0] == "phase"][-1]
+    assert phase[3] == 4242 and phase[9] == "val"
+    # cursor contract: nothing new -> same cursor, no events
+    evs2, cur2 = tl.drain_since(cur)
+    assert evs2 == [] and cur2 == cur
+
+
+def test_clock_offset_max_filter_and_export_alignment():
+    """One-way delay biases every heartbeat sample DOWN, so the estimator
+    takes the max of the window; export re-bases remote events by it."""
+    node = "ff" * 16
+    now = time.time()
+    # true skew +5s, observed through delays 0.2/0.05/0.5
+    for delay in (0.2, 0.05, 0.5):
+        tl.note_clock_sample(node, now + 5.0 - delay, local_wall=now)
+    off = tl.clock_offset(node)
+    assert 4.4 <= off <= 5.0 and off == pytest.approx(4.95, abs=0.01)
+    # a remote span at remote-wall `now + 5.0` must export at ~`now`
+    tl.ingest_remote(node, "worker-1",
+                     [["span", 1, "dag_step", "exec", 123, now + 5.0,
+                       0.002, None]])
+    ev = [e for e in tl.export() if e.get("cat") == "dag_step"][0]
+    assert abs(ev["ts"] / 1e6 - (now + 0.05)) < 0.2
+
+
+def test_remote_ingest_sanitized():
+    """A version-skewed pusher degrades to missing lanes, not an export
+    crash."""
+    tl.ingest_remote("aa" * 16, "w", [
+        "garbage", ["phase", 1], ["span", 2, "cat", "n", 1, "NaN?", "x"],
+        # len-7 span: shape-valid prefix but missing the args slot — must
+        # be rejected (export unpacks 8 fields; one admitted short entry
+        # would fail every later export)
+        ["span", 4, "cat", "n", 1, time.time(), 0.1],
+        ["span", 3, "ok_cat", "ok", 1, time.time(), 0.1, {"k": 1}],
+    ])
+    rows = [t for t in tl.remote_events() if t[0] == "aa" * 16]
+    assert len(rows) == 1 and rows[0][2][2] == "ok_cat"
+    tl.export()  # must not raise
+
+
+# ----------------------------------------------- satellite: stable lanes
+def test_timeline_stable_lanes_and_open_running_spans(session):
+    """Lane ids must be stable (not per-process hash-salted) and a task
+    whose terminal event was evicted surfaces as an open ph:'B' span
+    instead of silently vanishing."""
+    rt = get_runtime()
+    t0 = time.time()
+    a1, a2 = "aa" * 8, "bb" * 8
+    rt._task_events.extend([
+        {"task_id": "11" * 12, "name": "m1", "state": "RUNNING",
+         "ts": t0, "actor_id": a1},
+        {"task_id": "22" * 12, "name": "m2", "state": "RUNNING",
+         "ts": t0 + 0.01, "actor_id": a2},
+        {"task_id": "11" * 12, "name": "m1", "state": "FINISHED",
+         "ts": t0 + 0.02, "actor_id": a1},
+        # 22.. never gets a terminal event (evicted / still running)
+    ])
+    trace = rt_state.timeline()
+    done = [e for e in trace if e.get("cat") == "task" and e["name"] == "m1"]
+    open_spans = [e for e in trace
+                  if e.get("cat") == "task" and e.get("ph") == "B"
+                  and e["name"] == "m2"]
+    assert done and done[0]["ph"] == "X"
+    assert open_spans, "unpaired RUNNING must surface as an open span"
+    # stable lanes: sorted distinct actor keys -> 1..N, so the two actors
+    # get DIFFERENT deterministic lanes (sorted(a1, a2) order)
+    lane_m1, lane_m2 = done[0]["tid"], open_spans[0]["tid"]
+    assert lane_m1 != lane_m2
+    expected = {k: i + 1 for i, k in enumerate(sorted({a1, a2, "tasks"}
+                | {ev.get("actor_id") or "tasks"
+                   for ev in rt._task_events}))}
+    assert lane_m1 == expected[a1] and lane_m2 == expected[a2]
+
+
+# --------------------------------------------- satellite: series expiry
+def test_pushed_series_expire_after_silence(monkeypatch):
+    """A (node, src) that stops pushing for 3x the push period must drop
+    out of the scrape — a dead worker's gauges lingered forever before."""
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_PERIOD_S", "2")
+    node = "dead" + "00" * 14
+    rt_metrics.ingest_wire_snapshot(
+        node, [["tlp_exp_gauge", "gauge", [[[["k", "v"]], 3.0]]]], "w-1")
+    assert any(k[0] == node for k in rt_metrics.remote_snapshots())
+    assert f'node_id="{node}"' in rt_metrics.prometheus_text()
+    # silence: age the entry past 3x period
+    with rt_metrics._remote_lock:
+        rt_metrics._remote[(node, "w-1")]["ts"] -= 6.1
+    assert not any(k[0] == node for k in rt_metrics.remote_snapshots())
+    assert f'node_id="{node}"' not in rt_metrics.prometheus_text()
+
+
+# ------------------------------------------- satellite: label escaping
+def test_prometheus_label_escaping():
+    """Backslash / quote / newline in label values must escape per the
+    exposition spec — op names and node ids flow into labels from
+    user-visible strings."""
+    c = rt_metrics.Counter("tlp_esc_total", tag_keys=("op",))
+    hostile = 'evil"op\\name\nnewline'
+    c.inc(tags={"op": hostile})
+    text = rt_metrics.prometheus_text()
+    line = [ln for ln in text.splitlines() if ln.startswith("tlp_esc_total")][0]
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n" not in line  # the raw newline must not split the sample
+    # the system-text helper now routes through the same escaper
+    assert rt_metrics._fmt_labels([("state", 'a"b\nc')]) == \
+        '{state="a\\"b\\nc"}'
+
+
+# ------------------------------------------- satellite: flight crash dump
+def test_flight_dump_written_on_shutdown(tmp_path):
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    session_dir = get_runtime().session_dir
+    flight_recorder.record("tlp_dump", "marker_event", detail="survives")
+    ray_tpu.shutdown()
+    dump_path = os.path.join(session_dir, "flight_dump.json")
+    assert os.path.exists(dump_path), "shutdown must leave the post-mortem"
+    payload = json.load(open(dump_path))
+    assert any(e.get("subsystem") == "tlp_dump"
+               and e.get("event") == "marker_event"
+               for e in payload["events"])
+    # fatal-signal path writes the same artifact (handler invoked directly;
+    # a real SIGTERM would also terminate the test runner)
+    os.unlink(dump_path)
+    import signal as _signal
+
+    flight_recorder.install_crash_dump(session_dir)
+    try:
+        prev = flight_recorder._prev_handlers.get(_signal.SIGTERM)
+        flight_recorder._prev_handlers[_signal.SIGTERM] = _signal.SIG_IGN
+        flight_recorder._on_fatal_signal(_signal.SIGTERM, None)
+        assert os.path.exists(dump_path)
+        flight_recorder._prev_handlers[_signal.SIGTERM] = prev
+    finally:
+        flight_recorder.uninstall_crash_dump(final_dump=False)
+
+
+# ------------------------------------- satellite: node_io rate clamping
+def test_node_io_rate_clamped_across_worker_restart():
+    """A worker restart resets its counters; the next push's negative
+    delta must clamp to zero bandwidth, not report negative MB/s."""
+    node = "ee" * 16
+    metric = "ray_tpu_plane_pull_bytes_total"
+
+    def snap(total):
+        return [[metric, "counter", [[[], float(total)]]]]
+
+    rt_metrics.ingest_wire_snapshot(node, snap(50_000_000), "w-restart")
+    rt_metrics.ingest_wire_snapshot(node, snap(1_000_000), "w-restart")
+    assert rt_metrics.node_rates(metric).get(node, 0.0) == 0.0
+    roll = rt_metrics.node_io_rollup()
+    assert roll["pull_rate"].get(node, 0.0) == 0.0
+    assert roll["pull_total"][node] == pytest.approx(1_000_000)
+    rt_metrics.drop_remote_snapshot(node)
+
+
+# ------------------------------------------------ profiler: wire + sampler
+def test_profile_capture_version_gated():
+    """Mixed-version: profile_capture is since=8 — an old-wire connection
+    must refuse it outbound (the head checks negotiated_version first)."""
+    from ray_tpu.core import rpc
+    from ray_tpu.core.rpc import schema
+
+    spec = schema.get_op("profile_capture")
+    assert spec.since == 8 and spec.blocking
+    srv = rpc.RpcServer(handlers={"ping": lambda p, m: "pong"})
+    try:
+        old = rpc.connect(*srv.address, name="old-head", versions=(1, 7))
+        assert old.negotiated_version == 7
+        with pytest.raises(schema.WireVersionError):
+            old.call("profile_capture", pid=1, timeout=5)
+        old.close()
+    finally:
+        srv.close()
+
+
+def test_stack_sampler_reaches_lock_blocked_process():
+    """The profiler's core claim: a SIGUSR-triggered in-process sampler
+    captures a process whose MAIN THREAD is blocked in a lock — where a
+    remote-task capture provably cannot run."""
+    from ray_tpu.util import stack_sampler
+
+    code = textwrap.dedent("""
+        import threading
+        from ray_tpu.util import stack_sampler
+        assert stack_sampler.install()
+        lock = threading.Lock()
+        lock.acquire()
+        def wedged_in_lock():
+            lock.acquire()   # never released: blocks forever
+        print("ready", flush=True)
+        wedged_in_lock()
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        time.sleep(0.2)  # let the main thread actually park in acquire()
+        blob = stack_sampler.capture_out_of_band(proc.pid, duration_s=0.5,
+                                                 samples=10)
+        art = json.loads(blob)
+        assert art["pid"] == proc.pid and art["samples"] >= 1
+        main_stacks = art["collapsed"].get("MainThread", {})
+        assert any("wedged_in_lock" in s for s in main_stacks), (
+            "the sampler must name the blocking frame; got "
+            f"{list(main_stacks)[:3]}")
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# ----------------------------------------------- acceptance: live 2-node
+def test_out_of_band_capture_of_hung_worker_2node():
+    """Acceptance: a worker deliberately wedged in a lock on a REAL
+    isolated-plane node is captured out-of-band — the agent's sampler
+    returns the blocking frame, the artifact is sealed to the plane and
+    pulled at the head via the zero-copy pull path."""
+    os.environ["RAY_TPU_METRICS_PUSH_PERIOD_S"] = "0.5"
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        nid = cluster.add_node(num_cpus=2, real_process=True,
+                               isolated_plane=True)
+
+        @ray_tpu.remote(scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id=nid.hex(), soft=False))
+        def wedged_in_lock():
+            import threading
+
+            lock = threading.Lock()
+            lock.acquire()
+            lock.acquire()  # blocks the worker's executor forever
+
+        ref = wedged_in_lock.remote()  # noqa: F841 — never resolved, by design
+        rt = get_runtime()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            tasks = [t for t in rt.list_tasks()
+                     if t["name"] == "wedged_in_lock"
+                     and t["state"] == "RUNNING"]
+            if tasks:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("hung task never reached RUNNING on the agent")
+        time.sleep(0.5)  # let the worker actually park in the lock
+
+        m = rt_metrics.get_metric("ray_tpu_plane_pull_bytes_total")
+        pulls_before = sum(m.snapshot().values()) if m else 0.0
+        res = rt.profile_worker(nid, pid=0, duration_s=0.5, samples=10)
+        assert res["transport"] == "plane", (
+            "artifact must be sealed to the plane and pulled, "
+            f"got {res['transport']}")
+        art = json.loads(res["blob"])
+        assert art["samples"] >= 1
+        main_stacks = art["collapsed"].get("MainThread", {})
+        assert any("wedged_in_lock" in s for s in main_stacks), (
+            f"blocking frame missing; stacks: {list(main_stacks)[:3]}")
+        pulls_after = sum(rt_metrics.get_metric(
+            "ray_tpu_plane_pull_bytes_total").snapshot().values())
+        assert pulls_after > pulls_before, (
+            "the head must land the artifact over the plane pull path")
+        # the capture is flight-recorded for the session post-mortem
+        assert any(e["event"] == "stack_capture"
+                   for e in flight_recorder.records("profile"))
+        # a pid that is NOT a pool worker must be refused, never signalled
+        # (SIGUSR2 to a handler-less process would terminate it)
+        with pytest.raises(Exception, match="not a live worker"):
+            rt.profile_worker(nid, pid=999_999_999, duration_s=0.2)
+    finally:
+        cluster.shutdown()
+        os.environ.pop("RAY_TPU_METRICS_PUSH_PERIOD_S", None)
+
+
+def test_timeline_one_trace_live_2node(tmp_path):
+    """Acceptance: a live 2-node session exports ONE Perfetto-loadable
+    trace containing >= 6 distinct event categories, cross-node events
+    offset-aligned, and submit->exec flow arrows present."""
+    os.environ["RAY_TPU_METRICS_PUSH_PERIOD_S"] = "0.4"
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+    from ray_tpu.util import tracing
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    compiled = None
+    try:
+        tracing.enable_tracing()
+        nid = cluster.add_node(num_cpus=2, real_process=True,
+                               isolated_plane=True)
+
+        @ray_tpu.remote(scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id=nid.hex(), soft=False))
+        def make():
+            import numpy as np
+
+            return np.arange(1_000_000)  # ~8 MB sealed on the agent node
+
+        @ray_tpu.remote
+        def bump(x):
+            return x + 1
+
+        arr = ray_tpu.get(make.remote(), timeout=180)  # head pulls -> plane_pull
+        assert arr.shape == (1_000_000,)
+        assert ray_tpu.get([bump.remote(i) for i in range(3)],
+                           timeout=120) == [1, 2, 3]
+
+        @ray_tpu.remote
+        class Stage:
+            def __init__(self, k):
+                self.k = k
+
+            def proc(self, x):
+                return x + self.k
+
+        s1, s2 = Stage.remote(1), Stage.remote(10)
+        with InputNode() as inp:
+            dag = s2.proc.bind(s1.proc.bind(inp))
+        compiled = dag.experimental_compile()
+        assert compiled.execute(0).get(timeout=60) == 11  # -> dag_step span
+
+        flight_recorder.record("timeline_test", "marker")
+
+        # wait for the agent's pushes to land the remote task-phase lane
+        agent_phase = []
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            agent_phase = [t for t in tl.remote_events()
+                           if t[0] == nid.hex() and t[2][0] == "phase"]
+            if agent_phase:
+                break
+            time.sleep(0.25)
+        assert agent_phase, "agent-node worker phases never reached the head"
+
+        out = tmp_path / "session_trace.json"
+        trace = rt_state.timeline(str(out))
+        # the artifact is ONE JSON trace file Perfetto/chrome loads
+        loaded = json.load(open(out))
+        assert isinstance(loaded, list) and len(loaded) == len(trace)
+        cats = {e.get("cat") for e in trace}
+        required = {"task", "task_phase", "span", "dag_step", "plane_pull",
+                    "flight"}
+        assert required <= cats, f"missing categories: {required - cats}"
+
+        # submit -> exec flow arrows: s/f pairs joined by task id
+        s_ids = {e["id"] for e in trace
+                 if e.get("cat") == "flow" and e.get("ph") == "s"}
+        f_ids = {e["id"] for e in trace
+                 if e.get("cat") == "flow" and e.get("ph") == "f"}
+        assert s_ids & f_ids, "no complete submit->exec flow arrow"
+
+        # offset alignment: the agent-node exec window must sit inside the
+        # head-observed RUNNING..FINISHED window (± slack) after re-basing
+        head_make = [e for e in trace if e.get("cat") == "task"
+                     and e["name"] == "make" and e.get("ph") == "X"]
+        assert head_make
+        hm = head_make[0]
+        short = hm["args"]["task_id"][:12]
+        agent_lanes = {e["pid"] for e in trace
+                       if e.get("cat") == "task_phase"
+                       and e["args"].get("node") == nid.hex()}
+        assert agent_lanes and all(p >= 10 for p in agent_lanes), (
+            "agent phases must render on their own node lane")
+        execs = [e for e in trace if e.get("cat") == "task_phase"
+                 and e["name"] == f"exec:{short}"]
+        assert execs, "no worker exec window for the cross-node task"
+        slack = 2_000_000  # ±2 s in us: same-box clocks, scheduler slop
+        assert hm["ts"] - slack <= execs[0]["ts"] <= \
+            hm["ts"] + hm["dur"] + slack
+    finally:
+        if compiled is not None:
+            try:
+                compiled.teardown()
+            except Exception:
+                pass
+        tracing.disable_tracing()
+        tracing.clear()
+        cluster.shutdown()
+        os.environ.pop("RAY_TPU_METRICS_PUSH_PERIOD_S", None)
+
+
+# --------------------------------------------------------- dashboard route
+def test_dashboard_timeline_endpoint(session):
+    import urllib.request
+
+    from ray_tpu.dashboard.head import Dashboard
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    dash = Dashboard(port=8276)
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:8276/api/v0/timeline", timeout=30) as r:
+            trace = json.load(r)
+        assert isinstance(trace, list) and trace
+        assert any(e.get("cat") == "task" for e in trace)
+    finally:
+        dash.stop()
