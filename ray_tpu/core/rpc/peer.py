@@ -259,7 +259,9 @@ class RpcPeer:
     def _send_raw(self, frame: bytes) -> None:
         try:
             with self._wlock:
-                self._sock.sendall(frame)
+                # _wlock exists to serialize whole frames onto one socket:
+                # blocking inside it IS the design (frame atomicity)
+                self._sock.sendall(frame)  # graftlint: disable=blocking-under-lock
         except OSError as e:
             self._fail(PeerDisconnected(f"send to {self.name} failed: {e}"))
             raise PeerDisconnected(str(e)) from e
@@ -279,7 +281,8 @@ class RpcPeer:
         total = sum(len(b) for b in bufs0)
         try:
             with self._wlock:
-                sent = self._sock.sendmsg(bufs0)
+                # frame-atomicity lock, as in _send_raw: blocking is the point
+                sent = self._sock.sendmsg(bufs0)  # graftlint: disable=blocking-under-lock
                 while sent < total:  # short write: resend the remainder,
                     #                  still by reference (sliced views)
                     rem, skipped = [], 0
@@ -290,7 +293,7 @@ class RpcPeer:
                         off = sent - skipped  # <= 0 for buffers fully unsent
                         rem.append(b[off:] if off > 0 else b)
                         skipped += len(b)
-                    sent += self._sock.sendmsg(rem)
+                    sent += self._sock.sendmsg(rem)  # graftlint: disable=blocking-under-lock
         except OSError as e:
             self._fail(PeerDisconnected(f"send to {self.name} failed: {e}"))
             raise PeerDisconnected(str(e)) from e

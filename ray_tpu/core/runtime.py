@@ -1141,9 +1141,16 @@ class Runtime:
                         # Permanently lost (no lineage, e.g. a freed put): fail the task
                         # terminally — drop the returns' lineage so get() raises instead
                         # of re-entering recovery forever.
+                        dropped = []
                         with self._lock:
                             for rid in spec.return_ids():
-                                self._lineage.pop(rid, None)
+                                dropped.append(self._lineage.pop(rid, None))
+                        # the popped specs can hold the last ObjectRef to a
+                        # task arg; its __del__ -> _on_ref_zero ->
+                        # _free_plane_copies re-takes self._lock, so the
+                        # specs must die AFTER release (graftlint
+                        # ref-drop-under-lock, the PR-5 deadlock class)
+                        del dropped
                         self._store_error(spec, ObjectLostError(oid.hex()))
                         return "FAILED"
                 return "WAITING"

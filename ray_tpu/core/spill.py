@@ -22,6 +22,7 @@ from collections import OrderedDict
 from typing import TYPE_CHECKING, Optional
 
 from ray_tpu._private.ids import ObjectID
+from ray_tpu.util import flight_recorder
 
 if TYPE_CHECKING:
     from ray_tpu.core.shm_store import SharedMemoryStore
@@ -150,8 +151,15 @@ class SpillManager:
                 view = None
                 try:
                     view = self._store.create_for_write(oid, size)
-                except Exception:
-                    view = None  # store under pressure: serve the file copy
+                except Exception as e:
+                    # store under pressure: serve the file copy — but leave
+                    # evidence, a non-pressure failure here silently turns
+                    # every restore into a file read (graftlint
+                    # swallowed-exception)
+                    view = None
+                    flight_recorder.record(
+                        "spill", "restore_reseat_failed", oid=oid.hex(),
+                        error=repr(e))
                 if view is not None:
                     ok = False
                     try:

@@ -1,0 +1,2 @@
+"""Developer tooling that ships with the runtime but never imports it at
+module scope — ``devtools`` must be importable in a bare checkout."""

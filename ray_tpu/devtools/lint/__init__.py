@@ -1,0 +1,39 @@
+"""graftlint: the project-native static analyzer.
+
+Pluggable AST rules over the ray_tpu tree — wire-schema contracts
+(migrated from scripts/check_wire_schemas.py), hot-path purity, and the
+concurrency/invariant pass (lock-order graph, ref-drop-under-lock,
+blocking-under-lock, thread + exception hygiene).
+
+Run it: ``python -m ray_tpu.devtools.lint`` (or the ``graftlint``
+console script). Per-line suppression: ``# graftlint: disable=<rule>``.
+Pre-existing debt is frozen in ``scripts/lint_baseline.json`` —
+append-only, integrity-hashed (see baseline.py).
+"""
+
+from ray_tpu.devtools.lint.core import (  # noqa: F401
+    RULES, FileCtx, Finding, ProjectCtx, Suppressions, file_rule,
+    project_rule)
+from ray_tpu.devtools.lint.runner import run_pass, main  # noqa: F401
+
+
+def lint_source(source: str, rules, rel: str = "fixture.py",
+                root: str = "."):
+    """Run a subset of FILE rules over one in-memory source string —
+    the fixture-test entry point (tests/test_lint.py)."""
+    import ast as _ast
+
+    import ray_tpu.devtools.lint.rules  # noqa: F401  (self-register)
+
+    ctx = FileCtx(root, rel, source, _ast.parse(source, filename=rel))
+    sup = Suppressions(source)
+    out = []
+    for name in rules:
+        rule = RULES[name]
+        if rule.kind != "file":
+            raise ValueError(f"{name} is a project rule; lint_source only "
+                             "drives file rules")
+        for f in rule.fn(ctx):
+            if not sup.is_suppressed(f.rule, f.line):
+                out.append(f)
+    return sorted(out, key=lambda f: (f.line, f.rule))

@@ -1,0 +1,3 @@
+from ray_tpu.devtools.lint.runner import main
+
+raise SystemExit(main())

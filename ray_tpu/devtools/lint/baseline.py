@@ -1,0 +1,127 @@
+"""The frozen, append-only findings baseline (scripts/lint_baseline.json).
+
+When a NEW rule class lands, the tree usually already violates it in a
+few reviewed-and-tolerated places. Those findings are frozen here so the
+analyzer exits 0 on the shipped tree while every *new* violation fails
+the build — the schema-baseline idea applied to findings.
+
+Integrity is machine-checked, not convention: every entry carries a
+dense sequential ``id`` and a self-hash over ``id|rule|path|key``.
+Appending a well-formed entry is legal; editing, deleting, or
+renumbering a shipped entry breaks the hash chain (each entry's hash
+also folds in the previous entry's hash) and fails the pass. Paying down
+debt is done by DELETING nothing: when the finding disappears from the
+tree the entry simply goes stale, and stale entries are reported so they
+can be retired in an explicit ``--prune-baseline`` commit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+
+BASELINE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    id: int
+    rule: str
+    path: str
+    key: str
+    sha: str
+
+
+def entry_sha(eid: int, rule: str, path: str, key: str,
+              prev_sha: str) -> str:
+    blob = f"{prev_sha}|{eid}|{rule}|{path}|{key}".encode()
+    return hashlib.sha1(blob).hexdigest()[:12]
+
+
+def validate(doc: dict) -> list:
+    """Structural + append-only integrity errors for a baseline document."""
+    errors = []
+    if not isinstance(doc, dict) or doc.get("version") != BASELINE_VERSION:
+        return [f"baseline: unknown version {doc.get('version')!r} "
+                f"(expected {BASELINE_VERSION})"]
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        return ["baseline: 'entries' must be a list"]
+    prev_sha = ""
+    for i, e in enumerate(entries):
+        want_id = i + 1
+        if not isinstance(e, dict) or \
+                sorted(e) != ["id", "key", "path", "rule", "sha"]:
+            errors.append(f"baseline entry #{want_id}: malformed "
+                          "(need exactly id/rule/path/key/sha)")
+            continue
+        if e["id"] != want_id:
+            errors.append(
+                f"baseline entry #{want_id}: id={e['id']} — entries are "
+                "append-only with dense ids; renumbering or deleting a "
+                "shipped entry is rejected")
+        want = entry_sha(e["id"], e["rule"], e["path"], e["key"], prev_sha)
+        if e["sha"] != want:
+            errors.append(
+                f"baseline entry #{e['id']} ({e['rule']}:{e['key']}): "
+                "hash mismatch — shipped entries must not be edited "
+                "(append a new entry instead)")
+        prev_sha = e["sha"]
+    return errors
+
+
+def load(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"version": BASELINE_VERSION, "entries": []}
+    with open(path) as f:
+        return json.load(f)
+
+
+def entries(doc: dict):
+    return [BaselineEntry(**e) for e in doc.get("entries", [])
+            if isinstance(e, dict) and
+            sorted(e) == ["id", "key", "path", "rule", "sha"]]
+
+
+def match_key(entry_list) -> set:
+    """The set of (rule, path, key) triples the baseline tolerates. One
+    entry matches every finding with that triple — keys carry the
+    qualname context, so that is 'this known pattern at this site', not a
+    blank cheque for the file."""
+    return {(e.rule, e.path, e.key) for e in entry_list}
+
+
+def append_entries(doc: dict, findings) -> dict:
+    """Return a new document with entries appended for every finding
+    triple not already present (deduplicated, deterministic order)."""
+    ents = list(doc.get("entries", []))
+    known = {(e["rule"], e["path"], e["key"]) for e in ents}
+    prev_sha = ents[-1]["sha"] if ents else ""
+    new_triples = sorted({(f.rule, f.path, f.key) for f in findings
+                          if (f.rule, f.path, f.key) not in known})
+    for rule, path, key in new_triples:
+        eid = len(ents) + 1
+        sha = entry_sha(eid, rule, path, key, prev_sha)
+        ents.append({"id": eid, "rule": rule, "path": path, "key": key,
+                     "sha": sha})
+        prev_sha = sha
+    return {"version": BASELINE_VERSION, "entries": ents}
+
+
+def rebuild(findings) -> dict:
+    """A fresh baseline from scratch (``--prune-baseline``): the explicit,
+    reviewed act that retires stale entries."""
+    return append_entries({"version": BASELINE_VERSION, "entries": []},
+                          findings)
+
+
+def save(doc: dict, path: str) -> None:
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)

@@ -1,0 +1,234 @@
+"""graftlint core: findings, the rule registry, and suppression parsing.
+
+The framework is deliberately small:
+
+- a **rule** is a named function. *File rules* run once per parsed source
+  file whose repo-relative path matches the rule's scope globs; *project
+  rules* run once per pass with the whole parsed tree available (that is
+  where cross-file contracts — schema registry, handler tables — live).
+- a **finding** carries a repo-relative path, a line, a message, and a
+  stable ``key`` (NO line numbers in the key) so the findings baseline
+  survives unrelated edits to the file.
+- suppression is per-line and per-rule: ``# graftlint: disable=<rule>``
+  on the offending line (or alone on the line above it) silences that
+  rule there; ``# graftlint: disable-file=<rule>`` anywhere silences the
+  rule for the whole file. Suppressions are for reviewed, justified
+  exceptions — pre-existing debt belongs in the frozen baseline instead
+  (scripts/lint_baseline.json, see baseline.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+# --------------------------------------------------------------- findings
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str      # repo-relative, forward slashes
+    line: int      # 1-based; 0 = whole-file / registry-level
+    message: str
+    key: str       # stable fingerprint: qualname/detail, never a line number
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+# ----------------------------------------------------------------- contexts
+
+
+class FileCtx:
+    """One parsed source file handed to file rules."""
+
+    def __init__(self, root: str, rel: str, source: str, tree: ast.AST):
+        self.root = root
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+
+    def finding(self, rule: str, node, message: str, key: str) -> Finding:
+        line = getattr(node, "lineno", 0) if node is not None else 0
+        return Finding(rule=rule, path=self.rel, line=line,
+                       message=message, key=key)
+
+
+class ProjectCtx:
+    """The whole tree, for project rules. Files are parsed up front (in
+    parallel, by the runner) and exposed by repo-relative path."""
+
+    def __init__(self, root: str, files: "dict[str, FileCtx]"):
+        self.root = root
+        self.files = files
+
+    def get(self, rel: str) -> "FileCtx | None":
+        return self.files.get(rel.replace(os.sep, "/"))
+
+    def finding(self, rule: str, rel: str, line: int, message: str,
+                key: str) -> Finding:
+        return Finding(rule=rule, path=rel.replace(os.sep, "/"), line=line,
+                       message=message, key=key)
+
+
+# ------------------------------------------------------------ rule registry
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    kind: str                      # "file" | "project"
+    scope: tuple                   # glob patterns (file rules only)
+    fn: Callable = field(compare=False)
+
+
+RULES: "dict[str, Rule]" = {}
+
+
+def _register(rule: Rule) -> None:
+    if rule.name in RULES:
+        raise ValueError(f"duplicate lint rule {rule.name!r}")
+    if not re.fullmatch(r"[a-z0-9][a-z0-9\-]*", rule.name):
+        raise ValueError(f"rule name {rule.name!r} must be kebab-case")
+    RULES[rule.name] = rule
+
+
+def file_rule(name: str, scope: Iterable[str] = ("ray_tpu/**/*.py",),
+              doc: str = ""):
+    """Register ``fn(ctx: FileCtx) -> list[Finding]`` to run on every file
+    matching ``scope`` (repo-relative glob patterns)."""
+
+    def deco(fn):
+        _register(Rule(name=name, doc=doc or (fn.__doc__ or "").strip(),
+                       kind="file", scope=tuple(scope), fn=fn))
+        return fn
+
+    return deco
+
+
+def project_rule(name: str, doc: str = ""):
+    """Register ``fn(ctx: ProjectCtx) -> list[Finding]`` to run once per
+    pass."""
+
+    def deco(fn):
+        _register(Rule(name=name, doc=doc or (fn.__doc__ or "").strip(),
+                       kind="project", scope=(), fn=fn))
+        return fn
+
+    return deco
+
+
+def scope_match(rel: str, patterns: Iterable[str]) -> bool:
+    rel = rel.replace(os.sep, "/")
+    for pat in patterns:
+        if fnmatch.fnmatch(rel, pat):
+            return True
+        # make "pkg/**/*.py" also match "pkg/top.py" (fnmatch's ** does not
+        # collapse to zero directories)
+        if "/**/" in pat and fnmatch.fnmatch(rel, pat.replace("/**/", "/")):
+            return True
+    return False
+
+
+# ------------------------------------------------------------- suppressions
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+class Suppressions:
+    """Per-file suppression table parsed from ``# graftlint:`` comments."""
+
+    def __init__(self, source: str):
+        self.by_line: "dict[int, set]" = {}
+        self.whole_file: set = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            names = {r.strip() for r in m.group("rules").split(",")}
+            if m.group("file"):
+                self.whole_file |= names
+            else:
+                self.by_line.setdefault(i, set()).update(names)
+                if text.lstrip().startswith("#"):
+                    # a comment-only suppression line covers the next line
+                    self.by_line.setdefault(i + 1, set()).update(names)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.whole_file:
+            return True
+        return rule in self.by_line.get(line, ())
+
+
+# ------------------------------------------------------------ AST utilities
+# Shared helpers used by several rules (migrated from the original
+# scripts/check_wire_schemas.py implementations).
+
+
+def callee_name(node: ast.Call) -> "str | None":
+    """The bare callee name: matches both ``packb(...)`` and
+    ``msgpack.packb(...)``."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def calls_in(fn: ast.AST, names) -> list:
+    """(lineno, name) for every call inside ``fn`` whose callee name/attr
+    is in ``names``."""
+    hits = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = callee_name(node)
+            if name in names:
+                hits.append((node.lineno, name))
+    return hits
+
+
+def find_funcs(tree: ast.AST, wanted) -> dict:
+    return {node.name: node for node in ast.walk(tree)
+            if isinstance(node, ast.FunctionDef) and node.name in wanted}
+
+
+def imported_modules(tree: ast.AST):
+    """(lineno, module) for every import in the module."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            out.extend((node.lineno, a.name) for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            out.append((node.lineno, node.module or ""))
+    return out
+
+
+def qualname_index(tree: ast.AST) -> dict:
+    """id(func_node) -> dotted qualname (Class.method or function) — the
+    line-stable context used in finding keys."""
+    out: dict = {}
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out[id(child)] = q
+                visit(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out

@@ -1,0 +1,6 @@
+"""Rule modules self-register on import. To add a rule: write a
+``@file_rule``/``@project_rule`` function in one of these modules (or a
+new one imported here) and add fixtures to tests/test_lint.py."""
+
+from ray_tpu.devtools.lint.rules import (  # noqa: F401
+    concurrency, exceptions, hotpath, wire)

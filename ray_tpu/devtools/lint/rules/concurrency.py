@@ -1,0 +1,613 @@
+"""The concurrency pass: lock-graph + blocking + thread hygiene.
+
+This is the rule family the runtime has been missing — its hardest
+shipped bugs were all concurrency-invariant violations caught late:
+
+- the ObjectRef ``__del__``-under-``runtime._lock`` re-entrancy deadlock
+  (PR 5): a container holding the last ObjectRef was popped/dropped
+  while the non-reentrant lock was held; the ref's ``__del__`` ran
+  ``_on_ref_zero -> _free_plane_copies`` which re-takes the same lock.
+  → ``ref-drop-under-lock``
+- blocking work parked on shared bounded-reactor slots (PR 7 review)
+  → ``blocking-under-lock`` + ``reactor-blocking-handler``
+- leaked gang/member threads (PR 10 review)
+  → ``thread-hygiene``
+
+Analysis model (per module): lock objects are recognized at their
+construction sites (``self.X = threading.Lock()`` in any method;
+``X = threading.Lock()`` at module scope — Lock/RLock/Condition/
+Semaphore/Event). ``with`` regions over known locks are walked with the
+held-set threaded through, nested function bodies excluded (deferred
+execution). Cross-method edges come from ``self.method()`` calls under a
+held lock joined against each method's transitively-acquired lock set.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ray_tpu.devtools.lint.core import (
+    FileCtx, ProjectCtx, callee_name, file_rule, project_rule,
+    qualname_index)
+
+LOCK_FACTORIES = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+    "Semaphore": "semaphore", "BoundedSemaphore": "semaphore",
+    "Event": "event",
+}
+# kinds that guard a `with` region (Event is tracked only as a wait target)
+REGION_KINDS = {"lock", "rlock", "condition", "semaphore"}
+NON_REENTRANT = {"lock"}
+
+
+def _lock_kind(node) -> "str | None":
+    """threading.Lock() / Lock() / threading.Condition(...) -> kind."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "threading":
+        return LOCK_FACTORIES.get(f.attr)
+    if isinstance(f, ast.Name):
+        return LOCK_FACTORIES.get(f.id)
+    return None
+
+
+def _recv_key(expr) -> "str | None":
+    """A stable name for a call receiver: ``self.X`` -> "self.X",
+    ``name`` -> "name", ``a.b.c`` -> "c" (tail)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return f"self.{expr.attr}"
+        return expr.attr
+    return None
+
+
+@dataclass(frozen=True)
+class Lock:
+    name: str    # "self._lock" or module-level "_runtime_lock"
+    kind: str
+
+
+def module_locks(tree: ast.Module) -> dict:
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            kind = _lock_kind(node.value)
+            if kind:
+                out[node.targets[0].id] = kind
+    return out
+
+
+def class_locks(cls: ast.ClassDef) -> dict:
+    """{attr: kind} for every ``self.X = threading.Lock()``-style assign
+    anywhere in the class's methods."""
+    out = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                kind = _lock_kind(node.value)
+                if kind:
+                    out[t.attr] = kind
+    return out
+
+
+@dataclass
+class MethodScan:
+    """Everything the walker saw in one function body."""
+    name: str
+    qualname: str
+    acquisitions: list = field(default_factory=list)  # (Lock, node, held)
+    self_calls: list = field(default_factory=list)    # (method, node, held)
+    ref_drops: list = field(default_factory=list)     # (node, detail, Lock)
+    blocking: list = field(default_factory=list)      # (node, callee, Lock)
+
+
+# Calls that park the calling thread: never under a lock, never on a
+# shared reactor slot. `.join` gets str/os.path exclusions; `.wait`/
+# `.notify*` are excused when the receiver is a known Condition (the CV
+# protocol releases the lock while parked).
+ALWAYS_BLOCKING = {
+    "result", "recv", "recv_into", "recv_bytes", "recvmsg", "sendall",
+    "sendmsg", "accept", "connect", "sleep", "select", "call",
+    "pull", "pull_into", "pull_into_or_pull",
+}
+_JOIN_EXEMPT_RECV = {"os", "posixpath", "ntpath", "shlex", "string", "path",
+                     "sep"}
+
+
+def _classify_blocking(call: ast.Call, known_conditions: set) -> "str | None":
+    """Return the blocking-callee label, or None if benign."""
+    name = callee_name(call)
+    if name is None:
+        return None
+    recv = call.func.value if isinstance(call.func, ast.Attribute) else None
+    if name in ALWAYS_BLOCKING:
+        return name
+    if name == "join":
+        if recv is None:
+            return None           # bare join() — not a thread join
+        if isinstance(recv, ast.Constant):
+            return None           # ", ".join(...)
+        rk = _recv_key(recv)
+        if rk in _JOIN_EXEMPT_RECV or \
+                (isinstance(recv, ast.Attribute) and recv.attr == "path"):
+            return None           # os.path.join and friends
+        return name
+    if name in ("wait", "wait_for", "notify", "notify_all"):
+        rk = _recv_key(recv) if recv is not None else None
+        if rk is not None and rk in known_conditions:
+            return None           # condition-variable protocol
+        if name in ("notify", "notify_all") and recv is None:
+            return None
+        return name
+    return None
+
+
+class _FuncWalker:
+    """Walk one function body threading the held-lock set through
+    ``with`` regions. Nested function/lambda bodies are skipped — they
+    run later, not under this lock."""
+
+    def __init__(self, scan: MethodScan, resolve, known_conditions: set):
+        self.scan = scan
+        self.resolve = resolve            # expr -> Lock | None
+        self.known_conditions = known_conditions
+
+    def walk(self, body, held=()):
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in node.items:
+                self._expr(item.context_expr, tuple(new_held))
+                lk = self.resolve(item.context_expr)
+                if lk is not None and lk.kind in REGION_KINDS:
+                    self.scan.acquisitions.append(
+                        (lk, item.context_expr, tuple(new_held)))
+                    new_held.append(lk)
+            self.walk(node.body, tuple(new_held))
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                self._ref_drop(node, f"del {ast.unparse(tgt)}", held)
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in ("pop", "popitem", "clear"):
+                recv = _recv_key(call.func.value)
+                if recv is not None:
+                    self._ref_drop(
+                        node, f"discarded {recv}.{call.func.attr}()", held)
+        # expressions of this statement (and child statements, via fields)
+        for fname, value in ast.iter_fields(node):
+            if fname in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            for sub in (value if isinstance(value, list) else [value]):
+                if isinstance(sub, ast.AST):
+                    self._expr(sub, held)
+        for fname in ("body", "orelse", "finalbody"):
+            self.walk(getattr(node, fname, []) or [], held)
+        for h in getattr(node, "handlers", []) or []:
+            self.walk(h.body, held)
+
+    def _ref_drop(self, node, detail, held):
+        for lk in held:
+            if lk.kind in NON_REENTRANT:
+                self.scan.ref_drops.append((node, detail, lk))
+                return
+
+    def _expr(self, node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # deferred execution — not under this lock
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, held)
+
+    def _call(self, sub: ast.Call, held):
+        # lock.acquire() participates in ordering like a with-region
+        if isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "acquire":
+            lk = self.resolve(sub.func.value)
+            if lk is not None:
+                self.scan.acquisitions.append((lk, sub, tuple(held)))
+                return
+        # self.method() — cross-method lock edges
+        if isinstance(sub.func, ast.Attribute) and \
+                isinstance(sub.func.value, ast.Name) and \
+                sub.func.value.id == "self":
+            self.scan.self_calls.append((sub.func.attr, sub, tuple(held)))
+        if held:
+            label = _classify_blocking(sub, self.known_conditions)
+            if label is not None:
+                self.scan.blocking.append((sub, label, held[-1]))
+
+
+def _scan_scope(methods, locks: dict, qualnames: dict, prefix: str):
+    """Scan a set of functions sharing one lock namespace (a class's
+    methods, or a module's top-level functions)."""
+    known_conditions = {name for name, kind in locks.items()
+                        if kind == "condition"}
+
+    def resolve(expr):
+        rk = _recv_key(expr)
+        if rk is None:
+            return None
+        kind = locks.get(rk)
+        return Lock(rk, kind) if kind else None
+
+    scans = {}
+    for fn in methods:
+        scan = MethodScan(fn.name, qualnames.get(id(fn), fn.name))
+        _FuncWalker(scan, resolve, known_conditions).walk(fn.body)
+        scans[fn.name] = scan
+    return scans
+
+
+def _transitive_locks(scans: dict) -> dict:
+    """method -> set of lock names it may acquire, following self-calls."""
+    direct = {m: {lk.name for lk, _, _ in s.acquisitions}
+              for m, s in scans.items()}
+    callees = {m: {c for c, _, _ in s.self_calls} for m, s in scans.items()}
+    closure = {m: set(v) for m, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for m in closure:
+            for c in callees[m]:
+                extra = closure.get(c, set()) - closure[m]
+                if extra:
+                    closure[m] |= extra
+                    changed = True
+    return closure
+
+
+def _find_cycles(edges: dict) -> list:
+    """Simple SCC-ish cycle listing over {a: {b: site}} adjacency: every
+    distinct cycle's canonical node tuple, with one witness site."""
+    cycles = {}
+
+    def dfs(start, node, path, sites):
+        for nxt, site in sorted(edges.get(node, {}).items()):
+            if nxt == start and len(path) > 1:
+                lo = path.index(min(path))
+                canon = tuple(path[lo:] + path[:lo])
+                cycles.setdefault(canon, sites + [site])
+            elif nxt not in path and nxt > start:
+                # only explore nodes >= start: each cycle found once, from
+                # its smallest node
+                dfs(start, nxt, path + [nxt], sites + [site])
+
+    for n in sorted(edges):
+        dfs(n, n, [n], [])
+    return sorted(cycles.items())
+
+
+def _concurrency_scans(ctx: FileCtx):
+    """Per-scope MethodScans for a file: one scope per class + one for
+    module-level functions. Module-level locks are visible inside classes
+    too (``with _runtime_lock:`` in a method)."""
+    qualnames = qualname_index(ctx.tree)
+    mod_locks = module_locks(ctx.tree)
+    scopes = []
+    top_funcs = [n for n in ctx.tree.body if isinstance(n, ast.FunctionDef)]
+    if top_funcs:
+        scopes.append(("", _scan_scope(top_funcs, dict(mod_locks),
+                                       qualnames, "")))
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        locks = dict(mod_locks)
+        locks.update({f"self.{a}": k
+                      for a, k in class_locks(node).items()})
+        methods = [n for n in node.body if isinstance(n, ast.FunctionDef)]
+        if methods:
+            scopes.append((node.name,
+                           _scan_scope(methods, locks, qualnames,
+                                       node.name + ".")))
+    return scopes
+
+
+@file_rule("lock-order",
+           doc="lock-acquisition-order graph per class: flags cycles "
+               "(ABBA deadlocks) and re-entrant acquisition of a "
+               "non-reentrant lock across method calls")
+def lock_order_findings(ctx: FileCtx) -> list:
+    out = []
+    for scope_name, scans in _concurrency_scans(ctx):
+        closure = _transitive_locks(scans)
+        lock_kinds = {}
+        # edges: held -> acquired, with a witness (qualname, line)
+        edges: dict = {}
+        for m, scan in scans.items():
+            for lk, node, held in scan.acquisitions:
+                lock_kinds[lk.name] = lk.kind
+                for h in held:
+                    if h.name == lk.name and lk.kind in NON_REENTRANT:
+                        out.append(ctx.finding(
+                            "lock-order", node,
+                            f"{scan.qualname} re-acquires non-reentrant "
+                            f"{lk.name} already held — guaranteed "
+                            "self-deadlock on this path",
+                            f"{scan.qualname}:reacquire:{lk.name}"))
+                    elif h.name != lk.name:
+                        edges.setdefault(h.name, {}).setdefault(
+                            lk.name, (scan.qualname, node.lineno))
+            for callee, node, held in scan.self_calls:
+                if not held or callee not in closure:
+                    continue
+                for h in held:
+                    for t in sorted(closure[callee]):
+                        if t == h.name:
+                            if h.kind in NON_REENTRANT:
+                                out.append(ctx.finding(
+                                    "lock-order", node,
+                                    f"{scan.qualname} holds non-reentrant "
+                                    f"{h.name} while calling "
+                                    f"self.{callee}(), which can acquire "
+                                    f"{h.name} again — self-deadlock",
+                                    f"{scan.qualname}:reacquire-via:"
+                                    f"{callee}:{h.name}"))
+                        else:
+                            edges.setdefault(h.name, {}).setdefault(
+                                t, (scan.qualname, node.lineno))
+        for canon, sites in _find_cycles(
+                {a: {b: s for b, s in bs.items()}
+                 for a, bs in edges.items()}):
+            qn, line = sites[0] if sites else (scope_name, 0)
+            order = " -> ".join(canon + (canon[0],))
+            out.append(Finding_for_cycle(ctx, scope_name, order, canon,
+                                         line))
+    return out
+
+
+def Finding_for_cycle(ctx, scope_name, order, canon, line):
+    from ray_tpu.devtools.lint.core import Finding
+
+    return Finding(
+        rule="lock-order", path=ctx.rel, line=line,
+        message=f"lock-order cycle in {scope_name or 'module'}: {order} — "
+                "two threads entering from different ends deadlock",
+        key=f"{scope_name}:cycle:{'|'.join(sorted(canon))}")
+
+
+@file_rule("ref-drop-under-lock",
+           doc="a statement under a non-reentrant lock discards container "
+               "contents (del / discarded .pop() / .clear()) — if the "
+               "dropped value holds the last ObjectRef, its __del__ runs "
+               "release paths that re-enter the lock (the PR-5 deadlock)")
+def ref_drop_findings(ctx: FileCtx) -> list:
+    out = []
+    for scope_name, scans in _concurrency_scans(ctx):
+        for m, scan in scans.items():
+            for node, detail, lk in scan.ref_drops:
+                out.append(ctx.finding(
+                    "ref-drop-under-lock", node,
+                    f"{scan.qualname}: {detail} under non-reentrant "
+                    f"{lk.name} — a dropped value's __del__ (e.g. the last "
+                    "ObjectRef -> _on_ref_zero) re-enters the lock; pop "
+                    "under the lock, let the value die after release",
+                    f"{scan.qualname}:{lk.name}:{detail}"))
+    return out
+
+
+@file_rule("blocking-under-lock",
+           doc="RPC call/notify, socket ops, Future.result, Event.wait, "
+               "thread join, or sleep while holding a lock — serializes "
+               "every contender behind an unbounded wait")
+def blocking_under_lock_findings(ctx: FileCtx) -> list:
+    out = []
+    for scope_name, scans in _concurrency_scans(ctx):
+        for m, scan in scans.items():
+            for node, label, lk in scan.blocking:
+                out.append(ctx.finding(
+                    "blocking-under-lock", node,
+                    f"{scan.qualname}: {label}() while holding {lk.name} — "
+                    "every contender parks behind this wait; move the "
+                    "blocking work outside the lock",
+                    f"{scan.qualname}:{lk.name}:{label}"))
+    return out
+
+
+# ----------------------------------------------------- reactor handlers
+
+# Handlers not schema-flagged `blocking` run on the bounded shared reactor
+# pool; one parked slot stalls unrelated ops behind it. `.call` on a peer,
+# future results, joins, waits and sleeps are all parks.
+_HANDLER_BLOCKING = {
+    "result", "sleep", "select", "accept", "connect", "call",
+    "wait", "wait_for", "join",
+}
+
+
+def _handler_tables(tree: ast.AST) -> dict:
+    """op -> method-name for every ``{"op": self._h_x}`` dict entry."""
+    out = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and isinstance(v, ast.Attribute) \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                out.setdefault(k.value, v.attr)
+    return out
+
+
+@project_rule("reactor-blocking-handler",
+              doc="an RPC handler not schema-flagged `blocking` parks a "
+                  "bounded shared reactor slot (Future.result, join, "
+                  "wait, rpc call, sleep) — flag the op blocking=True or "
+                  "move the work off the slot")
+def reactor_blocking_findings(ctx: ProjectCtx) -> list:
+    from ray_tpu.core.rpc import schema
+    from ray_tpu.devtools.lint.rules.wire import HANDLER_FILES
+
+    out = []
+    for rel in HANDLER_FILES:
+        fctx = ctx.get(rel)
+        if fctx is None:
+            continue
+        qualnames = qualname_index(fctx.tree)
+        tables = _handler_tables(fctx.tree)
+        methods = {n.name: n for n in ast.walk(fctx.tree)
+                   if isinstance(n, ast.FunctionDef)}
+        for op, mname in sorted(tables.items()):
+            spec = schema.REGISTRY.get(op)
+            if spec is None or spec.blocking:
+                continue
+            fn = methods.get(mname)
+            if fn is None:
+                continue
+            # direct body + one level of same-class self-calls
+            bodies = [(fn, "")]
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and \
+                        node.func.attr in methods:
+                    bodies.append((methods[node.func.attr],
+                                   f" (via self.{node.func.attr})"))
+            seen = set()
+            for body_fn, via in bodies:
+                if id(body_fn) in seen:
+                    continue
+                seen.add(id(body_fn))
+                for sub in ast.walk(body_fn):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    label = _classify_blocking(sub, set())
+                    if label is None or label not in _HANDLER_BLOCKING:
+                        continue
+                    qn = qualnames.get(id(fn), mname)
+                    out.append(ctx.finding(
+                        "reactor-blocking-handler", rel, sub.lineno,
+                        f"handler {qn} for non-blocking op {op!r} calls "
+                        f"{label}(){via} — parks a bounded reactor slot; "
+                        "flag the schema blocking=True or defer the work",
+                        f"{op}:{label}"))
+    return out
+
+
+# ------------------------------------------------------- thread hygiene
+
+
+@file_rule("thread-hygiene",
+           doc="every threading.Thread is daemon=True or reachable from a "
+               "tracked join/shutdown path in its module — otherwise "
+               "interpreter exit hangs on the leaked thread")
+def thread_hygiene_findings(ctx: FileCtx) -> list:
+    tree = ctx.tree
+    qualnames = qualname_index(tree)
+
+    def _is_thread_ctor(call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "Thread" and \
+                isinstance(f.value, ast.Name) and f.value.id == "threading":
+            return True
+        return isinstance(f, ast.Name) and f.id == "Thread"
+
+    # tails that get joined / daemonized somewhere in this module
+    joined, daemonized = set(), set()
+    loop_iter_by_var = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            it = _recv_key(node.iter)
+            if it is None and isinstance(node.iter, ast.Call):
+                # e.g. `for t in list(self._threads):`
+                args = node.iter.args
+                it = _recv_key(args[0]) if args else None
+            if it is not None:
+                loop_iter_by_var.setdefault(node.target.id, set()).add(it)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            if node.func.attr == "join":
+                rk = _recv_key(node.func.value)
+                if rk is not None:
+                    joined.add(rk)
+                    for it in loop_iter_by_var.get(rk, ()):
+                        joined.add(it)
+            if node.func.attr == "setDaemon":
+                rk = _recv_key(node.func.value)
+                if rk is not None:
+                    daemonized.add(rk)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Attribute) and \
+                node.targets[0].attr == "daemon":
+            rk = _recv_key(node.targets[0].value)
+            if rk is not None and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value is True:
+                daemonized.add(rk)
+    # second pass: for-loop joins recorded before their loop var was seen
+    for var, iters in loop_iter_by_var.items():
+        if var in joined:
+            joined |= iters
+
+    # enclosing-function index for keys
+    out = []
+    parents: dict = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+
+    def _enclosing_qualname(node) -> str:
+        cur = node
+        while id(cur) in parents:
+            cur = parents[id(cur)]
+            q = qualnames.get(id(cur))
+            if q:
+                return q
+        return "<module>"
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        d = kw.get("daemon")
+        if isinstance(d, ast.Constant) and d.value is True:
+            continue
+        if d is not None and not isinstance(d, ast.Constant):
+            continue  # daemon=<expr>: caller decides; trust it
+        # where does the thread object land?
+        parent = parents.get(id(node))
+        tail = None
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            tail = _recv_key(parent.targets[0])
+        elif isinstance(parent, ast.Call) and \
+                isinstance(parent.func, ast.Attribute) and \
+                parent.func.attr == "append":
+            tail = _recv_key(parent.func.value)
+        elif isinstance(parent, ast.Attribute) and parent.attr == "start":
+            tail = None  # Thread(...).start() — fire and forget
+        if tail is not None and (tail in joined or tail in daemonized):
+            continue
+        if isinstance(parent, ast.Return):
+            continue  # factory: the caller owns the thread's lifecycle
+        tname = ""
+        tgt = kw.get("target")
+        if tgt is not None:
+            tname = _recv_key(tgt) or ""
+        qn = _enclosing_qualname(node)
+        out.append(ctx.finding(
+            "thread-hygiene", node,
+            f"{qn}: threading.Thread({('target=' + tname) if tname else ''}"
+            ") is neither daemon=True nor joined on any path in this "
+            "module — a leaked non-daemon thread hangs interpreter exit",
+            f"{qn}:thread:{tname or tail or 'anon'}"))
+    return out

@@ -1,0 +1,104 @@
+"""``swallowed-exception``: exception hygiene for ``core/``.
+
+A bare or over-broad ``except`` whose body neither re-raises, nor binds
+and uses the exception, nor reports it anywhere (logger, flight
+recorder, stderr) erases the only evidence of a failure — the class of
+silence that turns a one-line fix into a week of chaos-test bisection.
+Scoped to ``ray_tpu/core/`` where every swallowed error is a
+control-plane or data-plane invariant disappearing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.lint.core import FileCtx, file_rule, qualname_index
+
+_BROAD = {"Exception", "BaseException"}
+
+# A call with any of these callee names counts as reporting the failure.
+_REPORT_NAMES = {
+    "exception", "warning", "warn", "error", "info", "debug", "log",
+    "print", "print_exc", "format_exc", "record", "record_event",
+    "set_exception", "fail", "dump",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Attribute):
+        names = [t.attr]
+    elif isinstance(t, ast.Tuple):
+        for e in t.elts:
+            if isinstance(e, ast.Name):
+                names.append(e.id)
+            elif isinstance(e, ast.Attribute):
+                names.append(e.attr)
+    return any(n in _BROAD for n in names)
+
+
+def _handles_it(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in handler.body:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return True
+            if bound and isinstance(sub, ast.Name) and sub.id == bound:
+                return True  # the exception value is consumed somewhere
+            if isinstance(sub, ast.Call):
+                name = None
+                f = sub.func
+                if isinstance(f, ast.Attribute):
+                    name = f.attr
+                    recv = f.value
+                    rname = (recv.id if isinstance(recv, ast.Name)
+                             else recv.attr if isinstance(recv,
+                                                          ast.Attribute)
+                             else "")
+                    if "log" in rname or "flight" in rname or \
+                            "record" in rname:
+                        return True
+                elif isinstance(f, ast.Name):
+                    name = f.id
+                if name in _REPORT_NAMES:
+                    return True
+    return False
+
+
+@file_rule("swallowed-exception", scope=("ray_tpu/core/**/*.py",),
+           doc="bare/over-broad except in core/ that neither re-raises, "
+               "uses the bound exception, nor reports it (logger / flight "
+               "recorder) — failures must leave evidence")
+def swallowed_exception_findings(ctx: FileCtx) -> list:
+    qualnames = qualname_index(ctx.tree)
+    # map each except handler to its enclosing function for stable keys
+    out = []
+    occurrence: dict = {}
+
+    def visit(node, qn):
+        for child in ast.iter_child_nodes(node):
+            cqn = qualnames.get(id(child), qn)
+            if isinstance(child, ast.ExceptHandler) and _is_broad(child) \
+                    and not _handles_it(child):
+                caught = ("bare except" if child.type is None
+                          else f"except {ast.unparse(child.type)}")
+                # keys discriminate per handler (caught type + ordinal), so
+                # one baselined swallow cannot mask a NEW broad except added
+                # to the same function later
+                base = f"{qn or '<module>'}:swallow:{caught}"
+                n = occurrence[base] = occurrence.get(base, 0) + 1
+                out.append(ctx.finding(
+                    "swallowed-exception", child,
+                    f"{qn or '<module>'}: {caught} swallows without "
+                    "re-raise, use, or report — at minimum "
+                    "flight-record or debug-log the failure",
+                    base if n == 1 else f"{base}#{n}"))
+            visit(child, cqn)
+
+    visit(ctx.tree, "")
+    return out

@@ -1,0 +1,478 @@
+"""Wire-contract rules, migrated 1:1 from scripts/check_wire_schemas.py.
+
+Every check the old 710-line script ran lives here as a named rule with
+identical verdicts; the script itself is now a thin shim over these
+functions (same exit codes, same function names). Rule catalog:
+
+- ``schema-baseline``    registry unique + append-only vs SCHEMA_BASELINE
+- ``handlers-schemad``   every handler-table entry / call site is schema'd
+- ``no-pickle-in-rpc``   core/rpc/ + core/wire.py stay msgpack-native
+- ``blob-zero-copy``     the v3 raw BLOB frame path never copies/packs
+- ``dag-loop-rpc-free``  the compiled-graph exec loop is channels-only
+- ``version-gating``     ops introduced after v1 are ``since``-gated so an
+  old-wire peer never receives an op it cannot decode/serve
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import os
+
+from ray_tpu.devtools.lint.core import (
+    FileCtx, ProjectCtx, callee_name, calls_in, file_rule, find_funcs,
+    project_rule)
+from ray_tpu.devtools.lint.rules.hotpath import CONTROL_PLANE_IMPORTS
+
+# Frozen at ISSUE-2 (wire v2). Append new ops; NEVER edit existing pairs.
+SCHEMA_BASELINE = {
+    "hello": 1, "register_node": 2, "heartbeat": 3, "ref_add": 4,
+    "ref_drop": 5, "debug_register": 6, "debug_unregister": 7,
+    "debug_list": 8, "locate_object": 9, "object_added": 10,
+    "object_removed": 11, "pubsub_publish": 12, "pubsub_subscribe": 13,
+    "pubsub_unsubscribe": 14, "pubsub_msg": 15, "client_submit": 16,
+    "client_get": 17, "client_put": 18, "client_put_alloc": 19,
+    "client_put_seal": 20, "client_wait": 21, "client_free": 22,
+    "client_cancel": 23, "client_create_actor": 24, "client_actor_call": 25,
+    "client_get_actor": 26, "client_kill_actor": 27, "client_actor_cls": 28,
+    "client_next_stream": 29, "client_stream_done": 30, "execute_task": 31,
+    "task_blocked": 32, "plane_free": 33, "kill_worker": 34, "num_alive": 35,
+    "ping": 36, "shutdown": 37, "obj_meta": 38, "obj_chunk": 39,
+    "obj_done": 40, "xl_call": 41, "xl_submit": 42, "xl_get": 43,
+    "xl_put": 44, "xl_free": 45, "xl_actor_create": 46, "xl_actor_call": 47,
+    "xl_kill_actor": 48, "xl_list_funcs": 49, "kv_get": 50,
+    # ISSUE-5 (wire v3): bulk data plane
+    "obj_chunk_raw": 51,
+    # ISSUE-7 (wire v4): compiled actor graphs
+    "dag_install": 52, "dag_teardown": 53, "dag_ch_write": 54,
+    "dag_ch_read": 55,
+    # ISSUE-8 (wire v5): cluster telemetry plane
+    "metrics_push": 56,
+    # ISSUE-10 (wire v6): elastic gangs — preemption notices + checkpoint
+    # shard replication
+    "preempt_notice": 57, "plane_replicate": 58,
+    # ISSUE-11 (wire v7): disaggregated PD serving — KV handoff ack
+    "kv_ack": 59,
+    # ISSUE-13 (wire v8): out-of-band worker profiler (agent-driven SIGUSR
+    # stack sampler, artifact sealed to the object plane)
+    "profile_capture": 60,
+}
+
+# Files whose handler tables must be fully schema'd.
+HANDLER_FILES = [
+    "ray_tpu/core/cluster.py",
+    "ray_tpu/core/node_agent.py",
+    "ray_tpu/core/object_plane.py",
+    "ray_tpu/core/client_runtime.py",
+    "ray_tpu/serve/kv_transport.py",
+]
+
+# The sanctioned opaque-payload pickle site inside core/rpc/.
+PICKLE_ALLOWED = {"userblob.py"}
+
+_SCHEMA_REL = "ray_tpu/core/rpc/schema.py"
+
+
+class OnDemandCtx:
+    """A ProjectCtx stand-in that parses files lazily — what the
+    check_wire_schemas.py shim hands the rule bodies so it needs no
+    runner pass."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._cache: dict = {}
+
+    def get(self, rel: str):
+        rel = rel.replace(os.sep, "/")
+        if rel not in self._cache:
+            path = os.path.join(self.root, rel)
+            if not os.path.exists(path):
+                self._cache[rel] = None
+            else:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                self._cache[rel] = FileCtx(
+                    self.root, rel, src, ast.parse(src, filename=rel))
+        return self._cache[rel]
+
+    finding = ProjectCtx.finding
+
+
+# ------------------------------------------------------------ rule bodies
+
+
+def schema_registry_findings(ctx) -> list:
+    from ray_tpu.core.rpc import schema
+
+    out = []
+
+    def F(message, key):
+        out.append(ctx.finding("schema-baseline", _SCHEMA_REL, 0,
+                               message, key))
+
+    nums: dict = {}
+    for name, spec in schema.REGISTRY.items():
+        if spec.num in nums:
+            F(f"op number {spec.num} used by both {name!r} and "
+              f"{nums[spec.num]!r}", f"dup-num:{spec.num}")
+        nums[spec.num] = name
+        if not (1 <= spec.since <= schema.WIRE_VERSION):
+            F(f"op {name!r}: since={spec.since} outside "
+              f"[1, WIRE_VERSION={schema.WIRE_VERSION}]",
+              f"since-range:{name}")
+    # append-only vs the frozen baseline
+    for name, num in SCHEMA_BASELINE.items():
+        spec = schema.REGISTRY.get(name)
+        if spec is None:
+            F(f"baseline op {name!r} (#{num}) was REMOVED — shipped ops "
+              "must stay registered", f"removed:{name}")
+        elif spec.num != num:
+            F(f"op {name!r} renumbered {num} -> {spec.num} — numbers are "
+              "append-only", f"renumbered:{name}")
+    floor = max(SCHEMA_BASELINE.values())
+    for name, spec in schema.REGISTRY.items():
+        if name not in SCHEMA_BASELINE and spec.num <= floor:
+            F(f"new op {name!r} took number {spec.num} <= baseline max "
+              f"{floor} — new ops must append (and extend the baseline)",
+              f"below-floor:{name}")
+    return out
+
+
+@project_rule("schema-baseline",
+              doc="wire-op registry is unique and append-only against the "
+                  "frozen SCHEMA_BASELINE")
+def _schema_baseline_rule(ctx: ProjectCtx) -> list:
+    return schema_registry_findings(ctx)
+
+
+_NON_OPS = {
+    # dict-literal keys in the handler files that are not handler-table
+    # entries
+    "CPU", "TPU", "ok", "node_id", "shm_name", "shm_size", "log_dir",
+    "size", "actors", "funcs", "ref", "actor", "__bytes__", "pid", "ts",
+    "load1", "mem_total_mb", "mem_available_mb", "agent_rss_mb",
+    "workers_alive", "store_used_mb", "store_cap_mb", "wall_ts",
+    "num_returns",
+    "max_retries", "retry_exceptions", "name", "resources", "runtime_env",
+    "isolate_process", "peer_hello", "input_chans", "output_chan",
+    "_trace_ctx",
+    # kv_transport.py descriptor/stats fields (not handler-table keys)
+    "live_handoffs", "live_bytes", "k_shape", "v_shape", "local_pulls",
+}
+
+
+def handler_schema_findings(ctx) -> list:
+    """Every ``"op": handler`` table entry and every peer.call/notify op
+    literal in the control-plane modules must name a registered schema."""
+    from ray_tpu.core.rpc import schema
+
+    out = []
+    for rel in HANDLER_FILES:
+        fctx = ctx.get(rel)
+        if fctx is None:
+            out.append(ctx.finding(
+                "handlers-schemad", rel, 0,
+                f"{rel} missing — control-plane module renamed/deleted? "
+                "(update HANDLER_FILES so its handler table stays linted)",
+                "missing-module"))
+            continue
+        tree = fctx.tree
+        # call sites: peer.call("op", ...) / notify / call_async
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("call", "call_async", "notify")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                op = node.args[0].value
+                if op not in schema.REGISTRY:
+                    out.append(ctx.finding(
+                        "handlers-schemad", rel, node.lineno,
+                        f"call site uses op {op!r} with no schema entry",
+                        f"callsite:{op}"))
+        # handler tables: dict literals whose keys look like op names
+        seen = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k in node.keys:
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                key = k.value
+                if key in seen or key in _NON_OPS or \
+                        not key.replace("_", "").isalpha():
+                    continue
+                seen.add(key)
+                if key.islower() and "_" in key and \
+                        key not in schema.REGISTRY:
+                    out.append(ctx.finding(
+                        "handlers-schemad", rel, k.lineno,
+                        f"dict key {key!r} looks like an op but has no "
+                        "schema entry (add one, or list it in _NON_OPS)",
+                        f"dictkey:{key}"))
+    return out
+
+
+@project_rule("handlers-schemad",
+              doc="every handler-table entry / rpc call site in the "
+                  "control-plane modules names a registered op schema")
+def _handlers_schemad_rule(ctx: ProjectCtx) -> list:
+    return handler_schema_findings(ctx)
+
+
+@file_rule("no-pickle-in-rpc",
+           scope=("ray_tpu/core/rpc/*.py", "ray_tpu/core/wire.py"),
+           doc="control-plane frames stay msgpack-native: no pickle import "
+               "or dumps/loads outside userblob.py")
+def no_pickle_findings(ctx: FileCtx) -> list:
+    base = os.path.basename(ctx.rel)
+    if base in PICKLE_ALLOWED:
+        return []
+    out = []
+    where = ("the shim must stay transport-free"
+             if base == "wire.py" else
+             "control-plane frames must stay msgpack-native (opaque "
+             "payloads go through userblob.py)")
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names]
+            mod = getattr(node, "module", "") or ""
+            if "pickle" in names or "cloudpickle" in names or \
+                    mod in ("pickle", "cloudpickle"):
+                out.append(ctx.finding(
+                    "no-pickle-in-rpc", node,
+                    f"imports pickle — {where}", "import-pickle"))
+        if (isinstance(node, ast.Attribute)
+                and node.attr in ("dumps", "loads")
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ("pickle", "cloudpickle")):
+            out.append(ctx.finding(
+                "no-pickle-in-rpc", node,
+                f"{node.value.id}.{node.attr} of a control structure",
+                f"pickle-call:{node.attr}"))
+    return out
+
+
+def blob_zero_copy_findings(ctx) -> list:
+    """The v3 BLOB contract: raw kind version-gated, header schema frozen,
+    payload bytes never packed, joined, or copied on the chunk path."""
+    from ray_tpu.core.rpc import codec, schema
+
+    out = []
+    codec_rel = "ray_tpu/core/rpc/codec.py"
+
+    def F(rel, line, message, key):
+        out.append(ctx.finding("blob-zero-copy", rel, line, message, key))
+
+    spec = schema.REGISTRY.get("obj_chunk_raw")
+    if spec is None:
+        F(_SCHEMA_REL, 0, "obj_chunk_raw (the BLOB header schema) is not "
+          "registered", "missing:obj_chunk_raw")
+    elif spec.since < 3:
+        F(_SCHEMA_REL, 0, f"obj_chunk_raw gated since={spec.since} < 3 — a "
+          "v2 peer would receive a frame kind it cannot decode",
+          "gate:obj_chunk_raw")
+    if getattr(codec, "BLOB", None) is None or codec.BLOB <= codec.GOODBYE:
+        F(codec_rel, 0, "codec.BLOB must be a NEW frame kind appended after "
+          "GOODBYE (old decoders reject unknown kinds cleanly)",
+          "blob-kind")
+    # the packer sees header fields only
+    params = list(inspect.signature(codec.blob_header).parameters)
+    if params != ["reply_to", "payload_len"]:
+        F(codec_rel, 0, f"codec.blob_header{tuple(params)} — must take "
+          "(reply_to, payload_len): payload bytes never enter the msgpack "
+          "packer", "blob-header-sig")
+    # peer: sendmsg-by-reference out, recv_into in — no packer, no copies
+    peer_rel = "ray_tpu/core/rpc/peer.py"
+    fctx = ctx.get(peer_rel)
+    peer_fns = (find_funcs(fctx.tree, {"_send_blob", "_read_blob"})
+                if fctx else {})
+    packers = {"pack", "packb", "dumps", "reply_frame"}
+    for name in ("_send_blob", "_read_blob"):
+        fn = peer_fns.get(name)
+        if fn is None:
+            F(peer_rel, 0, f"{name} missing — BLOB path gone?",
+              f"missing:{name}")
+            continue
+        for lineno, callee in calls_in(fn, packers):
+            F(peer_rel, lineno, f"{name} calls {callee}() — BLOB payloads "
+              "must bypass the msgpack packer", f"packs:{name}:{callee}")
+    if "_send_blob" in peer_fns and not calls_in(peer_fns["_send_blob"],
+                                                 {"sendmsg"}):
+        F(peer_rel, peer_fns["_send_blob"].lineno,
+          "_send_blob no longer scatter-gathers via sendmsg (header+payload "
+          "in one syscall, by reference)", "no-sendmsg")
+    if "_read_blob" in peer_fns:
+        if calls_in(peer_fns["_read_blob"], {"_recv_exact"}):
+            F(peer_rel, peer_fns["_read_blob"].lineno,
+              "_read_blob uses copying _recv_exact — payload must land via "
+              "recv_into", "copying-recv")
+        if not calls_in(peer_fns["_read_blob"], {"_recv_exact_into"}):
+            F(peer_rel, peer_fns["_read_blob"].lineno,
+              "_read_blob must receive via _recv_exact_into (recv_into, "
+              "zero-copy)", "no-recv-into")
+    # plane: the raw-chunk handler serves a store view, never a bytes() copy
+    plane_rel = "ray_tpu/core/object_plane.py"
+    pctx = ctx.get(plane_rel)
+    fn = (find_funcs(pctx.tree, {"_h_chunk_raw"}).get("_h_chunk_raw")
+          if pctx else None)
+    if fn is None:
+        F(plane_rel, 0, "_h_chunk_raw handler missing",
+          "missing:_h_chunk_raw")
+    else:
+        for lineno, callee in calls_in(fn, packers | {"bytes", "bytearray"}):
+            F(plane_rel, lineno, f"_h_chunk_raw calls {callee}() — raw "
+              "chunks must leave as views into the store mapping (RawReply)",
+              f"copies:_h_chunk_raw:{callee}")
+        if not calls_in(fn, {"RawReply"}):
+            F(plane_rel, fn.lineno, "_h_chunk_raw must answer with a "
+              "RawReply (raw BLOB frame)", "no-rawreply")
+    return out
+
+
+@project_rule("blob-zero-copy",
+              doc="the v3 raw BLOB frame path stays zero-copy: sendmsg by "
+                  "reference out, recv_into in, no packer, no bytes()")
+def _blob_zero_copy_rule(ctx: ProjectCtx) -> list:
+    return blob_zero_copy_findings(ctx)
+
+
+# Control-plane call names that must never appear in the compiled-graph
+# exec loop: steady state is channels only (ISSUE-7 acceptance).
+DAG_LOOP_FORBIDDEN_CALLS = {
+    "remote", "call", "call_async", "notify", "submit_task",
+    "submit_actor_task", "create_actor",
+}
+# one shared control-plane module list for import bans (hotpath.py owns it)
+DAG_LOOP_FORBIDDEN_IMPORTS = CONTROL_PLANE_IMPORTS
+
+
+def dag_loop_findings(ctx) -> list:
+    """The resident exec loop a compiled graph installs in each actor makes
+    zero control-plane calls at steady state — its module may touch shm
+    channels and the serializer, nothing else."""
+    out = []
+    rel = "ray_tpu/dag/exec_loop.py"
+    fctx = ctx.get(rel)
+    if fctx is None:
+        return [ctx.finding("dag-loop-rpc-free", rel, 0,
+                            "exec_loop.py missing — compiled-graph loop "
+                            "gone?", "missing-module")]
+    for node in ast.walk(fctx.tree):
+        if isinstance(node, ast.Call):
+            name = callee_name(node)
+            if name in DAG_LOOP_FORBIDDEN_CALLS:
+                out.append(ctx.finding(
+                    "dag-loop-rpc-free", rel, node.lineno,
+                    f"calls {name}() — the compiled-graph loop must be "
+                    "channels-only at steady state (no RPC, no task "
+                    "submission)", f"call:{name}"))
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names]
+            mods.append(getattr(node, "module", "") or "")
+            for m in mods:
+                if any(m == f or m.startswith(f + ".")
+                       for f in DAG_LOOP_FORBIDDEN_IMPORTS):
+                    out.append(ctx.finding(
+                        "dag-loop-rpc-free", rel, node.lineno,
+                        f"imports {m} — the loop module must not link the "
+                        "control plane", f"import:{m}"))
+    fns = find_funcs(fctx.tree, {"run_plan"})
+    if "run_plan" not in fns:
+        out.append(ctx.finding("dag-loop-rpc-free", rel, 0,
+                               "run_plan missing", "missing:run_plan"))
+    elif not calls_in(fns["run_plan"], {"read_view", "read", "write"}):
+        out.append(ctx.finding(
+            "dag-loop-rpc-free", rel, fns["run_plan"].lineno,
+            "run_plan no longer moves data over channel read/write",
+            "run_plan-no-channels"))
+    # version gating: dag ops must be >= v4 so old peers negotiate down
+    from ray_tpu.core.rpc import schema
+
+    for op in ("dag_install", "dag_teardown", "dag_ch_write", "dag_ch_read"):
+        spec = schema.REGISTRY.get(op)
+        if spec is None:
+            out.append(ctx.finding("dag-loop-rpc-free", _SCHEMA_REL, 0,
+                                   f"{op} schema missing", f"missing:{op}"))
+        elif spec.since < 4:
+            out.append(ctx.finding(
+                "dag-loop-rpc-free", _SCHEMA_REL, 0,
+                f"{op} gated since={spec.since} < 4 — an old-wire peer must "
+                "fall back to RPC dispatch, not receive undecodable frames",
+                f"gate:{op}"))
+    return out
+
+
+@project_rule("dag-loop-rpc-free",
+              doc="the compiled-graph actor-resident exec loop is "
+                  "channels-only: no RPC, no control-plane imports")
+def _dag_loop_rule(ctx: ProjectCtx) -> list:
+    return dag_loop_findings(ctx)
+
+
+# ------------------------------------------------------------ version gates
+# Declarative table: op -> (min since, blocking required, rationale).
+# since-gating means the sender checks negotiated_version before using the
+# op, so a <since peer never receives an op number it cannot decode/serve;
+# blocking=True routes the handler to a dedicated thread instead of a
+# bounded reactor slot.
+VERSION_GATES = {
+    "preempt_notice": (6, False,
+                       "an old-wire peer would receive an op it cannot "
+                       "serve/decode"),
+    "plane_replicate": (6, True,
+                        "the agent handler parks on a whole-object pull "
+                        "and must not occupy a bounded reactor slot"),
+    "kv_ack": (7, False,
+               "an old-wire holder would receive an op it cannot decode"),
+    "profile_capture": (8, True,
+                        "the agent handler parks for the sample window"),
+}
+
+
+def gate_findings(ctx, ops=None) -> list:
+    from ray_tpu.core.rpc import schema
+
+    out = []
+    for op, (min_since, must_block, why) in sorted(VERSION_GATES.items()):
+        if ops is not None and op not in ops:
+            continue
+        spec = schema.REGISTRY.get(op)
+        if spec is None:
+            out.append(ctx.finding("version-gating", _SCHEMA_REL, 0,
+                                   f"{op} schema missing", f"missing:{op}"))
+            continue
+        if spec.since < min_since:
+            out.append(ctx.finding(
+                "version-gating", _SCHEMA_REL, 0,
+                f"{op} gated since={spec.since} < {min_since} — {why}",
+                f"gate:{op}"))
+        if must_block and not spec.blocking:
+            out.append(ctx.finding(
+                "version-gating", _SCHEMA_REL, 0,
+                f"{op} must be blocking=True — {why}", f"blocking:{op}"))
+    return out
+
+
+def profiler_piggyback_findings(ctx) -> list:
+    """The metrics_push ``phases`` piggyback field must exist (the
+    timeline half rides the v5 push; removing the field silently severs
+    worker phase lanes)."""
+    from ray_tpu.core.rpc import schema
+
+    push = schema.REGISTRY.get("metrics_push")
+    if push is not None and "phases" not in push.field_map():
+        return [ctx.finding(
+            "version-gating", _SCHEMA_REL, 0,
+            "metrics_push lost its `phases` field — worker timeline "
+            "entries have no transport", "field:metrics_push.phases")]
+    return []
+
+
+@project_rule("version-gating",
+              doc="post-v1 ops are since-gated (and blocking-flagged where "
+                  "the handler parks) so old-wire peers negotiate down")
+def _version_gating_rule(ctx: ProjectCtx) -> list:
+    return gate_findings(ctx) + profiler_piggyback_findings(ctx)

@@ -1,0 +1,270 @@
+"""graftlint runner: discover -> parse (parallel) -> rules -> baseline.
+
+Usage::
+
+    python -m ray_tpu.devtools.lint                 # full pass, baseline-aware
+    python -m ray_tpu.devtools.lint --list-rules
+    python -m ray_tpu.devtools.lint --rules lock-order,ref-drop-under-lock
+    python -m ray_tpu.devtools.lint --update-baseline   # freeze current debt
+    python -m ray_tpu.devtools.lint --prune-baseline    # retire stale entries
+
+Exit codes: 0 = clean (every finding suppressed or baselined), 1 = new
+findings or a corrupted baseline (edited/renumbered entries).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import concurrent.futures
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+
+from ray_tpu.devtools.lint import baseline as baseline_mod
+from ray_tpu.devtools.lint.core import (
+    RULES, FileCtx, Finding, ProjectCtx, Suppressions, scope_match)
+
+DEFAULT_SUBDIRS = ("ray_tpu",)
+SKIP_DIRS = {"__pycache__", ".git"}
+BASELINE_REL = os.path.join("scripts", "lint_baseline.json")
+
+
+def repo_root() -> str:
+    """The checkout root: the directory holding the ``ray_tpu`` package
+    this module was imported from."""
+    here = os.path.abspath(os.path.dirname(__file__))   # .../ray_tpu/devtools/lint
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def discover_files(root: str, subdirs=DEFAULT_SUBDIRS) -> list:
+    rels = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base) and base.endswith(".py"):
+            rels.append(os.path.relpath(base, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    rels.append(
+                        os.path.relpath(os.path.join(dirpath, fname), root))
+    return rels
+
+
+def _parse_one(root: str, rel: str):
+    path = os.path.join(root, rel)
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=rel)
+    return FileCtx(root, rel, source, tree)
+
+
+def parse_all(root: str, rels, jobs: "int | None" = None):
+    """Parse every file concurrently. Returns ({rel: FileCtx}, parse-error
+    findings) — a file that fails to parse becomes a finding, not a
+    crash, so one broken file cannot hide the rest of the pass."""
+    files: dict = {}
+    errors: list = []
+    jobs = jobs or min(32, (os.cpu_count() or 4))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as pool:
+        futs = {pool.submit(_parse_one, root, rel): rel for rel in rels}
+        for fut in concurrent.futures.as_completed(futs):
+            rel = futs[fut].replace(os.sep, "/")
+            try:
+                files[rel] = fut.result()
+            except SyntaxError as e:
+                errors.append(Finding(
+                    rule="parse-error", path=rel, line=e.lineno or 0,
+                    message=f"file does not parse: {e.msg}",
+                    key=f"syntax:{e.msg}"))
+            except OSError as e:
+                errors.append(Finding(
+                    rule="parse-error", path=rel, line=0,
+                    message=f"file unreadable: {e}", key="unreadable"))
+    return files, errors
+
+
+@dataclass
+class Report:
+    findings: list = field(default_factory=list)      # NEW (fail the pass)
+    baselined: list = field(default_factory=list)     # matched frozen debt
+    suppressed: int = 0
+    stale_entries: list = field(default_factory=list)  # baseline w/o finding
+    baseline_errors: list = field(default_factory=list)
+    rules_run: int = 0
+    files_scanned: int = 0
+    elapsed_s: float = 0.0
+
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.baseline_errors) else 0
+
+
+def run_pass(root: "str | None" = None, rule_names=None,
+             baseline_path: "str | None" = None, use_baseline: bool = True,
+             jobs: "int | None" = None, subdirs=DEFAULT_SUBDIRS) -> Report:
+    # rule modules self-register on import
+    import ray_tpu.devtools.lint.rules  # noqa: F401
+
+    t0 = time.monotonic()
+    root = root or repo_root()
+    report = Report()
+
+    selected = []
+    unknown = [n for n in (rule_names or []) if n not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))} "
+                         f"(see --list-rules)")
+    for name, rule in sorted(RULES.items()):
+        if rule_names is None or name in rule_names:
+            selected.append(rule)
+
+    rels = discover_files(root, subdirs=subdirs)
+    files, parse_findings = parse_all(root, rels, jobs=jobs)
+    report.files_scanned = len(files)
+    report.rules_run = len(selected)
+
+    raw: list = list(parse_findings)
+    file_rules = [r for r in selected if r.kind == "file"]
+    project_rules = [r for r in selected if r.kind == "project"]
+
+    def _run_file(ctx: FileCtx):
+        out = []
+        for rule in file_rules:
+            if scope_match(ctx.rel, rule.scope):
+                out.extend(rule.fn(ctx))
+        return out
+
+    jobs_n = jobs or min(32, (os.cpu_count() or 4))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=jobs_n) as pool:
+        for chunk in pool.map(_run_file, files.values()):
+            raw.extend(chunk)
+
+    pctx = ProjectCtx(root, files)
+    for rule in project_rules:
+        raw.extend(rule.fn(pctx))
+
+    # per-line / per-file suppressions
+    kept = []
+    for f in raw:
+        ctx = files.get(f.path)
+        if ctx is not None:
+            sup = getattr(ctx, "_suppressions", None)
+            if sup is None:
+                sup = ctx._suppressions = Suppressions(ctx.source)
+            if sup.is_suppressed(f.rule, f.line):
+                report.suppressed += 1
+                continue
+        kept.append(f)
+
+    # baseline: frozen debt passes, new findings fail
+    if use_baseline:
+        bpath = baseline_path or os.path.join(root, BASELINE_REL)
+        doc = baseline_mod.load(bpath)
+        report.baseline_errors = baseline_mod.validate(doc)
+        ents = baseline_mod.entries(doc)
+        tolerated = baseline_mod.match_key(ents)
+        seen_triples = set()
+        for f in kept:
+            triple = (f.rule, f.path, f.key)
+            seen_triples.add(triple)
+            (report.baselined if triple in tolerated
+             else report.findings).append(f)
+        # an entry is stale only if its RULE ran this pass and produced no
+        # matching finding — a --rules subset must not report (let alone
+        # prune) other rules' frozen debt
+        ran = {r.name for r in selected}
+        report.stale_entries = [
+            e for e in ents
+            if e.rule in ran and (e.rule, e.path, e.key) not in seen_triples]
+    else:
+        report.findings = kept
+
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    report.elapsed_s = time.monotonic() - t0
+    return report
+
+
+def render_report(report: Report, verbose: bool = False) -> str:
+    out = []
+    for e in report.baseline_errors:
+        out.append(f"BASELINE: {e}")
+    for f in report.findings:
+        out.append(f.render())
+    if verbose:
+        for f in sorted(report.baselined, key=lambda f: (f.path, f.line)):
+            out.append(f"baselined: {f.render()}")
+    for e in report.stale_entries:
+        out.append(f"stale baseline entry #{e.id} [{e.rule}] {e.path} "
+                   f"({e.key}) — finding gone; retire via --prune-baseline")
+    out.append(
+        f"graftlint: {report.rules_run} rules over "
+        f"{report.files_scanned} files in {report.elapsed_s:.1f}s — "
+        f"{len(report.findings)} new, {len(report.baselined)} baselined, "
+        f"{report.suppressed} suppressed")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="ray_tpu project-native static analysis")
+    ap.add_argument("--root", default=None, help="checkout root")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the frozen baseline")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline path (default: {BASELINE_REL})")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="append current NEW findings to the baseline")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rebuild the baseline from current findings "
+                         "(retires stale entries; reviewed commits only)")
+    ap.add_argument("--jobs", type=int, default=None)
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list baselined findings")
+    args = ap.parse_args(argv)
+
+    import ray_tpu.devtools.lint.rules  # noqa: F401
+
+    if args.list_rules:
+        for name, rule in sorted(RULES.items()):
+            doc = (rule.doc or "").splitlines()[0] if rule.doc else ""
+            print(f"{name:26s} [{rule.kind}] {doc}")
+        return 0
+
+    rule_names = (set(args.rules.split(",")) if args.rules else None)
+    root = args.root or repo_root()
+    bpath = args.baseline or os.path.join(root, BASELINE_REL)
+
+    if args.prune_baseline and rule_names:
+        # a subset pass only sees the selected rules' findings: rebuilding
+        # from it would silently delete every other rule's frozen debt
+        print("--prune-baseline requires a full pass (drop --rules)",
+              file=sys.stderr)
+        return 1
+
+    if args.prune_baseline or args.update_baseline:
+        report = run_pass(root=root, rule_names=rule_names,
+                          baseline_path=bpath, use_baseline=False,
+                          jobs=args.jobs)
+        doc = (baseline_mod.rebuild(report.findings) if args.prune_baseline
+               else baseline_mod.append_entries(baseline_mod.load(bpath),
+                                                report.findings))
+        baseline_mod.save(doc, bpath)
+        print(f"baseline written: {bpath} ({len(doc['entries'])} entries)")
+        return 0
+
+    report = run_pass(root=root, rule_names=rule_names, baseline_path=bpath,
+                      use_baseline=not args.no_baseline, jobs=args.jobs)
+    text = render_report(report, verbose=args.verbose)
+    print(text, file=sys.stderr if report.exit_code() else sys.stdout)
+    return report.exit_code()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -82,7 +82,12 @@ def take_rank_shards(key: str, rank: int) -> "dict[str, DataIterator]":
 
 def release_gang_shards(key: str) -> None:
     with _registry_lock:
-        _registry.pop(key, None)
+        dropped = _registry.pop(key, None)
+    # the shard iterators hold BlockRefs (ObjectRefs) and prefetch pump
+    # state: their teardown runs object-release paths (runtime lock, plane
+    # frees) and must not execute while holding the registry lock every
+    # rank's take_rank_shards contends on (graftlint ref-drop-under-lock)
+    del dropped
 
 
 def ingest_report(shards: "dict[str, DataIterator]") -> dict:

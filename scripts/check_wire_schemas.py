@@ -1,87 +1,52 @@
 #!/usr/bin/env python
-"""Wire-schema lint: the control plane's append-only contract, enforced.
+"""Wire-schema lint — THIN SHIM over ``ray_tpu.devtools.lint`` (graftlint).
 
-Runnable standalone (``python scripts/check_wire_schemas.py``) and as a
-test (tests/test_round5_fixes-style import; see test_rpc_wire.py). Asserts:
+Every check this script used to implement inline now lives in the
+pluggable analyzer as a named rule (see ``ray_tpu/devtools/lint/rules/``
+and README "Static analysis"):
 
-1. every handler registered on a control-plane server (core/cluster.py,
-   core/node_agent.py, core/object_plane.py) has a schema entry;
-2. schema numbers are unique and APPEND-ONLY against the frozen baseline
-   below — renumbering or reusing a shipped number is a wire break;
-3. no ``pickle.dumps``/``pickle.loads`` of control structures remains in
-   ``core/rpc/`` (the single sanctioned pickle site is userblob.py, the
-   opaque user-payload codec) nor in ``core/wire.py``;
-4. the raw BLOB frame keeps its zero-copy contract: the ``obj_chunk_raw``
-   header schema is registered and version-gated (since>=3, so v2 peers
-   never see a frame kind they can't decode), and no payload bytes pass
-   through the msgpack packer — or a ``bytes()`` copy — on the plane
-   chunk path (codec.blob_header packs lengths only; peer send is
-   sendmsg-by-reference, receive is recv_into);
-5. the compiled-graph steady-state contract: the actor-side exec loop
-   (``ray_tpu/dag/exec_loop.py``) makes NO control-plane calls — no
-   ``.remote()``, no rpc ``call``/``notify``, no task submission, no rpc
-   imports — channels only; and the ``dag_*`` ops are version-gated
-   (since>=4) so an old-wire peer negotiates down to RPC dispatch instead
-   of receiving frames it cannot decode.
+    check_registry                -> schema-baseline
+    check_handlers_have_schemas   -> handlers-schemad
+    check_no_pickle_in_rpc        -> no-pickle-in-rpc
+    check_blob_zero_copy          -> blob-zero-copy
+    check_dag_loop_steady_state   -> dag-loop-rpc-free
+    check_elastic_ops             -> version-gating (elastic ops)
+    check_profiler_op             -> version-gating (profiler op)
+    check_hot_path_instruments    -> hot-path-purity (exec loop + BLOB)
+    check_kv_transport            -> version-gating + hot-path-purity
+    check_data_streaming_hot_path -> hot-path-purity (data plane)
+    check_phase_stamp_hot_path    -> hot-path-purity (timeline)
 
-When you ADD an op: give it the next free number, bump WIRE_VERSION if the
-op must be gated, run this lint, then extend the baseline in the same PR.
+The function names, list-of-strings returns, stderr format, and exit
+codes are preserved so existing imports (tests/test_rpc_wire.py) keep
+passing unchanged. Prefer ``python -m ray_tpu.devtools.lint`` for new
+work — it also runs the concurrency/invariant pass this shim predates.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-# Frozen at ISSUE-2 (wire v2). Append new ops; NEVER edit existing pairs.
-SCHEMA_BASELINE = {
-    "hello": 1, "register_node": 2, "heartbeat": 3, "ref_add": 4,
-    "ref_drop": 5, "debug_register": 6, "debug_unregister": 7,
-    "debug_list": 8, "locate_object": 9, "object_added": 10,
-    "object_removed": 11, "pubsub_publish": 12, "pubsub_subscribe": 13,
-    "pubsub_unsubscribe": 14, "pubsub_msg": 15, "client_submit": 16,
-    "client_get": 17, "client_put": 18, "client_put_alloc": 19,
-    "client_put_seal": 20, "client_wait": 21, "client_free": 22,
-    "client_cancel": 23, "client_create_actor": 24, "client_actor_call": 25,
-    "client_get_actor": 26, "client_kill_actor": 27, "client_actor_cls": 28,
-    "client_next_stream": 29, "client_stream_done": 30, "execute_task": 31,
-    "task_blocked": 32, "plane_free": 33, "kill_worker": 34, "num_alive": 35,
-    "ping": 36, "shutdown": 37, "obj_meta": 38, "obj_chunk": 39,
-    "obj_done": 40, "xl_call": 41, "xl_submit": 42, "xl_get": 43,
-    "xl_put": 44, "xl_free": 45, "xl_actor_create": 46, "xl_actor_call": 47,
-    "xl_kill_actor": 48, "xl_list_funcs": 49, "kv_get": 50,
-    # ISSUE-5 (wire v3): bulk data plane
-    "obj_chunk_raw": 51,
-    # ISSUE-7 (wire v4): compiled actor graphs
-    "dag_install": 52, "dag_teardown": 53, "dag_ch_write": 54,
-    "dag_ch_read": 55,
-    # ISSUE-8 (wire v5): cluster telemetry plane
-    "metrics_push": 56,
-    # ISSUE-10 (wire v6): elastic gangs — preemption notices + checkpoint
-    # shard replication
-    "preempt_notice": 57, "plane_replicate": 58,
-    # ISSUE-11 (wire v7): disaggregated PD serving — KV handoff ack
-    "kv_ack": 59,
-    # ISSUE-13 (wire v8): out-of-band worker profiler (agent-driven SIGUSR
-    # stack sampler, artifact sealed to the object plane)
-    "profile_capture": 60,
-}
+from ray_tpu.devtools.lint.rules import hotpath as _hotpath  # noqa: E402
+from ray_tpu.devtools.lint.rules import wire as _wire  # noqa: E402
 
-# Files whose handler tables must be fully schema'd.
-HANDLER_FILES = [
-    "ray_tpu/core/cluster.py",
-    "ray_tpu/core/node_agent.py",
-    "ray_tpu/core/object_plane.py",
-    "ray_tpu/core/client_runtime.py",
-    "ray_tpu/serve/kv_transport.py",
-]
+# Re-exported: the frozen registries live with the rules now.
+SCHEMA_BASELINE = _wire.SCHEMA_BASELINE
+HANDLER_FILES = _wire.HANDLER_FILES
+PICKLE_ALLOWED = _wire.PICKLE_ALLOWED
 
-# The sanctioned opaque-payload pickle site inside core/rpc/.
-PICKLE_ALLOWED = {"userblob.py"}
+
+def _ctx():
+    return _wire.OnDemandCtx(REPO)
+
+
+def _msgs(findings) -> list:
+    return [f"{f.path}:{f.line}: {f.message}" if f.line
+            else f"{f.path}: {f.message}" for f in findings]
 
 
 def _fail(errors: list) -> None:
@@ -91,598 +56,70 @@ def _fail(errors: list) -> None:
 
 
 def check_registry() -> list:
-    from ray_tpu.core.rpc import schema
-
-    errors = []
-    nums: dict = {}
-    for name, spec in schema.REGISTRY.items():
-        if spec.num in nums:
-            errors.append(
-                f"op number {spec.num} used by both {name!r} and "
-                f"{nums[spec.num]!r}")
-        nums[spec.num] = name
-        if not (1 <= spec.since <= schema.WIRE_VERSION):
-            errors.append(f"op {name!r}: since={spec.since} outside "
-                          f"[1, WIRE_VERSION={schema.WIRE_VERSION}]")
-    # append-only vs the frozen baseline
-    for name, num in SCHEMA_BASELINE.items():
-        spec = schema.REGISTRY.get(name)
-        if spec is None:
-            errors.append(f"baseline op {name!r} (#{num}) was REMOVED — "
-                          "shipped ops must stay registered")
-        elif spec.num != num:
-            errors.append(f"op {name!r} renumbered {num} -> {spec.num} — "
-                          "numbers are append-only")
-    floor = max(SCHEMA_BASELINE.values())
-    for name, spec in schema.REGISTRY.items():
-        if name not in SCHEMA_BASELINE and spec.num <= floor:
-            errors.append(
-                f"new op {name!r} took number {spec.num} <= baseline max "
-                f"{floor} — new ops must append (and extend the baseline)")
-    return errors
-
-
-def _string_keys_of_dicts(tree: ast.AST) -> set:
-    """All string keys of dict literals + string first-args of handler-map
-    subscripts — a superset of op names used as handler-table keys."""
-    keys = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Dict):
-            for k in node.keys:
-                if isinstance(k, ast.Constant) and isinstance(k.value, str):
-                    keys.add(k.value)
-    return keys
-
-
-_NON_OPS = {
-    # dict-literal keys in those files that are not handler-table entries
-    "CPU", "TPU", "ok", "node_id", "shm_name", "shm_size", "log_dir",
-    "size", "actors", "funcs", "ref", "actor", "__bytes__", "pid", "ts",
-    "load1", "mem_total_mb", "mem_available_mb", "agent_rss_mb",
-    "workers_alive", "store_used_mb", "store_cap_mb", "wall_ts",
-    "num_returns",
-    "max_retries", "retry_exceptions", "name", "resources", "runtime_env",
-    "isolate_process", "peer_hello", "input_chans", "output_chan",
-    "_trace_ctx",
-    # kv_transport.py descriptor/stats fields (not handler-table keys)
-    "live_handoffs", "live_bytes", "k_shape", "v_shape", "local_pulls",
-}
+    return _msgs(_wire.schema_registry_findings(_ctx()))
 
 
 def check_handlers_have_schemas() -> list:
-    """Every ``"op": handler`` table entry and every peer.call/notify op
-    literal in the control-plane modules must name a registered schema."""
-    from ray_tpu.core.rpc import schema
-
-    errors = []
-    for rel in HANDLER_FILES:
-        path = os.path.join(REPO, rel)
-        tree = ast.parse(open(path).read(), filename=rel)
-        # call sites: peer.call("op", ...) / notify / call_async
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in ("call", "call_async", "notify")
-                    and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                op = node.args[0].value
-                if op not in schema.REGISTRY:
-                    errors.append(f"{rel}: call site uses op {op!r} with no "
-                                  "schema entry")
-        # handler tables: dict literals whose values are function refs and
-        # whose keys look like op names
-        for key in _string_keys_of_dicts(tree):
-            if key in _NON_OPS or not key.replace("_", "").isalpha():
-                continue
-            if key.islower() and "_" in key and key not in schema.REGISTRY:
-                # plausible op-shaped key with no schema — flag it
-                errors.append(f"{rel}: dict key {key!r} looks like an op "
-                              "but has no schema entry (add one, or list "
-                              "it in _NON_OPS)")
-    return errors
+    return _msgs(_wire.handler_schema_findings(_ctx()))
 
 
 def check_no_pickle_in_rpc() -> list:
-    errors = []
+    ctx = _ctx()
+    out = []
     rpc_dir = os.path.join(REPO, "ray_tpu", "core", "rpc")
-    for fname in sorted(os.listdir(rpc_dir)):
-        if not fname.endswith(".py") or fname in PICKLE_ALLOWED:
-            continue
-        src = open(os.path.join(rpc_dir, fname)).read()
-        tree = ast.parse(src, filename=fname)
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.Import, ast.ImportFrom)):
-                names = [a.name for a in node.names]
-                mod = getattr(node, "module", "") or ""
-                if "pickle" in names or "cloudpickle" in names or \
-                        mod in ("pickle", "cloudpickle"):
-                    errors.append(
-                        f"core/rpc/{fname}:{node.lineno}: imports pickle — "
-                        "control-plane frames must stay msgpack-native "
-                        "(opaque payloads go through userblob.py)")
-            if (isinstance(node, ast.Attribute)
-                    and node.attr in ("dumps", "loads")
-                    and isinstance(node.value, ast.Name)
-                    and node.value.id in ("pickle", "cloudpickle")):
-                errors.append(
-                    f"core/rpc/{fname}:{node.lineno}: "
-                    f"{node.value.id}.{node.attr} of a control structure")
-    # the legacy shim must carry no pickling either (AST check: prose in the
-    # docstring may mention the history)
-    wire_path = os.path.join(REPO, "ray_tpu", "core", "wire.py")
-    wire_tree = ast.parse(open(wire_path).read(), filename="wire.py")
-    for node in ast.walk(wire_tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            names = [a.name for a in node.names]
-            mod = getattr(node, "module", "") or ""
-            if "pickle" in names or "cloudpickle" in names or \
-                    mod in ("pickle", "cloudpickle"):
-                errors.append(f"core/wire.py:{node.lineno}: imports pickle — "
-                              "the shim must stay transport-free")
-    return errors
-
-
-def _calls_in(fn: ast.FunctionDef, names: set) -> list:
-    """(lineno, name) for every call inside ``fn`` whose callee name/attr is
-    in ``names`` (matches both ``packb(...)`` and ``msgpack.packb(...)``)."""
-    hits = []
-    for node in ast.walk(fn):
-        if not isinstance(node, ast.Call):
-            continue
-        callee = node.func
-        name = (callee.attr if isinstance(callee, ast.Attribute)
-                else callee.id if isinstance(callee, ast.Name) else None)
-        if name in names:
-            hits.append((node.lineno, name))
-    return hits
-
-
-def _find_funcs(tree: ast.AST, wanted: set) -> dict:
-    return {node.name: node for node in ast.walk(tree)
-            if isinstance(node, ast.FunctionDef) and node.name in wanted}
+    rels = [f"ray_tpu/core/rpc/{f}" for f in sorted(os.listdir(rpc_dir))
+            if f.endswith(".py")]
+    rels.append("ray_tpu/core/wire.py")
+    for rel in rels:
+        fctx = ctx.get(rel)
+        if fctx is not None:
+            out.extend(_wire.no_pickle_findings(fctx))
+    return _msgs(out)
 
 
 def check_blob_zero_copy() -> list:
-    """The v3 BLOB contract: raw kind version-gated, header schema frozen,
-    payload bytes never packed, joined, or copied on the chunk path."""
-    from ray_tpu.core.rpc import codec, schema
-
-    errors = []
-    # version gate: obj_chunk_raw (the only BLOB-replied op) must be >= v3
-    spec = schema.REGISTRY.get("obj_chunk_raw")
-    if spec is None:
-        errors.append("obj_chunk_raw (the BLOB header schema) is not "
-                      "registered")
-    elif spec.since < 3:
-        errors.append(f"obj_chunk_raw gated since={spec.since} < 3 — a v2 "
-                      "peer would receive a frame kind it cannot decode")
-    if getattr(codec, "BLOB", None) is None or codec.BLOB <= codec.GOODBYE:
-        errors.append("codec.BLOB must be a NEW frame kind appended after "
-                      "GOODBYE (old decoders reject unknown kinds cleanly)")
-    # the packer sees header fields only: blob_header takes lengths, never
-    # the payload
-    import inspect
-
-    params = list(inspect.signature(codec.blob_header).parameters)
-    if params != ["reply_to", "payload_len"]:
-        errors.append(f"codec.blob_header{tuple(params)} — must take "
-                      "(reply_to, payload_len): payload bytes never enter "
-                      "the msgpack packer")
-    # peer: sendmsg-by-reference out, recv_into in — no packer, no copies
-    peer_path = os.path.join(REPO, "ray_tpu", "core", "rpc", "peer.py")
-    peer_fns = _find_funcs(ast.parse(open(peer_path).read(), "peer.py"),
-                           {"_send_blob", "_read_blob"})
-    packers = {"pack", "packb", "dumps", "reply_frame"}
-    for name in ("_send_blob", "_read_blob"):
-        fn = peer_fns.get(name)
-        if fn is None:
-            errors.append(f"peer.py: {name} missing — BLOB path gone?")
-            continue
-        for lineno, callee in _calls_in(fn, packers):
-            errors.append(f"peer.py:{lineno}: {name} calls {callee}() — "
-                          "BLOB payloads must bypass the msgpack packer")
-    if "_send_blob" in peer_fns and not _calls_in(peer_fns["_send_blob"],
-                                                  {"sendmsg"}):
-        errors.append("peer.py: _send_blob no longer scatter-gathers via "
-                      "sendmsg (header+payload in one syscall, by reference)")
-    if "_read_blob" in peer_fns:
-        if _calls_in(peer_fns["_read_blob"], {"_recv_exact"}):
-            errors.append("peer.py: _read_blob uses copying _recv_exact — "
-                          "payload must land via recv_into")
-        if not _calls_in(peer_fns["_read_blob"], {"_recv_exact_into"}):
-            errors.append("peer.py: _read_blob must receive via "
-                          "_recv_exact_into (recv_into, zero-copy)")
-    # plane: the raw-chunk handler serves a store view, never a bytes() copy
-    plane_path = os.path.join(REPO, "ray_tpu", "core", "object_plane.py")
-    plane_fns = _find_funcs(ast.parse(open(plane_path).read(),
-                                      "object_plane.py"), {"_h_chunk_raw"})
-    fn = plane_fns.get("_h_chunk_raw")
-    if fn is None:
-        errors.append("object_plane.py: _h_chunk_raw handler missing")
-    else:
-        for lineno, callee in _calls_in(fn, packers | {"bytes", "bytearray"}):
-            errors.append(f"object_plane.py:{lineno}: _h_chunk_raw calls "
-                          f"{callee}() — raw chunks must leave as views "
-                          "into the store mapping (RawReply)")
-        if not _calls_in(fn, {"RawReply"}):
-            errors.append("object_plane.py: _h_chunk_raw must answer with "
-                          "a RawReply (raw BLOB frame)")
-    return errors
-
-
-# Control-plane call names that must never appear in the compiled-graph
-# exec loop: steady state is channels only (ISSUE-7 acceptance).
-_DAG_LOOP_FORBIDDEN_CALLS = {
-    "remote", "call", "call_async", "notify", "submit_task",
-    "submit_actor_task", "create_actor",
-}
-_DAG_LOOP_FORBIDDEN_IMPORTS = (
-    "ray_tpu.core.rpc", "ray_tpu.core.runtime", "ray_tpu.core.cluster",
-    "ray_tpu.core.client_runtime", "ray_tpu.core.api",
-)
+    return _msgs(_wire.blob_zero_copy_findings(_ctx()))
 
 
 def check_dag_loop_steady_state() -> list:
-    """The resident exec loop a compiled graph installs in each actor makes
-    zero control-plane calls at steady state — its module may touch shm
-    channels and the serializer, nothing else."""
-    errors = []
-    path = os.path.join(REPO, "ray_tpu", "dag", "exec_loop.py")
-    if not os.path.exists(path):
-        return ["ray_tpu/dag/exec_loop.py missing — compiled-graph loop gone?"]
-    tree = ast.parse(open(path).read(), filename="exec_loop.py")
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            callee = node.func
-            name = (callee.attr if isinstance(callee, ast.Attribute)
-                    else callee.id if isinstance(callee, ast.Name) else None)
-            if name in _DAG_LOOP_FORBIDDEN_CALLS:
-                errors.append(
-                    f"dag/exec_loop.py:{node.lineno}: calls {name}() — the "
-                    "compiled-graph loop must be channels-only at steady "
-                    "state (no RPC, no task submission)")
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            mods = [a.name for a in node.names]
-            mods.append(getattr(node, "module", "") or "")
-            for m in mods:
-                if any(m == f or m.startswith(f + ".")
-                       for f in _DAG_LOOP_FORBIDDEN_IMPORTS):
-                    errors.append(
-                        f"dag/exec_loop.py:{node.lineno}: imports {m} — the "
-                        "loop module must not link the control plane")
-    # run_plan must exist and speak the channel surface
-    fns = _find_funcs(tree, {"run_plan"})
-    if "run_plan" not in fns:
-        errors.append("dag/exec_loop.py: run_plan missing")
-    elif not _calls_in(fns["run_plan"], {"read_view", "read", "write"}):
-        errors.append("dag/exec_loop.py: run_plan no longer moves data over "
-                      "channel read/write")
-    # version gating: dag ops must be >= v4 so old peers negotiate down
-    from ray_tpu.core.rpc import schema
-
-    for op in ("dag_install", "dag_teardown", "dag_ch_write", "dag_ch_read"):
-        spec = schema.REGISTRY.get(op)
-        if spec is None:
-            errors.append(f"{op} schema missing")
-        elif spec.since < 4:
-            errors.append(f"{op} gated since={spec.since} < 4 — an old-wire "
-                          "peer must fall back to RPC dispatch, not receive "
-                          "undecodable frames")
-    return errors
-
-
-# Metric construction / registry-touching call names that must never run
-# per-event on a hot path — instruments bind at import/install time
-# (util/metrics.py bind contract, ISSUE-8 telemetry plane).
-_METRIC_CONSTRUCT_CALLS = {
-    "Counter", "Gauge", "Histogram", "bind", "get_metric",
-    "registry_snapshot", "wire_snapshot", "prometheus_text",
-    "attach_producer",
-}
-# Any metric recording at all is banned inside the raw BLOB frame paths —
-# a lock per frame there is a measured regression (pull metrics live at
-# whole-pull granularity in object_plane instead).
-_METRIC_RECORD_CALLS = {"inc", "observe", "record"}
+    return _msgs(_wire.dag_loop_findings(_ctx()))
 
 
 def check_hot_path_instruments() -> list:
-    """Hot-path telemetry contract: ``dag/exec_loop.py`` binds its
-    instruments at module import (and never constructs/looks one up inside
-    a function), and the BLOB send/recv frame paths (``peer._send_blob``/
-    ``_read_blob``, ``object_plane._h_chunk_raw``) carry NO metric calls at
-    all — no per-event registry lookups, no lock-per-frame regressions."""
-    errors = []
-    # 1) exec_loop: module-level bind exists...
-    loop_path = os.path.join(REPO, "ray_tpu", "dag", "exec_loop.py")
-    tree = ast.parse(open(loop_path).read(), filename="exec_loop.py")
-    top_binds = 0
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            callee = node.value.func
-            name = (callee.attr if isinstance(callee, ast.Attribute)
-                    else callee.id if isinstance(callee, ast.Name) else None)
-            if name == "bind":
-                top_binds += 1
-    if top_binds == 0:
-        errors.append(
-            "dag/exec_loop.py: no module-level instrument bind() — hot-loop "
-            "metrics must be bound at import time, not per event")
-    # ...and no function body constructs instruments / touches the registry
-    for fn in ast.walk(tree):
-        if not isinstance(fn, ast.FunctionDef):
-            continue
-        for lineno, callee in _calls_in(fn, _METRIC_CONSTRUCT_CALLS):
-            errors.append(
-                f"dag/exec_loop.py:{lineno}: {fn.name} calls {callee}() — "
-                "instruments bind at import time, never per event")
-    # 2) BLOB frame paths: zero metric traffic
-    for rel, fnames in (("ray_tpu/core/rpc/peer.py",
-                         {"_send_blob", "_read_blob"}),
-                        ("ray_tpu/core/object_plane.py", {"_h_chunk_raw"})):
-        path = os.path.join(REPO, rel)
-        fns = _find_funcs(ast.parse(open(path).read(), rel), fnames)
-        for fname in sorted(fnames):
-            fn = fns.get(fname)
-            if fn is None:
-                errors.append(f"{rel}: {fname} missing — BLOB path gone?")
-                continue
-            banned = _METRIC_CONSTRUCT_CALLS | _METRIC_RECORD_CALLS
-            for lineno, callee in _calls_in(fn, banned):
-                errors.append(
-                    f"{rel}:{lineno}: {fname} calls {callee}() — the raw "
-                    "BLOB frame path must stay metric-free (a lock per "
-                    "frame is a measured regression; account at pull "
-                    "granularity instead)")
-    return errors
+    return _msgs(_hotpath.hot_path_findings(
+        _ctx(), files={"ray_tpu/dag/exec_loop.py",
+                       "ray_tpu/core/rpc/peer.py",
+                       "ray_tpu/core/object_plane.py"}))
 
 
 def check_elastic_ops() -> list:
-    """The v6 elastic-gang ops are version-gated: a <v6 agent must never be
-    asked to serve ``plane_replicate`` (it has no handler), and a <v6 head
-    must never receive ``preempt_notice`` (undecodable op number) — the
-    sender checks ``negotiated_version`` before using either."""
-    from ray_tpu.core.rpc import schema
-
-    errors = []
-    for op in ("preempt_notice", "plane_replicate"):
-        spec = schema.REGISTRY.get(op)
-        if spec is None:
-            errors.append(f"{op} schema missing — elastic gang wire gone?")
-        elif spec.since < 6:
-            errors.append(f"{op} gated since={spec.since} < 6 — an old-wire "
-                          "peer would receive an op it cannot serve/decode")
-    spec = schema.REGISTRY.get("plane_replicate")
-    if spec is not None and not spec.blocking:
-        errors.append("plane_replicate must be blocking=True — the agent "
-                      "handler parks on a whole-object pull and must not "
-                      "occupy a bounded reactor slot")
-    return errors
+    return _msgs(_wire.gate_findings(
+        _ctx(), ops={"preempt_notice", "plane_replicate"}))
 
 
 def check_kv_transport() -> list:
-    """The v7 KV-transfer contract (ISSUE-11 PD disaggregation):
-
-    - ``kv_ack`` is version-gated (since>=7) — a <v7 holder must never
-      receive an op number it cannot decode; the puller skips the ack and
-      the publisher's TTL sweep reclaims instead.
-    - the handoff hot path (``KVTransport.publish``/``pull``) never
-      constructs or looks up a metric — instruments bind at module import
-      (the PR-8 hot-path contract; recording through bound handles is
-      fine, registry traffic per handoff is not).
-    - the pull path stays zero-copy: ``pull`` rides ``pull_into`` (BLOB
-      frames recv_into the local store slot), with the bytes-returning
-      ``pull`` only as the store-less fallback.
-    """
-    from ray_tpu.core.rpc import schema
-
-    errors = []
-    spec = schema.REGISTRY.get("kv_ack")
-    if spec is None:
-        errors.append("kv_ack schema missing — KV handoff ack gone?")
-    elif spec.since < 7:
-        errors.append(f"kv_ack gated since={spec.since} < 7 — an old-wire "
-                      "holder would receive an op it cannot decode")
-    path = os.path.join(REPO, "ray_tpu", "serve", "kv_transport.py")
-    if not os.path.exists(path):
-        return errors + ["ray_tpu/serve/kv_transport.py missing"]
-    tree = ast.parse(open(path).read(), filename="kv_transport.py")
-    fns = _find_funcs(tree, {"publish", "pull"})
-    for name in ("publish", "pull"):
-        fn = fns.get(name)
-        if fn is None:
-            errors.append(f"kv_transport.py: {name} missing — handoff "
-                          "path gone?")
-            continue
-        for lineno, callee in _calls_in(fn, _METRIC_CONSTRUCT_CALLS):
-            errors.append(
-                f"kv_transport.py:{lineno}: {name} calls {callee}() — the "
-                "handoff hot path must stay metric-construction-free "
-                "(bind instruments at import, record through the handles)")
-    if "pull" in fns and not _calls_in(fns["pull"],
-                                       {"pull_into", "pull_into_or_pull"}):
-        errors.append("kv_transport.py: pull no longer rides pull_into — "
-                      "KV pages must land zero-copy in the local store")
-    return errors
-
-
-# Streaming-data-plane hot functions (ISSUE-12): the operator pump and the
-# consumer-side fetch/prefetch loops. They may submit tasks and get objects
-# through the public ray_tpu API (which owns retry/failover), but must not
-# speak the wire directly nor construct/look up metrics per block —
-# instruments bind at operator-install time (the exec-loop/kv-transport
-# contract, applied to the data plane).
-_DATA_HOT_FUNCS = {
-    "ray_tpu/data/streaming.py": {
-        "_drive_op", "fetch_block", "_prefetch_pump", "__next__",
-        "_transform_to_plane", "_slice_to_plane",
-    },
-    "ray_tpu/data/exchange.py": {
-        "_reduce_partition", "_map_partition", "_pull_slices",
-    },
-}
-_DATA_HOT_FORBIDDEN_RPC = {"call", "call_async", "notify"}
+    ctx = _ctx()
+    return _msgs(_wire.gate_findings(ctx, ops={"kv_ack"}) +
+                 _hotpath.hot_path_findings(
+                     ctx, files={"ray_tpu/serve/kv_transport.py"}))
 
 
 def check_data_streaming_hot_path() -> list:
-    """The ISSUE-12 streaming hot path: pump/pull loops are
-    metric-bind()-only (no instrument construction or registry lookups per
-    block) and RPC-free (no direct wire calls — data moves via tasks +
-    plane pulls), and the data modules never import the wire layer."""
-    errors = []
-    for rel, fnames in sorted(_DATA_HOT_FUNCS.items()):
-        path = os.path.join(REPO, rel)
-        if not os.path.exists(path):
-            errors.append(f"{rel} missing — streaming data plane gone?")
-            continue
-        tree = ast.parse(open(path).read(), filename=rel)
-        # module must not link the control-plane wire directly
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.Import, ast.ImportFrom)):
-                mods = [a.name for a in node.names]
-                mods.append(getattr(node, "module", "") or "")
-                for m in mods:
-                    if m == "ray_tpu.core.rpc" or \
-                            m.startswith("ray_tpu.core.rpc."):
-                        errors.append(
-                            f"{rel}:{node.lineno}: imports {m} — the data "
-                            "plane rides tasks + plane pulls, never the "
-                            "wire directly")
-        fns = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.FunctionDef) and node.name in fnames:
-                fns.setdefault(node.name, node)
-        for fname in sorted(fnames):
-            fn = fns.get(fname)
-            if fn is None:
-                errors.append(f"{rel}: hot function {fname} missing — "
-                              "streaming pump/pull loop renamed? (update "
-                              "_DATA_HOT_FUNCS)")
-                continue
-            for lineno, callee in _calls_in(fn, _METRIC_CONSTRUCT_CALLS):
-                errors.append(
-                    f"{rel}:{lineno}: {fname} calls {callee}() — streaming "
-                    "hot path must record through handles bound at "
-                    "operator-install time, never construct/look up "
-                    "instruments per block")
-            for lineno, callee in _calls_in(fn, _DATA_HOT_FORBIDDEN_RPC):
-                errors.append(
-                    f"{rel}:{lineno}: {fname} calls {callee}() — streaming "
-                    "hot path is RPC-free (tasks and gets go through the "
-                    "public API)")
-    # the exchange's map stage must seal slices plane-side (put inside the
-    # task), and the reduce stage must PULL its own slices (get inside the
-    # task) — the ISSUE-12 plane-native contract
-    ex_path = os.path.join(REPO, "ray_tpu", "data", "exchange.py")
-    if os.path.exists(ex_path):
-        ex_fns = _find_funcs(ast.parse(open(ex_path).read(), "exchange.py"),
-                             {"_map_partition", "_reduce_partition"})
-        if "_map_partition" in ex_fns and \
-                not _calls_in(ex_fns["_map_partition"], {"put"}):
-            errors.append("exchange.py: _map_partition no longer seals "
-                          "slices via ray_tpu.put — slices must stay in "
-                          "the mapper's node store")
-        if "_reduce_partition" in ex_fns and \
-                not _calls_in(ex_fns["_reduce_partition"],
-                              {"get", "_pull_slices"}):
-            errors.append("exchange.py: _reduce_partition no longer pulls "
-                          "its own slices — reducers must resolve slices "
-                          "through the plane failover path themselves")
-    return errors
+    return _msgs(_hotpath.hot_path_findings(
+        _ctx(), files={"ray_tpu/data/streaming.py",
+                       "ray_tpu/data/exchange.py"}))
 
 
 def check_profiler_op() -> list:
-    """The v8 out-of-band profiler contract: ``profile_capture`` is
-    version-gated (since>=8 — a <v8 agent has no handler and must never be
-    sent the op; the head checks ``negotiated_version`` first) and
-    blocking (the agent-side handler parks for the whole sample window and
-    must not occupy a bounded reactor slot)."""
-    from ray_tpu.core.rpc import schema
-
-    errors = []
-    spec = schema.REGISTRY.get("profile_capture")
-    if spec is None:
-        return ["profile_capture schema missing — out-of-band profiler "
-                "wire gone?"]
-    if spec.since < 8:
-        errors.append(f"profile_capture gated since={spec.since} < 8 — an "
-                      "old-wire agent would receive an op it cannot serve")
-    if not spec.blocking:
-        errors.append("profile_capture must be blocking=True — the agent "
-                      "handler parks for the sample window")
-    # the metrics_push piggyback field must exist (the timeline half rides
-    # the v5 push; removing the field silently severs worker phase lanes)
-    push = schema.REGISTRY.get("metrics_push")
-    if push is not None and "phases" not in push.field_map():
-        errors.append("metrics_push lost its `phases` field — worker "
-                      "timeline entries have no transport")
-    return errors
-
-
-# The worker-side phase-stamping path (ISSUE-13 timeline): the stamp is a
-# ring append — it must never construct/look up instruments nor speak the
-# wire, exactly like the dag exec loop's sampled metrics.
-_PHASE_STAMP_FORBIDDEN = _METRIC_CONSTRUCT_CALLS | {
-    "call", "call_async", "notify", "remote", "submit_task",
-}
+    ctx = _ctx()
+    return _msgs(_wire.gate_findings(ctx, ops={"profile_capture"}) +
+                 _wire.profiler_piggyback_findings(ctx))
 
 
 def check_phase_stamp_hot_path() -> list:
-    """``util/timeline.py``'s recording half is bind-only: the stamp/record
-    functions make no instrument construction/lookup and no RPC, the
-    module never links the control plane, and the worker exec path
-    (``process_pool._worker_main``) actually stamps phases."""
-    errors = []
-    tl_path = os.path.join(REPO, "ray_tpu", "util", "timeline.py")
-    if not os.path.exists(tl_path):
-        return ["ray_tpu/util/timeline.py missing — cluster timeline gone?"]
-    tree = ast.parse(open(tl_path).read(), filename="timeline.py")
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            mods = [a.name for a in node.names]
-            mods.append(getattr(node, "module", "") or "")
-            for m in mods:
-                for f in _DAG_LOOP_FORBIDDEN_IMPORTS:
-                    if (m == f or m.startswith(f + ".")) \
-                            and f != "ray_tpu.core.runtime":
-                        errors.append(
-                            f"util/timeline.py:{node.lineno}: imports {m} — "
-                            "the recording module must not link the wire")
-    fns = _find_funcs(tree, {"phase_reply", "stamp_task_phases",
-                             "record_span", "drain_since"})
-    for name in ("phase_reply", "stamp_task_phases", "record_span",
-                 "drain_since"):
-        fn = fns.get(name)
-        if fn is None:
-            errors.append(f"util/timeline.py: {name} missing — phase "
-                          "recording path renamed? (update the lint)")
-            continue
-        for lineno, callee in _calls_in(fn, _PHASE_STAMP_FORBIDDEN):
-            errors.append(
-                f"util/timeline.py:{lineno}: {name} calls {callee}() — the "
-                "phase-stamping path is bind-only (ring append under one "
-                "lock; no instruments, no RPC)")
-    # export() may import the runtime (head-side merge), but the recording
-    # functions above may not — and both halves of the stamping path must
-    # stay wired: the worker exec path ships clocks on the done reply, the
-    # pool parent (head driver / node agent — the pushing processes) stamps
-    pp_path = os.path.join(REPO, "ray_tpu", "core", "process_pool.py")
-    pp_fns = _find_funcs(ast.parse(open(pp_path).read(), "process_pool.py"),
-                         {"_worker_main", "_reply_reader"})
-    wm = pp_fns.get("_worker_main")
-    if wm is None:
-        errors.append("process_pool.py: _worker_main missing")
-    elif not _calls_in(wm, {"phase_reply"}):
-        errors.append("process_pool.py: _worker_main no longer ships phase "
-                      "clocks on the done reply — worker timeline lanes go "
-                      "dark")
-    rr = pp_fns.get("_reply_reader")
-    if rr is None:
-        errors.append("process_pool.py: _reply_reader missing")
-    elif not _calls_in(rr, {"stamp_task_phases"}):
-        errors.append("process_pool.py: _reply_reader no longer stamps "
-                      "worker phase clocks into the parent's timeline ring")
-    return errors
+    return _msgs(_hotpath.hot_path_findings(
+        _ctx(), files={"ray_tpu/util/timeline.py",
+                       "ray_tpu/core/process_pool.py"}))
 
 
 def run_all() -> None:

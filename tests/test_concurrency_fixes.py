@@ -1,0 +1,122 @@
+"""Regression tests for the real findings graftlint's concurrency pass
+surfaced in the shipped tree (ISSUE-14: the analyzer pays for itself on
+day one).
+
+1. ``Runtime._deps_ready`` FAILED path popped lineage TaskSpecs
+   *discarded* under ``runtime._lock`` — a popped spec can hold the last
+   ObjectRef to a task arg, whose ``__del__`` -> ``_on_ref_zero`` ->
+   ``_free_plane_copies`` re-takes the non-reentrant lock: the exact
+   PR-5 deadlock class at a site the PR-5 fix missed.
+2. ``train.ingest.release_gang_shards`` popped the shard registry entry
+   discarded under ``_registry_lock`` — shard iterators hold BlockRefs
+   (ObjectRefs) and prefetch state, so their teardown ran object-release
+   paths while holding the lock every rank's ``take_rank_shards``
+   contends on.
+3. ``SpillManager.restore`` swallowed ``create_for_write`` failures
+   bare — a non-pressure failure silently turned every restore into a
+   file read. Now flight-recorded (``swallowed-exception`` rule).
+
+The drop-outside-the-lock tests use a sentinel whose ``__del__`` probes
+the lock: deterministic on CPython (refcount zero fires the destructor
+at the drop site).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import TaskSpec, get_runtime
+from ray_tpu._private.ids import JobID, TaskID
+
+
+class _LockProbe:
+    """Records, at __del__ time, whether `lock` was free (acquirable)."""
+
+    def __init__(self, lock, out: list):
+        self._lock = lock
+        self._out = out
+
+    def __del__(self):
+        ok = self._lock.acquire(blocking=False)
+        if ok:
+            self._lock.release()
+        self._out.append(ok)
+
+
+def test_deps_ready_failed_path_drops_lineage_outside_runtime_lock():
+    """graftlint ref-drop-under-lock @ runtime.py:_deps_ready — the
+    popped lineage entries must die AFTER self._lock is released."""
+    ray_tpu.init(num_cpus=1)
+    try:
+        rt = get_runtime()
+        ref = ray_tpu.put(b"payload")
+        oid = ref.object_id()
+        # make the dependency permanently lost: deleted, no lineage
+        rt.memory_store.delete([oid])
+        assert rt.memory_store.was_deleted(oid)
+        spec = TaskSpec(
+            task_id=TaskID.for_normal_task(JobID(os.urandom(JobID.SIZE))),
+            func=None, args=(ref,), kwargs={}, num_returns=1, resources={},
+            name="lint_regression")
+        probe_saw: list = []
+        rid = spec.return_ids()[0]
+        with rt._lock:
+            rt._lineage[rid] = _LockProbe(rt._lock, probe_saw)
+        assert rt._deps_ready(spec) == "FAILED"
+        assert probe_saw == [True], (
+            "lineage entry was destroyed while runtime._lock was held — "
+            "an ObjectRef in the entry would deadlock via _on_ref_zero")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_release_gang_shards_drops_registry_entry_outside_lock():
+    """graftlint ref-drop-under-lock @ train/ingest.py — shard teardown
+    (ObjectRef release paths) must not run under _registry_lock."""
+    from ray_tpu.train import ingest
+
+    probe_saw: list = []
+    key = "lint-regression-gang-shards"
+    with ingest._registry_lock:
+        ingest._registry[key] = _LockProbe(ingest._registry_lock, probe_saw)
+    ingest.release_gang_shards(key)
+    assert probe_saw == [True], (
+        "registry entry destroyed while _registry_lock was held — shard "
+        "teardown would stall/deadlock every rank's take_rank_shards")
+    # idempotent on a missing key
+    ingest.release_gang_shards(key)
+
+
+def test_spill_restore_reseat_failure_is_flight_recorded(tmp_path):
+    """graftlint swallowed-exception @ core/spill.py — a create_for_write
+    failure still serves the file copy, but now leaves evidence."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.core.spill import SpillManager
+    from ray_tpu.util import flight_recorder
+
+    class _FailingStore:
+        def create_for_write(self, oid, size):
+            raise RuntimeError("synthetic non-pressure failure")
+
+        def contains(self, oid):
+            return False
+
+    oid = ObjectID(os.urandom(ObjectID.SIZE))
+    payload = b"spilled-bytes"
+    path = tmp_path / oid.hex()
+    path.write_bytes(payload)
+
+    mgr = SpillManager(_FailingStore(), str(tmp_path))
+    mgr._spilled[oid] = (str(path), len(payload))
+    flight_recorder.clear()
+    blob = mgr.restore(oid)
+    assert bytes(blob) == payload, "file-copy fallback must still serve"
+    evts = [r for r in flight_recorder.records("spill")
+            if r["event"] == "restore_reseat_failed"]
+    assert len(evts) == 1
+    assert evts[0]["oid"] == oid.hex()
+    assert "synthetic non-pressure failure" in evts[0]["error"]
