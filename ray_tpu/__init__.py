@@ -30,6 +30,7 @@ from ray_tpu.core.api import (
     placement_group,
     placement_group_table,
     put,
+    put_batch,
     remote,
     remove_placement_group,
     shutdown,
@@ -48,6 +49,7 @@ __all__ = [
     "method",
     "get",
     "put",
+    "put_batch",
     "wait",
     "cancel",
     "kill",

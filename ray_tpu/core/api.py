@@ -164,11 +164,43 @@ def put(value: Any) -> ObjectRef:
     return get_runtime().put(value)
 
 
+def put_batch(values: list) -> list:
+    """N puts, one control-plane round trip (wire v9): inside a worker the
+    sealed entries register via a single ``client_put_seal_batch``; on the
+    head driver (or against an old-wire head) it degrades to a put loop."""
+    rt = get_runtime()
+    batch = getattr(rt, "put_batch", None)
+    if batch is not None:
+        return batch(list(values))
+    return [rt.put(v) for v in values]
+
+
 def get(refs, timeout: float | None = None):
     rt = get_runtime()
     if isinstance(refs, ObjectRef):
         return rt.get([refs], timeout)[0]
+    from ray_tpu.dag import CompiledDAGRef
+
+    if isinstance(refs, CompiledDAGRef):
+        # compiled-graph results live in the graph's result buffer, not the
+        # object store — consumers (serve router callers, ingresses) treat
+        # both ref kinds uniformly through this one entry point
+        return refs.get(timeout)
     if isinstance(refs, list):
+        if refs and any(isinstance(r, CompiledDAGRef) for r in refs):
+            # ONE deadline shared by the whole list (the homogeneous path's
+            # contract), not a fresh budget per element
+            import time as _time
+
+            deadline = (None if timeout is None
+                        else _time.monotonic() + timeout)
+
+            def remaining():
+                return (None if deadline is None
+                        else max(0.0, deadline - _time.monotonic()))
+
+            return [r.get(remaining()) if isinstance(r, CompiledDAGRef)
+                    else rt.get([r], remaining())[0] for r in refs]
         return rt.get(refs, timeout)
     raise TypeError(f"get() expects ObjectRef or list, got {type(refs)}")
 
@@ -212,6 +244,10 @@ _DEFAULT_TASK_OPTIONS = dict(
     # None follows config.task_execution (default: OS worker processes);
     # True/False force process/thread execution for this task.
     isolate_process=None,
+    # Soft input-holder locality (frozenset of NodeIDs): feasible nodes in
+    # the set win placement — streaming transforms pass their input
+    # block's holder so data stays where it was sealed.
+    locality_nodes=None,
 )
 
 _DEFAULT_ACTOR_OPTIONS = dict(
@@ -231,6 +267,11 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     # True: host the actor in a dedicated OS worker process (crash FT via
     # max_restarts, no GIL sharing with the driver) — reference default shape
     isolate_process=False,
+    # Explicit placement override (cross-node actor fabric, wire v9): a
+    # node-id hex string (or NodeID) pins the actor's dedicated worker to
+    # that agent — shorthand for NodeAffinitySchedulingStrategy. Requires
+    # isolate_process=True to actually land the process off-head.
+    node=None,
 )
 
 
@@ -331,6 +372,7 @@ class RemoteFunction:
             name=opts["name"] or self._fn.__name__,
             runtime_env=opts["runtime_env"],
             isolate_process=opts.get("isolate_process"),
+            locality_nodes=opts.get("locality_nodes"),
             **spec_kwargs,
         )
         refs = rt.submit_task(spec)
@@ -437,7 +479,13 @@ class ActorClass:
         rt = get_runtime()
         create_opts = dict(opts)
         spec_kwargs: dict = {}
-        _apply_strategy(spec_kwargs, opts.get("scheduling_strategy"))
+        strategy = opts.get("scheduling_strategy")
+        if opts.get("node") is not None and strategy is None:
+            # node= shorthand: pin the actor to that agent (hard affinity)
+            node = opts["node"]
+            strategy = NodeAffinitySchedulingStrategy(
+                node_id=node if isinstance(node, str) else node.hex())
+        _apply_strategy(spec_kwargs, strategy)
         if "placement_group" in spec_kwargs:
             create_opts["placement_group"] = spec_kwargs["placement_group"]
             create_opts["bundle_index"] = spec_kwargs.get("bundle_index", -1)
@@ -445,6 +493,10 @@ class ActorClass:
             create_opts["policy"] = spec_kwargs["policy"]
         if spec_kwargs.get("label_selector"):
             create_opts["label_selector"] = spec_kwargs["label_selector"]
+        if spec_kwargs.get("node_affinity") is not None:
+            create_opts["node_affinity"] = spec_kwargs["node_affinity"]
+            create_opts["node_affinity_soft"] = spec_kwargs.get(
+                "node_affinity_soft", False)
         actor_id = rt.create_actor(self._cls, args, kwargs, create_opts)
         return ActorHandle(actor_id, self._cls)
 

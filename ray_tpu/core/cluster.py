@@ -234,6 +234,9 @@ class ControlPlane:
             "client_put": self._h_client_put,
             "client_put_alloc": self._h_client_put_alloc,
             "client_put_seal": self._h_client_put_seal,
+            "client_put_seal_batch": self._h_client_put_seal_batch,
+            "actor_item": self._h_actor_item,
+            "actor_exit": self._h_actor_exit,
             "client_wait": self._h_client_wait,
             "client_free": self._h_client_free,
             "client_cancel": self._h_client_cancel,
@@ -388,6 +391,15 @@ class ControlPlane:
             # isolated-object-plane node: its store is served at this endpoint
             with rt._lock:
                 rt._plane_addrs[nid] = msg["plane_addr"]
+        if msg.get("fabric_addr"):
+            # v9: where this node serves compiled-graph fabric channels
+            with rt._lock:
+                rt._fabric_addrs[nid] = msg["fabric_addr"]
+        if msg.get("host_uid"):
+            # which MACHINE the agent shares (same-machine cross-node
+            # compiled edges attach rings by shm name, skipping TCP)
+            with rt._lock:
+                rt._host_uids[nid] = msg["host_uid"]
         # Re-announced plane objects (agent survived a head crash): restore
         # directory entries + get()-able markers for the primaries it pins.
         for oid_bin, size in msg.get("plane_objects") or ():
@@ -705,6 +717,37 @@ class ControlPlane:
             rt.hold_put_for_task(msg["task"], oid)
         return True
 
+    def _h_client_put_seal_batch(self, peer: RpcPeer, msg: dict):
+        """v9 batched form of client_put_seal: a data task's N output
+        blocks register in ONE round trip (entries: [[oid, size,
+        contained], ...]) instead of one blocking RPC each (ROADMAP
+        streaming follow-up (d)). Entries apply in order; a failure
+        mid-batch reports how many landed so the client can fall back
+        per-put for the remainder."""
+        done = 0
+        for entry in msg["entries"]:
+            oid_bin, size = entry[0], entry[1]
+            contained = entry[2] if len(entry) > 2 else None
+            self._h_client_put_seal(peer, {
+                "oid": oid_bin, "size": size, "contained": contained,
+                "task": msg.get("task"),
+            })
+            done += 1
+        return done
+
+    def _h_actor_item(self, peer: RpcPeer, msg: dict):
+        """v9 streaming-generator item from a remote actor's agent: route
+        to the in-flight call's on_item (remote_actor stream registry)."""
+        from ray_tpu.core import remote_actor
+
+        remote_actor.dispatch_item(msg)
+
+    def _h_actor_exit(self, peer: RpcPeer, msg: dict):
+        """v9 out-of-band worker-death notice from a node agent."""
+        self.runtime.on_remote_actor_exit(
+            ActorID(msg["actor"]), cause="actor worker process exited",
+            rc=msg.get("rc"), pid=msg.get("pid"))
+
     def _h_client_wait(self, peer: RpcPeer, msg: dict):
         rt = self.runtime
         if msg.get("task"):
@@ -820,9 +863,28 @@ class ControlPlane:
         res = self.runtime.dag_install(msg["spec"])
         gid = res["graph"]
         live = self.runtime.dag_channels(gid)
+        edges = res.get("edges") or {}
         driver_cids = list(res["input_chans"]) + [res["output_chan"]]
+
+        attached: list = []
+
+        def _bridge_chan(cid):
+            if cid in edges:
+                # driver edge hosted on a REMOTE node (cross-node fabric):
+                # the head bridges the client's dag_ch_* ops onto its own
+                # fabric connection (or a by-name ring attach for a
+                # same-machine node) — same read/write surface either way
+                from ray_tpu.dag import fabric
+
+                ch = fabric.build_edge(edges[cid], gid, cid)
+                if edges[cid][0] == "shm":
+                    attached.append(ch)
+                return ch
+            return live[cid]
+
         bridge = {
-            "chans": {cid: live[cid] for cid in driver_cids},
+            "chans": {cid: _bridge_chan(cid) for cid in driver_cids},
+            "attached": attached,
             # one lock per channel: a client retry after a local wire-budget
             # expiry must never run concurrently with the still-parked
             # previous handler on the same strictly single-reader channel
@@ -832,6 +894,18 @@ class ControlPlane:
         }
         with self._dag_lock:
             self._dag_bridges[gid] = bridge
+
+        def _close_bridge_chans(reason, chans=list(bridge["chans"].values())):
+            # graph aborted (actor/node death): close the bridge's channel
+            # ends so a parked client read/write raises promptly — a dead
+            # node's rings can't be closed by name (already unlinked)
+            for ch in chans:
+                try:
+                    ch.close_channel()
+                except Exception:
+                    logger.debug("bridge abort close failed", exc_info=True)
+
+        self.runtime.dag_register_abort_cb(gid, _close_bridge_chans)
         peer.meta.setdefault("dags", set()).add(gid)
         return {"graph": gid, "wire": True,
                 "input_chans": res["input_chans"],
@@ -876,13 +950,19 @@ class ControlPlane:
 
     def _dag_bridge_teardown(self, gid: bytes) -> None:
         # the bridge borrows the runtime's channel objects; teardown there
-        # closes + unlinks them
+        # closes + unlinks them (rings the bridge attached by name — a
+        # same-machine remote node's driver edges — just detach)
         with self._dag_lock:
-            self._dag_bridges.pop(gid, None)
+            bridge = self._dag_bridges.pop(gid, None)
         try:
             self.runtime.dag_teardown(gid)
         except Exception:
             pass
+        for ch in (bridge or {}).get("attached", ()):
+            try:
+                ch.detach()
+            except Exception as e:
+                logger.debug("bridge ring detach failed: %r", e)
 
     def _h_kv(self, peer: RpcPeer, msg: dict):
         from ray_tpu.experimental import internal_kv

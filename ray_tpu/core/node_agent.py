@@ -77,6 +77,22 @@ def main() -> None:
     pinned_objects: dict = {}
     pinned_lock = __import__("threading").Lock()
 
+    import threading as _threading
+
+    # ---- cross-node actor fabric (wire v9): dedicated actor workers this
+    # agent spawns + supervises, and the compiled-graph ring channels it
+    # hosts (served over the plane/fabric endpoint, dag/fabric.py)
+    from ray_tpu.dag.fabric import DagChannelHost
+    from ray_tpu.dag.fabric import machine_uid as _fabric_machine_uid
+
+    actors: dict = {}          # actor_bin -> DedicatedActorWorker
+    actors_lock = _threading.Lock()
+    actor_streams: dict = {}   # head stream id -> in-flight _ActorCall
+    exited_actors: dict = {}   # actor_bin -> rc, pending actor_exit notify
+    dag_host = DagChannelHost()
+    dag_records: dict = {}     # graph -> {"chans": {cid: ch}, "actors": set}
+    dag_lock = _threading.Lock()
+
     def h_execute_task(peer, msg):
         """Head-pushed task dispatch (reference: raylet grants a lease and the
         spec lands on a pooled worker, task_receiver.cc:228). Returns a
@@ -272,6 +288,241 @@ def main() -> None:
             target=work, daemon=True, name="profile-capture").start()
         return out
 
+    # ---- cross-node actor fabric handlers (wire v9, ISSUE 15) ----------
+    def _actor_log_base(name: str, actor_hex: str) -> "str | None":
+        log_dir = pool_box.get("log_dir")
+        if not log_dir:
+            return None
+        return os.path.join(log_dir, f"actor-{name}-{actor_hex}")
+
+    def h_actor_spawn(peer, msg):
+        """Spawn + supervise a dedicated worker hosting this actor on THIS
+        node (reference: any raylet leases a worker for an actor creation
+        task). Deferred reply: the remote __init__ may take seconds."""
+        from concurrent.futures import Future as _Future
+
+        from ray_tpu.core.process_pool import DedicatedActorWorker
+
+        out: _Future = _Future()
+
+        def work():
+            try:
+                worker = DedicatedActorWorker(
+                    shm_name=(local_store.name if local_store is not None
+                              else pool_box.get("shm_name")),
+                    shm_size=(local_store.size if local_store is not None
+                              else pool_box.get("shm_size") or 0),
+                    head_addr=args.head, token=args.token,
+                    log_base=_actor_log_base(msg.get("name") or "actor",
+                                             msg["actor"].hex()[:8]),
+                )
+                try:
+                    worker.init_actor_blob(
+                        msg["cls"], msg["args"], runtime_env=msg.get("renv"),
+                        max_concurrency=int(msg.get("max_concurrency") or 1),
+                        concurrency_groups=msg.get("concurrency_groups"))
+                except BaseException:
+                    worker.kill()
+                    raise
+                with actors_lock:
+                    actors[msg["actor"]] = worker
+                    # a pending death notice belongs to the PREVIOUS
+                    # incarnation — never re-send it over the respawn
+                    exited_actors.pop(msg["actor"], None)
+                out.set_result({"pid": worker.pid})
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        _threading.Thread(target=work, daemon=True,
+                          name="actor-spawn").start()
+        return out
+
+    def _actor_worker(actor_bin):
+        from ray_tpu.core.process_pool import WorkerCrashedError
+
+        with actors_lock:
+            worker = actors.get(actor_bin)
+        if worker is None:
+            raise WorkerCrashedError(
+                "no dedicated worker for this actor on this node "
+                "(killed, exited, or never spawned)")
+        return worker
+
+    def h_actor_call(peer, msg):
+        """One proxied actor method call -> deferred reply, so any number
+        of calls pipeline over the agent's standing connection (the
+        execute_task push model applied to actors). Generator calls
+        (`stream` set) forward every yielded item as an actor_item notify
+        BEFORE the final reply (same socket: order preserved)."""
+        from concurrent.futures import Future as _Future
+
+        from ray_tpu.core.process_pool import _RemoteTaskError
+
+        worker = _actor_worker(msg["actor"])
+        out: _Future = _Future()
+        stream_id = msg.get("stream")
+        on_item = None
+        if stream_id is not None:
+            def on_item(index, status, payload, extra, contained,
+                        _sid=stream_id):
+                peer.notify("actor_item", stream=_sid, index=index,
+                            status=status, payload=payload, extra=extra,
+                            contained=contained)
+
+        call = worker.submit_call(
+            msg["method"], msg["args"], msg.get("oid"), on_item=on_item,
+            task_bin=msg.get("oid")[:24] if msg.get("oid") else None,
+            backpressure=int(msg.get("backpressure") or 0),
+            group=msg.get("group"))
+        if stream_id is not None:
+            with actors_lock:
+                actor_streams[stream_id] = call
+
+        def _done(f):
+            if stream_id is not None:
+                with actors_lock:
+                    actor_streams.pop(stream_id, None)
+            try:
+                status, payload, size, contained = (
+                    tuple(f.result()) + (None,))[:4]
+            except _RemoteTaskError as e:
+                # unwrap so the ORIGINAL app exception type crosses the
+                # wire (typed, picklable) — retry matching behaves like
+                # local proc actors
+                orig = e.original_exception()
+                out.set_exception(
+                    orig if orig is not None else RuntimeError(e.remote_tb))
+                return
+            except BaseException as e:  # noqa: BLE001 — incl. crash
+                out.set_exception(e)
+                return
+            try:
+                if status == "shm" and local_store is not None:
+                    # sealed into THIS node's store: pin the primary here
+                    # and report it plane-resident (chunk-pullable)
+                    oid_bin = msg.get("oid")
+                    if oid_bin:
+                        local_store.pin(ObjectID(oid_bin))
+                        with pinned_lock:
+                            pinned_objects[oid_bin] = size
+                    out.set_result(["plane", payload, size, contained])
+                else:
+                    out.set_result([status, payload, size, contained])
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        call.future.add_done_callback(_done)
+        return out
+
+    def h_actor_ack(peer, msg):
+        """Generator consumed-count backpressure relay head -> worker."""
+        with actors_lock:
+            call = actor_streams.get(msg["stream"])
+        if call is not None:
+            call.ack(msg["consumed"])
+        return True
+
+    def h_actor_kill(peer, msg):
+        with actors_lock:
+            worker = actors.pop(msg["actor"], None)
+            exited_actors.pop(msg["actor"], None)
+        if worker is not None:
+            worker.kill()
+        _close_graphs_of(msg["actor"])
+        return True
+
+    def _close_graphs_of(actor_bin) -> None:
+        """An actor worker is gone: close every hosted ring of every graph
+        it participated in, so resident loops and far ends raise instead
+        of hanging (the edge-by-edge closure cascade's node-local start)."""
+        with dag_lock:
+            recs = [r for r in dag_records.values()
+                    if actor_bin in r["actors"]]
+        for rec in recs:
+            for ch in rec["chans"].values():
+                try:
+                    ch.close_channel()
+                except Exception as e:
+                    print(f"node agent: ring close failed: {e!r}",
+                          file=sys.stderr, flush=True)
+
+    def h_dag_node_install(peer, msg):
+        """Two-phase compiled-graph install on this node (see schema doc):
+        phase 1 creates + registers the rings this node HOSTS; phase 2
+        installs resident loops into this node's actor workers."""
+        import cloudpickle
+
+        from ray_tpu.core.shm_channel import ShmChannel
+
+        gid = msg["graph"]
+        with dag_lock:
+            rec = dag_records.setdefault(
+                gid, {"chans": {}, "actors": set()})
+        if msg.get("create"):
+            capacity = int(msg.get("capacity") or (1 << 20))
+            names = {}
+            for cid in msg["create"]:
+                ch = ShmChannel(capacity=capacity)
+                rec["chans"][cid] = ch
+                dag_host.register(gid, cid, ch)
+                names[cid] = ch.name
+            return {"chans": names}
+        if msg.get("plans"):
+            installs = cloudpickle.loads(msg["plans"])
+            for actor_bin, plan_blob, chan_descs in installs:
+                worker = _actor_worker(actor_bin)
+                worker.dag_install(plan_blob, chan_descs, gid)
+                rec["actors"].add(actor_bin)
+        return {}
+
+    def h_dag_node_teardown(peer, msg):
+        gid = msg["graph"]
+        dag_host.unregister_graph(gid)
+        with dag_lock:
+            rec = dag_records.pop(gid, None)
+        if rec is not None:
+            for ch in rec["chans"].values():
+                try:
+                    ch.destroy()  # close flag wakes local loops + far ends
+                except Exception as e:
+                    print(f"node agent: ring destroy failed: {e!r}",
+                          file=sys.stderr, flush=True)
+            # wake loops parked on channels hosted ELSEWHERE (a dead
+            # node's unlinked rings can only be closed by their mapping
+            # holders — the workers themselves)
+            for abin in rec["actors"]:
+                with actors_lock:
+                    worker = actors.get(abin)
+                if worker is not None:
+                    worker.dag_close(gid)
+        return True
+
+    def _sweep_dead_actors(p) -> None:
+        """Heartbeat-cadence supervision: a dedicated worker that died
+        OUTSIDE any in-flight call still gets its death reported (the head
+        runs the same restart path a WorkerCrashedError would trigger) and
+        its graphs' rings closed so nothing hangs waiting on it."""
+        with actors_lock:
+            dead = [(abin, w) for abin, w in actors.items()
+                    if not w.is_alive()]
+            for abin, w in dead:
+                actors.pop(abin, None)
+                exited_actors[abin] = (
+                    w.proc.returncode if w.proc.returncode is not None
+                    else -9, w.pid)
+            pending = list(exited_actors.items())
+        for abin, _ in dead:
+            _close_graphs_of(abin)
+        for abin, (rc, pid) in pending:
+            try:
+                # pid lets the head drop a notice that outlived its
+                # incarnation (the actor may already be respawned)
+                p.notify("actor_exit", actor=abin, rc=rc, pid=pid)
+                with actors_lock:
+                    exited_actors.pop(abin, None)
+            except wire.PeerDisconnected:
+                return  # re-sent on the next heartbeat after reconnect
+
     def h_kill_worker(peer, msg):
         return pool_box["pool"].kill_random_worker()
 
@@ -294,11 +545,27 @@ def main() -> None:
         "plane_free": h_plane_free,
         "plane_replicate": h_plane_replicate,
         "profile_capture": h_profile_capture,
+        "actor_spawn": h_actor_spawn,
+        "actor_call": h_actor_call,
+        "actor_ack": h_actor_ack,
+        "actor_kill": h_actor_kill,
+        "dag_node_install": h_dag_node_install,
+        "dag_node_teardown": h_dag_node_teardown,
         "kill_worker": h_kill_worker,
         "num_alive": h_num_alive,
         "ping": h_ping,
         "shutdown": h_shutdown,
     }
+
+    # Fabric endpoint: where OTHER nodes (and the head driver) read/write
+    # the compiled-graph rings this node hosts. Isolated-plane nodes serve
+    # it on the plane endpoint (one data-plane listener); shared-plane
+    # agents run a dedicated fabric server.
+    fabric_server = None
+    if plane_server is not None:
+        plane_server.server.add_handlers(dag_host.handlers())
+    else:
+        fabric_server = wire.RpcServer(dag_host.handlers(), host="0.0.0.0")
 
     def connect_and_register():
         """One connect+hello+register round; returns (peer, reg-reply)."""
@@ -316,6 +583,10 @@ def main() -> None:
             if plane_server is not None:
                 _, plane_port = plane_server.server.address
                 plane_addr = f"{peer.local_address[0]}:{plane_port}"
+            fabric_addr = plane_addr
+            if fabric_server is not None:
+                _, fabric_port = fabric_server.address
+                fabric_addr = f"{peer.local_address[0]}:{fabric_port}"
             with pinned_lock:
                 plane_objects = list(pinned_objects.items())
             reg = peer.call(
@@ -329,6 +600,8 @@ def main() -> None:
                 node_id=node_id.binary(),
                 plane_addr=plane_addr,
                 plane_objects=plane_objects,
+                fabric_addr=fabric_addr,
+                host_uid=_fabric_machine_uid(),
                 timeout=10,
             )
         except BaseException:
@@ -364,6 +637,10 @@ def main() -> None:
         )
 
     pool_box["pool"] = make_pool(shm_name, shm_size, reg.get("log_dir"))
+    # actor_spawn reads these for dedicated workers (shared-plane nodes
+    # hand workers the head segment; isolated nodes their local store)
+    pool_box["shm_name"], pool_box["shm_size"] = shm_name, shm_size
+    pool_box["log_dir"] = reg.get("log_dir")
 
     def _node_stats() -> dict:
         """Per-node physical stats shipped with every heartbeat (reference:
@@ -489,6 +766,8 @@ def main() -> None:
                 peer.notify("heartbeat", stats=_node_stats())
                 _maybe_push_metrics(peer)
                 _maybe_send_preempt(peer)
+                if (peer.negotiated_version or 0) >= 9:
+                    _sweep_dead_actors(peer)
             except wire.PeerDisconnected:
                 pass
             if peer.closed:
@@ -533,6 +812,27 @@ def main() -> None:
             pool_box["pool"].shutdown()
         except Exception:
             pass
+        with actors_lock:
+            doomed = list(actors.values())
+            actors.clear()
+        for w in doomed:
+            try:
+                w.kill()
+            except Exception as e:
+                print(f"node agent: actor worker kill failed: {e!r}",
+                      file=sys.stderr, flush=True)
+        with dag_lock:
+            dag_recs = list(dag_records.values())
+            dag_records.clear()
+        for rec in dag_recs:
+            for ch in rec["chans"].values():
+                try:
+                    ch.destroy()
+                except Exception as e:
+                    print(f"node agent: ring destroy failed: {e!r}",
+                          file=sys.stderr, flush=True)
+        if fabric_server is not None:
+            fabric_server.close()
         if cgroups is not None:
             try:  # retire the agent's cgroup subtree (matches head shutdown)
                 cgroups.cleanup()

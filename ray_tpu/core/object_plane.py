@@ -297,6 +297,17 @@ class PlaneClient:
         with self._hp_lock:
             return {a: n for a, n in self._holder_pending.items() if n > 0}
 
+    def _order_by_pending(self, entries: list) -> list:
+        """Candidate holders least-loaded first (ISSUE-15 satellite): the
+        stripe set is picked in this order, so a holder already owing this
+        process many chunk bytes — the node_io_view per-holder signal's
+        process-local source — is preferred LAST instead of whatever
+        directory order round-robin happened to return. Stable sort:
+        equally-idle holders keep directory order."""
+        with self._hp_lock:
+            pending = dict(self._holder_pending)
+        return sorted(entries, key=lambda e: pending.get(e[1], 0))
+
     def _note_pending(self, addr: str, delta: int) -> None:
         with self._hp_lock:
             n = self._holder_pending.get(addr, 0) + delta
@@ -464,7 +475,7 @@ class PlaneClient:
         try:
             while True:
                 holders = []
-                for token, addr in entries:
+                for token, addr in self._order_by_pending(entries):
                     if addr in stale or fails[addr] >= 2 or \
                             any(a == addr for _, a in holders):
                         continue

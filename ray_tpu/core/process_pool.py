@@ -415,6 +415,8 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
     # (max_concurrency=1 actors keep sequential semantics while a graph
     # loop runs in this process; see dag/exec_loop.py step_lock)
     actor_step_mutex = threading.Lock()
+    # graph_id -> channel objects installed loops hold (dag_close cascade)
+    dag_channels_by_graph: dict = {}
     actor_group_pools: dict = {}  # named concurrency group -> its own pool
     # (reference: concurrency_group_manager.cc runs sync calls on a pool of
     # max_concurrency threads inside the worker; user code owns its locking)
@@ -501,6 +503,18 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
             except BaseException as e:  # noqa: BLE001
                 _reply(_error_payload(e))
             continue
+        if kind == "dag_close":
+            # the head/agent cascading a graph abort: close THIS worker's
+            # channel mappings so its resident loop wakes with
+            # ChannelClosed — rings hosted by a DEAD node were already
+            # unlinked, so only mapping holders can flip the closed flag
+            for ch in dag_channels_by_graph.pop(req[1], ()):
+                try:
+                    ch.close_channel()
+                except Exception as e:
+                    print(f"worker: dag_close channel failed: {e!r}",
+                          flush=True)
+            continue
         if kind == "dag_install":
             # ("dag_install", seq, plan_blob, chan_names): attach the
             # compiled graph's shm channels and run the static schedule on a
@@ -513,8 +527,22 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
                 from ray_tpu.dag import exec_loop
 
                 plan = cloudpickle.loads(req[2])
-                chans = {cid: ShmChannel(name=name, create=False)
-                         for cid, name in req[3].items()}
+                graph_id = req[4] if len(req) > 4 else b""
+                # channel descriptors: a str is a node-local ring attached
+                # by name; an ["addr", kind] pair is a CROSS-NODE edge
+                # bridged through a pre-opened fabric peer (wire v9 —
+                # dag/fabric.py; kind "read": this actor consumes a ring
+                # hosted on the producer's node)
+                chans = {}
+                for cid, desc in req[3].items():
+                    if isinstance(desc, str):
+                        chans[cid] = ShmChannel(name=desc, create=False)
+                    else:
+                        from ray_tpu.dag import fabric
+
+                        chans[cid] = fabric.build_edge(desc, graph_id, cid)
+                dag_channels_by_graph.setdefault(graph_id, []).extend(
+                    chans.values())
                 threading.Thread(
                     target=exec_loop.run_plan,
                     args=(actor_instance, plan, chans),
@@ -1012,22 +1040,45 @@ class DedicatedActorWorker:
     def init_actor(self, cls, args_blob: bytes, runtime_env: dict | None = None,
                    max_concurrency: int = 1,
                    concurrency_groups: dict | None = None) -> None:
+        self.init_actor_blob(cloudpickle.dumps(cls), args_blob,
+                             runtime_env=runtime_env,
+                             max_concurrency=max_concurrency,
+                             concurrency_groups=concurrency_groups)
+
+    def init_actor_blob(self, cls_blob: bytes, args_blob: bytes,
+                        runtime_env: dict | None = None,
+                        max_concurrency: int = 1,
+                        concurrency_groups: dict | None = None) -> None:
+        """Init from an already-pickled class: a node agent relaying a
+        head-shipped actor_spawn forwards the blob verbatim — user code
+        deserializes only inside the worker, never in the agent."""
         with self._mu:
             if self._dead:
                 raise WorkerCrashedError("actor worker process died")
             fut = self._init_fut = Future()
         try:
-            self._send(("actor_init", cloudpickle.dumps(cls), args_blob,
+            self._send(("actor_init", cls_blob, args_blob,
                         runtime_env, max_concurrency, concurrency_groups))
         except (BrokenPipeError, OSError) as e:
             raise WorkerCrashedError("actor worker process died") from e
         fut.result()
 
-    def dag_install(self, plan_blob: bytes, chan_names: dict) -> None:
+    def dag_close(self, graph_id: bytes) -> None:
+        """Cascade a graph abort into the worker: it closes its own channel
+        mappings (no ack — the loop's ChannelClosed exit is the effect)."""
+        try:
+            self._send(("dag_close", graph_id))
+        except (BrokenPipeError, OSError):
+            pass  # worker already dead: nothing left to wake
+
+    def dag_install(self, plan_blob: bytes, chan_names: dict,
+                    graph_id: bytes = b"") -> None:
         """Install a compiled-graph resident loop in the worker process: it
-        attaches the named shm channels and drives the actor instance through
-        the static plan until the channels close (dag/exec_loop.py). Blocks
-        until the worker acks the attach (or reports the error)."""
+        attaches the named shm channels (cross-node edges arrive as
+        ["addr", kind] fabric descriptors instead of names) and drives the
+        actor instance through the static plan until the channels close
+        (dag/exec_loop.py). Blocks until the worker acks the attach (or
+        reports the error)."""
         with self._mu:
             if self._dead:
                 raise WorkerCrashedError("actor worker process died")
@@ -1035,7 +1086,8 @@ class DedicatedActorWorker:
             self._seq += 1
             fut = self._dag_futs[seq] = Future()
         try:
-            self._send(("dag_install", seq, plan_blob, dict(chan_names)))
+            self._send(("dag_install", seq, plan_blob, dict(chan_names),
+                        graph_id))
         except (BrokenPipeError, OSError) as e:
             with self._mu:
                 self._dag_futs.pop(seq, None)

@@ -111,7 +111,15 @@ class RpcPeer:
         name: str = "peer",
         reactor: Reactor | None = None,
         versions: tuple[int, int] | None = None,
+        count_ops: bool = True,
     ):
+        # count_ops=False marks a DATA-plane connection (compiled-graph
+        # fabric edges): its traffic is accounted under "fabric:<op>"
+        # counters instead of "rpc:<op>", so the zero-control-plane
+        # steady-state assertion (opcount.delta over "rpc:*") holds even
+        # when step frames cross nodes. Control-plane peers keep the
+        # default.
+        self._count_ops = count_ops
         self._sock = sock
         self._handlers = handlers or {}
         for op in self._handlers:
@@ -211,7 +219,7 @@ class RpcPeer:
         spec = get_op(op)
         self._check_version(spec)
         payload = validate_payload(spec, payload, outbound=True)
-        opcount.bump(f"rpc:{op}")
+        opcount.bump(f"rpc:{op}" if self._count_ops else f"fabric:{op}")
         mid = next(self._ids)
         fut: Future = Future()
         with self._plock:
@@ -245,7 +253,7 @@ class RpcPeer:
         spec = get_op(op)
         self._check_version(spec)
         payload = validate_payload(spec, payload, outbound=True)
-        opcount.bump(f"rpc:{op}")
+        opcount.bump(f"rpc:{op}" if self._count_ops else f"fabric:{op}")
         self._send_raw(codec.notify_frame(spec.num, payload))
 
     def _check_version(self, spec) -> None:
@@ -615,6 +623,16 @@ class RpcServer:
             if self._on_connect is not None:
                 self._on_connect(peer)
 
+    def add_handlers(self, handlers: dict) -> None:
+        """Register additional schema'd ops on this endpoint after
+        construction (the dag fabric attaches its channel ops to an already
+        -running plane server). The handler dict is shared by reference
+        with every accepted peer, so future AND existing connections see
+        the new ops."""
+        for op in handlers:
+            get_op(op)
+        self._handlers.update(handlers)
+
     def _peer_gone(self, peer: RpcPeer) -> None:
         with self._lock:
             if peer in self.peers:
@@ -644,12 +662,13 @@ def connect(
     name: str = "client",
     versions: tuple[int, int] | None = None,
     wait_negotiated: bool = True,
+    count_ops: bool = True,
 ) -> RpcPeer:
     sock = socket.create_connection((host, port), timeout=timeout)
     sock.settimeout(None)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     peer = RpcPeer(sock, handlers, on_disconnect=on_disconnect, name=name,
-                   versions=versions)
+                   versions=versions, count_ops=count_ops)
     if wait_negotiated:
         try:
             peer.wait_negotiated(timeout)

@@ -52,6 +52,17 @@ Version history:
   optional ``phases`` field — inbound-tolerant <v8 heads simply drop it,
   so no gating is needed for the timeline half. A <v8 agent cannot serve
   captures; the head falls back to the remote-task jax-profiler path.
+- v9: cross-node actor fabric — ``actor_spawn``/``actor_call``/
+  ``actor_item``/``actor_ack``/``actor_kill`` (a node agent spawns and
+  supervises dedicated actor workers; the head proxies method calls over
+  the agent's standing connection), ``dag_node_install``/
+  ``dag_node_teardown``/``dag_ch_close`` (compiled-graph rings created on
+  the nodes that host their producers; cross-node edges ride the EXISTING
+  v4 ``dag_ch_write``/``dag_ch_read`` ops served agent-to-agent on plane
+  endpoints — data plane, zero control-plane traffic per step),
+  ``actor_exit`` (out-of-band worker-death notice), and
+  ``client_put_seal_batch`` (N sealed puts registered in one RPC). A <v9
+  agent keeps head-host actors and per-call dispatch.
 """
 
 from __future__ import annotations
@@ -61,7 +72,7 @@ from typing import Optional
 
 # The schema version this build speaks, and the oldest it can fall back to.
 # Peers negotiate min(max_a, max_b) at hello; see negotiate().
-WIRE_VERSION = 8
+WIRE_VERSION = 9
 WIRE_VERSION_MIN = 1
 
 # Protocol magic sent in the hello frame: rejects foreign/legacy peers with
@@ -228,6 +239,12 @@ register_op(2, "register_node", [
     _f("slice_name", T.STR), _f("ici_coords", T.ANY), _f("pid", T.INT),
     _f("name", T.STR), _f("node_id", T.BYTES), _f("plane_addr", T.STR),
     _f("plane_objects", T.ANY),
+    # v9 appended (inbound-tolerant): where this node serves compiled-graph
+    # fabric channels (dag_ch_* — usually the plane endpoint; shared-plane
+    # agents run a dedicated fabric server), and which MACHINE the agent
+    # runs on (same-machine nodes attach each other's rings by shm name
+    # instead of bridging over TCP)
+    _f("fabric_addr", T.STR), _f("host_uid", T.STR),
 ], doc="agent joins; reply {node_id, shm_name, shm_size, log_dir}")
 register_op(3, "heartbeat", [_f("stats", T.ANY)],
             doc="agent liveness + node physical stats (notify)")
@@ -440,3 +457,81 @@ register_op(60, "profile_capture", [
         "— or {pid, size, blob, plane: false} inline on a shared-plane "
         "node. blocking: parks for the sample window, must not occupy a "
         "bounded reactor slot")
+
+# -- cross-node actor fabric (v9; reference: every actor is a CoreWorker
+#    process scheduled by ANY raylet — node-anywhere actors). The head asks a
+#    node agent to spawn + supervise a dedicated actor worker; method calls
+#    proxy over the agent's standing connection (deferred replies pipeline
+#    like execute_task); compiled-graph edges between nodes ride the
+#    persistent dag_ch_write/dag_ch_read ops served agent-to-agent on the
+#    DATA plane (plane endpoint, not the head control plane). Version-gated:
+#    a <v9 agent keeps head-host actors and per-call dispatch.
+register_op(61, "actor_spawn", [
+    _f("actor", T.BYTES, required=True), _f("cls", T.BLOB, required=True),
+    _f("args", T.BLOB, required=True), _f("renv", T.ANY),
+    _f("max_concurrency", T.INT), _f("concurrency_groups", T.ANY),
+    _f("name", T.STR)], since=9,
+    doc="head -> agent: spawn a dedicated worker hosting this actor "
+        "(DedicatedActorWorker on the agent's node); deferred reply "
+        "resolves after the remote __init__ finishes")
+register_op(62, "actor_call", [
+    _f("actor", T.BYTES, required=True), _f("method", T.STR, required=True),
+    _f("args", T.BLOB, required=True), _f("oid", T.BYTES),
+    _f("group", T.STR), _f("stream", T.INT), _f("backpressure", T.INT)],
+    since=9,
+    doc="head -> agent: one actor method call proxied to the node's "
+        "dedicated worker; deferred reply [status, payload, size, "
+        "contained] — results sealed into the node store come back as "
+        "status='plane'. `stream` (a head-minted id) marks a generator "
+        "call whose items ride actor_item notifies before the final reply")
+register_op(63, "actor_item", [
+    _f("stream", T.INT, required=True), _f("index", T.INT, required=True),
+    _f("status", T.STR, required=True), _f("payload", T.BLOB),
+    _f("extra", T.ANY), _f("contained", T.ANY)], since=9,
+    doc="agent -> head (notify): one yielded item of a streaming actor "
+        "method (socket order: all items precede the actor_call reply)")
+register_op(64, "actor_ack", [
+    _f("actor", T.BYTES, required=True), _f("stream", T.INT, required=True),
+    _f("consumed", T.INT, required=True)], since=9,
+    doc="head -> agent (notify): generator consumed-count backpressure ack, "
+        "relayed to the worker so it resumes yielding")
+register_op(65, "actor_kill", [
+    _f("actor", T.BYTES, required=True)], since=9,
+    doc="head -> agent: SIGKILL the actor's dedicated worker and drop its "
+        "record (ray.kill / restart both route here for remote actors)")
+register_op(66, "dag_node_install", [
+    _f("graph", T.BYTES, required=True), _f("create", T.ANY),
+    _f("capacity", T.INT), _f("plans", T.BLOB), _f("remotes", T.ANY)],
+    since=9, blocking=True,
+    doc="head -> agent, two-phase: phase 1 (`create`: chan ids) makes the "
+        "node's ring channels + registers them with the fabric host (they "
+        "become readable/writable via dag_ch_* on the plane endpoint) and "
+        "replies {chan: ring_name}; phase 2 (`plans` + `remotes`: "
+        "{chan: [addr, kind]}) installs resident loops into this node's "
+        "actor workers, remote edges bridged through pre-opened fabric "
+        "peers. blocking: worker installs ack synchronously")
+register_op(67, "dag_node_teardown", [
+    _f("graph", T.BYTES, required=True)], since=9, blocking=True,
+    doc="head -> agent: close + destroy this node's rings for the graph; "
+        "resident loops exit on ChannelClosed (local shm flag, or a "
+        "fabric read/write observing the closure)")
+register_op(68, "dag_ch_close", [
+    _f("graph", T.BYTES, required=True), _f("chan", T.INT, required=True)],
+    since=9,
+    doc="fabric peer -> channel host (notify): close one hosted ring — the "
+        "cross-node half of the edge-by-edge closure cascade (a remote "
+        "loop's finally closes every channel its plan touches)")
+register_op(69, "actor_exit", [
+    _f("actor", T.BYTES, required=True), _f("rc", T.INT),
+    # pid of the worker that died: the head matches it against the LIVE
+    # proxy so a delayed/re-sent notice can never kill a restarted
+    # (healthy) incarnation
+    _f("pid", T.INT)], since=9,
+    doc="agent -> head (notify): a dedicated actor worker exited outside "
+        "any in-flight call; the head runs the same death/restart path a "
+        "WorkerCrashedError on a call would have triggered")
+register_op(70, "client_put_seal_batch", [
+    _f("entries", T.ANY, required=True), _f("task", T.BYTES)], since=9,
+    doc="worker -> head: register MANY client-minted sealed puts in one "
+        "round trip (entries: [[oid, size, contained], ...]) — a data "
+        "task's output blocks cost one RPC per task, not one per block")

@@ -89,6 +89,9 @@ class TaskSpec:
     label_selector: dict[str, str] | None = None
     placement_group: PlacementGroupState | None = None
     bundle_index: int = -1
+    # soft input-holder locality: nodes already holding this task's input
+    # blocks score up in _select (streaming transform placement satellite)
+    locality_nodes: "frozenset | None" = None
     # actor linkage
     actor_id: ActorID | None = None
     method_name: str = ""
@@ -242,13 +245,29 @@ class _DagRecord:
         self.channels: dict[int, Any] = {}      # chan_id -> ShmChannel
         self.threads: list[threading.Thread] = []
         self.actor_bins: set[bytes] = set()
+        # NodeIDs hosting rings/loops of this graph (cross-node fabric);
+        # abort/teardown cascade to their agents
+        self.nodes: set = set()
+        # per-node ring names + machine uids: a DEAD node's same-machine
+        # rings are closed by direct shm attach (no agent left to ask)
+        self.node_rings: dict = {}
+        self.node_uids: dict = {}
         self.stop_monitor = threading.Event()
         self.dead_reason: str | None = None
+        self._abort_remote = None  # set by dag_install for cross-node graphs
+        # driver/bridge hooks fired on abort: they close channel objects
+        # only THEIR process has mapped (attached same-machine rings whose
+        # creator node died can't be re-attached — the dead agent's
+        # resource tracker already unlinked the names)
+        self.abort_cbs: list = []
 
     def abort(self, reason: str) -> None:
         """Close every channel: each resident loop (and the driver drain)
         wakes with ChannelClosed, so every in-flight execute() raises
-        instead of hanging. Idempotent; destroy() still owns the unlink."""
+        instead of hanging. Cross-node graphs also get their remote rings
+        closed (best-effort, off-thread — abort may run on a liveness
+        monitor that must not park on a dead agent's socket). Idempotent;
+        destroy() still owns the unlink."""
         if self.dead_reason is None:
             self.dead_reason = reason
         self.stop_monitor.set()
@@ -257,6 +276,17 @@ class _DagRecord:
                 ch.close_channel()
             except Exception:
                 pass
+        cbs, self.abort_cbs = list(self.abort_cbs), []
+        for cb in cbs:  # non-blocking channel closes; see abort_cbs
+            try:
+                cb(reason)
+            except Exception:
+                logging.getLogger("ray_tpu").debug(
+                    "dag abort hook failed", exc_info=True)
+        cb, self._abort_remote = self._abort_remote, None
+        if cb is not None:
+            threading.Thread(target=cb, daemon=True,
+                             name="dag-abort-remote").start()
 
 
 class Runtime:
@@ -322,8 +352,21 @@ class Runtime:
         # the ref_drop-vs-result borrow race; see hold_put_for_task)
         self._task_put_holds: dict[bytes, list] = {}
         self._plane_addrs: dict[NodeID, str] = {}
+        # node -> compiled-graph fabric endpoint (where that node serves
+        # dag_ch_* for rings it hosts; wire v9 — usually == plane_addr)
+        self._fabric_addrs: dict[NodeID, str] = {}
+        # node -> machine identity: same-machine cross-node edges attach
+        # rings by shm name (the multi-agent-one-box topology); only
+        # genuinely cross-HOST edges pay the wire bridge
+        self._host_uids: dict[NodeID, str] = {}
         self.plane_server = None
         self.plane_client = None
+        # rings the HEAD hosts for cross-node graphs (edges whose producer
+        # is a head-hosted actor, consumed by a remote node), served on the
+        # head's plane endpoint
+        from ray_tpu.dag.fabric import DagChannelHost
+
+        self._dag_host = DagChannelHost()
         if self.shm_store is not None:
             try:
                 from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
@@ -334,6 +377,8 @@ class Runtime:
                 self.plane_server = ObjectPlaneServer(
                     self.shm_store, host=config.control_plane_host,
                     spill=self.spill)
+                self.plane_server.server.add_handlers(
+                    self._dag_host.handlers())
                 self.plane_client = PlaneClient()
             except Exception as e:  # pragma: no cover
                 logger.warning("object plane unavailable: %s", e)
@@ -420,6 +465,11 @@ class Runtime:
         import weakref
 
         self._fn_blob_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+        # Placement scoring: the scheduler consumes the PR-8 node_io_view
+        # pressure signal through this provider (cached ≤1/s — _select runs
+        # per dispatch decision)
+        self._io_pressure_cache: "tuple[float, dict]" = (0.0, {})
+        self.scheduler.set_io_pressure_provider(self._io_pressure_by_node)
         self._dispatcher = threading.Thread(target=self._dispatch_loop, daemon=True, name="ray_tpu-dispatcher")
         self._dispatcher.start()
         from collections import deque
@@ -431,6 +481,12 @@ class Runtime:
             maxlen=config.task_events_max_buffer)
 
     # ------------------------------------------------------------------ objects
+    def put_batch(self, values: list) -> list:
+        """Head-driver puts are local store writes (no wire) — the batch
+        form exists for surface parity with ClientRuntime.put_batch, where
+        it collapses N seal RPCs into one."""
+        return [self.put(v) for v in values]
+
     def put(self, value: Any) -> ObjectRef:
         """Reference: CoreWorker::Put (core_worker.cc:1026) + worker.py:3024 ray.put."""
         with self._lock:
@@ -762,6 +818,44 @@ class Runtime:
         self._expire_seeded_planes()
         with self._lock:
             return bool(self._plane_locations.get(oid))
+
+    def plane_holder_nodes(self, oid: ObjectID) -> "frozenset | None":
+        """NodeIDs whose local stores hold ``oid`` — the locality hint the
+        streaming scheduler attaches to transform tasks (directory has
+        locations, scheduler has pressure: this joins them)."""
+        with self._lock:
+            nids = self._plane_locations.get(oid)
+            return frozenset(nids) if nids else None
+
+    def _io_pressure_by_node(self) -> dict:
+        """{NodeID: 0..1}: fraction of the plane pull budget each node has
+        pending (node_io_view), cached ≤1/s for per-dispatch use."""
+        ts, cached = self._io_pressure_cache
+        now = time.monotonic()
+        if now - ts < 1.0:
+            return cached
+        out: dict = {}
+        try:
+            from ray_tpu.core import object_plane
+            from ray_tpu.util import state as _state
+
+            budget = max(1, object_plane.pull_budget_bytes())
+            view = _state.node_io_view()
+            for key, row in view["nodes"].items():
+                if key == "head":
+                    continue  # head rows aren't scheduler NodeIDs
+                try:
+                    nid = NodeID(bytes.fromhex(key))
+                except ValueError:
+                    continue
+                out[nid] = min(
+                    1.0, float(row.get("pending_pull_bytes") or 0) / budget)
+        except Exception as e:
+            logger.debug("io-pressure sample failed (%r); scheduling on "
+                         "capacity alone", e)
+            out = {}
+        self._io_pressure_cache = (now, out)
+        return out
 
     def plane_holder_addrs(self, oid: ObjectID, include_head: bool = True) -> list:
         """(node_bin|None, addr) pairs for object-plane endpoints currently
@@ -1578,9 +1672,21 @@ class Runtime:
         # next access misses the directory and falls to lineage reconstruction.
         from ray_tpu._private import persistence
 
+        # Actors whose dedicated workers lived on the dead node: run the
+        # same death/restart path a WorkerCrashedError on a call would —
+        # OUT-OF-BAND, so an idle remote actor's death doesn't wait for the
+        # next call to surface, and its compiled graphs abort promptly
+        # (get() raises instead of hanging — the chaos contract).
+        for actor_id, st in list(self._actors.items()):
+            pw = st.proc_worker
+            if pw is not None and getattr(pw, "node_id", None) == node_id:
+                self.on_remote_actor_exit(actor_id,
+                                          cause="node agent died")
         store = persistence.get_store()
         with self._lock:
             self._plane_addrs.pop(node_id, None)
+            dropped_fabric = (self._fabric_addrs.pop(node_id, None),
+                              self._host_uids.pop(node_id, None))
             for oid, holders in list(self._plane_locations.items()):
                 if node_id in holders:
                     holders.discard(node_id)
@@ -1588,6 +1694,7 @@ class Runtime:
                         store.plane_remove(oid.binary(), node_id.binary())
                     if not holders:
                         self._plane_locations.pop(oid, None)
+        del dropped_fabric  # dies outside _lock (graftlint ref-drop rule)
         try:
             self.publisher.publish("nodes", {"node_id": node_id.hex(), "event": "dead"})
         except Exception:
@@ -2144,6 +2251,8 @@ class Runtime:
             resources=options.get("resources_full") or {"CPU": options.get("num_cpus", 1.0), **(options.get("resources") or {})},
             name=f"{cls.__name__}.__init__",
             policy=options.get("policy", "hybrid"),
+            node_affinity=options.get("node_affinity"),
+            node_affinity_soft=options.get("node_affinity_soft", False),
             label_selector=options.get("label_selector"),
             placement_group=options.get("placement_group"),
             bundle_index=options.get("bundle_index", -1),
@@ -2254,6 +2363,15 @@ class Runtime:
 
         import os as _os
 
+        # Cross-node actor fabric (wire v9): the scheduler leased a REAL
+        # agent node for this actor — land the dedicated worker THERE
+        # (reference: actors live node-anywhere; any raylet leases the
+        # worker). A <v9 agent keeps the pre-fabric behavior: the worker
+        # spawns on the head host.
+        agent = self._agents.get(state.node_id) if state.node_id else None
+        if agent is not None and (agent.negotiated_version or 0) >= 9:
+            self._spawn_remote_actor(state, spec, agent)
+            return
         log_base = _os.path.join(
             self.session_log_dir,
             f"actor-{state.cls.__name__}-{state.actor_id.hex()[:8]}-{state.num_restarts}",
@@ -2276,6 +2394,85 @@ class Runtime:
             worker.kill()
             raise
         state.proc_worker = worker
+
+    def _spawn_remote_actor(self, state: _ActorState, spec: TaskSpec,
+                            agent) -> None:
+        """Place the actor's dedicated worker on ``state.node_id``'s agent
+        (actor_spawn) and wire the head-side proxy. The actor directory is
+        the existing state table — ``state.node_id`` + the proxy's
+        ``node_id`` record node -> endpoint; kill/death cascades ride the
+        liveness plane (on_node_death / actor_exit)."""
+        import cloudpickle
+
+        from ray_tpu.core.remote_actor import RemoteActorWorker
+
+        res = agent.call(
+            "actor_spawn",
+            actor=state.actor_id.binary(),
+            cls=cloudpickle.dumps(state.cls),
+            args=self._marshal_args(spec),
+            renv=spec.runtime_env,
+            max_concurrency=state.max_concurrency,
+            concurrency_groups=state.concurrency_groups or None,
+            name=state.cls.__name__,
+            timeout=120,
+        )
+        state.proc_worker = RemoteActorWorker(
+            agent, state.actor_id.binary(), state.node_id,
+            pid=int(res.get("pid") or 0))
+        logger.info("actor %s (%s) placed on node %s",
+                    state.actor_id.hex()[:12], state.cls.__name__,
+                    state.node_id.hex()[:12])
+
+    def on_remote_actor_exit(self, actor_id: ActorID,
+                             cause: str = "actor worker process exited",
+                             rc: "int | None" = None,
+                             pid: "int | None" = None) -> None:
+        """Out-of-band death of a remote actor's dedicated worker (agent
+        actor_exit notify, or node death): run the same path an in-call
+        WorkerCrashedError takes — mark dead / restart within budget,
+        drain the mailbox, abort its compiled graphs.
+
+        The death is CLAIMED atomically (proc_worker nulled under
+        state.lock, pid-matched when the notice carries one) so an
+        in-call WorkerCrashedError racing this, a duplicate notice, or a
+        stale notice about a PREVIOUS incarnation can neither
+        double-restart nor kill a healthy restarted worker."""
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        with state.lock:
+            pw = state.proc_worker
+            if pw is None or not getattr(pw, "is_remote", False):
+                return
+            if state.state != "ALIVE":
+                return  # kill/restart already handled it
+            if pid is not None and pw.pid and pw.pid != pid:
+                return  # stale notice: a NEW incarnation is serving
+            state.proc_worker = None  # the claim
+        detail = cause if rc is None else f"{cause} (rc={rc})"
+        pw.mark_dead()
+        self._abort_dags_for(actor_id, detail)
+        if state.node_id is not None and state.sched_req is not None:
+            self.scheduler.release(state.node_id, state.sched_req)
+            state.node_id = None
+            self.scheduler.retry_pending_pgs()
+        from ray_tpu.util import flight_recorder
+
+        flight_recorder.record("actor", "remote_actor_exit",
+                               actor=actor_id.hex()[:16], cause=detail)
+        if self.restart_actor(actor_id):
+            return  # fresh creation spec queued; may land on ANOTHER node
+        state.state = "DEAD"
+        state.death_cause = detail
+        self._publish_actor_event(state)
+        if state.name:
+            with self._lock:
+                dropped_name = self._named_actors.pop(
+                    (state.namespace, state.name), None)
+            del dropped_name  # dies outside _lock (graftlint ref-drop rule)
+        self._drain_mailbox(state, ActorDiedError(detail))
+        state.poison_all()
 
     def _runtime_env_ctx(self, state: _ActorState):
         """Build (once) the actor's runtime_env context from its creation spec."""
@@ -2658,12 +2855,33 @@ class Runtime:
                                        group=spec.concurrency_group)
                 status, payload, size = res[0], res[1], res[2]
                 contained = res[3] if len(res) > 3 else None
-                self._store_worker_result(spec, rids, status, payload, size,
-                                          contained=contained)
+                # a REMOTE actor's "plane" result is pinned in its node's
+                # store: the directory entry needs that node id
+                self._store_worker_result(
+                    spec, rids, status, payload, size,
+                    node_id=getattr(proc_worker, "node_id", None),
+                    contained=contained)
             _finish("FINISHED")
             return False
         except WorkerCrashedError:
-            state.proc_worker = None
+            # claim the death atomically: an out-of-band actor_exit/node
+            # death racing this call must not ALSO release the lease and
+            # restart (double restart burns the budget + leaks a worker)
+            with state.lock:
+                claimed = state.proc_worker is proc_worker
+                if claimed:
+                    state.proc_worker = None
+            if not claimed and state.state == "ALIVE":
+                # another path owns the death and a restart is queued (or
+                # a NEW incarnation is already serving): only THIS task's
+                # fate is ours — replay it within its retry budget
+                if _retries_left(spec, entry.attempts if entry else 0):
+                    return _retry()
+                self._store_error(spec, ActorDiedError(
+                    "actor worker process died (task not retried: "
+                    "max_task_retries)"))
+                _finish("FAILED")
+                return False
             if state.state != "ALIVE":
                 # user-initiated kill (or concurrent death handling) already
                 # ran — do NOT resurrect a killed actor from the crash path
@@ -2899,77 +3117,286 @@ class Runtime:
 
     # ----------------------------------------------------- compiled graphs
     def dag_install(self, spec_blob: bytes) -> dict:
-        """Install a compiled actor graph (dag/compiled.py GraphSpec blob):
-        create one seqlock shm channel per DAG edge and start a resident
-        execution loop in every participating actor — in-process thread for
-        thread-hosted actors, a loop inside the dedicated worker for process
-        actors. Returns ``{"graph", "channels": {chan_id: shm_name},
-        "input_chans", "output_chan"}``; after this, graph steps run with
-        zero control-plane requests (dag/exec_loop.py)."""
+        """Install a compiled actor graph (dag/compiled.py GraphSpec blob).
+
+        Channel placement (cross-node actor fabric, wire v9): each edge's
+        ring is created on the node hosting its PRODUCER actor — driver
+        input edges on the CONSUMER actor's node — so every resident loop
+        WRITES local shm; a consumer on another node reads the ring through
+        a pre-opened fabric peer (dag/fabric.py: persistent ``dag_ch_read``
+        long-polls answered with raw BLOB frames). Install is one
+        ``dag_node_install`` round per phase per remote node: phase 1
+        creates + registers rings everywhere, phase 2 starts the resident
+        loops (so a loop's first remote read never races its ring's
+        creation). After this, graph steps run with ZERO control-plane
+        requests (dag/exec_loop.py; fabric frames count as ``fabric:*``,
+        on dedicated data connections).
+
+        Returns ``{"graph", "input_chans", "output_chan", "edges"}`` —
+        ``edges`` maps driver-edge chan ids hosted on REMOTE nodes to
+        ``[fabric_addr, kind]`` descriptors the driver bridges with."""
         import cloudpickle
 
+        from ray_tpu.core.rpc.schema import WireVersionError
         from ray_tpu.core.shm_channel import ShmChannel
-        from ray_tpu.dag import exec_loop
+        from ray_tpu.dag import exec_loop, fabric
 
         spec = cloudpickle.loads(spec_blob)
         rec = _DagRecord(spec.graph_id)
+        gid = spec.graph_id
+
+        # ---- resolve placement: which node hosts each actor / channel
+        states: dict = {}
+        actor_node: dict = {}          # actor_bin -> NodeID | None (head)
+        for plan in spec.plans:
+            state = self._dag_wait_actor(ActorID(plan.actor_bin))
+            states[plan.actor_bin] = state
+            pw = state.proc_worker
+            nid = (pw.node_id if pw is not None
+                   and getattr(pw, "is_remote", False) else None)
+            actor_node[plan.actor_bin] = nid
+        remote_nodes = {n for n in actor_node.values() if n is not None}
+        agents: dict = {}
+        if remote_nodes:
+            head_addr = (self.plane_server.address
+                         if self.plane_server is not None else None)
+            for nid in remote_nodes:
+                agent = self._agents.get(nid)
+                fab = self._fabric_addrs.get(nid)
+                if agent is None or fab is None or \
+                        (agent.negotiated_version or 0) < 9:
+                    raise WireVersionError(
+                        f"compiled graph spans node {nid.hex()[:12]} with "
+                        "no v9 fabric endpoint — falling back to per-call "
+                        "dispatch")
+                agents[nid] = agent
+            if head_addr is None and any(n is None
+                                         for n in actor_node.values()):
+                raise WireVersionError(
+                    "cross-node graph needs the head plane endpoint to "
+                    "serve head-hosted edges (shm store disabled)")
+
+        chan_host: dict = {}           # chan_id -> NodeID | None (head)
+        chan_consumers: dict = {}      # chan_id -> consumer node
+        for plan in spec.plans:
+            nid = actor_node[plan.actor_bin]
+            for cid in plan.write_chans():
+                chan_host[cid] = nid   # ring lives with its producer actor
+            for cid in plan.read_chans:
+                chan_consumers[cid] = nid
+        for cid in spec.input_chans:
+            # driver-produced edge: ring on the consumer actor's node, so
+            # the resident loop still reads local shm
+            chan_host[cid] = chan_consumers.get(cid)
+        for cid in spec.all_chans:
+            chan_host.setdefault(cid, None)
+
+        def fabric_addr_of(nid) -> str:
+            return (self.plane_server.address if nid is None
+                    else self._fabric_addrs[nid])
+
+        from ray_tpu.dag.fabric import force_wire, machine_uid
+
+        wire_only = force_wire()
+        my_uid = machine_uid()
+
+        def host_uid_of(nid) -> "str | None":
+            return my_uid if nid is None else self._host_uids.get(nid)
+
+        def chan_desc(cid: int, my_node, ring_names: dict):
+            """Descriptor one participant attaches chan ``cid`` with: a
+            local ring name, an [addr, kind] fabric bridge — or, when the
+            hosting node shares this participant's MACHINE (multi-agent
+            single-box topology), the ring's shm name: /dev/shm is
+            machine-global, so a cross-node same-host edge stays a pure
+            shm ring and only genuinely cross-HOST edges pay the wire."""
+            host = chan_host[cid]
+            if host == my_node:
+                return ring_names[cid]
+            h_uid = host_uid_of(host)
+            if not wire_only and h_uid is not None \
+                    and h_uid == host_uid_of(my_node):
+                return node_ring_names[host][cid]
+            kind = "read" if chan_consumers.get(cid, "driver") == my_node \
+                else "write"
+            return [fabric_addr_of(host), kind]
+
+        installed_nodes: list = []
         proc_workers = []
         try:
-            for cid in spec.all_chans:
-                rec.channels[cid] = ShmChannel(capacity=spec.capacity)
+            # ---- phase 1: create every ring where it lives
+            node_ring_names: dict = {None: {}}
+            for cid, host in chan_host.items():
+                if host is None:
+                    ch = rec.channels[cid] = ShmChannel(
+                        capacity=spec.capacity)
+                    node_ring_names[None][cid] = ch.name
+                    if remote_nodes:
+                        # a remote far end may read/write it over the wire
+                        self._dag_host.register(gid, cid, ch)
+            for nid in sorted(remote_nodes, key=lambda n: n.binary()):
+                cids = [c for c, h in chan_host.items() if h == nid]
+                res = agents[nid].call("dag_node_install", graph=gid,
+                                       create=cids, capacity=spec.capacity,
+                                       timeout=60)
+                node_ring_names[nid] = dict(res["chans"])
+                rec.nodes.add(nid)
+                rec.node_rings[nid] = dict(res["chans"])
+                rec.node_uids[nid] = self._host_uids.get(nid)
+                installed_nodes.append(nid)
+
+            # ---- phase 2: resident loops, grouped one round per node
+            per_node_installs: dict = {}
             for plan in spec.plans:
-                state = self._dag_wait_actor(ActorID(plan.actor_bin))
+                state = states[plan.actor_bin]
                 rec.actor_bins.add(plan.actor_bin)
+                nid = actor_node[plan.actor_bin]
                 plan_chans = set(plan.read_chans) | set(plan.write_chans())
-                if state.proc_worker is not None:
+                descs = {cid: chan_desc(cid, nid, node_ring_names[nid])
+                         for cid in plan_chans}
+                if nid is not None:
+                    per_node_installs.setdefault(nid, []).append(
+                        (plan.actor_bin, cloudpickle.dumps(plan), descs))
+                elif state.proc_worker is not None:
                     state.proc_worker.dag_install(
-                        cloudpickle.dumps(plan),
-                        {cid: rec.channels[cid].name for cid in plan_chans})
+                        cloudpickle.dumps(plan), descs, gid)
                     proc_workers.append(state.proc_worker)
                 else:
                     # in-process loop sharing the runtime's channel objects
-                    # (single reader/writer per end still holds: one loop per
-                    # channel end). The loop closes-but-never-detaches them;
-                    # dag_teardown owns the unlink. step_lock keeps mc=1
-                    # sequential semantics against normal dispatch;
-                    # mc>1/grouped actors opted into concurrency already.
+                    # (single reader/writer per end still holds: one loop
+                    # per channel end); cross-node edges attach same-host
+                    # rings by name or bridge through fabric peers. The
+                    # loop closes-but-never-detaches; dag_teardown owns
+                    # destroy (attached rings: detach only). step_lock
+                    # keeps mc=1 sequential semantics vs normal dispatch.
+                    chans = {}
+                    for cid in plan_chans:
+                        if chan_host[cid] is None:
+                            chans[cid] = rec.channels[cid]
+                        elif isinstance(descs[cid], str):
+                            ch = ShmChannel(name=descs[cid], create=False)
+                            rec.channels[cid] = chans[cid] = ch
+                        else:
+                            chans[cid] = fabric.build_edge(
+                                descs[cid], gid, cid)
                     step_lock = (state.dag_step_lock
                                  if state.max_concurrency == 1
                                  and not state.concurrency_groups else None)
                     t = threading.Thread(
                         target=exec_loop.run_plan,
-                        args=(state.instance, plan,
-                              {cid: rec.channels[cid] for cid in plan_chans}),
+                        args=(state.instance, plan, chans),
                         kwargs={"step_lock": step_lock},
                         daemon=True,
                         name=f"ray_tpu-dag-{state.cls.__name__}-"
-                             f"{spec.graph_id.hex()[:8]}",
+                             f"{gid.hex()[:8]}",
                     )
                     rec.threads.append(t)
                     t.start()
+            for nid, installs in per_node_installs.items():
+                agents[nid].call("dag_node_install", graph=gid,
+                                 plans=cloudpickle.dumps(installs),
+                                 timeout=120)
         except BaseException:
             rec.abort("install failed")
+            self._dag_host.unregister_graph(gid)
+            for nid in installed_nodes:
+                try:
+                    agents[nid].call("dag_node_teardown", graph=gid,
+                                     timeout=30)
+                except Exception as e:
+                    logger.debug("install-failure cleanup: node %s "
+                                 "teardown failed: %r", nid.hex()[:12], e)
             for ch in rec.channels.values():
                 ch.destroy()
             raise
+        if rec.nodes:
+            def abort_remote(rec=rec, gid=gid, my_uid=my_uid):
+                for nid in list(rec.nodes):
+                    agent = self._agents.get(nid)
+                    if agent is not None:
+                        try:
+                            agent.call("dag_node_teardown", graph=gid,
+                                       timeout=30)
+                            continue
+                        except Exception as e:
+                            logger.debug("dag abort: node %s teardown "
+                                         "failed: %r", nid.hex()[:12], e)
+                    # the agent is gone (node death): its shm segments
+                    # outlive it on this machine — close its rings by
+                    # direct attach so loops/drivers parked on them raise
+                    # instead of idling to their timeouts. Cross-host
+                    # rings need no help: far-end fabric reads observe
+                    # PeerDisconnected.
+                    if rec.node_uids.get(nid) == my_uid:
+                        self._close_dead_node_rings(rec, nid)
+
+            rec._abort_remote = abort_remote
         if proc_workers:
             # a SIGKILLed/crashed dedicated worker can't close its channels
             # itself — watch liveness and cascade the abort so no end hangs
             mon = threading.Thread(
                 target=self._dag_monitor, args=(rec, proc_workers),
                 daemon=True,
-                name=f"ray_tpu-dag-monitor-{spec.graph_id.hex()[:8]}")
+                name=f"ray_tpu-dag-monitor-{gid.hex()[:8]}")
             rec.threads.append(mon)
             mon.start()
         with self._dags_lock:
-            self._dags[spec.graph_id] = rec
-        # channel OBJECTS are exposed via dag_channels(); workers already got
-        # their segment names through proc_worker.dag_install above
+            self._dags[gid] = rec
+        # channel OBJECTS are exposed via dag_channels(); workers already
+        # got their descriptors through the installs above. Driver edges
+        # hosted on remote nodes come back as fabric descriptors.
+        edges = {}
+        for cid in list(spec.input_chans) + [spec.output_chan]:
+            host = chan_host[cid]
+            if host is not None:
+                if not wire_only and host_uid_of(host) == my_uid:
+                    # remote NODE, same MACHINE: the driver attaches the
+                    # ring by name — execute/get stay pure shm
+                    edges[cid] = ["shm", node_ring_names[host][cid]]
+                else:
+                    edges[cid] = [
+                        fabric_addr_of(host),
+                        "write" if cid in spec.input_chans else "read"]
         return {
-            "graph": spec.graph_id,
+            "graph": gid,
             "input_chans": list(spec.input_chans),
             "output_chan": spec.output_chan,
+            "edges": edges,
         }
+
+    def dag_register_abort_cb(self, graph_id: bytes, cb) -> None:
+        """Register a non-blocking hook fired when ``graph_id`` aborts
+        (actor/node death) — LOCAL drivers and head-side client bridges
+        close their own attached channel ends here, since a dead node's
+        rings can't be re-attached by name. Fires immediately if the
+        graph is already dead/gone."""
+        with self._dags_lock:
+            rec = self._dags.get(graph_id)
+            if rec is not None and rec.dead_reason is None:
+                rec.abort_cbs.append(cb)
+                return
+            reason = rec.dead_reason if rec is not None else "graph gone"
+        try:
+            cb(reason)
+        except Exception:
+            logger.debug("late dag abort hook failed", exc_info=True)
+
+    @staticmethod
+    def _close_dead_node_rings(rec: "_DagRecord", nid) -> None:
+        from ray_tpu.core.shm_channel import ShmChannel
+
+        for cid, name in (rec.node_rings.get(nid) or {}).items():
+            try:
+                ch = ShmChannel(name=name, create=False)
+            except FileNotFoundError:
+                continue  # already unlinked
+            except Exception as e:
+                logger.debug("dead-node ring %s attach failed: %r", name, e)
+                continue
+            try:
+                ch.close_channel()
+            finally:
+                ch.detach()
 
     def dag_channels(self, graph_id: bytes) -> dict:
         """Live channel objects of an installed graph — same-process callers
@@ -3010,12 +3437,25 @@ class Runtime:
 
     def dag_teardown(self, graph_id: bytes) -> None:
         """Close + destroy a graph's channels and join its loops; the actors
-        return to normal RPC dispatch (their mailboxes never stopped)."""
+        return to normal RPC dispatch (their mailboxes never stopped).
+        Cross-node graphs tear their remote rings down synchronously (one
+        dag_node_teardown per node, best-effort on dead agents)."""
         with self._dags_lock:
             rec = self._dags.pop(graph_id, None)
         if rec is None:
             return
+        rec._abort_remote = None  # torn down inline below, not off-thread
         rec.abort("graph torn down")
+        self._dag_host.unregister_graph(graph_id)
+        for nid in rec.nodes:
+            agent = self._agents.get(nid)
+            if agent is None:
+                continue  # node died; its rings died with it
+            try:
+                agent.call("dag_node_teardown", graph=graph_id, timeout=30)
+            except Exception as e:
+                logger.debug("dag_teardown: node %s round failed: %r",
+                             nid.hex()[:12], e)
         for t in rec.threads:
             t.join(timeout=5)
         for ch in rec.channels.values():
@@ -3115,6 +3555,15 @@ class Runtime:
                 "name": a.name,
                 "num_restarts": a.num_restarts,
                 "pending_tasks": a.pending_count,
+                # actor directory, fabric view: which node hosts the
+                # dedicated worker ("head" = head host) and where that
+                # node serves compiled-graph channels
+                "node_id": (a.node_id.hex() if a.node_id is not None
+                            and getattr(a.proc_worker, "is_remote", False)
+                            else "head"),
+                "fabric_addr": (self._fabric_addrs.get(a.node_id)
+                                if getattr(a.proc_worker, "is_remote",
+                                           False) else None),
             }
             for a in self._actors.values()
         ]
@@ -3146,6 +3595,12 @@ class Runtime:
                 self.dag_teardown(gid)
             except Exception:
                 pass
+        try:
+            from ray_tpu.dag import fabric as _fabric
+
+            _fabric.close_all_peers()
+        except Exception as e:
+            logger.debug("fabric peer cleanup at shutdown failed: %r", e)
         for state in list(self._actors.values()):
             if state.proc_worker is not None:
                 try:
@@ -3305,6 +3760,7 @@ def _sched_request(spec: TaskSpec) -> SchedulingRequest:
         label_selector=spec.label_selector,
         placement_group=spec.placement_group,
         bundle_index=spec.bundle_index,
+        locality_nodes=spec.locality_nodes,
     )
 
 

@@ -17,6 +17,7 @@ gangs land on physically adjacent chips.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -81,6 +82,11 @@ class SchedulingRequest:
     label_selector: dict[str, str] | None = None
     placement_group: Optional["PlacementGroupState"] = None
     bundle_index: int = -1
+    # Soft locality preference (ISSUE-15 satellite): nodes already holding
+    # this task's input blocks (streaming transform tasks name their block
+    # descriptor's holder) win among feasible candidates — the data stays
+    # where it was sealed instead of crossing the plane.
+    locality_nodes: "frozenset | None" = None
 
 
 @dataclass
@@ -118,6 +124,27 @@ class ClusterScheduler:
         self._nodes: dict[NodeID, NodeState] = {}
         self._pgs: dict[PlacementGroupID, PlacementGroupState] = {}
         self._config = config
+        # I/O-pressure signal (ISSUE-15): callable -> {NodeID: 0..1}
+        # fraction of the plane pull budget pending per node, installed by
+        # the runtime over state.node_io_view() (the PR-8 sensing half —
+        # this is its first placement consumer). Sampled per _select call;
+        # the provider owns caching.
+        self._io_pressure_provider = None
+
+    def set_io_pressure_provider(self, fn) -> None:
+        self._io_pressure_provider = fn
+
+    def _io_pressure(self) -> dict:
+        fn = self._io_pressure_provider
+        if fn is None:
+            return {}
+        try:
+            return fn() or {}
+        except Exception:
+            # telemetry gap must never block placement
+            logging.getLogger("ray_tpu").debug(
+                "io-pressure provider failed", exc_info=True)
+            return {}
 
     # --- node membership ---
     def add_node(
@@ -245,6 +272,12 @@ class ClusterScheduler:
                     return False
         return resources.fits_in(node.available)
 
+    # weight of the io-pressure penalty against utilization in hybrid
+    # packing: a node with its pull budget saturated scores like it were
+    # 50 utilization points emptier/fuller — enough to steer bulk work off
+    # a congested node without overriding real capacity differences.
+    IO_PRESSURE_WEIGHT = 0.5
+
     def _select(self, req: SchedulingRequest, resources: ResourceSet) -> NodeState | None:
         nodes = [n for n in self._nodes.values() if n.alive]
         if req.policy == "node_affinity" and req.node_affinity is not None:
@@ -257,16 +290,34 @@ class ClusterScheduler:
         feas = [n for n in nodes if self._feasible(n, resources, req)]
         if not feas:
             return None
+        if req.locality_nodes:
+            # input-holder locality (soft): feasible nodes already holding
+            # the task's blocks win; the normal policy picks among them
+            local = [n for n in feas if n.node_id in req.locality_nodes]
+            if local:
+                feas = local
+        pressure = self._io_pressure()
+
+        def press(n: NodeState) -> float:
+            return pressure.get(n.node_id, 0.0)
+
         if req.policy == "spread":
-            # pick least-utilized (spread_scheduling_policy.cc round-robins over feasible)
-            return min(feas, key=lambda n: (n.utilization(), n.node_id.binary()))
-        # hybrid top-k pack-then-spread (hybrid_scheduling_policy.cc): prefer packing
-        # onto already-utilized nodes until utilization crosses the threshold.
+            # pick least-utilized (spread_scheduling_policy.cc round-robins
+            # over feasible), congestion folded in as extra utilization
+            return min(feas, key=lambda n: (
+                n.utilization() + self.IO_PRESSURE_WEIGHT * press(n),
+                n.node_id.binary()))
+        # hybrid top-k pack-then-spread (hybrid_scheduling_policy.cc): prefer
+        # packing onto already-utilized nodes until utilization crosses the
+        # threshold; a node drowning in plane I/O packs LAST (node_io_view
+        # pressure signal, the PR-8 sensing half consumed).
         thresh = self._config.scheduler_spread_threshold
         below = [n for n in feas if n.utilization() < thresh]
         pool = below if below else feas
         # pack: most utilized below threshold first (stable by id)
-        return max(pool, key=lambda n: (n.utilization(), n.node_id.binary()))
+        return max(pool, key=lambda n: (
+            n.utilization() - self.IO_PRESSURE_WEIGHT * press(n),
+            n.node_id.binary()))
 
     # --- placement groups (2PC: prepare all bundles, then commit) ---
     def create_placement_group(

@@ -76,20 +76,29 @@ class ChannelClosed(Exception):
 
 def _backoff(spins: int) -> None:
     """Wait strategy: brief hot spin, then ``os.sched_yield()`` (a REAL
-    yield syscall — ``time.sleep(0)`` is not one), then escalate to bounded
-    micro-sleeps so an idle channel costs ~zero CPU.
+    yield syscall — ``time.sleep(0)`` is not one), then escalate through
+    50 µs micro-sleeps to a bounded 0.5 ms sleep so an idle channel costs
+    ~zero CPU.
 
     On a saturated pipeline the peer is RUNNABLE one timeslice away, so the
     yield phase carries the steady state: measured on a 1-core host, a
     cross-process ping-pong runs ~54K round trips/s under this policy vs
     ~1K with a fixed 0.5 ms poll-sleep (which capped compiled actor chains
-    at ~400 steps/s)."""
+    at ~400 steps/s). The intermediate 50 µs phase exists for CROSS-NODE
+    pipelines (ISSUE 15): a fabric hop makes inter-frame gaps ~RTT-sized,
+    which used to land every waiter in the 0.5 ms phase — three such wakes
+    per step capped 2-node chains near 800 steps/s. ~300 ms of 50 µs
+    wakes (a few % of one core) before settling keeps busy-pipeline wake
+    latency ~10x lower; a channel idle past that window still costs ~zero."""
     if spins < 16:
         return
     if spins < 2048:
         os.sched_yield()
         return
-    time.sleep(min(0.0005, 0.000005 * (spins - 2047)))
+    if spins < 8192:
+        time.sleep(0.00005)
+        return
+    time.sleep(min(0.0005, 0.000005 * (spins - 8191)))
 
 
 class ShmChannel:

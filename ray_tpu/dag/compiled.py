@@ -242,18 +242,44 @@ class ResultBufferDriver:
         self._exec_lock = threading.Lock()
         self._running = True
         self._dead: str | None = None
+        self._done_cbs: dict = {}  # seq -> [fn]; fired on land OR death
+
+    def notify_on(self, seq: int, fn) -> None:
+        """Call ``fn()`` (no args, exception-swallowed) once execution
+        ``seq`` has a buffered result or the graph dies — the serve
+        router's in-flight accounting hook: completion tracking without a
+        watcher thread polling refs."""
+        with self._cond:
+            if seq not in self._buffer and self._dead is None:
+                self._done_cbs.setdefault(seq, []).append(fn)
+                return
+        self._run_cb(fn)
+
+    @staticmethod
+    def _run_cb(fn) -> None:
+        try:
+            fn()
+        except Exception:
+            logger.exception("compiled-DAG completion callback failed")
 
     def _publish_result(self, seq: int, status: str, payload) -> None:
         with self._cond:
             self._buffer[seq] = (status, payload)
+            cbs = self._done_cbs.pop(seq, ())
             self._cond.notify_all()
+        for fn in cbs:
+            self._run_cb(fn)
 
     def _mark_dead(self, message: str, *, only_if_running: bool = False) -> None:
         with self._cond:
             if self._dead is None and not (only_if_running
                                            and not self._running):
                 self._dead = message
+            cbs = [fn for fns in self._done_cbs.values() for fn in fns]
+            self._done_cbs.clear()
             self._cond.notify_all()
+        for fn in cbs:
+            self._run_cb(fn)
 
     def get(self, seq: int, timeout: float | None = None):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -298,12 +324,32 @@ class CompiledActorDAG(ResultBufferDriver):
                 self._out_ch = _WireShim(
                     rt.dag_wire_out(self.graph_id, self._spec.output_chan))
             else:
-                # local driver shares the runtime's channel objects (one
+                # Local driver shares the runtime's channel objects (one
                 # writer/reader per end still holds: the driver is the only
-                # writer of input edges and the only reader of the output)
+                # writer of input edges and the only reader of the output).
+                # Edges whose ring lives on a REMOTE node (first/last stage
+                # actors placed off-head) come back as fabric descriptors
+                # — bridged over pre-opened data-plane peers, so execute()
+                # stays one frame write and get() one frame read with zero
+                # control-plane traffic (dag/fabric.py).
+                from ray_tpu.dag import fabric
+
                 live = rt.dag_channels(self.graph_id)
-                self._in_chs = [live[cid] for cid in self._spec.input_chans]
-                self._out_ch = live[self._spec.output_chan]
+                edges = res.get("edges") or {}
+                self._attached = []  # by-name rings we must detach
+
+                def _driver_chan(cid):
+                    if cid in edges:
+                        ch = fabric.build_edge(edges[cid],
+                                               self.graph_id, cid)
+                        if edges[cid][0] == "shm":
+                            self._attached.append(ch)
+                        return ch
+                    return live[cid]
+
+                self._in_chs = [_driver_chan(cid)
+                                for cid in self._spec.input_chans]
+                self._out_ch = _driver_chan(self._spec.output_chan)
         except BaseException:
             rt.dag_teardown(self.graph_id)
             raise
@@ -312,6 +358,27 @@ class CompiledActorDAG(ResultBufferDriver):
             target=self._drain_loop, daemon=True,
             name=f"ray_tpu-dag-drain-{self.graph_id.hex()[:8]}")
         self._drain.start()
+        register = getattr(rt, "dag_register_abort_cb", None)
+        if register is not None and not res.get("wire"):
+            # head-side abort hook (actor/node death): wake THIS driver
+            # now — channels this process attached to a DEAD node's rings
+            # cannot be closed by anyone else (the node's segments were
+            # already unlinked with its resource tracker), so without the
+            # hook a parked execute()/get() would sit out its timeout.
+            register(self.graph_id, self._on_graph_abort)
+
+    def _on_graph_abort(self, reason: str) -> None:
+        self._mark_dead(
+            "compiled DAG aborted (actor died, node died, or graph torn "
+            f"down): {reason}", only_if_running=True)
+        if not self._running:
+            return  # teardown already owns channel shutdown
+        for ch in list(self._in_chs) + [self._out_ch]:
+            try:
+                ch.close_channel()
+            except Exception:
+                logger.debug("abort-hook channel close failed",
+                             exc_info=True)
 
     # -------------------------------------------------------------- driver
     def _drain_loop(self) -> None:
@@ -333,11 +400,26 @@ class CompiledActorDAG(ResultBufferDriver):
                 self._mark_dead(
                     "compiled DAG channels closed (actor died or graph "
                     f"torn down): {e}", only_if_running=True)
+                self._release_parked_writers()
                 return
             except BaseException as e:  # noqa: BLE001 — never die silently
                 self._mark_dead(f"compiled DAG drain failed: {e!r}")
+                self._release_parked_writers()
                 return
             self._publish_result(seq, status, payload)
+
+    def _release_parked_writers(self) -> None:
+        """The graph is dead: close the input channels so an execute()
+        parked in a ring write — e.g. toward a ring whose consumer's NODE
+        just died and can no longer drain it — wakes with ChannelClosed
+        NOW instead of sitting out the full channel timeout."""
+        if self._running:
+            for ch in self._in_chs:
+                try:
+                    ch.close_channel()
+                except Exception:
+                    logger.debug("input-channel close on death failed",
+                                 exc_info=True)
 
     def execute(self, *input_args) -> "CompiledDAGRef":
         import cloudpickle
@@ -440,10 +522,13 @@ class CompiledActorDAG(ResultBufferDriver):
                 self._dead = "CompiledActorDAG torn down"
             self._cond.notify_all()
         # shm objects are the runtime's (dag_teardown destroyed them); only
-        # wire shims have driver-side state to release
+        # wire shims and rings this driver attached BY NAME (cross-node
+        # same-machine edges) have driver-side state to release
         for ch in list(self._in_chs) + [self._out_ch]:
             if isinstance(ch, _WireShim):
                 ch.detach()
+        for ch in getattr(self, "_attached", ()):
+            ch.detach()
 
 
 def _get_runtime():

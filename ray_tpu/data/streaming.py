@@ -257,31 +257,59 @@ def _transform_to_plane(transform: Callable[[Block], list[Block]],
     output block into THIS node's store, return tiny descriptor rows.
     The input arrived as a ShmArg (zero-copy from the local store, or a
     plane pull on miss); the outputs' primary copies stay here — the
-    driver sees ``[[ref, rows, bytes], ...]`` only."""
-    out = []
-    for b in transform(block):
-        out.append([ray_tpu.put(b), b.num_rows(), b.size_bytes()])
-    return out
+    driver sees ``[[ref, rows, bytes], ...]`` only. Seals are BATCHED:
+    the whole task's outputs register with the head in one
+    ``client_put_seal_batch`` round trip (wire v9), not one blocking RPC
+    per block."""
+    blocks = transform(block)
+    refs = ray_tpu.put_batch(blocks)
+    return [[ref, b.num_rows(), b.size_bytes()]
+            for ref, b in zip(refs, blocks)]
 
 
 def _slice_to_plane(block: Block, n: int) -> list:
     """Worker side of an equal streaming_split: slice one block into n
     near-equal row ranges sealed into this node's store (rows differ by at
-    most 1). Returns one descriptor row (or None for an empty take) per
-    slot — the driver rotates slots over shards."""
+    most 1; seals batched — one registration RPC for all n slices).
+    Returns one descriptor row (or None for an empty take) per slot — the
+    driver rotates slots over shards."""
     rows = block.num_rows()
     per, extra = divmod(rows, n)
-    out: list = []
+    slices: list = []
     start = 0
     for q in range(n):
         take = per + (1 if q < extra else 0)
         if not take:
-            out.append(None)
+            slices.append(None)
             continue
         sl = block.slice(start, start + take)
         start += take
-        out.append([ray_tpu.put(sl), take, sl.size_bytes()])
+        slices.append(sl)
+    refs = ray_tpu.put_batch([s for s in slices if s is not None])
+    out: list = []
+    it = iter(refs)
+    for sl in slices:
+        if sl is None:
+            out.append(None)
+        else:
+            out.append([next(it), sl.num_rows(), sl.size_bytes()])
     return out
+
+
+def _holder_locality(ref) -> "frozenset | None":
+    """Holder NodeIDs of a block ref — the transform-placement locality
+    hint (head driver only; workers/clients have no directory and return
+    None, costing nothing)."""
+    from ray_tpu.core.runtime import get_runtime_or_none
+
+    rt = get_runtime_or_none()
+    holders = getattr(rt, "plane_holder_nodes", None)
+    if holders is None:
+        return None
+    try:
+        return holders(ref.object_id())
+    except Exception:
+        return None
 
 
 class _PlaneTransformActor:
@@ -359,6 +387,14 @@ def _drive_op(upstream, op, stats: StreamOpStats,
     def submit(item):
         arg = item.ref if isinstance(item, BlockRef) else item
         if pool is None:
+            loc = _holder_locality(arg) if isinstance(item, BlockRef) \
+                else None
+            if loc:
+                # score the input block's holder node up: the transform
+                # runs where its block already lives (directory has
+                # locations, scheduler has node_io_view pressure — joined)
+                return remote_fn.options(
+                    locality_nodes=loc).remote(op.transform, arg), None
             return remote_fn.remote(op.transform, arg), None
         idx = min(loads, key=loads.get)
         loads[idx] += 1

@@ -136,6 +136,30 @@ HOT_PATHS = (
         ),
         missing_hint="shuffle task body renamed? (update HOT_PATHS)",
     ),
+    # ISSUE-15: the cross-node compiled-graph edge bridge. Per-FRAME path:
+    # metric-free entirely (bind-only would still take a lock per frame),
+    # no task submission, no control-plane linkage — its ONLY wire traffic
+    # is the persistent dag_ch_* ops on count_ops=False data peers, and
+    # host-side reads must leave as raw BLOB frames (the PR-5 sendmsg
+    # path). The zero-control-plane steady-state assert rests on this.
+    HotPath(
+        file="ray_tpu/dag/fabric.py",
+        funcs=("read_view", "write", "_h_read", "_h_write", "_poll"),
+        reason="per-frame cross-node compiled-graph edge traffic",
+        ban_metric_record=True,
+        ban_submit=True,
+        forbid_imports=("ray_tpu.core.runtime", "ray_tpu.core.cluster",
+                        "ray_tpu.core.client_runtime", "ray_tpu.core.api"),
+        require_calls=(
+            ("_h_read", ("RawReply",),
+             "fabric reads no longer answer with raw BLOB frames — the "
+             "zero-copy sendmsg reply path is the bridge's contract"),
+            ("_poll", ("call_async",),
+             "the reader no longer pipelines its long-polls (prefetch) — "
+             "each hop would pay exec + RTT + producer instead of max()"),
+        ),
+        missing_hint="cross-node edge bridge renamed? (update HOT_PATHS)",
+    ),
     # ISSUE-13: worker phase stamping — ring append under one lock; no
     # instruments, no RPC. export() may link the runtime; the recording
     # half may not.

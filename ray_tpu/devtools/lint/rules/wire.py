@@ -56,6 +56,11 @@ SCHEMA_BASELINE = {
     # ISSUE-13 (wire v8): out-of-band worker profiler (agent-driven SIGUSR
     # stack sampler, artifact sealed to the object plane)
     "profile_capture": 60,
+    # ISSUE-15 (wire v9): cross-node actor fabric — agent-hosted dedicated
+    # actor workers + cross-node compiled-graph edges + batched seals
+    "actor_spawn": 61, "actor_call": 62, "actor_item": 63, "actor_ack": 64,
+    "actor_kill": 65, "dag_node_install": 66, "dag_node_teardown": 67,
+    "dag_ch_close": 68, "actor_exit": 69, "client_put_seal_batch": 70,
 }
 
 # Files whose handler tables must be fully schema'd.
@@ -429,6 +434,31 @@ VERSION_GATES = {
                "an old-wire holder would receive an op it cannot decode"),
     "profile_capture": (8, True,
                         "the agent handler parks for the sample window"),
+    # ISSUE-15 (wire v9): the actor fabric. A <v9 agent keeps head-host
+    # actors; the head checks negotiated_version before remote placement.
+    "actor_spawn": (9, False,
+                    "deferred-Future reply (spawn thread agent-side); the "
+                    "reactor slot frees immediately"),
+    "actor_call": (9, False,
+                   "deferred-Future reply; pipelines like execute_task"),
+    "actor_item": (9, False,
+                   "an old-wire head would receive an op it cannot decode"),
+    "actor_ack": (9, False,
+                  "an old-wire agent would receive an op it cannot decode"),
+    "actor_kill": (9, False,
+                   "an old-wire agent would receive an op it cannot serve"),
+    "dag_node_install": (9, True,
+                         "worker loop installs ack synchronously (seconds)"),
+    "dag_node_teardown": (9, True,
+                          "joins ring destruction; must not park a shared "
+                          "reactor slot"),
+    "dag_ch_close": (9, False,
+                     "an old-wire host would receive an op it cannot decode"),
+    "actor_exit": (9, False,
+                   "an old-wire head would receive an op it cannot decode"),
+    "client_put_seal_batch": (9, False,
+                              "an old-wire head has no handler; clients "
+                              "fall back to per-put seals"),
 }
 
 

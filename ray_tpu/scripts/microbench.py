@@ -238,6 +238,75 @@ def bench_dag_steps_rpc_baseline(n: int, stages: int = 3) -> dict:
             "unit": "steps/s"}
 
 
+def bench_dag_cross_node(n: int, stages: int = 3) -> dict:
+    """ISSUE-15 acceptance A/B, interleaved in ONE setup: a `stages`-deep
+    compiled chain with stages alternating over 2 REAL isolated-plane
+    agents vs the same chain per-call. The compiled window asserts ZERO
+    control-plane requests (``rpc:*`` opcount delta) — cross-node edges
+    ride same-machine shm attach / data-plane fabric connections, never
+    the control plane."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.rpc import opcount
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote(isolate_process=True, num_cpus=0.5)
+    class Stage:
+        def proc(self, x):
+            return x + 1
+
+    cluster = Cluster(initialize_head=False)
+    for res in ({"mba": 100}, {"mbb": 100}):
+        cluster.add_node(num_cpus=stages, resources=res,
+                         real_process=True, isolated_plane=True)
+    actors = [
+        Stage.options(resources={("mba" if i % 2 == 0 else "mbb"): 1}
+                      ).remote()
+        for i in range(stages)
+    ]
+    try:
+        with InputNode() as inp:
+            node = inp
+            for a in actors:
+                node = a.proc.bind(node)
+        compiled = node.experimental_compile()
+        try:
+            compiled.execute(0).get(timeout=60)  # warm loops + channels
+            before = opcount.snapshot()
+            t0 = time.perf_counter()
+            refs = [compiled.execute(i) for i in range(n)]
+            out = [r.get(timeout=120) for r in refs]
+            dt = time.perf_counter() - t0
+            assert out[-1] == (n - 1) + stages
+            rpc_delta = sum(v - before.get(k, 0)
+                            for k, v in opcount.snapshot().items()
+                            if k.startswith("rpc:"))
+        finally:
+            compiled.teardown()
+        m = max(10, n // 5)
+        t0 = time.perf_counter()
+        for i in range(m):
+            ref = actors[0].proc.remote(i)
+            for a in actors[1:]:
+                ref = a.proc.remote(ref)
+            out = ray_tpu.get(ref)
+        dt_pc = time.perf_counter() - t0
+        assert out == (m - 1) + stages
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+        for nid in list(cluster.node_ids):
+            try:
+                cluster.remove_node(nid)
+            except Exception:
+                pass
+    return {"metric": "dag_cross_node_3stage",
+            "value": _rate(n, dt), "unit": "steps/s",
+            "per_call_steps_per_s": _rate(m, dt_pc),
+            "speedup": round((n / dt) / (m / dt_pc), 2),
+            "steady_state_rpc_requests": rpc_delta}
+
+
 def _median_of(samples: list[dict]) -> dict:
     """Collapse repeated runs of one bench into median + dispersion.
 
@@ -277,6 +346,9 @@ def run(quick: bool = False, repeats: int = 5) -> list[dict]:
         # compiled actor graphs vs per-call dispatch on the same 3-actor chain
         lambda: bench_dag_steps_compiled(200 * k),
         lambda: bench_dag_steps_rpc_baseline(50 * k),
+        # ISSUE-15: the same chain with stages on 2 REAL isolated-plane
+        # agents (cross-node actor fabric), A/B'd in one setup
+        lambda: bench_dag_cross_node(100 * k if not quick else 100),
         # object-plane pulls over live loopback plane servers (wire v3)
         lambda: bench_plane_pull(1, 1),
         lambda: bench_plane_pull(1, 2),

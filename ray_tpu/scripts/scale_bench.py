@@ -328,6 +328,75 @@ def bench_data_ingest(block_mb: int = 16, blocks: int = 16,
     return out
 
 
+class _ChainStage:
+    """One pipeline stage for the cross-node compiled-chain sweep."""
+
+    def __init__(self, idx: int):
+        self.idx = idx
+
+    def step(self, x):
+        return x + 1
+
+
+def bench_cross_node_chain(max_stages: int = 4, steps: int = 200) -> list[dict]:
+    """Compiled-chain steps/s vs stage count with stages spread over 2 REAL
+    isolated-plane agents (ISSUE-15): the cross-node fabric's throughput
+    curve, with the same chain per-call as the in-row baseline."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+
+    cluster = Cluster(initialize_head=False)
+    node_res = [{"xa": 100}, {"xb": 100}]
+    for res in node_res:
+        cluster.add_node(num_cpus=max_stages, resources=res,
+                         real_process=True, isolated_plane=True)
+    rows = []
+    try:
+        for n_stages in range(2, max_stages + 1):
+            actors = []
+            for i in range(n_stages):
+                res_key = "xa" if i % 2 == 0 else "xb"  # alternate agents
+                cls = ray_tpu.remote(isolate_process=True, num_cpus=0.5,
+                                     resources={res_key: 1})(_ChainStage)
+                actors.append(cls.remote(i))
+            with InputNode() as inp:
+                node = inp
+                for a in actors:
+                    node = a.step.bind(node)
+            dag = node.experimental_compile()
+            for w in range(3):
+                assert dag.execute(w).get(timeout=60) == w + n_stages
+            t0 = time.perf_counter()
+            refs = [dag.execute(i) for i in range(steps)]
+            out = [r.get(timeout=120) for r in refs]
+            dt = time.perf_counter() - t0
+            assert out[-1] == steps - 1 + n_stages
+            dag.teardown()
+            # per-call baseline: the same chain, one actor submit per stage
+            t0 = time.perf_counter()
+            per_call_steps = max(10, steps // 10)
+            for i in range(per_call_steps):
+                x = i
+                for a in actors:
+                    x = ray_tpu.get(a.step.remote(x), timeout=60)
+            dt_pc = time.perf_counter() - t0
+            rows.append({
+                "metric": f"cross_node_chain_{n_stages}stage",
+                "compiled_steps_per_s": round(steps / dt, 1),
+                "per_call_steps_per_s": round(per_call_steps / dt_pc, 1),
+                "speedup": round((steps / dt) / (per_call_steps / dt_pc), 2),
+            })
+            for a in actors:
+                ray_tpu.kill(a)
+    finally:
+        for nid in list(cluster.node_ids):
+            try:
+                cluster.remove_node(nid)
+            except Exception:
+                pass
+    return rows
+
+
 def bench_placement_groups(n: int) -> list[dict]:
     """n simultaneous 1-bundle PGs on a cluster with room for all of them."""
     rt = get_runtime()
@@ -351,7 +420,8 @@ def bench_placement_groups(n: int) -> list[dict]:
 
 def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int,
         dispatch_agents: int = 0, broadcast_agents: int = 0,
-        broadcast_mb: int = 64, data_mb: int = 0) -> list[dict]:
+        broadcast_mb: int = 64, data_mb: int = 0,
+        chain_stages: int = 0) -> list[dict]:
     results = []
     ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
     for section, fn in (
@@ -361,6 +431,8 @@ def run(nodes: int, real_agents: int, actors: int, tasks: int, pgs: int,
                       if broadcast_agents else []),
         ("data_ingest", lambda: bench_data_ingest(block_mb=data_mb)
                         if data_mb else []),
+        ("cross_node_chain", lambda: bench_cross_node_chain(chain_stages)
+                             if chain_stages else []),
         ("actors", lambda: bench_actors(actors)),
         ("queued_tasks", lambda: bench_queued_tasks(tasks)),
         ("placement_groups", lambda: bench_placement_groups(pgs)),
@@ -416,11 +488,15 @@ if __name__ == "__main__":
     ap.add_argument("--data-mb", type=int, default=0,
                     help="per-block MB for the data-ingestion sweep "
                          "(0 = skip)")
+    ap.add_argument("--chain-stages", type=int, default=0,
+                    help="max stages for the cross-node compiled-chain "
+                         "sweep over 2 real agents (0 = skip)")
     ap.add_argument("--md", default="SCALE_r05.md")
     a = ap.parse_args()
     res = run(a.nodes, a.real_agents, a.actors, a.tasks, a.pgs,
               dispatch_agents=a.dispatch_agents,
               broadcast_agents=a.broadcast_agents,
-              broadcast_mb=a.broadcast_mb, data_mb=a.data_mb)
+              broadcast_mb=a.broadcast_mb, data_mb=a.data_mb,
+              chain_stages=a.chain_stages)
     if a.md:
         write_md(res, a.md, a)

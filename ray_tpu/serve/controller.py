@@ -66,6 +66,13 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_compiled(self, req):
+        """Compiled-dispatch entry (ISSUE 15): one frame carries
+        ``(method_name, args, kwargs)`` through the ingress->replica
+        compiled-graph edge; the resident exec loop invokes this
+        synchronously — same body as handle_request, one unpack away."""
+        return self.handle_request(req[0], req[1], req[2])
+
     def handle_streaming(self, method_name: str, args, kwargs):
         """Generator entry: streams the user's generator method incrementally
         (reference: serve streaming responses over proxy)."""
@@ -409,6 +416,13 @@ class ServeController:
     def get_deployment_names(self) -> list[str]:
         return list(self._deployments)
 
+    def get_dispatch_mode(self, name: str) -> bool:
+        """Whether this deployment's handles should compile per-replica
+        dispatch graphs (DeploymentConfig.compiled_dispatch)."""
+        with self._lock:
+            st = self._deployments.get(name)
+            return bool(st and st.config.compiled_dispatch)
+
     def get_request_router(self, name: str) -> str:
         st = self._deployments.get(name)
         # getattr: configs restored from pre-field checkpoints lack the attr
@@ -603,6 +617,12 @@ class ServeController:
                     # can't stall sibling replicas through the GIL
                     # (reference: every serve replica is its own worker proc)
                     isolate_process=opts.get("isolate_process"),
+                    # cross-node actor fabric (ISSUE 15): custom resources /
+                    # node pins / strategies land replicas on REMOTE agents
+                    # — decode fleets finally live off the head host
+                    resources=opts.get("resources"),
+                    node=opts.get("node"),
+                    scheduling_strategy=opts.get("scheduling_strategy"),
                 )(ReplicaActor)
                 replica = actor_cls.remote(
                     d.func_or_class, d.init_args, d.init_kwargs, cfg.user_config
@@ -647,6 +667,10 @@ class Router:
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         self._reqs_since_report = 0
+        # compiled dispatch (ISSUE 15): per-replica ingress->replica graphs
+        # (rkey -> CompiledActorDAG | "unsupported"); None mode = unresolved
+        self._compiled: dict = {}
+        self._compiled_mode: "bool | None" = None
         # single completion watcher (not thread-per-request)
         import queue as _q
 
@@ -714,6 +738,20 @@ class Router:
                 self._replicas = reps
                 self._inflight = {self._rkey(r): self._inflight.get(self._rkey(r), 0) for r in reps}
                 self._last_refresh = now
+                live = {self._rkey(r) for r in reps}
+                stale_dags = [(k, d) for k, d in self._compiled.items()
+                              if k not in live]
+                # rebuild (not pop-discard): stale dag objects stay
+                # referenced by stale_dags until after the lock releases
+                self._compiled = {k: d for k, d in self._compiled.items()
+                                  if k in live}
+            for _, dag in stale_dags:  # teardown OUTSIDE the lock
+                if dag is not None and dag != "unsupported":
+                    try:
+                        dag.teardown()
+                    except Exception:
+                        logger.debug("stale replica dag teardown failed",
+                                     exc_info=True)
 
     def pick(self, wait_timeout: float = 30.0, hint=None):
         self._refresh()
@@ -772,7 +810,102 @@ class Router:
 
         return gen, done_cb
 
+    # ------------------------------------------------- compiled dispatch
+    def _use_compiled(self) -> bool:
+        if self._compiled_mode is None:
+            try:
+                self._compiled_mode = bool(ray_tpu.get(
+                    self._controller.get_dispatch_mode.remote(self._name)))
+            except Exception:
+                # transient (controller restarting/restoring): DON'T cache
+                # — a compiled_dispatch deployment must not silently serve
+                # per-call forever off one failed probe
+                logger.debug("dispatch-mode probe failed; retrying on the "
+                             "next request", exc_info=True)
+                return False
+        return self._compiled_mode
+
+    def _compiled_dag(self, replica):
+        """The replica's ingress->replica compiled graph, built on first
+        use (None: this replica/graph shape can't compile — per-call)."""
+        key = self._rkey(replica)
+        with self._lock:
+            ent = self._compiled.get(key)
+        if ent is not None:
+            return None if ent == "unsupported" else ent
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dag.compiled import CompiledActorDAG
+
+        dag = None
+        try:
+            with InputNode() as inp:
+                node = replica.handle_compiled.bind(inp)
+            compiled = node.experimental_compile()
+            if isinstance(compiled, CompiledActorDAG):
+                dag = compiled
+            else:
+                # legacy RPC-dispatch fallback object: per-call through
+                # the normal path beats per-call through a driver thread
+                try:
+                    compiled.teardown()
+                except Exception:
+                    logger.debug("legacy dag teardown failed",
+                                 exc_info=True)
+        except Exception:
+            logger.warning("compiled dispatch unavailable for %s; "
+                           "falling back to per-call", self._name,
+                           exc_info=True)
+        with self._lock:
+            cur = self._compiled.setdefault(
+                key, dag if dag is not None else "unsupported")
+        if cur is not dag and dag is not None:
+            dag.teardown()  # raced another builder: keep the first
+            return None if cur == "unsupported" else cur
+        return dag
+
+    def _drop_compiled(self, key: str) -> None:
+        with self._lock:
+            dag = self._compiled.pop(key, None)
+        if dag is not None and dag != "unsupported":
+            try:
+                dag.teardown()
+            except Exception:
+                logger.debug("dead replica dag teardown failed",
+                             exc_info=True)
+
+    def _submit_compiled(self, method_name: str, args, kwargs):
+        """One request = one channel frame through the replica's compiled
+        graph; in-flight accounting retires on the graph's completion
+        callback (no watcher thread, no wait on dag refs). Returns None
+        when compiled dispatch doesn't apply (caller goes per-call)."""
+        for _ in range(2):
+            replica = self.pick(
+                hint=self._routing_hint(method_name, args, kwargs))
+            dag = self._compiled_dag(replica)
+            if dag is None:
+                return None
+            key = self._rkey(replica)
+            with self._lock:
+                self._inflight[key] = self._inflight.get(key, 0) + 1
+            try:
+                ref = dag.execute((method_name, args, kwargs))
+            except Exception:
+                # graph dead (replica died / torn down): retry once on a
+                # fresh pick; the per-call path owns death bookkeeping
+                self._retire_inflight(key)
+                self._drop_compiled(key)
+                continue
+            dag.notify_on(ref._seq,
+                          lambda key=key: self._retire_inflight(key))
+            self._maybe_report()
+            return ref
+        return None
+
     def submit(self, method_name: str, args, kwargs):
+        if self._use_compiled():
+            ref = self._submit_compiled(method_name, args, kwargs)
+            if ref is not None:
+                return ref
         # A replica killed between router refreshes yields an instantly-errored
         # ref; retry on a different replica so in-flight traffic survives
         # replica death (reference: serve router replica retry on dead actors).

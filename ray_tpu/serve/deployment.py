@@ -35,6 +35,14 @@ class DeploymentConfig:
     # replica-selection policy for handles: "pow2" | "kv_aware"
     # (reference: pluggable RequestRouter, routing_policies/kv_aware)
     request_router: str = "pow2"
+    # Compiled dispatch (ISSUE 15): the handle compiles a per-replica
+    # actor graph (ingress -> replica edge) at first use, so a request is
+    # ONE channel frame instead of a control-plane actor-task submit.
+    # Replica-side execution is the resident exec loop — sequential per
+    # replica — so this fits engine-style deployments whose handler
+    # already serializes (LLM engines, PD prefill/decode); falls back to
+    # per-call dispatch when the graph can't compile.
+    compiled_dispatch: bool = False
 
 
 class Deployment:
@@ -74,7 +82,7 @@ def deployment(_func_or_class=None, *, name: str | None = None, num_replicas: in
                max_ongoing_requests: int = 100, ray_actor_options: dict | None = None,
                autoscaling_config: AutoscalingConfig | dict | None = None,
                user_config: Any = None, route_prefix: str | None = None,
-               request_router: str = "pow2"):
+               request_router: str = "pow2", compiled_dispatch: bool = False):
     """``@serve.deployment`` decorator (reference: serve/api.py)."""
 
     def wrap(target):
@@ -90,6 +98,7 @@ def deployment(_func_or_class=None, *, name: str | None = None, num_replicas: in
             user_config=user_config,
             route_prefix=route_prefix,
             request_router=request_router,
+            compiled_dispatch=compiled_dispatch,
         )
         return Deployment(target, cfg)
 

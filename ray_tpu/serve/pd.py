@@ -88,7 +88,7 @@ def build_prefill_deployment(config=None, *, prefill_config=None,
 
     @deployment(name=name, num_replicas=num_replicas,
                 ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=32,
-                request_router="kv_aware")
+                request_router="kv_aware", compiled_dispatch=True)
     class PrefillServer(_ReplicaLifecycle):
         def __init__(self, decode_cfg, prefill_cfg):
             from ray_tpu.serve.kv_transport import KVTransport
@@ -147,9 +147,13 @@ def build_decode_deployment(config=None, *, num_replicas: int = 1,
 
     cfg = config or PagedLLMConfig()
 
+    # compiled_dispatch: the engine stepping loop serializes requests
+    # anyway, so the resident-graph channel (one frame per request, zero
+    # control-plane) replaces an actor-task submit per decode — and the
+    # fabric lets these replicas live on REMOTE agents (ISSUE 15)
     @deployment(name=name, num_replicas=num_replicas,
                 ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=32,
-                request_router="kv_aware")
+                request_router="kv_aware", compiled_dispatch=True)
     class DecodeServer(_ReplicaLifecycle):
         def __init__(self, decode_cfg):
             from ray_tpu.serve.kv_transport import KVTransport

@@ -26,12 +26,14 @@ from ray_tpu.train.elastic import (
     run_elastic,
     shard_bounds,
 )
+from ray_tpu.train.compiled_step import CompiledGangStep
 from ray_tpu.train.gang import run_jax_gang
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [
     "run_jax_gang",
+    "CompiledGangStep",
     "Checkpoint",
     "CheckpointManager",
     "PlaneCheckpoint",

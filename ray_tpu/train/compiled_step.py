@@ -1,0 +1,140 @@
+"""Resident compiled-graph gang steps (ISSUE 15): the train-step hot loop
+as ONE compiled actor graph instead of one task submit per member per step.
+
+Per-call gang stepping costs, per step: N actor-task submits + N gets (task
+table, mailboxes, marshal — all control plane). Podracer-style pipelines
+(arXiv 2104.06272) only pay off when the per-step dispatch cost vanishes;
+``CompiledGangStep`` binds every member's step method into one graph
+
+    input ──► member_0.step ─┐
+         ├──► member_1.step ─┼──► aggregator.combine ──► output
+         └──► member_N.step ─┘
+
+so a step is one fan-out channel write and one fan-in read — ZERO
+control-plane requests at steady state, members anywhere the cross-node
+fabric reaches (process actors on remote agents included). Falls back to
+per-call dispatch when the graph can't compile (old-wire peers,
+async/generator step methods), keeping the same ``step()/get`` surface.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import ray_tpu
+
+logger = logging.getLogger("ray_tpu")
+
+
+class _StepAggregator:
+    """Head-hosted fan-in: gathers every member's step output (optionally
+    reducing with a user fn) so the graph has a single terminal node."""
+
+    def __init__(self, reduce_blob=None):
+        import cloudpickle
+
+        self._reduce = (cloudpickle.loads(reduce_blob)
+                        if reduce_blob is not None else None)
+
+    def combine(self, *outs):
+        if self._reduce is not None:
+            return self._reduce(list(outs))
+        return list(outs)
+
+
+class _PerCallStepRef:
+    """Fallback ref: same .get() surface as CompiledDAGRef."""
+
+    def __init__(self, refs, reduce_fn):
+        self._refs = refs
+        self._reduce = reduce_fn
+
+    def get(self, timeout=None):
+        outs = ray_tpu.get(self._refs, timeout=timeout)
+        return self._reduce(outs) if self._reduce is not None else outs
+
+
+class CompiledGangStep:
+    """Drive a gang of actor members through their step method as a
+    resident compiled graph.
+
+    ``step(batch)`` broadcasts ``batch`` to every member (members slice
+    their shard by rank — the SPMD contract) and returns a ref whose
+    ``.get()`` yields the aggregated outputs: the member-output list, or
+    ``reduce(outputs)`` when a reducer was given.
+
+    ``compiled`` reports whether the resident-graph path engaged; when it
+    could not (unsupported shapes, old-wire agents) the same surface runs
+    per-call dispatch so training code never branches."""
+
+    def __init__(self, members, method: str = "train_step",
+                 reduce=None):
+        import cloudpickle
+
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dag.compiled import CompiledActorDAG
+
+        if not members:
+            raise ValueError("CompiledGangStep needs at least one member")
+        self._members = list(members)
+        self._method = method
+        self._reduce = reduce
+        self._agg = None
+        self._dag = None
+        try:
+            with InputNode() as inp:
+                outs = [getattr(m, method).bind(inp) for m in self._members]
+                if len(outs) == 1 and reduce is None:
+                    node = outs[0]
+                else:
+                    # thread actor on the head: the fan-in lives with the
+                    # driver, members stay wherever the fabric placed them
+                    agg_cls = ray_tpu.remote(num_cpus=0)(_StepAggregator)
+                    self._agg = agg_cls.remote(
+                        cloudpickle.dumps(reduce) if reduce else None)
+                    node = self._agg.combine.bind(*outs)
+            compiled = node.experimental_compile()
+        except Exception:
+            logger.warning("gang step graph failed to build; per-call "
+                           "dispatch", exc_info=True)
+            compiled = None
+        if isinstance(compiled, CompiledActorDAG):
+            self._dag = compiled
+        elif compiled is not None:
+            # legacy RPC-dispatch driver object: per-call through the
+            # normal submit path is strictly cheaper — drop it
+            try:
+                compiled.teardown()
+            except Exception:
+                logger.debug("legacy gang dag teardown failed",
+                             exc_info=True)
+        self._single = len(self._members) == 1 and reduce is None
+
+    @property
+    def compiled(self) -> bool:
+        return self._dag is not None
+
+    def step(self, batch):
+        """One gang step; returns a ref with ``.get(timeout=)``."""
+        if self._dag is not None:
+            return self._dag.execute(batch)
+        refs = [getattr(m, self._method).remote(batch)
+                for m in self._members]
+        if self._single:
+            return _PerCallStepRef(refs[0:1],
+                                   (lambda outs: outs[0]))
+        return _PerCallStepRef(refs, self._reduce
+                               if self._reduce is not None else None)
+
+    def teardown(self) -> None:
+        if self._dag is not None:
+            try:
+                self._dag.teardown()
+            finally:
+                self._dag = None
+        if self._agg is not None:
+            try:
+                ray_tpu.kill(self._agg)
+            except Exception:
+                logger.debug("gang aggregator kill failed", exc_info=True)
+            self._agg = None

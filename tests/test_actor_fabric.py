@@ -1,0 +1,477 @@
+"""Cross-node actor fabric tests (ISSUE 15): actors placed on any agent,
+compiled graphs across nodes, chaos cascades, negotiate-down, placement
+scoring, serve compiled dispatch off-head.
+
+Topology: real node-agent OS processes with isolated object planes on one
+machine (the reference's multi-raylet test shape). Cross-node compiled
+edges attach same-machine rings by shm name by default; the wire-bridge
+test forces the agent-to-agent BLOB path explicitly.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.runtime import get_runtime
+
+
+@pytest.fixture
+def two_agents():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    cluster = Cluster(initialize_head=False)
+    na = cluster.add_node(num_cpus=4, resources={"a": 10},
+                          real_process=True, isolated_plane=True)
+    nb = cluster.add_node(num_cpus=4, resources={"b": 10},
+                          real_process=True, isolated_plane=True)
+    yield cluster, na, nb
+    cluster.shutdown()
+
+
+@ray_tpu.remote(isolate_process=True, num_cpus=1)
+class Counter:
+    def __init__(self, start=0):
+        self.x = start
+
+    def add(self, v):
+        self.x += v
+        return self.x
+
+    def where(self):
+        return os.environ.get("RAY_TPU_NODE_ID", "head")
+
+    def countdown(self, n):
+        for i in range(n):
+            yield n - i
+
+
+# ------------------------------------------------------- remote placement
+def test_remote_actor_placement_calls_and_streams(two_agents):
+    """An isolate_process actor scheduled onto an agent node spawns its
+    dedicated worker THERE (actor_spawn); calls, named lookup, generator
+    streaming, and kill all ride the agent proxy."""
+    cluster, na, nb = two_agents
+    rt = get_runtime()
+
+    a = Counter.options(resources={"a": 1}, name="fab-counter").remote(10)
+    assert ray_tpu.get(a.add.remote(5)) == 15
+    assert ray_tpu.get(a.add.remote(1)) == 16
+
+    st = rt.actor_state(a._actor_id)
+    assert st.node_id == na
+    assert getattr(st.proc_worker, "is_remote", False)
+    # the worker really lives on the agent's node (its env carries the id)
+    assert ray_tpu.get(a.where.remote()) == na.hex()
+
+    # actor directory: node -> endpoint view
+    row = next(r for r in rt.list_actors()
+               if r["actor_id"] == a._actor_id.hex())
+    assert row["node_id"] == na.hex()
+    assert row["fabric_addr"] == rt._fabric_addrs[na]
+
+    # named handle round-trips
+    h = ray_tpu.get_actor("fab-counter")
+    assert ray_tpu.get(h.add.remote(4)) == 20
+
+    # generator methods stream items back through actor_item notifies
+    gen = a.countdown.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in gen] == [4, 3, 2, 1]
+
+    # explicit node= override pins placement
+    b = Counter.options(node=nb.hex()).remote(0)
+    assert ray_tpu.get(b.where.remote()) == nb.hex()
+
+    ray_tpu.kill(a)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(a.add.remote(1))
+
+
+def test_remote_actor_shm_args_cross_plane(two_agents):
+    """Plane-resident args resolve inside the remote worker (ShmArg pull
+    path) and big results come back plane-resident."""
+    cluster, na, nb = two_agents
+
+    @ray_tpu.remote(isolate_process=True, num_cpus=1, resources={"b": 1})
+    class Echo:
+        def total(self, arr):
+            import numpy as np
+
+            return float(np.asarray(arr).sum())
+
+        def big(self, n):
+            import numpy as np
+
+            return np.ones(n, dtype=np.float64)
+
+    import numpy as np
+
+    e = Echo.remote()
+    big = ray_tpu.put(np.arange(100_000, dtype=np.float64))
+    assert ray_tpu.get(e.total.remote(big)) == pytest.approx(
+        float(np.arange(100_000).sum()))
+    out = ray_tpu.get(e.big.remote(200_000))
+    assert out.shape == (200_000,) and out[0] == 1.0
+
+
+# ------------------------------------------------ cross-node compiled dags
+def _chain(two_agents, stages=3):
+    _, na, nb = two_agents
+
+    @ray_tpu.remote(isolate_process=True, num_cpus=1)
+    class Stage:
+        def step(self, x):
+            return x + 1
+
+    actors = [
+        Stage.options(resources={("a" if i % 2 == 0 else "b"): 1}).remote()
+        for i in range(stages)
+    ]
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        node = inp
+        for a in actors:
+            node = a.step.bind(node)
+    return actors, node
+
+
+def test_cross_node_compiled_chain_zero_control_plane(two_agents):
+    """ACCEPTANCE: stages on 2 real agents compile into a resident graph;
+    the steady-state step makes ZERO control-plane requests (rpc:* opcount
+    delta) while producing exact results."""
+    from ray_tpu.core.rpc import opcount
+    from ray_tpu.dag.compiled import CompiledActorDAG
+
+    actors, node = _chain(two_agents)
+    compiled = node.experimental_compile()
+    assert isinstance(compiled, CompiledActorDAG)
+    try:
+        for i in range(3):
+            assert compiled.execute(i).get(timeout=60) == i + 3
+        before = opcount.snapshot()
+        refs = [compiled.execute(i) for i in range(50)]
+        out = [r.get(timeout=60) for r in refs]
+        delta = {k: v for k, v in opcount.delta(before).items()
+                 if k.startswith("rpc:") or k.startswith("local:")}
+        assert out == [i + 3 for i in range(50)]
+        assert not delta, f"steady state spoke the control plane: {delta}"
+    finally:
+        compiled.teardown()
+    # actors still serve normal calls after teardown
+    assert ray_tpu.get(actors[0].step.remote(7)) == 8
+
+
+def test_cross_node_wire_bridge_mode(two_agents):
+    """RAY_TPU_DAG_FABRIC_FORCE_WIRE=1: cross-node edges ride the
+    agent-to-agent dag_ch_* BLOB path (persistent data-plane peers) —
+    still zero rpc:* traffic, fabric:* counters move instead."""
+    from ray_tpu.core.rpc import opcount
+    from ray_tpu.dag.compiled import CompiledActorDAG
+
+    os.environ["RAY_TPU_DAG_FABRIC_FORCE_WIRE"] = "1"
+    try:
+        actors, node = _chain(two_agents)
+        compiled = node.experimental_compile()
+        assert isinstance(compiled, CompiledActorDAG)
+        try:
+            assert compiled.execute(0).get(timeout=60) == 3
+            before = opcount.snapshot()
+            refs = [compiled.execute(i) for i in range(20)]
+            out = [r.get(timeout=120) for r in refs]
+            delta = opcount.delta(before)
+            rpc = {k: v for k, v in delta.items() if k.startswith("rpc:")}
+            fabric = {k: v for k, v in delta.items()
+                      if k.startswith("fabric:")}
+            assert out == [i + 3 for i in range(20)]
+            assert not rpc, rpc
+            # the driver's own edges bridged over the wire (reads+writes)
+            assert sum(fabric.values()) >= 40, fabric
+        finally:
+            compiled.teardown()
+    finally:
+        os.environ.pop("RAY_TPU_DAG_FABRIC_FORCE_WIRE", None)
+
+
+def test_agent_sigkill_mid_step_cascades_then_recompiles(two_agents):
+    """CHAOS ACCEPTANCE: SIGKILL the agent hosting a mid-chain actor while
+    steps are in flight — every pending get() RAISES (bounded time, no
+    hang); after the actor re-places onto the surviving node, a fresh
+    compile serves steps again."""
+    cluster, na, nb = two_agents
+    rt = get_runtime()
+
+    @ray_tpu.remote(isolate_process=True, num_cpus=1, max_restarts=1,
+                    resources={"xany": 1})
+    class Stage:
+        def step(self, x):
+            return x + 1
+
+    # a resource BOTH agents carry, so the restart can land on the survivor
+    for nid in (na, nb):
+        node = rt.scheduler.get_node(nid)
+        node.total["xany"] = node.total.get("xany", 0) + 5
+        node.available["xany"] = node.available.get("xany", 0) + 5
+
+    from ray_tpu.dag import InputNode
+
+    s1, s2 = Stage.remote(), Stage.remote()
+    ray_tpu.get([s1.step.remote(0), s2.step.remote(0)])
+    victim_node = rt.actor_state(s1._actor_id).node_id
+    assert victim_node in (na, nb)
+
+    with InputNode() as inp:
+        node = s2.step.bind(s1.step.bind(inp))
+    compiled = node.experimental_compile()
+    assert compiled.execute(1).get(timeout=60) == 3
+
+    results: list = []
+
+    def stepper():
+        try:
+            for i in range(10_000):
+                results.append(compiled.execute(i).get(timeout=60))
+        except BaseException as e:  # noqa: BLE001 — the assertion target
+            results.append(e)
+
+    t = threading.Thread(target=stepper, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    while not results and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert results, "no step completed before the kill"
+    cluster.kill_node(victim_node)
+    t.join(timeout=60)
+    assert not t.is_alive(), "get() hung after agent SIGKILL (no cascade)"
+    assert isinstance(results[-1], BaseException), results[-1]
+    compiled.teardown()
+
+    # re-placement: the restart budget re-runs the creation spec on the
+    # surviving agent; a fresh compile then serves steps again
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = rt.actor_state(s1._actor_id)
+        if st.state == "ALIVE" and st.node_id is not None \
+                and st.node_id != victim_node:
+            break
+        time.sleep(0.05)
+    st = rt.actor_state(s1._actor_id)
+    assert st.state == "ALIVE" and st.node_id != victim_node, st.state
+    with InputNode() as inp:
+        node2 = s2.step.bind(s1.step.bind(inp))
+    compiled2 = node2.experimental_compile()
+    try:
+        assert compiled2.execute(5).get(timeout=60) == 7
+    finally:
+        compiled2.teardown()
+
+
+def test_old_wire_agent_negotiates_down_to_per_call(two_agents):
+    """A peer that negotiated wire < v9 cannot host fabric graphs: actor
+    SPAWN falls back to the head host, and a compile over an actor already
+    living on such a node falls back to the legacy per-call driver."""
+    cluster, na, nb = two_agents
+    rt = get_runtime()
+
+    # a remote actor placed while the agent spoke v9
+    a = Counter.options(resources={"a": 1}).remote(0)
+    assert ray_tpu.get(a.add.remote(1)) == 1
+    assert getattr(rt.actor_state(a._actor_id).proc_worker, "is_remote",
+                   False)
+
+    agent = rt._agents[na]
+    saved = agent.negotiated_version
+    agent.negotiated_version = 8  # simulate an old-wire agent
+    try:
+        from ray_tpu.dag import CompiledDAG, InputNode
+        from ray_tpu.dag.compiled import CompiledActorDAG
+
+        # compile sees the <v9 fabric endpoint and negotiates down
+        with InputNode() as inp:
+            node = a.add.bind(inp)
+        compiled = node.experimental_compile()
+        assert not isinstance(compiled, CompiledActorDAG)
+        assert isinstance(compiled, CompiledDAG)
+
+        # spawn against the old-wire node: worker lands on the head host
+        b = Counter.options(resources={"a": 1}).remote(100)
+        assert ray_tpu.get(b.add.remote(2)) == 102
+        assert not getattr(rt.actor_state(b._actor_id).proc_worker,
+                           "is_remote", False)
+    finally:
+        agent.negotiated_version = saved
+    # the legacy driver dispatches per-call over the REAL (v9) connection
+    try:
+        assert compiled.execute(2).get(timeout=60) == 3
+    finally:
+        compiled.teardown()
+
+
+# --------------------------------------------------- placement satellites
+def test_scheduler_io_pressure_and_locality():
+    """Unit: hybrid packing avoids a pressure-saturated node; locality
+    hints win among feasible nodes."""
+    from ray_tpu._private.config import Config
+    from ray_tpu.core.scheduler import (ClusterScheduler, ResourceSet,
+                                        SchedulingRequest)
+
+    sched = ClusterScheduler(Config())
+    n1 = sched.add_node({"CPU": 4})
+    n2 = sched.add_node({"CPU": 4})
+    # make n1 the pack winner on utilization (more utilized, same fit)
+    sched.try_acquire(SchedulingRequest(
+        resources=ResourceSet({"CPU": 1}), policy="node_affinity",
+        node_affinity=n1))
+
+    got = sched.try_acquire(SchedulingRequest(ResourceSet({"CPU": 1})))
+    assert got == n1  # pack onto the busier node
+    sched.release(got, SchedulingRequest(ResourceSet({"CPU": 1})))
+
+    sched.set_io_pressure_provider(lambda: {n1: 1.0})
+    got = sched.try_acquire(SchedulingRequest(ResourceSet({"CPU": 1})))
+    assert got == n2  # saturated pull budget steers the lease away
+    sched.release(got, SchedulingRequest(ResourceSet({"CPU": 1})))
+
+    # locality beats both packing and pressure among feasible nodes
+    sched.set_io_pressure_provider(lambda: {n2: 1.0})
+    got = sched.try_acquire(SchedulingRequest(
+        ResourceSet({"CPU": 1}), locality_nodes=frozenset({n2})))
+    assert got == n2
+
+
+def test_stripe_holder_order_weighted_by_pending():
+    """Unit: holder candidates sort least-pending-bytes first (stable)."""
+    from ray_tpu.core.object_plane import PlaneClient
+
+    c = PlaneClient()
+    c._holder_pending = {"h2:1": 4 << 20, "h1:1": 1 << 20}
+    entries = [(b"t2", "h2:1"), (b"t1", "h1:1"), (b"t3", "h3:1")]
+    ordered = c._order_by_pending(entries)
+    assert [a for _, a in ordered] == ["h3:1", "h1:1", "h2:1"]
+
+
+# ------------------------------------------------ serve compiled dispatch
+def test_serve_replica_remote_and_compiled_dispatch(two_agents):
+    """ACCEPTANCE: a serve replica lives on a REMOTE agent and serves
+    traffic through the compiled ingress->replica edge — steady-state
+    requests submit no actor tasks."""
+    cluster, na, nb = two_agents
+    from ray_tpu import serve
+    from ray_tpu.core.rpc import opcount
+    from ray_tpu.dag import CompiledDAGRef
+
+    @serve.deployment(name="FabEcho", compiled_dispatch=True,
+                      ray_actor_options={"isolate_process": True,
+                                         "num_cpus": 1,
+                                         "resources": {"b": 1}})
+    class FabEcho:
+        def __call__(self, body):
+            import os as _os
+
+            return {"doubled": body["x"] * 2,
+                    "node": _os.environ.get("RAY_TPU_NODE_ID", "head")}
+
+    try:
+        handle = serve.run(FabEcho.bind(), route_prefix=None)
+        out = ray_tpu.get(handle.remote({"x": 3}), timeout=60)
+        assert out["doubled"] == 6
+        assert out["node"] == nb.hex()  # replica is OFF the head host
+
+        # warm: the router compiled its per-replica graph on first use
+        ref = handle.remote({"x": 1})
+        assert isinstance(ref, CompiledDAGRef)
+        assert ray_tpu.get(ref, timeout=60)["doubled"] == 2
+        before = opcount.snapshot()
+        for i in range(20):
+            ref = handle.remote({"x": i})
+            assert isinstance(ref, CompiledDAGRef)  # every request compiled
+            assert ray_tpu.get(ref, timeout=60)["doubled"] == 2 * i
+        delta = opcount.delta(before)
+        # the REQUESTS submitted no actor tasks; the only control traffic
+        # is the router's periodic replica refresh (0.5s cadence)
+        assert delta.get("local:submit_actor_task", 0) <= 6, delta
+    finally:
+        serve.shutdown()
+
+
+def test_pd_decode_replica_off_head_compiled(two_agents):
+    """PDDecode replicas can finally live off-head: the decode fleet pins
+    to a remote agent, the PD app answers through the compiled dispatch
+    path with exact token flow."""
+    cluster, na, nb = two_agents
+    from ray_tpu import serve
+    from ray_tpu.serve import pd as pd_mod
+    from tests.test_kv_transport import _pd_model_config
+    from ray_tpu.serve.llm_paged import PagedLLMConfig
+
+    cfg = PagedLLMConfig(model_config=_pd_model_config(), max_batch_size=2,
+                         max_seq_len=256, block_size=16)
+    try:
+        dep = pd_mod.build_decode_deployment(cfg, num_replicas=1)
+        dep.deployment.config.ray_actor_options.update(
+            {"isolate_process": True, "num_cpus": 1, "resources": {"b": 1}})
+        serve.run(dep, route_prefix=None)
+        from ray_tpu.serve.api import _get_or_create_controller
+
+        ctrl = _get_or_create_controller()
+        deadline = time.monotonic() + 180
+        nodes = {}
+        while time.monotonic() < deadline:
+            nodes = ray_tpu.get(
+                ctrl.get_replica_nodes.remote("PDDecode"), timeout=30)
+            # "head" is the placeholder until the replica's probe lands
+            if nodes and set(nodes.values()) == {nb.hex()}:
+                break
+            time.sleep(0.3)
+        assert nodes and set(nodes.values()) == {nb.hex()}, nodes
+
+        handle = serve.get_deployment_handle("PDDecode")
+        from ray_tpu.dag import CompiledDAGRef
+
+        ref = handle.stats.remote()
+        st = ray_tpu.get(ref, timeout=120)
+        assert isinstance(ref, CompiledDAGRef)  # compiled dispatch engaged
+        assert "kv" in st
+    finally:
+        serve.shutdown()
+
+
+# ------------------------------------------------- compiled gang step
+def test_compiled_gang_step_parity_and_zero_control_plane(two_agents):
+    """train/: gang members execute their step loop as a resident compiled
+    graph — outputs match per-call dispatch exactly, steady state makes no
+    control-plane requests."""
+    from ray_tpu.core.rpc import opcount
+    from ray_tpu.train import CompiledGangStep
+
+    @ray_tpu.remote(isolate_process=True, num_cpus=1)
+    class Member:
+        def __init__(self, rank):
+            self.rank = rank
+            self.steps = 0
+
+        def train_step(self, batch):
+            self.steps += 1
+            return {"rank": self.rank, "loss": batch * 0.5 + self.rank}
+
+    members = [
+        Member.options(resources={("a" if i % 2 == 0 else "b"): 1}).remote(i)
+        for i in range(2)
+    ]
+    gang = CompiledGangStep(members, method="train_step")
+    assert gang.compiled
+    try:
+        out = gang.step(4.0).get(timeout=60)
+        assert [o["rank"] for o in out] == [0, 1]
+        assert out[0]["loss"] == 2.0 and out[1]["loss"] == 3.0
+        before = opcount.snapshot()
+        for i in range(20):
+            outs = gang.step(float(i)).get(timeout=60)
+            assert outs[1]["loss"] == i * 0.5 + 1
+        delta = {k: v for k, v in opcount.delta(before).items()
+                 if k.startswith(("rpc:", "local:"))}
+        assert not delta, delta
+    finally:
+        gang.teardown()

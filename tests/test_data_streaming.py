@@ -448,3 +448,59 @@ def test_llm_processor_streams_with_bounded_window(session):
     # window bound: never more than max_inflight_batches * batch_size
     # prompts in flight (+ the batch being submitted)
     assert max(hi_water) <= 3 * 4, max(hi_water)
+
+
+# ------------------------------------------------- batched seals (ISSUE 15)
+def test_put_batch_seals_in_one_rpc(session):
+    """ROADMAP streaming follow-up (d): a data task's N output blocks cost
+    ONE control-plane round trip (client_put_seal_batch), not one blocking
+    client_put_seal each — counter-asserted against a live head through a
+    real ClientRuntime (the worker-side put path)."""
+    import numpy as np
+
+    from ray_tpu.core.client_runtime import ClientRuntime
+    from ray_tpu.core.rpc import opcount
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    host, port = rt.control_plane.server.address
+    client = ClientRuntime(host, port, rt.control_plane.token,
+                           rt.shm_store.name, rt.config.object_store_memory)
+    try:
+        values = [np.arange(20_000, dtype=np.int64) + i for i in range(6)]
+        before = opcount.snapshot()
+        refs = client.put_batch(values)
+        delta = {k: v for k, v in opcount.delta(before).items()
+                 if k.startswith("rpc:client_put")}
+        assert delta == {"rpc:client_put_seal_batch": 1}, delta
+        # the head serves every sealed block back by value
+        for ref, v in zip(refs, values):
+            got = rt.get([ray_tpu.ObjectRef(ref.object_id(), rt)],
+                         timeout=30)[0]
+            assert np.array_equal(got, v)
+        # per-put path still costs one seal each (the batch is the win)
+        before = opcount.snapshot()
+        client.put(values[0])
+        delta = {k: v for k, v in opcount.delta(before).items()
+                 if k.startswith("rpc:client_put")}
+        assert delta == {"rpc:client_put_seal": 1}, delta
+    finally:
+        client.shutdown()
+
+
+def test_transform_task_outputs_ride_put_batch(session):
+    """The streaming map task body seals through ray_tpu.put_batch — one
+    registration for all of a task's output blocks."""
+    from ray_tpu.data.streaming import _slice_to_plane, _transform_to_plane
+
+    blk = Block({"x": np.arange(4096, dtype=np.int64)})
+    rows = _transform_to_plane(
+        lambda b: [b.slice(0, 2048), b.slice(2048, 4096)], blk)
+    assert len(rows) == 2
+    assert sum(r[1] for r in rows) == 4096
+    assert all(ray_tpu.get(r[0]).num_rows() == 2048 for r in rows)
+
+    slices = _slice_to_plane(blk, 3)
+    assert [s[1] for s in slices] == [1366, 1365, 1365]
+    got = [ray_tpu.get(s[0]).num_rows() for s in slices]
+    assert got == [1366, 1365, 1365]

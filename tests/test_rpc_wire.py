@@ -90,7 +90,8 @@ def test_mixed_version_against_live_control_plane():
         old.close()
 
         with pytest.raises(rpc.WireVersionError):
-            rpc.connect(host, port, name="future-worker", versions=(9, 9))
+            rpc.connect(host, port, name="future-worker",
+                        versions=(rpc.WIRE_VERSION + 1, rpc.WIRE_VERSION + 1))
     finally:
         ray_tpu.shutdown()
 
