@@ -506,6 +506,12 @@ class ControlPlane:
             from ray_tpu.util import timeline
 
             timeline.ingest_remote(node_hex, source, msg["phases"])
+        if msg.get("serve_phases"):
+            # serve-anatomy piggyback: replica-side request phase stamps,
+            # folded into the head's per-request ledgers/SLO scoreboard
+            from ray_tpu.serve import anatomy
+
+            anatomy.ingest_remote(node_hex, source, msg["serve_phases"])
         if peer.closed:
             # register-after-disconnect: _peer_gone may have already run
             # while this push sat on the reactor — withdraw, or a dead

@@ -325,6 +325,37 @@ def _worker_main(conn, shm_name: str | None, shm_size: int) -> None:
 
     threading.Thread(target=_pipe_reader, daemon=True, name="pipe-reader").start()
 
+    def _anatomy_pusher() -> None:
+        """Serve-anatomy uplink (ISSUE 16): a pool worker owns no head peer
+        (the client runtime only piggybacks LIVE connections), so request
+        phase stamps ride the reply pipe on the metrics beat — the same
+        route as phase_reply — and the pool parent, which does run a push
+        loop, re-homes them into its own ring (anatomy.adopt)."""
+        import sys as _sys
+
+        period = float(os.environ.get("RAY_TPU_METRICS_PUSH_PERIOD_S", "2")
+                       or 2)
+        if period <= 0:
+            return
+        cursor = 0
+        while True:
+            time.sleep(period)
+            an = _sys.modules.get("ray_tpu.serve.anatomy")
+            if an is None:
+                continue  # this worker never loaded the serve stack
+            try:
+                entries, cursor = an.drain_since(cursor)
+                if entries:
+                    _reply(("serve_phases", entries))
+            except Exception as e:  # telemetry never takes a worker down
+                from ray_tpu.util import flight_recorder
+
+                flight_recorder.record("serve", "anatomy_uplink_error",
+                                       error=str(e)[:200])
+
+    threading.Thread(target=_anatomy_pusher, daemon=True,
+                     name="serve-anatomy-push").start()
+
     def _check_skip(seq: int) -> bool:
         with pend_cv:
             if seq in cancelled:
@@ -1324,7 +1355,9 @@ class ProcessWorkerPool:
             except Exception:
                 resp = ("badreq", None)
             tag = resp[0]
-            if tag == "badreq" or tag not in ("ready", "start", "done", "skipped", "item"):
+            if tag == "badreq" or tag not in ("ready", "start", "done",
+                                              "skipped", "item",
+                                              "serve_phases"):
                 # Protocol desync (undecodable frame on either side): this
                 # worker's stream can no longer be trusted — kill it; the
                 # EOF path fails its in-flight futures as WorkerCrashedError
@@ -1368,6 +1401,20 @@ class ProcessWorkerPool:
                             pass
                         if not inf.future.done():
                             inf.future.set_exception(e)
+            elif tag == "serve_phases":
+                # worker serve-anatomy beat (reply-pipe uplink, like the
+                # phase_clocks piggyback): re-home the entries in THIS
+                # process's ring — the pool parent (head driver or node
+                # agent) runs a metrics push loop, its workers don't
+                try:
+                    from ray_tpu.serve import anatomy as _anatomy
+
+                    _anatomy.adopt(resp[1])
+                except Exception as e:
+                    from ray_tpu.util import flight_recorder
+
+                    flight_recorder.record("serve", "anatomy_adopt_error",
+                                           error=str(e)[:200])
             elif tag == "done":
                 seq, status, payload, extra = resp[1], resp[2], resp[3], resp[4]
                 contained = resp[5] if len(resp) > 5 else None

@@ -405,7 +405,11 @@ register_op(56, "metrics_push", [
     # v8 timeline piggyback: worker task-phase + subsystem span entries
     # (util/timeline.drain_since). Appended optional field — inbound-
     # tolerant <v8 heads drop it, so the push itself stays since=5.
-    _f("phases", T.ANY)], since=5,
+    _f("phases", T.ANY),
+    # v9 serve-anatomy piggyback: per-request phase-ledger entries
+    # (serve/anatomy.drain_since). Same appended-optional contract —
+    # older heads drop it, the push stays since=5.
+    _f("serve_phases", T.ANY)], since=5,
     doc="agent -> head (notify): compact metrics-registry snapshot "
         "(util/metrics.wire_snapshot) + new flight-recorder events + new "
         "timeline entries; the head merges all under the sender's node_id")

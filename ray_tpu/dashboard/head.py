@@ -7,6 +7,7 @@ Endpoints (reference: dashboard/routes.py + module handlers):
   GET /api/jobs            — job submission records
   GET /metrics             — Prometheus exposition (util.metrics registry)
   GET /api/serve/status    — serve applications (if serve controller exists)
+  GET /api/v0/serve        — serve request anatomy (SLO scoreboard + ledgers)
   GET /healthz
 """
 
@@ -322,6 +323,18 @@ class Dashboard:
 
             return web.json_response(jsonable(st.gang_view()))
 
+        async def serve_anatomy(request):
+            """Serve request anatomy (util/state.serve_view): SLO scoreboard
+            + predicted TTFT per replica + recent per-request phase ledgers.
+            ?limit= caps the ledger rows."""
+            from ray_tpu.util import state as st
+
+            try:
+                limit = min(int(request.query.get("limit", 64)), 512)
+            except ValueError:
+                limit = 64
+            return web.json_response(jsonable(st.serve_view(limit=limit)))
+
         async def timeline(request):
             """The whole session as ONE Chrome/Perfetto trace (util/state
             .timeline): task phases + head transitions + spans + dag steps
@@ -430,6 +443,7 @@ class Dashboard:
             app.router.add_get("/api/v0/flight_records", flight_records)
             app.router.add_get("/api/v0/node_io", node_io)
             app.router.add_get("/api/v0/gang", gang)
+            app.router.add_get("/api/v0/serve", serve_anatomy)
             app.router.add_get("/api/v0/timeline", timeline)
             app.router.add_get("/api/v0/{resource}", state_list)
             app.router.add_get("/api/jobs", jobs)

@@ -104,8 +104,60 @@ HOT_PATHS = (
             ("pull", ("pull_into", "pull_into_or_pull"),
              "pull no longer rides pull_into — KV pages must land "
              "zero-copy in the local store"),
+            ("publish", ("kv_window",),
+             "publish no longer stamps its anatomy KV window — request "
+             "ledgers lose the kv_publish phase (serve/anatomy.py)"),
+            ("pull", ("kv_window",),
+             "pull no longer stamps its anatomy KV window — request "
+             "ledgers lose the kv_pull phase (serve/anatomy.py)"),
         ),
         missing_hint="handoff path gone?",
+    ),
+    # ISSUE-16: serve anatomy stamping — every stamp is ONE bounded-ring
+    # append: no instruments (bind or record), no RPC, no task submission
+    # on the request path. Recording happens head-side at fold/settle time.
+    HotPath(
+        file="ray_tpu/serve/anatomy.py",
+        funcs=("stamp", "kv_window", "link_kv", "complete", "admit",
+               "rid_of", "router_stamp", "replica_dequeue", "drain_since"),
+        reason="per-request phase stamps on the serve hot path",
+        ban_metric_record=True,
+        ban_rpc=True,
+        ban_submit=True,
+        forbid_imports=CONTROL_PLANE_IMPORTS,
+        missing_hint="anatomy stamping API renamed? (update HOT_PATHS)",
+    ),
+    # ISSUE-16: the stamping sites stay wired — the router marks its
+    # decision (compiled dispatch stays ONE channel frame: the stamp is a
+    # ring append, not a wire op), the paged engine stamps the first
+    # decoded token.
+    HotPath(
+        file="ray_tpu/serve/controller.py",
+        funcs=("_submit_compiled", "submit", "submit_stream"),
+        reason="per-request dispatch; anatomy stamps must stay ring-only",
+        ban_metric_construct=False,
+        require_calls=(
+            ("_submit_compiled", ("router_stamp",),
+             "compiled dispatch no longer stamps router_decision — "
+             "ledgers lose the routing phase on the zero-RPC path"),
+            ("submit", ("router_stamp",),
+             "per-call dispatch no longer stamps router_decision"),
+            ("submit_stream", ("router_stamp",),
+             "streaming dispatch no longer stamps router_decision"),
+        ),
+        missing_hint="router dispatch renamed? (update HOT_PATHS)",
+    ),
+    HotPath(
+        file="ray_tpu/serve/llm_paged.py",
+        funcs=("_step_decode",),
+        reason="per-step decode loop; first-token stamp is one ring append",
+        require_calls=(
+            ("_step_decode", ("stamp",),
+             "_step_decode no longer stamps decode_first_token — PD "
+             "ledgers lose the first-token phase and TTFT degrades to "
+             "completion time"),
+        ),
+        missing_hint="paged decode step renamed? (update HOT_PATHS)",
     ),
     # ISSUE-12: streaming data plane pump / fetch / task bodies. May submit
     # tasks and get objects through the public API (which owns

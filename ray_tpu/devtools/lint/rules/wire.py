@@ -487,18 +487,27 @@ def gate_findings(ctx, ops=None) -> list:
 
 
 def profiler_piggyback_findings(ctx) -> list:
-    """The metrics_push ``phases`` piggyback field must exist (the
+    """The metrics_push piggyback fields must exist: ``phases`` (the
     timeline half rides the v5 push; removing the field silently severs
-    worker phase lanes)."""
+    worker phase lanes) and ``serve_phases`` (the serve anatomy ledger
+    rides the same push; removing it silently blinds the SLO
+    scoreboard to every remote replica)."""
     from ray_tpu.core.rpc import schema
 
+    out = []
     push = schema.REGISTRY.get("metrics_push")
     if push is not None and "phases" not in push.field_map():
-        return [ctx.finding(
+        out.append(ctx.finding(
             "version-gating", _SCHEMA_REL, 0,
             "metrics_push lost its `phases` field — worker timeline "
-            "entries have no transport", "field:metrics_push.phases")]
-    return []
+            "entries have no transport", "field:metrics_push.phases"))
+    if push is not None and "serve_phases" not in push.field_map():
+        out.append(ctx.finding(
+            "version-gating", _SCHEMA_REL, 0,
+            "metrics_push lost its `serve_phases` field — remote serve "
+            "anatomy stamps have no transport (serve/anatomy.py)",
+            "field:metrics_push.serve_phases"))
+    return out
 
 
 @project_rule("version-gating",

@@ -140,6 +140,21 @@ def shutdown() -> None:
         _state["routes"] = {}
 
 
+def _ntokens_of(result) -> int:
+    """Generated-token count from the common reply shapes (PD/LLM bodies
+    and OpenAI objects both carry usage.completion_tokens)."""
+    if isinstance(result, dict):
+        usage = result.get("usage")
+        if isinstance(usage, dict):
+            try:
+                return int(usage.get("completion_tokens") or 0)
+            except (TypeError, ValueError):
+                return 0
+        if isinstance(result.get("token_ids"), (list, tuple)):
+            return len(result["token_ids"])
+    return 0
+
+
 async def _await_ref(ref, timeout: float):
     """Await an ObjectRef on the reactor: the runtime's future-based get
     parks NO thread per in-flight request (reference: the asyncio router of
@@ -191,6 +206,8 @@ class HttpProxy:
         from aiohttp import web
 
         async def handler(request: "web.Request") -> "web.Response":
+            from ray_tpu.serve import anatomy
+
             route, handle = self._match(request.path)
             if handle is None:
                 return web.json_response({"error": f"no route for {request.path}"}, status=404)
@@ -198,6 +215,10 @@ class HttpProxy:
                 body = await request.json() if request.can_read_body else {}
             except json.JSONDecodeError:
                 return web.json_response({"error": "invalid JSON body"}, status=400)
+            # anatomy front door: the proxy admits the request (rid rides the
+            # body through router -> replica -> engine) and, having admitted,
+            # owns the completion record for both reply shapes below
+            rid = anatomy.admit(body, handle.deployment_name)
             # OpenAI-compatible endpoints (reference: ray.serve.llm ingress,
             # llm/_internal/serve/core/ingress/): only for deployments that
             # opted into the surface (build_openai_app) — the subpath selects
@@ -214,10 +235,16 @@ class HttpProxy:
                 try:
                     result = await _await_ref(ref, timeout=120)
                 except Exception as e:  # noqa: BLE001
+                    if rid is not None:
+                        anatomy.complete(rid, handle.deployment_name,
+                                         ok=False, err=str(e)[:200])
                     return web.json_response(
                         {"error": {"message": str(e)[:500], "type": type(e).__name__}},
                         status=500,
                     )
+                if rid is not None:
+                    anatomy.complete(rid, handle.deployment_name,
+                                     ntokens=_ntokens_of(result))
                 return web.json_response(result)
             if isinstance(body, dict) and body.get("stream"):
                 return await self._stream_response(request, handle, body)
@@ -225,7 +252,13 @@ class HttpProxy:
             try:
                 result = await _await_ref(ref, timeout=60)
             except Exception as e:  # noqa: BLE001
+                if rid is not None:
+                    anatomy.complete(rid, handle.deployment_name,
+                                     ok=False, err=str(e)[:200])
                 return web.json_response({"error": str(e)[:500]}, status=500)
+            if rid is not None:
+                anatomy.complete(rid, handle.deployment_name,
+                                 ntokens=_ntokens_of(result))
             if isinstance(result, (dict, list, str, int, float)) or result is None:
                 return web.json_response({"result": result})
             return web.json_response({"result": repr(result)})
@@ -251,6 +284,8 @@ class HttpProxy:
         (reference: serve streaming responses through the proxy)."""
         from aiohttp import web
 
+        from ray_tpu.serve import anatomy
+
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -258,7 +293,10 @@ class HttpProxy:
         await resp.prepare(request)
         loop = asyncio.get_running_loop()
         method = body.get("stream_method", "stream_tokens")
+        rid = anatomy.rid_of(body)
         it = handle.stream(body, method_name=method)
+        nframes = 0
+        err = None
 
         def next_item():
             try:
@@ -271,18 +309,28 @@ class HttpProxy:
                 try:
                     item = await loop.run_in_executor(self._stream_pool, next_item)
                 except Exception as e:  # noqa: BLE001 - stream errors become frames
-                    msg = str(e).splitlines()[-1][:200] if str(e) else type(e).__name__
-                    await resp.write(f"data: {json.dumps({'error': msg})}\n\n".encode())
+                    err = str(e).splitlines()[-1][:200] if str(e) else type(e).__name__
+                    await resp.write(f"data: {json.dumps({'error': err})}\n\n".encode())
                     break
                 if item is _STREAM_END:
                     break
+                if nframes == 0 and rid is not None:
+                    # front-door first-token clock; an engine-side stamp
+                    # (earlier, more precise) folds over this one when the
+                    # replica's push beat lands
+                    anatomy.stamp(rid, "decode_first_token",
+                                  anatomy.now_wall())
+                nframes += 1
                 await resp.write(f"data: {json.dumps(item)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
         except (ConnectionError, ConnectionResetError, asyncio.CancelledError):
-            pass  # client went away: fall through to close the stream below
+            err = err or "client_disconnected"
         finally:
             it.close()  # releases the router's in-flight slot (GeneratorExit)
+            if rid is not None:
+                anatomy.complete(rid, handle.deployment_name,
+                                 ntokens=nframes, ok=err is None, err=err)
         return resp
 
     def _match(self, path: str):

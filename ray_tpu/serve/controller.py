@@ -45,9 +45,14 @@ class ReplicaActor:
         self._lock = threading.Lock()
 
     def handle_request(self, method_name: str, args, kwargs):
+        from ray_tpu.serve import anatomy
         from ray_tpu.serve.multiplex import _set_model_id
 
         _set_model_id("")  # fresh per request: no stale id across thread reuse
+        # queue-wait stamp: the request left this replica's mailbox (one
+        # ring append, gated on the body carrying a ledger)
+        if args and isinstance(args[0], dict):
+            anatomy.replica_dequeue(args[0])
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -76,9 +81,12 @@ class ReplicaActor:
     def handle_streaming(self, method_name: str, args, kwargs):
         """Generator entry: streams the user's generator method incrementally
         (reference: serve streaming responses over proxy)."""
+        from ray_tpu.serve import anatomy
         from ray_tpu.serve.multiplex import _set_model_id
 
         _set_model_id("")
+        if args and isinstance(args[0], dict):
+            anatomy.replica_dequeue(args[0])
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -259,6 +267,15 @@ class ServeController:
                 ray_tpu.kill(r)
             except Exception:
                 pass
+        # declare the deployment's TTFT SLO to the anatomy scoreboard (the
+        # controller runs on the head, where the scoreboard lives)
+        try:
+            from ray_tpu.serve import anatomy
+
+            anatomy.set_slo(name, getattr(deployment.config,
+                                          "slo_ttft_ms", None))
+        except Exception:
+            pass
         self._checkpoint()
         self._reconcile_once()
         self._publish_routes()
@@ -387,7 +404,7 @@ class ServeController:
         self._harvest_node_probes(wait_s=2.0)
         victims: list = []
         with self._lock:
-            for st in self._deployments.values():
+            for dep_name, st in self._deployments.items():
                 for r in list(st.replicas):
                     # match only KNOWN placements — "head" is a real value,
                     # so an unresolved probe must not default into it (a
@@ -397,15 +414,34 @@ class ServeController:
                     if self._replica_nodes.get(
                             r._actor_id.hex()) == node_hex:
                         st.replicas.remove(r)
-                        victims.append(r)
-            for r in victims:
+                        victims.append((dep_name, r))
+            for _dep, r in victims:
                 self._replica_nodes.pop(r._actor_id.hex(), None)
                 self._node_probes.pop(r._actor_id.hex(), None)
         flight_recorder.record("serve", "node_drain", node_id=node_hex,
                                reason=reason, replicas=len(victims))
-        for r in victims:
+        for _dep, r in victims:
             try:
                 ray_tpu.kill(r)
+            except Exception:
+                pass
+        # retire the victims' telemetry NOW instead of letting their last
+        # pushed series serve as live for 3x the push period: scoreboard +
+        # predicted-TTFT entries per replica, and the drained node's pushed
+        # snapshots (its replica workers are being killed; survivors on the
+        # node re-appear on their next push beat)
+        if victims:
+            try:
+                from ray_tpu.serve import anatomy
+                from ray_tpu.util import metrics as _metrics
+
+                by_dep: dict = {}
+                for dep_name, r in victims:
+                    by_dep.setdefault(dep_name, []).append(
+                        r._actor_id.hex())
+                for dep_name, keys in by_dep.items():
+                    anatomy.retire_replica(dep_name, keys)
+                _metrics.drop_remote_snapshot(node_hex)
             except Exception:
                 pass
         return len(victims)
@@ -677,6 +713,19 @@ class Router:
         self._completions: "_q.Queue" = _q.Queue()
         self._watcher = threading.Thread(target=self._watch_loop, daemon=True)
         self._watcher.start()
+        # anatomy sensing: expose this router's per-replica in-flight depth
+        # to the head's predicted-TTFT estimator (weakly held). Subclasses
+        # (KVAwareRouter) may have set a real node map already.
+        from ray_tpu.serve import anatomy
+
+        if not hasattr(self, "_replica_nodes"):
+            self._replica_nodes: dict = {}
+        anatomy.register_router(self)
+
+    def inflight_snapshot(self) -> dict:
+        """Per-replica in-flight depths (the predicted-TTFT queue signal)."""
+        with self._lock:
+            return dict(self._inflight)
 
     def _watch_loop(self) -> None:
         import queue as _q
@@ -793,10 +842,15 @@ class Router:
         """Streaming variant: (ObjectRefGenerator, done_cb). The stream counts as
         in flight until the caller's iterator finishes/closes (done_cb) — long
         token streams stay visible to load balancing and autoscaling."""
+        from ray_tpu.serve import anatomy
+
+        t_route0 = anatomy.now_wall()
         replica = self.pick(hint=self._routing_hint(method_name, args, kwargs))
         key = self._rkey(replica)
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
+        anatomy.router_stamp(args[0] if args else None, self._name,
+                             key, t_route0)
         gen = replica.handle_streaming.options(num_returns="streaming").remote(
             method_name, args, kwargs
         )
@@ -878,6 +932,9 @@ class Router:
         graph; in-flight accounting retires on the graph's completion
         callback (no watcher thread, no wait on dag refs). Returns None
         when compiled dispatch doesn't apply (caller goes per-call)."""
+        from ray_tpu.serve import anatomy
+
+        t_route0 = anatomy.now_wall()
         for _ in range(2):
             replica = self.pick(
                 hint=self._routing_hint(method_name, args, kwargs))
@@ -887,6 +944,10 @@ class Router:
             key = self._rkey(replica)
             with self._lock:
                 self._inflight[key] = self._inflight.get(key, 0) + 1
+            # routing-decision stamp rides the ledger already in the body —
+            # still ONE channel frame, zero control-plane requests
+            anatomy.router_stamp(args[0] if args else None, self._name,
+                                 key, t_route0)
             try:
                 ref = dag.execute((method_name, args, kwargs))
             except Exception:
@@ -909,12 +970,17 @@ class Router:
         # A replica killed between router refreshes yields an instantly-errored
         # ref; retry on a different replica so in-flight traffic survives
         # replica death (reference: serve router replica retry on dead actors).
+        from ray_tpu.serve import anatomy
+
+        t_route0 = anatomy.now_wall()
         last_ref = None
         for _ in range(4):
             replica = self.pick(hint=self._routing_hint(method_name, args, kwargs))
             key = self._rkey(replica)
             with self._lock:
                 self._inflight[key] = self._inflight.get(key, 0) + 1
+            anatomy.router_stamp(args[0] if args else None, self._name,
+                                 key, t_route0)
             ref = replica.handle_request.remote(method_name, args, kwargs)
             self._maybe_report()
             last_ref = ref

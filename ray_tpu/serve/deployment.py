@@ -43,6 +43,12 @@ class DeploymentConfig:
     # already serializes (LLM engines, PD prefill/decode); falls back to
     # per-call dispatch when the graph can't compile.
     compiled_dispatch: bool = False
+    # Declared TTFT SLO in milliseconds (ISSUE 16). None = no SLO: the
+    # anatomy scoreboard still records TTFT quantiles but scores no
+    # goodput/breach accounting. Consumed by serve/anatomy.py (the SLO
+    # scoreboard + serve_slo_breach_total) and — next PR — the
+    # autoscaler/admission controller.
+    slo_ttft_ms: float | None = None
 
 
 class Deployment:
@@ -82,7 +88,8 @@ def deployment(_func_or_class=None, *, name: str | None = None, num_replicas: in
                max_ongoing_requests: int = 100, ray_actor_options: dict | None = None,
                autoscaling_config: AutoscalingConfig | dict | None = None,
                user_config: Any = None, route_prefix: str | None = None,
-               request_router: str = "pow2", compiled_dispatch: bool = False):
+               request_router: str = "pow2", compiled_dispatch: bool = False,
+               slo_ttft_ms: float | None = None):
     """``@serve.deployment`` decorator (reference: serve/api.py)."""
 
     def wrap(target):
@@ -99,6 +106,7 @@ def deployment(_func_or_class=None, *, name: str | None = None, num_replicas: in
             route_prefix=route_prefix,
             request_router=request_router,
             compiled_dispatch=compiled_dispatch,
+            slo_ttft_ms=slo_ttft_ms,
         )
         return Deployment(target, cfg)
 

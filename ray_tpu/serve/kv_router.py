@@ -76,13 +76,23 @@ class KVAwareRouter(Router):
     def _routing_hint(self, method_name: str, args, kwargs):
         body = args[0] if args else kwargs.get("body")
         if isinstance(body, dict):
+            hint = None
             handoff = body.get("handoff")
             if isinstance(handoff, dict) and isinstance(
                     handoff.get("kv_ref"), dict):
-                return ("decode", handoff["kv_ref"])
-            ids = body.get("prompt_ids")
-            if isinstance(ids, (list, tuple)) and ids:
-                return ("prefix", list(ids))
+                hint = ("decode", handoff["kv_ref"])
+            else:
+                ids = body.get("prompt_ids")
+                if isinstance(ids, (list, tuple)) and ids:
+                    hint = ("prefix", list(ids))
+            if hint is not None:
+                a = body.get("_anatomy")
+                if isinstance(a, dict):
+                    # the ledger's router_decision stamp records WHICH
+                    # routing mode placed the request (prefix affinity vs
+                    # decode placement scoring)
+                    a["route"] = hint[0]
+                return hint
         return None
 
     def _block_hashes(self, prompt_ids: list) -> list[int]:

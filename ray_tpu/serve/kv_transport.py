@@ -45,6 +45,7 @@ import numpy as np
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
 from ray_tpu.core.shm_store import SharedMemoryStore
+from ray_tpu.serve import anatomy
 from ray_tpu.util import flight_recorder
 from ray_tpu.util.metrics import Counter, Gauge
 
@@ -225,6 +226,7 @@ class KVTransport:
         nbytes = k.nbytes + v.nbytes
         oid = ObjectID(os.urandom(ObjectID.SIZE))
         hid = os.urandom(12)
+        t0_w = anatomy.now_wall()
         view = self._store.create_for_write(oid, nbytes)
         if view is None:  # random oid collided with a sealed entry: impossible
             raise RuntimeError("KV handoff oid collision")
@@ -243,6 +245,10 @@ class KVTransport:
             self._live[hid] = h
             self._by_oid[oid.binary()] = hid
         _M_PUB_BYTES.inc(nbytes)
+        # anatomy window keyed by oid (no request id in scope on the engine
+        # thread; pd.py links rid<->oid): one ring append, hot-path safe
+        anatomy.kv_window(oid.binary().hex(), "kv_publish", t0_w,
+                          anatomy.now_wall(), nbytes)
         desc = {
             "hid": hid,
             "oid": oid.binary(),
@@ -327,6 +333,7 @@ class KVTransport:
         oid = ObjectID(bytes(desc["oid"]))
         addr = desc["addr"]
         nbytes = int(desc["nbytes"])
+        t0_w = anatomy.now_wall()
         # the canonical pull policy: zero-copy pull-into-store first,
         # bytes-returning fallback when there is no room (object_plane.py)
         payload, how = self._client.pull_into_or_pull(
@@ -362,6 +369,8 @@ class KVTransport:
                 self._drop_local(oid)
             raise
         _M_PULL_BYTES.inc(nbytes)
+        anatomy.kv_window(oid.binary().hex(), "kv_pull", t0_w,
+                          anatomy.now_wall(), nbytes)
 
         def ack(_local=local, _oid=oid, _desc=desc):
             self.ack(_desc)

@@ -32,6 +32,7 @@ from typing import Optional
 import numpy as np
 
 from ray_tpu.models import llama
+from ray_tpu.serve import anatomy
 from ray_tpu.serve.llm import LLMConfig, LLMEngine, _Slot
 from ray_tpu.serve.paged_kv import BlockPool, NoFreeBlocks
 
@@ -59,6 +60,11 @@ class PagedLLMEngine(LLMEngine):
                  external_step: bool = False):
         # PD ops (prefill_extract / attach) processed on the engine thread
         self._ops: "queue.Queue" = queue.Queue()
+        # slot -> anatomy rid awaiting its first DECODED token (the attach
+        # payload's _rid); stamped+popped by the first _step_decode that
+        # appends a token for the slot, popped unstamped when the slot is
+        # released first (0/1-token requests finish at attach)
+        self._anatomy_pending: dict = {}
         # kv_transfer="plane" wiring (set by the PD deployment that owns the
         # engine): kv_publish(k, v, meta=...) -> descriptor publishes the
         # gathered pages (KVTransport.publish); kv_pull(descriptor) ->
@@ -118,6 +124,7 @@ class PagedLLMEngine(LLMEngine):
         blocks after they're reallocated to other sequences (silent KV
         corruption). Zeroed rows write into reserved garbage block 0."""
         super()._release_slot(i)
+        self._anatomy_pending.pop(i, None)
         self.tables[i] = 0
         self.lengths[i] = 0
         self.last_tokens[i] = 0
@@ -285,6 +292,12 @@ class PagedLLMEngine(LLMEngine):
                     st.token_queue.put(tok)
                 self.lengths[i] += 1
                 self.last_tokens[i, 0] = tok
+        if self._anatomy_pending:  # falsy-dict check: zero cost per step
+            t_w = anatomy.now_wall()
+            for i in list(self._anatomy_pending):
+                if self.active[i]:
+                    anatomy.stamp(self._anatomy_pending.pop(i),
+                                  "decode_first_token", t_w)
         for i in range(self.config.max_batch_size):
             if self.active[i]:
                 self._maybe_finish(i, self.slots[i].generated[-1])
@@ -472,6 +485,9 @@ class PagedLLMEngine(LLMEngine):
                 ack()  # pages landed in the pool: free both plane copies
             except Exception:
                 pass  # publisher gone/old-wire: its TTL sweep reclaims
+        rid = handoff.get("_rid")
+        if rid is not None:
+            self._anatomy_pending[slot] = rid
         # a 1-token (or 0-token) request is already complete with first_token
         self._maybe_finish(slot, handoff["first_token"])
         return slot

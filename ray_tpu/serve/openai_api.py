@@ -131,15 +131,24 @@ def build_openai_app(config: "LLMConfig | None" = None, *,
                 },
             }
 
-        def _stream_deltas(self, ids: list[int], max_tokens):
+        def _stream_deltas(self, ids: list[int], max_tokens, body=None):
             """Incremental detokenization: decode the WHOLE generated id list
             each step and emit the text delta, holding back a trailing
             partial character (multi-byte/multi-token chars must not split
             into replacement chars across chunks — vLLM's incremental
             detokenizer behavior)."""
+            from ray_tpu.serve import anatomy
+
+            arid = anatomy.rid_of(body)
             generated: list[int] = []
             emitted = ""
             for tok_id in self.engine.generate_stream(ids, max_tokens):
+                if arid is not None:
+                    # replica-clock first-token stamp: closest observer to
+                    # the engine, beats the proxy's first-SSE-frame clock
+                    anatomy.stamp(arid, "decode_first_token",
+                                  anatomy.now_wall())
+                    arid = None
                 generated.append(int(tok_id))
                 text = self.tok.decode(generated)
                 if text.endswith("�"):
@@ -156,7 +165,8 @@ def build_openai_app(config: "LLMConfig | None" = None, *,
             rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
             prompt = _render_chat(body.get("messages", []))
             ids = self.tok.encode(prompt)
-            for delta in self._stream_deltas(ids, body.get("max_tokens")):
+            for delta in self._stream_deltas(ids, body.get("max_tokens"),
+                                             body):
                 yield {
                     "id": rid,
                     "object": "chat.completion.chunk",
@@ -182,7 +192,8 @@ def build_openai_app(config: "LLMConfig | None" = None, *,
             if isinstance(prompt, list):
                 prompt = "".join(prompt)
             ids = self.tok.encode(prompt)
-            for delta in self._stream_deltas(ids, body.get("max_tokens")):
+            for delta in self._stream_deltas(ids, body.get("max_tokens"),
+                                             body):
                 yield {
                     "id": rid,
                     "object": "text_completion",

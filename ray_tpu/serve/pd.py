@@ -102,8 +102,20 @@ def build_prefill_deployment(config=None, *, prefill_config=None,
         def prefill(self, body: dict) -> dict:
             import time
 
+            from ray_tpu.serve import anatomy
+
             t0 = time.monotonic()
+            t0_w = anatomy.now_wall()
             h = self.engine.prefill_extract(body.get("prompt_ids", []))
+            rid = anatomy.rid_of(body)
+            if rid is not None:
+                # the prefill_exec window brackets the engine call (the
+                # kv_publish window it contains is stamped oid-keyed inside
+                # the transport); link rid<->oid so the head can join them
+                anatomy.stamp(rid, "prefill_exec", t0_w, anatomy.now_wall())
+                kv_ref = h.get("kv_ref")
+                if isinstance(kv_ref, dict) and kv_ref.get("oid") is not None:
+                    anatomy.link_kv(rid, bytes(kv_ref["oid"]).hex())
             return {
                 "handoff": {
                     # the compact descriptor: plane ref + endpoint inside
@@ -164,9 +176,20 @@ def build_decode_deployment(config=None, *, num_replicas: int = 1,
             self._init_tag()
 
         def decode(self, body: dict) -> dict:
+            from ray_tpu.serve import anatomy
             from ray_tpu.serve.kv_transport import KVHandoffLost
 
-            handoff = body["handoff"]
+            handoff = dict(body["handoff"])
+            rid = anatomy.rid_of(body)
+            if rid is not None:
+                # ride the rid into the engine's attach payload so the
+                # stepping loop can stamp decode_first_token; link the
+                # handoff's oid on THIS side too (the pull window is
+                # stamped by a different process than the publish one)
+                handoff["_rid"] = rid
+                kv_ref = handoff.get("kv_ref")
+                if isinstance(kv_ref, dict) and kv_ref.get("oid") is not None:
+                    anatomy.link_kv(rid, bytes(kv_ref["oid"]).hex())
             max_tokens = body.get("max_tokens")
             if max_tokens is None:
                 max_tokens = 32
@@ -236,9 +259,11 @@ def build_pd_controller(prefill_name: str = "PDPrefill",
     @deployment(name=name, num_replicas=num_replicas,
                 ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=64)
     class PDController:
-        def __init__(self, prefill_name: str, decode_name: str):
+        def __init__(self, prefill_name: str, decode_name: str,
+                     name: str = "PDIngress"):
             self._prefill_name = prefill_name
             self._decode_name = decode_name
+            self._name = name  # ledger deployment tag (anatomy)
             self._prefill = None
             self._decode = None
 
@@ -254,26 +279,47 @@ def build_pd_controller(prefill_name: str = "PDPrefill",
             import time
 
             import ray_tpu
+            from ray_tpu.serve import anatomy
 
             ph, dh = self._handles()
+            # idempotent: returns a rid ONLY when this call newly admitted
+            # (direct handle calls); an HTTP-proxied body arrives already
+            # admitted and the proxy owns the completion record
+            self_rid = anatomy.admit(body, self._name)
+            a = body.get("_anatomy")
             max_tokens = body.get("max_tokens")
             if max_tokens is None:
                 max_tokens = 32  # explicit 0 honored (prefill-only probe)
             t0 = time.monotonic()
             out = pre = None
-            for attempt in range(2):
-                pre = ray_tpu.get(ph.prefill.remote(
-                    {"prompt_ids": body.get("prompt_ids", [])}), timeout=120)
-                out = ray_tpu.get(dh.decode.remote(
-                    {"handoff": pre["handoff"], "max_tokens": max_tokens}),
-                    timeout=120)
-                if not (isinstance(out, dict)
-                        and out.get("error") == "kv_handoff_lost"):
-                    break
-                # pages reclaimed between the phases: one fresh prefill
-            if isinstance(out, dict) and out.get("error"):
-                raise RuntimeError(f"PD decode failed: {out['error']}")
-            return {
+            try:
+                for attempt in range(2):
+                    sub = {"prompt_ids": body.get("prompt_ids", [])}
+                    if isinstance(a, dict):
+                        # per-leg copy: the router writes sent_w/route into
+                        # it, and the two legs must not share those marks
+                        sub["_anatomy"] = dict(a)
+                    pre = ray_tpu.get(ph.prefill.remote(sub), timeout=120)
+                    dsub = {"handoff": pre["handoff"],
+                            "max_tokens": max_tokens}
+                    if isinstance(a, dict):
+                        dsub["_anatomy"] = dict(a)
+                    out = ray_tpu.get(dh.decode.remote(dsub), timeout=120)
+                    if not (isinstance(out, dict)
+                            and out.get("error") == "kv_handoff_lost"):
+                        break
+                    # pages reclaimed between the phases: one fresh prefill
+                    anatomy.record_reprefill(
+                        self._name, out.get("replica"),
+                        out.get("detail") or "kv_handoff_lost")
+                if isinstance(out, dict) and out.get("error"):
+                    raise RuntimeError(f"PD decode failed: {out['error']}")
+            except BaseException as e:
+                if self_rid is not None:
+                    anatomy.complete(self_rid, self._name, ok=False,
+                                     err=str(e)[:200])
+                raise
+            result = {
                 "token_ids": out["token_ids"],
                 "usage": out["usage"],
                 "timings": {"ttft_s": pre["prefill_s"],
@@ -283,6 +329,11 @@ def build_pd_controller(prefill_name: str = "PDPrefill",
                 "pd": {"prefill_replica": pre.get("replica"),
                        "decode_replica": out.get("replica")},
             }
+            if self_rid is not None:
+                anatomy.complete(
+                    self_rid, self._name, replica=out.get("replica"),
+                    ntokens=out["usage"].get("completion_tokens", 0))
+            return result
 
         def stats(self) -> dict:
             import ray_tpu
@@ -293,7 +344,7 @@ def build_pd_controller(prefill_name: str = "PDPrefill",
                 "decode": ray_tpu.get(dh.stats.remote(), timeout=30),
             }
 
-    return PDController.bind(prefill_name, decode_name)
+    return PDController.bind(prefill_name, decode_name, name)
 
 
 def deploy_pd_app(config=None, *, prefill_config=None,

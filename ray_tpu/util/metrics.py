@@ -503,15 +503,24 @@ def push_once(peer, cursor) -> dict:
     next time instead of dropping them — shared by the node agent's
     heartbeat loop and the worker pusher. Raises on transport failure (the
     caller owns reconnect/skip policy)."""
+    import sys
+
     from ray_tpu.util import flight_recorder, timeline
 
     if not isinstance(cursor, dict):
         cursor = {"flight": int(cursor), "timeline": 0}
     events, fl_cursor = flight_recorder.drain_since(cursor.get("flight", 0))
     phases, tl_cursor = timeline.drain_since(cursor.get("timeline", 0))
+    # serve-anatomy piggyback: only processes that already loaded the serve
+    # stack can have request-phase stamps — checking sys.modules keeps the
+    # pusher from importing ray_tpu.serve into every worker
+    serve_phases, sv_cursor = None, cursor.get("serve", 0)
+    anatomy = sys.modules.get("ray_tpu.serve.anatomy")
+    if anatomy is not None:
+        serve_phases, sv_cursor = anatomy.drain_since(sv_cursor)
     peer.notify("metrics_push", snap=wire_snapshot(), events=events or None,
-                phases=phases or None)
-    return {"flight": fl_cursor, "timeline": tl_cursor}
+                phases=phases or None, serve_phases=serve_phases or None)
+    return {"flight": fl_cursor, "timeline": tl_cursor, "serve": sv_cursor}
 
 
 # ---------------------------------------------------------------- exposition

@@ -92,6 +92,17 @@ def gang_view() -> list[dict]:
     return elastic.gang_view()
 
 
+def serve_view(limit: int = 64) -> dict:
+    """Serve request anatomy (ISSUE 16): per-deployment SLO scoreboard
+    (rolling TTFT/TPOT quantiles, goodput vs the declared
+    ``slo_ttft_ms``, predicted TTFT per replica) plus the most recent
+    per-request phase ledgers, head-clock aligned — the state-API face of
+    ``serve/anatomy.py`` (served at /api/v0/serve)."""
+    from ray_tpu.serve import anatomy
+
+    return anatomy.serve_view(limit)
+
+
 # per-metric previous sample for the HEAD's own rate estimation (remote
 # nodes get rates from consecutive metrics_push deltas; the head has no
 # pusher, so consecutive node_io_view() calls carry the baseline)

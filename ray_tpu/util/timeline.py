@@ -433,6 +433,18 @@ def export(path: Optional[str] = None) -> list[dict]:
     _export_pipeline_events(trace)
     _flow_arrows(trace, exec_flow)
     _lane_metadata(trace, lanes)
+    try:
+        # serve request lanes + ingress->prefill->decode flow arrows
+        # (serve/anatomy.py, ISSUE 16) — already offset-aligned via this
+        # module's clock_offsets; lazy so non-serve sessions never import
+        # the serve package here
+        import sys as _sys
+
+        _an = _sys.modules.get("ray_tpu.serve.anatomy")
+        if _an is not None:
+            trace.extend(_an.trace_events())
+    except Exception:
+        pass  # a malformed ledger must not break the whole export
     trace.sort(key=lambda e: e.get("ts", 0))
     if path:
         import json

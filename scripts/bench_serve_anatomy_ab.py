@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Interleaved serve-anatomy overhead A/B (MICROBENCH round 14).
+
+Measures front-door serving throughput with the ISSUE-16 request anatomy
+ON (default) vs OFF (``RAY_TPU_SERVE_ANATOMY=0`` — switches off every
+stamping site: admit, router_stamp, replica_dequeue, engine first-token,
+KV windows, complete). Each arm runs in a FRESH process (the gate is read
+at module import); interleave arms by alternating invocations:
+
+    python scripts/bench_serve_anatomy_ab.py --arm on  --requests 120
+    python scripts/bench_serve_anatomy_ab.py --arm off --requests 120
+
+The metric is tokens/s over the full production path — HTTP proxy ->
+router -> replica -> engine, SSE streaming (CPU byte-tokenizer fallback
+model, short decodes) — so the per-request stamping cost shows up
+undiluted by long decode loops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+PORT = int(os.environ.get("RAY_TPU_SERVE_BENCH_PORT", "18473"))
+
+
+def _stream_tokens(url: str, body: dict) -> int:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    n = 0
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                n += 1
+    return n
+
+
+def bench(requests: int, max_tokens: int, repeats: int,
+          concurrency: int) -> list[float]:
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    app = serve.build_openai_app()
+    serve.run(app, route_prefix="/v1")
+    serve.start_http_proxy(port=PORT)
+    url = f"http://127.0.0.1:{PORT}/v1/chat/completions"
+    body = {"messages": [{"role": "user", "content": "anatomy ab"}],
+            "max_tokens": max_tokens, "stream": True}
+
+    pool = ThreadPoolExecutor(max_workers=concurrency)
+    # warm: model build + route table + SSE path
+    list(pool.map(lambda _: _stream_tokens(url, body), range(16)))
+    rates = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        toks = sum(pool.map(lambda _: _stream_tokens(url, body),
+                            range(requests)))
+        rates.append(toks / (time.perf_counter() - t0))
+    pool.shutdown(wait=False)
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return rates
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arm", choices=("on", "off"), required=True)
+    ap.add_argument("--requests", type=int, default=120)
+    ap.add_argument("--max-tokens", type=int, default=8)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--concurrency", type=int, default=8)
+    args = ap.parse_args()
+
+    os.environ["RAY_TPU_SERVE_ANATOMY"] = "1" if args.arm == "on" else "0"
+    rates = bench(args.requests, args.max_tokens, args.repeats,
+                  args.concurrency)
+    out = {"arm": args.arm, "requests": args.requests,
+           "max_tokens": args.max_tokens,
+           "rates": [round(r, 1) for r in rates],
+           "median_tokens_per_s": round(statistics.median(rates), 1)}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

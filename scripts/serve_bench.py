@@ -143,9 +143,25 @@ def _fire_stream(url: str, body: dict, timeout: float = 120.0,
         return {"ok": False}
 
 
+def _phase_breakdown_since(since_wall: float) -> dict:
+    """Per-phase duration quantiles for requests admitted after
+    ``since_wall`` (ISSUE 16 anatomy ledgers). Replica-side stamps ride the
+    push/reply-pipe beat, so wait one beat before attributing."""
+    from ray_tpu.serve import anatomy
+
+    time.sleep(1.2 * float(
+        os.environ.get("RAY_TPU_METRICS_PUSH_PERIOD_S", "2") or 2))
+    bd = anatomy.phase_breakdown(since_wall=since_wall)
+    return {"requests": bd["requests"],
+            "phases": {p: {"p50_ms": round(v["p50_ms"], 3),
+                           "p99_ms": round(v["p99_ms"], 3), "n": v["n"]}
+                       for p, v in bd["phases"].items()}}
+
+
 def run_ingress_sweep(base: str, rates: list, duration_s: float,
                       slo_ttft_ms: float, max_tokens: int) -> list:
     from ray_tpu import serve
+    from ray_tpu.serve import anatomy
 
     app = serve.build_openai_app()  # default config: CPU-model fallback
     serve.run(app, route_prefix="/v1")
@@ -160,10 +176,12 @@ def run_ingress_sweep(base: str, rates: list, duration_s: float,
 
     points = []
     for i, rate in enumerate(rates):
+        since = anatomy.now_wall()
         records, wall = _open_loop(
             lambda sched: _fire_stream(url, body, sched_t=sched),
             rate, duration_s, seed=17 + i)
         pt = _point(records, wall, rate, slo_ttft_ms, max_tokens)
+        pt["phase_breakdown"] = _phase_breakdown_since(since)
         print(f"  ingress rate={rate:g}/s -> {pt['tokens_per_s']} tok/s, "
               f"goodput {pt['goodput_rps']}/s, "
               f"ttft p50/p99 {pt['ttft_p50_ms']}/{pt['ttft_p99_ms']} ms")
@@ -227,9 +245,13 @@ def run_pd_ab(base: str, rate_rps: float, duration_s: float, rounds: int,
                     i = n["i"]
                 return _fire_pd(f"{base}{route}", body(i), sched_t=sched)
 
+            from ray_tpu.serve import anatomy
+
+            since = anatomy.now_wall()
             records, wall = _open_loop(fire, rate_rps, duration_s,
                                        seed=29 + rnd)
             pt = _point(records, wall, rate_rps, slo_ttft_ms, max_tokens)
+            pt["phase_breakdown"] = _phase_breakdown_since(since)
             per_round[arm].append(pt)
             print(f"  pd round {rnd} {arm}: {pt['tokens_per_s']} tok/s, "
                   f"ttft p50 {pt['ttft_p50_ms']} ms, "
@@ -239,6 +261,7 @@ def run_pd_ab(base: str, rate_rps: float, duration_s: float, rounds: int,
         keys = ("tokens_per_s", "goodput_rps", "ttft_p50_ms", "ttft_p99_ms",
                 "latency_p50_ms", "latency_p99_ms")
         out = dict(pts[0])
+        out.pop("phase_breakdown", None)  # per-round tables keep theirs
         for k in keys:
             out[k] = round(statistics.median(p[k] for p in pts), 2)
         out["completed"] = sum(p["completed"] for p in pts)

@@ -1,0 +1,472 @@
+"""Serve request anatomy tests (ISSUE 16): per-request phase ledger
+assembly, SLO scoreboard scoring, predicted-TTFT sensing, stale-series
+retirement, Perfetto merge — and the 2-node acceptance: phase stamps from
+two real isolated-plane agents ride the metrics_push ``serve_phases``
+piggyback back to the head and fold into ONE complete, monotonic,
+offset-aligned ledger."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve import anatomy
+from ray_tpu.util import flight_recorder
+
+
+@pytest.fixture
+def fresh():
+    """Module-global anatomy state is shared across tests — wipe it."""
+    anatomy.clear()
+    yield
+    anatomy.clear()
+
+
+def _walk_all_phases(dep="walkdep", replica="rep0", oid="ab" * 16,
+                     pause=0.002):
+    """Drive a request through all eight phases in the local ring (single
+    process, so every stamp folds under node='head') and return its rid."""
+    body = {"prompt": "hi"}
+    rid = anatomy.admit(body, dep)
+    assert rid is not None
+    time.sleep(pause)
+    t0 = anatomy.now_wall()
+    time.sleep(pause)
+    anatomy.router_stamp(body, dep, replica, t0)
+    time.sleep(pause)
+    anatomy.replica_dequeue(body)
+    time.sleep(pause)
+    t0 = anatomy.now_wall()
+    time.sleep(pause)
+    anatomy.stamp(rid, "prefill_exec", t0, anatomy.now_wall())
+    t0 = anatomy.now_wall()
+    time.sleep(pause)
+    anatomy.kv_window(oid, "kv_publish", t0, anatomy.now_wall(), 1 << 20)
+    anatomy.link_kv(rid, oid)
+    t0 = anatomy.now_wall()
+    time.sleep(pause)
+    anatomy.kv_window(oid, "kv_pull", t0, anatomy.now_wall(), 1 << 20)
+    time.sleep(pause)
+    anatomy.stamp(rid, "decode_first_token", anatomy.now_wall())
+    time.sleep(pause)
+    anatomy.complete(rid, dep, replica=replica, ntokens=8)
+    return rid
+
+
+def _row(view, rid):
+    rows = [r for r in view["requests"] if r["rid"] == rid]
+    assert rows, f"rid {rid} not in serve_view"
+    return rows[0]
+
+
+# ------------------------------------------------------------ unit: ledger
+def test_admit_idempotent_and_ownership(fresh):
+    body = {"prompt": "x"}
+    rid = anatomy.admit(body, "d1")
+    assert rid is not None
+    assert anatomy.rid_of(body) == rid
+    # an upstream-admitted body is NOT re-admitted: the second caller gets
+    # None and does not own the completion record
+    assert anatomy.admit(body, "d2") is None
+    assert body["_anatomy"]["dep"] == "d1"
+    # non-dict bodies are a no-op, never a crash
+    assert anatomy.admit([1, 2], "d1") is None
+    assert anatomy.rid_of(None) is None
+
+
+def test_full_ledger_assembles_complete(fresh):
+    rid = _walk_all_phases(dep="ldep")
+    view = anatomy.serve_view()
+    row = _row(view, rid)
+    assert set(anatomy.PHASES) <= set(row["phases"])
+    assert row["done"] and row["ok"] and row["complete"], row
+    t0s = [row["phases"][p]["t0"] for p in anatomy.PHASES]
+    assert all(b >= a for a, b in zip(t0s, t0s[1:]))
+    assert row["ntokens"] == 8
+    assert row["ttft_ms"] is not None and row["ttft_ms"] >= 0
+    assert row["tpot_ms"] is not None and row["tpot_ms"] >= 0
+    b = view["deployments"]["ldep"]
+    assert b["admitted"] == 1 and b["completed"] == 1 and b["errors"] == 0
+    assert b["ttft_ms"]["n"] == 1
+    assert "rep0" in b["replicas"]
+
+
+def test_first_routing_leg_wins(fresh):
+    """The PD path routes the same rid twice (prefill leg, then decode
+    leg); the FIRST leg is the canonical routing window or the ledger goes
+    non-monotonic."""
+    body = {}
+    rid = anatomy.admit(body, "pd")
+    t = anatomy.now_wall()
+    anatomy.stamp(rid, "router_decision", t, t + 0.01)
+    anatomy.stamp(rid, "router_decision", t + 0.5, t + 0.6)  # decode leg
+    view = anatomy.serve_view()
+    w = _row(view, rid)["phases"]["router_decision"]
+    assert abs(w["t0"] - t) < 1e-6
+    assert abs(w["t1"] - (t + 0.01)) < 1e-6
+
+
+def test_kv_window_joins_in_both_arrival_orders(fresh):
+    """publish/pull windows are oid-keyed (stamped on the engine thread);
+    the link entry may fold before OR after the window — both join."""
+    # window first, link second
+    b1 = {}
+    r1 = anatomy.admit(b1, "kv")
+    t = anatomy.now_wall()
+    anatomy.kv_window("aa" * 16, "kv_publish", t, t + 0.002, 4096)
+    anatomy.link_kv(r1, "aa" * 16)
+    # link first, window second
+    b2 = {}
+    r2 = anatomy.admit(b2, "kv")
+    anatomy.link_kv(r2, "bb" * 16)
+    anatomy.kv_window("bb" * 16, "kv_pull", t, t + 0.003, 4096)
+    view = anatomy.serve_view()
+    assert "kv_publish" in _row(view, r1)["phases"]
+    assert "kv_pull" in _row(view, r2)["phases"]
+
+
+def test_incomplete_ledger_not_marked_complete(fresh):
+    body = {}
+    rid = anatomy.admit(body, "partial")
+    anatomy.complete(rid, "partial", ntokens=1)
+    row = _row(anatomy.serve_view(), rid)
+    assert row["done"] and not row["complete"]
+
+
+def test_phase_breakdown_covers_all_phases(fresh):
+    _walk_all_phases(dep="bk")
+    bd = anatomy.phase_breakdown()
+    assert bd["requests"] >= 1
+    for p in anatomy.PHASES:
+        assert p in bd["phases"], f"{p} missing from breakdown"
+        assert bd["phases"][p]["p50_ms"] >= 0
+        assert bd["phases"][p]["p99_ms"] >= bd["phases"][p]["p50_ms"] - 1e-9
+
+
+# ------------------------------------------------------ unit: SLO scoring
+def test_slo_breach_goodput_and_flight_event(fresh):
+    from ray_tpu.util import metrics as _metrics
+
+    dep = "slodep"
+    anatomy.set_slo(dep, 5.0)  # 5 ms TTFT SLO
+
+    # breach: first token ~50ms after admit
+    b1 = {}
+    r1 = anatomy.admit(b1, dep)
+    time.sleep(0.05)
+    anatomy.stamp(r1, "decode_first_token", anatomy.now_wall())
+    anatomy.complete(r1, dep, replica="repA", ntokens=4)
+
+    # within SLO: first token immediately
+    b2 = {}
+    r2 = anatomy.admit(b2, dep)
+    anatomy.stamp(r2, "decode_first_token", anatomy.now_wall())
+    anatomy.complete(r2, dep, replica="repA", ntokens=4)
+
+    view = anatomy.serve_view()
+    b = view["deployments"][dep]
+    assert b["slo_ttft_ms"] == 5.0
+    assert b["slo_breach"] == 1 and b["slo_ok"] == 1
+    assert b["goodput"] == 0.5
+
+    recs = [r for r in flight_recorder.records("serve")
+            if r["event"] == "slo_breach" and r.get("deployment") == dep]
+    assert recs and recs[-1]["ttft_ms"] > 5.0
+
+    # the breach counter reached the prometheus exposition
+    text = _metrics.prometheus_text()
+    assert "ray_tpu_serve_slo_breach_total" in text
+    assert "ray_tpu_serve_ttft_ms" in text
+
+    # un-declaring the SLO stops scoring
+    anatomy.set_slo(dep, None)
+    assert anatomy.serve_view()["deployments"][dep]["slo_ttft_ms"] is None
+
+
+def test_breach_flight_events_rate_limited(fresh):
+    """Flight-ring cardinality stays bounded no matter the breach rate."""
+    dep = "stormdep"
+    anatomy.set_slo(dep, 0.0)  # everything breaches
+    for _ in range(20):
+        b = {}
+        r = anatomy.admit(b, dep)
+        time.sleep(0.001)
+        anatomy.stamp(r, "decode_first_token", anatomy.now_wall())
+        anatomy.complete(r, dep, ntokens=2)
+    anatomy.serve_view()
+    recs = [r for r in flight_recorder.records("serve")
+            if r["event"] == "slo_breach" and r.get("deployment") == dep]
+    assert len(recs) <= 2  # min-gap limiter: ~1 per second
+
+
+# ------------------------------------------- unit: predicted TTFT + retire
+class _StubRouter:
+    """Shape-compatible with serve.controller.Router for the estimator."""
+
+    def __init__(self, name, depths, nodes):
+        self._name = name
+        self._depths = depths
+        self._replica_nodes = nodes
+
+    def inflight_snapshot(self):
+        return dict(self._depths)
+
+
+def test_predicted_ttft_from_router_depths(fresh):
+    dep = "preddep"
+    # one settled request gives the deployment a service-time EWMA
+    b = {}
+    r = anatomy.admit(b, dep)
+    time.sleep(0.02)
+    anatomy.stamp(r, "decode_first_token", anatomy.now_wall())
+    anatomy.complete(r, dep, replica="r1", ntokens=2)
+    anatomy.serve_view()
+
+    stub = _StubRouter(dep, {"r1": 3, "r2": 0}, {"r1": None, "r2": None})
+    anatomy.register_router(stub)
+    view = anatomy.serve_view()
+    pred = view["deployments"][dep]["predicted_ttft_ms"]
+    # depth 3 x ~20ms service EWMA >> depth 0
+    assert pred["r1"] > pred["r2"]
+    assert pred["r1"] >= 3 * 0.5  # well above zero
+    del stub  # dead routers drop out of the registry
+    pairs = anatomy._predicted_pairs()
+    assert not any(t["deployment"] == dep for t, _ in pairs)
+
+
+def test_retire_replica_drops_series_immediately(fresh):
+    dep = "retdep"
+    b = {}
+    r = anatomy.admit(b, dep)
+    anatomy.stamp(r, "decode_first_token", anatomy.now_wall())
+    anatomy.complete(r, dep, replica="deadbeef", ntokens=2)
+    view = anatomy.serve_view()
+    assert "deadbeef" in view["deployments"][dep]["replicas"]
+    anatomy.retire_replica(dep, ["deadbeef"])
+    view = anatomy.serve_view()
+    assert "deadbeef" not in view["deployments"][dep]["replicas"]
+
+
+def test_drain_node_retires_scoreboard_replica():
+    """Controller wiring: drain_node retires the victims' scoreboard
+    entries in the same call that kills them (hardening-test idiom)."""
+    from ray_tpu.serve.controller import ServeController
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    anatomy.clear()
+    ctrl = ServeController()
+
+    @serve.deployment(name="AnatDrain", num_replicas=1)
+    class AnatDrain:
+        def __call__(self, body):
+            return 1
+
+    try:
+        ctrl.deploy(AnatDrain.bind().deployment, None)
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and len(ctrl.get_replicas("AnatDrain")) < 1):
+            time.sleep(0.05)
+        reps = ctrl.get_replicas("AnatDrain")
+        assert reps
+        key0 = reps[0]._actor_id.hex()
+
+        # give the victim a scoreboard presence, then drain its node
+        b = {}
+        r = anatomy.admit(b, "AnatDrain")
+        anatomy.stamp(r, "decode_first_token", anatomy.now_wall())
+        anatomy.complete(r, "AnatDrain", replica=key0, ntokens=2)
+        view = anatomy.serve_view()
+        assert key0 in view["deployments"]["AnatDrain"]["replicas"]
+
+        ctrl._replica_nodes[key0] = "anatomynode"
+        assert ctrl.drain_node("anatomynode", reason="test") == 1
+        view = anatomy.serve_view()
+        assert key0 not in view["deployments"]["AnatDrain"]["replicas"]
+    finally:
+        anatomy.clear()
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+# --------------------------------------------------- unit: timeline merge
+def test_trace_events_and_timeline_export_merge(fresh):
+    from ray_tpu.util import timeline
+
+    rid = _walk_all_phases(dep="tdep")
+    events = anatomy.trace_events()
+    xrows = [e for e in events if e.get("ph") == "X"
+             and e["args"].get("rid") == rid]
+    assert {e["name"] for e in xrows} == set(anatomy.PHASES)
+    assert all(e["cat"] == "serve" and e["pid"] == 95 for e in xrows)
+    flows = [e for e in events if e.get("ph") in ("s", "f")
+             and str(e.get("id", "")).startswith(f"serve:{rid}")]
+    # all three flow arrows present, each with a start and an end
+    assert len([e for e in flows if e["ph"] == "s"]) == 3
+    assert len([e for e in flows if e["ph"] == "f"]) == 3
+
+    # the PR-13 exporter merges the serve lanes into the one cluster trace
+    trace = timeline.export()
+    assert any(e.get("cat") == "serve" and e.get("ph") == "X"
+               and e.get("args", {}).get("rid") == rid for e in trace)
+    names = [e for e in trace if e.get("ph") == "M"
+             and e.get("name") == "process_name"
+             and e.get("args", {}).get("name") == "serve: request anatomy"]
+    assert names
+
+
+def test_serve_view_via_state_facade(fresh):
+    from ray_tpu.util import state
+
+    rid = _walk_all_phases(dep="sdep")
+    view = state.serve_view()
+    assert view["enabled"] is True
+    assert "sdep" in view["deployments"]
+    assert any(r["rid"] == rid for r in view["requests"])
+
+
+# ----------------------------------------------------- unit: kill switch
+def test_kill_switch_disables_recording():
+    """RAY_TPU_SERVE_ANATOMY=0 turns every stamping call into a no-op (the
+    env is read at import, so probe in a subprocess)."""
+    code = (
+        "from ray_tpu.serve import anatomy\n"
+        "assert not anatomy.enabled()\n"
+        "body = {}\n"
+        "assert anatomy.admit(body, 'd') is None\n"
+        "assert '_anatomy' not in body\n"
+        "anatomy.stamp('r', 'prefill_exec', 0.0)\n"
+        "anatomy.kv_window('aa', 'kv_publish', 0.0, 1.0, 1)\n"
+        "anatomy.complete('r', 'd')\n"
+        "assert anatomy.local_events() == []\n"
+        "print('KILLSWITCH_OK')\n"
+    )
+    env = dict(os.environ, RAY_TPU_SERVE_ANATOMY="0", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert "KILLSWITCH_OK" in out.stdout, out.stderr
+
+
+# ------------------------------------------------- 2-node acceptance test
+def test_cross_node_trace_propagation():
+    """ACCEPTANCE: replica-side phase stamps from two REAL isolated-plane
+    agents ride the metrics_push ``serve_phases`` piggyback to the head and
+    fold — with the head's own front-door stamps — into one complete
+    8-phase monotonic ledger in serve_view(), offset-aligned, with the KV
+    handoff window joined across the two remote rings."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    anatomy.clear()
+    cluster = Cluster(initialize_head=False)
+    oid = "cd" * 16
+    try:
+        cluster.add_node(num_cpus=1, resources={"pre": 1},
+                         real_process=True, isolated_plane=True)
+        cluster.add_node(num_cpus=1, resources={"dec": 1},
+                         real_process=True, isolated_plane=True)
+
+        @ray_tpu.remote(num_cpus=1, resources={"pre": 1})
+        def prefill_leg(body, oid_hex):
+            import os as _os
+            import time as _time
+
+            from ray_tpu.serve import anatomy as _an
+
+            _an.replica_dequeue(body)
+            rid = _an.rid_of(body)
+            t0 = _an.now_wall()
+            _time.sleep(0.05)  # "prefill"
+            _an.stamp(rid, "prefill_exec", t0, _an.now_wall())
+            t0 = _an.now_wall()
+            _time.sleep(0.02)  # "publish"
+            _an.kv_window(oid_hex, "kv_publish", t0, _an.now_wall(),
+                          1 << 20)
+            _an.link_kv(rid, oid_hex)
+            return _os.environ.get("RAY_TPU_NODE_ID")
+
+        @ray_tpu.remote(num_cpus=1, resources={"dec": 1})
+        def decode_leg(body, oid_hex):
+            import os as _os
+            import time as _time
+
+            from ray_tpu.serve import anatomy as _an
+
+            rid = _an.rid_of(body)
+            t0 = _an.now_wall()
+            _time.sleep(0.02)  # "pull"
+            _an.kv_window(oid_hex, "kv_pull", t0, _an.now_wall(), 1 << 20)
+            _an.link_kv(rid, oid_hex)
+            _time.sleep(0.02)
+            _an.stamp(rid, "decode_first_token", _an.now_wall())
+            return _os.environ.get("RAY_TPU_NODE_ID")
+
+        # head-side front door: admit + route (50ms routing window so the
+        # cross-process clock alignment noise can't reorder the phases)
+        body = {"prompt": "anatomy"}
+        rid = anatomy.admit(body, "xnode")
+        t_route0 = anatomy.now_wall()
+        time.sleep(0.05)
+        anatomy.router_stamp(body, "xnode", "pre-replica", t_route0)
+
+        pre_node = ray_tpu.get(prefill_leg.remote(body, oid), timeout=300)
+        dec_node = ray_tpu.get(decode_leg.remote(body, oid), timeout=300)
+        anatomy.complete(rid, "xnode", replica="pre-replica", ntokens=8)
+
+        assert pre_node and dec_node and pre_node != dec_node
+
+        # the remote stamps arrive on the workers' next push beat
+        # (RAY_TPU_METRICS_PUSH_PERIOD_S, default 2s) — poll for the fold
+        deadline = time.monotonic() + 90
+        row = None
+        while time.monotonic() < deadline:
+            view = anatomy.serve_view()
+            rows = [r for r in view["requests"] if r["rid"] == rid]
+            if rows and rows[0]["complete"]:
+                row = rows[0]
+                break
+            time.sleep(0.5)
+        assert row is not None, (
+            f"ledger never completed; last: {rows[0] if rows else None}")
+
+        # complete == all eight phases, aligned t0s non-decreasing
+        assert set(anatomy.PHASES) <= set(row["phases"])
+        t0s = [row["phases"][p]["t0"] for p in anatomy.PHASES]
+        assert all(b >= a for a, b in zip(t0s, t0s[1:])), row["phases"]
+
+        # the ledger is genuinely cross-node: front door on the head,
+        # prefill phases and decode phases tagged with two distinct agents
+        nodes = {p: row["phases"][p]["node"] for p in anatomy.PHASES}
+        assert nodes["ingress_admit"] == "head"
+        assert nodes["prefill_exec"] != "head"
+        assert nodes["decode_first_token"] != "head"
+        assert nodes["prefill_exec"] != nodes["decode_first_token"]
+        assert nodes["kv_publish"] == nodes["prefill_exec"]
+        assert nodes["kv_pull"] == nodes["decode_first_token"]
+
+        # scoreboard scored it (settled with a real first token)
+        b = view["deployments"]["xnode"]
+        assert b["completed"] == 1 and b["ttft_ms"]["n"] == 1
+        # ttft spans the remote first-token stamp: >= the scripted delays
+        assert row["ttft_ms"] >= 50.0
+
+        # serve lanes + flows ride the merged Perfetto export
+        from ray_tpu.util import timeline
+
+        trace = timeline.export()
+        serve_rows = [e for e in trace if e.get("cat") == "serve"
+                      and e.get("ph") == "X"
+                      and e.get("args", {}).get("rid") == rid]
+        assert {e["name"] for e in serve_rows} == set(anatomy.PHASES)
+        assert any(e.get("ph") == "s"
+                   and str(e.get("id", "")).startswith(f"serve:{rid}")
+                   for e in trace)
+    finally:
+        anatomy.clear()
+        cluster.shutdown()
+        ray_tpu.shutdown()
