@@ -59,6 +59,10 @@ class Publisher:
         self._local: dict[str, list[Subscriber]] = {}
         # channel -> list of (peer, sub_id); delivery via peer.notify frames
         self._remote: dict[str, list[tuple]] = {}
+        # channel -> last retained message (last-value cache, MQTT-style):
+        # a late subscriber gets current state immediately instead of
+        # waiting for the next publish (routing epochs ride this)
+        self._retained: dict[str, Any] = {}
         self.published_total = 0
 
     # ---- local (driver / same-process) ----
@@ -66,6 +70,9 @@ class Publisher:
         sub = Subscriber(self, channel)
         with self._lock:
             self._local.setdefault(channel, []).append(sub)
+            retained = self._retained.get(channel)
+        if retained is not None:
+            sub._offer(retained)
         return sub
 
     def unsubscribe(self, sub: Subscriber) -> None:
@@ -78,6 +85,22 @@ class Publisher:
     def subscribe_remote(self, channel: str, peer, sub_id: str) -> None:
         with self._lock:
             self._remote.setdefault(channel, []).append((peer, sub_id))
+            retained = self._retained.get(channel)
+        if retained is not None:
+            # same delivery shape as publish(): a pushed notify frame — no
+            # new wire op, the subscriber can't tell replay from live
+            import cloudpickle
+
+            try:
+                peer.notify("pubsub_msg", channel=channel, sub=sub_id,
+                            blob=cloudpickle.dumps(retained))
+            except Exception:
+                import logging
+
+                logging.getLogger("ray_tpu.pubsub").debug(
+                    "retained replay to %s/%s failed; dropping subscription",
+                    channel, sub_id, exc_info=True)
+                self.unsubscribe_remote(peer, sub_id)
 
     def unsubscribe_remote(self, peer, sub_id: str | None = None) -> None:
         """Drop one subscription, or every subscription of a dead peer."""
@@ -89,14 +112,18 @@ class Publisher:
                 ]
 
     # ---- publish ----
-    def publish(self, channel: str, message: Any) -> int:
+    def publish(self, channel: str, message: Any, retain: bool = False) -> int:
         """Deliver to every subscriber; returns the number actually delivered
-        (dead peers are skipped, purged, and not counted)."""
+        (dead peers are skipped, purged, and not counted). ``retain`` keeps
+        the message as the channel's last-value cache, replayed to future
+        subscribers."""
         import cloudpickle
 
         with self._lock:
             local = list(self._local.get(channel, []))
             remote = list(self._remote.get(channel, []))
+            if retain:
+                self._retained[channel] = message
             self.published_total += 1
         delivered = 0
         for sub in local:

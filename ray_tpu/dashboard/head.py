@@ -359,6 +359,20 @@ class Dashboard:
             except Exception as e:  # noqa: BLE001
                 return web.json_response({"error": str(e)[:200]}, status=503)
 
+        async def front_door(request):
+            """Front-door fleet view: ingress addresses + per-ingress shed
+            counters + SLO-autoscaler state (serve.front_door_view)."""
+            import asyncio as _aio
+
+            try:
+                from ray_tpu.serve.front_door import front_door_view
+
+                loop = _aio.get_running_loop()
+                view = await loop.run_in_executor(None, front_door_view)
+                return web.json_response(jsonable(view))
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)[:200]}, status=503)
+
         async def healthz(request):
             return web.json_response({"status": "ok"})
 
@@ -444,6 +458,7 @@ class Dashboard:
             app.router.add_get("/api/v0/node_io", node_io)
             app.router.add_get("/api/v0/gang", gang)
             app.router.add_get("/api/v0/serve", serve_anatomy)
+            app.router.add_get("/api/v0/front_door", front_door)
             app.router.add_get("/api/v0/timeline", timeline)
             app.router.add_get("/api/v0/{resource}", state_list)
             app.router.add_get("/api/jobs", jobs)

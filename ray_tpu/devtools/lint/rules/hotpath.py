@@ -226,6 +226,57 @@ HOT_PATHS = (
                              if m != "ray_tpu.core.runtime"),
         missing_hint="phase recording path renamed? (update HOT_PATHS)",
     ),
+    # ISSUE-17: the front door's ingress dispatch fast path. Per-REQUEST:
+    # route lookup, replica pick, and the admission predictor read ONLY the
+    # local routing-epoch cache — zero control-plane RPCs, no task
+    # submission, no per-request instruments. Fleet management (_spawn,
+    # _ensure, _drop) is deliberately NOT declared: it may submit actors.
+    # Note: this PR added NO new wire ops — the retained-epoch replay rides
+    # the existing pubsub_msg notify frame, so the rpc/schema baseline and
+    # version gate are untouched by design.
+    HotPath(
+        file="ray_tpu/serve/front_door.py",
+        funcs=("_refresh", "pick", "_lookup", "_predict", "_admit"),
+        reason="per-request ingress dispatch; local epoch cache only",
+        ban_rpc=True,
+        ban_submit=True,
+        forbid_imports=CONTROL_PLANE_IMPORTS,
+        require_calls=(
+            ("_refresh", ("snapshot",),
+             "_refresh no longer reads the local epoch cache — routing "
+             "state must come from the last applied epoch, not a "
+             "controller poll"),
+            ("pick", ("wait_newer",),
+             "pick no longer waits on the epoch condition variable — "
+             "empty replica sets must block on the NEXT epoch, not "
+             "sleep-poll the controller"),
+            ("_admit", ("try_admit",),
+             "_admit no longer consults the admission gate — requests "
+             "reach anatomy.admit ungated and SLO breaches stop shedding"),
+        ),
+        missing_hint="ingress fast path renamed? (update HOT_PATHS)",
+    ),
+    # ISSUE-17: admission decisions stay pure + accounted. The gate runs
+    # per request BEFORE anatomy.admit; it must never speak the wire, and
+    # every shed must land on the shed counter + flight ring.
+    HotPath(
+        file="ray_tpu/serve/admission.py",
+        funcs=("decide", "try_admit", "_shed"),
+        reason="per-request admission gate ahead of anatomy.admit",
+        ban_rpc=True,
+        ban_submit=True,
+        forbid_imports=CONTROL_PLANE_IMPORTS,
+        require_calls=(
+            ("try_admit", ("decide",),
+             "try_admit no longer routes through the pure decide() table — "
+             "the policy must stay one tested function"),
+            ("_shed", ("record_shed",),
+             "_shed no longer records through anatomy.record_shed — "
+             "ray_tpu_serve_shed_total and the flight-ring shed events "
+             "go dark"),
+        ),
+        missing_hint="admission gate renamed? (update HOT_PATHS)",
+    ),
     # ISSUE-13: both halves of the stamping pipeline stay wired — the
     # worker ships clocks on the done reply, the pool parent stamps them.
     HotPath(

@@ -14,11 +14,18 @@ from ray_tpu.core import runtime as rt_mod
 from ray_tpu.core.pubsub import Subscriber
 
 
-def publish(channel: str, message: Any) -> int:
-    """Deliver `message` to every subscriber of `channel`; returns count."""
+def publish(channel: str, message: Any, retain: bool = False) -> int:
+    """Deliver `message` to every subscriber of `channel`; returns count.
+
+    ``retain=True`` keeps the message as the channel's last-value cache:
+    future subscribers receive it immediately on subscribe (routing epochs
+    use this so a freshly placed ingress serves from its first request).
+    Retention is a head-side property — worker publishers fall back to a
+    plain publish rather than growing the wire protocol a new op.
+    """
     rt = rt_mod.get_runtime()
     if hasattr(rt, "publisher"):
-        return rt.publisher.publish(channel, message)
+        return rt.publisher.publish(channel, message, retain=retain)
     return rt.publish(channel, message)  # worker client runtime
 
 

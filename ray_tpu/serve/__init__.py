@@ -37,6 +37,22 @@ def __getattr__(name):
         from ray_tpu.serve import pd
 
         return getattr(pd, name)
+    # front door (ISSUE 17): loads lazily — the ingress fleet pulls aiohttp
+    # via HttpProxy, which plain `import ray_tpu.serve` must not require
+    if name in ("start_front_door", "stop_front_door", "front_door_addresses",
+                "front_door_view", "FrontDoor", "IngressActor",
+                "EpochRouter", "EpochKVRouter", "EpochCache"):
+        from ray_tpu.serve import front_door
+
+        return getattr(front_door, name)
+    if name == "DeploymentAutoscaler":
+        from ray_tpu.serve.autoscale import DeploymentAutoscaler
+
+        return DeploymentAutoscaler
+    if name in ("AdmissionConfig", "AdmissionGate"):
+        from ray_tpu.serve import admission
+
+        return getattr(admission, name)
     raise AttributeError(name)
 from ray_tpu.serve.controller import DeploymentHandle, ServeController
 from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment, deployment
@@ -52,6 +68,10 @@ __all__ = [
     "build_decode_deployment", "build_pd_controller", "deploy_pd_app",
     "batch", "DeploymentHandle", "ServeController",
     "multiplexed", "get_multiplexed_model_id",
+    "start_front_door", "stop_front_door", "front_door_addresses",
+    "front_door_view", "FrontDoor", "IngressActor",
+    "EpochRouter", "EpochKVRouter", "EpochCache",
+    "DeploymentAutoscaler", "AdmissionConfig", "AdmissionGate",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rec

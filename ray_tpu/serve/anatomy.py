@@ -98,6 +98,11 @@ _M_PRED = Gauge(
     "Predicted TTFT per replica: queue depth x recent service time + "
     "pending KV pull bytes on the replica's node",
     tag_keys=("deployment", "replica"))
+_M_SHED = Counter(
+    "ray_tpu_serve_shed_total",
+    "Requests refused by admission control before admit (never ledgered: "
+    "sheds are invisible to the SLO scoreboard's goodput accounting)",
+    tag_keys=("deployment", "reason"))
 
 _bind_lock = threading.Lock()
 _bind_cache: dict[tuple, object] = {}
@@ -209,6 +214,10 @@ def router_stamp(body, deployment: str, replica_key, t0_w: float) -> None:
         return
     t1 = now_wall()
     a["sent_w"] = t1
+    # the dispatch mark rides the body to the replica, where it becomes
+    # replica_queue_wait.t0 — stamped on THIS process's clock, so carry
+    # this node too for per-endpoint offset alignment at fold time
+    a["sent_node"] = os.environ.get("RAY_TPU_NODE_ID", "head")
     extra = {"dep": deployment, "replica": str(replica_key)}
     route = a.get("route")
     if route:
@@ -226,9 +235,12 @@ def replica_dequeue(body) -> None:
         return
     t1 = now_wall()
     t0 = a.get("sent_w")
+    extra = {"pid": os.getpid()}
+    sn = a.get("sent_node")
+    if isinstance(t0, (int, float)) and isinstance(sn, str):
+        extra["sent_node"] = sn  # t0 lives on the sender's clock
     stamp(a.get("rid"), "replica_queue_wait",
-          t0 if isinstance(t0, (int, float)) else t1, t1,
-          {"pid": os.getpid()})
+          t0 if isinstance(t0, (int, float)) else t1, t1, extra)
 
 
 # --------------------------------------------------------------- shipping
@@ -512,9 +524,11 @@ def _flight_limited(dep: str, event: str, **fields) -> None:
 
 
 def record_shed(deployment: str, reason: str) -> None:
-    """Admission-control shed event (the consumer half lands next PR; the
-    event vocabulary is fixed here so dashboards don't churn)."""
+    """Admission-control shed event (serve/admission.py is the consumer:
+    each ingress calls this BEFORE ``admit``, so a shed request never
+    creates a ledger and never scores as an SLO breach)."""
     _bound(_M_DONE, deployment=deployment, outcome="shed").inc()
+    _bound(_M_SHED, deployment=deployment, reason=reason).inc()
     _flight_limited(deployment, "shed", reason=reason)
 
 
@@ -626,6 +640,26 @@ def _predicted_pairs() -> list:
 _M_PRED.attach_producer(_predicted_pairs)
 
 
+def service_estimate(deployment: str) -> "float | None":
+    """The deployment's scoreboard service-time EWMA in seconds (None until
+    a request completes). The controller folds this into routing epochs as
+    the ingress fleet's admission-predictor hint."""
+    with _head_lock:
+        b = _board.get(deployment)
+        return b.get("service_ewma_s") if b else None
+
+
+def predicted_ttft_by_deployment() -> dict:
+    """deployment -> worst-replica predicted TTFT in ms (head-side rollup
+    of the per-replica estimator; the SLO autoscaler's breach signal)."""
+    out: dict = {}
+    for tags, pred in _predicted_pairs():
+        dep = tags["deployment"]
+        if pred > out.get(dep, -1.0):
+            out[dep] = pred
+    return out
+
+
 # ---------------------------------------------------------------- views
 def _quantiles(samples) -> dict:
     if not samples:
@@ -683,7 +717,13 @@ def serve_view(limit: int = 64) -> dict:
         for led in leds:
             phases = {}
             for p, (t0, t1, node, extra) in led["phases"].items():
-                phases[p] = {"t0": _aligned(t0, node, offsets),
+                # a queue-wait window straddles two clocks: t0 (the router's
+                # dispatch mark) was stamped on the SENDER's clock, t1 on the
+                # replica's — align each end with its own node's offset
+                t0_node = node
+                if isinstance(extra, dict) and "sent_node" in extra:
+                    t0_node = extra["sent_node"]
+                phases[p] = {"t0": _aligned(t0, t0_node, offsets),
                              "t1": _aligned(t1, node, offsets),
                              "node": node}
                 if extra:

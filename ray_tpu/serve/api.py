@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 import time
 from typing import Optional
@@ -121,6 +122,12 @@ def status() -> dict:
 
 
 def shutdown() -> None:
+    try:
+        from ray_tpu.serve.front_door import stop_front_door
+
+        stop_front_door()
+    except Exception:
+        pass
     stop_proxies()
     with _lock:
         c = _state["controller"]
@@ -155,15 +162,23 @@ def _ntokens_of(result) -> int:
     return 0
 
 
-async def _await_ref(ref, timeout: float):
+async def _await_ref(ref, timeout: float, executor=None):
     """Await an ObjectRef on the reactor: the runtime's future-based get
     parks NO thread per in-flight request (reference: the asyncio router of
     serve/_private/router.py:614 — replica replies resolve on the event
-    loop). Falls back to an executor get for runtimes without get_async."""
+    loop). Falls back to an executor get for runtimes without get_async.
+    ``executor`` bounds the blocking-get path: each parked get holds one
+    worker, so the pool size IS the proxy's in-flight dispatch budget."""
     from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dag import CompiledDAGRef
 
     rt = get_runtime()
     ga = getattr(rt, "get_async", None)
+    # compiled-graph results live in the graph's result buffer, not the
+    # object store — get_async only speaks ObjectRef, so compiled refs take
+    # the executor path (ray_tpu.get dispatches on ref kind)
+    if isinstance(ref, CompiledDAGRef):
+        ga = None
     if ga is not None:
         try:
             return await asyncio.wait_for(asyncio.wrap_future(ga(ref)),
@@ -171,7 +186,8 @@ async def _await_ref(ref, timeout: float):
         except asyncio.TimeoutError as e:
             raise TimeoutError(f"request timed out after {timeout}s") from e
     loop = asyncio.get_running_loop()
-    return await loop.run_in_executor(None, lambda: ray_tpu.get(ref, timeout=timeout))
+    return await loop.run_in_executor(
+        executor, lambda: ray_tpu.get(ref, timeout=timeout))
 
 
 # ------------------------------------------------------------------ HTTP proxy
@@ -183,7 +199,7 @@ class HttpProxy:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
-                 route_lookup=None):
+                 route_lookup=None, admission=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.host = host
@@ -191,11 +207,26 @@ class HttpProxy:
         # pluggable router: per-node proxy actors resolve routes against
         # their own controller-synced table instead of this process's _state
         self._route_lookup = route_lookup
+        # pluggable admission gate (serve/admission.py): called with the
+        # deployment name BEFORE anatomy.admit — a shed request never
+        # creates a ledger, so it can't count against goodput. May block
+        # (degrade-to-queue), so it runs on an executor, not the reactor.
+        self._admission = admission
         self._loop = None
         self._runner = None
         # dedicated pool for long-lived SSE polls so streams can't starve the
         # default executor used by non-streaming requests
         self._stream_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="sse")
+        # per-proxy in-flight dispatch budget: every non-streaming request
+        # whose result needs a blocking get (compiled-graph refs, runtimes
+        # without get_async) parks one worker here until the replica
+        # answers — the pool size is THE concurrency ceiling of this
+        # ingress, and replicating ingresses (serve/front_door.py) is how
+        # the fleet raises the aggregate budget
+        self._dispatch_pool = ThreadPoolExecutor(
+            max_workers=int(os.environ.get(
+                "RAY_TPU_SERVE_INGRESS_CONCURRENCY", "8")),
+            thread_name_prefix="dispatch")
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._started = threading.Event()
         self._thread.start()
@@ -215,6 +246,15 @@ class HttpProxy:
                 body = await request.json() if request.can_read_body else {}
             except json.JSONDecodeError:
                 return web.json_response({"error": "invalid JSON body"}, status=400)
+            if self._admission is not None:
+                loop = asyncio.get_running_loop()
+                ok, reason = await loop.run_in_executor(
+                    None, self._admission, handle.deployment_name)
+                if not ok:
+                    return web.json_response(
+                        {"error": "shed", "reason": reason,
+                         "deployment": handle.deployment_name},
+                        status=503, headers={"Retry-After": "1"})
             # anatomy front door: the proxy admits the request (rid rides the
             # body through router -> replica -> engine) and, having admitted,
             # owns the completion record for both reply shapes below
@@ -233,7 +273,8 @@ class HttpProxy:
                     return await self._stream_response(request, handle, body)
                 ref = getattr(handle, method).remote(body)
                 try:
-                    result = await _await_ref(ref, timeout=120)
+                    result = await _await_ref(ref, timeout=120,
+                                               executor=self._dispatch_pool)
                 except Exception as e:  # noqa: BLE001
                     if rid is not None:
                         anatomy.complete(rid, handle.deployment_name,
@@ -250,7 +291,8 @@ class HttpProxy:
                 return await self._stream_response(request, handle, body)
             ref = handle.remote(body)
             try:
-                result = await _await_ref(ref, timeout=60)
+                result = await _await_ref(ref, timeout=60,
+                                           executor=self._dispatch_pool)
             except Exception as e:  # noqa: BLE001
                 if rid is not None:
                     anatomy.complete(rid, handle.deployment_name,

@@ -144,6 +144,15 @@ class ServeController:
     CHECKPOINT_KEY = "controller_checkpoint"
     CHECKPOINT_NS = "serve"
 
+    # Routing-epoch publication (ISSUE 17): the controller owns DESIRED
+    # state and pushes versioned snapshots of the ROUTING state over
+    # pubsub; ingress replicas consume epochs from a local cache and never
+    # poll the controller on the request path.
+    EPOCH_CHANNEL = "serve:epochs"
+    # heartbeat republish cadence: refreshes soft hints (service-time EWMA
+    # for admission predictors) even when membership didn't change
+    EPOCH_REFRESH_S = 5.0
+
     def __init__(self):
         self._deployments: dict[str, _DeploymentState] = {}
         self._routes: dict[str, str] = {}  # route_prefix -> deployment name
@@ -152,8 +161,19 @@ class ServeController:
         self._replica_nodes: dict[str, str] = {}  # replica key -> node hex
         self._node_probes: dict[str, object] = {}  # replica key -> node_hex ref
         self._draining_nodes: set[str] = set()
+        self._ingress: dict[str, tuple] = {}  # ingress key -> (host, port)
         self._lock = threading.Lock()
         self._reconcile_lock = threading.Lock()  # serializes reconcile passes
+        self._epoch_lock = threading.Lock()  # serializes epoch build+publish
+        # seeded from the wall clock so versions stay monotonic ACROSS
+        # controller generations: the epoch channel retains the last doc,
+        # and a fresh controller restarting from version 1 would lose the
+        # version-gate race against its predecessor's retained epoch
+        # (ingresses would pin stale routes and 404 new ones)
+        self._epoch_version = int(time.time() * 1000)
+        self._epoch_fp = None
+        self._epoch_pub_t = 0.0
+        self._epoch_last: dict | None = None
         self._running = True
         self._restore_from_checkpoint()
         # Proactive drain (reference: the serve controller reacting to GCS
@@ -279,6 +299,7 @@ class ServeController:
         self._checkpoint()
         self._reconcile_once()
         self._publish_routes()
+        self._publish_epoch()
 
     def _publish_routes(self) -> None:
         """Push the route table to subscribed proxies (reference: the
@@ -296,11 +317,118 @@ class ServeController:
         with self._lock:
             return dict(self._routes)
 
+    # ---- routing epochs (ISSUE 17): versioned, inbound-tolerant routing
+    # snapshots over pubsub (the "nodes"-channel idiom). Subscribers ignore
+    # fields they don't know and drop versions older than what they hold;
+    # retain=True replays the current epoch to late subscribers, so a
+    # freshly placed ingress serves from its first request. ----
+    def _epoch_doc(self) -> dict:
+        self._harvest_node_probes()
+        with self._lock:
+            deployments = {}
+            for name, st in self._deployments.items():
+                reps = list(st.replicas)
+                deployments[name] = {
+                    "replicas": reps,
+                    "nodes": {r._actor_id.hex():
+                              self._replica_nodes.get(r._actor_id.hex(), "head")
+                              for r in reps},
+                    "router": getattr(st.config, "request_router", "pow2"),
+                    "compiled": bool(getattr(st.config, "compiled_dispatch",
+                                             False)),
+                    "slo_ttft_ms": getattr(st.config, "slo_ttft_ms", None),
+                    "max_ongoing_requests": st.config.max_ongoing_requests,
+                    "version": st.version,
+                    "target_replicas": st.target_replicas,
+                }
+            doc = {
+                "routes": dict(self._routes),
+                "deployments": deployments,
+                "ingress": {k: list(v) for k, v in self._ingress.items()},
+                "draining": sorted(self._draining_nodes),
+            }
+        # soft hints outside the lock (anatomy takes its own head lock):
+        # the admission predictor's service-time scale per deployment
+        for name, ent in doc["deployments"].items():
+            try:
+                from ray_tpu.serve import anatomy
+
+                ent["service_ewma_s"] = anatomy.service_estimate(name)
+            except Exception:
+                ent["service_ewma_s"] = None
+        return doc
+
+    @staticmethod
+    def _epoch_fingerprint(doc: dict) -> tuple:
+        return (
+            tuple(sorted(doc["routes"].items())),
+            tuple(sorted(
+                (n, e["version"], e["target_replicas"], e["router"],
+                 e["compiled"], e["slo_ttft_ms"],
+                 tuple(sorted(e["nodes"].items())))
+                for n, e in doc["deployments"].items())),
+            tuple(sorted((k, tuple(v)) for k, v in doc["ingress"].items())),
+            tuple(doc["draining"]),
+        )
+
+    def _publish_epoch(self, force: bool = True) -> None:
+        """Build and publish the next routing epoch. ``force=False`` is the
+        reconcile-loop path: publish only when the routing fingerprint
+        changed or the heartbeat refresh is due."""
+        try:
+            with self._epoch_lock:
+                doc = self._epoch_doc()
+                fp = self._epoch_fingerprint(doc)
+                now = time.monotonic()
+                if (not force and fp == self._epoch_fp
+                        and now - self._epoch_pub_t < self.EPOCH_REFRESH_S):
+                    return
+                self._epoch_version += 1
+                doc["version"] = self._epoch_version
+                self._epoch_fp = fp
+                self._epoch_pub_t = now
+                self._epoch_last = doc
+                from ray_tpu.experimental import pubsub
+
+                pubsub.publish(self.EPOCH_CHANNEL, doc, retain=True)
+        except Exception:
+            pass  # consumers self-heal from get_epoch / the next publish
+
+    def get_epoch(self) -> dict | None:
+        """The last published routing epoch (initial-sync RPC for consumers
+        that boot before any publish reaches them)."""
+        with self._epoch_lock:
+            last = self._epoch_last
+        if last is None:
+            self._publish_epoch()
+            with self._epoch_lock:
+                last = self._epoch_last
+        return last
+
+    # ---- ingress fleet registry (the front door registers each placed
+    # ingress; the epoch's "ingress" map is what load balancers/benchmarks
+    # consume, and drain_node drops a doomed node's entry immediately) ----
+    def set_ingress(self, key: str, host: str, port: int) -> None:
+        with self._lock:
+            self._ingress[key] = (host, int(port))
+        self._publish_epoch()
+
+    def remove_ingress(self, key: str) -> None:
+        with self._lock:
+            existed = self._ingress.pop(key, None) is not None
+        if existed:
+            self._publish_epoch()
+
+    def get_ingress(self) -> dict:
+        with self._lock:
+            return {k: list(v) for k, v in self._ingress.items()}
+
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             st = self._deployments.pop(name, None)
             self._routes = {p: n for p, n in self._routes.items() if n != name}
         self._publish_routes()
+        self._publish_epoch()
         if st:
             for r in st.replicas:
                 try:
@@ -382,6 +510,14 @@ class ServeController:
         Returns the number of replicas drained."""
         from ray_tpu.util import flight_recorder
 
+        # the node's ingress is a corpse too: drop it from the fleet
+        # registry FIRST — before the draining mark, and before the probe
+        # harvest below can let a concurrent reconcile publish an epoch —
+        # so every epoch that shows this node draining also shows its
+        # ingress gone (routing-state consumers retire with the node, not
+        # on heartbeat expiry)
+        with self._lock:
+            dropped_ingress = self._ingress.pop(node_hex, None) is not None
         self._draining_nodes.add(node_hex)
         # cordon the scheduler too (best-effort): reconcile respawns the
         # victims immediately, and without the cordon the replacements
@@ -418,8 +554,13 @@ class ServeController:
             for _dep, r in victims:
                 self._replica_nodes.pop(r._actor_id.hex(), None)
                 self._node_probes.pop(r._actor_id.hex(), None)
+        # routing state consumers first (satellite of ISSUE 17): the epoch
+        # with the victims and the dead ingress removed goes out before the
+        # kills — no request is routed to a corpse in the gap
+        self._publish_epoch()
         flight_recorder.record("serve", "node_drain", node_id=node_hex,
-                               reason=reason, replicas=len(victims))
+                               reason=reason, replicas=len(victims),
+                               ingress_dropped=dropped_ingress)
         for _dep, r in victims:
             try:
                 ray_tpu.kill(r)
@@ -475,12 +616,62 @@ class ServeController:
                 }
         return out
 
+    def autoscale_view(self) -> dict:
+        """Per-deployment scaling inputs for the SLO autoscaler (slow path,
+        one RPC per tick): bounds/delays, current target vs running, the
+        declared SLO, and the replica resource shape for standing demand."""
+        import dataclasses as _dc
+
+        out = {}
+        with self._lock:
+            for name, st in self._deployments.items():
+                auto = st.config.autoscaling_config
+                opts = st.config.ray_actor_options
+                shape = {"CPU": float(opts.get("num_cpus", 1.0))}
+                if opts.get("num_tpus"):
+                    shape["TPU"] = float(opts["num_tpus"])
+                for k, v in (opts.get("resources") or {}).items():
+                    shape[k] = float(v)
+                out[name] = {
+                    "autoscaling": _dc.asdict(auto) if auto else None,
+                    "policy": (getattr(auto, "policy", "ongoing_requests")
+                               if auto else None),
+                    "slo_ttft_ms": getattr(st.config, "slo_ttft_ms", None),
+                    "target_replicas": st.target_replicas,
+                    "running_replicas": len(st.replicas),
+                    "replica_shape": shape,
+                }
+        return out
+
+    def set_target_replicas(self, name: str, target: int) -> int:
+        """External autoscaler actuation (serve/autoscale.py): set the
+        desired replica count, clamped to the deployment's autoscaling
+        bounds; reconcile does the spawning/killing. Returns the clamped
+        target (-1: unknown deployment)."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return -1
+            auto = st.config.autoscaling_config
+            lo = auto.min_replicas if auto else 0
+            hi = auto.max_replicas if auto else max(1, int(target))
+            prev = st.target_replicas
+            st.target_replicas = max(lo, min(hi, int(target)))
+            now = time.monotonic()
+            if st.target_replicas > prev:
+                st.last_scale_up = now
+            elif st.target_replicas < prev:
+                st.last_scale_down = now
+            return st.target_replicas
+
     def record_autoscaling_metrics(self, name: str, ongoing_per_replica: float) -> None:
         """Router-reported load (reference: autoscaling_state.py metric flow)."""
         st = self._deployments.get(name)
         if st is None or st.config.autoscaling_config is None:
             return
         auto = st.config.autoscaling_config
+        if getattr(auto, "policy", "ongoing_requests") == "slo":
+            return  # the SLO autoscaler owns this deployment's target
         now = time.monotonic()
         with self._lock:
             if ongoing_per_replica > auto.target_ongoing_requests:
@@ -512,6 +703,9 @@ class ServeController:
             try:
                 self._reconcile_once()
                 self._autoscale_tick()
+                # changed-or-heartbeat epoch publish: replica churn from
+                # reconcile reaches the ingress fleet within one tick
+                self._publish_epoch(force=False)
             except Exception:
                 pass
             time.sleep(0.25)
@@ -593,13 +787,19 @@ class ServeController:
                 ray_tpu.kill(r)
             except Exception:
                 pass
+            # epoch consumers drop the dead replica now, not on their next
+            # poll cycle (the replacement rides the reconcile-loop publish)
+            self._publish_epoch()
 
     def _autoscale_tick(self) -> None:
         """Controller-side load polling so idle deployments scale DOWN even with
         no router traffic (reference: autoscaling_state.py replica metrics)."""
         with self._lock:
             states = [(n, st) for n, st in self._deployments.items()
-                      if st.config.autoscaling_config is not None and st.replicas]
+                      if st.config.autoscaling_config is not None
+                      and getattr(st.config.autoscaling_config, "policy",
+                                  "ongoing_requests") != "slo"
+                      and st.replicas]
         for name, st in states:
             try:
                 qlens = ray_tpu.get([r.queue_len.remote() for r in st.replicas], timeout=5)

@@ -13,13 +13,26 @@ from typing import Any, Callable, Optional
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Reference: serve autoscaling_policy.py defaults."""
+    """Reference: serve autoscaling_policy.py defaults.
+
+    ``policy`` selects who drives ``target_replicas``:
+    - "ongoing_requests" (default): the controller's queue-depth loop
+      (router-reported ongoing requests vs ``target_ongoing_requests``).
+    - "slo": the SLO autoscaler (serve/autoscale.py) scales off predicted
+      TTFT vs ``slo_ttft_ms``; the queue-depth loop stands down so the two
+      can't fight over the target. ``upscale_delay_s`` is the sustained-
+      breach window (hysteresis) and ``downscale_delay_s`` the cooldown.
+
+    Readers use ``getattr(cfg, "policy", "ongoing_requests")`` — configs
+    restored from pre-field controller checkpoints lack the attribute.
+    """
 
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 2.0
     downscale_delay_s: float = 10.0
+    policy: str = "ongoing_requests"
 
 
 @dataclasses.dataclass

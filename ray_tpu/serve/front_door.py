@@ -1,0 +1,600 @@
+"""Replicated serving front door: a stateless ingress replica on every node.
+
+The PR-16 anatomy gave serving its senses (phase ledger, SLO scoreboard,
+predicted TTFT); this module is the actuation half (ISSUE 17). Instead of
+one head-bound proxy fronting every request, an ``IngressActor`` is placed
+on EVERY node through the actor fabric (isolate_process + node pins), and
+each ingress:
+
+- consumes ROUTING EPOCHS — versioned, inbound-tolerant snapshots of the
+  routing state (replica sets, replica->node map, router kinds, SLO config,
+  ingress fleet) that the ``ServeController`` publishes over pubsub on the
+  "serve:epochs" channel (retained: a late subscriber gets current state on
+  subscribe). The controller shrinks to a reconciler owning desired state;
+  nothing polls it on the request path.
+- routes through ``EpochRouter``/``EpochKVRouter`` — the stock routers with
+  their controller RPCs replaced by reads of the local epoch cache, keeping
+  compiled per-replica dispatch: a request entering ANY node is still ONE
+  channel frame to its replica, ZERO control-plane RPCs.
+- gates admission (serve/admission.py) off an ingress-local predicted-TTFT
+  estimate (own in-flight depths x the epoch's service-time hint) BEFORE
+  ``anatomy.admit`` — breached deployments degrade to a bounded queue, then
+  shed with 503 (+ ``ray_tpu_serve_shed_total{deployment,reason}``).
+
+``FrontDoor`` (head side) owns fleet membership: one ingress per live node,
+subscribed to the "nodes" channel — a registered node gets an ingress, a
+dead/preempted/cordoned node's ingress is dropped (the controller's
+``drain_node`` removed it from the epoch already) and replaced when a node
+returns. Reference: Ray Serve's proxy-per-node + LongPollHost push model
+(serve/_private/proxy.py, long_poll.py), MQTT-style retained last-value
+channels for the epoch replay.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import ray_tpu
+from ray_tpu.serve.admission import AdmissionGate
+from ray_tpu.serve.api import HttpProxy
+from ray_tpu.serve.controller import (
+    CONTROLLER_NAME,
+    DeploymentHandle,
+    Router,
+    ServeController,
+)
+from ray_tpu.serve.kv_router import KVAwareRouter
+
+logger = logging.getLogger("ray_tpu.serve")
+
+EPOCH_CHANNEL = ServeController.EPOCH_CHANNEL
+
+
+class EpochCache:
+    """Latest-routing-epoch holder: versioned (monotonic, stale publishes
+    dropped), inbound-tolerant (junk ignored, unknown fields passed
+    through), condition-variable waits for consumers."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._doc: dict | None = None
+        self.version = 0
+        self.rejected = 0  # stale or malformed updates seen (observability)
+
+    def update(self, doc) -> bool:
+        if not isinstance(doc, dict):
+            with self._cond:
+                self.rejected += 1
+            return False
+        try:
+            ver = int(doc.get("version") or 0)
+        except (TypeError, ValueError):
+            with self._cond:
+                self.rejected += 1
+            return False
+        with self._cond:
+            if ver <= self.version:
+                if ver < self.version:
+                    self.rejected += 1  # out-of-order replay
+                return False
+            self._doc = doc
+            self.version = ver
+            self._cond.notify_all()
+            return True
+
+    def get(self) -> dict | None:
+        with self._cond:
+            return self._doc
+
+    def snapshot(self) -> tuple:
+        with self._cond:
+            return self.version, self._doc
+
+    def wait_newer(self, version: int, timeout: float) -> bool:
+        """Block until an epoch newer than ``version`` lands (True) or the
+        timeout expires (False)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self.version <= version:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+
+class _EpochRefreshMixin:
+    """Replaces a router's controller polling with local epoch-cache reads.
+
+    The request fast path (``_refresh``/``pick``/``_select``) makes ZERO
+    control-plane RPCs: replica sets, node maps, and the compiled-dispatch
+    flag all come from the last applied epoch, and the per-N-requests load
+    report to the controller is disabled (deployment load reaches the
+    autoscaler through the telemetry plane's predicted-TTFT series).
+    """
+
+    def __init__(self, controller, deployment_name: str, cache: EpochCache):
+        self._cache = cache
+        self._applied_version = -1
+        super().__init__(controller, deployment_name)
+
+    def _refresh(self) -> None:
+        ver, doc = self._cache.snapshot()
+        if doc is None:
+            return
+        with self._lock:
+            if ver == self._applied_version and self._replicas:
+                return
+        ent = (doc.get("deployments") or {}).get(self._name) or {}
+        reps = list(ent.get("replicas") or [])
+        nodes = ent.get("nodes")
+        with self._lock:
+            reps = [r for r in reps if self._rkey(r) not in self._dead]
+            self._replicas = reps
+            self._inflight = {self._rkey(r): self._inflight.get(
+                self._rkey(r), 0) for r in reps}
+            self._last_refresh = time.monotonic()
+            self._applied_version = ver
+            self._compiled_mode = bool(ent.get("compiled"))
+            if isinstance(nodes, dict):
+                self._replica_nodes = dict(nodes)
+            live = frozenset(self._rkey(r) for r in reps)
+            stale_dags = [(k, d) for k, d in self._compiled.items()
+                          if k not in live]
+            self._compiled = {k: d for k, d in self._compiled.items()
+                              if k in live}
+            self._epoch_applied_locked(live, ent)
+        for _, dag in stale_dags:  # teardown OUTSIDE the lock
+            if dag is not None and dag != "unsupported":
+                try:
+                    dag.teardown()
+                except Exception:
+                    logger.debug("stale replica dag teardown failed",
+                                 exc_info=True)
+
+    def _epoch_applied_locked(self, live: frozenset, ent: dict) -> None:
+        """Subclass hook, called under the router lock after an epoch lands."""
+
+    def _use_compiled(self) -> bool:
+        return bool(self._compiled_mode)
+
+    def _maybe_report(self) -> None:
+        return  # no per-request controller RPC; load rides telemetry
+
+    def pick(self, wait_timeout: float = 30.0, hint=None):
+        self._refresh()
+        if not self._replicas:
+            # replicas still starting: wait on the NEXT epoch instead of
+            # polling the controller (condition-variable, not sleep-poll)
+            deadline = time.monotonic() + wait_timeout
+            while time.monotonic() < deadline and not self._replicas:
+                self._cache.wait_newer(self._applied_version, timeout=0.25)
+                self._refresh()
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(f"No replicas for deployment '{self._name}'")
+            if len(self._replicas) == 1:
+                return self._replicas[0]
+            return self._select(hint)
+
+
+class EpochRouter(_EpochRefreshMixin, Router):
+    """Power-of-two routing fed by the local routing epoch."""
+
+    KIND = "epoch"
+
+
+class EpochKVRouter(_EpochRefreshMixin, KVAwareRouter):
+    """KV-cache-aware routing fed by the local routing epoch: the replica->
+    node map (decode placement + prefix ownership pruning) comes from the
+    epoch instead of the ``get_replica_nodes`` RPC."""
+
+    KIND = "epoch_kv"
+
+    def _epoch_applied_locked(self, live: frozenset, ent: dict) -> None:
+        self._prune_stale_owners(live)
+
+    def _fetch_node_map(self):
+        return None  # unused: _refresh applies the epoch's node map
+
+
+class _EpochHandle(DeploymentHandle):
+    """DeploymentHandle whose router is epoch-fed (no controller RPC at
+    construction: the router kind comes from the epoch too)."""
+
+    def __init__(self, controller, deployment_name: str, cache: EpochCache):
+        self._controller = controller
+        self._name = deployment_name
+        self._cache = cache
+        self._router = self._make_router()
+
+    def _routing_kind(self) -> str:
+        doc = self._cache.get() or {}
+        ent = (doc.get("deployments") or {}).get(self._name) or {}
+        return ent.get("router") or "pow2"
+
+    def _make_router(self) -> Router:
+        kind = self._routing_kind()
+        cls = EpochKVRouter if kind == "kv_aware" else EpochRouter
+        r = cls(self._controller, self._name, self._cache)
+        r._config_kind = kind
+        return r
+
+    def _current_router(self) -> Router:
+        kind = self._routing_kind()
+        if kind != self._router._config_kind:
+            self._router = self._make_router()  # redeploy changed the policy
+        return self._router
+
+
+class IngressActor:
+    """One stateless ingress (isolate_process, one per node): an HttpProxy
+    whose route lookup, replica routing, and admission predictor all read
+    the LOCAL epoch cache — the only controller interactions are one
+    ``get_epoch`` at boot (belt-and-braces under the retained-channel
+    replay) and the pubsub subscription itself."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 ingress_key: str | None = None):
+        self._key = ingress_key
+        self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        self._cache = EpochCache()
+        self._handles: dict[str, _EpochHandle] = {}
+        self._handle_lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._sub = None
+        try:
+            from ray_tpu.experimental import pubsub
+
+            self._sub = pubsub.subscribe(EPOCH_CHANNEL)
+            threading.Thread(target=self._epoch_loop, daemon=True,
+                             name="ingress-epochs").start()
+        except Exception:
+            pass  # initial-sync doc below still serves (no live updates)
+        try:
+            self._cache.update(ray_tpu.get(
+                self._controller.get_epoch.remote(), timeout=10))
+        except Exception:
+            pass  # retained replay on the subscription covers boot
+        self._gate = AdmissionGate(self._predict)
+        self._proxy = HttpProxy(host, port, route_lookup=self._lookup,
+                                admission=self._admit)
+
+    def _epoch_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                msg = self._sub.poll(timeout=1.0)
+            except Exception:
+                return  # subscription torn down
+            if msg is not None:
+                self._cache.update(msg)  # version-gated, junk-tolerant
+
+    # ------------------------- request fast path: local epoch cache only
+    def _lookup(self, path: str):
+        doc = self._cache.get()
+        routes = (doc.get("routes") or {}) if doc else {}
+        best = None
+        for prefix, name in routes.items():
+            if (path == prefix or path.startswith(prefix.rstrip("/") + "/")
+                    or prefix == "/"):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        if best is None:
+            return (None, None)
+        return best[0], self._handle(best[1])
+
+    def _admit(self, deployment: str):
+        return self._gate.try_admit(deployment)
+
+    def _predict(self, deployment: str):
+        """Ingress-local predicted TTFT (ms): this ingress's mean in-flight
+        depth per replica (+1 for the arriving request) x the epoch's
+        service-time hint. No RPC — epoch + own routers only."""
+        doc = self._cache.get()
+        ent = ((doc.get("deployments") or {}).get(deployment) or {}) \
+            if doc else {}
+        slo = ent.get("slo_ttft_ms")
+        if slo is None:
+            return None, None
+        h = self._handles.get(deployment)
+        if h is None:
+            return None, slo  # nothing in flight here yet: admit
+        depths = h._router.inflight_snapshot()
+        n = max(1, len(depths))
+        svc = ent.get("service_ewma_s") or 0.05
+        pred = (sum(depths.values()) / n + 1.0) * float(svc) * 1000.0
+        return pred, slo
+
+    # ------------------------------------------------------- slow path
+    def _handle(self, name: str) -> _EpochHandle:
+        h = self._handles.get(name)
+        if h is None:
+            with self._handle_lock:
+                h = self._handles.get(name)
+                if h is None:
+                    h = self._handles[name] = _EpochHandle(
+                        self._controller, name, self._cache)
+        return h
+
+    def address(self) -> tuple:
+        import socket as _socket
+
+        host = self._proxy.host
+        if host == "0.0.0.0":
+            host = _socket.gethostbyname(_socket.gethostname())
+        return (host, self._proxy.port)
+
+    def node_hex(self) -> str:
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID", "head")
+
+    def epoch_version(self) -> int:
+        return self._cache.version
+
+    def shed_counts(self) -> dict:
+        return self._gate.shed_counts()
+
+    def router_stats(self) -> dict:
+        """Per-deployment dispatch-path state of THIS ingress: whether the
+        epoch enables compiled dispatch, and how many per-replica graphs
+        compiled vs fell back — the first thing to look at when a fleet
+        isn't scaling (per-call RPC dispatch hides behind the same API)."""
+        out = {}
+        for name, h in list(self._handles.items()):
+            r = h._router
+            with r._lock:
+                compiled = sum(1 for d in r._compiled.values()
+                               if d not in (None, "unsupported"))
+                unsupported = sum(1 for d in r._compiled.values()
+                                  if d == "unsupported")
+                out[name] = {"compiled_mode": bool(r._use_compiled()),
+                             "epoch_version": r._applied_version,
+                             "replicas": len(r._replicas),
+                             "compiled_edges": compiled,
+                             "unsupported_edges": unsupported,
+                             "inflight": dict(r._inflight)}
+        return out
+
+    def queued(self, deployment: str) -> int:
+        return self._gate.queued(deployment)
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        """Primed = at least one routing epoch has landed (boot get_epoch
+        or the retained replay). The fleet waits on this before reporting
+        the address: an ingress that is HTTP-up but epoch-less would 404
+        every route until the replay arrives."""
+        return self._cache.wait_newer(0, timeout=timeout)
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._sub is not None:
+            try:
+                self._sub.close()
+            except Exception:
+                pass
+        self._proxy.stop()
+
+
+class FrontDoor:
+    """Head-side fleet manager: places one ingress per live node (or a
+    fixed ``count`` SPREAD fleet for single-node benches), registers each
+    with the controller's ingress registry, and reconciles membership off
+    the "nodes" channel — registered nodes gain an ingress, doomed nodes
+    lose theirs (the controller's drain already dropped them from the
+    published epoch) and are replaced when capacity returns."""
+
+    def __init__(self, host: str = "127.0.0.1", base_port: int = 0,
+                 count: int | None = None):
+        self._host = host
+        self._base_port = base_port
+        self._count = count
+        self._controller = None
+        self._fleet: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._nodes_sub = None
+        self._port_seq = 0
+
+    def start(self) -> "FrontDoor":
+        from ray_tpu.serve.api import _get_or_create_controller
+
+        self._controller = _get_or_create_controller()
+        if self._count is not None:
+            for i in range(self._count):
+                self._spawn(key=f"ingress-{i}", node=None)
+        else:
+            for n in ray_tpu.nodes():
+                if n.get("Alive", True):
+                    self._spawn(key=n["NodeID"], node=n["NodeID"])
+            try:
+                from ray_tpu.experimental import pubsub
+
+                self._nodes_sub = pubsub.subscribe("nodes")
+                threading.Thread(target=self._nodes_loop, daemon=True,
+                                 name="front-door-nodes").start()
+            except Exception:
+                pass  # static fleet (no control plane): no reconciliation
+        return self
+
+    def _next_port(self) -> int:
+        if not self._base_port:
+            return 0  # ephemeral: per-node fleets share one machine in tests
+        p = self._base_port + self._port_seq
+        self._port_seq += 1
+        return p
+
+    def _spawn(self, key: str, node: str | None) -> tuple:
+        import uuid as _uuid
+
+        name = f"SERVE_INGRESS:{_uuid.uuid4().hex[:6]}:{key[:8]}"
+        attempts = [node, None] if node is not None else [None]
+        actor = None
+        last_err = None
+        for pin in attempts:
+            opts = dict(isolate_process=True, num_cpus=0.5, name=name)
+            if pin is not None:
+                opts["node"] = pin
+            try:
+                actor = ray_tpu.remote(**opts)(IngressActor).remote(
+                    port=self._next_port(), host=self._host, ingress_key=key)
+                if not ray_tpu.get(actor.ready.remote(), timeout=60):
+                    raise TimeoutError(
+                        f"ingress {key} never received a routing epoch")
+                break
+            except Exception as e:  # head node refuses pins: retry unpinned
+                last_err = e
+                if actor is not None:
+                    try:
+                        ray_tpu.kill(actor)
+                    except Exception:
+                        pass
+                    actor = None
+        if actor is None:
+            raise RuntimeError(f"ingress {key} failed to start: {last_err}")
+        addr = tuple(ray_tpu.get(actor.address.remote(), timeout=30))
+        with self._lock:
+            self._fleet[key] = {"actor": actor, "addr": addr, "node": node}
+        ray_tpu.get(self._controller.set_ingress.remote(key, addr[0], addr[1]))
+        return addr
+
+    def _nodes_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                msg = self._nodes_sub.poll(timeout=0.5)
+            except Exception:
+                return
+            if not isinstance(msg, dict):
+                continue
+            event = msg.get("event")
+            node_hex = msg.get("node_id", "")
+            if not node_hex:
+                continue
+            if event == "registered":
+                try:
+                    self._ensure(node_hex)
+                except Exception:
+                    logger.warning("ingress spawn on %s failed", node_hex,
+                                   exc_info=True)
+            elif event in ("dead", "preempt_notice", "cordon"):
+                self._drop(node_hex)
+
+    def _ensure(self, node_hex: str) -> None:
+        with self._lock:
+            if node_hex in self._fleet:
+                return
+        self._spawn(key=node_hex, node=node_hex)
+
+    def _drop(self, node_hex: str) -> None:
+        with self._lock:
+            ent = self._fleet.pop(node_hex, None)
+        # the controller's drain_node dropped this ingress from the epoch
+        # when the node event fired; this unregister is idempotent cleanup
+        try:
+            self._controller.remove_ingress.remote(node_hex)
+        except Exception:
+            pass
+        if ent is not None:
+            try:
+                ray_tpu.kill(ent["actor"])
+            except Exception:
+                pass
+
+    def addresses(self) -> list:
+        with self._lock:
+            return [ent["addr"] for _, ent in sorted(self._fleet.items())]
+
+    def fleet_view(self) -> dict:
+        with self._lock:
+            fleet = {k: {"addr": list(ent["addr"]), "node": ent["node"]}
+                     for k, ent in self._fleet.items()}
+        sheds: dict = {}
+        with self._lock:
+            actors = [(k, ent["actor"]) for k, ent in self._fleet.items()]
+        for key, actor in actors:
+            try:
+                sheds[key] = ray_tpu.get(actor.shed_counts.remote(),
+                                         timeout=2)
+            except Exception:
+                sheds[key] = None  # ingress mid-replacement
+        return {"ingress": fleet, "shed_counts": sheds}
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._nodes_sub is not None:
+            try:
+                self._nodes_sub.close()
+            except Exception:
+                pass
+        with self._lock:
+            fleet, self._fleet = self._fleet, {}
+        for key, ent in fleet.items():
+            try:
+                self._controller.remove_ingress.remote(key)
+            except Exception:
+                pass
+            try:
+                ray_tpu.get(ent["actor"].stop.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(ent["actor"])
+            except Exception:
+                pass
+
+
+# ------------------------------------------------------------ module API
+_fd_lock = threading.Lock()
+_fd_state: dict = {"front_door": None, "autoscaler": None}
+
+
+def start_front_door(host: str = "127.0.0.1", base_port: int = 0,
+                     count: int | None = None,
+                     autoscale: bool = False) -> list:
+    """Start the ingress fleet (idempotent) and return its addresses.
+    ``count=None`` places one ingress per live node; a fixed count places a
+    SPREAD fleet (single-node benches). ``autoscale=True`` also starts the
+    SLO deployment autoscaler (serve/autoscale.py)."""
+    with _fd_lock:
+        if _fd_state["front_door"] is None:
+            _fd_state["front_door"] = FrontDoor(host, base_port, count).start()
+        if autoscale and _fd_state["autoscaler"] is None:
+            from ray_tpu.serve.autoscale import DeploymentAutoscaler
+
+            _fd_state["autoscaler"] = DeploymentAutoscaler(
+                _fd_state["front_door"]._controller).start()
+        return _fd_state["front_door"].addresses()
+
+
+def front_door_addresses() -> list:
+    with _fd_lock:
+        fd = _fd_state["front_door"]
+    return fd.addresses() if fd is not None else []
+
+
+def front_door_view() -> dict:
+    """Dashboard payload: fleet membership + shed counts + autoscaler state."""
+    with _fd_lock:
+        fd = _fd_state["front_door"]
+        sc = _fd_state["autoscaler"]
+    out = {"running": fd is not None}
+    if fd is not None:
+        out.update(fd.fleet_view())
+    if sc is not None:
+        out["autoscaler"] = sc.view()
+    return out
+
+
+def stop_front_door() -> None:
+    with _fd_lock:
+        fd, _fd_state["front_door"] = _fd_state["front_door"], None
+        sc, _fd_state["autoscaler"] = _fd_state["autoscaler"], None
+    if sc is not None:
+        try:
+            sc.stop()
+        except Exception:
+            pass
+    if fd is not None:
+        fd.stop()

@@ -107,6 +107,16 @@ class KVAwareRouter(Router):
         return out
 
     # ---- refresh: prune owners/nodes of removed replicas ----
+    def _fetch_node_map(self) -> "dict | None":
+        """Pull the replica->node map from the controller (None: keep the
+        last map). Overridable seam: the front door's epoch-fed variant
+        reads the map from its local routing epoch instead of this RPC."""
+        try:
+            return ray_tpu.get(self._controller.get_replica_nodes.remote(
+                self._name), timeout=2)
+        except Exception:
+            return None  # older controller / transient failure
+
     def _refresh(self) -> None:
         before = self._last_refresh
         super()._refresh()
@@ -116,11 +126,7 @@ class KVAwareRouter(Router):
         now = time.monotonic()
         if now - self._nodes_fetched >= self.NODE_MAP_PERIOD_S:
             self._nodes_fetched = now
-            try:
-                nodes = ray_tpu.get(self._controller.get_replica_nodes.remote(
-                    self._name), timeout=2)
-            except Exception:
-                pass  # older controller / transient failure: keep last map
+            nodes = self._fetch_node_map()
         # warm the io-pressure cache OUTSIDE the lock: node_io_view() is a
         # full metrics rollup, and _select_decode (which reads it) runs
         # under the router lock on the request path

@@ -79,7 +79,9 @@ def _init_engine(decode_cfg, prefill_cfg=None, kv_transfer: str | None = None):
 
 
 def build_prefill_deployment(config=None, *, prefill_config=None,
-                             num_replicas: int = 1, name: str = "PDPrefill"):
+                             num_replicas: int = 1, name: str = "PDPrefill",
+                             slo_ttft_ms: float | None = None,
+                             autoscaling_config=None):
     """The prefill fleet: KV pages out, descriptors back."""
     from ray_tpu.serve.deployment import deployment
     from ray_tpu.serve.llm_paged import PagedLLMConfig
@@ -88,7 +90,9 @@ def build_prefill_deployment(config=None, *, prefill_config=None,
 
     @deployment(name=name, num_replicas=num_replicas,
                 ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=32,
-                request_router="kv_aware", compiled_dispatch=True)
+                request_router="kv_aware", compiled_dispatch=True,
+                slo_ttft_ms=slo_ttft_ms,
+                autoscaling_config=autoscaling_config)
     class PrefillServer(_ReplicaLifecycle):
         def __init__(self, decode_cfg, prefill_cfg):
             from ray_tpu.serve.kv_transport import KVTransport
@@ -152,7 +156,9 @@ def build_prefill_deployment(config=None, *, prefill_config=None,
 
 
 def build_decode_deployment(config=None, *, num_replicas: int = 1,
-                            name: str = "PDDecode"):
+                            name: str = "PDDecode",
+                            slo_ttft_ms: float | None = None,
+                            autoscaling_config=None):
     """The decode fleet: handoff descriptors in, token streams out."""
     from ray_tpu.serve.deployment import deployment
     from ray_tpu.serve.llm_paged import PagedLLMConfig
@@ -165,7 +171,9 @@ def build_decode_deployment(config=None, *, num_replicas: int = 1,
     # fabric lets these replicas live on REMOTE agents (ISSUE 15)
     @deployment(name=name, num_replicas=num_replicas,
                 ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=32,
-                request_router="kv_aware", compiled_dispatch=True)
+                request_router="kv_aware", compiled_dispatch=True,
+                slo_ttft_ms=slo_ttft_ms,
+                autoscaling_config=autoscaling_config)
     class DecodeServer(_ReplicaLifecycle):
         def __init__(self, decode_cfg):
             from ray_tpu.serve.kv_transport import KVTransport
@@ -251,13 +259,17 @@ def build_decode_deployment(config=None, *, num_replicas: int = 1,
 
 def build_pd_controller(prefill_name: str = "PDPrefill",
                         decode_name: str = "PDDecode",
-                        name: str = "PDIngress", num_replicas: int = 1):
+                        name: str = "PDIngress", num_replicas: int = 1,
+                        slo_ttft_ms: float | None = None,
+                        autoscaling_config=None):
     """The ingress joining the fleets (reference: pd_server.py's
     orchestration, now across deployments instead of inside one replica)."""
     from ray_tpu.serve.deployment import deployment
 
     @deployment(name=name, num_replicas=num_replicas,
-                ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=64)
+                ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=64,
+                slo_ttft_ms=slo_ttft_ms,
+                autoscaling_config=autoscaling_config)
     class PDController:
         def __init__(self, prefill_name: str, decode_name: str,
                      name: str = "PDIngress"):
@@ -351,31 +363,43 @@ def deploy_pd_app(config=None, *, prefill_config=None,
                   num_prefill_replicas: int = 1,
                   num_decode_replicas: int = 1,
                   route_prefix: str | None = "/pd",
-                  name_prefix: str = "PD"):
+                  name_prefix: str = "PD",
+                  slo_ttft_ms: float | None = None,
+                  autoscaling_config=None):
     """Deploy the disaggregated app (prefill fleet + decode fleet +
-    controller ingress) and return the controller handle."""
+    controller ingress) and return the controller handle.
+
+    ``slo_ttft_ms`` / ``autoscaling_config`` plumb through to BOTH engine
+    fleets (the front door's admission gate and the SLO autoscaler read
+    them per deployment); the thin controller ingress carries only the SLO
+    tag so its ledger rows land on the scoreboard too."""
     from ray_tpu import serve
 
     prefill_name = f"{name_prefix}Prefill"
     decode_name = f"{name_prefix}Decode"
     serve.run(build_prefill_deployment(
         config, prefill_config=prefill_config,
-        num_replicas=num_prefill_replicas, name=prefill_name),
+        num_replicas=num_prefill_replicas, name=prefill_name,
+        slo_ttft_ms=slo_ttft_ms, autoscaling_config=autoscaling_config),
         route_prefix=None)
     serve.run(build_decode_deployment(
-        config, num_replicas=num_decode_replicas, name=decode_name),
+        config, num_replicas=num_decode_replicas, name=decode_name,
+        slo_ttft_ms=slo_ttft_ms, autoscaling_config=autoscaling_config),
         route_prefix=None)
     # the ingress is named distinctly from build_pd_deployment's hard-coded
     # co-located "PDServer": deploying both shapes side by side for an A/B
     # (the module docstring's framing) must not silently redeploy one over
     # the other
     return serve.run(build_pd_controller(
-        prefill_name, decode_name, name=f"{name_prefix}Ingress"),
+        prefill_name, decode_name, name=f"{name_prefix}Ingress",
+        slo_ttft_ms=slo_ttft_ms),
         route_prefix=route_prefix)
 
 
 def build_pd_deployment(config=None, *, num_replicas: int = 1,
-                        prefill_config=None):
+                        prefill_config=None,
+                        slo_ttft_ms: float | None = None,
+                        autoscaling_config=None):
     """The CO-LOCATED baseline: one replica owns both engines and hands KV
     over in-process (the pre-disaggregation shape; kept as the serve-bench
     A/B control and the small-deployment fallback).
@@ -388,7 +412,9 @@ def build_pd_deployment(config=None, *, num_replicas: int = 1,
     cfg = config or PagedLLMConfig()
 
     @deployment(name="PDServer", num_replicas=num_replicas,
-                ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=32)
+                ray_actor_options={"num_tpus": 0.0}, max_ongoing_requests=32,
+                slo_ttft_ms=slo_ttft_ms,
+                autoscaling_config=autoscaling_config)
     class PDServer(_ReplicaLifecycle):
         def __init__(self, decode_cfg, prefill_cfg):
             from ray_tpu.serve.llm_paged import PagedLLMEngine

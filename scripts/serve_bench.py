@@ -189,6 +189,160 @@ def run_ingress_sweep(base: str, rates: list, duration_s: float,
     return points
 
 
+# -------------------------------------------------- front door (ISSUE 17)
+def _fd_fleet(count: int, body: dict) -> list:
+    """(Re)start the ingress fleet at ``count`` and warm every ingress:
+    first request through an ingress builds its epoch handle + compiled
+    per-replica dispatch, which must not land inside a measured window."""
+    from ray_tpu import serve
+
+    serve.stop_front_door()
+    addrs = serve.start_front_door(count=count)
+    urls = [f"http://{h}:{p}/v1/chat/completions" for h, p in addrs]
+    for u in urls:
+        for _ in range(2):  # touch both replicas through each ingress
+            _post(u, body)
+        _fire_stream(u, body)
+    return urls
+
+
+def _fd_fire_split(urls: list, body: dict, fire_one=_fire_stream):
+    """Open-loop ``fire`` splitting arrivals round-robin across the
+    ingress fleet (per-ingress arrival split; one merged record stream)."""
+    n = {"i": 0}
+    lock = threading.Lock()
+
+    def fire(sched):
+        with lock:
+            i = n["i"]
+            n["i"] += 1
+        return fire_one(urls[i % len(urls)], body, sched_t=sched)
+
+    return fire
+
+
+def _fire_raw(url: str, body: dict, timeout: float = 120.0,
+              sched_t: float | None = None) -> dict:
+    """One non-streaming request; the response is a single shot so TTFT
+    and end-to-end latency coincide. Clocks from the scheduled arrival
+    when given (client-side queueing stays visible)."""
+    t0 = time.perf_counter() if sched_t is None else sched_t
+    try:
+        out = _post(url, body, timeout=timeout)
+        res = out.get("result", out)
+        if res.get("ntokens") is None:
+            return {"ok": False}
+        lat = (time.perf_counter() - t0) * 1000.0
+        return {"ok": True, "ttft_ms": lat, "latency_ms": lat}
+    except Exception:
+        return {"ok": False}
+
+
+def run_front_door(rates: list, duration_s: float, slo_ttft_ms: float,
+                   max_tokens: int, n_ingress: int, ab_rate: float,
+                   ab_rounds: int) -> dict:
+    """Multi-ingress arm: the same open-loop sweep through a fleet of
+    ``n_ingress`` replicated front-door ingresses (each its own PROCESS:
+    isolate_process actors with epoch-fed routers — zero control-plane
+    RPCs per request), plus an interleaved 1-vs-2-ingress A/B at a fixed
+    rate past the single-ingress dispatch ceiling (see the A/B block
+    below for why the ceiling is compiled-edge capacity)."""
+    from ray_tpu import serve
+
+    serve.run(serve.build_openai_app(num_replicas=2), route_prefix="/v1")
+    body = {"messages": [{"role": "user", "content": "benchmark prompt"}],
+            "max_tokens": max_tokens}
+
+    urls = _fd_fleet(n_ingress, body)
+    points = []
+    for i, rate in enumerate(rates):
+        records, wall = _open_loop(_fd_fire_split(urls, body), rate,
+                                   duration_s, seed=43 + i)
+        pt = _point(records, wall, rate, slo_ttft_ms, max_tokens)
+        print(f"  front-door x{n_ingress} rate={rate:g}/s -> "
+              f"{pt['tokens_per_s']} tok/s, goodput {pt['goodput_rps']}/s, "
+              f"ttft p50/p99 {pt['ttft_p50_ms']}/{pt['ttft_p99_ms']} ms")
+        points.append(pt)
+
+    # --- interleaved 1-vs-2-ingress A/B on an accelerator-sleep engine ---
+    # This box has ONE CPU core (see MICROBENCH.md), so wall-clock CPU
+    # parallelism across ingress processes is physically impossible here.
+    # What ingress replication buys on any box is per-ingress DISPATCH
+    # capacity: each ingress compiles its own per-replica dispatch edges,
+    # each edge admits one in-flight execution at a time, so an ingress
+    # ceilings at n_replicas/service_time — and a second ingress doubles
+    # the edge count and the ceiling. The engine sleeps (simulated
+    # accelerator time) instead of burning CPU so that edge ceiling, not
+    # the single core, is the measured knee; the offered rate sits past
+    # the single-ingress ceiling (~16/0.15 = 107 req/s) while both arms'
+    # ceilings stay well under what the shared core can push.
+    svc_s = 0.15
+    n_rep = 16
+
+    @serve.deployment(name="FDEngine", num_replicas=n_rep,
+                      compiled_dispatch=True,
+                      ray_actor_options={"num_cpus": 0.1})
+    class FDEngine:
+        def __call__(self, body):
+            time.sleep(svc_s)
+            return {"ntokens": body.get("max_tokens", 0)}
+
+    serve.run(FDEngine.bind(), route_prefix="/fd_engine")
+    eng_body = {"max_tokens": max_tokens}
+
+    def fd_eng_fleet(count: int) -> list:
+        serve.stop_front_door()
+        addrs = serve.start_front_door(count=count)
+        eng_urls = [f"http://{h}:{p}/fd_engine" for h, p in addrs]
+        for u in eng_urls:  # compile this ingress's per-replica edges
+            for _ in range(int(n_rep * 1.5)):
+                _fire_raw(u, eng_body)
+        return eng_urls
+
+    # interleaved A/B (1, 2, 1, 2, ...): box drift hits both arms equally
+    per_arm: dict = {1: [], 2: []}
+    for rnd in range(ab_rounds):
+        for count in (1, 2):
+            ab_urls = fd_eng_fleet(count)
+            records, wall = _open_loop(
+                _fd_fire_split(ab_urls, eng_body, fire_one=_fire_raw),
+                ab_rate, duration_s, seed=61 + rnd, max_workers=192)
+            pt = _point(records, wall, ab_rate, slo_ttft_ms, max_tokens)
+            per_arm[count].append(pt)
+            print(f"  front-door ab round {rnd} x{count}: "
+                  f"{pt['tokens_per_s']} tok/s, "
+                  f"ttft p50 {pt['ttft_p50_ms']} ms")
+    serve.stop_front_door()
+
+    def med(pts: list) -> dict:
+        keys = ("tokens_per_s", "goodput_rps", "ttft_p50_ms", "ttft_p99_ms",
+                "latency_p50_ms", "latency_p99_ms")
+        out = dict(pts[0])
+        for k in keys:
+            out[k] = round(statistics.median(p[k] for p in pts), 2)
+        out["completed"] = sum(p["completed"] for p in pts)
+        out["errors"] = sum(p["errors"] for p in pts)
+        out["offered"] = sum(p["offered"] for p in pts)
+        out["wall_s"] = round(sum(p["wall_s"] for p in pts), 3)
+        return out
+
+    one, two = med(per_arm[1]), med(per_arm[2])
+    ratio = round(two["tokens_per_s"] / one["tokens_per_s"], 2) \
+        if one["tokens_per_s"] else 0.0
+    return {
+        "n_ingress": n_ingress,
+        "sweep": points,
+        "ab": {"rate_rps": ab_rate, "rounds": ab_rounds,
+               "workload": "accelerator-sleep engine (single-core box: "
+                           "the knee is per-ingress compiled-edge "
+                           "capacity, not CPU parallelism)",
+               "engine": {"replicas": n_rep, "service_s": svc_s,
+                          "per_ingress_ceiling_rps": round(n_rep / svc_s)},
+               "one_ingress": one, "two_ingress": two,
+               "tokens_per_s_ratio": ratio},
+    }
+
+
 # ------------------------------------------------------------------ PD A/B
 def _fire_pd(url: str, body: dict, timeout: float = 120.0,
              sched_t: float | None = None) -> dict:
@@ -284,7 +438,9 @@ def run_pd_ab(base: str, rate_rps: float, duration_s: float, rounds: int,
 
 # ----------------------------------------------------------------------- main
 def run(rates: list, duration_s: float, slo_ttft_ms: float, max_tokens: int,
-        pd_rate: float, pd_rounds: int, pd_max_tokens: int) -> dict:
+        pd_rate: float, pd_rounds: int, pd_max_tokens: int,
+        fd: bool = False, fd_ingresses: int = 2, fd_rate: float = 220.0,
+        fd_rounds: int = 2) -> dict:
     import ray_tpu
 
     from ray_tpu import serve
@@ -295,6 +451,13 @@ def run(rates: list, duration_s: float, slo_ttft_ms: float, max_tokens: int,
           f"SLO ttft<={slo_ttft_ms}ms")
     sweep = run_ingress_sweep(base, rates, duration_s, slo_ttft_ms,
                               max_tokens)
+    front_door = None
+    if fd:
+        print(f"front door: x{fd_ingresses} ingress sweep + "
+              f"1-vs-2 A/B at {fd_rate} req/s x {fd_rounds} rounds")
+        front_door = run_front_door(rates, duration_s, slo_ttft_ms,
+                                    max_tokens, fd_ingresses, fd_rate,
+                                    fd_rounds)
     print(f"PD A/B: {pd_rate} req/s x {duration_s}s x {pd_rounds} rounds")
     pd_ab = run_pd_ab(base, rate_rps=pd_rate, duration_s=duration_s,
                       rounds=pd_rounds, slo_ttft_ms=slo_ttft_ms,
@@ -311,6 +474,8 @@ def run(rates: list, duration_s: float, slo_ttft_ms: float, max_tokens: int,
         "pd_ab": pd_ab,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if front_door is not None:
+        result["front_door"] = front_door
     serve.shutdown()
     ray_tpu.shutdown()
     return result
@@ -332,6 +497,18 @@ def main() -> None:
                         help="decode length for the PD A/B (recorded in "
                              "pd_ab.max_tokens; the top-level max_tokens "
                              "is the ingress sweep's)")
+    parser.add_argument("--ingress-per-node", action="store_true",
+                        help="front-door arm: replicated-ingress sweep "
+                             "(per-ingress arrival split, merged table) + "
+                             "interleaved 1-vs-2-ingress A/B")
+    parser.add_argument("--fd-ingresses", type=int, default=2,
+                        help="fleet size for the front-door sweep")
+    parser.add_argument("--fd-rate", type=float, default=220.0,
+                        help="offered rate for the 1-vs-2-ingress A/B "
+                             "(past the single-ingress dispatch ceiling, "
+                             "~107 req/s with the sleep engine)")
+    parser.add_argument("--fd-rounds", type=int, default=2,
+                        help="interleaved rounds per front-door arm")
     parser.add_argument("--quick", action="store_true",
                         help="smoke sizes (CI)")
     parser.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
@@ -339,8 +516,11 @@ def main() -> None:
     rates = [float(r) for r in args.rates.split(",") if r]
     if args.quick:
         rates, args.duration, args.pd_rounds = [2.0, 8.0], 4.0, 1
+        args.fd_rounds = 1
     result = run(rates, args.duration, args.slo_ttft_ms, args.max_tokens,
-                 args.pd_rate, args.pd_rounds, args.pd_max_tokens)
+                 args.pd_rate, args.pd_rounds, args.pd_max_tokens,
+                 fd=args.ingress_per_node, fd_ingresses=args.fd_ingresses,
+                 fd_rate=args.fd_rate, fd_rounds=args.fd_rounds)
     print(json.dumps({k: v for k, v in result.items() if k != "pd_ab"},
                      indent=2))
     with open(args.out, "w") as f:

@@ -1,0 +1,690 @@
+"""Replicated serving front door tests (ISSUE 17): routing epochs over
+retained pubsub, epoch-fed routers with zero control-plane RPCs per
+request, SLO admission (shed vs degrade-to-queue), the SLO deployment
+autoscaler, and the per-node ingress fleet under node loss.
+
+Topology for the acceptance/chaos tests: real node-agent OS processes with
+isolated planes on one machine (the fabric test shape) — an ingress pinned
+to a NON-head node serves HTTP and assembles the full 8-phase ledger.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.serve import admission, anatomy
+from ray_tpu.serve.admission import (
+    ADMIT,
+    QUEUE,
+    REASON_PREDICTED_TTFT,
+    REASON_QUEUE_FULL,
+    REASON_QUEUE_TIMEOUT,
+    SHED,
+    AdmissionConfig,
+    AdmissionGate,
+)
+from ray_tpu.serve.front_door import EpochCache
+from ray_tpu.util import flight_recorder
+
+
+@pytest.fixture
+def fresh():
+    anatomy.clear()
+    yield
+    anatomy.clear()
+
+
+def _cfg(**kw):
+    base = dict(queue_budget=32, queue_wait_s=2.0, headroom=1.0,
+                poll_s=0.005)
+    base.update(kw)
+    return AdmissionConfig(**base)
+
+
+def _post(url, body, timeout=30):
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# ------------------------------------------------ admission decision table
+@pytest.mark.parametrize("pred,slo,queued,cfg_kw,action,reason", [
+    # no SLO / no prediction: always admit
+    (None, None, 0, {}, ADMIT, None),
+    (9999.0, None, 0, {}, ADMIT, None),
+    (None, 100.0, 0, {}, ADMIT, None),
+    # under the line (boundary inclusive): admit
+    (99.0, 100.0, 0, {}, ADMIT, None),
+    (100.0, 100.0, 0, {}, ADMIT, None),
+    # headroom moves the line
+    (149.0, 100.0, 0, {"headroom": 1.5}, ADMIT, None),
+    (151.0, 100.0, 0, {"headroom": 1.5}, QUEUE, None),
+    # over the line: queue while budget remains...
+    (101.0, 100.0, 0, {}, QUEUE, None),
+    (101.0, 100.0, 31, {}, QUEUE, None),
+    # ...queue-budget boundary: full -> shed
+    (101.0, 100.0, 32, {}, SHED, REASON_QUEUE_FULL),
+    (101.0, 100.0, 33, {}, SHED, REASON_QUEUE_FULL),
+    # zero budget: shed immediately on breach
+    (101.0, 100.0, 0, {"queue_budget": 0}, SHED, REASON_PREDICTED_TTFT),
+])
+def test_admission_decision_table(pred, slo, queued, cfg_kw, action, reason):
+    assert admission.decide(pred, slo, queued, _cfg(**cfg_kw)) == \
+        (action, reason)
+
+
+def test_gate_admits_without_slo(fresh):
+    gate = AdmissionGate(lambda dep: (None, None), _cfg())
+    assert gate.try_admit("d") == (True, None)
+    assert gate.shed_counts() == {}
+
+
+def test_gate_sheds_immediately_with_zero_budget(fresh):
+    gate = AdmissionGate(lambda dep: (500.0, 100.0), _cfg(queue_budget=0))
+    ok, reason = gate.try_admit("d")
+    assert (ok, reason) == (False, REASON_PREDICTED_TTFT)
+    assert gate.shed_counts() == {f"d:{REASON_PREDICTED_TTFT}": 1}
+
+
+def test_gate_queued_request_admits_when_prediction_clears(fresh):
+    """Degrade-to-queue: a breached arrival holds a queue slot and admits
+    as soon as the predictor clears — well before the wait deadline."""
+    state = {"pred": 500.0}
+    gate = AdmissionGate(lambda dep: (state["pred"], 100.0),
+                         _cfg(queue_wait_s=10.0))
+    t0 = time.monotonic()
+
+    def clear():
+        time.sleep(0.05)
+        state["pred"] = 50.0
+
+    threading.Thread(target=clear, daemon=True).start()
+    ok, reason = gate.try_admit("d")
+    assert (ok, reason) == (True, None)
+    assert time.monotonic() - t0 < 5.0  # cleared, not timed out
+    assert gate.queued("d") == 0  # slot released
+
+
+def test_gate_queue_timeout_sheds(fresh):
+    gate = AdmissionGate(lambda dep: (500.0, 100.0),
+                         _cfg(queue_wait_s=0.05))
+    ok, reason = gate.try_admit("d")
+    assert (ok, reason) == (False, REASON_QUEUE_TIMEOUT)
+    assert gate.queued("d") == 0
+    assert gate.shed_counts() == {f"d:{REASON_QUEUE_TIMEOUT}": 1}
+
+
+def test_gate_queue_budget_boundary(fresh):
+    """With the budget already held by queued requests, the NEXT breached
+    arrival sheds queue_full instead of queueing."""
+    gate = AdmissionGate(lambda dep: (500.0, 100.0),
+                         _cfg(queue_budget=2, queue_wait_s=0.5))
+    results = []
+
+    def arrival():
+        results.append(gate.try_admit("d"))
+
+    threads = [threading.Thread(target=arrival) for _ in range(2)]
+    for t in threads:
+        t.start()
+    # condition-wait until both hold their queue slots
+    deadline = time.monotonic() + 5
+    while gate.queued("d") < 2 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert gate.queued("d") == 2
+    assert gate.try_admit("d") == (False, REASON_QUEUE_FULL)
+    for t in threads:
+        t.join(timeout=10)
+    assert results == [(False, REASON_QUEUE_TIMEOUT)] * 2
+    sc = gate.shed_counts()
+    assert sc[f"d:{REASON_QUEUE_FULL}"] == 1
+    assert sc[f"d:{REASON_QUEUE_TIMEOUT}"] == 2
+
+
+def test_shed_metrics_and_flight_ring_rate_limited(fresh):
+    """Every shed lands on ray_tpu_serve_shed_total{deployment,reason} and
+    requests_total{outcome=shed}, but the "serve" flight ring sees a
+    rate-limited trickle, not one event per shed."""
+    from ray_tpu.util.metrics import registry_snapshot
+
+    gate = AdmissionGate(lambda dep: (500.0, 100.0), _cfg(queue_budget=0))
+    for _ in range(20):
+        gate.try_admit("stormdep")
+    snap = registry_snapshot()
+    shed = [(dict(tags), v) for tags, v
+            in snap["ray_tpu_serve_shed_total"].items()
+            if dict(tags).get("deployment") == "stormdep"]
+    assert shed == [({"deployment": "stormdep",
+                      "reason": REASON_PREDICTED_TTFT}, 20.0)]
+    done = [v for tags, v in snap["ray_tpu_serve_requests_total"].items()
+            if dict(tags).get("deployment") == "stormdep"
+            and dict(tags).get("outcome") == "shed"]
+    assert done == [20.0]
+    recs = [r for r in flight_recorder.records("serve")
+            if r["event"] == "shed" and r.get("deployment") == "stormdep"]
+    assert 1 <= len(recs) <= 2  # min-gap limiter: ~1 per second
+
+
+def test_scoreboard_goodput_unaffected_by_sheds(fresh):
+    """Sheds happen BEFORE admit, so they never create ledgers: the SLO
+    scoreboard's completed/goodput accounting only sees admitted work."""
+    dep = "gooddep"
+    anatomy.set_slo(dep, 1000.0)
+    b = {}
+    rid = anatomy.admit(b, dep)
+    anatomy.stamp(rid, "decode_first_token", anatomy.now_wall())
+    anatomy.complete(rid, dep, ntokens=4)
+    for _ in range(10):
+        anatomy.record_shed(dep, REASON_QUEUE_FULL)
+    view = anatomy.serve_view()
+    board = view["deployments"][dep]
+    assert board["admitted"] == 1 and board["completed"] == 1
+    assert board["slo_breach"] == 0
+    assert board["goodput"] == 1.0  # sheds don't dent goodput...
+    assert board["ttft_ms"]["n"] == 1  # ...and scored zero ledgers
+    assert all(r["rid"] == rid for r in view["requests"])
+
+
+# ------------------------------------------------------------- epoch cache
+def test_epoch_cache_version_gate_and_tolerance():
+    c = EpochCache()
+    assert not c.update("junk")
+    assert not c.update(None)
+    assert not c.update({"version": "zebra"})
+    assert c.rejected == 3
+    assert c.update({"version": 3, "routes": {"/a": "A"}})
+    assert c.version == 3
+    # stale and duplicate publishes drop; doc untouched
+    assert not c.update({"version": 2, "routes": {}})
+    assert not c.update({"version": 3, "routes": {}})
+    assert c.get()["routes"] == {"/a": "A"}
+    # unknown fields pass through (inbound-tolerant)
+    assert c.update({"version": 4, "routes": {}, "future_field": 1})
+    assert c.get()["future_field"] == 1
+
+
+def test_epoch_cache_wait_newer():
+    c = EpochCache()
+    c.update({"version": 1})
+    got = []
+
+    def waiter():
+        got.append(c.wait_newer(1, timeout=5.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    c.update({"version": 2})
+    t.join(timeout=10)
+    assert got == [True]
+    assert c.wait_newer(2, timeout=0.05) is False
+
+
+# ----------------------------------- controller epochs + drain drops ingress
+def test_controller_publishes_epochs_and_drain_drops_ingress(ray_start_regular):
+    """The controller publishes versioned routing epochs on a RETAINED
+    channel (late subscriber sees current state at subscribe time), and
+    drain_node removes the doomed node's ingress + replicas from the epoch
+    BEFORE the kills land (satellite: routing-state consumers retire with
+    the node, not on their next poll)."""
+    from ray_tpu import serve
+    from ray_tpu.experimental import pubsub
+    from ray_tpu.serve.controller import ServeController
+
+    anatomy.clear()
+    ctrl = ServeController()
+    try:
+        @serve.deployment(name="EpochDep", num_replicas=1)
+        class EpochDep:
+            def __call__(self, body):
+                return {"ok": True}
+
+        ctrl.deploy(EpochDep.bind().deployment, "/epoch")
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and len(ctrl.get_replicas("EpochDep")) < 1):
+            time.sleep(0.05)
+
+        # a LATE subscriber gets the retained epoch without any publish
+        sub = pubsub.subscribe(ctrl.EPOCH_CHANNEL)
+        try:
+            doc = sub.poll(timeout=10)
+            assert doc is not None, "retained epoch not replayed"
+            assert doc["version"] >= 1
+            assert doc["routes"].get("/epoch") == "EpochDep"
+            ent = doc["deployments"]["EpochDep"]
+            assert len(ent["replicas"]) == 1
+            assert set(ent["nodes"].values()) == {"head"}
+
+            ctrl.set_ingress("nodeA", "127.0.0.1", 9999)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                nxt = sub.poll(timeout=2)
+                if nxt and nxt.get("ingress", {}).get("nodeA"):
+                    doc = nxt
+                    break
+            assert doc["ingress"]["nodeA"] == ["127.0.0.1", 9999]
+
+            # pin the replica to nodeA, then drain it: ONE epoch carries
+            # both removals (replica gone, ingress gone) before the kill
+            rkey = ent["replicas"][0]._actor_id.hex()
+            ctrl._replica_nodes[rkey] = "nodeA"
+            ctrl.drain_node("nodeA", reason="test")
+            # EVERY epoch that shows the node draining must already show
+            # its ingress gone (the pop precedes the draining mark); poll
+            # until the victim replica leaves the node map too
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                nxt = sub.poll(timeout=2)
+                if nxt is None:
+                    continue
+                doc = nxt
+                if "nodeA" in doc.get("draining", []):
+                    assert "nodeA" not in doc.get("ingress", {}), doc
+                if rkey not in doc["deployments"]["EpochDep"]["nodes"]:
+                    break
+            assert "nodeA" in doc["draining"]
+            assert "nodeA" not in doc.get("ingress", {})
+            assert rkey not in doc["deployments"]["EpochDep"]["nodes"]
+            drains = [r for r in flight_recorder.records("serve")
+                      if r["event"] == "node_drain"
+                      and r.get("node_id") == "nodeA"]
+            assert drains and drains[-1]["ingress_dropped"] is True
+        finally:
+            sub.close()
+    finally:
+        from ray_tpu import serve as _s
+
+        _s.shutdown()
+        anatomy.clear()
+
+
+# --------------------------------------- zero-control-plane epoch dispatch
+def test_epoch_router_dispatch_zero_control_plane_rpcs(ray_start_regular):
+    """ACCEPTANCE: per-request dispatch through the epoch-fed handle makes
+    ZERO control-plane RPCs — replica set, node map, and compiled flag all
+    come from the local epoch cache; the request itself is one compiled
+    channel frame (counter-asserted via the wire/local opcounts)."""
+    from ray_tpu import serve
+    from ray_tpu.core.rpc import opcount
+    from ray_tpu.experimental import pubsub
+    from ray_tpu.serve.controller import ServeController
+    from ray_tpu.serve.front_door import _EpochHandle, EpochCache
+
+    anatomy.clear()
+    ctrl = ServeController()
+    try:
+        @serve.deployment(name="FastDep", num_replicas=2,
+                          compiled_dispatch=True)
+        class FastDep:
+            def __call__(self, body):
+                return {"echo": body["x"]}
+
+        ctrl.deploy(FastDep.bind().deployment, "/fast")
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and len(ctrl.get_replicas("FastDep")) < 2):
+            time.sleep(0.05)
+
+        cache = EpochCache()
+        sub = pubsub.subscribe(ctrl.EPOCH_CHANNEL)
+        try:
+            cache.update(sub.poll(timeout=10))  # retained replay
+            h = _EpochHandle(ctrl, "FastDep", cache)
+            # warm: first calls build the per-replica compiled dags
+            for i in range(4):
+                assert ray_tpu.get(h.remote({"x": i}),
+                                   timeout=60)["echo"] == i
+
+            before = opcount.snapshot()
+            for i in range(20):
+                assert ray_tpu.get(h.remote({"x": i}),
+                                   timeout=60)["echo"] == i
+            delta = {k: v for k, v in opcount.delta(before).items()
+                     if k.startswith("rpc:") or k in (
+                         "local:submit_task", "local:submit_actor_task")}
+            assert not delta, f"control-plane traffic on dispatch: {delta}"
+        finally:
+            sub.close()
+    finally:
+        from ray_tpu import serve as _s
+
+        _s.shutdown()
+        anatomy.clear()
+
+
+# ------------------------------------------------------- SLO autoscaler
+class _Harness:
+    """Event-driven autoscaler harness: injected clock, signals, and
+    actuation; a condition variable wakes the test on every decision."""
+
+    def __init__(self, *, min_r=1, max_r=4, up_s=2.0, down_s=10.0,
+                 slo=100.0):
+        from ray_tpu.serve.autoscale import DeploymentAutoscaler
+
+        self.now = 0.0
+        self.pred = {"dep": None}
+        self.target = 1
+        self.running = 1
+        self.auto = {"min_replicas": min_r, "max_replicas": max_r,
+                     "target_ongoing_requests": 2.0,
+                     "upscale_delay_s": up_s, "downscale_delay_s": down_s,
+                     "policy": "slo"}
+        self.slo = slo
+        self.events = []
+        self.cond = threading.Condition()
+        self.sc = DeploymentAutoscaler(
+            None, tick_s=3600.0,
+            predicted_fn=lambda: dict(self.pred),
+            view_fn=self._view, actuate_fn=self._actuate,
+            now_fn=lambda: self.now)
+        self.sc.add_listener(self._on_event)
+
+    def _view(self):
+        return {"dep": {"autoscaling": dict(self.auto), "policy": "slo",
+                        "slo_ttft_ms": self.slo,
+                        "target_replicas": self.target,
+                        "running_replicas": self.running,
+                        "replica_shape": {"CPU": 1.0}}}
+
+    def _actuate(self, dep, target):
+        self.target = target
+
+    def _on_event(self, dep, action, target):
+        with self.cond:
+            self.events.append((dep, action, target))
+            self.cond.notify_all()
+
+    def advance(self, dt, pred):
+        self.now += dt
+        self.pred["dep"] = pred
+        self.sc.tick()
+
+
+def test_autoscaler_scales_up_on_sustained_breach_only():
+    h = _Harness(up_s=2.0)
+    # breach must SUSTAIN: a blip inside the window does not scale
+    h.advance(0.0, 500.0)
+    h.advance(1.0, 500.0)
+    assert h.target == 1 and h.events == []
+    h.advance(0.5, 50.0)   # clears -> breach window resets
+    h.advance(0.5, 500.0)  # breach restarts
+    h.advance(1.0, 500.0)
+    assert h.target == 1
+    h.advance(1.5, 500.0)  # sustained past upscale_delay_s now
+    assert h.target == 2
+    assert h.events == [("dep", "scale_up", 2)]
+    # cooldown: the very next breached tick cannot double-fire
+    h.advance(0.1, 500.0)
+    assert h.target == 2
+    # another full window sustains -> next step, bounded by max_replicas
+    h.advance(2.5, 500.0)
+    assert h.target == 3
+
+
+def test_autoscaler_respects_max_and_scales_down_after_cooldown():
+    h = _Harness(max_r=2, up_s=1.0, down_s=3.0)
+    h.advance(0.0, 500.0)
+    h.advance(1.5, 500.0)
+    assert h.target == 2
+    h.advance(2.0, 500.0)  # at max: no further up
+    assert h.target == 2
+    # clearance (below SLO x 0.5) must sustain downscale_delay_s
+    h.advance(1.0, 10.0)
+    h.advance(2.0, 10.0)
+    assert h.target == 2  # cooldown since last_scale not yet met
+    h.advance(2.0, 10.0)  # sustained + cooled
+    assert h.target == 1
+    assert h.events[-1] == ("dep", "scale_down", 1)
+    # hysteresis band (between 0.5x and 1x SLO): neither window runs
+    h.advance(5.0, 80.0)
+    h.advance(5.0, 80.0)
+    assert h.target == 1
+
+
+def test_autoscaler_registers_standing_demand():
+    """Scale-up registers the deficit's replica shapes with the cluster
+    autoscaler hook; demand clears once running catches the target."""
+    from ray_tpu.autoscaler.autoscaler import standing_demand
+
+    h = _Harness(up_s=1.0)
+    try:
+        h.advance(0.0, 500.0)
+        h.advance(1.5, 500.0)
+        assert h.target == 2
+        pending = standing_demand()
+        assert {"CPU": 1.0} in pending
+        h.running = 2
+        h.advance(0.1, 50.0)  # any tick with running >= target clears
+        assert {"CPU": 1.0} not in standing_demand()
+    finally:
+        h.sc.stop()
+
+
+def test_controller_naive_loop_stands_down_for_slo_policy(ray_start_regular):
+    """AutoscalingConfig(policy="slo"): router load reports are ignored and
+    the stock queue-depth tick skips the deployment — the SLO autoscaler
+    owns the target exclusively; set_target_replicas clamps to bounds."""
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import ServeController
+
+    anatomy.clear()
+    ctrl = ServeController()
+    try:
+        @serve.deployment(name="SloDep", num_replicas=1,
+                          autoscaling_config={"min_replicas": 1,
+                                              "max_replicas": 3,
+                                              "policy": "slo"},
+                          slo_ttft_ms=100.0)
+        class SloDep:
+            def __call__(self, body):
+                return {"ok": True}
+
+        ctrl.deploy(SloDep.bind().deployment, "/slo")
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and len(ctrl.get_replicas("SloDep")) < 1):
+            time.sleep(0.05)
+        # a storm of queue-depth reports must NOT move the target
+        for _ in range(10):
+            ctrl.record_autoscaling_metrics("SloDep", 99.0)
+        ctrl._autoscale_tick()
+        assert ctrl.autoscale_view()["SloDep"]["target_replicas"] == 1
+        assert ctrl.set_target_replicas("SloDep", 2) == 2
+        assert ctrl.set_target_replicas("SloDep", 99) == 3   # clamp hi
+        assert ctrl.set_target_replicas("SloDep", 0) == 1    # clamp lo
+        assert ctrl.set_target_replicas("NoSuchDep", 2) == -1
+    finally:
+        from ray_tpu import serve as _s
+
+        _s.shutdown()
+        anatomy.clear()
+
+
+# --------------------------------------------- 2-node acceptance + chaos
+def test_nonhead_ingress_full_phase_ledger():
+    """ACCEPTANCE: a request entering an ingress on a NON-head node —
+    admission, routing, and dispatch all off the local epoch cache in that
+    node's ingress process — completes with the full 8-phase anatomy
+    ledger folded head-side, phases tagged with the right nodes."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    anatomy.clear()
+    cluster = Cluster(initialize_head=False)
+    try:
+        agent = cluster.add_node(num_cpus=4, real_process=True,
+                                 isolated_plane=True)
+
+        @serve.deployment(name="EngineSim", num_replicas=1,
+                          slo_ttft_ms=5000.0)
+        class EngineSim:
+            def __call__(self, body):
+                from ray_tpu.serve import anatomy as _an
+
+                _an.replica_dequeue(body)
+                rid = _an.rid_of(body)
+                t0 = _an.now_wall()
+                time.sleep(0.02)
+                _an.stamp(rid, "prefill_exec", t0, _an.now_wall())
+                t0 = _an.now_wall()
+                _an.stamp(rid, "kv_publish", t0, _an.now_wall())
+                t0 = _an.now_wall()
+                _an.stamp(rid, "kv_pull", t0, _an.now_wall())
+                time.sleep(0.01)
+                _an.stamp(rid, "decode_first_token", _an.now_wall())
+                return {"tokens": [1, 2, 3]}
+
+        serve.run(EngineSim.bind(), route_prefix="/engine")
+        serve.start_front_door()  # one ingress per live node
+        view = serve.front_door_view()
+        assert agent.hex() in view["ingress"], view
+        host, port = view["ingress"][agent.hex()]["addr"]
+
+        status, out = _post(f"http://{host}:{port}/engine",
+                            {"prompt": "x"}, timeout=120)
+        assert status == 200 and out["result"]["tokens"] == [1, 2, 3], out
+
+        # remote stamps ride the next metrics-push beat; poll for the fold
+        deadline = time.monotonic() + 90
+        row = None
+        while time.monotonic() < deadline:
+            rows = [r for r in anatomy.serve_view()["requests"]
+                    if r["deployment"] == "EngineSim"]
+            if rows and rows[0]["complete"]:
+                row = rows[0]
+                break
+            time.sleep(0.5)
+        assert row is not None, f"ledger never completed: {rows}"
+        assert set(anatomy.PHASES) <= set(row["phases"])
+        t0s = [row["phases"][p]["t0"] for p in anatomy.PHASES]
+        assert all(b >= a for a, b in zip(t0s, t0s[1:])), row["phases"]
+        # the front door really ran on the agent: admission + routing
+        # stamped from the agent's ingress process, the engine on the head
+        nodes = {p: row["phases"][p]["node"] for p in anatomy.PHASES}
+        assert nodes["ingress_admit"] == agent.hex()
+        assert nodes["router_decision"] == agent.hex()
+        assert nodes["prefill_exec"] == "head"
+    finally:
+        from ray_tpu import serve as _s
+
+        _s.shutdown()
+        cluster.shutdown()
+        ray_tpu.shutdown()
+        anatomy.clear()
+
+
+def test_chaos_ingress_node_sigkill_mid_traffic():
+    """ACCEPTANCE/CHAOS: SIGKILL the node hosting one ingress while both
+    are serving. Only requests in flight through the dead node's ingress
+    fail; the surviving ingress keeps serving throughout; the fleet
+    reconciler drops the dead ingress and places one on a replacement
+    node. All waits are condition/event-driven (pubsub polls + events)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu import serve
+    from ray_tpu.experimental import pubsub
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    anatomy.clear()
+    cluster = Cluster(initialize_head=False)
+    try:
+        na = cluster.add_node(num_cpus=2, real_process=True,
+                              isolated_plane=True)
+        nb = cluster.add_node(num_cpus=2, real_process=True,
+                              isolated_plane=True)
+
+        # tiny CPU ask keeps the replica ON THE HEAD even when earlier
+        # tests in the same session hold head CPUs — the chaos under test
+        # is the INGRESS node dying, so the replica must survive the kill
+        @serve.deployment(name="ChaosDep", num_replicas=1,
+                          ray_actor_options={"num_cpus": 0.1})
+        class ChaosDep:
+            def __call__(self, body):
+                return {"ok": True}
+
+        serve.run(ChaosDep.bind(), route_prefix="/chaos")
+        serve.start_front_door()
+        view = serve.front_door_view()
+        assert na.hex() in view["ingress"] and nb.hex() in view["ingress"]
+        url_a = "http://{}:{}/chaos".format(
+            *view["ingress"][na.hex()]["addr"])
+        url_b = "http://{}:{}/chaos".format(
+            *view["ingress"][nb.hex()]["addr"])
+        for u in (url_a, url_b):  # both ingresses serving
+            assert _post(u, {})[0] == 200
+
+        # open-loop traffic through BOTH ingresses from worker threads
+        stop = threading.Event()
+        results = {"a_ok": 0, "a_err": 0, "b_ok": 0, "b_err": 0}
+        lock = threading.Lock()
+
+        def pump(url, okk, errk):
+            while not stop.is_set():
+                try:
+                    ok = _post(url, {}, timeout=5)[0] == 200
+                except Exception:
+                    ok = False
+                with lock:
+                    results[okk if ok else errk] += 1
+
+        threads = [threading.Thread(target=pump,
+                                    args=(url_a, "a_ok", "a_err")),
+                   threading.Thread(target=pump,
+                                    args=(url_b, "b_ok", "b_err"))]
+        for t in threads:
+            t.start()
+
+        epochs = pubsub.subscribe(serve.ServeController.EPOCH_CHANNEL)
+        try:
+            cluster.kill_node(na)  # SIGKILL: agent + its ingress die
+            # wait (condition-driven) for the epoch that drops na's ingress
+            deadline = time.monotonic() + 60
+            dropped = False
+            while time.monotonic() < deadline:
+                doc = epochs.poll(timeout=2)
+                if doc is not None and na.hex() not in doc.get(
+                        "ingress", {}):
+                    dropped = True
+                    break
+            assert dropped, "dead node's ingress never left the epoch"
+        finally:
+            epochs.close()
+
+        # the surviving ingress serves AFTER the kill, strictly more wins
+        with lock:
+            b_ok_at_kill = results["b_ok"]
+        assert _post(url_b, {}, timeout=10)[0] == 200
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert results["b_err"] == 0, results  # blast radius: node A only
+        assert results["b_ok"] > b_ok_at_kill
+        assert results["a_err"] >= 1  # the dead ingress actually failed
+
+        # reconciler: a REPLACEMENT node gets an ingress (nodes-channel
+        # "registered" event drives the spawn; wait on fleet membership)
+        nc = cluster.add_node(num_cpus=2, real_process=True,
+                              isolated_plane=True)
+        deadline = time.monotonic() + 90
+        fleet = {}
+        while time.monotonic() < deadline:
+            fleet = serve.front_door_view()["ingress"]
+            if nc.hex() in fleet and na.hex() not in fleet:
+                break
+            time.sleep(0.5)
+        assert nc.hex() in fleet, fleet
+        assert na.hex() not in fleet, fleet
+        assert _post("http://{}:{}/chaos".format(
+            *fleet[nc.hex()]["addr"]), {})[0] == 200
+    finally:
+        from ray_tpu import serve as _s
+
+        _s.shutdown()
+        cluster.shutdown()
+        ray_tpu.shutdown()
+        anatomy.clear()
