@@ -512,6 +512,12 @@ class ControlPlane:
             from ray_tpu.serve import anatomy
 
             anatomy.ingest_remote(node_hex, source, msg["serve_phases"])
+        if msg.get("mem_report"):
+            # memory-anatomy piggyback: the sender's plane-store ledger
+            # snapshot, merged into the cluster memory view per (node, oid)
+            from ray_tpu.core import mem_anatomy
+
+            mem_anatomy.ingest_remote(node_hex, source, msg["mem_report"])
         if peer.closed:
             # register-after-disconnect: _peer_gone may have already run
             # while this push sat on the reactor — withdraw, or a dead
@@ -519,6 +525,11 @@ class ControlPlane:
             # PR-2 closed for pending_gets)
             peer.meta.pop("metrics_source", None)
             _metrics.drop_remote_snapshot(node_hex, source)
+            import sys as _sys
+
+            _mem = _sys.modules.get("ray_tpu.core.mem_anatomy")
+            if _mem is not None:
+                _mem.drop_remote(node_hex, source)
 
     def _h_preempt_notice(self, peer: RpcPeer, msg: dict):
         """v6: the sending agent's VM got a provider preemption notice —

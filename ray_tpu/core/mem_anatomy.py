@@ -1,0 +1,486 @@
+"""Cluster memory anatomy (ISSUE 18): where did the bytes go.
+
+Head-side join of the plane-store ledger snapshots every agent/worker ships
+on its ``metrics_push`` beat (core/shm_store.mem_report — the ``mem_report``
+piggyback field) with the head's own state: plane directory (copy
+locations), reference counter (who still holds a ref), task table (which
+task/actor sealed the object, and where), and the spill manager. The result
+is ``cluster_memory_view()``: per-object rows (size, copies + nodes, pin
+state, ref state, creator, age) plus per-node store rollups — Ray's
+``ray memory`` + cluster-scope ``list_objects`` capability (PAPER.md
+§L3/L6), and the sensing half of owner-held object metadata (ROADMAP
+"decentralize the head", arxiv 1712.05889).
+
+Merging contract: the native segment is shared, so each PROCESS ledgers
+only its own operations — a worker seals its results, the node agent pins
+primaries. The head merges rows per (node, oid) across sources: max size
+(pin-only rows carry size 0), OR of pin/secondary flags, earliest seal
+stamp. Store totals come only from segment OWNERS, so an agent and its
+workers never double-count one arena.
+
+A rate-limited sweeper runs on ingest and on view calls; it flight-records
+("mem" ring) leak suspects — sealed, unreferenced past
+``RAY_TPU_MEM_LEAK_GRACE_S`` — at-risk objects (referenced, single live
+copy, holder draining) and store-pressure events, so the evidence exists
+even if nobody was watching. Tests wait on a condition variable
+(``wait_until``), never by polling sleeps.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+LEAK_GRACE_S = float(os.environ.get("RAY_TPU_MEM_LEAK_GRACE_S", "30"))
+SWEEP_MIN_S = float(os.environ.get("RAY_TPU_MEM_SWEEP_MIN_S", "2"))
+PRESSURE_FRACTION = 0.9
+_PRESSURE_MIN_S = 30.0
+_OCCUPANCY_MAX = 4096
+
+# (node_hex, source) -> {"mono": monotonic, "wall": wall-clock,
+#                        "store": totals|None, "objects": [ledger rows]}
+_reports: dict[tuple, dict] = {}
+_lock = threading.Lock()
+# Separate condition: wait_until predicates call view functions that take
+# _lock — waking on a condition built over _lock would deadlock them.
+_wake = threading.Condition()
+
+# store-occupancy samples for the Perfetto counter tracks, stamped with the
+# HEAD wall clock at ingest so cross-node clock offsets never enter into it
+_occupancy: deque = deque(maxlen=_OCCUPANCY_MAX)
+
+# sweeper state: first-seen-unreferenced stamps, once-only flags
+_unref_since: dict[str, float] = {}
+_flagged: set = set()
+_at_risk_flagged: set = set()
+_pressure_last: dict[str, float] = {}
+_sweep_last = 0.0
+
+
+def _sane_report(report) -> "dict | None":
+    """Harden the inbound piggyback: a malformed report from one process
+    must not poison the cluster view. Returns the sanitized report or
+    None."""
+    if not isinstance(report, dict):
+        return None
+    store = report.get("store")
+    if store is not None:
+        if not isinstance(store, dict):
+            return None
+        store = {k: int(store.get(k, 0))
+                 for k in ("used", "cap", "num", "evictions")}
+    objects = []
+    for row in report.get("objects") or []:
+        try:
+            oid_bin, nbytes, sealed_at, pinned, secondary, last = row[:6]
+            if not isinstance(oid_bin, bytes):
+                continue
+            objects.append([oid_bin, int(nbytes), float(sealed_at),
+                            1 if pinned else 0, 1 if secondary else 0,
+                            float(last)])
+        except Exception as e:
+            logger.debug("dropping malformed mem_report row: %s", e)
+            continue
+    return {"store": store, "objects": objects}
+
+
+def ingest_remote(node_hex: str, source: str, report) -> None:
+    """Fold one process's mem_report into the head's tables (called from
+    the metrics_push handler). A report is a stateful snapshot: it REPLACES
+    the sender's previous one — there is no cursor to advance."""
+    rep = _sane_report(report)
+    if rep is None:
+        return
+    now_wall = time.time()
+    with _lock:
+        _reports[(node_hex, source)] = {
+            "mono": time.monotonic(), "wall": now_wall,
+            "store": rep["store"], "objects": rep["objects"]}
+        if rep["store"] is not None:
+            pinned = sum(r[1] for r in rep["objects"] if r[3])
+            _occupancy.append((now_wall, node_hex,
+                               rep["store"]["used"], pinned))
+    try:
+        maybe_sweep()
+    except Exception as e:
+        # a sweep bug must not take the push handler down
+        logger.debug("mem sweep failed on ingest: %s", e)
+    with _wake:
+        _wake.notify_all()
+
+
+def drop_remote(node_hex: str, source: Optional[str] = None) -> None:
+    """Withdraw a disconnected process's report (source=None: the whole
+    node died — drop every source it had)."""
+    with _lock:
+        dropped = [_reports.pop(k, None) for k in list(_reports)
+                   if k[0] == node_hex and (source is None or k[1] == source)]
+    del dropped  # report payloads die outside the lock
+    with _wake:
+        _wake.notify_all()
+
+
+def _live_reports() -> list[tuple]:
+    """(node_hex, source, report) triples that are still fresh: a pusher
+    that went quiet for 3 push periods (util/metrics push expiry) is
+    presumed gone and its rows must stop looking live."""
+    from ray_tpu.util import metrics as _metrics
+
+    exp = _metrics._push_expiry_s()
+    now = time.monotonic()
+    with _lock:
+        return [(k[0], k[1], v) for k, v in _reports.items()
+                if exp is None or now - v["mono"] <= exp]
+
+
+def _local_report() -> "dict | None":
+    """The head process has no metrics pusher — sample its own stores
+    directly at view time so head-plane objects appear under "head"."""
+    import sys
+
+    shm = sys.modules.get("ray_tpu.core.shm_store")
+    if shm is None:
+        return None
+    try:
+        return shm.mem_report()
+    except Exception as e:
+        logger.debug("local mem_report failed: %s", e)
+        return None
+
+
+def _merged_rows(rt) -> "tuple[dict, dict]":
+    """Join everything: returns (objects, node_totals) where objects maps
+    oid_bin -> {"size", "sealed_at", "last_access", "pinned", "nodes":
+    {node_hex: {"pinned", "secondary"}}} and node_totals maps node_hex ->
+    owner store totals."""
+    triples = _live_reports()
+    local = _local_report()
+    if local is not None:
+        triples.append(("head", "local",
+                        {"store": local["store"],
+                         "objects": local["objects"], "wall": time.time()}))
+    objects: dict[bytes, dict] = {}
+    node_totals: dict[str, dict] = {}
+    for node_hex, _source, rep in triples:
+        if rep["store"] is not None:
+            tot = node_totals.setdefault(
+                node_hex, {"used": 0, "cap": 0, "num": 0, "evictions": 0})
+            for k in tot:
+                tot[k] += rep["store"][k]
+        for oid_bin, nbytes, sealed_at, pinned, secondary, last in \
+                rep["objects"]:
+            row = objects.get(oid_bin)
+            if row is None:
+                row = objects[oid_bin] = {
+                    "size": 0, "sealed_at": float("inf"), "last_access": 0.0,
+                    "pinned": False, "nodes": {}}
+            row["size"] = max(row["size"], nbytes)
+            if sealed_at:
+                row["sealed_at"] = min(row["sealed_at"], sealed_at)
+            row["last_access"] = max(row["last_access"], last)
+            row["pinned"] = row["pinned"] or bool(pinned)
+            nd = row["nodes"].setdefault(node_hex,
+                                         {"pinned": False, "secondary": False})
+            nd["pinned"] = nd["pinned"] or bool(pinned)
+            nd["secondary"] = nd["secondary"] or bool(secondary)
+    # fold in the plane directory: copies the head routed that no ledger
+    # reported yet (or whose reporter's push hasn't landed)
+    try:
+        with rt._lock:
+            directory = {oid: set(nids) for oid, nids in
+                         rt._plane_locations.items()}
+    except Exception as e:
+        logger.debug("plane directory unavailable: %s", e)
+        directory = {}
+    for oid, nids in directory.items():
+        row = objects.get(oid.binary())
+        if row is None:
+            continue  # directory-only objects have no reported bytes yet
+        for nid in nids:
+            row["nodes"].setdefault(nid.hex(),
+                                    {"pinned": False, "secondary": True})
+    return objects, node_totals
+
+
+def _creator_of(rt, oid) -> "tuple[str, str, str | None]":
+    """(label, kind, node_hex) for the task/actor that made the object —
+    derived from the ObjectID itself (24-byte TaskID prefix, _private/ids),
+    so attribution needs no extra wire traffic."""
+    kind = "put" if oid.is_put() else "task"
+    try:
+        entry = rt._tasks.get(oid.task_id())
+    except Exception as e:
+        logger.debug("creator lookup failed for %s: %s", oid, e)
+        entry = None
+    if entry is None:
+        return ("driver" if kind == "put" else "?", kind, None)
+    spec = entry.spec
+    if spec.actor_id is not None:
+        kind = "actor"
+    node = entry.node_id.hex() if entry.node_id is not None else None
+    return (spec.desc() or "?", kind, node)
+
+
+def cluster_memory_view(limit: int = 1000) -> dict:
+    """The join, as rows. ``{"objects": [...], "nodes": {...},
+    "leak_suspects": [...], "ts": wall}`` — objects sorted biggest-first
+    and capped at ``limit`` (the big rows carry the bytes; a cap that kept
+    the small ones would hide the problem)."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    if not hasattr(rt, "scheduler"):
+        raise RuntimeError(
+            "cluster_memory_view() is head-only: this process holds a "
+            "client runtime; query the head's dashboard at /api/v0/memory")
+    maybe_sweep()
+    objects, node_totals = _merged_rows(rt)
+    refs = rt.reference_counter.all_references()
+    ref_by_bin = {oid.binary(): ref for oid, ref in refs.items()}
+    now = time.time()
+    spill = getattr(rt, "spill", None)
+    rows = []
+    for oid_bin, m in objects.items():
+        oid = ObjectID(oid_bin)
+        if not m["size"]:
+            # pin-only rows (head pinned a pool-worker-sealed primary whose
+            # ledger has no pusher): the memory store knows the size
+            m["size"] = rt.memory_store.size_of(oid) or 0
+        ref = ref_by_bin.get(oid_bin)
+        creator, kind, creator_node = _creator_of(rt, oid)
+        nodes = sorted(m["nodes"])
+        # the primary is the non-secondary copy; pull_into marks pulled
+        # replicas, so an unmarked node holds the sealed original
+        primaries = [n for n, d in m["nodes"].items() if not d["secondary"]]
+        sealed_at = 0.0 if m["sealed_at"] == float("inf") else m["sealed_at"]
+        oid_hex = oid.hex()
+        rows.append({
+            "object_id": oid_hex,
+            "size_bytes": m["size"],
+            "copies": len(m["nodes"]),
+            "nodes": nodes,
+            "primary_node": primaries[0] if primaries else None,
+            "pinned": m["pinned"],
+            "ref_state": "referenced" if ref is not None else "unreferenced",
+            "ref_count": ref.total() if ref is not None else 0,
+            "creator": creator,
+            "creator_kind": kind,
+            "creator_node": creator_node,
+            "age_s": max(0.0, now - sealed_at) if sealed_at else 0.0,
+            "idle_s": (max(0.0, now - m["last_access"])
+                       if m["last_access"] else 0.0),
+            "spilled": bool(spill is not None and spill.is_spilled(oid)),
+            "leak_suspect": oid_hex in _flagged,
+        })
+    rows.sort(key=lambda r: -r["size_bytes"])
+    node_rollup: dict[str, dict] = {}
+    for r in rows:
+        for n in r["nodes"]:
+            agg = node_rollup.setdefault(
+                n, {"objects": 0, "bytes": 0, "pinned_bytes": 0})
+            agg["objects"] += 1
+            agg["bytes"] += r["size_bytes"]
+            if r["pinned"]:
+                agg["pinned_bytes"] += r["size_bytes"]
+    for n, tot in node_totals.items():
+        node_rollup.setdefault(
+            n, {"objects": 0, "bytes": 0, "pinned_bytes": 0}).update(
+            store_used=tot["used"], store_capacity=tot["cap"],
+            store_objects=tot["num"], store_evictions=tot["evictions"])
+    suspects = [r for r in rows if r["leak_suspect"]]
+    return {"objects": rows[:limit], "nodes": node_rollup,
+            "leak_suspects": suspects, "ts": now}
+
+
+def object_plane_index() -> dict:
+    """Cheap oid_hex -> {"size", "copies", "nodes"} map for
+    ``state.list_objects()`` enrichment — reports + directory only, no
+    refs/creator join."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    if not hasattr(rt, "scheduler"):
+        return {}
+    objects, _ = _merged_rows(rt)
+    out = {}
+    for b, m in objects.items():
+        oid = ObjectID(b)
+        size = m["size"] or rt.memory_store.size_of(oid) or 0
+        out[oid.hex()] = {"size": size, "copies": len(m["nodes"]),
+                          "nodes": sorted(m["nodes"])}
+    return out
+
+
+# -------------------------------------------------------------- the sweeper
+def maybe_sweep() -> None:
+    """Rate-limited leak/at-risk/pressure scan (>= SWEEP_MIN_S apart) —
+    runs opportunistically on ingest and on every view call, so flight
+    events fire even with no viewer attached. Head-only; a no-op anywhere
+    else."""
+    global _sweep_last
+    from ray_tpu.core.runtime import get_runtime
+
+    now = time.monotonic()
+    with _lock:
+        if now - _sweep_last < SWEEP_MIN_S:
+            return
+        _sweep_last = now
+    try:
+        rt = get_runtime()
+    except Exception as e:
+        logger.debug("no runtime for mem sweep: %s", e)
+        return
+    if not hasattr(rt, "scheduler"):
+        return
+    _sweep(rt)
+
+
+def _sweep(rt) -> None:
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.util import flight_recorder
+
+    objects, node_totals = _merged_rows(rt)
+    refs = {oid.binary() for oid in rt.reference_counter.all_references()}
+    now = time.time()
+    # the head process has no metrics pusher, so its stores never transit
+    # ingest_remote — sample their occupancy here (sweep cadence) or a
+    # head-only session exports no plane_store_bytes counter track at all
+    local = _local_report()
+    if local is not None and local["store"] is not None:
+        pinned = sum(r[1] for r in local["objects"] if r[3])
+        with _lock:
+            _occupancy.append((now, "head", local["store"]["used"], pinned))
+    draining = set()
+    try:
+        draining = {n.node_id.hex() for n in rt.scheduler.nodes()
+                    if n.draining}
+    except Exception as e:
+        logger.debug("drain state unavailable in mem sweep: %s", e)
+    live_hex = set()
+    fired = False
+    for oid_bin, m in objects.items():
+        oid_hex = ObjectID(oid_bin).hex()
+        live_hex.add(oid_hex)
+        if oid_bin not in refs:
+            # sealed + unreferenced: a leak suspect once it outlives the
+            # grace window (the window absorbs the normal seal->release
+            # race between a worker's report and the head's ref drop)
+            since = _unref_since.setdefault(oid_hex, now)
+            if now - since >= LEAK_GRACE_S and oid_hex not in _flagged:
+                _flagged.add(oid_hex)
+                creator, kind, _node = _creator_of(rt, ObjectID(oid_bin))
+                size = (m["size"]
+                        or rt.memory_store.size_of(ObjectID(oid_bin)) or 0)
+                flight_recorder.record(
+                    "mem", "leak_suspect", object_id=oid_hex,
+                    size_bytes=size, nodes=sorted(m["nodes"]),
+                    creator=creator, creator_kind=kind,
+                    unreferenced_s=round(now - since, 3))
+                fired = True
+        else:
+            # referenced again (borrower registered late): clear both maps
+            # so a future real leak of this oid re-fires
+            _unref_since.pop(oid_hex, None)
+            _flagged.discard(oid_hex)
+            if len(m["nodes"]) == 1 and oid_hex not in _at_risk_flagged:
+                holder = next(iter(m["nodes"]))
+                if holder in draining:
+                    _at_risk_flagged.add(oid_hex)
+                    flight_recorder.record(
+                        "mem", "at_risk_single_copy", object_id=oid_hex,
+                        size_bytes=m["size"], node_id=holder)
+                    fired = True
+    for stale in set(_unref_since) - live_hex:
+        # evicted/deleted between sweeps: no longer anyone's problem
+        _unref_since.pop(stale, None)
+        _flagged.discard(stale)
+        _at_risk_flagged.discard(stale)
+    mono = time.monotonic()
+    for node_hex, tot in node_totals.items():
+        if tot["cap"] and tot["used"] / tot["cap"] >= PRESSURE_FRACTION:
+            last = _pressure_last.get(node_hex, 0.0)
+            if mono - last >= _PRESSURE_MIN_S:
+                _pressure_last[node_hex] = mono
+                flight_recorder.record(
+                    "mem", "store_pressure", node_id=node_hex,
+                    used_bytes=tot["used"], capacity_bytes=tot["cap"],
+                    fraction=round(tot["used"] / tot["cap"], 3))
+                fired = True
+    if fired:
+        with _wake:
+            _wake.notify_all()
+
+
+def wait_until(predicate: Callable[[], bool], timeout: float = 10.0) -> bool:
+    """Block until ``predicate()`` holds or ``timeout`` passes — woken by
+    ingest and by sweep flags, with a 1 s cap per wait so grace-window
+    expiry (pure passage of time, no new event) is still noticed. The
+    predicate runs OUTSIDE every module lock: it may call
+    cluster_memory_view()/flight_records() freely."""
+    deadline = time.monotonic() + timeout
+    while True:
+        maybe_sweep()
+        if predicate():
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        with _wake:
+            _wake.wait(min(remaining, 1.0))
+
+
+# ------------------------------------------------- timeline counter tracks
+def occupancy_nodes() -> set:
+    """Node hexes with at least one occupancy sample — so the timeline
+    export can allocate them named lanes even if they never shipped task
+    events."""
+    with _lock:
+        return {s[1] for s in _occupancy}
+
+
+def trace_counter_events(lane_of: Callable[[str], int]) -> list[dict]:
+    """Perfetto "C" (counter) events — one per ingested occupancy sample,
+    on the owning node's lane: the store-occupancy track next to that
+    node's task spans in the timeline export. Samples were stamped with
+    the HEAD wall clock at ingest, so no cross-node offset applies."""
+    out = []
+    with _lock:
+        samples = list(_occupancy)
+    for wall, node_hex, used, pinned in samples:
+        try:
+            pid = lane_of(node_hex)
+        except Exception as e:
+            logger.debug("no timeline lane for %s: %s", node_hex, e)
+            continue
+        out.append({"ph": "C", "name": "plane_store_bytes", "cat": "mem",
+                    "pid": pid, "tid": 0, "ts": int(wall * 1e6),
+                    "args": {"used": int(used), "pinned": int(pinned)}})
+    return out
+
+
+def _reset_for_tests() -> None:
+    """Drop every table (test isolation only)."""
+    global _sweep_last, _reports, _occupancy, _unref_since, _flagged, \
+        _at_risk_flagged, _pressure_last
+    with _lock:
+        # rebind fresh containers; the old ones die after the lock releases
+        old = (_reports, _occupancy, _unref_since, _flagged,
+               _at_risk_flagged, _pressure_last)
+        _reports = {}
+        _occupancy = deque(maxlen=_OCCUPANCY_MAX)
+        _unref_since = {}
+        _flagged = set()
+        _at_risk_flagged = set()
+        _pressure_last = {}
+        _sweep_last = 0.0
+    del old
+    with _wake:
+        _wake.notify_all()

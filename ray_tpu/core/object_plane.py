@@ -400,6 +400,10 @@ class PlaneClient:
             raise
         if ok:
             store.seal(oid)
+            # pulled copies are SECONDARIES: the sealer elsewhere holds the
+            # primary, and the head's memory view uses this flag to tell
+            # replicas from the authoritative copy (one flag write per pull)
+            store._led_mark_secondary(oid.binary())
             return "sealed"
         if state.get("created"):
             self._abort_or_leak(store, oid, state)

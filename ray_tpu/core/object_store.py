@@ -76,6 +76,14 @@ class MemoryStore:
             except Exception:
                 pass
 
+    def size_of(self, object_id: ObjectID) -> "int | None":
+        """Known payload size (None when absent) — the memory-anatomy size
+        fallback for shm objects whose sealer's ledger lives in a process
+        with no metrics pusher (head-host pool workers)."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+        return getattr(obj, "size", None) if obj is not None else None
+
     def on_ready(self, object_id: ObjectID, cb: Callable) -> None:
         """Invoke cb(RayObject) when the object arrives (immediately if
         present; immediately with an ObjectLostError payload if it was

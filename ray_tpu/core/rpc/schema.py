@@ -409,7 +409,12 @@ register_op(56, "metrics_push", [
     # v9 serve-anatomy piggyback: per-request phase-ledger entries
     # (serve/anatomy.drain_since). Same appended-optional contract —
     # older heads drop it, the push stays since=5.
-    _f("serve_phases", T.ANY)], since=5,
+    _f("serve_phases", T.ANY),
+    # v10 memory-anatomy piggyback: this process's plane-store snapshot
+    # (core/shm_store.mem_report): owner-only store totals + per-entry
+    # ledger rows. Appended-optional, inbound-tolerant — older heads drop
+    # it, the push stays since=5.
+    _f("mem_report", T.ANY)], since=5,
     doc="agent -> head (notify): compact metrics-registry snapshot "
         "(util/metrics.wire_snapshot) + new flight-recorder events + new "
         "timeline entries; the head merges all under the sender's node_id")

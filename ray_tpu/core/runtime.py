@@ -1666,6 +1666,11 @@ class Runtime:
         from ray_tpu.util import metrics as util_metrics
 
         util_metrics.drop_remote_snapshot(node_id.hex())  # all its sources
+        import sys as _sys
+
+        _mem = _sys.modules.get("ray_tpu.core.mem_anatomy")
+        if _mem is not None:  # dead node's store rows must not look live
+            _mem.drop_remote(node_id.hex())
         flight_recorder.record("cluster", "node_dead", node_id=node_id.hex())
         export_events.emit("node", {"node_id": node_id.hex(), "state": "DEAD"})
         # Objects whose only copies lived on the dead node are now lost; the

@@ -4,17 +4,43 @@ Parity: the plasma client (object_manager/plasma/client.cc) — create/seal/get/
 release/delete against the node-local store, zero-copy reads via mmap. Unlike
 plasma there is no store process or socket: every process maps the same segment
 (see shm_store.cpp header comment).
+
+Memory anatomy (ISSUE 18): every store handle keeps an O(1)-maintained
+per-entry ledger (oid, nbytes, sealed_at, pinned, secondary, last-access) of
+the objects THIS process sealed/pinned — the native segment is shared across
+processes, so each process ledgers only its own operations and the head
+merges rows per (node, oid) from the ``mem_report`` snapshots that ride the
+v5 ``metrics_push`` beat (util/metrics.push_once -> core/mem_anatomy.py).
+Every ledger update is ONE dict operation under a small lock — no
+instruments, no RPC, no allocation beyond the row itself — pinned by the
+graftlint ``hot-path-purity`` entry for this module. ``RAY_TPU_MEM_ACCOUNTING=0``
+switches the whole recording path off (the A/B arm).
 """
 
 from __future__ import annotations
 
 import atexit
 import ctypes
+import logging
 import os
+import threading
+import time
+import weakref
 from typing import Optional
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError
+
+logger = logging.getLogger(__name__)
+
+# env-gated so the accounting A/B can switch the whole ledger path off;
+# checked per update as one module-global load (the util/timeline idiom)
+_ACCOUNTING = os.environ.get("RAY_TPU_MEM_ACCOUNTING", "1") != "0"
+# wire cap: a mem_report ships at most this many rows (largest first) so a
+# store full of tiny objects cannot bloat the metrics push
+_REPORT_MAX = int(os.environ.get("RAY_TPU_MEM_REPORT_MAX", "512"))
+# every live store handle in this process; mem_report() walks it
+_stores: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class _Lib:
@@ -86,6 +112,11 @@ class SharedMemoryStore:
         if not self._handle:
             raise RuntimeError(f"failed to create/open shm store {name}")
         self._base = self._lib.shm_store_base(self._handle)
+        # per-entry ledger of THIS process's operations:
+        # oid_bin -> [nbytes, sealed_at_wall, pinned, secondary, last_access]
+        self._ledger: dict[bytes, list] = {}
+        self._ledger_lock = threading.Lock()
+        _stores.add(self)
         atexit.register(self.close)
         # prefault=False: small short-lived stores (e.g. serve KV-transport
         # handoff stores, one per replica) skip the background page-table
@@ -128,6 +159,87 @@ class SharedMemoryStore:
 
         threading.Thread(target=run, daemon=True, name="shm-prefault").start()
 
+    # --- accounting ledger (ISSUE 18) ---
+    # Each update is ONE dict operation under the ledger lock: bind-only /
+    # allocation-light by contract (graftlint hot-path-purity). Recording
+    # is per-SEAL/PIN/GET — whole-object granularity, never per frame.
+    def _led_seal(self, oid_bin: bytes, nbytes: int) -> None:
+        if not _ACCOUNTING:
+            return
+        now = time.time()
+        with self._ledger_lock:
+            self._ledger[oid_bin] = [nbytes, now, 0, 0, now]
+
+    def _led_pin(self, oid_bin: bytes) -> None:
+        if not _ACCOUNTING:
+            return
+        with self._ledger_lock:
+            row = self._ledger.get(oid_bin)
+            if row is None:
+                # pin of an object another process sealed (e.g. the node
+                # agent pinning a worker-sealed primary): partial row — the
+                # head merge takes size from the sealer's report
+                now = time.time()
+                self._ledger[oid_bin] = [0, now, 1, 0, now]
+            else:
+                row[2] = 1
+
+    def _led_release(self, oid_bin: bytes) -> None:
+        if not _ACCOUNTING:
+            return
+        with self._ledger_lock:
+            row = self._ledger.get(oid_bin)
+            if row is not None:
+                row[2] = 0
+
+    def _led_drop(self, oid_bin: bytes) -> None:
+        if not _ACCOUNTING:
+            return
+        with self._ledger_lock:
+            dropped = self._ledger.pop(oid_bin, None)
+        del dropped  # plain ints — but values die OUTSIDE the lock on principle
+
+    def _led_access(self, oid_bin: bytes) -> None:
+        if not _ACCOUNTING:
+            return
+        with self._ledger_lock:
+            row = self._ledger.get(oid_bin)
+            if row is not None:
+                row[4] = time.time()
+
+    def _led_mark_secondary(self, oid_bin: bytes) -> None:
+        """Flag a row as a pulled/replicated copy (object_plane.pull_into
+        seals secondaries through the same create/seal lifecycle)."""
+        if not _ACCOUNTING:
+            return
+        with self._ledger_lock:
+            row = self._ledger.get(oid_bin)
+            if row is not None:
+                row[3] = 1
+
+    def _ledger_rows(self) -> list:
+        """Snapshot this store's ledger as msgpack-native rows
+        ``[oid_bin, nbytes, sealed_at, pinned, secondary, last_access]``,
+        pruning entries the native store no longer holds (deleted/evicted
+        by ANY process — the ledger must not report ghosts forever)."""
+        with self._ledger_lock:
+            items = list(self._ledger.items())
+        out = []
+        dead = []
+        for oid_bin, row in items:
+            if not row[1]:
+                continue  # CREATING slot: not visible until sealed
+            if not self._lib.shm_store_contains(self._handle, oid_bin):
+                dead.append(oid_bin)
+                continue
+            out.append([oid_bin, row[0], row[1], row[2], row[3], row[4]])
+        if dead:
+            with self._ledger_lock:
+                dropped = [self._ledger.pop(oid_bin, None)
+                           for oid_bin in dead]
+            del dropped  # values die outside the ledger lock
+        return out
+
     # --- object lifecycle ---
     def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> None:
         import numpy as np
@@ -149,6 +261,7 @@ class SharedMemoryStore:
             self._lib.shm_store_abort(self._handle, oid.binary())
             raise
         self._lib.shm_store_seal(self._handle, oid.binary())
+        self._led_seal(oid.binary(), len(data))
 
     def put_parts(self, oid: ObjectID, total: int, parts: list) -> None:
         """Scatter-gather put: write pre-laid-out parts (serialization.serialize_parts)
@@ -173,6 +286,7 @@ class SharedMemoryStore:
             self._lib.shm_store_abort(self._handle, oid.binary())
             raise
         self._lib.shm_store_seal(self._handle, oid.binary())
+        self._led_seal(oid.binary(), total)
 
     def create_for_write(self, oid: ObjectID, size: int) -> Optional[memoryview]:
         """Incremental-write API over the native create/seal lifecycle: a
@@ -186,6 +300,10 @@ class SharedMemoryStore:
         off = self._create_slot(oid, size)
         if off is None:
             return None
+        if _ACCOUNTING:
+            # pending row (sealed_at=0): invisible to reports until seal()
+            with self._ledger_lock:
+                self._ledger[oid.binary()] = [size, 0.0, 0, 0, 0.0]
         buf = (ctypes.c_char * size).from_address(self._base + off)
         return memoryview(buf).cast("B")
 
@@ -193,11 +311,30 @@ class SharedMemoryStore:
         """Publish a create_for_write slot: the object becomes immutable and
         readable (native seal wakes blocked getters)."""
         self._lib.shm_store_seal(self._handle, oid.binary())
+        self._led_finish_seal(oid.binary())
+
+    def _led_finish_seal(self, oid_bin: bytes) -> None:
+        if not _ACCOUNTING:
+            return
+        now = time.time()
+        with self._ledger_lock:
+            row = self._ledger.get(oid_bin)
+            if row is not None and not row[1]:
+                row[1] = now
+                row[4] = now
 
     def abort(self, oid: ObjectID) -> None:
         """Retire a create_for_write slot whose fill failed, freeing its
         arena space (plasma's Abort analog). No-op for sealed objects."""
         self._lib.shm_store_abort(self._handle, oid.binary())
+        if _ACCOUNTING:
+            dropped = None
+            with self._ledger_lock:
+                row = self._ledger.get(oid.binary())
+                if row is not None and not row[1]:  # pending only: the
+                    # native abort no-ops on sealed entries, so must we
+                    dropped = self._ledger.pop(oid.binary(), None)
+            del dropped  # dies outside the ledger lock
 
     def _create_slot(self, oid: ObjectID, size: int) -> Optional[int]:
         """Allocate a CREATING entry; returns payload offset, or None if the
@@ -261,6 +398,7 @@ class SharedMemoryStore:
         off = self._lib.shm_store_get(self._handle, oid.binary(), timeout_ms, ctypes.byref(size))
         if not off:
             return None
+        self._led_access(oid.binary())
         buf = (ctypes.c_char * size.value).from_address(self._base + off)
         if os.environ.get("RAY_TPU_SHM_COPY_READS") == "1":
             # bisect/debug mode: copy out and release immediately (no zero-copy,
@@ -279,13 +417,18 @@ class SharedMemoryStore:
 
     def pin(self, oid: ObjectID) -> bool:
         """Hold the object against LRU eviction (one pin per live ObjectRef)."""
-        return bool(self._lib.shm_store_pin(self._handle, oid.binary()))
+        ok = bool(self._lib.shm_store_pin(self._handle, oid.binary()))
+        if ok:
+            self._led_pin(oid.binary())
+        return ok
 
     def release(self, oid: ObjectID) -> None:
         self._lib.shm_store_release(self._handle, oid.binary())
+        self._led_release(oid.binary())
 
     def delete(self, oid: ObjectID) -> None:
         self._lib.shm_store_delete(self._handle, oid.binary())
+        self._led_drop(oid.binary())
 
     def stats(self) -> dict:
         out = (ctypes.c_uint64 * 4)()
@@ -305,6 +448,10 @@ class SharedMemoryStore:
         stop = getattr(self, "_prefault_stop", None)
         if stop is not None:
             stop.set()
+        # a retired store must stop feeding mem_report: the atexit hook
+        # keeps this object alive past runtime shutdown, and its sealed
+        # entries would read as unreferenced "leaks" in the NEXT session
+        _stores.discard(self)
         if self._handle and self.owner:
             self.owner = False
             self._lib.shm_store_unlink(self.name.encode())
@@ -314,3 +461,90 @@ class SharedMemoryStore:
             self.close()
         except Exception:
             pass
+
+
+# --------------------------------------------------- mem_report (ISSUE 18)
+def mem_report() -> "dict | None":
+    """This process's compact memory snapshot for the ``metrics_push``
+    piggyback: per-entry ledger rows (largest first, capped at
+    ``RAY_TPU_MEM_REPORT_MAX``) plus store-level totals. Totals come ONLY
+    from stores this process OWNS (created the segment) — agent and worker
+    processes map the same segment, so owner-only totals keep the head from
+    double-counting a node's arena. Returns None when accounting is off or
+    this process has nothing to report."""
+    if not _ACCOUNTING:
+        return None
+    objects: list = []
+    totals = {"used": 0, "cap": 0, "num": 0, "evictions": 0}
+    owner_seen = False
+    for store in list(_stores):
+        try:
+            objects.extend(store._ledger_rows())
+            if store.owner:
+                s = store.stats()
+                owner_seen = True
+                totals["used"] += int(s["bytes_in_use"])
+                totals["cap"] += int(s["arena_size"])
+                totals["num"] += int(s["num_objects"])
+                totals["evictions"] += int(s["evictions"])
+        except Exception as e:
+            # a closing store must not kill the push
+            logger.debug("mem_report skipped a store: %s", e)
+            continue
+    if not objects and not owner_seen:
+        return None
+    if len(objects) > _REPORT_MAX:
+        objects.sort(key=lambda r: -r[1])  # the big rows carry the bytes
+        objects = objects[:_REPORT_MAX]
+    return {"store": totals if owner_seen else None, "objects": objects}
+
+
+# Store-occupancy gauges (ray_tpu_plane_store_*_bytes): producer-attached —
+# sampled at scrape/push time, zero hot-path cost (util/metrics contract).
+# Remote nodes' values reach the head through the normal metrics_push
+# snapshot and surface on /metrics tagged node_id; spilled bytes live on
+# the SpillManager (core/spill.py attaches that producer).
+def _produce_store_gauges():
+    used = cap = 0.0
+    pinned = 0.0
+    seen_owner = False
+    for store in list(_stores):
+        try:
+            if store.owner:
+                s = store.stats()
+                used += float(s["bytes_in_use"])
+                cap += float(s["arena_size"])
+                seen_owner = True
+            with store._ledger_lock:
+                pinned += float(sum(r[0] for r in store._ledger.values()
+                                    if r[2] and r[1]))
+        except Exception as e:
+            logger.debug("pinned gauge skipped a store: %s", e)
+            continue
+    out = [({}, pinned)] if pinned or seen_owner else []
+    return out
+
+
+def _install_gauges() -> None:
+    from ray_tpu.util import metrics as _metrics
+
+    def _used():
+        vals = [(s.stats()["bytes_in_use"]) for s in list(_stores) if s.owner]
+        return [({}, float(sum(vals)))] if vals else []
+
+    def _cap():
+        vals = [(s.stats()["arena_size"]) for s in list(_stores) if s.owner]
+        return [({}, float(sum(vals)))] if vals else []
+
+    _metrics.Gauge("ray_tpu_plane_store_used_bytes",
+                   "bytes in use across this process's owned plane stores"
+                   ).attach_producer(_used)
+    _metrics.Gauge("ray_tpu_plane_store_capacity_bytes",
+                   "arena capacity across this process's owned plane stores"
+                   ).attach_producer(_cap)
+    _metrics.Gauge("ray_tpu_plane_store_pinned_bytes",
+                   "bytes held by explicit pins this process placed"
+                   ).attach_producer(_produce_store_gauges)
+
+
+_install_gauges()

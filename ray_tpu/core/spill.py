@@ -45,6 +45,30 @@ class SpillManager:
         self._restoring: set[ObjectID] = set()
         self.spilled_bytes_total = 0
         self.restored_bytes_total = 0
+        self._install_spilled_gauge()
+
+    def _install_spilled_gauge(self) -> None:
+        """Producer-attached currently-on-disk gauge (memory anatomy,
+        ISSUE 18): sampled at scrape time, never on the spill path. Weakly
+        bound so an abandoned manager doesn't keep reporting."""
+        import weakref
+
+        from ray_tpu.util import metrics as _metrics
+
+        self_ref = weakref.ref(self)
+
+        def _produce():
+            mgr = self_ref()
+            if mgr is None:
+                return []
+            with mgr._lock:
+                cur = sum(size for _path, size in mgr._spilled.values())
+            return [({}, float(cur))]
+
+        _metrics.Gauge(
+            "ray_tpu_plane_store_spilled_bytes",
+            "bytes currently spilled to disk by this node's spill manager",
+        ).attach_producer(_produce)
 
     # ------------------------------------------------------------ bookkeeping
     def on_put(self, oid: ObjectID, size: int) -> None:

@@ -3,6 +3,8 @@
 Endpoints (reference: dashboard/routes.py + module handlers):
   GET /api/cluster_status  — nodes + resources (reference: ray status)
   GET /api/v0/nodes|actors|tasks|objects|placement_groups — state API
+      (?limit= cap, ?filter=key<op>value where op is = != > < ~)
+  GET /api/v0/memory       — cluster memory anatomy (objects/rollups/leaks)
   GET /api/v0/tasks/summarize , /api/v0/actors/summarize
   GET /api/jobs            — job submission records
   GET /metrics             — Prometheus exposition (util.metrics registry)
@@ -160,7 +162,22 @@ class Dashboard:
                 "available_resources": ray_tpu.available_resources(),
             })
 
+        def _parse_filters(request):
+            """?filter=key=value (repeatable). Ops, longest first so '!='
+            isn't read as '=': != = > < ~ (contains)."""
+            out = []
+            for expr in request.query.getall("filter", []):
+                for tok, op in (("!=", "!="), ("=", "="), (">", ">"),
+                                ("<", "<"), ("~", "contains")):
+                    k, sep, v = expr.partition(tok)
+                    if sep and k:
+                        out.append((k.strip(), op, v.strip()))
+                        break
+            return out or None
+
         async def state_list(request):
+            import inspect
+
             from ray_tpu.util import state as st
 
             resource = request.match_info["resource"]
@@ -173,7 +190,40 @@ class Dashboard:
             }.get(resource)
             if fn is None:
                 return web.json_response({"error": f"unknown resource {resource}"}, status=404)
-            return web.json_response(jsonable(fn()))
+            # pass ?limit=/?filter= through, but only to listers that take
+            # them (list_nodes/list_placement_groups have no filters param)
+            kwargs = {}
+            params = inspect.signature(fn).parameters
+            try:
+                if "limit" in params:
+                    kwargs["limit"] = min(
+                        int(request.query.get("limit", 1000)), 10000)
+            except ValueError:
+                pass
+            filters = _parse_filters(request)
+            if filters and "filters" in params:
+                kwargs["filters"] = filters
+            return web.json_response(jsonable(fn(**kwargs)))
+
+        async def memory(request):
+            """Cluster memory anatomy (util/state.cluster_memory_view):
+            per-object size/copies/pins/refs/creator rows + per-node store
+            rollups + current leak suspects. ?limit= caps object rows."""
+            import asyncio as _aio
+
+            from ray_tpu.util import state as st
+
+            try:
+                limit = min(int(request.query.get("limit", 1000)), 10000)
+            except ValueError:
+                limit = 1000
+            loop = _aio.get_running_loop()
+            try:
+                view = await loop.run_in_executor(
+                    None, lambda: st.cluster_memory_view(limit=limit))
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)[:300]}, status=500)
+            return web.json_response(jsonable(view))
 
         async def task_detail(request):
             from ray_tpu.util import state as st
@@ -460,6 +510,7 @@ class Dashboard:
             app.router.add_get("/api/v0/serve", serve_anatomy)
             app.router.add_get("/api/v0/front_door", front_door)
             app.router.add_get("/api/v0/timeline", timeline)
+            app.router.add_get("/api/v0/memory", memory)
             app.router.add_get("/api/v0/{resource}", state_list)
             app.router.add_get("/api/jobs", jobs)
             app.router.add_post("/api/jobs", job_submit)

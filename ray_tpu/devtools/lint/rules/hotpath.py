@@ -277,6 +277,42 @@ HOT_PATHS = (
         ),
         missing_hint="admission gate renamed? (update HOT_PATHS)",
     ),
+    # ISSUE-18: the plane-store accounting ledger. Every _led_* update is
+    # ONE dict operation under the ledger lock on the put/seal/pull path:
+    # no instruments (the store gauges are producer-attached at import),
+    # no RPC (reports ride the existing metrics_push beat), no control-
+    # plane linkage — and the lifecycle hooks must STAY wired, or the
+    # cluster memory view silently goes blind.
+    HotPath(
+        file="ray_tpu/core/shm_store.py",
+        funcs=("_led_seal", "_led_pin", "_led_release", "_led_drop",
+               "_led_access", "_led_mark_secondary", "_led_finish_seal",
+               "put_bytes", "put_parts", "seal", "pin", "release",
+               "delete", "get_bytes"),
+        reason="per-object plane-store ledger on the put/seal/pull path",
+        ban_metric_record=True,
+        ban_rpc=True,
+        ban_submit=True,
+        forbid_imports=CONTROL_PLANE_IMPORTS,
+        require_calls=(
+            ("put_bytes", ("_led_seal",),
+             "put_bytes no longer ledgers its seal — sealed objects "
+             "vanish from cluster_memory_view"),
+            ("put_parts", ("_led_seal",),
+             "put_parts no longer ledgers its seal — vectored puts "
+             "vanish from cluster_memory_view"),
+            ("seal", ("_led_finish_seal",),
+             "seal no longer finishes its pending ledger row — pulled "
+             "copies vanish from cluster_memory_view"),
+            ("pin", ("_led_pin",),
+             "pin no longer ledgers — pinned bytes read as evictable in "
+             "the memory view and the pinned gauge goes dark"),
+            ("get_bytes", ("_led_access",),
+             "get_bytes no longer stamps last-access — idle-age leak "
+             "triage goes blind"),
+        ),
+        missing_hint="store ledger renamed? (update HOT_PATHS)",
+    ),
     # ISSUE-13: both halves of the stamping pipeline stay wired — the
     # worker ships clocks on the done reply, the pool parent stamps them.
     HotPath(

@@ -507,6 +507,13 @@ def profiler_piggyback_findings(ctx) -> list:
             "metrics_push lost its `serve_phases` field — remote serve "
             "anatomy stamps have no transport (serve/anatomy.py)",
             "field:metrics_push.serve_phases"))
+    if push is not None and "mem_report" not in push.field_map():
+        out.append(ctx.finding(
+            "version-gating", _SCHEMA_REL, 0,
+            "metrics_push lost its `mem_report` field — plane-store "
+            "ledger snapshots have no transport and the cluster memory "
+            "view goes blind to every remote node (core/mem_anatomy.py)",
+            "field:metrics_push.mem_report"))
     return out
 
 

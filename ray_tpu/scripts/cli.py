@@ -35,6 +35,102 @@ def cmd_status(args) -> int:
     print(f"nodes: {len(ray_tpu.nodes())}")
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0):.1f}/{total[k]:.1f} available")
+    # `ray status` parity: pending demand with an infeasible-vs-waiting
+    # verdict per shape (head-local tables; a client attach skips it)
+    try:
+        from ray_tpu.util import state
+
+        asv = state.autoscaler_status_view()
+    except Exception:
+        return 0
+    print("\nDemand:")
+    if not asv["pending_shapes"]:
+        print("  (no pending resource demand)")
+    for g in asv["pending_shapes"]:
+        shape = ", ".join(f"{k}: {v:g}" for k, v in sorted(g["shape"].items()))
+        print(f"  {{{shape}}} x {g['count']}  [{g['source']}]  "
+              f"{g['status'].upper()}")
+        print(f"    {g['reason']}")
+    if asv["standing_demand"]:
+        print(f"  standing demand entries: {len(asv['standing_demand'])}")
+    return 0
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def cmd_memory(args) -> int:
+    """Cluster memory anatomy (`ray memory` parity): where the bytes live,
+    who made them, what still references them, what looks leaked."""
+    from ray_tpu.util import state
+
+    _init_session(args)
+    try:
+        view = state.cluster_memory_view(limit=args.limit)
+    except RuntimeError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    rows = view["objects"]
+    sort_key = {"size": lambda r: -r["size_bytes"],
+                "age": lambda r: -r["age_s"],
+                "copies": lambda r: -r["copies"]}[args.sort_by]
+    rows = sorted(rows, key=sort_key)
+    print("== cluster memory ==")
+    total_bytes = sum(r["size_bytes"] for r in rows)
+    print(f"objects: {len(rows)}  bytes: {_fmt_bytes(total_bytes)}")
+    if args.group_by:
+        group_key = {
+            "creator": lambda r: f"{r['creator_kind']}:{r['creator']}",
+            "node": lambda r: ",".join(r["nodes"]) or "?",
+            "state": lambda r: r["ref_state"],
+        }[args.group_by]
+        groups: dict = {}
+        for r in rows:
+            g = groups.setdefault(group_key(r),
+                                  {"objects": 0, "bytes": 0, "pinned": 0})
+            g["objects"] += 1
+            g["bytes"] += r["size_bytes"]
+            g["pinned"] += 1 if r["pinned"] else 0
+        print(f"\n  {'group':<40} {'objects':>8} {'bytes':>10} {'pinned':>7}")
+        for name, g in sorted(groups.items(), key=lambda kv: -kv[1]["bytes"]):
+            print(f"  {name[:40]:<40} {g['objects']:>8} "
+                  f"{_fmt_bytes(g['bytes']):>10} {g['pinned']:>7}")
+    else:
+        hdr = (f"  {'object_id':<18} {'size':>10} {'copies':>6} {'pin':>4} "
+               f"{'refs':>5} {'age':>8} {'creator':<24} nodes")
+        print("\n" + hdr)
+        for r in rows:
+            pin = "yes" if r["pinned"] else "-"
+            flag = " LEAK?" if r["leak_suspect"] else ""
+            print(f"  {r['object_id'][:16] + '..':<18} "
+                  f"{_fmt_bytes(r['size_bytes']):>10} {r['copies']:>6} "
+                  f"{pin:>4} {r['ref_count']:>5} {r['age_s']:>7.1f}s "
+                  f"{(r['creator_kind'] + ':' + r['creator'])[:24]:<24} "
+                  f"{','.join(n[:8] for n in r['nodes'])}{flag}")
+    if view["nodes"]:
+        print("\nPer-node stores:")
+        for n, agg in sorted(view["nodes"].items()):
+            used = agg.get("store_used")
+            cap = agg.get("store_capacity")
+            occ = (f"  store {_fmt_bytes(used)}/{_fmt_bytes(cap)}"
+                   if used is not None and cap else "")
+            print(f"  {n[:16]:<16} objects={agg['objects']} "
+                  f"bytes={_fmt_bytes(agg['bytes'])} "
+                  f"pinned={_fmt_bytes(agg['pinned_bytes'])}{occ}")
+    if view["leak_suspects"]:
+        print("\nLeak suspects (sealed, unreferenced past grace):")
+        for r in view["leak_suspects"]:
+            print(f"  {r['object_id'][:16]}..  {_fmt_bytes(r['size_bytes'])}"
+                  f"  creator={r['creator_kind']}:{r['creator']}"
+                  f"  nodes={','.join(n[:8] for n in r['nodes'])}")
+    else:
+        print("\nNo leak suspects.")
     return 0
 
 
@@ -255,7 +351,15 @@ def main(argv=None) -> int:
     p.add_argument("--token", default=None, help="session token for --address")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sub.add_parser("status", help="cluster resource status")
+    sub.add_parser("status", help="cluster resource status + pending demand")
+
+    mp = sub.add_parser("memory", help="cluster memory anatomy "
+                        "(`ray memory` parity: sizes, copies, owners, leaks)")
+    mp.add_argument("--sort-by", choices=["size", "age", "copies"],
+                    default="size")
+    mp.add_argument("--group-by", choices=["creator", "node", "state"],
+                    default=None)
+    mp.add_argument("--limit", type=int, default=1000)
 
     lp = sub.add_parser("list", help="list live state")
     lp.add_argument("resource", choices=["tasks", "actors", "nodes", "objects", "placement-groups"])
@@ -295,6 +399,8 @@ def main(argv=None) -> int:
         return cmd_stop(args)
     if args.cmd == "status":
         return cmd_status(args)
+    if args.cmd == "memory":
+        return cmd_memory(args)
     if args.cmd == "list":
         return cmd_list(args)
     if args.cmd == "summary":

@@ -518,8 +518,19 @@ def push_once(peer, cursor) -> dict:
     anatomy = sys.modules.get("ray_tpu.serve.anatomy")
     if anatomy is not None:
         serve_phases, sv_cursor = anatomy.drain_since(sv_cursor)
+    # memory-anatomy piggyback: same sys.modules gate — only processes that
+    # already mapped a plane store carry a ledger, and mem_report() is a
+    # stateful snapshot (no cursor: the head replaces the previous report).
+    mem = None
+    shm = sys.modules.get("ray_tpu.core.shm_store")
+    if shm is not None:
+        try:
+            mem = shm.mem_report()
+        except Exception:
+            mem = None  # a closing store must not take the push down
     peer.notify("metrics_push", snap=wire_snapshot(), events=events or None,
-                phases=phases or None, serve_phases=serve_phases or None)
+                phases=phases or None, serve_phases=serve_phases or None,
+                mem_report=mem)
     return {"flight": fl_cursor, "timeline": tl_cursor, "serve": sv_cursor}
 
 

@@ -47,23 +47,50 @@ def list_nodes(limit: int = 1000) -> list[dict]:
     ][:limit]
 
 
-def list_objects(limit: int = 1000) -> list[dict]:
+def list_objects(filters: Optional[list] = None,
+                 limit: int = 1000) -> list[dict]:
     rt = get_runtime()
+    # plane enrichment (memory anatomy): size/copies/locations per object
+    # from the merged store reports + directory — best-effort, the ref
+    # listing must keep working on a head with no plane at all
+    try:
+        from ray_tpu.core import mem_anatomy
+
+        plane = mem_anatomy.object_plane_index()
+    except Exception:
+        plane = {}
     out = []
     for oid, ref in rt.reference_counter.all_references().items():
+        oid_hex = oid.hex()
+        p = plane.get(oid_hex)
         out.append(
             {
-                "object_id": oid.hex(),
+                "object_id": oid_hex,
                 "local_refs": ref.local_refs,
                 "submitted_task_refs": ref.submitted_task_refs,
                 "lineage_refs": ref.lineage_refs,
                 "pinned": ref.pinned,
                 "in_store": rt.memory_store.contains(oid),
+                "size_bytes": p["size"] if p else None,
+                "plane_copies": p["copies"] if p else 0,
+                "plane_nodes": p["nodes"] if p else [],
             }
         )
-        if len(out) >= limit:
-            break
-    return out
+    # newest entries win the cap (ref registration order is insertion
+    # order) — same contract as list_tasks: a session that has made >limit
+    # objects must still surface CURRENT ones, not the oldest thousand
+    return _apply_filters(out, filters)[-limit:]
+
+
+def cluster_memory_view(limit: int = 1000) -> dict:
+    """Cluster memory anatomy (ISSUE 18): per-object rows — size, copy
+    count + nodes, pin state, ref state, creator task/actor and node, age —
+    joined from the per-process store ledgers shipped on metrics_push,
+    plus per-node store rollups and the sweeper's current leak suspects.
+    Head-only (served at /api/v0/memory); the `ray_tpu memory` CLI face."""
+    from ray_tpu.core import mem_anatomy
+
+    return mem_anatomy.cluster_memory_view(limit)
 
 
 def list_placement_groups(limit: int = 1000) -> list[dict]:
@@ -228,7 +255,18 @@ def timeline(path: str | None = None) -> list[dict]:
     return tl.export(path)
 
 
+def _num(v) -> "float | None":
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
 def _apply_filters(rows: list[dict], filters) -> list[dict]:
+    """(key, op, value) predicate table over state rows. Ops: ``=`` / ``!=``
+    (string equality), ``>`` / ``<`` (numeric — rows whose value doesn't
+    coerce to a number are dropped, so `size_bytes > 1e6` never matches a
+    None), ``contains`` (case-insensitive substring)."""
     if not filters:
         return rows
     for key, op, value in filters:
@@ -236,4 +274,76 @@ def _apply_filters(rows: list[dict], filters) -> list[dict]:
             rows = [r for r in rows if str(r.get(key)) == str(value)]
         elif op == "!=":
             rows = [r for r in rows if str(r.get(key)) != str(value)]
+        elif op in (">", "<"):
+            bound = _num(value)
+            if bound is None:
+                rows = []
+                continue
+            keep = []
+            for r in rows:
+                got = _num(r.get(key))
+                if got is None:
+                    continue
+                if (got > bound) if op == ">" else (got < bound):
+                    keep.append(r)
+            rows = keep
+        elif op == "contains":
+            needle = str(value).lower()
+            rows = [r for r in rows if needle in str(r.get(key)).lower()]
     return rows
+
+
+def autoscaler_status_view() -> dict:
+    """`ray status` parity for the CLI: pending resource shapes (queued
+    tasks, pending placement-group bundles, standing demand), grouped,
+    each marked ``waiting`` (some alive node could EVER hold the shape —
+    it's a capacity queue) or ``infeasible`` (no alive node's TOTAL
+    resources fit it — it will never schedule on the current cluster),
+    with a human reason line. Mirrors the autoscaler's ``_feasible_now``
+    capacity test, not instantaneous availability."""
+    rt = get_runtime()
+    from ray_tpu.autoscaler.autoscaler import standing_demand
+
+    shapes: list[tuple[dict, str]] = []
+    with rt._lock:
+        for entry in rt._tasks.values():
+            if entry.state == "PENDING" and entry.spec.resources:
+                shapes.append((dict(entry.spec.resources), "task"))
+    for pg in rt.scheduler.placement_groups():
+        if pg.state == "PENDING":
+            for b in pg.bundles:
+                shapes.append((dict(b.resources), "placement_group"))
+    standing = standing_demand()
+    for s in standing:
+        shapes.append((dict(s), "standing"))
+    nodes = [n for n in rt.scheduler.nodes() if n.alive]
+    grouped: dict[tuple, dict] = {}
+    for shape, source in shapes:
+        key = (tuple(sorted(shape.items())), source)
+        g = grouped.get(key)
+        if g is None:
+            feasible = any(
+                all(n.total.get(k, 0.0) >= v for k, v in shape.items())
+                for n in nodes)
+            if feasible:
+                reason = "waiting for resources to free up"
+            else:
+                biggest = {}
+                for k in shape:
+                    biggest[k] = max(
+                        (n.total.get(k, 0.0) for n in nodes), default=0.0)
+                lacking = ", ".join(
+                    f"{k}: need {shape[k]:g}, largest node has "
+                    f"{biggest[k]:g}" for k in sorted(shape)
+                    if biggest[k] < shape[k])
+                reason = (f"infeasible on current nodes ({lacking})"
+                          if lacking else "infeasible on current nodes")
+            g = grouped[key] = {
+                "shape": dict(shape), "source": source, "count": 0,
+                "status": "waiting" if feasible else "infeasible",
+                "reason": reason}
+        g["count"] += 1
+    return {"pending_shapes": sorted(
+                grouped.values(),
+                key=lambda g: (g["status"], g["source"])),
+            "standing_demand": standing}

@@ -421,9 +421,19 @@ def export(path: Optional[str] = None) -> list[dict]:
     rt = get_runtime_or_none()
     events = rt.task_events() if hasattr(rt, "task_events") else []
 
+    import sys as _sys
+
     trace: list[dict] = []
     exec_flow: dict[str, dict] = {}
     node_hexes = [t[0] for t in remote_events()]
+    # nodes known only through store-occupancy samples (memory anatomy)
+    # still deserve their own named lane for the counter track
+    _mem = _sys.modules.get("ray_tpu.core.mem_anatomy")
+    if _mem is not None:
+        try:
+            node_hexes += list(_mem.occupancy_nodes())
+        except Exception:
+            pass
     lanes = _node_lanes(node_hexes)
 
     _head_transition_events(events, trace, exec_flow)
@@ -438,13 +448,20 @@ def export(path: Optional[str] = None) -> list[dict]:
         # (serve/anatomy.py, ISSUE 16) — already offset-aligned via this
         # module's clock_offsets; lazy so non-serve sessions never import
         # the serve package here
-        import sys as _sys
-
         _an = _sys.modules.get("ray_tpu.serve.anatomy")
         if _an is not None:
             trace.extend(_an.trace_events())
     except Exception:
         pass  # a malformed ledger must not break the whole export
+    try:
+        # per-node plane-store occupancy counter tracks (memory anatomy,
+        # ISSUE 18): samples carry head wall stamps from ingest time, so
+        # they need no cross-node offset alignment
+        if _mem is not None:
+            trace.extend(_mem.trace_counter_events(
+                lambda nh: lanes.get(nh, _HEAD_PID)))
+    except Exception:
+        pass
     trace.sort(key=lambda e: e.get("ts", 0))
     if path:
         import json

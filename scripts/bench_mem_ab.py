@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Interleaved memory-accounting overhead A/B (MICROBENCH.md round 16).
+
+Measures the ISSUE-18 store-ledger cost on the two paths it rides:
+
+1. ``plane_pull_64mb`` — MB/s of a 64 MB ``PlaneClient.pull_into`` landing
+   in a local store over a live loopback plane server (the seal +
+   mark-secondary ledger sites fire once per pulled object);
+2. ``shuffle`` — rows/s of a full ``Dataset.random_shuffle`` exchange
+   through a live session (every block put/pin/get crosses the ledger).
+
+Accounting is a module-import gate (``RAY_TPU_MEM_ACCOUNTING``), so each
+arm runs in a FRESH process; interleave arms by alternating invocations:
+
+    python scripts/bench_mem_ab.py --arm on
+    python scripts/bench_mem_ab.py --arm off
+
+Single-run numbers on a shared core are noise — compare medians across
+3 alternating rounds per arm.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def bench_pull(size_mb: int, repeats: int) -> list[float]:
+    import numpy as np
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.core.object_plane import ObjectPlaneServer, PlaneClient
+    from ray_tpu.core.shm_store import SharedMemoryStore
+
+    nbytes = size_mb << 20
+    slack = 16 << 20
+    tag = f"{os.getpid()}_{size_mb}"
+    # sized for every repeat: the plane server's read pin defers each
+    # delete, so per-repeat space is not reliably back before the next put
+    src = SharedMemoryStore(f"/rtpu_memab_src_{tag}",
+                            size=repeats * nbytes + slack, owner=True)
+    dst = SharedMemoryStore(f"/rtpu_memab_dst_{tag}",
+                            size=repeats * nbytes + slack, owner=True)
+    server = ObjectPlaneServer(src)
+    client = PlaneClient()
+    try:
+        payload = np.random.default_rng(0).bytes(nbytes)
+        rates = []
+        for _ in range(repeats):
+            oid = ObjectID(os.urandom(ObjectID.SIZE))
+            src.put_bytes(oid, payload)
+            t0 = time.perf_counter()
+            status = client.pull_into([server.address], oid, dst)
+            dt = time.perf_counter() - t0
+            assert status == "sealed", status
+            rates.append(round(nbytes / dt / 1e6, 1))
+            src.delete(oid)
+        return rates
+    finally:
+        client.close()
+        server.close()
+        src.close()
+        dst.close()
+
+
+def bench_shuffle(rows: int, repeats: int) -> list[float]:
+    import ray_tpu
+    from ray_tpu import data as rdata
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        # warm: pool spawn + import cost stays out of the measured rounds
+        rdata.range(200, parallelism=4).random_shuffle(seed=0).take_all()
+        rates = []
+        for i in range(repeats):
+            t0 = time.perf_counter()
+            out = rdata.range(rows, parallelism=8) \
+                       .random_shuffle(seed=i).take_all()
+            dt = time.perf_counter() - t0
+            assert len(out) == rows
+            rates.append(round(rows / dt, 1))
+        return rates
+    finally:
+        ray_tpu.shutdown()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arm", choices=("on", "off"), required=True)
+    ap.add_argument("--size-mb", type=int, default=64)
+    ap.add_argument("--rows", type=int, default=20000)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    os.environ["RAY_TPU_MEM_ACCOUNTING"] = "1" if args.arm == "on" else "0"
+    pull = bench_pull(args.size_mb, args.repeats)
+    shuffle = bench_shuffle(args.rows, args.repeats)
+    print(json.dumps({
+        "arm": args.arm,
+        "plane_pull_mb_per_s": pull,
+        "plane_pull_median": round(statistics.median(pull), 1),
+        "shuffle_rows_per_s": shuffle,
+        "shuffle_median": round(statistics.median(shuffle), 1),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.append(os.getcwd())
+    sys.exit(main())

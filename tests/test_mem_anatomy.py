@@ -1,0 +1,546 @@
+"""Cluster memory anatomy tests (ISSUE 18): store-ledger accounting +
+mem_report snapshots, head-side ingest/join (`cluster_memory_view`), leak
+detection through the "mem" flight ring, the state-filter op table, the
+dashboard /api/v0/memory + /api/v0/objects endpoints, `ray status`
+autoscaler parity, and the 2-node remote-attribution acceptance.
+
+Reference analogs: `ray memory` / cluster-scope `list_objects`
+(python/ray/util/state) and the plasma store's per-object accounting.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import ObjectID
+
+
+@pytest.fixture
+def session():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def mem_reset():
+    from ray_tpu.core import mem_anatomy
+
+    mem_anatomy._reset_for_tests()
+    yield mem_anatomy
+    mem_anatomy._reset_for_tests()
+
+
+# ------------------------------------------------------------ filter table
+def test_apply_filters_op_table():
+    from ray_tpu.util.state import _apply_filters
+
+    rows = [
+        {"name": "alpha", "size_bytes": 100, "state": "RUNNING"},
+        {"name": "beta", "size_bytes": 5000, "state": "FINISHED"},
+        {"name": "Gamma", "size_bytes": None, "state": "FINISHED"},
+    ]
+    assert [r["name"] for r in _apply_filters(rows, [("state", "=",
+                                                      "FINISHED")])] == \
+        ["beta", "Gamma"]
+    assert [r["name"] for r in _apply_filters(rows, [("state", "!=",
+                                                      "FINISHED")])] == \
+        ["alpha"]
+    # numeric ops drop rows whose value doesn't coerce (None never matches)
+    assert [r["name"] for r in _apply_filters(rows, [("size_bytes", ">",
+                                                      "200")])] == ["beta"]
+    assert [r["name"] for r in _apply_filters(rows, [("size_bytes", "<",
+                                                      "200")])] == ["alpha"]
+    # contains is case-insensitive substring
+    assert [r["name"] for r in _apply_filters(rows, [("name", "contains",
+                                                      "GAM")])] == ["Gamma"]
+    # ops chain (AND)
+    assert _apply_filters(rows, [("state", "=", "FINISHED"),
+                                 ("size_bytes", ">", "0")])[0]["name"] == \
+        "beta"
+    # non-numeric bound for a numeric op matches nothing rather than lying
+    assert _apply_filters(rows, [("size_bytes", ">", "banana")]) == []
+
+
+def test_state_listers_accept_filters(session):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def tiny():
+        return 1
+
+    ref = tiny.remote()
+    assert ray_tpu.get(ref, timeout=120) == 1
+    done = state.list_tasks(filters=[("state", "=", "FINISHED"),
+                                     ("name", "contains", "tiny")])
+    assert done and all(t["name"] == "tiny" for t in done)
+    assert state.list_tasks(filters=[("name", "=", "no-such-task")]) == []
+    assert isinstance(state.list_actors(filters=[("state", "!=", "DEAD")]),
+                      list)
+    objs = state.list_objects(filters=[("plane_copies", ">", "-1")])
+    assert isinstance(objs, list)
+    del ref
+
+
+def test_list_objects_newest_win_and_plane_columns(session):
+    """Satellite: over-limit keeps the NEWEST rows (list_tasks contract),
+    and rows carry the plane columns."""
+    from ray_tpu.util import state
+
+    refs = [ray_tpu.put(i) for i in range(8)]
+    rows = state.list_objects(limit=3)
+    assert len(rows) == 3
+    all_rows = state.list_objects()
+    # the capped listing is the TAIL of the full listing, not the head
+    assert [r["object_id"] for r in rows] == \
+        [r["object_id"] for r in all_rows[-3:]]
+    for col in ("size_bytes", "plane_copies", "plane_nodes"):
+        assert col in rows[0]
+    del refs
+
+
+# ------------------------------------------------------- ledger + report
+def test_store_ledger_tracks_lifecycle():
+    from ray_tpu.core import shm_store as sm
+
+    store = sm.SharedMemoryStore(f"/rtpu_memt_{os.getpid()}",
+                                 size=32 << 20, owner=True)
+    try:
+        oid = ObjectID.from_random()
+        store.put_bytes(oid, b"x" * (1 << 20))
+        rows = store._ledger_rows()
+        row = next(r for r in rows if r[0] == oid.binary())
+        assert row[1] == (1 << 20) and row[2] > 0  # size, sealed stamp
+        assert row[3] == 0 and row[4] == 0          # unpinned, primary
+        assert store.pin(oid)
+        row = next(r for r in store._ledger_rows()
+                   if r[0] == oid.binary())
+        assert row[3] == 1
+        store._led_mark_secondary(oid.binary())
+        row = next(r for r in store._ledger_rows()
+                   if r[0] == oid.binary())
+        assert row[4] == 1
+        # last-access stamps on read
+        before = row[5]
+        time.sleep(0.01)
+        view = store.get_bytes(oid)
+        assert view is not None
+        row = next(r for r in store._ledger_rows()
+                   if r[0] == oid.binary())
+        assert row[5] > before
+        del view  # read pin drops with the buffer (GC-tied finalizer)
+        store.release(oid)
+        store.delete(oid)
+        assert all(r[0] != oid.binary() for r in store._ledger_rows())
+
+        # mem_report: owner totals + rows, biggest-first under the cap
+        small = ObjectID.from_random()
+        big = ObjectID.from_random()
+        store.put_bytes(small, b"s" * 1024)
+        store.put_bytes(big, b"b" * (2 << 20))
+        rep = sm.mem_report()
+        assert rep is not None and rep["store"] is not None
+        # cap is the usable arena (net of the native entry table)
+        assert rep["store"]["used"] > 0 and rep["store"]["cap"] > (16 << 20)
+        sizes = {r[0]: r[1] for r in rep["objects"]}
+        assert sizes.get(big.binary()) == (2 << 20)
+        assert sizes.get(small.binary()) == 1024
+    finally:
+        store.close()
+
+
+def test_pending_rows_invisible_and_abort_prunes():
+    from ray_tpu.core import shm_store as sm
+
+    store = sm.SharedMemoryStore(f"/rtpu_memp_{os.getpid()}",
+                                 size=16 << 20, owner=True)
+    try:
+        oid = ObjectID.from_random()
+        view = store.create_for_write(oid, 4096)
+        assert view is not None
+        # CREATING slots never ship (a half-written object is not memory
+        # anatomy can attribute yet)
+        assert all(r[0] != oid.binary() for r in store._ledger_rows())
+        del view
+        store.abort(oid)
+        with store._ledger_lock:
+            assert oid.binary() not in store._ledger
+        # abort after seal must NOT drop the ledger row (native abort
+        # no-ops on sealed entries)
+        sealed = ObjectID.from_random()
+        store.put_bytes(sealed, b"z" * 512)
+        store.abort(sealed)
+        assert any(r[0] == sealed.binary() for r in store._ledger_rows())
+    finally:
+        store.close()
+
+
+def test_mem_report_accounting_off_env():
+    """RAY_TPU_MEM_ACCOUNTING=0 (the A/B arm) disables the ledger and the
+    report entirely — checked in a subprocess because the flag binds at
+    import."""
+    import subprocess
+
+    code = (
+        "import os\n"
+        "from ray_tpu.core import shm_store as sm\n"
+        "from ray_tpu._private.ids import ObjectID\n"
+        "s = sm.SharedMemoryStore('/rtpu_memoff_%d', size=16<<20, "
+        "owner=True)\n"
+        "s.put_bytes(ObjectID.from_random(), b'x' * 1024)\n"
+        "assert not s._ledger, 'ledger must stay empty when off'\n"
+        "assert sm.mem_report() is None\n"
+        "s.close()\n"
+        "print('OK')\n" % os.getpid())
+    env = dict(os.environ, RAY_TPU_MEM_ACCOUNTING="0", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0 and "OK" in out.stdout, out.stderr
+
+
+# ------------------------------------------------------------- head ingest
+def test_ingest_sanitize_and_drop(mem_reset):
+    mem = mem_reset
+    good = [b"a" * 28, 4096, time.time(), 1, 0, time.time()]
+    report = {"store": {"used": 4096, "cap": 1 << 20, "num": 1,
+                        "evictions": 0},
+              "objects": [good,
+                          ["not-bytes-oid", 1, 2, 3, 4, 5],   # dropped
+                          [b"b" * 28],                        # short: dropped
+                          "garbage"]}                         # dropped
+    mem.ingest_remote("nodeaa", "worker-1", report)
+    with mem._lock:
+        rep = mem._reports[("nodeaa", "worker-1")]
+    assert len(rep["objects"]) == 1
+    assert rep["objects"][0][0] == b"a" * 28
+    assert rep["store"]["used"] == 4096
+    # junk report types are rejected whole
+    mem.ingest_remote("nodeaa", "worker-2", ["not", "a", "dict"])
+    with mem._lock:
+        assert ("nodeaa", "worker-2") not in mem._reports
+    # occupancy sample landed for the counter track
+    assert "nodeaa" in mem.occupancy_nodes()
+    events = mem.trace_counter_events(lambda nh: 42)
+    assert events and events[0]["ph"] == "C" and events[0]["pid"] == 42
+    # withdrawal drops the source
+    mem.drop_remote("nodeaa", "worker-1")
+    with mem._lock:
+        assert not mem._reports
+
+
+def test_cluster_memory_view_needs_runtime():
+    from ray_tpu.core import mem_anatomy
+    from ray_tpu.core.runtime import get_runtime_or_none
+
+    if get_runtime_or_none() is not None:
+        pytest.skip("a live head runtime exists in this process")
+    with pytest.raises(RuntimeError):
+        mem_anatomy.cluster_memory_view()
+
+
+# ------------------------------------------- attribution + leak detection
+def test_attribution_and_leak_flip_local(session, mem_reset, monkeypatch):
+    """Head-local acceptance half: a worker-made object is attributed to
+    its creating task; an orphan seal (bytes in the store, no reference)
+    flips to leak-suspect after the grace window and fires a "mem" flight
+    event — condition-variable waits throughout, no sleep polling."""
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.util import state
+
+    mem = mem_reset
+    monkeypatch.setattr(mem, "LEAK_GRACE_S", 0.5)
+    monkeypatch.setattr(mem, "SWEEP_MIN_S", 0.05)
+
+    @ray_tpu.remote
+    def make_block():
+        return np.ones(4 << 20, dtype=np.uint8)
+
+    ref = make_block.remote()
+    arr = ray_tpu.get(ref, timeout=120)
+    assert arr.nbytes == (4 << 20)
+    oid_hex = ref.object_id().hex()
+
+    def attributed():
+        rows = {r["object_id"]: r
+                for r in state.cluster_memory_view()["objects"]}
+        r = rows.get(oid_hex)
+        # size is the serialized blob (array + pickle framing): >= payload
+        return bool(r and r["creator"] == "make_block"
+                    and r["creator_kind"] == "task"
+                    and r["size_bytes"] >= (4 << 20)
+                    and r["ref_state"] == "referenced")
+    assert mem.wait_until(attributed, timeout=20), \
+        state.cluster_memory_view()["objects"]
+
+    # referenced objects never flag, even past grace
+    assert not mem.wait_until(
+        lambda: any(r["leak_suspect"]
+                    for r in state.cluster_memory_view()["objects"]),
+        timeout=1.5)
+
+    # orphan: sealed bytes nobody references — THE leak shape
+    rt = get_runtime()
+    if rt.shm_store is None:
+        pytest.skip("native shm store unavailable")
+    orphan = ObjectID.from_random()
+    rt.shm_store.put_bytes(orphan, b"L" * (1 << 20))
+    assert mem.wait_until(
+        lambda: any(r["object_id"] == orphan.hex() and r["leak_suspect"]
+                    for r in state.cluster_memory_view()["objects"]),
+        timeout=20)
+    recs = state.flight_records("mem")
+    leak_evs = [e for e in recs if e["event"] == "leak_suspect"
+                and e["object_id"] == orphan.hex()]
+    assert leak_evs and leak_evs[0]["size_bytes"] == (1 << 20)
+    # the suspect surfaces in the view's dedicated section
+    assert any(r["object_id"] == orphan.hex()
+               for r in state.cluster_memory_view()["leak_suspects"])
+
+    # killing the last reference of the HEALTHY object removes it cleanly
+    # (negative control: release is not a leak)
+    del ref, arr
+    import gc
+
+    gc.collect()
+    assert mem.wait_until(
+        lambda: oid_hex not in {
+            r["object_id"]
+            for r in state.cluster_memory_view()["objects"]},
+        timeout=20)
+    rt.shm_store.delete(orphan)
+
+
+# --------------------------------------------------------------- dashboard
+def test_dashboard_memory_and_objects_endpoints(session, mem_reset):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard.head import Dashboard
+
+    refs = [ray_tpu.put(np.ones(1 << 18, dtype=np.uint8))
+            for _ in range(3)]
+    dash = Dashboard(port=8274)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:8274{path}", timeout=10) as r:
+                return json.loads(r.read())
+
+        view = get("/api/v0/memory")
+        for key in ("objects", "nodes", "leak_suspects", "ts"):
+            assert key in view
+        assert "head" in view["nodes"]
+
+        capped = get("/api/v0/memory?limit=1")
+        assert len(capped["objects"]) <= 1
+
+        objs = get("/api/v0/objects")
+        assert len(objs) >= 3
+        capped_ids = [o["object_id"]
+                      for o in get("/api/v0/objects?limit=2")]
+        assert capped_ids == [o["object_id"] for o in objs[-2:]]  # newest win
+        # filter ops over the wire: = > ~ (contains)
+        some_id = objs[-1]["object_id"]
+        hit = get(f"/api/v0/objects?filter=object_id={some_id}")
+        assert len(hit) == 1 and hit[0]["object_id"] == some_id
+        assert get("/api/v0/objects?filter=plane_copies>999") == []
+        sub = some_id[:12]
+        assert any(o["object_id"] == some_id
+                   for o in get(f"/api/v0/objects?filter=object_id~{sub}"))
+        # tasks keep working through the same query plumbing
+        assert isinstance(get("/api/v0/tasks?filter=state=FINISHED"), list)
+    finally:
+        dash.stop()
+        del refs
+
+
+# ----------------------------------------------------- status parity (CLI)
+def test_autoscaler_status_view(session):
+    from ray_tpu.autoscaler import autoscaler as asc
+    from ray_tpu.util import state
+
+    asc.register_standing_demand("memtest", [{"CPU": 1.0}])
+    try:
+        @ray_tpu.remote(resources={"no_such_accel": 4.0})
+        def never_runs():
+            return 0
+
+        ref = never_runs.remote()
+        try:
+            view = state.autoscaler_status_view()
+            groups = view["pending_shapes"]
+            standing = [g for g in groups if g["source"] == "standing"
+                        and g["shape"] == {"CPU": 1.0}]
+            assert standing and standing[0]["status"] == "waiting"
+            assert "waiting" in standing[0]["reason"]
+            # the task shape carries the implicit CPU:1 plus the accel
+            infeas = [g for g in groups if g["source"] == "task"
+                      and "no_such_accel" in g["shape"]]
+            assert infeas and infeas[0]["status"] == "infeasible"
+            assert "infeasible" in infeas[0]["reason"]
+            assert "no_such_accel" in infeas[0]["reason"]
+            assert infeas[0]["count"] >= 1
+            assert {"CPU": 1.0} in view["standing_demand"]
+        finally:
+            ray_tpu.cancel(ref, force=True)
+    finally:
+        asc.clear_standing_demand("memtest")
+
+
+def test_cli_status_and_memory_render(session, mem_reset, capsys):
+    """The CLI faces render without a live subprocess: status shows the
+    demand section, memory shows the table + rollups + leak section."""
+    from ray_tpu.scripts import cli
+
+    ref = ray_tpu.put(np.ones(1 << 18, dtype=np.uint8))
+    assert cli.main(["status"]) == 0
+    out = capsys.readouterr().out
+    assert "Demand:" in out
+    assert cli.main(["memory"]) == 0
+    out = capsys.readouterr().out
+    assert "== cluster memory ==" in out and "Per-node stores:" in out
+    assert cli.main(["memory", "--group-by", "creator"]) == 0
+    out = capsys.readouterr().out
+    assert "group" in out
+    del ref
+
+
+# ------------------------------------------------------ 2-node acceptance
+def test_two_node_memory_anatomy_acceptance(mem_reset, monkeypatch):
+    """Acceptance: a 32 MB worker-made object on the remote node appears in
+    cluster_memory_view() attributed to its creating task and node with
+    correct copy count/pin state; a replicated checkpoint shard shows 2
+    copies; an orphaned seal on the remote store flips to leak-suspect
+    after the grace window with a "mem" flight event. All waits ride the
+    module condition variable."""
+    os.environ["RAY_TPU_METRICS_PUSH_PERIOD_S"] = "0.5"
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state
+
+    mem = mem_reset
+    monkeypatch.setattr(mem, "LEAK_GRACE_S", 1.0)
+    monkeypatch.setattr(mem, "SWEEP_MIN_S", 0.1)
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        nid = cluster.add_node(num_cpus=2, real_process=True,
+                               isolated_plane=True)
+        strat = ray_tpu.NodeAffinitySchedulingStrategy(node_id=nid.hex())
+
+        @ray_tpu.remote(scheduling_strategy=strat)
+        def make_shard():
+            return np.ones(32 << 20, dtype=np.uint8)  # the 32 MB object
+
+        ref = make_shard.remote()
+        assert ray_tpu.wait([ref], timeout=180)[0]
+
+        oid_hex = ref.object_id().hex()
+
+        def remote_row():
+            rows = {r["object_id"]: r
+                    for r in state.cluster_memory_view()["objects"]}
+            r = rows.get(oid_hex)
+            # size is the serialized blob: >= the 32 MB payload
+            return (r if r and nid.hex() in r["nodes"]
+                    and r["size_bytes"] >= (32 << 20) else None)
+        assert mem.wait_until(lambda: remote_row() is not None, timeout=60)
+        row = remote_row()
+        # attribution: creating task + node; primary pinned on its node
+        assert row["creator"] == "make_shard"
+        assert row["creator_kind"] == "task"
+        assert row["creator_node"] == nid.hex()
+        assert row["ref_state"] == "referenced"
+        assert row["pinned"] is True
+        assert row["copies"] >= 1
+
+        # remote rows carry node_id: every reported node key is a real hex
+        view = state.cluster_memory_view()
+        assert nid.hex() in view["nodes"], view["nodes"].keys()
+
+        # replicated checkpoint shard: a second copy lands (head store)
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        got = rt.ensure_plane_replicas(ref.object_id(), copies=2,
+                                       timeout=120)
+        assert got >= 2
+        assert mem.wait_until(
+            lambda: (remote_row() or {}).get("copies", 0) >= 2, timeout=60)
+
+        # orphan seal on the REMOTE node's store: the leak shape, detected
+        # through the remote report pipeline end to end
+        @ray_tpu.remote(scheduling_strategy=strat)
+        def seal_orphan():
+            import ray_tpu as rt
+            from ray_tpu._private.ids import ObjectID as _OID
+            from ray_tpu.core import shm_store as _sm
+
+            # dial the head: the worker's metrics pusher only piggybacks a
+            # LIVE peer, and the orphan must ride this worker's mem_report
+            rt.get(rt.put(1))
+            stores = list(_sm._stores)
+            assert stores, "worker has no mapped plane store"
+            orphan = _OID.from_random()
+            stores[0].put_bytes(orphan, b"L" * (1 << 20))
+            return orphan.hex()
+
+        orphan_hex = ray_tpu.get(seal_orphan.remote(), timeout=120)
+        assert mem.wait_until(
+            lambda: any(r["object_id"] == orphan_hex and r["leak_suspect"]
+                        for r in
+                        state.cluster_memory_view()["objects"]),
+            timeout=90)
+        leaks = [e for e in state.flight_records("mem")
+                 if e["event"] == "leak_suspect"
+                 and e["object_id"] == orphan_hex]
+        assert leaks and nid.hex() in leaks[0]["nodes"]
+        del ref
+    finally:
+        cluster.shutdown()
+        os.environ.pop("RAY_TPU_METRICS_PUSH_PERIOD_S", None)
+
+
+# -------------------------------------------------------- metrics surface
+def test_plane_store_gauges_exposed(session):
+    from ray_tpu.util import metrics as rt_metrics
+
+    ref = ray_tpu.put(np.ones(1 << 18, dtype=np.uint8))
+    text = rt_metrics.prometheus_text()
+    assert "ray_tpu_plane_store_used_bytes" in text
+    assert "ray_tpu_plane_store_capacity_bytes" in text
+    assert "ray_tpu_plane_store_pinned_bytes" in text
+    assert "ray_tpu_plane_store_spilled_bytes" in text
+    del ref
+
+
+def test_timeline_carries_mem_counter_track(session, mem_reset):
+    from ray_tpu.util import state
+
+    mem = mem_reset
+    mem.ingest_remote(
+        "feedbeef", "agent-1",
+        {"store": {"used": 1 << 20, "cap": 4 << 20, "num": 1,
+                   "evictions": 0},
+         "objects": [[b"c" * 28, 1 << 20, time.time(), 1, 0,
+                      time.time()]]})
+    trace = state.timeline()
+    counters = [e for e in trace if e.get("ph") == "C"
+                and e.get("name") == "plane_store_bytes"]
+    assert counters, "no plane_store_bytes counter track in the export"
+    assert counters[0]["args"]["used"] == (1 << 20)
+    assert counters[0]["args"]["pinned"] == (1 << 20)
+
+
+def test_mem_report_rides_metrics_push_schema():
+    """The piggyback field exists, optional, on the since=5 op — the
+    baseline stays untouched (inbound-tolerant idiom)."""
+    from ray_tpu.core.rpc import schema
+
+    spec = schema.get_op("metrics_push")
+    assert spec.since == 5
+    fm = spec.field_map()
+    assert "mem_report" in fm
+    assert not fm["mem_report"].required
